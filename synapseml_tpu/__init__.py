@@ -1,0 +1,30 @@
+"""synapseml_tpu — a TPU-native ML framework with the capability surface of
+SynapseML/MMLSpark (reference: /root/reference), rebuilt on jax/XLA/Pallas.
+
+Layer map (SURVEY.md §1 → TPU-native):
+  core/      pipeline kernel + param system (SparkML plumbing analogue)
+  data/      columnar Table data plane + minibatch machinery
+  runtime/   device binding, jit-cached batched executor
+  parallel/  mesh bootstrap, ICI collectives, ring attention, MoE, pipeline par.
+  onnx/      ONNX -> jax importer + ONNXModel transformer
+  gbdt/      LightGBM-equivalent histogram GBDT on TPU
+  linear/    VW-equivalent hashed linear / contextual bandit learners
+  explainers/ LIME + KernelSHAP (tabular/vector/image/text)
+  featurize/ auto-featurization, indexing, text featurizers
+  train/     TrainClassifier/TrainRegressor, model statistics
+  automl/    hyperparameter search, FindBestModel
+  stages/    utility transformers
+  knn/       BallTree KNN / ConditionalKNN
+  recommendation/ SAR recommender + ranking evaluators
+  image/     image ops, ImageFeaturizer
+  dl/        deep-learning models (ResNet, tagger) + distributed trainer
+  io/        HTTP-on-Spark analogue, serving
+  utils/     cluster/fault/timing utilities
+"""
+__version__ = "0.1.0"
+
+from synapseml_tpu.core.param import Param, ComplexParam, Params
+from synapseml_tpu.core.pipeline import (
+    Estimator, Evaluator, Model, Pipeline, PipelineModel, PipelineStage, Transformer,
+)
+from synapseml_tpu.data.table import Table, concat_tables

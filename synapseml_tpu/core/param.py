@@ -1,0 +1,241 @@
+"""Typed parameter system for pipeline stages.
+
+TPU-native re-design of the reference's SparkML param plumbing:
+- ``ComplexParam`` side-file serialization (ref: core/src/main/scala/com/microsoft/ml/spark/core/serialize/ComplexParam.scala:13-34)
+- typed param zoo (ref: core/src/main/scala/org/apache/spark/ml/param/*.scala)
+- shared column traits (ref: core/.../core/contracts/Params.scala:9-101)
+
+Instead of JVM reflection + codegen, params are plain Python descriptors carrying
+name/doc/type/default plus JSON codecs; complex (non-JSON) values are written to
+side files next to ``metadata.json`` at save time.
+"""
+from __future__ import annotations
+
+import json
+import pickle
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+import numpy as np
+
+T = TypeVar("T")
+
+_UNSET = object()
+
+
+class Param(Generic[T]):
+    """A typed parameter attached to a :class:`Params` subclass.
+
+    Acts as a descriptor: ``stage.num_leaves`` reads the current value (or
+    default), ``stage.set(num_leaves=31)`` / ``stage.num_leaves = 31`` writes it.
+    """
+
+    __slots__ = ("name", "doc", "default", "type_check", "is_complex", "owner_cls")
+
+    def __init__(
+        self,
+        doc: str = "",
+        default: Any = _UNSET,
+        type_check: Optional[Callable[[Any], bool]] = None,
+        is_complex: bool = False,
+    ):
+        self.doc = doc
+        self.default = default
+        self.type_check = type_check
+        self.is_complex = is_complex
+        self.name: str = ""
+        self.owner_cls: Optional[type] = None
+
+    def __set_name__(self, owner, name):
+        self.name = name
+        self.owner_cls = owner
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj.get(self.name)
+
+    def __set__(self, obj, value):
+        obj.set(**{self.name: value})
+
+    def has_default(self) -> bool:
+        return self.default is not _UNSET
+
+    def validate(self, value):
+        if self.type_check is not None and value is not None:
+            if not self.type_check(value):
+                raise TypeError(
+                    f"Param {self.name!r} got invalid value {value!r}"
+                )
+        return value
+
+    def __repr__(self):
+        return f"Param({self.name!r})"
+
+
+class ComplexParam(Param):
+    """Param holding non-JSON-serializable values (models, arrays, callables).
+
+    Saved to a side file (``params/<name>.pkl`` or ``.npz``) at save time,
+    mirroring the reference's ComplexParam side-file scheme
+    (ref: core/.../core/serialize/ComplexParam.scala:13-34).
+    """
+
+    def __init__(self, doc: str = "", default: Any = _UNSET,
+                 type_check: Optional[Callable[[Any], bool]] = None):
+        super().__init__(doc, default, type_check, is_complex=True)
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class Params:
+    """Base class holding a bag of :class:`Param` values.
+
+    Unlike the reference's JVM reflection, param discovery is plain class-dict
+    walking; JSON round-trip covers simple params and side files cover complex
+    ones (see :mod:`synapseml_tpu.core.serde`).
+    """
+
+    def __init__(self, **kwargs):
+        self._paramMap: Dict[str, Any] = {}
+        if kwargs:
+            self.set(**kwargs)
+
+    # -- introspection -------------------------------------------------
+    @classmethod
+    def params(cls) -> Dict[str, Param]:
+        out: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    out[k] = v
+        return out
+
+    @classmethod
+    def param(cls, name: str) -> Param:
+        p = cls.params().get(name)
+        if p is None:
+            raise KeyError(f"{cls.__name__} has no param {name!r}")
+        return p
+
+    # -- get/set -------------------------------------------------------
+    def set(self, **kwargs) -> "Params":
+        for name, value in kwargs.items():
+            p = self.param(name)
+            self._paramMap[name] = p.validate(value)
+        return self
+
+    def get(self, name: str, default: Any = _UNSET) -> Any:
+        p = self.param(name)
+        if name in self._paramMap:
+            return self._paramMap[name]
+        if p.has_default():
+            return p.default
+        if default is not _UNSET:
+            return default
+        return None
+
+    def is_set(self, name: str) -> bool:
+        return name in self._paramMap
+
+    def is_defined(self, name: str) -> bool:
+        return self.is_set(name) or self.param(name).has_default()
+
+    def clear(self, name: str) -> "Params":
+        self._paramMap.pop(name, None)
+        return self
+
+    def explain_params(self) -> str:
+        lines = []
+        for name, p in sorted(self.params().items()):
+            cur = self._paramMap.get(name, p.default if p.has_default() else "<unset>")
+            lines.append(f"{name}: {p.doc} (current: {cur!r})")
+        return "\n".join(lines)
+
+    def copy(self, **overrides) -> "Params":
+        other = self.__class__.__new__(self.__class__)
+        Params.__init__(other)
+        other._paramMap = dict(self._paramMap)
+        other._post_copy(self)
+        if overrides:
+            other.set(**overrides)
+        return other
+
+    def _post_copy(self, src: "Params"):
+        """Hook for subclasses carrying non-param state (e.g. fitted models)."""
+
+    # -- serde ---------------------------------------------------------
+    def simple_param_json(self) -> str:
+        simple = {
+            k: v for k, v in self._paramMap.items()
+            if not self.param(k).is_complex
+        }
+        return json.dumps(simple, default=_json_default, sort_keys=True)
+
+    def complex_param_values(self) -> Dict[str, Any]:
+        return {
+            k: v for k, v in self._paramMap.items()
+            if self.param(k).is_complex
+        }
+
+    def load_simple_params(self, payload: str):
+        self._paramMap.update(json.loads(payload))
+
+    def save_complex_value(self, path: str, value: Any):
+        with open(path, "wb") as f:
+            pickle.dump(value, f)
+
+    def load_complex_value(self, path: str) -> Any:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Shared column traits (ref: core/.../core/contracts/Params.scala:9-101)
+# ---------------------------------------------------------------------------
+
+class HasInputCol(Params):
+    input_col = Param("name of the input column", default="input")
+
+
+class HasInputCols(Params):
+    input_cols = Param("names of the input columns", default=None)
+
+
+class HasOutputCol(Params):
+    output_col = Param("name of the output column", default="output")
+
+
+class HasOutputCols(Params):
+    output_cols = Param("names of the output columns", default=None)
+
+
+class HasLabelCol(Params):
+    label_col = Param("name of the label column", default="label")
+
+
+class HasFeaturesCol(Params):
+    features_col = Param("name of the features column", default="features")
+
+
+class HasWeightCol(Params):
+    weight_col = Param("name of the sample-weight column", default=None)
+
+
+class HasPredictionCol(Params):
+    prediction_col = Param("name of the prediction column", default="prediction")
+
+
+class HasProbabilityCol(Params):
+    probability_col = Param("probability column name", default="probability")
+
+
+class HasRawPredictionCol(Params):
+    raw_prediction_col = Param("raw prediction (margin) column", default="rawPrediction")

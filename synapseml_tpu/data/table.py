@@ -1,0 +1,216 @@
+"""Columnar Table — the framework's data plane.
+
+The reference rides Spark DataFrames (L1 in SURVEY.md); here the data plane is a
+lightweight immutable columnar table backed by numpy, with zero-copy pandas /
+pyarrow interop. TPU-first rationale: fixed-width columns (including 2-D
+"vector" columns) stay contiguous so host→device transfer of a whole batch is a
+single ``jax.device_put`` — the analogue of the reference's chunked SWIG array
+ingest (ref: lightgbm/.../dataset/DatasetAggregator.scala:69-180) without the
+JVM⇄native marshalling hot loop.
+
+Columns are 1-D numpy arrays (scalars, strings as object dtype) or 2-D numpy
+arrays ("vector" columns, the analogue of SparkML VectorUDT). Ragged data
+(token lists, variable images) uses 1-D object arrays.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+ColumnLike = Union[np.ndarray, Sequence[Any]]
+
+
+def _as_column(values: ColumnLike) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    values = list(values)
+    if values and isinstance(values[0], np.ndarray) and values[0].ndim >= 1:
+        shapes = {v.shape for v in values if isinstance(v, np.ndarray)}
+        if len(shapes) == 1:
+            return np.stack(values)
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+    arr = np.asarray(values)
+    if arr.dtype.kind in ("U", "S"):
+        arr = arr.astype(object)
+    return arr
+
+
+class Table:
+    """Immutable columnar table."""
+
+    __slots__ = ("_cols", "_n")
+
+    def __init__(self, columns: Dict[str, ColumnLike]):
+        cols: Dict[str, np.ndarray] = {}
+        n = None
+        for name, values in columns.items():
+            arr = _as_column(values)
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise ValueError(
+                    f"column {name!r} has length {len(arr)}, expected {n}"
+                )
+            cols[name] = arr
+        self._cols = cols
+        self._n = 0 if n is None else n
+
+    # -- construction --------------------------------------------------
+    @staticmethod
+    def from_pandas(df) -> "Table":
+        return Table({c: df[c].to_numpy() for c in df.columns})
+
+    @staticmethod
+    def from_rows(rows: Iterable[Dict[str, Any]]) -> "Table":
+        rows = list(rows)
+        if not rows:
+            return Table({})
+        names = list(rows[0].keys())
+        return Table({n: [r[n] for r in rows] for n in names})
+
+    @staticmethod
+    def from_arrow(arrow_table) -> "Table":
+        return Table.from_pandas(arrow_table.to_pandas())
+
+    # -- basic accessors ----------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self._n
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        if name not in self._cols:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        return self._cols[name]
+
+    def column(self, name: str) -> np.ndarray:
+        return self[name]
+
+    def schema(self) -> Dict[str, Tuple[Any, Tuple[int, ...]]]:
+        return {k: (v.dtype, v.shape[1:]) for k, v in self._cols.items()}
+
+    def rows(self) -> Iterator[Dict[str, Any]]:
+        for i in range(self._n):
+            yield {k: v[i] for k, v in self._cols.items()}
+
+    def to_pandas(self):
+        import pandas as pd
+        out = {}
+        for k, v in self._cols.items():
+            out[k] = list(v) if v.ndim > 1 else v
+        return pd.DataFrame(out)
+
+    # -- relational ops ------------------------------------------------
+    def select(self, *names: str) -> "Table":
+        return Table({n: self[n] for n in names})
+
+    def drop(self, *names: str) -> "Table":
+        return Table({k: v for k, v in self._cols.items() if k not in names})
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table({mapping.get(k, k): v for k, v in self._cols.items()})
+
+    def with_column(self, name: str, values: ColumnLike) -> "Table":
+        cols = dict(self._cols)
+        cols[name] = values
+        return Table(cols)
+
+    def with_columns(self, new: Dict[str, ColumnLike]) -> "Table":
+        cols = dict(self._cols)
+        cols.update(new)
+        return Table(cols)
+
+    def filter(self, mask: ColumnLike) -> "Table":
+        mask = np.asarray(mask, dtype=bool)
+        return Table({k: v[mask] for k, v in self._cols.items()})
+
+    def take(self, indices: ColumnLike) -> "Table":
+        idx = np.asarray(indices)
+        return Table({k: v[idx] for k, v in self._cols.items()})
+
+    def slice(self, start: int, stop: int) -> "Table":
+        return Table({k: v[start:stop] for k, v in self._cols.items()})
+
+    def head(self, n: int = 5) -> "Table":
+        return self.slice(0, min(n, self._n))
+
+    def sort(self, by: str, ascending: bool = True) -> "Table":
+        order = np.argsort(self[by], kind="stable")
+        if not ascending:
+            order = order[::-1]
+        return self.take(order)
+
+    def shuffle(self, seed: int = 0) -> "Table":
+        rng = np.random.default_rng(seed)
+        return self.take(rng.permutation(self._n))
+
+    def random_split(self, fractions: Sequence[float], seed: int = 0) -> List["Table"]:
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._n)
+        total = float(sum(fractions))
+        out, start = [], 0
+        for i, f in enumerate(fractions):
+            stop = self._n if i == len(fractions) - 1 else start + int(round(self._n * f / total))
+            out.append(self.take(perm[start:stop]))
+            start = stop
+        return out
+
+    def concat(self, *others: "Table") -> "Table":
+        tables = [self, *others]
+        cols = {}
+        for name in self.columns:
+            parts = [t[name] for t in tables]
+            if any(p.dtype == object for p in parts):
+                merged = np.empty(sum(len(p) for p in parts), dtype=object)
+                i = 0
+                for p in parts:
+                    merged[i:i + len(p)] = p
+                    i += len(p)
+                cols[name] = merged
+            else:
+                cols[name] = np.concatenate(parts)
+        return Table(cols)
+
+    def group_indices(self, by: str) -> Dict[Any, np.ndarray]:
+        """Map distinct value -> row indices (stable order)."""
+        out: Dict[Any, List[int]] = {}
+        col = self[by]
+        for i in range(self._n):
+            out.setdefault(col[i], []).append(i)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def iter_batches(self, batch_size: int) -> Iterator["Table"]:
+        for start in range(0, self._n, batch_size):
+            yield self.slice(start, start + batch_size)
+
+    def map_column(self, name: str, fn: Callable[[Any], Any],
+                   output: Optional[str] = None) -> "Table":
+        out = output or name
+        return self.with_column(out, [fn(v) for v in self[name]])
+
+    def __repr__(self):
+        parts = ", ".join(
+            f"{k}:{v.dtype}{list(v.shape[1:]) if v.ndim > 1 else ''}"
+            for k, v in self._cols.items()
+        )
+        return f"Table[{self._n} rows]({parts})"
+
+
+def concat_tables(tables: Sequence[Table]) -> Table:
+    tables = list(tables)
+    if not tables:
+        return Table({})
+    return tables[0].concat(*tables[1:])

@@ -1,0 +1,18 @@
+import os
+
+# Deterministic multi-device testing: 8 virtual CPU devices stand in for a TPU
+# slice (the analogue of the reference testing distributed paths on local[*],
+# SURVEY.md §4.4). Must be set before jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

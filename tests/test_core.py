@@ -1,0 +1,100 @@
+import os
+import numpy as np
+import pytest
+
+from synapseml_tpu import Param, Params, Pipeline, PipelineModel, Table, Transformer, Estimator, Model
+from synapseml_tpu.core.param import ComplexParam
+from synapseml_tpu.core.pipeline import PipelineStage
+from synapseml_tpu.data.batching import FixedMiniBatchTransformer, FlattenBatch
+
+
+class _Scaler(Transformer):
+    factor = Param("multiplier", default=2.0)
+    input_col = Param("in col", default="x")
+    output_col = Param("out col", default="y")
+
+    def _transform(self, t):
+        return t.with_column(self.output_col, t[self.input_col] * self.factor)
+
+
+class _MeanModel(Model):
+    mean = Param("fitted mean", default=0.0)
+
+    def _transform(self, t):
+        return t.with_column("centered", t["x"] - self.mean)
+
+
+class _MeanEstimator(Estimator):
+    def _fit(self, t):
+        return _MeanModel(mean=float(np.mean(t["x"])))
+
+
+def test_params_basics():
+    s = _Scaler()
+    assert s.factor == 2.0
+    s.set(factor=3.0)
+    assert s.factor == 3.0
+    s2 = s.copy(factor=4.0)
+    assert s2.factor == 4.0 and s.factor == 3.0
+    assert "factor" in s.explain_params()
+
+
+def test_table_ops():
+    t = Table({"x": [1.0, 2.0, 3.0], "name": ["a", "b", "c"]})
+    assert t.num_rows == 3
+    assert t.select("x").columns == ["x"]
+    t2 = t.filter(t["x"] > 1.5)
+    assert t2.num_rows == 2
+    t3 = t.with_column("v", np.ones((3, 4)))
+    assert t3["v"].shape == (3, 4)
+    splits = t.random_split([0.5, 0.5], seed=1)
+    assert sum(s.num_rows for s in splits) == 3
+    both = t.concat(t)
+    assert both.num_rows == 6
+
+
+def test_transform_and_fit():
+    t = Table({"x": np.arange(5.0)})
+    out = _Scaler().transform(t)
+    np.testing.assert_allclose(out["y"], 2.0 * np.arange(5.0))
+    model = _MeanEstimator().fit(t)
+    assert model.mean == 2.0
+    np.testing.assert_allclose(model.transform(t)["centered"], np.arange(5.0) - 2.0)
+
+
+def test_pipeline_fit_transform_save_load(tmp_path):
+    t = Table({"x": np.arange(6.0)})
+    pipe = Pipeline([_Scaler(factor=10.0), _MeanEstimator()])
+    pm = pipe.fit(t)
+    out = pm.transform(t)
+    assert "y" in out and "centered" in out
+
+    p = str(tmp_path / "pm")
+    pm.save(p)
+    pm2 = PipelineStage.load(p)
+    out2 = pm2.transform(t)
+    np.testing.assert_allclose(out2["centered"], out["centered"])
+    # estimator pipeline roundtrip too
+    pdir = str(tmp_path / "pipe")
+    pipe.save(pdir)
+    pipe2 = PipelineStage.load(pdir)
+    assert len(pipe2.stages) == 2
+    assert pipe2.stages[0].factor == 10.0
+
+
+def test_stage_save_load_roundtrip(tmp_path):
+    s = _Scaler(factor=7.0)
+    p = str(tmp_path / "s")
+    s.save(p)
+    s2 = PipelineStage.load(p)
+    assert isinstance(s2, _Scaler) and s2.factor == 7.0 and s2.uid == s.uid
+
+
+def test_minibatch_flatten_roundtrip():
+    t = Table({"x": np.arange(10.0), "s": [f"r{i}" for i in range(10)]})
+    batched = FixedMiniBatchTransformer(batch_size=3).transform(t)
+    assert batched.num_rows == 4
+    assert len(batched["x"][0]) == 3 and len(batched["x"][3]) == 1
+    flat = FlattenBatch().transform(batched)
+    assert flat.num_rows == 10
+    np.testing.assert_allclose(np.asarray(flat["x"], dtype=float), np.arange(10.0))
