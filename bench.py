@@ -1,0 +1,59 @@
+"""Benchmark driver — prints ONE JSON line.
+
+North-star metric (BASELINE.md): ONNX ResNet-50 inference images/sec/chip,
+target >= 1x GPU-VM throughput on the "ONNX - Inference on Spark" workload.
+The reference publishes no number; we take 1000 images/sec/chip as the
+nominal GPU-VM (T4-class, ORT-CUDA fp16, bs128) baseline for vs_baseline.
+
+Runs on whatever jax.devices() provides (the real TPU chip under the driver).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from synapseml_tpu.dl.resnet import init_resnet, resnet50
+
+    batch = 128
+    model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
+    variables = init_resnet(model, jax.random.PRNGKey(0), image_size=224)
+
+    @jax.jit
+    def forward(images):
+        return model.apply(variables, images, train=False)
+
+    images = jnp.asarray(
+        np.random.default_rng(0).standard_normal((batch, 224, 224, 3)),
+        dtype=jnp.bfloat16)
+
+    # compile + warmup
+    forward(images).block_until_ready()
+    for _ in range(3):
+        forward(images).block_until_ready()
+
+    iters = 20
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = forward(images)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = batch * iters / elapsed
+    gpu_vm_baseline = 1000.0  # nominal GPU-VM ResNet-50 fp16 inference img/s
+    print(json.dumps({
+        "metric": "resnet50_inference_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / gpu_vm_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,7 +44,8 @@ class _BatcherBase(Transformer):
 class FixedMiniBatchTransformer(_BatcherBase):
     """Pack rows into fixed-size batches (ref: MiniBatchTransformer.scala:150)."""
 
-    batch_size = Param("rows per batch", default=32)
+    batch_size = Param("rows per batch", default=32,
+                       type_check=lambda v: isinstance(v, int) and v > 0)
     buffered = Param("unused compat flag (reference buffers on a thread)", default=False)
     max_buffer_size = Param("compat", default=2147483647)
 

@@ -1,0 +1,131 @@
+"""ResNet family in flax — the flagship inference model.
+
+The reference runs ResNet-50 through onnxruntime-CUDA
+(ref: deep-learning/.../onnx/ONNXModel.scala:422-684, notebook
+"ONNX - Inference on Spark"). Here the flagship path is a native flax
+implementation compiled by XLA onto the MXU: NHWC layout (TPU-preferred),
+bf16 compute with f32 batch-norm statistics, and an optional truncation
+point so :class:`synapseml_tpu.image.featurizer.ImageFeaturizer` can reuse
+the same network headless (the CNTK ``cutOutputLayers`` analogue,
+ref: deep-learning/.../cntk/ImageFeaturizer.scala:100-125).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """NHWC ResNet. ``num_classes=None`` -> pooled features (headless)."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: Optional[int] = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, capture: Optional[list] = None):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, strides,
+                                   conv=conv, norm=norm,
+                                   name=f"stage{i}_block{j}")(x)
+            if capture is not None:
+                capture.append(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        features = x.astype(jnp.float32)
+        if self.num_classes is None:
+            return features
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(features)
+        return logits.astype(jnp.float32)
+
+
+def resnet18(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes, dtype=dtype)
+
+
+def resnet34(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([3, 4, 6, 3], BasicBlock, num_classes, dtype=dtype)
+
+
+def resnet50(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes, dtype=dtype)
+
+
+def resnet101(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes, dtype=dtype)
+
+
+def init_resnet(model: ResNet, rng: jax.Array, image_size: int = 224):
+    variables = model.init(rng, jnp.zeros((1, image_size, image_size, 3),
+                                          jnp.float32), train=False)
+    return variables
+
+
+def make_forward(model: ResNet, variables) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    def forward(images):
+        return model.apply(variables, images, train=False)
+    return forward

@@ -1,0 +1,247 @@
+"""Sequence tagger — the pod-scale sequence-model family.
+
+The reference's sequence model is a CNTK BiLSTM run one batch at a time for
+medical entity extraction (SURVEY.md §5 long-context: "absent";
+BASELINE.json config #5 "pod-scale"). The TPU-native design replaces it with
+a transformer encoder tagger built to shard over the full 5-axis mesh:
+
+  dp — batch          sp — sequence (ring attention over ICI)
+  tp — heads / ffn    ep — MoE experts      pp — stacked pipeline stages
+
+Parameters are plain pytrees with explicit ``NamedSharding`` trees (GSPMD
+inserts collectives); attention optionally runs through the manual
+shard_map ring kernel (:mod:`synapseml_tpu.parallel.ring_attention`).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from synapseml_tpu.parallel.moe import moe_ffn
+from synapseml_tpu.parallel.ring_attention import (
+    dense_attention, make_ring_attention, make_ulysses_attention)
+
+
+@dataclasses.dataclass(frozen=True)
+class TaggerConfig:
+    vocab_size: int = 4096
+    num_tags: int = 16
+    d_model: int = 64
+    num_heads: int = 4
+    head_dim: int = 16
+    ffn_dim: int = 128
+    num_stages: int = 2          # pipeline stages (stacked, sharded over pp)
+    layers_per_stage: int = 1
+    num_experts: int = 4
+    top_k: int = 2
+    max_seq_len: int = 512
+    attention: str = "ring"      # ring | ulysses | dense
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def for_mesh(mesh: Mesh, **overrides) -> "TaggerConfig":
+        """Smallest config whose dims are divisible by the mesh axes."""
+        def up(n, m):
+            return ((n + m - 1) // m) * m
+
+        ax = dict(mesh.shape)
+        pp, tp, ep = ax.get("pp", 1), ax.get("tp", 1), ax.get("ep", 1)
+        base = dict(
+            num_stages=up(max(2, pp), pp),
+            num_heads=up(max(4, tp), tp),
+            num_experts=up(max(2, ep), ep),
+        )
+        base.update(overrides)
+        cfg = TaggerConfig(**base)
+        # round sharded dims up to mesh divisibility (tp shards d_model/ffn,
+        # ep shards experts, pp shards the stage stack)
+        fixed = dataclasses.replace(
+            cfg,
+            num_stages=up(cfg.num_stages, pp),
+            num_heads=up(cfg.num_heads, tp),
+            num_experts=up(cfg.num_experts, ep),
+            d_model=up(cfg.d_model, tp),
+            ffn_dim=up(cfg.ffn_dim, tp),
+        )
+        return fixed
+
+
+def _init(rng: np.random.Generator, shape, scale=None):
+    scale = scale if scale is not None else 1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1])
+    return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+
+def init_params(cfg: TaggerConfig, seed: int = 0) -> Dict[str, Any]:
+    r = np.random.default_rng(seed)
+    s, l = cfg.num_stages, cfg.layers_per_stage
+    d, h, dh, f, e = (cfg.d_model, cfg.num_heads, cfg.head_dim,
+                      cfg.ffn_dim, cfg.num_experts)
+    return {
+        "embed": _init(r, (cfg.vocab_size, d), scale=0.02),
+        "stages": {
+            "ln1": np.ones((s, l, d), np.float32),
+            "ln2": np.ones((s, l, d), np.float32),
+            "wq": _init(r, (s, l, d, h, dh)),
+            "wk": _init(r, (s, l, d, h, dh)),
+            "wv": _init(r, (s, l, d, h, dh)),
+            "wo": _init(r, (s, l, h, dh, d), scale=1.0 / np.sqrt(h * dh)),
+            "gate": _init(r, (s, l, d, e)),
+            "w1": _init(r, (s, l, e, d, f)),
+            "w2": _init(r, (s, l, e, f, d), scale=1.0 / np.sqrt(f)),
+        },
+        "ln_f": np.ones((d,), np.float32),
+        "head": _init(r, (d, cfg.num_tags)),
+    }
+
+
+def param_specs(cfg: TaggerConfig) -> Dict[str, Any]:
+    """PartitionSpec tree mirroring :func:`init_params`."""
+    return {
+        "embed": P(None, "tp"),
+        "stages": {
+            "ln1": P("pp"),
+            "ln2": P("pp"),
+            "wq": P("pp", None, None, "tp", None),
+            "wk": P("pp", None, None, "tp", None),
+            "wv": P("pp", None, None, "tp", None),
+            "wo": P("pp", None, "tp", None, None),
+            "gate": P("pp", None, None, "ep"),
+            "w1": P("pp", None, "ep", None, "tp"),
+            "w2": P("pp", None, "ep", "tp", None),
+        },
+        "ln_f": P(),
+        "head": P(),
+    }
+
+
+def shard_params(params, mesh: Mesh):
+    specs = param_specs(TaggerConfig())  # structure-only; sizes irrelevant
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs)
+
+
+def _layer_norm(x, scale):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale).astype(x.dtype)
+
+
+def _rope(x, positions):
+    """Rotary embedding. x: [B, S, H, D], positions: [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def make_apply(cfg: TaggerConfig, mesh: Optional[Mesh] = None) -> Callable:
+    """Build the forward function. With a mesh, activations carry sharding
+    constraints and attention uses the requested sequence-parallel kernel."""
+
+    if mesh is not None and cfg.attention == "ring":
+        attn_fn = make_ring_attention(mesh)
+    elif mesh is not None and cfg.attention == "ulysses":
+        attn_fn = make_ulysses_attention(mesh)
+    else:
+        attn_fn = partial(dense_attention, causal=False)
+
+    def wsc(x, *spec):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+    def block(x, w, positions):
+        # attention
+        y = _layer_norm(x, w["ln1"])
+        q = jnp.einsum("bsd,dhe->bshe", y, w["wq"].astype(y.dtype))
+        k = jnp.einsum("bsd,dhe->bshe", y, w["wk"].astype(y.dtype))
+        v = jnp.einsum("bsd,dhe->bshe", y, w["wv"].astype(y.dtype))
+        q, k = _rope(q, positions), _rope(k, positions)
+        q = wsc(q, "dp", "sp", "tp", None)
+        k = wsc(k, "dp", "sp", "tp", None)
+        v = wsc(v, "dp", "sp", "tp", None)
+        a = attn_fn(q, k, v)
+        a = jnp.einsum("bshe,hed->bsd", a, w["wo"].astype(a.dtype))
+        x = x + wsc(a, "dp", "sp", None)
+        # MoE FFN
+        y = _layer_norm(x, w["ln2"])
+        expert_spec = (NamedSharding(mesh, P("dp", "sp", "ep", None))
+                       if mesh is not None else None)
+        m, aux = moe_ffn(y, w["gate"].astype(y.dtype),
+                         w["w1"].astype(y.dtype), w["w2"].astype(y.dtype),
+                         top_k=cfg.top_k, expert_spec=expert_spec)
+        x = x + wsc(m, "dp", "sp", None)
+        return x, aux
+
+    def apply(params, tokens):
+        # tokens: [B, S] int32
+        positions = jnp.arange(tokens.shape[1])
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = wsc(x, "dp", "sp", None)
+        aux_total = jnp.zeros((), jnp.float32)
+
+        def layer_step(carry, w):
+            x, aux = carry
+            x, a = block(x, w, positions)
+            return (x, aux + a), None
+
+        def stage_step(carry, stage_w):
+            # scan over the layers of one pipeline stage
+            (x, aux), _ = jax.lax.scan(layer_step, carry, stage_w)
+            return (x, aux), None
+
+        (x, aux_total), _ = jax.lax.scan(
+            stage_step, (x, aux_total), params["stages"])
+        x = _layer_norm(x, params["ln_f"])
+        logits = jnp.einsum("bsd,dt->bst", x.astype(jnp.float32),
+                            params["head"])
+        return logits, aux_total
+
+    return apply
+
+
+def tagging_loss(logits, labels, mask, aux, aux_weight=0.01):
+    """Token-level cross entropy. labels: [B,S] int, mask: [B,S] bool."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1)
+    return (nll * mask).sum() / denom + aux_weight * aux
+
+
+def make_train_step(cfg: TaggerConfig, mesh: Mesh, learning_rate: float = 1e-3):
+    """Jitted sharded train step: (params, opt_state, batch) -> (params, opt_state, loss)."""
+    apply = make_apply(cfg, mesh)
+    tx = optax.adamw(learning_rate)
+
+    def loss_fn(params, tokens, labels, mask):
+        logits, aux = apply(params, tokens)
+        return tagging_loss(logits, labels, mask, aux)
+
+    def train_step(params, opt_state, tokens, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels, mask)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    batch_shard = NamedSharding(mesh, P("dp", "sp"))
+
+    def init_state(seed: int = 0):
+        params = shard_params(init_params(cfg, seed), mesh)
+        opt_state = tx.init(params)
+        return params, opt_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    return jitted, init_state, batch_shard

@@ -1,0 +1,55 @@
+"""Thin wrappers over XLA collectives used throughout the framework.
+
+The reference's per-iteration data plane is TCP: lib_lightgbm's internal
+socket collectives and VW's spanning-tree AllReduce (SURVEY.md §2.10).
+Here every collective is an XLA op riding ICI (intra-slice) / DCN
+(multi-slice), inserted either explicitly inside ``shard_map`` regions or
+automatically by GSPMD from sharding annotations.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def all_reduce_sum(x, axis: AxisName):
+    return lax.psum(x, axis)
+
+
+def all_reduce_mean(x, axis: AxisName):
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: AxisName, *, gather_axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ring_permute(x, axis: str, shift: int = 1):
+    """Send this shard to the next rank on ``axis`` (a ring step)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def barrier_sum(axis: AxisName):
+    """Cheap gang barrier: psum of a scalar. The TPU analogue of the
+    reference's BarrierTaskContext.barrier() gang scheduling
+    (ref: lightgbm/.../LightGBMBase.scala:482-483)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis)

@@ -1,0 +1,119 @@
+"""Device-mesh bootstrap — the TPU-native control/data plane.
+
+Replaces the reference's socket rendezvous + native ring topologies
+(ref: lightgbm/.../LightGBMBase.scala:394-432 createDriverNodesThread,
+lightgbm/.../TrainUtils.scala:236-295 getNetworkInitNodes/networkInit,
+vw/.../VowpalWabbitBase.scala:434-462 spanning tree): instead of exchanging
+``host:port`` lists over TCP and letting the native engine build its own
+collectives, we build a named :class:`jax.sharding.Mesh` over the slice and
+let XLA insert ICI collectives (psum / all_gather / reduce_scatter /
+ppermute). Multi-host joins the mesh via ``jax.distributed.initialize`` —
+see :mod:`synapseml_tpu.parallel.distributed`.
+
+Mesh axes (the framework's canonical names):
+  dp — data parallel (batch)          sp — sequence/context parallel
+  pp — pipeline parallel (stages)     tp — tensor parallel (heads / ffn)
+  ep — expert parallel (MoE)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+def _prime_factors(n: int) -> List[int]:
+    out, d = [], 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
+def factor_axes(
+    n_devices: int,
+    want: Optional[Dict[str, int]] = None,
+) -> Dict[str, int]:
+    """Factor ``n_devices`` into the five canonical axes.
+
+    Explicit sizes in ``want`` are honored (their product must divide
+    n_devices); the remainder is distributed round-robin over the unpinned
+    model axes (tp, sp, pp) before spilling into dp, so a pure power-of-two
+    slice exercises every parallelism style.
+    """
+    want = dict(want or {})
+    sizes = {a: want.get(a, 0) for a in AXES}
+    pinned = int(np.prod([v for v in sizes.values() if v > 0])) if any(
+        v > 0 for v in sizes.values()) else 1
+    if n_devices % pinned != 0:
+        raise ValueError(
+            f"pinned axes product {pinned} does not divide {n_devices}")
+    rest = n_devices // pinned
+    free = [a for a in ("tp", "sp", "pp") if sizes[a] == 0]
+    for a in AXES:
+        if sizes[a] == 0:
+            sizes[a] = 1
+    for p in _prime_factors(rest):
+        # spread model-parallel factors first, then pile the rest onto dp
+        target = None
+        for a in free:
+            if sizes[a] == 1:
+                target = a
+                break
+        if target is None:
+            target = "dp"
+        sizes[target] *= p
+    assert int(np.prod(list(sizes.values()))) == n_devices
+    return sizes
+
+
+def build_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    want: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = factor_axes(len(devices), want)
+    shape = tuple(sizes[a] for a in AXES)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape((1,) * len(AXES)), AXES)
+
+
+# -- sharding helpers -------------------------------------------------------
+
+def data_sharding(mesh: Mesh, *trailing: Optional[str]) -> NamedSharding:
+    """Batch axis sharded over dp (and sp if free); trailing dims as given."""
+    return NamedSharding(mesh, P("dp", *trailing))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, arr, batch_axes: Tuple[str, ...] = ("dp",)):
+    return jax.device_put(arr, NamedSharding(mesh, P(batch_axes)))
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name]
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0) -> Tuple[np.ndarray, int]:
+    n = arr.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return arr, n
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(arr, widths), n

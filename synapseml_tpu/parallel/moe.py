@@ -1,0 +1,55 @@
+"""Mixture-of-Experts layer with expert parallelism over the ``ep`` mesh axis.
+
+Absent in the reference (SURVEY.md §2.10) but first-class here. Round-1
+implementation is dense-dispatch: every expert's FFN is evaluated for every
+token as one big einsum with the expert dimension sharded over ``ep`` (GSPMD
+turns the final combine into a reduce over ICI). This keeps shapes static
+(XLA-friendly, no capacity-overflow dynamic shapes); a capacity-based
+all-to-all dispatch is the planned optimization for large expert counts.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _wsc(x, spec):
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def topk_gating(gate_logits: jnp.ndarray, top_k: int):
+    """Top-k softmax gating with renormalization. gate_logits: [..., E]."""
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    top_vals, _ = jax.lax.top_k(probs, top_k)
+    threshold = top_vals[..., -1:]
+    masked = jnp.where(probs >= threshold, probs, 0.0)
+    return masked / (masked.sum(axis=-1, keepdims=True) + 1e-9)
+
+
+def moe_ffn(
+    x: jnp.ndarray,          # [B, S, D]
+    gate_w: jnp.ndarray,     # [D, E]
+    w1: jnp.ndarray,         # [E, D, F]
+    w2: jnp.ndarray,         # [E, F, D]
+    *,
+    top_k: int = 2,
+    activation=jax.nn.gelu,
+    expert_spec: Optional[P] = None,
+):
+    """Dense-dispatch MoE feed-forward. Returns ([B,S,D], aux_loss)."""
+    gates = topk_gating(jnp.einsum("bsd,de->bse", x, gate_w), top_k)  # [B,S,E]
+    h = jnp.einsum("bsd,edf->bsef", x, w1)
+    if expert_spec is not None:
+        h = _wsc(h, expert_spec)
+    h = activation(h)
+    y = jnp.einsum("bsef,efd->bsed", h, w2)
+    out = jnp.einsum("bse,bsed->bsd", gates.astype(y.dtype), y)
+    # load-balancing auxiliary loss (Switch-style): E * sum_e f_e * p_e
+    e = gate_w.shape[-1]
+    frac = (gates > 0).astype(jnp.float32).mean(axis=(0, 1))
+    prob = gates.mean(axis=(0, 1))
+    aux = e * jnp.sum(frac * prob)
+    return out, aux

@@ -1,0 +1,158 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference has no sequence parallelism (SURVEY.md §2.10: "absent in
+reference"); it is first-class here because long-context models shard the
+sequence dimension across chips. Design: blockwise attention with an online
+softmax accumulator; K/V blocks rotate around the ``sp`` ring via
+``lax.ppermute`` so each device only ever holds one sequence block of K/V
+while computing attention for its local Q block. Communication overlaps the
+per-block matmuls and total memory is O(S/sp) per chip.
+
+Also provides Ulysses-style all-to-all sequence parallelism
+(head-scatter/seq-gather) as an alternative when head count ≥ sp size.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def dense_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+    """Reference (single-device) attention. q,k,v: [B, S, H, D]."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q * s, k)
+    if causal:
+        qi = jnp.arange(q.shape[1])[:, None]
+        ki = jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where((ki > qi)[None, None], NEG_INF, logits)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _block_step(q, k, v, m, l, o, mask):
+    """One blockwise-attention accumulation step with online softmax.
+
+    q: [B, Sq, H, D]; k,v: [B, Sk, H, D]; m,l: [B, H, Sq]; o: [B, Sq, H, D];
+    mask: [Sq, Sk] boolean (True = attend) or None.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    p = jnp.exp(logits - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
+    """Body run per-device inside shard_map. q,k,v are local blocks."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    n = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+
+    q = (q * scale).astype(q.dtype)
+    m = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    o = jnp.zeros((b, sq, h, d), jnp.float32)
+
+    def compute(step, k_blk, v_blk, m, l, o):
+        # K/V block currently held arrived from rank (rank - step) % n
+        src = (rank - step) % n
+        if causal:
+            q_pos = rank * sq + jnp.arange(sq)[:, None]
+            k_pos = src * sk + jnp.arange(sk)[None, :]
+            mask = k_pos <= q_pos
+        else:
+            mask = None
+        return _block_step(q, k_blk, v_blk, m, l, o, mask)
+
+    def body(step, carry):
+        k_blk, v_blk, m, l, o = carry
+        m, l, o = compute(step, k_blk, v_blk, m, l, o)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return k_blk, v_blk, m, l, o
+
+    # n-1 (compute, rotate) steps, then a final compute with no wasted rotate
+    k, v, m, l, o = lax.fori_loop(0, n - 1, body, (k, v, m, l, o))
+    m, l, o = compute(n - 1, k, v, m, l, o)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sp",
+    head_axis: Optional[str] = "tp",
+    batch_axis: str = "dp",
+    causal: bool = False,
+):
+    """Build a ring-attention callable over ``mesh``.
+
+    Inputs q,k,v are GLOBAL [B, S, H, D] arrays (jit-traced values); shard_map
+    splits B over dp, S over sp, H over tp. Differentiable (ppermute has a
+    transpose rule), so it drops into training steps.
+    """
+    spec = P(batch_axis, seq_axis, head_axis, None)
+
+    def fn(q, k, v):
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        local = partial(_ring_attention_local, axis=seq_axis,
+                        causal=causal, scale=scale)
+        return shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    return fn
+
+
+def make_ulysses_attention(
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_axis: str = "dp",
+    head_axis: Optional[str] = "tp",
+    causal: bool = False,
+):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Each device trades its sequence shard of all heads for all sequence of a
+    head shard (all_to_all over sp), runs dense attention on whole sequences
+    of its local heads, then trades back.  Requires H % (sp*tp) == 0.
+    """
+    spec = P(batch_axis, seq_axis, head_axis, None)
+
+    def local(q, k, v):
+        def a2a(x, split_head=True):
+            # [B, S_loc, H_loc, D] -> [B, S, H_loc/sp, D] (or inverse)
+            if split_head:
+                return lax.all_to_all(x, seq_axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+            return lax.all_to_all(x, seq_axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        qg, kg, vg = a2a(q), a2a(k), a2a(v)
+        out = dense_attention(qg, kg, vg, causal=causal)
+        return a2a(out, split_head=False)
+
+    def fn(q, k, v):
+        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    return fn

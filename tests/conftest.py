@@ -3,11 +3,18 @@ import os
 # Deterministic multi-device testing: 8 virtual CPU devices stand in for a TPU
 # slice (the analogue of the reference testing distributed paths on local[*],
 # SURVEY.md §4.4). Must be set before jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may point at a TPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The container's sitecustomize imports jax at interpreter startup (axon TPU
+# registration), so jax's config has already captured JAX_PLATFORMS=axon.
+# Override it at the config level before any backend is created.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from synapseml_tpu.runtime.executor import BatchedExecutor, coerce_host_array, round_up_pow2
+
+
+def test_round_up_pow2():
+    assert round_up_pow2(1) == 8
+    assert round_up_pow2(8) == 8
+    assert round_up_pow2(9) == 16
+    assert round_up_pow2(100) == 128
+
+
+def test_coerce_host_array():
+    a = np.arange(4, dtype=np.float64)
+    assert coerce_host_array(a).dtype == np.float32
+    assert coerce_host_array(np.arange(4, dtype=np.int64)).dtype == np.int32
+    assert coerce_host_array(a, jnp.bfloat16).dtype == jnp.bfloat16
+
+
+def test_batched_executor_padding_and_bucketing():
+    calls = []
+
+    def fn(x):
+        calls.append(x.shape)
+        return x * 2.0
+
+    ex = BatchedExecutor(fn, min_bucket=8)
+    out, = ex(np.arange(5, dtype=np.float64))
+    np.testing.assert_allclose(out, np.arange(5) * 2.0)
+    assert out.shape == (5,)
+
+    out, = ex(np.arange(20, dtype=np.float64))
+    assert out.shape == (20,)
+    np.testing.assert_allclose(out, np.arange(20) * 2.0)
+
+
+def test_batched_executor_multi_output():
+    def fn(x, y):
+        return x + y, x - y
+
+    ex = BatchedExecutor(fn, min_bucket=4)
+    a = np.arange(10, dtype=np.float32)
+    b = np.ones(10, dtype=np.float32)
+    s, d = ex(a, b)
+    np.testing.assert_allclose(s, a + 1)
+    np.testing.assert_allclose(d, a - 1)
+
+
+def test_resnet_tiny_forward():
+    from synapseml_tpu.dl.resnet import ResNet, BasicBlock, init_resnet
+
+    model = ResNet([1, 1], BasicBlock, num_classes=10, num_filters=8,
+                   dtype=jnp.float32)
+    variables = init_resnet(model, jax.random.PRNGKey(0), image_size=32)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    logits = jax.jit(lambda im: model.apply(variables, im, train=False))(x)
+    assert logits.shape == (2, 10)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_resnet_headless_features():
+    from synapseml_tpu.dl.resnet import ResNet, BasicBlock, init_resnet
+
+    model = ResNet([1, 1], BasicBlock, num_classes=None, num_filters=8,
+                   dtype=jnp.float32)
+    variables = init_resnet(model, jax.random.PRNGKey(0), image_size=32)
+    feats = model.apply(variables, jnp.ones((2, 32, 32, 3)), train=False)
+    assert feats.shape == (2, 16)  # 8 * 2**(n_stages-1)
