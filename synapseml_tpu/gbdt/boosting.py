@@ -1,0 +1,781 @@
+"""Boosting loop + Booster model for the TPU GBDT engine.
+
+The reference's train loop lives in Scala driving native iterations
+(ref: lightgbm/.../TrainUtils.scala trainCore:92-159 — iteration loop, eval
+metrics, early stopping with improvement tolerance) over lib_lightgbm.
+Here the loop is Python orchestration around ONE jitted iteration step
+(grad/hess + bagging + tree build + score update all fused on device), and
+the model is a stack of flat tree arrays scanned on device at predict time.
+
+Boosting types: gbdt, goss (gradient one-side sampling), dart (dropout),
+rf (bagged random forest) — mirroring the reference's boostingType param
+(lightgbm/.../params/LightGBMParams.scala).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from synapseml_tpu.gbdt import objectives as obj
+from synapseml_tpu.gbdt.binning import BinMapper
+from synapseml_tpu.gbdt.grower import (
+    GrowerParams, Tree, build_tree, predict_tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class BoostParams:
+    objective: str = "binary"
+    boosting_type: str = "gbdt"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    max_depth: int = 0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    max_bin: int = 255
+    feature_fraction: float = 1.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    # goss
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    # dart
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    # multiclass
+    num_class: int = 1
+    sigmoid: float = 1.0
+    alpha: float = 0.9            # huber / quantile alpha
+    tweedie_variance_power: float = 1.5
+    poisson_max_delta_step: float = 0.7
+    boost_from_average: bool = True
+    max_position: int = 30      # lambdarank NDCG truncation
+    early_stopping_round: int = 0
+    metric: Optional[str] = None
+    seed: int = 0
+    deterministic: bool = True
+    categorical_features: Tuple[int, ...] = ()
+    verbosity: int = -1
+
+    def grower(self) -> GrowerParams:
+        return GrowerParams(
+            num_leaves=self.num_leaves,
+            max_bin=0,  # filled at fit time (device width)
+            max_depth=self.max_depth,
+            lambda_l1=self.lambda_l1,
+            lambda_l2=self.lambda_l2,
+            min_data_in_leaf=max(1, self.min_data_in_leaf),
+            min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.min_gain_to_split,
+        )
+
+
+def _objective_fn(p: BoostParams) -> Callable:
+    o = p.objective
+    if o in ("binary", "binary_logloss"):
+        return partial(obj.binary_logloss_obj, sigmoid=p.sigmoid)
+    if o in ("multiclass", "softmax", "multiclassova"):
+        return obj.softmax_obj
+    if o in ("lambdarank", "rank_xendcg"):
+        return None  # handled specially with group ids
+    if o == "huber":
+        return partial(obj.huber_obj, alpha=p.alpha)
+    if o == "quantile":
+        return partial(obj.quantile_obj, alpha=p.alpha)
+    if o == "tweedie":
+        return partial(obj.tweedie_obj, rho=p.tweedie_variance_power)
+    if o == "poisson":
+        return partial(obj.poisson_obj, max_delta_step=p.poisson_max_delta_step)
+    fn = obj.REGRESSION_OBJECTIVES.get(o)
+    if fn is None:
+        raise ValueError(f"unknown objective {o!r}")
+    return fn
+
+
+def _default_metric(p: BoostParams) -> str:
+    if p.metric:
+        return p.metric
+    if p.objective in ("binary", "binary_logloss"):
+        return "binary_logloss"
+    if p.objective in ("multiclass", "softmax", "multiclassova"):
+        return "multi_logloss"
+    if p.objective in ("lambdarank", "rank_xendcg"):
+        return "ndcg"
+    if p.objective in ("regression_l1", "l1", "mae"):
+        return "mae"
+    return "rmse"
+
+
+def _init_score(p: BoostParams, y: np.ndarray, weight: Optional[np.ndarray]):
+    """boost_from_average analogue of LightGBM's ObtainAutomaticInitialScore."""
+    if not p.boost_from_average:
+        return 0.0
+    w = weight if weight is not None else np.ones_like(y, dtype=np.float64)
+    if p.objective in ("binary", "binary_logloss"):
+        pbar = float(np.clip(np.average(y, weights=w), 1e-12, 1 - 1e-12))
+        return float(np.log(pbar / (1 - pbar)) / p.sigmoid)
+    if p.objective in ("poisson", "tweedie"):
+        mean = max(float(np.average(y, weights=w)), 1e-12)
+        return float(np.log(mean))
+    if p.objective == "quantile":
+        return float(np.quantile(y, p.alpha))
+    if p.objective in ("regression_l1", "l1", "mae", "huber", "mape"):
+        return float(np.median(y))
+    if p.objective in ("multiclass", "softmax", "multiclassova",
+                       "lambdarank", "rank_xendcg"):
+        return 0.0
+    return float(np.average(y, weights=w))
+
+
+@dataclasses.dataclass
+class Booster:
+    """Trained model: stacked tree arrays + metadata. Device-scannable."""
+    trees_feature: np.ndarray    # [T, M]
+    trees_threshold: np.ndarray  # [T, M]
+    trees_left: np.ndarray       # [T, M]
+    trees_right: np.ndarray      # [T, M]
+    trees_value: np.ndarray      # [T, M] (already shrunk by learning rate)
+    trees_cover: np.ndarray      # [T, M] training row count per node
+    trees_gain: np.ndarray       # [T, M] split gain per internal node
+    tree_weights: np.ndarray     # [T] (1.0 for gbdt; 1/T for rf; dart weights)
+    params: BoostParams = dataclasses.field(default_factory=BoostParams)
+    init_score: float = 0.0
+    num_class: int = 1
+    best_iteration: int = -1
+    num_features: int = -1
+    feature_names: Optional[List[str]] = None
+    feature_importance_split: Optional[np.ndarray] = None
+    feature_importance_gain: Optional[np.ndarray] = None
+    eval_history: Dict[str, List[float]] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_trees(self) -> int:
+        return self.trees_feature.shape[0]
+
+    def _raw_scores(self, x: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """[N] or [N, K] raw margin scores, computed with a device scan."""
+        x = np.asarray(x, dtype=np.float32)
+        if self.num_features > 0 and x.shape[1] != self.num_features:
+            raise ValueError(
+                f"feature width mismatch: model trained on "
+                f"{self.num_features} features, got {x.shape[1]}")
+        k = self.num_class
+        t = self.num_trees
+        if num_iteration and num_iteration > 0:
+            t = min(t, num_iteration * k)
+        elif self.best_iteration >= 0:
+            # after early stopping, default to the best iteration (LightGBM)
+            t = min(t, (self.best_iteration + 1) * k)
+        stack = (
+            jnp.asarray(self.trees_feature[:t]),
+            jnp.asarray(self.trees_threshold[:t]),
+            jnp.asarray(self.trees_left[:t]),
+            jnp.asarray(self.trees_right[:t]),
+            jnp.asarray(self.trees_value[:t]),
+        )
+        weights = jnp.asarray(self.tree_weights[:t], jnp.float32)
+        out = _predict_stack(stack, weights, jnp.asarray(x), k, t)
+        out = np.asarray(out) + self.init_score
+        return out if k > 1 else out[:, 0]
+
+    def predict_raw(self, x, num_iteration: int = -1):
+        return self._raw_scores(x, num_iteration)
+
+    def predict(self, x, num_iteration: int = -1):
+        raw = self._raw_scores(x, num_iteration)
+        o = self.params.objective
+        if o in ("binary", "binary_logloss"):
+            return 1.0 / (1.0 + np.exp(-self.params.sigmoid * raw))
+        if o in ("multiclass", "softmax"):
+            e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+            return e / e.sum(axis=-1, keepdims=True)
+        if o == "multiclassova":
+            s_ = 1.0 / (1.0 + np.exp(-self.params.sigmoid * raw))
+            return s_ / s_.sum(axis=-1, keepdims=True)
+        if o in ("poisson", "tweedie"):
+            return np.exp(raw)
+        return raw
+
+    def predict_leaf(self, x) -> np.ndarray:
+        """[N, T] leaf index per tree (parity with predictLeaf,
+        ref: lightgbm/.../LightGBMModelMethods.scala)."""
+        x = np.asarray(x, dtype=np.float32)
+        stack = (
+            jnp.asarray(self.trees_feature),
+            jnp.asarray(self.trees_threshold),
+            jnp.asarray(self.trees_left),
+            jnp.asarray(self.trees_right),
+        )
+        return np.asarray(_leaf_index_stack(stack, jnp.asarray(x)))
+
+    # -- persistence ---------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "params": dataclasses.asdict(self.params),
+            "init_score": self.init_score,
+            "num_class": self.num_class,
+            "best_iteration": self.best_iteration,
+            "num_features": self.num_features,
+            "feature_names": self.feature_names,
+            "trees": {
+                "feature": self.trees_feature.tolist(),
+                "threshold": self.trees_threshold.tolist(),
+                "left": self.trees_left.tolist(),
+                "right": self.trees_right.tolist(),
+                "value": self.trees_value.tolist(),
+                "cover": self.trees_cover.tolist(),
+                "gain": self.trees_gain.tolist(),
+                "weights": self.tree_weights.tolist(),
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Booster":
+        t = d["trees"]
+        params = d.get("params", {})
+        params["categorical_features"] = tuple(params.get("categorical_features", ()))
+        return Booster(
+            trees_feature=np.asarray(t["feature"], np.int32),
+            trees_threshold=np.asarray(t["threshold"], np.float32),
+            trees_left=np.asarray(t["left"], np.int32),
+            trees_right=np.asarray(t["right"], np.int32),
+            trees_value=np.asarray(t["value"], np.float32),
+            trees_cover=np.asarray(t.get("cover", np.zeros_like(t["value"])), np.float32),
+            trees_gain=np.asarray(t.get("gain", np.zeros_like(t["value"])), np.float32),
+            tree_weights=np.asarray(t["weights"], np.float32),
+            params=BoostParams(**params),
+            init_score=d.get("init_score", 0.0),
+            num_class=d.get("num_class", 1),
+            best_iteration=d.get("best_iteration", -1),
+            num_features=d.get("num_features", -1),
+            feature_names=d.get("feature_names"),
+        )
+
+    def save_string(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def load_string(s: str) -> "Booster":
+        return Booster.from_dict(json.loads(s))
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _predict_stack(stack, weights, x, k: int, t: int):
+    n = x.shape[0]
+
+    def body(carry, tree_w):
+        (feat, thr, left, right, value), w, idx = tree_w
+        pred = predict_tree((feat, thr, left, right, value), x) * w
+        carry = carry.at[:, idx % k].add(pred)
+        return carry, None
+
+    out = jnp.zeros((n, k), jnp.float32)
+    idxs = jnp.arange(t, dtype=jnp.int32)
+    out, _ = jax.lax.scan(body, out, (stack, weights, idxs))
+    return out
+
+
+@jax.jit
+def _leaf_index_stack(stack, x):
+    def body(_, tree):
+        feat, thr, left, right = tree
+        n = x.shape[0]
+        node = jnp.zeros(n, jnp.int32)
+        max_depth = feat.shape[0] // 2 + 1
+
+        def step(i, node):
+            is_leaf = feat[node] < 0
+            xv = x[jnp.arange(n), feat[node].clip(0)]
+            nxt = jnp.where(xv <= thr[node], left[node], right[node])
+            return jnp.where(is_leaf, node, nxt)
+
+        node = jax.lax.fori_loop(0, max_depth, step, node)
+        return None, node
+
+    _, leaves = jax.lax.scan(body, None, stack)
+    return leaves.T
+
+
+def train(
+    p: BoostParams,
+    x: np.ndarray,
+    y: np.ndarray,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    valid_sets: Sequence[Tuple[np.ndarray, np.ndarray]] = (),
+    feature_names: Optional[List[str]] = None,
+    mesh=None,
+) -> Booster:
+    """Train a Booster. ``mesh`` enables dp-sharded histogram training."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float32)
+    n, f = x.shape
+    k = p.num_class if p.objective in ("multiclass", "softmax", "multiclassova") else 1
+
+    mapper = BinMapper(max_bin=p.max_bin,
+                       categorical_features=p.categorical_features,
+                       seed=p.seed).fit(x)
+    binned_np = mapper.transform(x)
+    bdev = mapper.total_bins
+    gp = dataclasses.replace(p.grower(), max_bin=bdev)
+    thresholds = jnp.asarray(mapper.threshold_values(), jnp.float32)
+
+    binned = jnp.asarray(binned_np)
+    yd = jnp.asarray(y)
+    wd = jnp.asarray(weight, jnp.float32) if weight is not None else None
+    init = _init_score(p, y, weight)
+    obj_fn = _objective_fn(p)
+    is_rank = p.objective in ("lambdarank", "rank_xendcg")
+    group_ids = jnp.asarray(group, jnp.int32) if group is not None else None
+
+    if k > 1:
+        y_onehot = jax.nn.one_hot(yd.astype(jnp.int32), k)
+        scores = jnp.zeros((n, k), jnp.float32) + init
+    else:
+        scores = jnp.zeros(n, jnp.float32) + init
+
+    # -- jitted single-iteration step ----------------------------------
+    use_goss = p.boosting_type == "goss"
+    is_rf = p.boosting_type == "rf"
+    use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
+
+    feature_frac = p.feature_fraction
+
+    def compute_grad(scores, class_idx):
+        if k > 1:
+            g, h = obj_fn(scores, y_onehot, wd)
+            return g[:, class_idx], h[:, class_idx]
+        if is_rank:
+            g, h = obj.lambdarank_grad(scores, yd, group_ids,
+                                       max_dcg_pos=p.max_position)
+            if wd is not None:
+                g, h = g * wd, h * wd
+            return g, h
+        return obj_fn(scores, yd, wd)
+
+    def sample_mask_and_weights(grad, hess, key):
+        """bagging / GOSS row selection; returns (mask, grad, hess)."""
+        if use_goss:
+            a, b = p.top_rate, p.other_rate
+            n_top = max(1, int(a * n))
+            thresh = -jnp.sort(-jnp.abs(grad))[n_top - 1]
+            top = jnp.abs(grad) >= thresh
+            rand = jax.random.uniform(key, (n,)) < b
+            amp = (1.0 - a) / max(b, 1e-12)
+            small = (~top) & rand
+            mask = top | small
+            g = jnp.where(small, grad * amp, grad)
+            h = jnp.where(small, hess * amp, hess)
+            return mask, g, h
+        if use_bagging:
+            frac = p.bagging_fraction if not is_rf else (
+                p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632)
+            mask = jax.random.uniform(key, (n,)) < frac
+            return mask, grad, hess
+        return jnp.ones(n, jnp.bool_), grad, hess
+
+    def feature_mask(key):
+        if feature_frac >= 1.0:
+            return None
+        keep = max(1, int(round(feature_frac * f)))
+        perm = jax.random.permutation(key, f)
+        mask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
+        return mask
+
+    # -- distributed (data-parallel) path --------------------------------
+    # Rows shard over the mesh's dp axis; per-shard histograms are psum'ed
+    # over ICI inside build_tree, after which every rank takes identical
+    # split decisions (the TPU-native replacement for the reference's
+    # tree_learner=data_parallel socket reduce-scatter, SURVEY.md 2.10).
+    if mesh is not None:
+        return _train_distributed(
+            p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
+            thresholds, valid_sets, feature_names)
+
+    axis_name = None
+    renew_alpha = None
+    if k == 1 and p.objective in ("regression_l1", "l1", "mae"):
+        renew_alpha = 0.5
+    elif k == 1 and p.objective == "quantile":
+        renew_alpha = p.alpha
+
+    @jax.jit
+    def iteration(scores, key, class_idx):
+        base = jnp.full_like(scores, init) if is_rf else scores
+        g, h = compute_grad(base, class_idx)
+        k1, k2 = jax.random.split(key)
+        mask, g2, h2 = sample_mask_and_weights(g, h, k1)
+        fmask = feature_mask(k2)
+        gb = binned
+        if fmask is not None:
+            # masked-out features get the missing bin everywhere -> never split
+            gb = jnp.where(fmask[None, :], binned, bdev - 1)
+        tree, row_slot, slot_value, slot_node = build_tree(
+            gb, g2, h2, mask, thresholds, gp, axis_name)
+        if renew_alpha is not None:
+            # L1-family leaf renewal (LightGBM RenewTreeOutput): leaf output
+            # := alpha-quantile of residuals of the rows in the leaf.
+            residual = yd - scores
+
+            def leaf_quantile(slot):
+                r = jnp.where(row_slot == slot, residual, jnp.nan)
+                return jnp.nanquantile(r, renew_alpha)
+
+            renewed = jax.vmap(leaf_quantile)(jnp.arange(gp.num_leaves))
+            slot_value = jnp.where(jnp.isnan(renewed), slot_value, renewed)
+            # rebuild node-level leaf values from renewed slot values
+            m_nodes = tree.leaf_value.shape[0]
+            widx = jnp.where(slot_node >= 0, slot_node, m_nodes)
+            new_leaf = jnp.zeros(m_nodes, jnp.float32).at[widx].set(
+                slot_value, mode="drop")
+            tree = Tree(
+                split_feature=tree.split_feature, threshold=tree.threshold,
+                threshold_bin=tree.threshold_bin, left_child=tree.left_child,
+                right_child=tree.right_child, leaf_value=new_leaf,
+                cover=tree.cover, gain=tree.gain)
+        lr = 1.0 if is_rf else p.learning_rate
+        delta = lr * slot_value[row_slot]
+        if k > 1:
+            new_scores = scores.at[:, class_idx].add(delta)
+        else:
+            new_scores = scores + delta
+        scaled = Tree(
+            split_feature=tree.split_feature,
+            threshold=tree.threshold,
+            threshold_bin=tree.threshold_bin,
+            left_child=tree.left_child,
+            right_child=tree.right_child,
+            leaf_value=tree.leaf_value * lr,
+            cover=tree.cover,
+            gain=tree.gain,
+        )
+        return new_scores, scaled
+
+    if p.boosting_type == "dart":
+        if k > 1:
+            raise NotImplementedError("dart + multiclass not yet supported")
+        return _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init,
+                           n, f, valid_sets, feature_names)
+
+    # -- validation state ----------------------------------------------
+    metric_name = _default_metric(p)
+    metric_fn, larger_better = obj.METRICS.get(metric_name, (None, False))
+    valid_raw = []
+    _pt = jax.jit(predict_tree)
+    for vx, vy in valid_sets:
+        valid_raw.append([jnp.asarray(np.asarray(vx, np.float32)),
+                          jnp.asarray(np.asarray(vy, np.float32)),
+                          jnp.zeros((len(vy), k), jnp.float32) + init])
+
+    trees: List[Tree] = []
+    rng = jax.random.PRNGKey(p.seed)
+    best_score = -np.inf if larger_better else np.inf
+    best_iter = -1
+    history: Dict[str, List[float]] = {metric_name: []}
+    stop = False
+
+    for it in range(p.num_iterations):
+        for c in range(k):
+            rng, key = jax.random.split(rng)
+            scores, tree = iteration(scores, key, c)
+            for v in valid_raw:
+                vt = _pt(
+                    (tree.split_feature, tree.threshold,
+                     tree.left_child, tree.right_child, tree.leaf_value),
+                    v[0])
+                v[2] = v[2].at[:, c].add(vt)
+            trees.append(jax.tree_util.tree_map(np.asarray, tree))
+
+        if valid_raw and metric_fn is not None:
+            vx, vy, vscore = valid_raw[0]
+            if k > 1:
+                m = float(metric_fn(vscore, vy.astype(jnp.int32)))
+            else:
+                m = float(metric_fn(vscore[:, 0], vy))
+            history[metric_name].append(m)
+            improved = m > best_score if larger_better else m < best_score
+            if improved:
+                best_score, best_iter = m, it
+            elif (p.early_stopping_round > 0
+                  and it - best_iter >= p.early_stopping_round):
+                stop = True
+        if stop:
+            break
+
+    t_total = len(trees)
+    tree_weights = np.full(t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0,
+                           np.float32)
+    booster = Booster(
+        trees_feature=np.stack([t.split_feature for t in trees]),
+        trees_threshold=np.stack([t.threshold for t in trees]),
+        trees_left=np.stack([t.left_child for t in trees]),
+        trees_right=np.stack([t.right_child for t in trees]),
+        trees_value=np.stack([t.leaf_value for t in trees]),
+        trees_cover=np.stack([t.cover for t in trees]),
+        trees_gain=np.stack([t.gain for t in trees]),
+        tree_weights=tree_weights,
+        params=p,
+        init_score=init,
+        num_class=k,
+        best_iteration=best_iter,
+        num_features=f,
+        feature_names=feature_names,
+        eval_history=history,
+    )
+    booster.feature_importance_split, booster.feature_importance_gain = (
+        _importances(booster, f))
+    return booster
+
+
+def _importances(b: Booster, num_features: int):
+    split = np.zeros(num_features, np.float64)
+    gain = np.zeros(num_features, np.float64)
+    internal = b.trees_feature >= 0
+    np.add.at(split, b.trees_feature[internal], 1.0)
+    np.add.at(gain, b.trees_feature[internal], b.trees_gain[internal])
+    return split, gain
+
+
+def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
+                       bdev, thresholds, valid_sets, feature_names):
+    """dp-sharded training: shard_map over the mesh's 'dp' axis.
+
+    Supports row-wise objectives (binary / multiclass / regression family).
+    Ranking and GOSS need cross-shard coordination and currently fall back
+    to per-shard approximations or raise.
+    """
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if p.objective in ("lambdarank", "rank_xendcg"):
+        raise NotImplementedError(
+            "distributed lambdarank needs group-aligned sharding; "
+            "train single-device or pre-shard by query")
+    if p.boosting_type in ("goss", "dart"):
+        raise NotImplementedError(
+            f"distributed {p.boosting_type} needs cross-shard coordination; "
+            "use boosting_type='gbdt' or 'rf' on a mesh")
+
+    dpn = mesh.shape["dp"]
+    n0, f = binned_np.shape
+    pad = (-n0) % dpn
+    pad_mask_np = np.ones(n0 + pad, bool)
+    if pad:
+        binned_np = np.vstack([binned_np,
+                               np.zeros((pad, f), binned_np.dtype)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+        if weight is not None:
+            weight = np.concatenate([weight, np.zeros(pad, weight.dtype)])
+        pad_mask_np[n0:] = False
+    n = n0 + pad
+
+    row_spec = P("dp")
+    mat_spec = P("dp", None)
+    rep = P()
+
+    def put(arr, spec):
+        return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+    binned = put(binned_np, mat_spec)
+    yd = put(y.astype(np.float32), row_spec)
+    wd = put(weight.astype(np.float32), row_spec) if weight is not None else None
+    padm = put(pad_mask_np, row_spec)
+    y_onehot_spec = P("dp", None)
+    if k > 1:
+        yoh = put(jax.nn.one_hot(jnp.asarray(y.astype(np.int32)), k), y_onehot_spec)
+        scores = put(np.zeros((n, k), np.float32) + init, y_onehot_spec)
+    else:
+        yoh = None
+        scores = put(np.zeros(n, np.float32) + init, row_spec)
+
+    use_bagging = p.bagging_freq > 0 and p.bagging_fraction < 1.0
+    is_rf = p.boosting_type == "rf"
+
+    def local_iter(binned_l, yd_l, yoh_l, wd_l, padm_l, scores_l, key, cls):
+        base = jnp.full_like(scores_l, init) if is_rf else scores_l
+        if k > 1:
+            g, h = obj_fn(base, yoh_l, wd_l)
+            g, h = g[:, cls], h[:, cls]
+        else:
+            g, h = obj_fn(base, yd_l, wd_l)
+        mask = padm_l
+        if use_bagging or is_rf:
+            frac = p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632
+            bkey = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            mask = mask & (jax.random.uniform(bkey, mask.shape) < frac)
+        binned_use = binned_l
+        if p.feature_fraction < 1.0:
+            # same key on every rank -> identical feature subset mesh-wide
+            keep = max(1, int(round(p.feature_fraction * f)))
+            perm = jax.random.permutation(jax.random.fold_in(key, 17), f)
+            fmask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
+            binned_use = jnp.where(fmask[None, :], binned_l, bdev - 1)
+        tree, row_slot, slot_value, _ = build_tree(
+            binned_use, g, h, mask, thresholds, gp, axis_name="dp")
+        lr = 1.0 if is_rf else p.learning_rate
+        delta = lr * slot_value[row_slot]
+        if k > 1:
+            new_scores = scores_l.at[:, cls].add(delta)
+        else:
+            new_scores = scores_l + delta
+        scaled = Tree(
+            split_feature=tree.split_feature, threshold=tree.threshold,
+            threshold_bin=tree.threshold_bin, left_child=tree.left_child,
+            right_child=tree.right_child, leaf_value=tree.leaf_value * lr,
+            cover=tree.cover, gain=tree.gain)
+        return new_scores, scaled
+
+    score_spec = y_onehot_spec if k > 1 else row_spec
+    tree_spec = Tree(*([rep] * 8))
+
+    smapped = shard_map(
+        local_iter, mesh=mesh,
+        in_specs=(mat_spec, row_spec, (y_onehot_spec if k > 1 else None),
+                  (row_spec if wd is not None else None), row_spec,
+                  score_spec, rep, rep),
+        out_specs=(score_spec, tree_spec),
+        check_vma=False)
+    jitted = jax.jit(smapped)
+
+    metric_name = _default_metric(p)
+    metric_fn, larger_better = obj.METRICS.get(metric_name, (None, False))
+    valid_raw = []
+    _pt = jax.jit(predict_tree)
+    for vx, vy in valid_sets:
+        valid_raw.append([jnp.asarray(np.asarray(vx, np.float32)),
+                          jnp.asarray(np.asarray(vy, np.float32)),
+                          jnp.zeros((len(vy), k), jnp.float32) + init])
+
+    trees: List[Tree] = []
+    rng = jax.random.PRNGKey(p.seed)
+    best_score = -np.inf if larger_better else np.inf
+    best_iter = -1
+    history: Dict[str, List[float]] = {metric_name: []}
+    stop = False
+    for it in range(p.num_iterations):
+        for c in range(k):
+            rng, key = jax.random.split(rng)
+            scores, tree = jitted(binned, yd, yoh, wd, padm, scores, key,
+                                  jnp.int32(c))
+            for v in valid_raw:
+                vt = _pt((tree.split_feature, tree.threshold, tree.left_child,
+                          tree.right_child, tree.leaf_value), v[0])
+                v[2] = v[2].at[:, c].add(vt)
+            trees.append(jax.tree_util.tree_map(np.asarray, tree))
+        if valid_raw and metric_fn is not None:
+            _, vy_, vscore = valid_raw[0]
+            if k > 1:
+                m = float(metric_fn(vscore, vy_.astype(jnp.int32)))
+            else:
+                m = float(metric_fn(vscore[:, 0], vy_))
+            history[metric_name].append(m)
+            improved = m > best_score if larger_better else m < best_score
+            if improved:
+                best_score, best_iter = m, it
+            elif (p.early_stopping_round > 0
+                  and it - best_iter >= p.early_stopping_round):
+                stop = True
+        if stop:
+            break
+
+    t_total = len(trees)
+    tree_weights = np.full(
+        t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0, np.float32)
+    booster = Booster(
+        trees_feature=np.stack([t.split_feature for t in trees]),
+        trees_threshold=np.stack([t.threshold for t in trees]),
+        trees_left=np.stack([t.left_child for t in trees]),
+        trees_right=np.stack([t.right_child for t in trees]),
+        trees_value=np.stack([t.leaf_value for t in trees]),
+        trees_cover=np.stack([t.cover for t in trees]),
+        trees_gain=np.stack([t.gain for t in trees]),
+        tree_weights=tree_weights,
+        params=p, init_score=init, num_class=k, num_features=f,
+        best_iteration=best_iter, feature_names=feature_names,
+        eval_history=history)
+    booster.feature_importance_split, booster.feature_importance_gain = (
+        _importances(booster, f))
+    return booster
+
+
+def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
+                valid_sets, feature_names):
+    """DART boosting (Rashmi & Gilad-Bachrach): each round drops a random
+    subset of existing trees, fits the new tree against the reduced
+    ensemble, then renormalizes (paper normalization with shrinkage:
+    w_new = lr/(|D|+1), dropped *= |D|/(|D|+1)).
+
+    Per-tree train predictions are cached on device so score
+    reconstruction is a weighted sum, not a re-traversal.
+    """
+    @jax.jit
+    def fit_at(score_used, key):
+        g, h = obj_fn(score_used, yd, wd)
+        tree, row_slot, slot_value, _ = build_tree(
+            binned, g, h, jnp.ones(n, jnp.bool_), thresholds, gp, None)
+        return tree, slot_value[row_slot]
+
+    rng = np.random.default_rng(p.seed)
+    jkey = jax.random.PRNGKey(p.seed)
+    trees: List[Tree] = []
+    preds: List[jnp.ndarray] = []     # unscaled per-tree train predictions
+    weights: List[float] = []
+    base = jnp.zeros(n, jnp.float32) + init
+
+    for it in range(p.num_iterations):
+        t = len(trees)
+        if t == 0 or rng.random() < p.skip_drop:
+            dropped = np.empty(0, np.int64)
+        else:
+            sel = rng.random(t) < p.drop_rate
+            dropped = np.nonzero(sel)[0][: p.max_drop]
+        w = np.asarray(weights, np.float32)
+        if len(dropped):
+            w_used = w.copy()
+            w_used[dropped] = 0.0
+        else:
+            w_used = w
+        score_used = base
+        if t:
+            score_used = base + jnp.einsum(
+                "t,tn->n", jnp.asarray(w_used), jnp.stack(preds))
+        jkey, sub = jax.random.split(jkey)
+        tree, pred = fit_at(score_used, sub)
+        kd = len(dropped)
+        if kd:
+            new_w = p.learning_rate / (kd + 1.0)
+            factor = kd / (kd + 1.0)
+            for d in dropped:
+                weights[d] *= factor
+        else:
+            new_w = p.learning_rate
+        trees.append(jax.tree_util.tree_map(np.asarray, tree))
+        preds.append(pred)
+        weights.append(float(new_w))
+
+    booster = Booster(
+        trees_feature=np.stack([t.split_feature for t in trees]),
+        trees_threshold=np.stack([t.threshold for t in trees]),
+        trees_left=np.stack([t.left_child for t in trees]),
+        trees_right=np.stack([t.right_child for t in trees]),
+        trees_value=np.stack([t.leaf_value for t in trees]),
+        trees_cover=np.stack([t.cover for t in trees]),
+        trees_gain=np.stack([t.gain for t in trees]),
+        tree_weights=np.asarray(weights, np.float32),
+        params=p, init_score=init, num_class=1, num_features=f,
+        feature_names=feature_names)
+    booster.feature_importance_split, booster.feature_importance_gain = (
+        _importances(booster, f))
+    return booster

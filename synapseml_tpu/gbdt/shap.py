@@ -1,0 +1,151 @@
+"""Path-dependent TreeSHAP for the GBDT Booster.
+
+Parity target: the reference's per-row SHAP surface (featuresShapCol /
+predict_contrib, ref: lightgbm/.../LightGBMModelMethods.scala:12-116 and
+booster SHAP at lightgbm/.../booster/LightGBMBooster.scala:414), computed
+natively by lib_lightgbm. This is the Lundberg & Lee path-dependent TreeSHAP
+algorithm over our flat tree arrays, host-side numpy (the per-row cost is
+O(T·L·D²) control flow — a poor fit for the MXU; batching via the explainers'
+KernelSHAP path is the TPU-native alternative for large N).
+
+Returns [N, F+1] — per-feature contributions plus the expected value in the
+last slot, matching LightGBM's predict(..., pred_contrib=True) layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Path:
+    __slots__ = ("d", "z", "o", "w")
+
+    def __init__(self, n):
+        self.d = np.empty(n, np.int64)   # feature index
+        self.z = np.empty(n, np.float64)  # zero fraction
+        self.o = np.empty(n, np.float64)  # one fraction
+        self.w = np.empty(n, np.float64)  # permutation weight
+
+
+def _extend(p: _Path, m: int, pz: float, po: float, pi: int):
+    p.d[m] = pi
+    p.z[m] = pz
+    p.o[m] = po
+    p.w[m] = 1.0 if m == 0 else 0.0
+    for i in range(m - 1, -1, -1):
+        p.w[i + 1] += po * p.w[i] * (i + 1) / (m + 1)
+        p.w[i] = pz * p.w[i] * (m - i) / (m + 1)
+
+
+def _unwind(p: _Path, m: int, i: int):
+    n = p.w[m]
+    o, z = p.o[i], p.z[i]
+    for j in range(m - 1, -1, -1):
+        if o != 0:
+            t = p.w[j]
+            p.w[j] = n * (m + 1) / ((j + 1) * o)
+            n = t - p.w[j] * z * (m - j) / (m + 1)
+        else:
+            p.w[j] = p.w[j] * (m + 1) / (z * (m - j))
+    for j in range(i, m):
+        p.d[j] = p.d[j + 1]
+        p.z[j] = p.z[j + 1]
+        p.o[j] = p.o[j + 1]
+
+
+def _unwound_sum(p: _Path, m: int, i: int) -> float:
+    n = p.w[m]
+    o, z = p.o[i], p.z[i]
+    total = 0.0
+    if o != 0:
+        for j in range(m - 1, -1, -1):
+            t = n / ((j + 1) * o)
+            total += t
+            n = p.w[j] - t * z * (m - j)
+    else:
+        for j in range(m - 1, -1, -1):
+            total += p.w[j] / (z * (m - j))
+    return total * (m + 1)
+
+
+def _shap_recurse(feat, thr, left, right, value, cover, x, phi,
+                  node, pz, po, pi, parent: _Path, m: int):
+    p = _Path(m + 2)
+    p.d[:m] = parent.d[:m]
+    p.z[:m] = parent.z[:m]
+    p.o[:m] = parent.o[:m]
+    p.w[:m] = parent.w[:m]
+    _extend(p, m, pz, po, pi)
+    m = m + 1
+
+    if feat[node] < 0:  # leaf
+        v = value[node]
+        for i in range(1, m):
+            w = _unwound_sum(p, m - 1, i)
+            phi[p.d[i]] += w * (p.o[i] - p.z[i]) * v
+        return
+
+    f = feat[node]
+    hot, cold = (left[node], right[node]) if x[f] <= thr[node] else (
+        right[node], left[node])
+    iz, io = 1.0, 1.0
+    k = -1
+    for i in range(1, m):
+        if p.d[i] == f:
+            k = i
+            break
+    if k >= 0:
+        iz, io = p.z[k], p.o[k]
+        _unwind(p, m - 1, k)
+        m -= 1
+
+    c = max(cover[node], 1e-12)
+    _shap_recurse(feat, thr, left, right, value, cover, x, phi,
+                  hot, iz * cover[hot] / c, io, f, p, m)
+    _shap_recurse(feat, thr, left, right, value, cover, x, phi,
+                  cold, iz * cover[cold] / c, 0.0, f, p, m)
+
+
+def _expected_value(feat, left, right, value, cover, node=0) -> float:
+    if feat[node] < 0:
+        return value[node]
+    c = max(cover[node], 1e-12)
+    return (cover[left[node]] / c * _expected_value(feat, left, right, value,
+                                                    cover, left[node])
+            + cover[right[node]] / c * _expected_value(feat, left, right,
+                                                       value, cover,
+                                                       right[node]))
+
+
+def tree_shap(booster, x: np.ndarray) -> np.ndarray:
+    """SHAP contributions [N, F+1] (last column = expected value)."""
+    x = np.asarray(x, np.float64)
+    n, f = x.shape
+    k = booster.num_class
+    out = np.zeros((n, f + 1) if k == 1 else (n, k, f + 1), np.float64)
+
+    for t in range(booster.num_trees):
+        feat = booster.trees_feature[t].astype(np.int64)
+        thr = booster.trees_threshold[t].astype(np.float64)
+        left = booster.trees_left[t].astype(np.int64)
+        right = booster.trees_right[t].astype(np.int64)
+        value = booster.trees_value[t].astype(np.float64)
+        cover = booster.trees_cover[t].astype(np.float64)
+        w = float(booster.tree_weights[t])
+        value = value * w
+        ev = _expected_value(feat, left, right, value, cover)
+        cls = t % k
+        for i in range(n):
+            phi = np.zeros(f + 1, np.float64)
+            empty = _Path(1)
+            _shap_recurse(feat, thr, left, right, value, cover, x[i], phi,
+                          0, 1.0, 1.0, -1, empty, 0)
+            phi[f] += ev
+            if k == 1:
+                out[i] += phi
+            else:
+                out[i, cls] += phi
+    if k == 1:
+        out[:, f] += booster.init_score
+    else:
+        out[:, :, f] += booster.init_score
+    return out

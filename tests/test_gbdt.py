@@ -1,0 +1,229 @@
+import numpy as np
+import pytest
+
+from sklearn.datasets import load_breast_cancer, load_diabetes, load_iris
+from sklearn.metrics import r2_score, roc_auc_score
+from sklearn.model_selection import train_test_split
+
+from synapseml_tpu.core.pipeline import PipelineStage
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.gbdt.boosting import Booster, BoostParams, train
+from synapseml_tpu.gbdt.estimators import (
+    LightGBMClassifier, LightGBMRanker, LightGBMRegressor)
+
+
+@pytest.fixture(scope="module")
+def cancer():
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X, y, test_size=0.3, random_state=0)
+
+
+def test_binary_auc_beats_reference_gate(cancer):
+    # reference gate: breast-cancer gbdt AUC 0.9920 +- 0.1
+    # (BASELINE.md, lightgbm benchmarks CSV row 22)
+    Xt, Xv, yt, yv = cancer
+    b = train(BoostParams(objective="binary", num_iterations=100), Xt, yt)
+    auc = roc_auc_score(yv, b.predict(Xv))
+    assert auc > 0.99
+
+
+def test_classifier_estimator_table_api(cancer, tmp_path):
+    Xt, Xv, yt, yv = cancer
+    t = Table({"features": Xt, "label": yt})
+    model = LightGBMClassifier(num_iterations=30).fit(t)
+    out = model.transform(Table({"features": Xv, "label": yv}))
+    assert set(["rawPrediction", "probability", "prediction"]) <= set(out.columns)
+    auc = roc_auc_score(yv, out["probability"][:, 1])
+    assert auc > 0.98
+    # serde roundtrip (SerializationFuzzing analogue, SURVEY.md 4.2)
+    p = str(tmp_path / "m")
+    model.save(p)
+    model2 = PipelineStage.load(p)
+    out2 = model2.transform(Table({"features": Xv, "label": yv}))
+    np.testing.assert_allclose(out2["probability"], out["probability"], rtol=1e-6)
+
+
+def test_multiclass(cancer):
+    X, y = load_iris(return_X_y=True)
+    t = Table({"features": X, "label": y.astype(float)})
+    model = LightGBMClassifier(objective="multiclass", num_iterations=40,
+                               num_leaves=15, min_data_in_leaf=5).fit(t)
+    out = model.transform(t)
+    acc = (out["prediction"] == y).mean()
+    assert acc > 0.95
+    assert out["probability"].shape == (len(y), 3)
+    np.testing.assert_allclose(out["probability"].sum(-1), 1.0, atol=1e-5)
+
+
+def test_regressor_matches_sklearn_ballpark():
+    X, y = load_diabetes(return_X_y=True)
+    Xt, Xv, yt, yv = train_test_split(X, y, test_size=0.3, random_state=0)
+    t = Table({"features": Xt, "label": yt})
+    model = LightGBMRegressor(num_iterations=200, learning_rate=0.05).fit(t)
+    pred = model.transform(Table({"features": Xv}))["prediction"]
+    from sklearn.ensemble import HistGradientBoostingRegressor
+    ref = HistGradientBoostingRegressor(
+        max_iter=200, learning_rate=0.05, max_leaf_nodes=31,
+        min_samples_leaf=20, early_stopping=False).fit(Xt, yt)
+    ours, theirs = r2_score(yv, pred), r2_score(yv, ref.predict(Xv))
+    assert ours > theirs - 0.05
+
+
+def test_feature_cols_api(cancer):
+    Xt, Xv, yt, yv = cancer
+    cols = {f"f{i}": Xt[:, i] for i in range(5)}
+    cols["label"] = yt
+    t = Table(cols)
+    m = LightGBMClassifier(features_col=None,
+                           feature_cols=[f"f{i}" for i in range(5)],
+                           num_iterations=20).fit(t)
+    out = m.transform(t)
+    assert roc_auc_score(yt, out["probability"][:, 1]) > 0.9
+
+
+def test_early_stopping_and_validation_col(cancer):
+    Xt, Xv, yt, yv = cancer
+    X = np.vstack([Xt, Xv])
+    y = np.concatenate([yt, yv])
+    is_val = np.zeros(len(y), bool)
+    is_val[len(yt):] = True
+    t = Table({"features": X, "label": y, "isVal": is_val})
+    m = LightGBMClassifier(num_iterations=500, validation_indicator_col="isVal",
+                           early_stopping_round=10).fit(t)
+    assert m.booster.num_trees < 500  # stopped early
+    assert m.booster.best_iteration >= 0
+
+
+def test_weight_column_changes_model(cancer):
+    Xt, Xv, yt, yv = cancer
+    w = np.where(yt == 1, 10.0, 1.0)
+    t_w = Table({"features": Xt, "label": yt, "w": w})
+    m0 = LightGBMClassifier(num_iterations=10).fit(t_w)
+    m1 = LightGBMClassifier(num_iterations=10, weight_col="w").fit(t_w)
+    p0 = m0.transform(t_w)["probability"][:, 1]
+    p1 = m1.transform(t_w)["probability"][:, 1]
+    assert not np.allclose(p0, p1)
+    # upweighting positives shifts predictions up on average
+    assert p1.mean() > p0.mean()
+
+
+def test_goss_and_rf_and_bagging(cancer):
+    Xt, Xv, yt, yv = cancer
+    for bt, kw in [("goss", {}), ("rf", dict(bagging_fraction=0.8,
+                                             bagging_freq=1)),
+                   ("gbdt", dict(bagging_fraction=0.7, bagging_freq=1,
+                                 feature_fraction=0.8))]:
+        b = train(BoostParams(objective="binary", boosting_type=bt,
+                              num_iterations=40, **kw), Xt, yt)
+        auc = roc_auc_score(yv, b.predict(Xv))
+        assert auc > 0.95, (bt, auc)
+
+
+def test_ranker_orders_by_relevance():
+    rng = np.random.default_rng(0)
+    n_q, per_q = 40, 10
+    n = n_q * per_q
+    x = rng.standard_normal((n, 5))
+    rel = (x[:, 0] + 0.3 * rng.standard_normal(n) > 0.5).astype(float) * 2
+    q = np.repeat(np.arange(n_q), per_q)
+    t = Table({"features": x, "label": rel, "query": q})
+    m = LightGBMRanker(num_iterations=30, num_leaves=7,
+                       min_data_in_leaf=5).fit(t)
+    pred = m.transform(t)["prediction"]
+    # predictions should correlate with relevance
+    assert np.corrcoef(pred, rel)[0, 1] > 0.5
+
+
+def test_shap_additivity(cancer):
+    Xt, Xv, yt, yv = cancer
+    b = train(BoostParams(objective="binary", num_iterations=10,
+                          num_leaves=7), Xt, yt)
+    xs = Xv[:16]
+    contrib = b.predict_raw(xs)
+    from synapseml_tpu.gbdt.shap import tree_shap
+    phi = tree_shap(b, xs)
+    np.testing.assert_allclose(phi.sum(axis=1), contrib, rtol=1e-4, atol=1e-4)
+
+
+def test_feature_importances(cancer):
+    Xt, Xv, yt, yv = cancer
+    t = Table({"features": Xt, "label": yt})
+    m = LightGBMClassifier(num_iterations=20).fit(t)
+    split_imp = m.get_feature_importances("split")
+    gain_imp = m.get_feature_importances("gain")
+    assert len(split_imp) == Xt.shape[1]
+    assert sum(split_imp) > 0 and sum(gain_imp) > 0
+
+
+def test_missing_values_handled(cancer):
+    Xt, Xv, yt, yv = cancer
+    Xt = Xt.copy()
+    rng = np.random.default_rng(0)
+    Xt[rng.random(Xt.shape) < 0.1] = np.nan
+    b = train(BoostParams(objective="binary", num_iterations=30), Xt, yt)
+    pred = b.predict(np.where(np.isnan(Xv), np.nan, Xv))
+    assert np.isfinite(pred).all()
+    assert roc_auc_score(yv, pred) > 0.95
+
+
+def test_booster_string_roundtrip(cancer):
+    Xt, Xv, yt, yv = cancer
+    b = train(BoostParams(objective="binary", num_iterations=5), Xt, yt)
+    b2 = Booster.load_string(b.save_string())
+    np.testing.assert_allclose(b2.predict(Xv), b.predict(Xv), rtol=1e-6)
+
+
+def test_predict_leaf_shape(cancer):
+    Xt, Xv, yt, yv = cancer
+    b = train(BoostParams(objective="binary", num_iterations=5), Xt, yt)
+    leaves = b.predict_leaf(Xv[:10])
+    assert leaves.shape == (10, 5)
+    assert (leaves >= 0).all()
+
+
+def test_distributed_dp_matches_single_device(cancer):
+    import jax
+    from jax.sharding import Mesh
+    Xt, Xv, yt, yv = cancer
+    p = BoostParams(objective="binary", num_iterations=10)
+    b_single = train(p, Xt, yt)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    b_dist = train(p, Xt, yt, mesh=mesh)
+    np.testing.assert_allclose(
+        b_dist.predict(Xv), b_single.predict(Xv), rtol=1e-4, atol=1e-5)
+
+
+def test_distributed_multiclass_runs():
+    import jax
+    from jax.sharding import Mesh
+    X, y = load_iris(return_X_y=True)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    b = train(BoostParams(objective="multiclass", num_class=3,
+                          num_iterations=10, num_leaves=7,
+                          min_data_in_leaf=5), X, y.astype(float), mesh=mesh)
+    acc = (b.predict(X).argmax(-1) == y).mean()
+    assert acc > 0.9
+
+
+def test_dart_boosting(cancer):
+    Xt, Xv, yt, yv = cancer
+    b = train(BoostParams(objective="binary", boosting_type="dart",
+                          num_iterations=40, drop_rate=0.2), Xt, yt)
+    auc = roc_auc_score(yv, b.predict(Xv))
+    assert auc > 0.95
+    # dart reweights dropped trees below the base learning rate
+    assert (b.tree_weights <= 0.1 + 1e-6).all()
+    assert (b.tree_weights > 0).all()
+
+
+def test_model_save_with_estimator_params(cancer, tmp_path):
+    Xt, Xv, yt, yv = cancer
+    t = Table({"features": Xt, "label": yt})
+    m = LightGBMClassifier(objective="binary", num_iterations=5,
+                           learning_rate=0.2).fit(t)
+    p = str(tmp_path / "m2")
+    m.save(p)  # regression: estimator-only params used to break model save
+    m2 = PipelineStage.load(p)
+    np.testing.assert_allclose(
+        m2.transform(Table({"features": Xv}))["probability"],
+        m.transform(Table({"features": Xv}))["probability"], rtol=1e-6)
