@@ -1,0 +1,40 @@
+"""Featurization layer (ref inventory: SURVEY.md §2.4 featurize/)."""
+from synapseml_tpu.featurize.assemble import (
+    Featurize,
+    FeaturizeModel,
+    OneHotEncoder,
+    VectorAssembler,
+)
+from synapseml_tpu.featurize.clean import (
+    CleanMissingData,
+    CleanMissingDataModel,
+    CountSelector,
+    CountSelectorModel,
+    DataConversion,
+)
+from synapseml_tpu.featurize.indexer import (
+    IndexToValue,
+    ValueIndexer,
+    ValueIndexerModel,
+)
+from synapseml_tpu.featurize.text import (
+    IDF,
+    HashingTF,
+    IDFModel,
+    MultiNGram,
+    NGram,
+    PageSplitter,
+    StopWordsRemover,
+    TextFeaturizer,
+    TextFeaturizerModel,
+    Tokenizer,
+)
+
+__all__ = [
+    "CleanMissingData", "CleanMissingDataModel", "CountSelector",
+    "CountSelectorModel", "DataConversion", "Featurize", "FeaturizeModel",
+    "HashingTF", "IDF", "IDFModel", "IndexToValue", "MultiNGram", "NGram",
+    "OneHotEncoder", "PageSplitter", "StopWordsRemover", "TextFeaturizer",
+    "TextFeaturizerModel", "Tokenizer", "ValueIndexer", "ValueIndexerModel",
+    "VectorAssembler",
+]

@@ -1,0 +1,134 @@
+"""Missing-value imputation, dtype conversion, zero-variance pruning.
+
+Re-designs of the reference's CleanMissingData (ref:
+core/.../featurize/CleanMissingData.scala:48-182), DataConversion
+(ref: core/.../featurize/DataConversion.scala:21-173) and CountSelector
+(ref: core/.../featurize/CountSelector.scala:23) as vectorized columnar ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from synapseml_tpu.core.param import ComplexParam, Param, Params
+from synapseml_tpu.core.pipeline import Estimator, Model, Transformer
+from synapseml_tpu.data.table import Table
+
+
+class CleanMissingDataModel(Model):
+    fill_values = ComplexParam("column -> replacement value")
+    input_cols = Param("columns to clean", default=None)
+    output_cols = Param("output column names (default: in place)", default=None)
+
+    def _transform(self, table: Table) -> Table:
+        fills: Dict[str, float] = self.fill_values or {}
+        ins: List[str] = self.input_cols or list(fills)
+        outs: List[str] = self.output_cols or ins
+        new = {}
+        for cin, cout in zip(ins, outs):
+            col = table[cin]
+            if np.issubdtype(col.dtype, np.floating):
+                new[cout] = np.where(np.isnan(col), fills[cin], col)
+            elif col.dtype == object:
+                new[cout] = np.array(
+                    [fills[cin] if v is None else v for v in col], dtype=object)
+            else:
+                new[cout] = col
+        return table.with_columns(new)
+
+
+class CleanMissingData(Estimator):
+    """Impute missing values per column: mean / median / custom constant
+    (ref: CleanMissingData.scala:48)."""
+
+    input_cols = Param("columns to clean", default=None)
+    output_cols = Param("output column names", default=None)
+    cleaning_mode = Param("'Mean' | 'Median' | 'Custom'", default="Mean")
+    custom_value = Param("replacement for Custom mode", default=None)
+
+    def _fit(self, table: Table) -> CleanMissingDataModel:
+        mode = self.cleaning_mode
+        ins = self.input_cols or [
+            c for c, arr in ((c, table[c]) for c in table.columns)
+            if np.issubdtype(arr.dtype, np.number)
+        ]
+        fills: Dict[str, float] = {}
+        for c in ins:
+            col = table[c]
+            if mode == "Custom":
+                fills[c] = self.custom_value
+            else:
+                vals = col[~np.isnan(col)] if np.issubdtype(col.dtype, np.floating) else col
+                fills[c] = float(np.mean(vals)) if mode == "Mean" else float(np.median(vals))
+        return CleanMissingDataModel(
+            fill_values=fills, input_cols=ins,
+            output_cols=self.output_cols or ins)
+
+
+_CONVERSIONS = {
+    "boolean": np.bool_,
+    "byte": np.int8,
+    "short": np.int16,
+    "integer": np.int32,
+    "long": np.int64,
+    "float": np.float32,
+    "double": np.float64,
+    "string": object,
+}
+
+
+class DataConversion(Transformer):
+    """Cast listed columns to a target type (ref: DataConversion.scala:21).
+
+    ``convert_to='toCategorical'`` indexes in place via ValueIndexer;
+    ``'clearCategorical'`` is a no-op here (no MLlib metadata to strip).
+    """
+
+    cols = Param("columns to convert", default=None)
+    convert_to = Param("target type name", default="double")
+    date_format = Param("strftime format for date→string", default="yyyy-MM-dd HH:mm:ss")
+
+    def _transform(self, table: Table) -> Table:
+        target = self.convert_to
+        new = {}
+        for c in self.cols or []:
+            col = table[c]
+            if target == "toCategorical":
+                from synapseml_tpu.featurize.indexer import ValueIndexer
+                model = ValueIndexer(input_col=c, output_col=c).fit(table)
+                new[c] = model.transform(table)[c]
+            elif target == "clearCategorical":
+                new[c] = col
+            elif target == "string":
+                new[c] = np.array([str(v) for v in col], dtype=object)
+            else:
+                np_t = _CONVERSIONS[target]
+                if col.dtype == object:
+                    col = np.array([float(v) for v in col])
+                new[c] = col.astype(np_t)
+        return table.with_columns(new)
+
+
+class CountSelectorModel(Model):
+    indices = ComplexParam("slot indices to keep")
+    input_col = Param("vector input column", default="features")
+    output_col = Param("output column", default="features")
+
+    def _transform(self, table: Table) -> Table:
+        idx = np.asarray(self.indices)
+        mat = np.asarray(table[self.input_col])
+        return table.with_column(self.output_col, mat[:, idx])
+
+
+class CountSelector(Estimator):
+    """Drops vector slots that are zero for every row (ref: CountSelector.scala:23)."""
+
+    input_col = Param("vector input column", default="features")
+    output_col = Param("output column", default="features")
+
+    def _fit(self, table: Table) -> CountSelectorModel:
+        mat = np.asarray(table[self.input_col])
+        nonzero = np.flatnonzero(np.any(mat != 0, axis=0))
+        return CountSelectorModel(
+            indices=nonzero, input_col=self.input_col, output_col=self.output_col)

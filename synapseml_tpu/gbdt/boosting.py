@@ -118,6 +118,96 @@ def _default_metric(p: BoostParams) -> str:
     return "rmse"
 
 
+def _ndcg_score(scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray,
+                at: int) -> float:
+    """Mean NDCG@at over query groups (numpy; valid sets are small)."""
+    total, count = 0.0, 0
+    for g in np.unique(group_ids):
+        sel = group_ids == g
+        rel = labels[sel]
+        if len(rel) == 0:
+            continue
+        order = np.argsort(-scores[sel], kind="stable")[:at]
+        discounts = 1.0 / np.log2(np.arange(2, len(order) + 2))
+        dcg = float(np.sum((2.0 ** rel[order] - 1.0) * discounts))
+        ideal = np.sort(rel)[::-1][:at]
+        idcg = float(np.sum((2.0 ** ideal - 1.0)
+                            / np.log2(np.arange(2, len(ideal) + 2))))
+        if idcg > 0:
+            total += dcg / idcg
+            count += 1
+    return total / max(count, 1)
+
+
+class _ValidTracker:
+    """Validation scoring + early stopping shared by the train loops.
+
+    Tree outputs accumulate as a raw sum; the effective margin at iteration
+    ``it`` is ``init + sum * (1/(it+1) if rf else 1)`` so rf metrics are
+    computed on averaged scores, matching rf prediction. ``best_iteration``
+    is only exported when early stopping is enabled (LightGBM semantics —
+    merely supplying eval data must not truncate predictions).
+    """
+
+    def __init__(self, p: BoostParams, k: int, init: float, valid_sets):
+        self.p, self.k, self.init = p, k, init
+        self.metric_name = _default_metric(p)
+        self.metric_fn, self.larger_better = obj.METRICS.get(
+            self.metric_name, (None, False))
+        self.is_rank_metric = self.metric_name == "ndcg"
+        if self.is_rank_metric:
+            self.larger_better = True
+        self.sets = []
+        for vs in valid_sets:
+            vx, vy = vs[0], vs[1]
+            vg = (np.asarray(vs[2]) if len(vs) > 2 and vs[2] is not None
+                  else None)
+            self.sets.append([
+                jnp.asarray(np.asarray(vx, np.float32)),
+                jnp.asarray(np.asarray(vy, np.float32)),
+                jnp.zeros((len(vy), k), jnp.float32), vg])
+        self.enabled = bool(self.sets) and (
+            self.metric_fn is not None
+            or (self.is_rank_metric and self.sets[0][3] is not None))
+        self.best_score = -np.inf if self.larger_better else np.inf
+        self.best_iter = -1
+        self.history: Dict[str, List[float]] = {self.metric_name: []}
+        self._pt = jax.jit(predict_tree)
+
+    def add_tree(self, tree, class_idx: int):
+        for v in self.sets:
+            vt = self._pt(
+                (tree.split_feature, tree.threshold, tree.left_child,
+                 tree.right_child, tree.leaf_value), v[0])
+            v[2] = v[2].at[:, class_idx].add(vt)
+
+    def step(self, it: int, is_rf: bool) -> bool:
+        """Record the metric after iteration ``it``; True = stop early."""
+        if not self.enabled:
+            return False
+        _, vy, vsum, vg = self.sets[0]
+        scale = 1.0 / (it + 1.0) if is_rf else 1.0
+        vscore = vsum * scale + self.init
+        if self.is_rank_metric:
+            m = _ndcg_score(np.asarray(vscore[:, 0]), np.asarray(vy), vg,
+                            self.p.max_position)
+        elif self.k > 1:
+            m = float(self.metric_fn(vscore, vy.astype(jnp.int32)))
+        else:
+            m = float(self.metric_fn(vscore[:, 0], vy))
+        self.history[self.metric_name].append(m)
+        improved = (m > self.best_score if self.larger_better
+                    else m < self.best_score)
+        if improved:
+            self.best_score, self.best_iter = m, it
+            return False
+        return (self.p.early_stopping_round > 0
+                and it - self.best_iter >= self.p.early_stopping_round)
+
+    def final_best_iter(self) -> int:
+        return self.best_iter if self.p.early_stopping_round > 0 else -1
+
+
 def _init_score(p: BoostParams, y: np.ndarray, weight: Optional[np.ndarray]):
     """boost_from_average analogue of LightGBM's ObtainAutomaticInitialScore."""
     if not p.boost_from_average:
@@ -332,12 +422,25 @@ def train(
     gp = dataclasses.replace(p.grower(), max_bin=bdev)
     thresholds = jnp.asarray(mapper.threshold_values(), jnp.float32)
 
-    binned = jnp.asarray(binned_np)
-    yd = jnp.asarray(y)
-    wd = jnp.asarray(weight, jnp.float32) if weight is not None else None
     init = _init_score(p, y, weight)
     obj_fn = _objective_fn(p)
     is_rank = p.objective in ("lambdarank", "rank_xendcg")
+
+    # -- distributed (data-parallel) path --------------------------------
+    # Rows shard over the mesh's dp axis; per-shard histograms are psum'ed
+    # over ICI inside build_tree, after which every rank takes identical
+    # split decisions (the TPU-native replacement for the reference's
+    # tree_learner=data_parallel socket reduce-scatter, SURVEY.md 2.10).
+    # Dispatch happens BEFORE any host->device transfer so the large [N,F]
+    # matrix is only placed once, with its mesh sharding.
+    if mesh is not None:
+        return _train_distributed(
+            p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
+            thresholds, valid_sets, feature_names)
+
+    binned = jnp.asarray(binned_np)
+    yd = jnp.asarray(y)
+    wd = jnp.asarray(weight, jnp.float32) if weight is not None else None
     group_ids = jnp.asarray(group, jnp.int32) if group is not None else None
 
     if k > 1:
@@ -393,16 +496,6 @@ def train(
         perm = jax.random.permutation(key, f)
         mask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
         return mask
-
-    # -- distributed (data-parallel) path --------------------------------
-    # Rows shard over the mesh's dp axis; per-shard histograms are psum'ed
-    # over ICI inside build_tree, after which every rank takes identical
-    # split decisions (the TPU-native replacement for the reference's
-    # tree_learner=data_parallel socket reduce-scatter, SURVEY.md 2.10).
-    if mesh is not None:
-        return _train_distributed(
-            p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
-            thresholds, valid_sets, feature_names)
 
     axis_name = None
     renew_alpha = None
@@ -470,48 +563,18 @@ def train(
                            n, f, valid_sets, feature_names)
 
     # -- validation state ----------------------------------------------
-    metric_name = _default_metric(p)
-    metric_fn, larger_better = obj.METRICS.get(metric_name, (None, False))
-    valid_raw = []
-    _pt = jax.jit(predict_tree)
-    for vx, vy in valid_sets:
-        valid_raw.append([jnp.asarray(np.asarray(vx, np.float32)),
-                          jnp.asarray(np.asarray(vy, np.float32)),
-                          jnp.zeros((len(vy), k), jnp.float32) + init])
+    tracker = _ValidTracker(p, k, init, valid_sets)
 
     trees: List[Tree] = []
     rng = jax.random.PRNGKey(p.seed)
-    best_score = -np.inf if larger_better else np.inf
-    best_iter = -1
-    history: Dict[str, List[float]] = {metric_name: []}
-    stop = False
 
     for it in range(p.num_iterations):
         for c in range(k):
             rng, key = jax.random.split(rng)
             scores, tree = iteration(scores, key, c)
-            for v in valid_raw:
-                vt = _pt(
-                    (tree.split_feature, tree.threshold,
-                     tree.left_child, tree.right_child, tree.leaf_value),
-                    v[0])
-                v[2] = v[2].at[:, c].add(vt)
+            tracker.add_tree(tree, c)
             trees.append(jax.tree_util.tree_map(np.asarray, tree))
-
-        if valid_raw and metric_fn is not None:
-            vx, vy, vscore = valid_raw[0]
-            if k > 1:
-                m = float(metric_fn(vscore, vy.astype(jnp.int32)))
-            else:
-                m = float(metric_fn(vscore[:, 0], vy))
-            history[metric_name].append(m)
-            improved = m > best_score if larger_better else m < best_score
-            if improved:
-                best_score, best_iter = m, it
-            elif (p.early_stopping_round > 0
-                  and it - best_iter >= p.early_stopping_round):
-                stop = True
-        if stop:
+        if tracker.step(it, is_rf):
             break
 
     t_total = len(trees)
@@ -529,10 +592,10 @@ def train(
         params=p,
         init_score=init,
         num_class=k,
-        best_iteration=best_iter,
+        best_iteration=tracker.final_best_iter(),
         num_features=f,
         feature_names=feature_names,
-        eval_history=history,
+        eval_history=tracker.history,
     )
     booster.feature_importance_split, booster.feature_importance_gain = (
         _importances(booster, f))
@@ -649,45 +712,18 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         check_vma=False)
     jitted = jax.jit(smapped)
 
-    metric_name = _default_metric(p)
-    metric_fn, larger_better = obj.METRICS.get(metric_name, (None, False))
-    valid_raw = []
-    _pt = jax.jit(predict_tree)
-    for vx, vy in valid_sets:
-        valid_raw.append([jnp.asarray(np.asarray(vx, np.float32)),
-                          jnp.asarray(np.asarray(vy, np.float32)),
-                          jnp.zeros((len(vy), k), jnp.float32) + init])
+    tracker = _ValidTracker(p, k, init, valid_sets)
 
     trees: List[Tree] = []
     rng = jax.random.PRNGKey(p.seed)
-    best_score = -np.inf if larger_better else np.inf
-    best_iter = -1
-    history: Dict[str, List[float]] = {metric_name: []}
-    stop = False
     for it in range(p.num_iterations):
         for c in range(k):
             rng, key = jax.random.split(rng)
             scores, tree = jitted(binned, yd, yoh, wd, padm, scores, key,
                                   jnp.int32(c))
-            for v in valid_raw:
-                vt = _pt((tree.split_feature, tree.threshold, tree.left_child,
-                          tree.right_child, tree.leaf_value), v[0])
-                v[2] = v[2].at[:, c].add(vt)
+            tracker.add_tree(tree, c)
             trees.append(jax.tree_util.tree_map(np.asarray, tree))
-        if valid_raw and metric_fn is not None:
-            _, vy_, vscore = valid_raw[0]
-            if k > 1:
-                m = float(metric_fn(vscore, vy_.astype(jnp.int32)))
-            else:
-                m = float(metric_fn(vscore[:, 0], vy_))
-            history[metric_name].append(m)
-            improved = m > best_score if larger_better else m < best_score
-            if improved:
-                best_score, best_iter = m, it
-            elif (p.early_stopping_round > 0
-                  and it - best_iter >= p.early_stopping_round):
-                stop = True
-        if stop:
+        if tracker.step(it, is_rf):
             break
 
     t_total = len(trees)
@@ -703,8 +739,8 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         trees_gain=np.stack([t.gain for t in trees]),
         tree_weights=tree_weights,
         params=p, init_score=init, num_class=k, num_features=f,
-        best_iteration=best_iter, feature_names=feature_names,
-        eval_history=history)
+        best_iteration=tracker.final_best_iter(), feature_names=feature_names,
+        eval_history=tracker.history)
     booster.feature_importance_split, booster.feature_importance_gain = (
         _importances(booster, f))
     return booster

@@ -157,8 +157,12 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
     def _fit(self, table: Table) -> "LightGBMClassificationModel":
         train_t, valid_t = self._split_validation(table)
         x = self._features(train_t)
-        y = np.asarray(train_t[self.label_col], np.float64)
-        classes = np.unique(y)
+        y_raw = np.asarray(train_t[self.label_col], np.float64)
+        # remap arbitrary class labels to dense 0..k-1 (the reference gets
+        # this via label reindexing in TrainClassifier / native LightGBM
+        # validation); predictions map back through label_values
+        classes = np.unique(y_raw)
+        y = np.searchsorted(classes, y_raw).astype(np.float64)
         num_class = len(classes)
         objective = self.objective
         if num_class > 2 and objective == "binary":
@@ -167,14 +171,16 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
                   if self.weight_col else None)
         valid = []
         if valid_t is not None and valid_t.num_rows:
+            vy_raw = np.asarray(valid_t[self.label_col], np.float64)
             valid = [(self._features(valid_t),
-                      np.asarray(valid_t[self.label_col], np.float64))]
+                      np.searchsorted(classes, vy_raw).astype(np.float64))]
         booster = train(
             self._boost_params(objective,
                                num_class if objective != "binary" else 1),
             x, y, weight=weight, valid_sets=valid)
         model = self._make_model(LightGBMClassificationModel, booster)
-        model.set(num_classes=max(num_class, 2))
+        model.set(num_classes=max(num_class, 2),
+                  label_values=[float(c) for c in classes])
         return model
 
 
@@ -182,6 +188,7 @@ class LightGBMClassificationModel(_LightGBMModelBase):
     probability_col = Param("probability column", default="probability")
     raw_prediction_col = Param("raw margin column", default="rawPrediction")
     num_classes = Param("number of classes", default=2)
+    label_values = Param("original class labels in index order", default=None)
 
     def _transform(self, table: Table) -> Table:
         x = self._features(table)
@@ -192,10 +199,15 @@ class LightGBMClassificationModel(_LightGBMModelBase):
             raws = np.column_stack([-raw, raw])
         else:
             raws = raw
+        pred_idx = probs.argmax(-1)
+        if self.label_values is not None:
+            pred = np.asarray(self.label_values, np.float64)[pred_idx]
+        else:
+            pred = pred_idx.astype(np.float64)
         return table.with_columns({
             self.raw_prediction_col: raws,
             self.probability_col: probs,
-            self.prediction_col: probs.argmax(-1).astype(np.float64),
+            self.prediction_col: pred,
         })
 
 
@@ -253,8 +265,11 @@ class LightGBMRanker(Estimator, _LightGBMParams):
                   if self.weight_col else None)
         valid = []
         if valid_t is not None and valid_t.num_rows:
+            _, vgroup = np.unique(np.asarray(valid_t[self.group_col]),
+                                  return_inverse=True)
             valid = [(self._features(valid_t),
-                      np.asarray(valid_t[self.label_col], np.float64))]
+                      np.asarray(valid_t[self.label_col], np.float64),
+                      vgroup)]
         bp = dataclasses.replace(self._boost_params("lambdarank"),
                                  max_position=int(self.max_position))
         booster = train(bp, x, y, weight=weight, group=group_ids,

@@ -1,0 +1,90 @@
+"""MurmurHash3 (x86 32-bit) — the hash family the reference uses for feature
+hashing (ref: vw/.../featurizer/VowpalWabbitMurmurWithPrefix.scala; Spark's
+HashingTF also rides murmur3_32).
+
+Scalar path hashes arbitrary byte strings (used for vocab/token hashing, with a
+per-process memo so each distinct token is hashed once); the vectorized path
+hashes int32 arrays on-device for interaction features.
+"""
+from __future__ import annotations
+
+import struct
+from functools import lru_cache
+from typing import Union
+
+import numpy as np
+
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_MASK = 0xFFFFFFFF
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & _MASK
+
+
+def murmur3_32(data: Union[bytes, str], seed: int = 0) -> int:
+    """MurmurHash3 x86_32 over bytes. Returns unsigned 32-bit int."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    h = seed & _MASK
+    n = len(data)
+    tail = n & ~3
+    for i in range(0, tail, 4):
+        k = struct.unpack_from("<I", data, i)[0]
+        k = (k * _C1) & _MASK
+        k = _rotl32(k, 15)
+        k = (k * _C2) & _MASK
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & _MASK
+    k = 0
+    rem = n & 3
+    if rem == 3:
+        k ^= data[tail + 2] << 16
+    if rem >= 2:
+        k ^= data[tail + 1] << 8
+    if rem >= 1:
+        k ^= data[tail]
+        k = (k * _C1) & _MASK
+        k = _rotl32(k, 15)
+        k = (k * _C2) & _MASK
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _MASK
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _MASK
+    h ^= h >> 16
+    return h
+
+
+@lru_cache(maxsize=1 << 20)
+def hash_token(token: str, seed: int = 0) -> int:
+    """Memoized murmur3 of a token — each distinct token hashed once per process."""
+    return murmur3_32(token, seed)
+
+
+def hash_index(token: str, num_features: int, seed: int = 0) -> int:
+    return hash_token(token, seed) % num_features
+
+
+def hash_int_array(values: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Vectorized murmur3-style finalizer over an int array (one 4-byte word
+    per value). Matches murmur3_32 of the little-endian 4-byte encoding."""
+    k = values.astype(np.uint32)
+    h = np.full_like(k, seed & _MASK, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        k = k * np.uint32(_C1)
+        k = (k << np.uint32(15)) | (k >> np.uint32(17))
+        k = k * np.uint32(_C2)
+        h = h ^ k
+        h = (h << np.uint32(13)) | (h >> np.uint32(19))
+        h = h * np.uint32(5) + np.uint32(0xE6546B64)
+        h = h ^ np.uint32(4)
+        h = h ^ (h >> np.uint32(16))
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> np.uint32(13))
+        h = h * np.uint32(0xC2B2AE35)
+        h = h ^ (h >> np.uint32(16))
+    return h

@@ -1,0 +1,185 @@
+"""Featurize layer tests (model: reference suites for ValueIndexer,
+CleanMissingData, TextFeaturizer, Featurize — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.featurize import (
+    IDF,
+    CleanMissingData,
+    CountSelector,
+    DataConversion,
+    Featurize,
+    HashingTF,
+    IndexToValue,
+    MultiNGram,
+    OneHotEncoder,
+    PageSplitter,
+    TextFeaturizer,
+    Tokenizer,
+    ValueIndexer,
+    VectorAssembler,
+)
+from synapseml_tpu.utils.hashing import hash_int_array, murmur3_32
+
+
+def test_murmur3_reference_vectors():
+    # public murmur3_32 test vectors + cross-check vs sklearn's C implementation
+    assert murmur3_32(b"") == 0
+    assert murmur3_32(b"", seed=1) == 0x514E28B7
+    assert murmur3_32(b"hello") == 0x248BFA47
+    from sklearn.utils import murmurhash3_32
+    for data in [b"hello, world", b"abc", b"The quick brown fox", b"a", b"ab"]:
+        for seed in (0, 25):
+            assert murmur3_32(data, seed) == murmurhash3_32(data, seed, positive=True)
+
+
+def test_vectorized_hash_matches_scalar():
+    vals = np.array([0, 1, 7, 123456], dtype=np.int32)
+    vec = hash_int_array(vals, seed=3)
+    for v, h in zip(vals, vec):
+        assert murmur3_32(int(v).to_bytes(4, "little"), seed=3) == int(h)
+
+
+def test_value_indexer_roundtrip():
+    t = Table({"cat": ["b", "a", "b", None, "c"]})
+    model = ValueIndexer(input_col="cat", output_col="idx").fit(t)
+    out = model.transform(t)
+    levels = model.levels
+    assert sorted(levels) == ["a", "b", "c"]
+    idx = out["idx"]
+    assert idx[3] == len(levels)  # missing -> trailing slot
+    back = IndexToValue(input_col="idx", output_col="orig", levels=levels).transform(out)
+    assert list(back["orig"][:3]) == ["b", "a", "b"]
+    assert back["orig"][3] is None
+
+
+def test_value_indexer_numeric():
+    t = Table({"x": np.array([3.0, 1.0, np.nan, 3.0])})
+    model = ValueIndexer(input_col="x", output_col="ix").fit(t)
+    out = model.transform(t)
+    assert out["ix"][0] == out["ix"][3]
+    assert out["ix"][2] == len(model.levels)
+
+
+def test_clean_missing_mean_median():
+    t = Table({"a": np.array([1.0, np.nan, 3.0]), "b": np.array([1.0, 2.0, 9.0])})
+    m = CleanMissingData(input_cols=["a"], cleaning_mode="Mean").fit(t)
+    assert m.transform(t)["a"][1] == pytest.approx(2.0)
+    m2 = CleanMissingData(input_cols=["a"], cleaning_mode="Custom", custom_value=-1.0).fit(t)
+    assert m2.transform(t)["a"][1] == -1.0
+
+
+def test_data_conversion():
+    t = Table({"s": ["1", "2"], "f": np.array([1.9, 2.1])})
+    out = DataConversion(cols=["s"], convert_to="double").transform(t)
+    assert out["s"].dtype == np.float64
+    out2 = DataConversion(cols=["f"], convert_to="integer").transform(t)
+    assert out2["f"].dtype == np.int32
+    out3 = DataConversion(cols=["f"], convert_to="string").transform(t)
+    assert isinstance(out3["f"][0], str)
+
+
+def test_count_selector():
+    t = Table({"features": np.array([[1.0, 0.0, 2.0], [0.0, 0.0, 1.0]])})
+    m = CountSelector().fit(t)
+    out = m.transform(t)
+    assert out["features"].shape == (2, 2)
+
+
+def test_vector_assembler_mixed():
+    t = Table({"x": np.array([1.0, 2.0]),
+               "v": np.array([[3.0, 4.0], [5.0, 6.0]])})
+    out = VectorAssembler(input_cols=["x", "v"], output_col="features").transform(t)
+    assert out["features"].shape == (2, 3)
+    assert out["features"].dtype == np.float32
+    np.testing.assert_allclose(out["features"][0], [1, 3, 4])
+
+
+def test_one_hot():
+    t = Table({"i": np.array([0, 2, 3], dtype=np.int32)})
+    out = OneHotEncoder(input_col="i", output_col="oh", size=4, drop_last=True).transform(t)
+    assert out["oh"].shape == (3, 3)
+    assert out["oh"][2].sum() == 0  # missing slot dropped
+
+
+def test_tokenizer_ngram_tf_idf():
+    t = Table({"text": ["The quick brown fox", "the lazy dog the"]})
+    toks = Tokenizer(input_col="text", output_col="toks").transform(t)
+    assert toks["toks"][0] == ["the", "quick", "brown", "fox"]
+    mg = MultiNGram(input_col="toks", output_col="grams", lengths=(1, 2)).transform(toks)
+    assert "the quick" in mg["grams"][0]
+    tf = HashingTF(input_col="toks", output_col="tf", num_features=64).transform(toks)
+    assert tf["tf"].shape == (2, 64)
+    assert tf["tf"][1].sum() == 4  # "the" counted twice
+    idf = IDF(input_col="tf", output_col="tfidf").fit(tf).transform(tf)
+    assert idf["tfidf"].shape == (2, 64)
+
+
+def test_page_splitter():
+    t = Table({"text": ["abcde " * 100]})
+    out = PageSplitter(input_col="text", output_col="pages",
+                       maximum_page_length=100, minimum_page_length=50).transform(t)
+    pages = out["pages"][0]
+    assert all(len(p) <= 100 for p in pages)
+    assert "".join(pages) == "abcde " * 100
+
+
+def test_text_featurizer_end_to_end():
+    t = Table({"text": ["good movie great plot", "bad movie awful plot", "great great film"]})
+    model = TextFeaturizer(input_col="text", output_col="features",
+                           num_features=128, use_idf=True).fit(t)
+    out = model.transform(t)
+    assert out["features"].shape == (3, 128)
+    assert "__tokens" not in out.columns
+
+
+def test_featurize_auto():
+    t = Table({
+        "num": np.array([1.0, np.nan, 3.0, 4.0]),
+        "cat": ["a", "b", "a", None],
+        "flag": np.array([True, False, True, False]),
+        "vec": np.array([[0.1, 0.2]] * 4),
+        "label": np.array([0, 1, 0, 1]),
+    })
+    model = Featurize(input_cols=["num", "cat", "flag", "vec"],
+                      output_col="features").fit(t)
+    out = model.transform(t)
+    f = out["features"]
+    # num(1) + cat one-hot(3: a,b,missing) + flag(1) + vec(2)
+    assert f.shape == (4, 7)
+    assert f.dtype == np.float32
+    assert not np.isnan(f).any()
+    assert "label" in out.columns
+    assert all(not c.startswith("__") for c in out.columns)
+
+
+def test_featurize_serde(tmp_path):
+    t = Table({"num": np.array([1.0, 2.0]), "cat": ["x", "y"]})
+    model = Featurize(input_cols=["num", "cat"], output_col="features").fit(t)
+    a = model.transform(t)["features"]
+    path = str(tmp_path / "feat")
+    model.save(path)
+    from synapseml_tpu.core.pipeline import PipelineStage
+    loaded = PipelineStage.load(path)
+    b = loaded.transform(t)["features"]
+    np.testing.assert_allclose(a, b)
+
+
+def test_text_featurizer_pretokenized_preserves_input():
+    # review finding: use_tokenizer=False must not clobber the input column
+    t = Table({"toks": [["hello", "world", "foo"], ["bar", "baz", "qux"]]})
+    model = TextFeaturizer(input_col="toks", output_col="f", use_tokenizer=False,
+                           use_ngram=True, n_gram_length=2,
+                           num_features=32, use_idf=False).fit(t)
+    out = model.transform(t)
+    assert list(out["toks"][0]) == ["hello", "world", "foo"]
+    assert out["f"].shape == (2, 32)
+
+
+def test_page_splitter_no_infinite_loop_min_zero():
+    t = Table({"text": [" " + "x" * 600]})
+    out = PageSplitter(input_col="text", output_col="p",
+                       maximum_page_length=100,
+                       minimum_page_length=0).transform(t)
+    assert "".join(out["p"][0]) == " " + "x" * 600

@@ -227,3 +227,75 @@ def test_model_save_with_estimator_params(cancer, tmp_path):
     np.testing.assert_allclose(
         m2.transform(Table({"features": Xv}))["probability"],
         m.transform(Table({"features": Xv}))["probability"], rtol=1e-6)
+
+
+def test_nonzero_based_labels_remap(cancer):
+    # labels {1,2} must train as well as {0,1} (review finding: label remap)
+    x, _, y, _ = cancer
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.gbdt.estimators import LightGBMClassifier
+    t = Table({"features": x, "label": y + 1.0})
+    model = LightGBMClassifier(num_iterations=20).fit(t)
+    out = model.transform(t)
+    acc = float((out["prediction"] == y + 1.0).mean())
+    assert acc > 0.9
+    assert set(np.unique(out["prediction"])) <= {1.0, 2.0}
+
+
+def test_sparse_multiclass_labels():
+    # labels {0,2,5} -> dense remap, predictions in original label space
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(300, 4))
+    y = np.choose((x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int),
+                  [0.0, 2.0, 5.0])
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.gbdt.estimators import LightGBMClassifier
+    t = Table({"features": x, "label": y})
+    out = LightGBMClassifier(num_iterations=20).fit(t).transform(t)
+    assert set(np.unique(out["prediction"])) <= {0.0, 2.0, 5.0}
+    assert float((out["prediction"] == y).mean()) > 0.85
+
+
+def test_valid_set_without_early_stopping_keeps_all_trees(cancer):
+    # review finding: best_iteration must not truncate predictions unless
+    # early stopping is enabled
+    x, _, y, _ = cancer
+    from synapseml_tpu.gbdt.boosting import BoostParams, train
+    split = int(0.8 * len(y))
+    b_plain = train(BoostParams(objective="binary", num_iterations=15),
+                    x[:split], y[:split])
+    b_valid = train(BoostParams(objective="binary", num_iterations=15),
+                    x[:split], y[:split],
+                    valid_sets=[(x[split:], y[split:])])
+    assert b_valid.best_iteration == -1
+    np.testing.assert_allclose(b_plain.predict(x[split:]),
+                               b_valid.predict(x[split:]), rtol=1e-5)
+
+
+def test_ranker_ndcg_early_stopping():
+    rng = np.random.default_rng(1)
+    n = 400
+    x = rng.normal(size=(n, 5))
+    rel = (x[:, 0] + 0.1 * rng.normal(size=n) > 0.5).astype(np.float64)
+    group = np.repeat(np.arange(n // 8), 8)
+    from synapseml_tpu.gbdt.boosting import BoostParams, train
+    b = train(BoostParams(objective="lambdarank", num_iterations=30,
+                          early_stopping_round=5),
+              x[:320], rel[:320], group=group[:320],
+              valid_sets=[(x[320:], rel[320:], group[320:] - group[320])])
+    assert "ndcg" in b.eval_history
+    assert len(b.eval_history["ndcg"]) > 0
+    assert max(b.eval_history["ndcg"]) > 0.5
+
+
+def test_rf_valid_metric_uses_averaged_scores(cancer):
+    x, _, y, _ = cancer
+    from synapseml_tpu.gbdt.boosting import BoostParams, train
+    split = int(0.8 * len(y))
+    b = train(BoostParams(objective="binary", boosting_type="rf",
+                          bagging_fraction=0.8, bagging_freq=1,
+                          num_iterations=12),
+              x[:split], y[:split], valid_sets=[(x[split:], y[split:])])
+    h = b.eval_history["binary_logloss"]
+    # averaged margins keep logloss bounded; summed margins would diverge
+    assert h[-1] < 1.0
