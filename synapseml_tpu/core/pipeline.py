@@ -64,6 +64,9 @@ class PipelineStage(Params):
                 self.save_complex_value(os.path.join(cdir, f"{name}.pkl"), value)
         self._save_extra(path)
 
+    def _post_copy(self, src: "Params"):
+        self.uid = f"{type(self).__name__}_{uuid.uuid4().hex[:12]}"
+
     def _save_extra(self, path: str):
         """Hook for subclasses with non-param state (fitted artifacts)."""
 

@@ -33,7 +33,13 @@ def _as_column(values: ColumnLike) -> np.ndarray:
         for i, v in enumerate(values):
             arr[i] = v
         return arr
-    arr = np.asarray(values)
+    try:
+        arr = np.asarray(values)
+    except ValueError:  # ragged nested lists -> object column
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
     if arr.dtype.kind in ("U", "S"):
         arr = arr.astype(object)
     return arr

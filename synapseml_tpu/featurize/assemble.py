@@ -101,15 +101,22 @@ class Featurize(Estimator, HasOutputCol):
         numeric_cols = []
         for c in ins:
             col = table[c]
-            if col.ndim == 2:
+            if col.ndim == 2 and col.dtype.kind in "biuf":
                 assemble_cols.append(c)
+            elif col.ndim == 2:
+                # uniform-length token rows stack into a 2-D object/str array
+                from synapseml_tpu.featurize.text import HashingTF
+                stages.append(HashingTF(input_col=c, output_col=f"__f_{c}",
+                                        num_features=self.num_features))
+                assemble_cols.append(f"__f_{c}")
             elif col.dtype == bool:
                 stages.append(_BoolToFloat(input_col=c, output_col=f"__f_{c}"))
                 assemble_cols.append(f"__f_{c}")
             elif np.issubdtype(col.dtype, np.number):
                 numeric_cols.append(c)
                 assemble_cols.append(f"__f_{c}")
-            elif col.dtype == object and len(col) and isinstance(col[0], (list, tuple)):
+            elif col.dtype == object and any(
+                    isinstance(v, (list, tuple, np.ndarray)) for v in col):
                 from synapseml_tpu.featurize.text import HashingTF
                 stages.append(HashingTF(input_col=c, output_col=f"__f_{c}",
                                         num_features=self.num_features))

@@ -88,6 +88,9 @@ class DataConversion(Transformer):
     cols = Param("columns to convert", default=None)
     convert_to = Param("target type name", default="double")
     date_format = Param("strftime format for date→string", default="yyyy-MM-dd HH:mm:ss")
+    categorical_models = ComplexParam(
+        "per-column fitted indexers, learned on first transform so repeated "
+        "batches map values consistently", default=None)
 
     def _transform(self, table: Table) -> Table:
         target = self.convert_to
@@ -96,8 +99,13 @@ class DataConversion(Transformer):
             col = table[c]
             if target == "toCategorical":
                 from synapseml_tpu.featurize.indexer import ValueIndexer
-                model = ValueIndexer(input_col=c, output_col=c).fit(table)
-                new[c] = model.transform(table)[c]
+                cache = self.categorical_models
+                if cache is None:
+                    cache = {}
+                    self.set(categorical_models=cache)
+                if c not in cache:
+                    cache[c] = ValueIndexer(input_col=c, output_col=c).fit(table)
+                new[c] = cache[c].transform(table)[c]
             elif target == "clearCategorical":
                 new[c] = col
             elif target == "string":

@@ -48,6 +48,8 @@ class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
             idx = np.fromiter(
                 (missing_idx if _is_missing(v) else lut.get(v, missing_idx) for v in col),
                 dtype=np.int32, count=len(col))
+        elif not levels:
+            idx = np.full(len(col), missing_idx, dtype=np.int32)
         else:
             # numeric path: vectorized searchsorted over sorted levels
             lv = np.asarray(levels)

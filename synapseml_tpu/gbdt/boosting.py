@@ -175,7 +175,10 @@ class _ValidTracker:
         self._pt = jax.jit(predict_tree)
 
     def add_tree(self, tree, class_idx: int):
-        for v in self.sets:
+        if not self.enabled:
+            return
+        # step() only consumes sets[0]; skip accumulating scores nobody reads
+        for v in self.sets[:1]:
             vt = self._pt(
                 (tree.split_feature, tree.threshold, tree.left_child,
                  tree.right_child, tree.leaf_value), v[0])
@@ -276,6 +279,11 @@ class Booster:
             jnp.asarray(self.trees_value[:t]),
         )
         weights = jnp.asarray(self.tree_weights[:t], jnp.float32)
+        if self.params.boosting_type == "rf" and t > 0:
+            # rf margins are averages over the trees actually used, so a
+            # truncated predict (early stopping / num_iteration) must
+            # renormalize from 1/T_total to 1/T_kept
+            weights = jnp.full((t,), 1.0 / max(t // k, 1), jnp.float32)
         out = _predict_stack(stack, weights, jnp.asarray(x), k, t)
         out = np.asarray(out) + self.init_score
         return out if k > 1 else out[:, 0]

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from typing import List, Optional
 
@@ -172,15 +173,26 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
         valid = []
         if valid_t is not None and valid_t.num_rows:
             vy_raw = np.asarray(valid_t[self.label_col], np.float64)
-            valid = [(self._features(valid_t),
-                      np.searchsorted(classes, vy_raw).astype(np.float64))]
+            # rows whose label never appeared in training have no class index;
+            # they are dropped from eval (scoring them is ill-defined)
+            vpos = np.clip(np.searchsorted(classes, vy_raw), 0, len(classes) - 1)
+            known = classes[vpos] == vy_raw
+            if not known.all():
+                logging.getLogger("synapseml_tpu").warning(
+                    "dropping %d validation rows with labels unseen in training",
+                    int((~known).sum()))
+            if known.any():
+                valid = [(self._features(valid_t)[known],
+                          vpos[known].astype(np.float64))]
         booster = train(
             self._boost_params(objective,
                                num_class if objective != "binary" else 1),
             x, y, weight=weight, valid_sets=valid)
         model = self._make_model(LightGBMClassificationModel, booster)
-        model.set(num_classes=max(num_class, 2),
-                  label_values=[float(c) for c in classes])
+        label_values = [float(c) for c in classes]
+        while len(label_values) < 2:  # single-class fit still emits 2 prob cols
+            label_values.append(label_values[-1] if label_values else 0.0)
+        model.set(num_classes=max(num_class, 2), label_values=label_values)
         return model
 
 

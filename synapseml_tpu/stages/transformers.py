@@ -1,0 +1,507 @@
+"""Utility pipeline transformers.
+
+TPU-native re-design of the reference's stage zoo
+(ref: core/src/main/scala/com/microsoft/ml/spark/stages/ — DropColumns ~40 LoC,
+SelectColumns, RenameColumn, Repartition, StratifiedRepartition.scala:31,
+EnsembleByKey.scala:152, Explode.scala:43, Lambda.scala:22,
+UDFTransformer.scala:112, MultiColumnAdapter.scala:135, TextPreprocessor.scala:98,
+UnicodeNormalize.scala:22, ClassBalancer.scala:25, Timer.scala:55,
+SummarizeData.scala:101, Cacher.scala:43, udfs.scala:36).
+
+Stages operate on the columnar :class:`Table`; anything numeric is vectorized
+numpy/jax rather than per-row UDF dispatch, because a fused columnar op is the
+TPU-friendly shape of this work (one host→device transfer per column, not per
+row).
+"""
+from __future__ import annotations
+
+import logging
+import time
+import unicodedata
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from synapseml_tpu.core.param import (
+    ComplexParam,
+    HasInputCol,
+    HasInputCols,
+    HasLabelCol,
+    HasOutputCol,
+    Param,
+)
+from synapseml_tpu.core.pipeline import Estimator, Model, Transformer
+from synapseml_tpu.data.table import Table, concat_tables
+
+logger = logging.getLogger("synapseml_tpu")
+
+
+class DropColumns(Transformer):
+    """Drop the named columns (ref: stages/DropColumns.scala)."""
+
+    cols = Param("columns to drop", default=())
+
+    def __init__(self, cols: Sequence[str] = (), **kw):
+        super().__init__(**kw)
+        self.set(cols=list(cols))
+
+    def _transform(self, table: Table) -> Table:
+        return table.drop(*self.cols)
+
+
+class SelectColumns(Transformer):
+    """Keep only the named columns (ref: stages/SelectColumns.scala)."""
+
+    cols = Param("columns to keep", default=())
+
+    def __init__(self, cols: Sequence[str] = (), **kw):
+        super().__init__(**kw)
+        self.set(cols=list(cols))
+
+    def _transform(self, table: Table) -> Table:
+        return table.select(*self.cols)
+
+
+class RenameColumn(Transformer, HasInputCol, HasOutputCol):
+    """Rename one column (ref: stages/RenameColumn.scala)."""
+
+    def _transform(self, table: Table) -> Table:
+        return table.rename({self.input_col: self.output_col})
+
+
+class Repartition(Transformer):
+    """Re-chunk the table into ``n`` near-equal shards.
+
+    The reference reshuffles Spark partitions (ref: stages/Repartition.scala);
+    here a Table is one contiguous block, so "repartition" records the shard
+    boundaries used downstream by the batched executor and distributed trainers
+    (shards become the per-device leading dim).
+    """
+
+    n = Param("number of partitions", default=1)
+    disable = Param("pass-through when true", default=False)
+
+    def __init__(self, n: int = 1, **kw):
+        super().__init__(**kw)
+        self.set(n=n)
+
+    def _transform(self, table: Table) -> Table:
+        return table
+
+    def shards(self, table: Table) -> List[Table]:
+        if self.disable:
+            return [table]
+        bounds = np.linspace(0, table.num_rows, self.n + 1).astype(int)
+        return [table.slice(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])]
+
+
+class StratifiedRepartition(Transformer, HasLabelCol):
+    """Rebalance rows so each shard sees every label
+    (ref: stages/StratifiedRepartition.scala:31 — per-label round-robin)."""
+
+    n = Param("number of partitions", default=1)
+    mode = Param("equal | original | mixed", default="mixed")
+
+    def _transform(self, table: Table) -> Table:
+        labels = table[self.label_col]
+        order: List[int] = []
+        groups = [list(idx) for idx in table.group_indices(self.label_col).values()]
+        # round-robin interleave so every contiguous shard contains all labels
+        i = 0
+        while any(groups):
+            for g in groups:
+                if i < len(g):
+                    order.append(g[i])
+            i += 1
+            groups = [g for g in groups if i <= len(g)]
+        del labels
+        return table.take(np.asarray(order[: table.num_rows], dtype=int))
+
+
+class EnsembleByKey(Transformer):
+    """Group rows by key columns and average the named vector/scalar columns
+    (ref: stages/EnsembleByKey.scala:152)."""
+
+    keys = Param("key columns", default=())
+    cols = Param("value columns to ensemble", default=())
+    strategy = Param("only 'mean' is supported, as in the reference", default="mean")
+    collapse_group = Param("emit one row per key when true", default=True)
+    vector_dims = ComplexParam("optional {col: dim} checks", default=None)
+
+    def __init__(self, keys: Sequence[str] = (), cols: Sequence[str] = (), **kw):
+        super().__init__(**kw)
+        self.set(keys=list(keys), cols=list(cols))
+
+    def _transform(self, table: Table) -> Table:
+        keys, cols = list(self.keys), list(self.cols)
+        key_col = (
+            table[keys[0]].astype(str)
+            if len(keys) == 1
+            else np.array(["".join(str(table[k][i]) for k in keys)
+                           for i in range(table.num_rows)], dtype=object)
+        )
+        tmp = table.with_column("__ensemble_key__", key_col)
+        groups = tmp.group_indices("__ensemble_key__")
+        out_rows: Dict[str, List[Any]] = {k: [] for k in keys}
+        means: Dict[str, List[Any]] = {f"mean({c})": [] for c in cols}
+        for _, idx in groups.items():
+            for k in keys:
+                out_rows[k].append(table[k][idx[0]])
+            for c in cols:
+                means[f"mean({c})"].append(np.mean(np.stack([table[c][i] for i in idx]), axis=0))
+        if self.collapse_group:
+            return Table({**out_rows, **means})
+        # broadcast group means back onto original rows
+        expanded = {name: [None] * table.num_rows for name in means}
+        for gi, (_, idx) in enumerate(groups.items()):
+            for name in means:
+                for i in idx:
+                    expanded[name][i] = means[name][gi]
+        return table.with_columns({n: np.asarray(v) if np.asarray(v).dtype != object else _obj(v)
+                                   for n, v in expanded.items()})
+
+
+def _obj(values: List[Any]) -> np.ndarray:
+    arr = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        arr[i] = v
+    return arr
+
+
+class Explode(Transformer, HasInputCol, HasOutputCol):
+    """One output row per element of an array column (ref: stages/Explode.scala:43)."""
+
+    def _transform(self, table: Table) -> Table:
+        col = table[self.input_col]
+        counts = np.asarray([len(v) for v in col])
+        rep = np.repeat(np.arange(table.num_rows), counts)
+        exploded = _obj([x for v in col for x in v])
+        base = table.take(rep)
+        if exploded.size and not isinstance(exploded[0], (list, np.ndarray, dict)):
+            exploded = np.asarray(list(exploded))
+        return base.with_column(self.output_col, exploded)
+
+
+class Lambda(Transformer):
+    """Arbitrary Table -> Table function as a stage (ref: stages/Lambda.scala:22)."""
+
+    fn = ComplexParam("table -> table callable")
+
+    def __init__(self, fn: Optional[Callable[[Table], Table]] = None, **kw):
+        super().__init__(**kw)
+        if fn is not None:
+            self.set(fn=fn)
+
+    def _transform(self, table: Table) -> Table:
+        return self.fn(table)
+
+
+class UDFTransformer(Transformer, HasInputCol, HasInputCols, HasOutputCol):
+    """Apply a per-row (or whole-column when ``vectorized``) function
+    (ref: stages/UDFTransformer.scala:112)."""
+
+    udf = ComplexParam("row function")
+    vectorized = Param("when true, udf receives whole column array(s)", default=False)
+
+    def __init__(self, udf: Optional[Callable] = None, **kw):
+        super().__init__(**kw)
+        if udf is not None:
+            self.set(udf=udf)
+
+    def _transform(self, table: Table) -> Table:
+        fn = self.udf
+        cols = self.input_cols or [self.input_col]
+        arrays = [table[c] for c in cols]
+        if self.vectorized:
+            out = fn(*arrays)
+        else:
+            out = [fn(*vals) for vals in zip(*arrays)]
+        return table.with_column(self.output_col, out)
+
+
+class MultiColumnAdapter(Transformer):
+    """Apply one single-column transformer across many column pairs
+    (ref: stages/MultiColumnAdapter.scala:135)."""
+
+    base_stage = ComplexParam("single-col transformer/estimator to replicate")
+    input_cols = Param("input columns", default=())
+    output_cols = Param("output columns", default=())
+
+    def __init__(self, base_stage=None, input_cols=(), output_cols=(), **kw):
+        super().__init__(**kw)
+        if base_stage is not None:
+            self.set(base_stage=base_stage)
+        self.set(input_cols=list(input_cols), output_cols=list(output_cols))
+
+    def _pairs(self):
+        ins, outs = list(self.input_cols), list(self.output_cols)
+        if len(ins) != len(outs):
+            raise ValueError("input_cols and output_cols must have equal length")
+        return list(zip(ins, outs))
+
+    def _transform(self, table: Table) -> Table:
+        for i, o in self._pairs():
+            stage = self.base_stage.copy(input_col=i, output_col=o)
+            table = stage.transform(table)
+        return table
+
+    def fit(self, table: Table) -> "MultiColumnAdapterModel":
+        fitted = []
+        for i, o in self._pairs():
+            stage = self.base_stage.copy(input_col=i, output_col=o)
+            fitted.append(stage.fit(table) if isinstance(stage, Estimator) else stage)
+        return MultiColumnAdapterModel(stages=fitted)
+
+
+class MultiColumnAdapterModel(Model):
+    stages = ComplexParam("fitted per-column stages")
+
+    def __init__(self, stages=None, **kw):
+        super().__init__(**kw)
+        if stages is not None:
+            self.set(stages=stages)
+
+    def _transform(self, table: Table) -> Table:
+        for s in self.stages:
+            table = s.transform(table)
+        return table
+
+
+class _TrieNode(dict):
+    __slots__ = ("value",)
+
+    def __init__(self):
+        super().__init__()
+        self.value: Optional[str] = None
+
+
+class TextPreprocessor(Transformer, HasInputCol, HasOutputCol):
+    """Longest-match replacement via a trie over the map keys
+    (ref: stages/TextPreprocessor.scala:98 — trie-based normalization)."""
+
+    map = ComplexParam("substring -> replacement map", default=None)
+    normalize_pattern = Param("chars-to-strip regex (applied before match)", default=None)
+
+    def __init__(self, map: Optional[Dict[str, str]] = None, **kw):
+        super().__init__(**kw)
+        if map is not None:
+            self.set(map=map)
+
+    def _build_trie(self) -> _TrieNode:
+        root = _TrieNode()
+        for key, val in (self.map or {}).items():
+            node = root
+            for ch in key:
+                node = node.setdefault(ch, _TrieNode())
+            node.value = val
+        return root
+
+    def _transform(self, table: Table) -> Table:
+        trie = self._build_trie()
+
+        def process(text: str) -> str:
+            out, i, n = [], 0, len(text)
+            while i < n:
+                node, j, best, best_end = trie, i, None, i
+                while j < n and text[j] in node:
+                    node = node[text[j]]
+                    j += 1
+                    if node.value is not None:
+                        best, best_end = node.value, j
+                if best is not None:
+                    out.append(best)
+                    i = best_end
+                else:
+                    out.append(text[i])
+                    i += 1
+            return "".join(out)
+
+        return table.map_column(self.input_col, process, self.output_col)
+
+
+class UnicodeNormalize(Transformer, HasInputCol, HasOutputCol):
+    """NFC/NFD/NFKC/NFKD + optional lower-casing (ref: stages/UnicodeNormalize.scala:22)."""
+
+    form = Param("unicode normal form", default="NFKD")
+    lower = Param("lower-case the output", default=True)
+
+    def _transform(self, table: Table) -> Table:
+        def norm(s: str) -> str:
+            s = unicodedata.normalize(self.form, s)
+            return s.lower() if self.lower else s
+
+        return table.map_column(self.input_col, norm, self.output_col)
+
+
+class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
+    """Adds a weight column inversely proportional to class frequency
+    (ref: stages/ClassBalancer.scala:25)."""
+
+    broadcast_join = Param("kept for API parity; join is columnar here", default=True)
+
+    def __init__(self, input_col: str = "label", output_col: str = "weight", **kw):
+        super().__init__(**kw)
+        self.set(input_col=input_col, output_col=output_col)
+
+    def _fit(self, table: Table) -> "ClassBalancerModel":
+        col = table[self.input_col]
+        values, counts = np.unique(col.astype(str), return_counts=True)
+        weights = counts.max() / counts.astype(np.float64)
+        return ClassBalancerModel(
+            weights={v: float(w) for v, w in zip(values, weights)},
+            input_col=self.input_col, output_col=self.output_col)
+
+
+class ClassBalancerModel(Model, HasInputCol, HasOutputCol):
+    weights = ComplexParam("class -> weight")
+
+    def __init__(self, weights=None, **kw):
+        super().__init__(**kw)
+        if weights is not None:
+            self.set(weights=weights)
+
+    def _transform(self, table: Table) -> Table:
+        w = self.weights
+        col = table[self.input_col]
+        return table.with_column(
+            self.output_col,
+            np.asarray([w[str(v)] for v in col], dtype=np.float64))
+
+
+class Timer(Estimator):
+    """Wrap a stage; log wall-clock of its fit/transform
+    (ref: stages/Timer.scala:55)."""
+
+    stage = ComplexParam("wrapped stage")
+    log_to_scala = Param("kept for parity; logs via python logging", default=True)
+    disable = Param("pass-through when true", default=False)
+
+    def __init__(self, stage=None, **kw):
+        super().__init__(**kw)
+        if stage is not None:
+            self.set(stage=stage)
+
+    def _fit(self, table: Table) -> "TimerModel":
+        inner = self.stage
+        if isinstance(inner, Estimator):
+            t0 = time.time()
+            fitted = inner.fit(table)
+            if not self.disable:
+                logger.info("%s took %.3fs to fit", inner, time.time() - t0)
+            return TimerModel(stage=fitted, disable=self.disable)
+        return TimerModel(stage=inner, disable=self.disable)
+
+
+class TimerModel(Model):
+    stage = ComplexParam("wrapped fitted stage")
+    disable = Param("pass-through when true", default=False)
+
+    def __init__(self, stage=None, **kw):
+        super().__init__(**kw)
+        if stage is not None:
+            self.set(stage=stage)
+
+    def _transform(self, table: Table) -> Table:
+        t0 = time.time()
+        out = self.stage.transform(table)
+        if not self.disable:
+            logger.info("%s took %.3fs to transform", self.stage, time.time() - t0)
+        return out
+
+
+class SummarizeData(Transformer):
+    """Counts / quantiles / missing / basic stats per column
+    (ref: stages/SummarizeData.scala:101)."""
+
+    counts = Param("emit count block", default=True)
+    basic = Param("emit basic block", default=True)
+    sample = Param("emit sample quantile block", default=True)
+    percentiles = Param("emit percentile block", default=True)
+    error_threshold = Param("quantile error (parity; exact here)", default=0.0)
+
+    _PCTS = (0.005, 0.01, 0.05, 0.25, 0.5, 0.75, 0.95, 0.99, 0.995)
+
+    def _transform(self, table: Table) -> Table:
+        rows: Dict[str, List[Any]] = {"Feature": []}
+
+        def put(name: str, val: Any):
+            rows.setdefault(name, []).append(val)
+
+        for name in table.columns:
+            col = table[name]
+            rows["Feature"].append(name)
+            is_num = col.dtype.kind in "biufc" and col.ndim == 1
+            numeric = col.astype(np.float64) if is_num else None
+            if self.counts:
+                put("Count", float(len(col)))
+                missing = (
+                    float(np.isnan(numeric).sum()) if is_num
+                    else float(sum(v is None for v in col)))
+                put("Missing Value Count", missing)
+                uniq = (len(np.unique(col[~np.isnan(numeric)])) if is_num
+                        else len({str(v) for v in col}))
+                put("Unique Value Count", float(uniq))
+            if self.basic:
+                put("Min", float(np.nanmin(numeric)) if is_num and len(col) else np.nan)
+                put("Max", float(np.nanmax(numeric)) if is_num and len(col) else np.nan)
+                put("Mean", float(np.nanmean(numeric)) if is_num and len(col) else np.nan)
+                put("Variance", float(np.nanvar(numeric, ddof=1)) if is_num and len(col) > 1 else np.nan)
+            if self.sample:
+                put("Sample Variance", float(np.nanvar(numeric, ddof=1)) if is_num and len(col) > 1 else np.nan)
+                put("Sample Standard Deviation",
+                    float(np.nanstd(numeric, ddof=1)) if is_num and len(col) > 1 else np.nan)
+            if self.percentiles:
+                for p in self._PCTS:
+                    put(f"P{p}", float(np.nanquantile(numeric, p)) if is_num and len(col) else np.nan)
+        return Table(rows)
+
+
+class Cacher(Transformer):
+    """Materializes/pins the table (ref: stages/Cacher.scala:43).
+
+    Tables are already host-resident numpy; cache here means pre-staging the
+    numeric columns onto the TPU device so downstream jitted stages skip the
+    host→device copy.
+    """
+
+    disable = Param("pass-through when true", default=False)
+    device_put = Param("stage numeric columns onto the default device", default=True)
+
+    def _transform(self, table: Table) -> Table:
+        if self.disable or not self.device_put:
+            return table
+        import jax
+
+        for name in table.columns:
+            col = table[name]
+            if col.dtype.kind in "biuf":
+                # persistently cached on device; Table keeps the host view
+                jax.device_put(col)
+        return table
+
+
+class DynamicMiniBatchTransformer(Transformer):
+    """Re-export point for the batching machinery (ref: stages/MiniBatchTransformer.scala)."""
+
+    def __new__(cls, *a, **kw):
+        from synapseml_tpu.data.batching import DynamicMiniBatchTransformer as Impl
+        return Impl(*a, **kw)
+
+
+class PartitionConsolidator(Transformer, HasInputCol, HasOutputCol):
+    """Funnel many shards' rows through one worker (rate-limited services)
+    (ref: stages/PartitionConsolidator.scala:20-139).
+
+    In the columnar runtime this is a shard-coalescer: given shards produced by
+    :meth:`Repartition.shards`, it concatenates them so exactly one downstream
+    worker (e.g. one HTTP client) sees the whole stream.
+    """
+
+    concurrency = Param("number of concurrent consumers after consolidation", default=1)
+
+    def _transform(self, table: Table) -> Table:
+        return table
+
+    def consolidate(self, shards: Sequence[Table]) -> List[Table]:
+        merged = concat_tables(list(shards))
+        return [merged] + [Table({}) for _ in range(len(shards) - 1)]

@@ -1,0 +1,139 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.stages import (
+    ClassBalancer,
+    DropColumns,
+    EnsembleByKey,
+    Explode,
+    Lambda,
+    MultiColumnAdapter,
+    PartitionConsolidator,
+    RenameColumn,
+    Repartition,
+    SelectColumns,
+    StratifiedRepartition,
+    SummarizeData,
+    TextPreprocessor,
+    Timer,
+    UDFTransformer,
+    UnicodeNormalize,
+)
+
+
+@pytest.fixture
+def table():
+    return Table({
+        "a": np.arange(6, dtype=np.float64),
+        "b": ["x", "y", "x", "y", "x", "y"],
+        "label": [0, 0, 0, 0, 1, 1],
+    })
+
+
+def test_drop_select_rename(table):
+    assert DropColumns(["a"]).transform(table).columns == ["b", "label"]
+    assert SelectColumns(["a"]).transform(table).columns == ["a"]
+    out = RenameColumn(input_col="a", output_col="z").transform(table)
+    assert "z" in out and "a" not in out
+
+
+def test_repartition_shards(table):
+    shards = Repartition(n=3).shards(table)
+    assert len(shards) == 3
+    assert sum(s.num_rows for s in shards) == 6
+
+
+def test_stratified_repartition(table):
+    out = StratifiedRepartition(label_col="label", n=2).transform(table)
+    assert out.num_rows == 6
+    # first half should contain both labels after interleave
+    first = out.slice(0, 3)["label"]
+    assert set(first) == {0, 1}
+
+
+def test_ensemble_by_key():
+    t = Table({
+        "k": ["u", "u", "v"],
+        "score": np.array([[1.0, 3.0], [3.0, 5.0], [2.0, 2.0]]),
+    })
+    out = EnsembleByKey(keys=["k"], cols=["score"]).transform(t)
+    assert out.num_rows == 2
+    got = {out["k"][i]: out["mean(score)"][i] for i in range(2)}
+    np.testing.assert_allclose(got["u"], [2.0, 4.0])
+
+
+def test_explode():
+    t = Table({"id": [1, 2], "words": [["a", "b"], ["c"]]})
+    out = Explode(input_col="words", output_col="word").transform(t)
+    assert out.num_rows == 3
+    assert list(out["word"]) == ["a", "b", "c"]
+    assert list(out["id"]) == [1, 1, 2]
+
+
+def test_lambda_and_udf(table):
+    out = Lambda(lambda t: t.with_column("c", t["a"] * 2)).transform(table)
+    np.testing.assert_allclose(out["c"], table["a"] * 2)
+    udf = UDFTransformer(lambda v: v + 1.0, input_col="a", output_col="a1")
+    np.testing.assert_allclose(udf.transform(table)["a1"], table["a"] + 1)
+    vec = UDFTransformer(lambda v: v * 3, input_col="a", output_col="a3",
+                         vectorized=True)
+    np.testing.assert_allclose(vec.transform(table)["a3"], table["a"] * 3)
+
+
+def test_multi_column_adapter(table):
+    base = UDFTransformer(lambda v: str(v).upper(), input_col="x", output_col="y")
+    mca = MultiColumnAdapter(base, input_cols=["b"], output_cols=["B"])
+    out = mca.transform(table)
+    assert list(out["B"]) == ["X", "Y", "X", "Y", "X", "Y"]
+
+
+def test_text_preprocessor():
+    t = Table({"text": ["the cat sat", "catalog"]})
+    tp = TextPreprocessor({"cat": "dog", "catalog": "book"},
+                          input_col="text", output_col="out")
+    out = tp.transform(t)
+    # longest match wins: "catalog" -> "book", not "dogalog"
+    assert list(out["out"]) == ["the dog sat", "book"]
+
+
+def test_unicode_normalize():
+    t = Table({"text": ["Café"]})
+    out = UnicodeNormalize(input_col="text", output_col="out", form="NFKD").transform(t)
+    assert out["out"][0].startswith("cafe")
+
+
+def test_class_balancer(table):
+    model = ClassBalancer(input_col="label", output_col="w").fit(table)
+    out = model.transform(table)
+    # minority class (1, count 2) gets weight 2x majority (0, count 4)
+    w0 = out["w"][0]
+    w1 = out["w"][5]
+    assert w1 == pytest.approx(2 * w0)
+
+
+def test_timer(table):
+    inner = UDFTransformer(lambda v: v, input_col="a", output_col="a2")
+    model = Timer(inner).fit(table)
+    assert "a2" in model.transform(table)
+
+
+def test_summarize(table):
+    out = SummarizeData().transform(table)
+    stats = {out["Feature"][i]: out["Mean"][i] for i in range(out.num_rows)}
+    assert stats["a"] == pytest.approx(2.5)
+
+
+def test_partition_consolidator(table):
+    shards = Repartition(n=3).shards(table)
+    merged = PartitionConsolidator().consolidate(shards)
+    assert merged[0].num_rows == 6
+    assert all(m.num_rows == 0 for m in merged[1:])
+
+
+def test_stage_serde(table, tmp_path):
+    from synapseml_tpu.core.pipeline import PipelineStage
+    tp = TextPreprocessor({"cat": "dog"}, input_col="b", output_col="b2")
+    tp.save(str(tmp_path / "tp"))
+    loaded = PipelineStage.load(str(tmp_path / "tp"))
+    assert loaded.map == {"cat": "dog"}
