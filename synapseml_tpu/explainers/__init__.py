@@ -1,0 +1,22 @@
+from synapseml_tpu.explainers.local import (
+    ImageLIME,
+    ImageSHAP,
+    LocalExplainer,
+    TabularLIME,
+    TabularSHAP,
+    TextLIME,
+    TextSHAP,
+    VectorLIME,
+    VectorSHAP,
+)
+from synapseml_tpu.explainers.superpixel import SuperpixelData, superpixels
+from synapseml_tpu.explainers.surrogate import (
+    weighted_lasso,
+    weighted_least_squares,
+)
+
+__all__ = [
+    "ImageLIME", "ImageSHAP", "LocalExplainer", "TabularLIME", "TabularSHAP",
+    "TextLIME", "TextSHAP", "VectorLIME", "VectorSHAP", "SuperpixelData",
+    "superpixels", "weighted_lasso", "weighted_least_squares",
+]

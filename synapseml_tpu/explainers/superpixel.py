@@ -1,0 +1,88 @@
+"""SLIC-style superpixel clustering on device.
+
+Re-design of the reference's Superpixel
+(ref: core/.../lime/Superpixel.scala:42-267 — grid-seeded iterative
+color-distance clustering, `cellSize`/`modifier` params) as a jitted jax
+k-means-style loop: all pixel→center distances compute as one [HW, P] block
+per iteration (MXU-friendly), centers update via ``segment_sum``. No
+per-pixel Python.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class SuperpixelData:
+    """Cluster assignment for one image (ref: SuperpixelData.scala:25)."""
+    assignment: np.ndarray  # [H, W] int32 cluster ids
+    num_clusters: int
+
+    def masked_image(self, image: np.ndarray, state: np.ndarray,
+                     background: float = 0.0) -> np.ndarray:
+        """Apply an on/off superpixel state vector to the image."""
+        on = np.asarray(state)[self.assignment].astype(image.dtype)
+        if image.ndim == 3:
+            on = on[..., None]
+        return image * on + background * (1 - on)
+
+
+@partial(jax.jit, static_argnames=("grid_h", "grid_w", "iters"))
+def _slic(pix, yx, grid_h: int, grid_w: int, spatial_w, iters: int):
+    h, w, _ = pix.shape
+    p = grid_h * grid_w
+    flat = pix.reshape(-1, pix.shape[-1])
+    pos = yx.reshape(-1, 2)
+    cy = (jnp.arange(grid_h) + 0.5) * (h / grid_h)
+    cx = (jnp.arange(grid_w) + 0.5) * (w / grid_w)
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1).reshape(-1, 2)
+    c_idx = (jnp.clip(cyx[:, 0].astype(jnp.int32), 0, h - 1) * w
+             + jnp.clip(cyx[:, 1].astype(jnp.int32), 0, w - 1))
+    centers = jnp.concatenate([flat[c_idx], cyx], axis=1)  # [P, C+2]
+
+    def body(_, centers):
+        cd = jnp.sum((flat[:, None, :] - centers[None, :, :-2]) ** 2, -1)
+        sd = jnp.sum((pos[:, None, :] - centers[None, :, -2:]) ** 2, -1)
+        assign = jnp.argmin(cd + spatial_w * sd, axis=1)
+        feat = jnp.concatenate([flat, pos], axis=1)
+        sums = jax.ops.segment_sum(feat, assign, num_segments=p)
+        cnts = jax.ops.segment_sum(jnp.ones((flat.shape[0],)), assign,
+                                   num_segments=p)
+        new = sums / jnp.maximum(cnts, 1.0)[:, None]
+        return jnp.where(cnts[:, None] > 0, new, centers)
+
+    centers = jax.lax.fori_loop(0, iters, body, centers)
+    cd = jnp.sum((flat[:, None, :] - centers[None, :, :-2]) ** 2, -1)
+    sd = jnp.sum((pos[:, None, :] - centers[None, :, -2:]) ** 2, -1)
+    return jnp.argmin(cd + spatial_w * sd, axis=1).astype(jnp.int32)
+
+
+def superpixels(image: np.ndarray, cell_size: float = 16.0,
+                modifier: float = 130.0, iters: int = 10) -> SuperpixelData:
+    """Cluster an [H, W, C] (or [H, W]) image into ~(H/cell)*(W/cell)
+    superpixels. ``modifier`` balances color vs spatial distance, matching the
+    reference's parameter naming (ref: Superpixel.scala:148)."""
+    img = np.asarray(image, np.float32)
+    if img.ndim == 2:
+        img = img[..., None]
+    if img.max() <= 1.5:  # normalize to the 0..255 scale `modifier` assumes
+        img = img * 255.0
+    h, w = img.shape[:2]
+    grid_h = max(1, round(h / cell_size))
+    grid_w = max(1, round(w / cell_size))
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    yx = np.stack([ys, xs], -1).astype(np.float32)
+    # standard SLIC distance: d_color^2 + (modifier/S)^2 * d_spatial^2,
+    # colors on the 0..255 scale
+    spatial_w = (modifier / cell_size) ** 2
+    assign = np.asarray(_slic(jnp.asarray(img), jnp.asarray(yx),
+                              grid_h, grid_w, spatial_w, iters))
+    # compact ids: drop empty clusters so states have no dead slots
+    uniq, compact = np.unique(assign, return_inverse=True)
+    return SuperpixelData(compact.reshape(h, w).astype(np.int32), len(uniq))

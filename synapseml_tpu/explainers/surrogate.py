@@ -1,0 +1,115 @@
+"""Surrogate regressions for LIME / KernelSHAP.
+
+Re-design of the reference's pure-Breeze solvers
+(ref: core/.../explainers/LassoRegression.scala:74 — coordinate-descent lasso,
+LeastSquaresRegression.scala:8, RegressionBase.scala:20 — weighted
+centering/rescaling) as jitted jax kernels, vmappable over a whole batch of
+rows so one device launch fits every row's surrogate at once (the reference
+fits per-row on the driver).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("fit_intercept",))
+def weighted_least_squares(x, y, w, fit_intercept: bool = True, l2: float = 1e-6):
+    """Closed-form weighted ridge-stabilized least squares.
+
+    x: [S, D], y: [S], w: [S] sample weights. Returns (coefs [D], intercept).
+    (ref: LeastSquaresRegression.scala:8 — normal equations on weighted data)
+    """
+    w = w / (jnp.sum(w) + 1e-12)
+    if fit_intercept:
+        xm = jnp.sum(x * w[:, None], axis=0)
+        ym = jnp.sum(y * w)
+        xc, yc = x - xm, y - ym
+    else:
+        xm = jnp.zeros(x.shape[1], x.dtype)
+        ym = jnp.asarray(0.0, x.dtype)
+        xc, yc = x, y
+    xw = xc * w[:, None]
+    a = xc.T @ xw + l2 * jnp.eye(x.shape[1], dtype=x.dtype)
+    b = xw.T @ yc
+    coefs = jnp.linalg.solve(a, b)
+    intercept = ym - jnp.dot(xm, coefs)
+    return coefs, intercept
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def weighted_lasso(x, y, w, alpha, iters: int = 100):
+    """Weighted lasso via cyclic coordinate descent with soft-thresholding,
+    on standardized features (ref: LassoRegression.scala:10-74
+    CoordinateDescentLasso). Returns (coefs [D], intercept) in original scale.
+
+    The coordinate sweep is a ``lax.fori_loop`` over a ``lax.scan`` across
+    coordinates — fixed trip count, so XLA compiles one fused kernel and the
+    whole batch of per-row fits runs as a single vmapped launch.
+    """
+    s, d = x.shape
+    w = w / (jnp.sum(w) + 1e-12)
+    xm = jnp.sum(x * w[:, None], axis=0)
+    ym = jnp.sum(y * w)
+    xc = x - xm
+    yc = y - ym
+    scale = jnp.sqrt(jnp.sum(xc * xc * w[:, None], axis=0)) + 1e-12
+    xs = xc / scale
+    # precompute weighted gram quantities
+    g = (xs * w[:, None]).T @ xs          # [D, D]
+    c = (xs * w[:, None]).T @ yc          # [D]
+
+    def coord_step(beta, j):
+        rho = c[j] - jnp.dot(g[j], beta) + g[j, j] * beta[j]
+        bj = jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - alpha, 0.0) / (g[j, j] + 1e-12)
+        beta = beta.at[j].set(bj)
+        return beta, None
+
+    def sweep(_, beta):
+        beta, _ = jax.lax.scan(coord_step, beta, jnp.arange(d))
+        return beta
+
+    beta = jax.lax.fori_loop(0, iters, sweep, jnp.zeros(d, x.dtype))
+    coefs = beta / scale
+    intercept = ym - jnp.dot(xm, coefs)
+    return coefs, intercept
+
+
+# batched variants: one launch fits surrogates for every explained row
+batched_least_squares = jax.jit(
+    jax.vmap(lambda x, y, w: weighted_least_squares(x, y, w)),
+)
+batched_lasso = jax.jit(
+    jax.vmap(lambda x, y, w, a: weighted_lasso(x, y, w, a)),
+)
+
+
+@jax.jit
+def shap_weighted_fit(z, y, w, fnull, fx):
+    """KernelSHAP solve with the efficiency constraint eliminated exactly.
+
+    z: [S, D] coalition matrix, y: [S] model outputs, w: [S] shapley kernel
+    weights, fnull: model output on the all-background sample, fx: output on
+    the original row. Instead of soft-pinning the constraint with a huge
+    weight (catastrophic in float32), substitute
+    ``phi_D = (fx - fnull) - sum(phi_1..D-1)`` and solve the reduced weighted
+    least squares — intercept is phi_0 = fnull by construction, matching the
+    reference's weighted-LS-with-intercept-phi0 (ref: KernelSHAPBase.scala:42-94).
+    Returns [D+1]: phi_0 followed by phi_1..D.
+    """
+    e = fx - fnull
+    zd = z[:, -1:]
+    x = z[:, :-1] - zd                    # [S, D-1]
+    t = y - fnull - zd[:, 0] * e
+    xw = x * w[:, None]
+    a = x.T @ xw + 1e-8 * jnp.eye(x.shape[1], dtype=x.dtype)
+    b = xw.T @ t
+    head = jnp.linalg.solve(a, b)
+    last = e - jnp.sum(head)
+    return jnp.concatenate([jnp.asarray([fnull], z.dtype), head,
+                            jnp.asarray([last], z.dtype)])
+
+
+batched_shap_fit = jax.jit(jax.vmap(shap_weighted_fit))

@@ -115,8 +115,10 @@ class Featurize(Estimator, HasOutputCol):
             elif np.issubdtype(col.dtype, np.number):
                 numeric_cols.append(c)
                 assemble_cols.append(f"__f_{c}")
-            elif col.dtype == object and any(
-                    isinstance(v, (list, tuple, np.ndarray)) for v in col):
+            elif col.dtype == object and len(col) and all(
+                    isinstance(v, (list, tuple, np.ndarray))
+                    for v in col if v is not None) and any(
+                    v is not None for v in col):
                 from synapseml_tpu.featurize.text import HashingTF
                 stages.append(HashingTF(input_col=c, output_col=f"__f_{c}",
                                         num_features=self.num_features))

@@ -1,0 +1,3 @@
+from synapseml_tpu.isolationforest.iforest import IsolationForest, IsolationForestModel
+
+__all__ = ["IsolationForest", "IsolationForestModel"]

@@ -1,0 +1,181 @@
+"""Isolation Forest anomaly detector.
+
+The reference wraps LinkedIn's isolation-forest Spark library
+(ref: core/.../isolationforest/IsolationForest.scala:18-89, dep at
+build.sbt:36). Here the algorithm is implemented natively: trees are built on
+the host from subsamples (cheap, O(sample * trees)), then flattened into
+stacked arrays so *scoring* — the hot path — is a single jitted scan over all
+trees on device, the same stacked-tree layout the GBDT booster uses.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from synapseml_tpu.core.param import ComplexParam, HasFeaturesCol, HasPredictionCol, Param
+from synapseml_tpu.core.pipeline import Estimator, Model
+from synapseml_tpu.data.table import Table
+
+
+def _avg_path_length(n: float) -> float:
+    """c(n): average unsuccessful BST search length (Liu et al. 2008)."""
+    if n <= 1:
+        return 0.0
+    h = math.log(n - 1) + 0.5772156649
+    return 2.0 * h - 2.0 * (n - 1) / n
+
+
+def _build_tree(x: np.ndarray, rng: np.random.Generator, max_depth: int,
+                feature, threshold, left, right, depth_adj):
+    """Grow one isolation tree into flat arrays; returns node count used."""
+    nodes = [(x, 0)]  # (rows, depth) queued for node i in BFS order
+    i = 0
+    while nodes:
+        rows, depth = nodes.pop(0)
+        n = len(rows)
+        if depth >= max_depth or n <= 1:
+            feature.append(-1)
+            threshold.append(0.0)
+            left.append(-1)
+            right.append(-1)
+            depth_adj.append(depth + _avg_path_length(n))
+            i += 1
+            continue
+        # random split: feature uniform, threshold uniform in column range
+        spread = rows.max(axis=0) - rows.min(axis=0)
+        cand = np.flatnonzero(spread > 0)
+        if len(cand) == 0:
+            feature.append(-1)
+            threshold.append(0.0)
+            left.append(-1)
+            right.append(-1)
+            depth_adj.append(depth + _avg_path_length(n))
+            i += 1
+            continue
+        f = int(rng.choice(cand))
+        lo, hi = rows[:, f].min(), rows[:, f].max()
+        t = float(rng.uniform(lo, hi))
+        mask = rows[:, f] < t
+        feature.append(f)
+        threshold.append(t)
+        # children appended after all queued nodes (BFS indexing)
+        left.append(i + len(nodes) + 1)
+        right.append(i + len(nodes) + 2)
+        depth_adj.append(0.0)
+        nodes.append((rows[mask], depth + 1))
+        nodes.append((rows[~mask], depth + 1))
+        i += 1
+    return i
+
+
+@partial(jax.jit, static_argnames=("depth_iters",))
+def _path_lengths(stack, x, depth_iters: int):
+    """stack: (feature [T,M], threshold [T,M], left, right, depth_adj);
+    x: [N, D] -> mean path length [N] over trees via lax.scan.
+    ``depth_iters`` must be >= the deepest leaf (trees are unbalanced, so the
+    node count says nothing about depth)."""
+    feat, thr, lft, rgt, dadj = stack
+
+    rows = jnp.arange(x.shape[0])
+
+    def one_tree(carry, tree):
+        f, t, l, r, da = tree
+
+        def step(_, node):
+            fi = f[node]                                   # [N]
+            col = x[rows, jnp.maximum(fi, 0)]              # per-row gather
+            nxt = jnp.where(col < t[node], l[node], r[node])
+            return jnp.where(fi < 0, node, nxt)
+
+        node = jax.lax.fori_loop(
+            0, depth_iters, step,
+            jnp.zeros(x.shape[0], jnp.int32))
+        return carry + da[node], None
+
+    total, _ = jax.lax.scan(one_tree, jnp.zeros(x.shape[0], jnp.float32),
+                            (feat, thr, lft, rgt, dadj))
+    return total / feat.shape[0]
+
+
+class IsolationForest(Estimator, HasFeaturesCol, HasPredictionCol):
+    """ref: core/.../isolationforest/IsolationForest.scala:18 (param names
+    follow the LinkedIn library the reference wraps)."""
+
+    num_estimators = Param("number of trees", default=100)
+    max_samples = Param("subsample size per tree", default=256)
+    max_features = Param("feature subsample fraction", default=1.0)
+    contamination = Param("expected anomaly fraction (sets the threshold)",
+                          default=0.0)
+    score_col = Param("anomaly score column", default="outlierScore")
+    random_seed = Param("rng seed", default=1)
+
+    def _fit(self, table: Table) -> "IsolationForestModel":
+        x = np.asarray(table[self.features_col], np.float32)
+        n = len(x)
+        rng = np.random.default_rng(int(self.random_seed))
+        sample = min(int(self.max_samples), n)
+        max_depth = max(1, int(math.ceil(math.log2(max(sample, 2)))))
+        trees = []
+        for _ in range(int(self.num_estimators)):
+            idx = rng.choice(n, size=sample, replace=False)
+            feature: List[int] = []
+            threshold: List[float] = []
+            left: List[int] = []
+            right: List[int] = []
+            depth_adj: List[float] = []
+            _build_tree(x[idx], rng, max_depth, feature, threshold,
+                        left, right, depth_adj)
+            trees.append((feature, threshold, left, right, depth_adj))
+        m = max(len(t[0]) for t in trees)
+        T = len(trees)
+        feat = np.full((T, m), -1, np.int32)
+        thr = np.zeros((T, m), np.float32)
+        lft = np.zeros((T, m), np.int32)
+        rgt = np.zeros((T, m), np.int32)
+        dadj = np.zeros((T, m), np.float32)
+        for i, (f, t, l, r, d) in enumerate(trees):
+            feat[i, :len(f)] = f
+            thr[i, :len(t)] = t
+            lft[i, :len(l)] = l
+            rgt[i, :len(r)] = r
+            dadj[i, :len(d)] = d
+        model = IsolationForestModel(
+            trees=(feat, thr, lft, rgt, dadj),
+            max_depth=max_depth,
+            c_norm=_avg_path_length(sample),
+            features_col=self.features_col,
+            prediction_col=self.prediction_col,
+            score_col=self.score_col)
+        contamination = float(self.contamination)
+        if contamination > 0:
+            scores = model._scores(x)
+            model.set(threshold=float(np.quantile(scores, 1 - contamination)))
+        return model
+
+
+class IsolationForestModel(Model, HasFeaturesCol, HasPredictionCol):
+    trees = ComplexParam("stacked tree arrays (feature/threshold/left/right/depth)")
+    max_depth = Param("tree depth cap used at fit time", default=12)
+    c_norm = Param("c(sample_size) score normalizer", default=1.0)
+    threshold = Param("score threshold for the 0/1 prediction", default=0.5)
+    score_col = Param("anomaly score column", default="outlierScore")
+
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        feat, thr, lft, rgt, dadj = self.trees
+        stack = tuple(jnp.asarray(a) for a in (feat, thr, lft, rgt, dadj))
+        mean_path = np.asarray(_path_lengths(stack, jnp.asarray(x, jnp.float32),
+                                             int(self.max_depth) + 1))
+        return np.power(2.0, -mean_path / max(float(self.c_norm), 1e-9))
+
+    def _transform(self, table: Table) -> Table:
+        x = np.asarray(table[self.features_col], np.float32)
+        scores = self._scores(x)
+        return table.with_columns({
+            self.score_col: scores.astype(np.float64),
+            self.prediction_col: (scores >= float(self.threshold)).astype(np.float64),
+        })

@@ -1,0 +1,9 @@
+from synapseml_tpu.knn.knn import (
+    BallTree,
+    ConditionalKNN,
+    ConditionalKNNModel,
+    KNN,
+    KNNModel,
+)
+
+__all__ = ["BallTree", "ConditionalKNN", "ConditionalKNNModel", "KNN", "KNNModel"]

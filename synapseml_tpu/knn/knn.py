@@ -1,0 +1,202 @@
+"""Exact k-nearest-neighbours: KNN / ConditionalKNN.
+
+Re-design of the reference's broadcast-BallTree search
+(ref: core/.../nn/BallTree.scala:109-271, KNN.scala:48-126,
+ConditionalKNN.scala:31-120, BoundedPriorityQueue.scala).
+
+TPU-first: the reference walks a JVM ball tree per query row; here the index
+is a dense [N, D] matrix resident on device and search is one batched
+``top_k`` over a distance matrix computed on the MXU
+(``q @ index.T`` dominates, so the whole search is a matmul). That is both
+exact (same results as the ball tree) and the idiomatic accelerator shape of
+kNN. The conditional variant masks disallowed labels with +inf before top_k
+(ref: ConditionalBallTree label-filtered search).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from synapseml_tpu.core.param import ComplexParam, HasInputCol, HasOutputCol, Param
+from synapseml_tpu.core.pipeline import Estimator, Model
+from synapseml_tpu.data.table import Table
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _knn_search(index, queries, k: int):
+    """index [N, D], queries [Q, D] -> (dist [Q, k], idx [Q, k]).
+
+    Squared-L2 via the expanded form so the [Q, N] inner-product block runs
+    on the MXU; top_k on the negated distances.
+    """
+    qn = jnp.sum(queries * queries, axis=1, keepdims=True)   # [Q, 1]
+    xn = jnp.sum(index * index, axis=1)[None, :]             # [1, N]
+    d2 = qn + xn - 2.0 * queries @ index.T
+    neg, idx = jax.lax.top_k(-d2, k)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), idx
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _knn_search_masked(index, queries, allowed, k: int):
+    """Conditional search: allowed [Q, N] bool — False entries excluded."""
+    qn = jnp.sum(queries * queries, axis=1, keepdims=True)
+    xn = jnp.sum(index * index, axis=1)[None, :]
+    d2 = qn + xn - 2.0 * queries @ index.T
+    d2 = jnp.where(allowed, d2, jnp.inf)
+    neg, idx = jax.lax.top_k(-d2, k)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), idx
+
+
+class KNN(Estimator, HasInputCol, HasOutputCol):
+    """Fit stores the feature matrix + payload values (ref: KNN.scala:48)."""
+
+    values_col = Param("column carried as the match payload", default=None)
+    k = Param("neighbours per query", default=5)
+
+    def _fit(self, table: Table) -> "KNNModel":
+        x = np.ascontiguousarray(np.asarray(table[self.input_col], np.float32))
+        vcol = self.values_col
+        values = list(table[vcol]) if vcol else list(range(len(x)))
+        return KNNModel(
+            index=x, values=values, k=int(self.k),
+            input_col=self.input_col, output_col=self.output_col)
+
+
+class KNNModel(Model, HasInputCol, HasOutputCol):
+    """Batched exact top-k search (ref: KNNModel.scala:78)."""
+
+    index = ComplexParam("[N, D] feature matrix")
+    values = ComplexParam("payload per index row")
+    k = Param("neighbours per query", default=5)
+
+    def _transform(self, table: Table) -> Table:
+        q = np.asarray(table[self.input_col], np.float32)
+        k = min(int(self.k), len(self.index))  # top_k requires k <= N
+        dist, idx = _knn_search(
+            jnp.asarray(self.index), jnp.asarray(q), k)
+        dist, idx = np.asarray(dist), np.asarray(idx)
+        values = self.values
+        out = np.empty(len(q), dtype=object)
+        for i in range(len(q)):
+            out[i] = [
+                {"value": values[j], "distance": float(d), "index": int(j)}
+                for j, d in zip(idx[i], dist[i])
+            ]
+        return table.with_column(self.output_col, out)
+
+
+class ConditionalKNN(Estimator, HasInputCol, HasOutputCol):
+    """kNN restricted per-query to an allowed label set
+    (ref: ConditionalKNN.scala:31, ConditionalBallTree.scala:202)."""
+
+    values_col = Param("payload column", default=None)
+    label_col = Param("index label column", default="labels")
+    conditioner_col = Param("per-query allowed label set column",
+                            default="conditioner")
+    k = Param("neighbours per query", default=5)
+
+    def _fit(self, table: Table) -> "ConditionalKNNModel":
+        x = np.ascontiguousarray(np.asarray(table[self.input_col], np.float32))
+        vcol = self.values_col
+        values = list(table[vcol]) if vcol else list(range(len(x)))
+        labels = list(table[self.label_col])
+        return ConditionalKNNModel(
+            index=x, values=values, labels=labels, k=int(self.k),
+            input_col=self.input_col, output_col=self.output_col,
+            conditioner_col=self.conditioner_col)
+
+
+class ConditionalKNNModel(Model, HasInputCol, HasOutputCol):
+    index = ComplexParam("[N, D] feature matrix")
+    values = ComplexParam("payload per index row")
+    labels = ComplexParam("label per index row")
+    conditioner_col = Param("per-query allowed label set column",
+                            default="conditioner")
+    k = Param("neighbours per query", default=5)
+
+    def _transform(self, table: Table) -> Table:
+        q = np.asarray(table[self.input_col], np.float32)
+        labels = np.asarray(self.labels, dtype=object)
+        allowed = np.empty((len(q), len(labels)), dtype=bool)
+        for i, cond in enumerate(table[self.conditioner_col]):
+            cond_set = set(cond) if not isinstance(cond, set) else cond
+            allowed[i] = [l in cond_set for l in labels]
+        dist, idx = _knn_search_masked(
+            jnp.asarray(self.index), jnp.asarray(q), jnp.asarray(allowed),
+            min(int(self.k), len(self.index)))
+        dist, idx = np.asarray(dist), np.asarray(idx)
+        values = self.values
+        out = np.empty(len(q), dtype=object)
+        for i in range(len(q)):
+            out[i] = [
+                {"value": values[j], "distance": float(d),
+                 "label": labels[j], "index": int(j)}
+                for j, d in zip(idx[i], dist[i]) if np.isfinite(d)
+            ]
+        return table.with_column(self.output_col, out)
+
+
+# ---------------------------------------------------------------------------
+# Host-side BallTree for API parity (ref: BallTree.scala:109-271). The TPU
+# path above is the default; this structure exists for host-only callers and
+# as an exactness cross-check in tests.
+# ---------------------------------------------------------------------------
+
+class BallTree:
+    """Classic ball tree over [N, D] points with best-first k-NN queries."""
+
+    def __init__(self, points: np.ndarray, values: Optional[Sequence[Any]] = None,
+                 leaf_size: int = 50):
+        self.points = np.asarray(points, np.float64)
+        self.values = list(values) if values is not None else list(range(len(points)))
+        self.leaf_size = leaf_size
+        idx = np.arange(len(self.points))
+        self.root = self._build(idx)
+
+    def _build(self, idx: np.ndarray):
+        pts = self.points[idx]
+        center = pts.mean(axis=0)
+        radius = float(np.sqrt(((pts - center) ** 2).sum(axis=1)).max()) if len(idx) else 0.0
+        node = {"center": center, "radius": radius, "idx": idx,
+                "left": None, "right": None}
+        if len(idx) > self.leaf_size:
+            spread = pts.max(axis=0) - pts.min(axis=0)
+            dim = int(np.argmax(spread))
+            order = np.argsort(pts[:, dim], kind="stable")
+            half = len(idx) // 2
+            node["left"] = self._build(idx[order[:half]])
+            node["right"] = self._build(idx[order[half:]])
+        return node
+
+    def query(self, q: np.ndarray, k: int = 5) -> List[dict]:
+        q = np.asarray(q, np.float64)
+        import heapq
+        best: List = []  # max-heap by -dist
+
+        def visit(node):
+            if node is None:
+                return
+            gap = float(np.sqrt(((q - node["center"]) ** 2).sum())) - node["radius"]
+            if len(best) == k and gap > -best[0][0]:
+                return
+            if node["left"] is None:
+                for j in node["idx"]:
+                    d = float(np.sqrt(((q - self.points[j]) ** 2).sum()))
+                    if len(best) < k:
+                        heapq.heappush(best, (-d, int(j)))
+                    elif d < -best[0][0]:
+                        heapq.heapreplace(best, (-d, int(j)))
+            else:
+                kids = sorted(
+                    (node["left"], node["right"]),
+                    key=lambda c: float(np.sqrt(((q - c["center"]) ** 2).sum())))
+                for c in kids:
+                    visit(c)
+
+        visit(self.root)
+        return [{"value": self.values[j], "distance": -nd, "index": j}
+                for nd, j in sorted(best, key=lambda t: -t[0])]

@@ -134,12 +134,11 @@ class EnsembleByKey(Transformer):
 
     def _transform(self, table: Table) -> Table:
         keys, cols = list(self.keys), list(self.cols)
-        key_col = (
-            table[keys[0]].astype(str)
-            if len(keys) == 1
-            else np.array(["".join(str(table[k][i]) for k in keys)
-                           for i in range(table.num_rows)], dtype=object)
-        )
+        # tuple keys, not concatenated strings: ('x','yz') must not collide
+        # with ('xy','z')
+        key_col = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            key_col[i] = tuple(table[k][i] for k in keys)
         tmp = table.with_column("__ensemble_key__", key_col)
         groups = tmp.group_indices("__ensemble_key__")
         out_rows: Dict[str, List[Any]] = {k: [] for k in keys}
@@ -173,7 +172,7 @@ class Explode(Transformer, HasInputCol, HasOutputCol):
 
     def _transform(self, table: Table) -> Table:
         col = table[self.input_col]
-        counts = np.asarray([len(v) for v in col])
+        counts = np.asarray([len(v) for v in col], dtype=np.int64)
         rep = np.repeat(np.arange(table.num_rows), counts)
         exploded = _obj([x for v in col for x in v])
         base = table.take(rep)
@@ -460,12 +459,21 @@ class Cacher(Transformer):
     """Materializes/pins the table (ref: stages/Cacher.scala:43).
 
     Tables are already host-resident numpy; cache here means pre-staging the
-    numeric columns onto the TPU device so downstream jitted stages skip the
-    host→device copy.
+    numeric columns onto the TPU device and keeping them alive in
+    ``device_cache`` so device-aware consumers (the batched executor,
+    trainers) can reuse the staged copy instead of re-transferring.
     """
 
     disable = Param("pass-through when true", default=False)
     device_put = Param("stage numeric columns onto the default device", default=True)
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.device_cache: Dict[str, Any] = {}
+
+    def device_column(self, name: str):
+        """The staged device array for a column, if cached."""
+        return self.device_cache.get(name)
 
     def _transform(self, table: Table) -> Table:
         if self.disable or not self.device_put:
@@ -475,8 +483,7 @@ class Cacher(Transformer):
         for name in table.columns:
             col = table[name]
             if col.dtype.kind in "biuf":
-                # persistently cached on device; Table keeps the host view
-                jax.device_put(col)
+                self.device_cache[name] = jax.device_put(col)
         return table
 
 

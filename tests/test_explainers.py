@@ -1,0 +1,148 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.core.param import Param
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.explainers import (
+    ImageLIME,
+    ImageSHAP,
+    TabularSHAP,
+    TextLIME,
+    VectorLIME,
+    VectorSHAP,
+    superpixels,
+)
+
+W = np.array([2.0, -1.0, 0.5], np.float32)
+
+
+class LinearModel(Transformer):
+    """probability = W . features (deterministic, vector input)."""
+
+    input_col = Param("features col", default="features")
+
+    def _transform(self, table):
+        x = np.asarray(table[self.input_col], np.float32)
+        p = x @ W
+        return table.with_column("probability", np.column_stack([p]))
+
+
+class TabularLinear(Transformer):
+    def _transform(self, table):
+        p = (2.0 * np.asarray(table["a"], np.float32)
+             - 1.0 * np.asarray(table["b"], np.float32))
+        return table.with_column("probability", np.column_stack([p]))
+
+
+class TokenCounter(Transformer):
+    """Score = 1 if 'good' present else 0."""
+
+    def _transform(self, table):
+        p = np.array([1.0 if "good" in str(t).split() else 0.0
+                      for t in table["text"]], np.float32)
+        return table.with_column("probability", np.column_stack([p]))
+
+
+class BrightnessModel(Transformer):
+    """Score = mean pixel intensity of the image."""
+
+    def _transform(self, table):
+        p = np.array([float(np.mean(img)) for img in table["image"]], np.float32)
+        return table.with_column("probability", np.column_stack([p]))
+
+
+@pytest.fixture
+def vec_table():
+    rng = np.random.default_rng(1)
+    return Table({"features": rng.normal(size=(4, 3)).astype(np.float32)})
+
+
+def test_vector_shap_matches_linear(vec_table):
+    shap = VectorSHAP(model=LinearModel(), input_col="features",
+                      target_col="probability", target_classes=(0,),
+                      num_samples=128, seed=3)
+    out = shap.transform(vec_table)
+    phis = out["explanation" if "explanation" in out else "output"]
+    x = np.asarray(vec_table["features"])
+    bg = x.mean(axis=0)
+    expected = W * (x - bg)  # linear-model shapley values
+    got = np.asarray(phis)[:, 0, 1:]
+    np.testing.assert_allclose(got, expected, atol=0.08)
+    # phi0 == f(background)
+    np.testing.assert_allclose(np.asarray(phis)[:, 0, 0], np.full(4, W @ bg),
+                               atol=0.05)
+    # efficiency: phis sum to f(x) - f(bg)
+    np.testing.assert_allclose(got.sum(1), x @ W - W @ bg, atol=0.02)
+
+
+def test_vector_lime_signs(vec_table):
+    lime = VectorLIME(model=LinearModel(), input_col="features",
+                      target_col="probability", target_classes=(0,),
+                      num_samples=200, seed=0, regularization=0.001)
+    out = lime.transform(vec_table)
+    coefs = np.asarray(out["output"])[:, 0, :]
+    x = np.asarray(vec_table["features"])
+    bg = x.mean(axis=0)
+    # LIME coefs on on/off states approximate w_i * (x_i - bg_i)
+    expected = W * (x - bg)
+    assert np.corrcoef(coefs.ravel(), expected.ravel())[0, 1] > 0.9
+
+
+def test_tabular_shap():
+    t = Table({"a": np.array([1.0, 2.0, 0.0]),
+               "b": np.array([0.0, 1.0, 2.0]),
+               "id": [10, 11, 12]})
+    shap = TabularSHAP(model=TabularLinear(), input_cols=["a", "b"],
+                       target_col="probability", target_classes=(0,),
+                       num_samples=32, seed=0)
+    out = shap.transform(t)
+    phis = np.asarray(out["output"])
+    a, b = t["a"], t["b"]
+    expected_a = 2.0 * (a - a.mean())
+    np.testing.assert_allclose(phis[:, 0, 1], expected_a, atol=0.05)
+    assert "id" in out  # pass-through columns preserved
+
+
+def test_text_lime():
+    t = Table({"text": ["good movie plot", "bad movie plot"]})
+    lime = TextLIME(model=TokenCounter(), input_col="text",
+                    target_col="probability", target_classes=(0,),
+                    num_samples=64, seed=0)
+    out = lime.transform(t)
+    coefs = np.asarray(out["output"])
+    toks0 = out["tokens"][0]
+    # 'good' token should carry the largest positive weight in row 0
+    assert toks0[int(np.argmax(coefs[0, 0, :len(toks0)]))] == "good"
+    # row 1 has no signal: coefficients near zero
+    assert np.abs(coefs[1, 0]).max() < 0.2
+
+
+def test_superpixels():
+    img = np.zeros((24, 24, 3), np.float32)
+    img[:, 12:] = 1.0
+    sp = superpixels(img, cell_size=8.0)
+    assert sp.assignment.shape == (24, 24)
+    assert 2 <= sp.num_clusters <= 16
+    # left/right halves should not share a cluster
+    left = set(sp.assignment[:, :10].ravel())
+    right = set(sp.assignment[:, 14:].ravel())
+    assert not left & right
+
+
+def test_image_lime_and_shap():
+    rng = np.random.default_rng(0)
+    img = rng.random((16, 16, 3)).astype(np.float32) * 0.2
+    img[4:12, 4:12] = 0.9  # bright patch drives the score
+    t = Table({"image": [img], "rowid": [1]})
+    for cls in (ImageLIME, ImageSHAP):
+        ex = cls(model=BrightnessModel(), input_col="image",
+                 target_col="probability", target_classes=(0,),
+                 num_samples=40, seed=0, cell_size=8.0)
+        out = ex.transform(t)
+        coefs = np.asarray(out["output"])[0, 0]
+        sp = out["superpixels"][0]
+        # the superpixel covering the bright center should rank highest
+        center_cluster = sp[8, 8]
+        vals = coefs[1:] if cls is ImageSHAP else coefs
+        assert int(np.argmax(vals[:sp.max() + 1])) == int(center_cluster)

@@ -1,0 +1,75 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.isolationforest import IsolationForest
+from synapseml_tpu.knn import BallTree, ConditionalKNN, KNN
+
+
+@pytest.fixture
+def points():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(200, 8)).astype(np.float32)
+
+
+def test_knn_exact(points):
+    t = Table({"features": points, "id": np.arange(200)})
+    model = KNN(input_col="features", output_col="matches",
+                values_col="id", k=4).fit(t)
+    q = Table({"features": points[:5]})
+    out = model.transform(q)
+    for i in range(5):
+        matches = out["matches"][i]
+        assert matches[0]["value"] == i  # nearest neighbour of a point is itself
+        assert matches[0]["distance"] == pytest.approx(0.0, abs=1e-3)
+        dists = [m["distance"] for m in matches]
+        assert dists == sorted(dists)
+
+
+def test_knn_matches_balltree(points):
+    t = Table({"features": points})
+    model = KNN(input_col="features", output_col="matches", k=6).fit(t)
+    out = model.transform(Table({"features": points[10:13]}))
+    tree = BallTree(points)
+    for i, row in enumerate(out["matches"]):
+        expected = tree.query(points[10 + i], k=6)
+        assert {m["index"] for m in row} == {m["index"] for m in expected}
+
+
+def test_conditional_knn(points):
+    labels = ["a" if i % 2 == 0 else "b" for i in range(200)]
+    t = Table({"features": points, "labels": labels})
+    model = ConditionalKNN(input_col="features", output_col="matches",
+                           label_col="labels", k=3).fit(t)
+    q = Table({"features": points[:4],
+               "conditioner": [["b"]] * 4})
+    out = model.transform(q)
+    for row in out["matches"]:
+        assert all(m["label"] == "b" for m in row)
+
+
+def test_isolation_forest():
+    rng = np.random.default_rng(1)
+    normal = rng.normal(size=(300, 4)).astype(np.float32)
+    outliers = rng.normal(size=(6, 4)).astype(np.float32) * 8 + 12
+    x = np.concatenate([normal, outliers])
+    t = Table({"features": x})
+    model = IsolationForest(num_estimators=50, max_samples=128,
+                            contamination=0.02, random_seed=3).fit(t)
+    out = model.transform(t)
+    scores = out["outlierScore"]
+    # outliers should score above the typical inlier
+    assert scores[300:].mean() > scores[:300].mean() + 0.1
+    # contamination threshold flags mostly the planted outliers
+    flagged = np.flatnonzero(out["prediction"])
+    assert len(set(flagged) & set(range(300, 306))) >= 4
+
+
+def test_knn_serde(points, tmp_path):
+    from synapseml_tpu.core.pipeline import PipelineStage
+    t = Table({"features": points})
+    model = KNN(input_col="features", output_col="m", k=2).fit(t)
+    model.save(str(tmp_path / "knn"))
+    loaded = PipelineStage.load(str(tmp_path / "knn"))
+    out = loaded.transform(Table({"features": points[:2]}))
+    assert out["m"][0][0]["index"] == 0
