@@ -1,0 +1,18 @@
+from synapseml_tpu.automl.automl import (
+    BestModel,
+    DiscreteHyperParam,
+    FindBestModel,
+    GridSpace,
+    HyperparamBuilder,
+    MetricEvaluator,
+    ParamSpace,
+    RangeHyperParam,
+    TuneHyperparameters,
+    TuneHyperparametersModel,
+)
+
+__all__ = [
+    "BestModel", "DiscreteHyperParam", "FindBestModel", "GridSpace",
+    "HyperparamBuilder", "MetricEvaluator", "ParamSpace", "RangeHyperParam",
+    "TuneHyperparameters", "TuneHyperparametersModel",
+]

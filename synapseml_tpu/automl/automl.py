@@ -1,0 +1,240 @@
+"""AutoML: hyperparameter spaces, tuning with k-fold CV, best-model selection.
+
+Re-design of the reference's automl package
+(ref: core/.../automl/TuneHyperparameters.scala:36-254 — randomized/grid
+search with thread-pool parallelism (:97-120) and k-fold CV (fit :144);
+ParamSpace.scala:43, HyperparamBuilder.scala:113, DefaultHyperparams.scala;
+FindBestModel.scala — evaluate candidates on one dataset, keep the best).
+
+Candidates run concurrently on a thread pool exactly like the reference;
+each fit is itself jax-accelerated, and XLA serializes device work, so the
+pool mainly overlaps host-side featurization/data prep.
+"""
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from synapseml_tpu.core.param import ComplexParam, Param
+from synapseml_tpu.core.pipeline import Estimator, Evaluator, Model
+from synapseml_tpu.data.table import Table
+
+
+class Dist:
+    """A distribution over one hyperparameter value."""
+
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class DiscreteHyperParam(Dist):
+    """Uniform over an explicit list (ref: HyperparamBuilder.DiscreteHyperParam)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(len(self.values)))]
+
+    def grid(self):
+        return list(self.values)
+
+
+class RangeHyperParam(Dist):
+    """Uniform over [lo, hi); int or float (ref: HyperparamBuilder.RangeHyperParam)."""
+
+    def __init__(self, lo, hi, n_grid: int = 5):
+        self.lo, self.hi, self.n_grid = lo, hi, n_grid
+        self.is_int = isinstance(lo, (int, np.integer)) and isinstance(hi, (int, np.integer))
+
+    def sample(self, rng):
+        if self.is_int:
+            return int(rng.integers(self.lo, self.hi))
+        return float(rng.uniform(self.lo, self.hi))
+
+    def grid(self):
+        vals = np.linspace(self.lo, self.hi, self.n_grid)
+        return [int(v) for v in vals] if self.is_int else [float(v) for v in vals]
+
+
+class HyperparamBuilder:
+    """Collects (param name -> Dist) pairs (ref: HyperparamBuilder.scala:113)."""
+
+    def __init__(self):
+        self._dists: Dict[str, Dist] = {}
+
+    def add_hyperparam(self, name: str, dist: Dist) -> "HyperparamBuilder":
+        self._dists[name] = dist
+        return self
+
+    def build(self) -> Dict[str, Dist]:
+        return dict(self._dists)
+
+
+class ParamSpace:
+    """Random draws over a dist map (ref: ParamSpace.scala:43 RandomSpace)."""
+
+    def __init__(self, dists: Dict[str, Dist], seed: int = 0):
+        self.dists = dists
+        self.rng = np.random.default_rng(seed)
+
+    def sample(self) -> Dict[str, Any]:
+        return {k: d.sample(self.rng) for k, d in self.dists.items()}
+
+    def param_maps(self, n: int) -> List[Dict[str, Any]]:
+        return [self.sample() for _ in range(n)]
+
+
+class GridSpace:
+    """Full cartesian grid (ref: GridSpace in ParamSpace.scala)."""
+
+    def __init__(self, dists: Dict[str, Dist]):
+        self.dists = dists
+
+    def param_maps(self) -> List[Dict[str, Any]]:
+        names = list(self.dists)
+        grids = [self.dists[n].grid() for n in names]
+        return [dict(zip(names, combo)) for combo in itertools.product(*grids)]
+
+
+def _kfold_indices(n: int, k: int, seed: int) -> List[Tuple[np.ndarray, np.ndarray]]:
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    folds = np.array_split(perm, k)
+    out = []
+    for i in range(k):
+        test = folds[i]
+        train = np.concatenate([folds[j] for j in range(k) if j != i])
+        out.append((train, test))
+    return out
+
+
+class TuneHyperparameters(Estimator):
+    """Randomized/grid search over estimators with k-fold CV
+    (ref: TuneHyperparameters.scala:36, fit :144, thread pool :97-120)."""
+
+    models = ComplexParam("candidate estimators")
+    evaluator = ComplexParam("metric Evaluator (larger-better aware)")
+    param_space = ComplexParam("ParamSpace/GridSpace or list of param maps",
+                               default=None)
+    number_of_runs = Param("random samples per estimator", default=8)
+    number_of_folds = Param("k in k-fold CV", default=3)
+    parallelism = Param("concurrent candidate fits", default=4)
+    seed = Param("cv split seed", default=0)
+
+    def _fit(self, table: Table) -> "TuneHyperparametersModel":
+        models: List[Estimator] = list(self.models)
+        space = self.param_space
+        if space is None:
+            maps: List[Dict[str, Any]] = [{}]
+        elif isinstance(space, list):
+            maps = space
+        elif isinstance(space, GridSpace):
+            maps = space.param_maps()
+        else:
+            maps = space.param_maps(int(self.number_of_runs))
+        candidates: List[Tuple[Estimator, Dict[str, Any]]] = [
+            (est, pm) for est in models for pm in maps]
+        folds = _kfold_indices(table.num_rows, int(self.number_of_folds),
+                               int(self.seed))
+        evaluator: Evaluator = self.evaluator
+        larger_better = evaluator.is_larger_better
+
+        def run(cand: Tuple[Estimator, Dict[str, Any]]) -> float:
+            est, pm = cand
+            metrics = []
+            for train_idx, test_idx in folds:
+                model = est.copy(**pm).fit(table.take(train_idx))
+                scored = model.transform(table.take(test_idx))
+                metrics.append(evaluator.evaluate(scored))
+            return float(np.mean(metrics))
+
+        with ThreadPoolExecutor(max_workers=int(self.parallelism)) as pool:
+            metrics = list(pool.map(run, candidates))
+        best_i = int(np.argmax(metrics) if larger_better else np.argmin(metrics))
+        best_est, best_pm = candidates[best_i]
+        best_model = best_est.copy(**best_pm).fit(table)
+        return TuneHyperparametersModel(
+            best_model=best_model, best_metric=float(metrics[best_i]),
+            all_metrics=[float(m) for m in metrics],
+            best_params=dict(best_pm))
+
+
+class TuneHyperparametersModel(Model):
+    """ref: TuneHyperparameters.scala:225."""
+
+    best_model = ComplexParam("winning fitted model")
+    best_metric = Param("winning CV metric", default=None)
+    best_params = ComplexParam("winning param map", default=None)
+    all_metrics = ComplexParam("metric per candidate", default=None)
+
+    def _transform(self, table: Table) -> Table:
+        return self.best_model.transform(table)
+
+    def get_best_model_info(self) -> str:
+        return f"metric={self.best_metric} params={self.best_params}"
+
+
+class FindBestModel(Estimator):
+    """Evaluate pre-built models on one dataset, keep the best
+    (ref: FindBestModel.scala)."""
+
+    models = ComplexParam("candidate fitted models OR estimators")
+    evaluator = ComplexParam("metric Evaluator")
+
+    def _fit(self, table: Table) -> "BestModel":
+        evaluator: Evaluator = self.evaluator
+        metrics = []
+        fitted = []
+        for m in self.models:
+            model = m.fit(table) if isinstance(m, Estimator) else m
+            fitted.append(model)
+            metrics.append(evaluator.evaluate(model.transform(table)))
+        best_i = int(np.argmax(metrics) if evaluator.is_larger_better
+                     else np.argmin(metrics))
+        return BestModel(best_model=fitted[best_i],
+                         best_metric=float(metrics[best_i]),
+                         all_metrics=[float(m) for m in metrics])
+
+
+class BestModel(Model):
+    best_model = ComplexParam("winning model")
+    best_metric = Param("winning metric", default=None)
+    all_metrics = ComplexParam("metric per candidate", default=None)
+
+    def _transform(self, table: Table) -> Table:
+        return self.best_model.transform(table)
+
+
+class MetricEvaluator(Evaluator):
+    """Simple column-based evaluator for tuning (accuracy / mse / auc)."""
+
+    metric = Param("accuracy | mse | auc", default="accuracy")
+    label_col = Param("label column", default="label")
+    prediction_col = Param("prediction column", default="prediction")
+    probability_col = Param("probability column (auc)", default="probability")
+
+    def evaluate(self, table: Table) -> float:
+        y = np.asarray(table[self.label_col], np.float64)
+        if self.metric == "accuracy":
+            pred = np.asarray(table[self.prediction_col], np.float64)
+            return float((pred == y).mean())
+        if self.metric == "mse":
+            pred = np.asarray(table[self.prediction_col], np.float64)
+            return float(np.mean((pred - y) ** 2))
+        from synapseml_tpu.train.train import _binary_auc
+        probs = table[self.probability_col]
+        p1 = (np.asarray([p[1] for p in probs], np.float64)
+              if probs.dtype == object or probs.ndim == 2
+              else np.asarray(probs, np.float64))
+        return _binary_auc(p1, y)
+
+    @property
+    def is_larger_better(self) -> bool:
+        return self.metric != "mse"

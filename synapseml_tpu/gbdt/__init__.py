@@ -1,0 +1,15 @@
+from synapseml_tpu.gbdt.boosting import BoostParams, Booster, train
+from synapseml_tpu.gbdt.estimators import (
+    LightGBMClassificationModel,
+    LightGBMClassifier,
+    LightGBMRanker,
+    LightGBMRankerModel,
+    LightGBMRegressionModel,
+    LightGBMRegressor,
+)
+
+__all__ = [
+    "BoostParams", "Booster", "LightGBMClassificationModel",
+    "LightGBMClassifier", "LightGBMRanker", "LightGBMRankerModel",
+    "LightGBMRegressionModel", "LightGBMRegressor", "train",
+]

@@ -1,0 +1,22 @@
+from synapseml_tpu.linear.estimators import (
+    VowpalWabbitClassificationModel,
+    VowpalWabbitClassifier,
+    VowpalWabbitContextualBandit,
+    VowpalWabbitContextualBanditModel,
+    VowpalWabbitRegressionModel,
+    VowpalWabbitRegressor,
+)
+from synapseml_tpu.linear.featurizer import (
+    VectorZipper,
+    VowpalWabbitFeaturizer,
+    VowpalWabbitInteractions,
+)
+from synapseml_tpu.linear.learner import VWParams, VWState, init_state, train
+
+__all__ = [
+    "VWParams", "VWState", "VectorZipper", "VowpalWabbitClassificationModel",
+    "VowpalWabbitClassifier", "VowpalWabbitContextualBandit",
+    "VowpalWabbitContextualBanditModel", "VowpalWabbitFeaturizer",
+    "VowpalWabbitInteractions", "VowpalWabbitRegressionModel",
+    "VowpalWabbitRegressor", "init_state", "train",
+]

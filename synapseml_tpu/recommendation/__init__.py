@@ -1,0 +1,17 @@
+from synapseml_tpu.recommendation.sar import (
+    RankingAdapter,
+    RankingAdapterModel,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RankingTrainValidationSplitModel,
+    RecommendationIndexer,
+    RecommendationIndexerModel,
+    SAR,
+    SARModel,
+)
+
+__all__ = [
+    "RankingAdapter", "RankingAdapterModel", "RankingEvaluator",
+    "RankingTrainValidationSplit", "RankingTrainValidationSplitModel",
+    "RecommendationIndexer", "RecommendationIndexerModel", "SAR", "SARModel",
+]

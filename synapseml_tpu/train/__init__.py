@@ -1,0 +1,14 @@
+from synapseml_tpu.train.train import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    TrainClassifier,
+    TrainedClassifierModel,
+    TrainedRegressorModel,
+    TrainRegressor,
+)
+
+__all__ = [
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "TrainClassifier", "TrainedClassifierModel", "TrainedRegressorModel",
+    "TrainRegressor",
+]

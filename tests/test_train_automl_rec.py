@@ -1,0 +1,171 @@
+import numpy as np
+import pytest
+
+from synapseml_tpu.automl import (
+    DiscreteHyperParam,
+    FindBestModel,
+    GridSpace,
+    HyperparamBuilder,
+    MetricEvaluator,
+    ParamSpace,
+    RangeHyperParam,
+    TuneHyperparameters,
+)
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.recommendation import (
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RecommendationIndexer,
+    SAR,
+)
+from synapseml_tpu.train import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    TrainClassifier,
+    TrainRegressor,
+)
+
+
+def _tabular(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cat = rng.choice(["a", "b", "c"], size=n)
+    y = ((x1 + (cat == "a") * 2 - x2) > 0).astype(np.float64)
+    return Table({"x1": x1, "x2": x2, "cat": cat, "label": y})
+
+
+def test_train_classifier():
+    t = _tabular()
+    model = TrainClassifier(label_col="label").fit(t)
+    out = model.transform(t)
+    acc = (out["prediction"] == t["label"]).mean()
+    assert acc > 0.9
+    stats = ComputeModelStatistics(label_col="label").transform(out)
+    assert stats["accuracy"][0] == pytest.approx(acc)
+    assert 0.9 < stats["AUC"][0] <= 1.0
+
+
+def test_train_regressor():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=300)
+    y = 3 * x + rng.normal(size=300) * 0.1
+    t = Table({"x": x, "label": y})
+    model = TrainRegressor(label_col="label").fit(t)
+    out = model.transform(t)
+    stats = ComputeModelStatistics(label_col="label",
+                                   evaluation_metric="regression").transform(out)
+    assert stats["R^2"][0] > 0.8
+
+
+def test_per_instance_stats():
+    t = Table({
+        "label": [0.0, 1.0],
+        "prediction": [0.0, 0.0],
+        "probability": np.array([[0.9, 0.1], [0.6, 0.4]]),
+    })
+    out = ComputePerInstanceStatistics(label_col="label").transform(t)
+    assert out["log_loss"][0] == pytest.approx(-np.log(0.9))
+    assert out["correct"][1] == 0.0
+
+
+def test_tune_hyperparameters():
+    from synapseml_tpu.gbdt import LightGBMClassifier
+    t = _tabular(300)
+    from synapseml_tpu.featurize import Featurize
+    ft = Featurize(input_cols=["x1", "x2", "cat"],
+                   output_col="features").fit(t).transform(t)
+    space = ParamSpace(
+        HyperparamBuilder()
+        .add_hyperparam("num_leaves", DiscreteHyperParam([4, 8]))
+        .add_hyperparam("num_iterations", DiscreteHyperParam([10, 20]))
+        .build(), seed=1)
+    tuned = TuneHyperparameters(
+        models=[LightGBMClassifier(features_col="features")],
+        evaluator=MetricEvaluator(metric="accuracy"),
+        param_space=space, number_of_runs=3, number_of_folds=2,
+        parallelism=2).fit(ft)
+    assert tuned.best_metric > 0.8
+    assert "num_leaves" in tuned.best_params
+    out = tuned.transform(ft)
+    assert "prediction" in out
+
+
+def test_grid_space_and_find_best():
+    from synapseml_tpu.gbdt import LightGBMClassifier
+    t = _tabular(250)
+    from synapseml_tpu.featurize import Featurize
+    ft = Featurize(input_cols=["x1", "x2", "cat"],
+                   output_col="features").fit(t).transform(t)
+    grid = GridSpace({"num_iterations": DiscreteHyperParam([5, 15])})
+    assert len(grid.param_maps()) == 2
+    fb = FindBestModel(
+        models=[LightGBMClassifier(features_col="features", num_iterations=5),
+                LightGBMClassifier(features_col="features", num_iterations=25)],
+        evaluator=MetricEvaluator(metric="accuracy")).fit(ft)
+    assert fb.best_metric >= 0.8
+
+
+def _interactions(n_users=30, n_items=20, seed=0):
+    """Block structure: even users like even items, odd users odd items."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(n_users):
+        liked = [i for i in range(n_items) if i % 2 == u % 2]
+        for i in rng.choice(liked, size=6, replace=False):
+            rows.append((f"u{u}", f"i{i}", 1.0, 1_600_000_000 + u))
+    return Table({
+        "user": [r[0] for r in rows],
+        "item": [r[1] for r in rows],
+        "rating": [r[2] for r in rows],
+        "ts": [float(r[3]) for r in rows],
+    })
+
+
+def test_sar_recommendations():
+    t = _interactions()
+    indexer = RecommendationIndexer().fit(t)
+    it = indexer.transform(t)
+    sar = SAR(support_threshold=1, similarity_function="jaccard")
+    model = sar.fit(it)
+    recs = model.recommend_for_all_users(5)
+    items = it["itemIdx"]
+    users = it["userIdx"]
+    # users should be recommended unseen items of their own parity block
+    item_levels = indexer.item_indexer.levels
+    for row in range(min(10, recs.num_rows)):
+        uidx = recs["userIdx"][row]
+        urows = np.flatnonzero(users == uidx)
+        u_parity = int(item_levels[items[urows[0]]][1:]) % 2
+        rec_parities = [int(item_levels[j][1:]) % 2
+                        for j in recs["recommendations"][row]]
+        assert np.mean([p == u_parity for p in rec_parities]) > 0.7
+
+
+def test_sar_transform_scores():
+    t = _interactions()
+    it = RecommendationIndexer().fit(t).transform(t)
+    model = SAR(support_threshold=1).fit(it)
+    out = model.transform(it)
+    assert (out["prediction"] >= 0).all()
+
+
+def test_ranking_eval_and_split():
+    ev = RankingEvaluator(k=3, metric_name="ndcgAt")
+    t = Table({
+        "recommendations": [[1, 2, 3], [4, 5, 6]],
+        "label": [[1, 2, 3], [9, 9, 9]],
+    })
+    m = ev.evaluate(t)
+    assert 0.4 < m < 0.6  # perfect row + zero row averages to 0.5
+
+    inter = _interactions()
+    it = RecommendationIndexer().fit(inter).transform(inter)
+    tv = RankingTrainValidationSplit(
+        estimator=RankingAdapter(
+            recommender=SAR(support_threshold=1), k=5),
+        evaluator=RankingEvaluator(k=5, metric_name="recallAtK"),
+        train_ratio=0.7, seed=2).fit(it)
+    assert tv.validation_metric is not None
+    assert tv.validation_metric > 0.1
