@@ -110,19 +110,3 @@ class FlattenBatch(Transformer):
                 else:
                     cols[name].extend([value] * n)
         return Table(cols)
-
-
-class PartitionConsolidator(Transformer):
-    """N-partitions→1 funnel for rate-limited services
-    (ref: core/.../stages/PartitionConsolidator.scala:20-139).
-
-    The columnar plane has no task concept; consolidation is a no-op pass-through
-    retained for pipeline compatibility. In serving mode the shared-queue
-    consolidation lives in synapseml_tpu.io.serving.
-    """
-
-    concurrency = Param("max concurrent consumers", default=1)
-    timeout = Param("poll timeout seconds", default=60.0)
-
-    def _transform(self, table: Table) -> Table:
-        return table

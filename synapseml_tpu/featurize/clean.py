@@ -92,6 +92,14 @@ class DataConversion(Transformer):
         "per-column fitted indexers, learned on first transform so repeated "
         "batches map values consistently", default=None)
 
+    def _post_copy(self, src: Params):
+        super()._post_copy(src)
+        # the fit-on-first-use indexer cache must not be shared by reference
+        # across copies: one copy's transform would mutate another's mapping
+        if self._paramMap.get("categorical_models"):
+            self._paramMap["categorical_models"] = dict(
+                self._paramMap["categorical_models"])
+
     def _transform(self, table: Table) -> Table:
         target = self.convert_to
         new = {}

@@ -120,6 +120,8 @@ class IsolationForest(Estimator, HasFeaturesCol, HasPredictionCol):
         rng = np.random.default_rng(int(self.random_seed))
         sample = min(int(self.max_samples), n)
         max_depth = max(1, int(math.ceil(math.log2(max(sample, 2)))))
+        d = x.shape[1]
+        n_feat = max(1, min(d, int(round(float(self.max_features) * d))))
         trees = []
         for _ in range(int(self.num_estimators)):
             idx = rng.choice(n, size=sample, replace=False)
@@ -128,8 +130,15 @@ class IsolationForest(Estimator, HasFeaturesCol, HasPredictionCol):
             left: List[int] = []
             right: List[int] = []
             depth_adj: List[float] = []
-            _build_tree(x[idx], rng, max_depth, feature, threshold,
-                        left, right, depth_adj)
+            if n_feat < d:
+                # per-tree feature subsample, as in the wrapped LinkedIn lib
+                cols = np.sort(rng.choice(d, size=n_feat, replace=False))
+                _build_tree(x[np.ix_(idx, cols)], rng, max_depth, feature,
+                            threshold, left, right, depth_adj)
+                feature = [int(cols[f]) if f >= 0 else -1 for f in feature]
+            else:
+                _build_tree(x[idx], rng, max_depth, feature, threshold,
+                            left, right, depth_adj)
             trees.append((feature, threshold, left, right, depth_adj))
         m = max(len(t[0]) for t in trees)
         T = len(trees)

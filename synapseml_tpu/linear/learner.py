@@ -103,20 +103,23 @@ def train_step(state: VWState, idx, val, y, weight, p: VWParams,
     b = idx.shape[0]
     pred = predict_batch(state.w, state.bias, idx, val)
     loss, dpred = lf(pred, y, weight)
+    # normalize by total example weight, not batch size: zero-weight padding
+    # rows (tail batches) must not dilute the update
+    wsum = jnp.maximum(jnp.sum(weight), 1e-9)
     # sparse grad: scatter-add dpred * val into the weight table
     flat_idx = idx.reshape(-1)
     flat_g = (dpred[:, None] * val).reshape(-1)
-    grad = jnp.zeros_like(state.w).at[flat_idx].add(flat_g) / b
-    gbias = jnp.mean(dpred)
+    grad = jnp.zeros_like(state.w).at[flat_idx].add(flat_g) / wsum
+    gbias = jnp.sum(dpred) / wsum
     if p.l2 > 0:
         grad = grad + p.l2 * state.w
     if axis_name is not None:
         grad = jax.lax.pmean(grad, axis_name)
         gbias = jax.lax.pmean(gbias, axis_name)
-        loss = jax.lax.pmean(jnp.mean(loss), axis_name)
+        loss = jax.lax.pmean(jnp.sum(loss) / wsum, axis_name)
     else:
-        loss = jnp.mean(loss)
-    t = state.t + b
+        loss = jnp.sum(loss) / wsum
+    t = state.t + wsum
     if p.optimizer == "ftrl":
         # FTRL-proximal (McMahan et al.): per-coord adaptive z/n updates
         n_new = state.g2 + grad * grad
@@ -159,6 +162,8 @@ def train(p: VWParams, idx: np.ndarray, val: np.ndarray, y: np.ndarray,
     gradients (one optimizer step per global batch, gang semantics —
     ref: VowpalWabbitBase barrier mode :420-423)."""
     n = len(y)
+    if n == 0:
+        raise RuntimeError("no optimizer step executed (empty input)")
     w_arr = (np.ones(n, np.float32) if weight is None
              else np.asarray(weight, np.float32))
     state = initial if initial is not None else init_state(p)
@@ -184,17 +189,26 @@ def train(p: VWParams, idx: np.ndarray, val: np.ndarray, y: np.ndarray,
         step_fn = lambda s, i2, v2, y2, w2, _p: sharded_step(s, i2, v2, y2, w2)  # noqa: E731
     for _ in range(p.num_passes):
         order = rng.permutation(n)
-        for start in range(0, n - bs + 1, bs):
+        for start in range(0, n, bs):
             sl = order[start:start + bs]
+            bw = w_arr[sl]
+            if len(sl) < bs:
+                # VW consumes every example: pad the tail batch to the jit
+                # cache's batch shape with zero-weight rows (no-op updates)
+                pad = bs - len(sl)
+                sl = np.concatenate([sl, np.zeros(pad, sl.dtype)])
+                bw = np.concatenate([bw, np.zeros(pad, np.float32)])
             if mesh is not None:
                 state, loss = step_fn(state, jnp.asarray(idx[sl]),
                                       jnp.asarray(val[sl]), jnp.asarray(y[sl]),
-                                      jnp.asarray(w_arr[sl]), p)
+                                      jnp.asarray(bw), p)
                 loss = jnp.mean(loss)
             else:
                 state, loss = train_step(state, jnp.asarray(idx[sl]),
                                          jnp.asarray(val[sl]),
                                          jnp.asarray(y[sl]),
-                                         jnp.asarray(w_arr[sl]), p)
+                                         jnp.asarray(bw), p)
             losses.append(float(loss))
+    if not losses:
+        raise RuntimeError("no optimizer step executed (empty input)")
     return state, losses

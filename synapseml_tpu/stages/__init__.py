@@ -1,9 +1,14 @@
+from synapseml_tpu.data.batching import (
+    DynamicMiniBatchTransformer,
+    FixedMiniBatchTransformer,
+    FlattenBatch,
+    TimeIntervalMiniBatchTransformer,
+)
 from synapseml_tpu.stages.transformers import (
     Cacher,
     ClassBalancer,
     ClassBalancerModel,
     DropColumns,
-    DynamicMiniBatchTransformer,
     EnsembleByKey,
     Explode,
     Lambda,
@@ -24,9 +29,10 @@ from synapseml_tpu.stages.transformers import (
 
 __all__ = [
     "Cacher", "ClassBalancer", "ClassBalancerModel", "DropColumns",
-    "DynamicMiniBatchTransformer", "EnsembleByKey", "Explode", "Lambda",
+    "DynamicMiniBatchTransformer", "EnsembleByKey", "Explode",
+    "FixedMiniBatchTransformer", "FlattenBatch", "Lambda",
     "MultiColumnAdapter", "MultiColumnAdapterModel", "PartitionConsolidator",
     "RenameColumn", "Repartition", "SelectColumns", "StratifiedRepartition",
-    "SummarizeData", "TextPreprocessor", "Timer", "TimerModel",
-    "UDFTransformer", "UnicodeNormalize",
+    "SummarizeData", "TextPreprocessor", "TimeIntervalMiniBatchTransformer",
+    "Timer", "TimerModel", "UDFTransformer", "UnicodeNormalize",
 ]

@@ -467,9 +467,11 @@ class Cacher(Transformer):
     disable = Param("pass-through when true", default=False)
     device_put = Param("stage numeric columns onto the default device", default=True)
 
-    def __init__(self, **kw):
-        super().__init__(**kw)
-        self.device_cache: Dict[str, Any] = {}
+    @property
+    def device_cache(self) -> Dict[str, Any]:
+        # Lazy: Params.copy() / PipelineStage.load() construct via __new__ and
+        # skip subclass __init__, so the cache must not live in __init__.
+        return self.__dict__.setdefault("_device_cache", {})
 
     def device_column(self, name: str):
         """The staged device array for a column, if cached."""
@@ -487,28 +489,74 @@ class Cacher(Transformer):
         return table
 
 
-class DynamicMiniBatchTransformer(Transformer):
-    """Re-export point for the batching machinery (ref: stages/MiniBatchTransformer.scala)."""
-
-    def __new__(cls, *a, **kw):
-        from synapseml_tpu.data.batching import DynamicMiniBatchTransformer as Impl
-        return Impl(*a, **kw)
-
-
 class PartitionConsolidator(Transformer, HasInputCol, HasOutputCol):
     """Funnel many shards' rows through one worker (rate-limited services)
     (ref: stages/PartitionConsolidator.scala:20-139).
 
-    In the columnar runtime this is a shard-coalescer: given shards produced by
-    :meth:`Repartition.shards`, it concatenates them so exactly one downstream
-    worker (e.g. one HTTP client) sees the whole stream.
+    Reference semantics: every partition feeds its rows into a shared,
+    executor-local ``Consolidator``; exactly one partition (the first to
+    arrive) is elected the output worker and emits everything, the rest emit
+    nothing. Here ``transform`` is called once per shard (possibly from
+    concurrent threads, e.g. the per-shard serving workers in
+    :mod:`synapseml_tpu.io.serving`): the elected owner's call returns all
+    rows buffered so far, non-owners return an empty table. Rows fed after
+    the owner's last drain stay buffered; the epoch driver collects them with
+    :meth:`flush` (the analogue of the reference's drain-until-complete loop).
     """
 
     concurrency = Param("number of concurrent consumers after consolidation", default=1)
 
+    @property
+    def _state(self):
+        import threading
+
+        st = self.__dict__.get("_consolidator_state")
+        if st is None:
+            st = {"lock": threading.Lock(), "buffer": [], "owner": None}
+            self.__dict__["_consolidator_state"] = st
+        return st
+
+    @staticmethod
+    def _merge(tables: Sequence[Table], schema_of: Table) -> Table:
+        nonempty = [t for t in tables if t.num_rows]
+        if not nonempty:
+            return Table({c: schema_of[c][:0] for c in schema_of.columns})
+        return concat_tables(nonempty)
+
     def _transform(self, table: Table) -> Table:
-        return table
+        import threading
+
+        st = self._state
+        me = threading.get_ident()
+        with st["lock"]:
+            st["buffer"].append(table)
+            if st["owner"] is None:
+                st["owner"] = me
+            if st["owner"] == me:
+                merged = self._merge(st["buffer"], table)
+                st["buffer"].clear()
+                return merged
+        return Table({c: table[c][:0] for c in table.columns})
+
+    def flush(self) -> Optional[Table]:
+        """Drain rows buffered since the owner's last call (end of epoch);
+        None when nothing is pending."""
+        st = self._state
+        with st["lock"]:
+            pending = [t for t in st["buffer"] if t.num_rows]
+            st["buffer"].clear()
+        if not pending:
+            return None
+        return concat_tables(pending)
+
+    def reset(self):
+        """Clear buffered rows and the owner election (new epoch)."""
+        self.__dict__.pop("_consolidator_state", None)
 
     def consolidate(self, shards: Sequence[Table]) -> List[Table]:
-        merged = concat_tables(list(shards))
-        return [merged] + [Table({}) for _ in range(len(shards) - 1)]
+        """One-shot helper: [shard...] -> [merged, empty...]."""
+        if not shards:
+            return []
+        merged = self._merge(shards, shards[0])
+        return [merged] + [
+            Table({c: s[c][:0] for c in s.columns}) for s in shards[1:]]

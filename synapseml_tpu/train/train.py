@@ -191,6 +191,10 @@ class ComputePerInstanceStatistics(Transformer, HasLabelCol):
     scored_probabilities_col = Param("probability column", default="probability")
     evaluation_metric = Param("classification | regression | auto",
                               default="auto")
+    label_values = Param(
+        "ordered class values; maps non 0..k-1 labels (e.g. {-1,1}) to "
+        "probability-matrix columns, as the reference does with indexed labels",
+        default=None)
 
     def _transform(self, table: Table) -> Table:
         y = np.asarray(table[self.label_col], np.float64)
@@ -208,8 +212,19 @@ class ComputePerInstanceStatistics(Transformer, HasLabelCol):
         probs = table[self.scored_probabilities_col]
         mat = (np.stack(list(probs)) if probs.dtype == object
                else np.asarray(probs, np.float64))
-        yi = y.astype(int)
-        yi = np.clip(yi, 0, mat.shape[1] - 1)
+        if self.label_values is not None:
+            lookup = {float(v): i for i, v in enumerate(self.label_values)}
+            try:
+                yi = np.asarray([lookup[float(v)] for v in y], int)
+            except KeyError as e:
+                raise ValueError(
+                    f"label {e.args[0]!r} not in label_values {self.label_values}")
+        else:
+            yi = y.astype(int)
+            if np.any((yi != y) | (yi < 0) | (yi >= mat.shape[1])):
+                raise ValueError(
+                    "labels must be class indices 0..k-1; pass label_values= "
+                    "to map arbitrary label values to probability columns")
         p_true = np.clip(mat[np.arange(len(yi)), yi], 1e-15, 1.0)
         return table.with_columns({
             "log_loss": -np.log(p_true),

@@ -183,3 +183,20 @@ def test_page_splitter_no_infinite_loop_min_zero():
                        maximum_page_length=100,
                        minimum_page_length=0).transform(t)
     assert "".join(out["p"][0]) == " " + "x" * 600
+
+
+def test_dataconversion_copy_isolated():
+    import numpy as np
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.featurize import DataConversion
+
+    t1 = Table({"c": ["a", "b", "a"]})
+    conv = DataConversion(cols=["c"], convert_to="toCategorical")
+    conv.transform(t1)
+    cp = conv.copy()
+    assert cp.categorical_models is not conv.categorical_models
+    # transforming new data through the copy must not mutate the original
+    t2 = Table({"c": ["z", "a"]})
+    cp.transform(t2)
+    out1 = conv.transform(t1)
+    assert list(out1["c"]) == [0, 1, 0]

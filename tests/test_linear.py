@@ -142,3 +142,40 @@ def test_vw_serde(tmp_path):
     np.testing.assert_allclose(
         loaded.transform(ft)["probability"],
         model.transform(ft)["probability"], rtol=1e-5)
+
+
+def test_vw_trains_tail_rows():
+    # round-1 defect: range(0, n - bs + 1, bs) dropped the tail batch
+    import numpy as np
+    from synapseml_tpu.linear.learner import VWParams, train
+
+    rng = np.random.default_rng(0)
+    n, k = 300, 4  # bs=256 -> tail of 44 rows must still train
+    idx = rng.integers(0, 1 << 10, (n, k))
+    val = rng.standard_normal((n, k)).astype(np.float32)
+    y = np.where(val.sum(1) > 0, 1.0, -1.0).astype(np.float32)
+    p = VWParams(num_bits=10, num_passes=1, batch_size=256)
+    state, losses = train(p, idx, val, y)
+    assert len(losses) == 2  # full batch + padded tail batch
+    # n < bs entirely: must still run one (padded) step, not zero
+    p2 = VWParams(num_bits=10, num_passes=1, batch_size=512)
+    state2, losses2 = train(p2, idx, val, y)
+    assert len(losses2) == 1 and float(np.abs(np.asarray(state2.w)).sum()) > 0
+
+
+def test_iforest_max_features():
+    import numpy as np
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.isolationforest.iforest import IsolationForest
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200, 10)).astype(np.float32)
+    t = Table({"features": x})
+    m = IsolationForest(num_estimators=10, max_features=0.3).fit(t)
+    feat = m.trees[0]
+    used = set(int(f) for f in feat.ravel() if f >= 0)
+    assert len(used) <= 10  # sanity
+    per_tree = [set(int(f) for f in row if f >= 0) for row in feat]
+    assert all(len(s) <= 3 for s in per_tree)
+    # different trees should sample different subsets (overwhelmingly likely)
+    assert len(set(frozenset(s) for s in per_tree if s)) > 1

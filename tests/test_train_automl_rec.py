@@ -169,3 +169,24 @@ def test_ranking_eval_and_split():
         train_ratio=0.7, seed=2).fit(it)
     assert tv.validation_metric is not None
     assert tv.validation_metric > 0.1
+
+
+def test_per_instance_stats_label_mapping():
+    import numpy as np
+    import pytest
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.train import ComputePerInstanceStatistics
+
+    probs = np.array([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+    t = Table({
+        "label": np.array([-1.0, 1.0, -1.0]),
+        "prediction": np.array([-1.0, 1.0, 1.0]),
+        "probability": probs,
+    })
+    # {-1,1} labels without a mapping must raise, not silently misread columns
+    with pytest.raises(ValueError):
+        ComputePerInstanceStatistics(label_col="label").transform(t)
+    out = ComputePerInstanceStatistics(
+        label_col="label", label_values=[-1.0, 1.0]).transform(t)
+    np.testing.assert_allclose(
+        out["log_loss"], -np.log([0.9, 0.8, 0.7]), rtol=1e-12)
