@@ -1,0 +1,14 @@
+"""ONNX subsystem: protobuf codec, graph->jax importer, ONNXModel transformer.
+
+TPU-native replacement of the reference's onnxruntime-backed ONNXModel
+(ref: deep-learning/src/main/scala/com/microsoft/ml/spark/onnx/ONNXModel.scala).
+"""
+from synapseml_tpu.onnx.builder import GraphBuilder
+from synapseml_tpu.onnx.importer import ImportedGraph, import_model, supported_ops
+from synapseml_tpu.onnx.model import ONNXModel
+from synapseml_tpu.onnx import proto, zoo
+
+__all__ = [
+    "GraphBuilder", "ImportedGraph", "ONNXModel", "import_model",
+    "supported_ops", "proto", "zoo",
+]

@@ -1,0 +1,154 @@
+"""ONNXModel transformer: batched TPU inference over an imported ONNX graph.
+
+TPU-native rebuild of the reference's ONNXModel
+(ref: deep-learning/src/main/scala/com/microsoft/ml/spark/onnx/ONNXModel.scala:422-684):
+the reference minibatches the DataFrame, coerces columns to tensor dtypes,
+opens a per-partition onnxruntime session and marshals NIO buffers per row
+(:564, :173-193, :357-402). Here the graph is lowered once to a jax function
+(:mod:`synapseml_tpu.onnx.importer`) and run through the
+:class:`~synapseml_tpu.runtime.executor.BatchedExecutor` — shape-bucketed jit
+cache, single contiguous host->device transfer per batch, optional bf16
+compute. Softmax/argmax post-processing columns mirror the reference
+(:519-562), and feed/fetch dicts mirror ``setFeedDict``/``setFetchDict``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from synapseml_tpu.core.param import ComplexParam, Param
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.onnx.importer import ImportedGraph, import_model
+from synapseml_tpu.runtime.executor import BatchedExecutor
+
+_DTYPES = {"float32": np.float32, "bfloat16": "bfloat16", "float16": np.float16}
+
+
+class ONNXModel(Transformer):
+    """Runs a (user-supplied) ONNX graph as a pipeline transformer.
+
+    Parameters mirror the reference's surface: ``model_payload`` (the raw
+    ``.onnx`` bytes), ``feed_dict`` mapping graph input name -> table column,
+    ``fetch_dict`` mapping output column -> graph output name, minibatch size,
+    and optional ``softmax_output_col`` / ``argmax_output_col`` post-columns.
+    """
+
+    model_payload = ComplexParam("raw .onnx protobuf bytes")
+    feed_dict = Param("graph input name -> input column", default=None)
+    fetch_dict = Param("output column -> graph output name", default=None)
+    mini_batch_size = Param("max rows per device batch", default=128)
+    compute_dtype = Param("device compute dtype: float32|bfloat16|float16",
+                          default="float32")
+    softmax_output_col = Param("column for softmax of first output", default=None)
+    argmax_output_col = Param("column for argmax of first output", default=None)
+
+    def __init__(self, model_path: Optional[str] = None,
+                 model_bytes: Optional[bytes] = None, **kw):
+        super().__init__(**kw)
+        if model_path is not None:
+            with open(model_path, "rb") as fh:
+                model_bytes = fh.read()
+        if model_bytes is not None:
+            self.set(model_payload=bytes(model_bytes))
+        self._graph_cache: Optional[ImportedGraph] = None
+        self._executor_cache: Dict[Any, BatchedExecutor] = {}
+
+    # -- graph access ---------------------------------------------------
+    @property
+    def graph(self) -> ImportedGraph:
+        cache = self.__dict__.setdefault("_graph_cache", None)
+        if cache is None:
+            payload = self.model_payload
+            if payload is None:
+                raise ValueError("ONNXModel has no model_payload set")
+            cache = import_model(payload)
+            self.__dict__["_graph_cache"] = cache
+        return cache
+
+    def model_metadata(self) -> Dict[str, Any]:
+        g = self.graph
+        return {
+            "inputs": {n: g.input_info.get(n) for n in g.input_names},
+            "outputs": list(g.output_names),
+            "n_nodes": len(g._nodes),
+            "param_bytes": g.param_bytes(),
+            "opset": g.opset,
+        }
+
+    def _post_copy(self, src):
+        super()._post_copy(src)
+        self._graph_cache = None
+        self._executor_cache = {}
+
+    # -- execution ------------------------------------------------------
+    def _resolve_feeds(self, table: Table) -> List[np.ndarray]:
+        g = self.graph
+        feed = self.feed_dict or {}
+        arrays = []
+        for name in g.input_names:
+            col = feed.get(name, name)
+            if col not in table:
+                raise KeyError(
+                    f"graph input {name!r}: column {col!r} not in table "
+                    f"(columns: {table.columns})")
+            arr = np.asarray(table[col])
+            if arr.dtype == object:
+                arr = np.stack([np.asarray(v) for v in arr])
+            want_dtype, _ = g.input_info.get(name, (None, None))
+            if want_dtype is not None and np.issubdtype(np.dtype(want_dtype),
+                                                        np.integer):
+                arr = arr.astype(want_dtype)
+            arrays.append(arr)
+        return arrays
+
+    def _executor(self) -> BatchedExecutor:
+        cache = self.__dict__.setdefault("_executor_cache", {})
+        key = (self.mini_batch_size, self.compute_dtype)
+        if key not in cache:
+            g = self.graph
+            dtype = _DTYPES[self.compute_dtype]
+            params = g.params
+            if self.compute_dtype != "float32":
+                params = {
+                    k: (v.astype(dtype) if np.issubdtype(v.dtype, np.floating)
+                        else v)
+                    for k, v in params.items()
+                }
+            compute = None if self.compute_dtype == "float32" else dtype
+            # params ride as a bound argument pytree: device-resident once,
+            # shared by every shape bucket (vs baked-in jit constants)
+            cache[key] = BatchedExecutor(
+                g.apply, compute_dtype=compute,
+                max_bucket=self.mini_batch_size, bound_args=(params,))
+        return cache[key]
+
+    def _transform(self, table: Table) -> Table:
+        g = self.graph
+        feeds = self._resolve_feeds(table)
+        outs = self._executor()(*feeds)
+        fetch = self.fetch_dict or {n: n for n in g.output_names}
+        by_name = dict(zip(g.output_names, outs))
+        new_cols: Dict[str, np.ndarray] = {}
+        for col, out_name in fetch.items():
+            if out_name not in by_name:
+                raise KeyError(f"fetch_dict: no graph output {out_name!r}")
+            new_cols[col] = np.asarray(by_name[out_name], dtype=np.float32) \
+                if np.issubdtype(np.asarray(by_name[out_name]).dtype, np.floating) \
+                else np.asarray(by_name[out_name])
+        first = np.asarray(outs[0])
+        if self.softmax_output_col:
+            x = first.astype(np.float64)
+            x = x - x.max(axis=-1, keepdims=True)
+            e = np.exp(x)
+            new_cols[self.softmax_output_col] = (
+                e / e.sum(axis=-1, keepdims=True)).astype(np.float32)
+        if self.argmax_output_col:
+            new_cols[self.argmax_output_col] = first.argmax(axis=-1).astype(np.int64)
+        return table.with_columns(new_cols)
+
+    def _load_extra(self, path: str):
+        self._graph_cache = None
+        self._executor_cache = {}

@@ -1,0 +1,489 @@
+"""Self-contained ONNX protobuf wire codec (no ``onnx``/``protobuf`` deps).
+
+The reference executes ONNX graphs through the onnxruntime JNI
+(ref: deep-learning/src/main/scala/com/microsoft/ml/spark/onnx/ONNXModel.scala:173-193);
+this framework instead *imports* the graph and re-lowers it to XLA
+(see :mod:`synapseml_tpu.onnx.importer`). That requires parsing the ``.onnx``
+protobuf container, which this module does with a hand-rolled wire-format
+codec: protobuf field numbers are frozen forever by compatibility rules, so the
+small schema below (ModelProto / GraphProto / NodeProto / TensorProto /
+AttributeProto / ValueInfoProto and friends) is stable across every ONNX
+release. Both directions (decode for import, encode for export/test fixtures)
+are supported.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Wire-format primitives
+# ---------------------------------------------------------------------------
+
+_WIRE_VARINT = 0
+_WIRE_FIXED64 = 1
+_WIRE_LEN = 2
+_WIRE_FIXED32 = 5
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _write_varint(out: bytearray, value: int):
+    if value < 0:
+        value &= (1 << 64) - 1  # two's-complement 64-bit, 10-byte encoding
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _zigzag_signed(value: int) -> int:
+    """Interpret an up-to-64-bit varint as a signed int64 (not zigzag —
+    protobuf int64 fields use plain two's complement)."""
+    value &= (1 << 64) - 1
+    if value >= 1 << 63:
+        value -= 1 << 64
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Declarative message schema
+# ---------------------------------------------------------------------------
+
+class F:
+    """Field spec: wire number -> (python name, kind, repeated)."""
+
+    __slots__ = ("num", "name", "kind", "repeated", "message")
+
+    def __init__(self, num: int, name: str, kind: str, repeated: bool = False,
+                 message: Optional[str] = None):
+        self.num = num
+        self.name = name
+        self.kind = kind  # int64 | float | double | bytes | string | message
+        self.repeated = repeated
+        self.message = message  # schema key for kind == "message"
+
+
+_SCHEMAS: Dict[str, List[F]] = {
+    "ModelProto": [
+        F(1, "ir_version", "int64"),
+        F(2, "producer_name", "string"),
+        F(3, "producer_version", "string"),
+        F(4, "domain", "string"),
+        F(5, "model_version", "int64"),
+        F(6, "doc_string", "string"),
+        F(7, "graph", "message", message="GraphProto"),
+        F(8, "opset_import", "message", repeated=True, message="OperatorSetIdProto"),
+    ],
+    "OperatorSetIdProto": [
+        F(1, "domain", "string"),
+        F(2, "version", "int64"),
+    ],
+    "GraphProto": [
+        F(1, "node", "message", repeated=True, message="NodeProto"),
+        F(2, "name", "string"),
+        F(5, "initializer", "message", repeated=True, message="TensorProto"),
+        F(10, "doc_string", "string"),
+        F(11, "input", "message", repeated=True, message="ValueInfoProto"),
+        F(12, "output", "message", repeated=True, message="ValueInfoProto"),
+        F(13, "value_info", "message", repeated=True, message="ValueInfoProto"),
+    ],
+    "NodeProto": [
+        F(1, "input", "string", repeated=True),
+        F(2, "output", "string", repeated=True),
+        F(3, "name", "string"),
+        F(4, "op_type", "string"),
+        F(5, "attribute", "message", repeated=True, message="AttributeProto"),
+        F(6, "doc_string", "string"),
+        F(7, "domain", "string"),
+    ],
+    "AttributeProto": [
+        F(1, "name", "string"),
+        F(2, "f", "float"),
+        F(3, "i", "int64"),
+        F(4, "s", "bytes"),
+        F(5, "t", "message", message="TensorProto"),
+        F(6, "g", "message", message="GraphProto"),
+        F(7, "floats", "float", repeated=True),
+        F(8, "ints", "int64", repeated=True),
+        F(9, "strings", "bytes", repeated=True),
+        F(10, "tensors", "message", repeated=True, message="TensorProto"),
+        F(11, "graphs", "message", repeated=True, message="GraphProto"),
+        F(20, "type", "int64"),
+    ],
+    "TensorProto": [
+        F(1, "dims", "int64", repeated=True),
+        F(2, "data_type", "int64"),
+        F(4, "float_data", "float", repeated=True),
+        F(5, "int32_data", "int64", repeated=True),
+        F(6, "string_data", "bytes", repeated=True),
+        F(7, "int64_data", "int64", repeated=True),
+        F(8, "name", "string"),
+        F(9, "raw_data", "bytes"),
+        F(10, "double_data", "double", repeated=True),
+        F(11, "uint64_data", "int64", repeated=True),
+        F(12, "doc_string", "string"),
+    ],
+    "ValueInfoProto": [
+        F(1, "name", "string"),
+        F(2, "type", "message", message="TypeProto"),
+        F(3, "doc_string", "string"),
+    ],
+    "TypeProto": [
+        F(1, "tensor_type", "message", message="TypeProto.Tensor"),
+    ],
+    "TypeProto.Tensor": [
+        F(1, "elem_type", "int64"),
+        F(2, "shape", "message", message="TensorShapeProto"),
+    ],
+    "TensorShapeProto": [
+        F(1, "dim", "message", repeated=True, message="TensorShapeProto.Dimension"),
+    ],
+    "TensorShapeProto.Dimension": [
+        F(1, "dim_value", "int64"),
+        F(2, "dim_param", "string"),
+    ],
+}
+
+# AttributeProto.type enum values (onnx AttributeProto.AttributeType)
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR, ATTR_GRAPH = 1, 2, 3, 4, 5
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS, ATTR_TENSORS, ATTR_GRAPHS = 6, 7, 8, 9, 10
+
+
+class Msg:
+    """Generic decoded protobuf message; fields become attributes."""
+
+    __slots__ = ("_schema", "__dict__")
+
+    def __init__(self, schema: str, **kwargs):
+        self._schema = schema
+        for f in _SCHEMAS[schema]:
+            setattr(self, f.name, [] if f.repeated else None)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        body = {f.name: getattr(self, f.name) for f in _SCHEMAS[self._schema]
+                if getattr(self, f.name) not in (None, [])}
+        return f"{self._schema}({body})"
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+
+def decode(schema: str, buf: bytes) -> Msg:
+    fields = {f.num: f for f in _SCHEMAS[schema]}
+    msg = Msg(schema)
+    pos, end = 0, len(buf)
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        num, wire = tag >> 3, tag & 7
+        f = fields.get(num)
+        if f is None:  # unknown field: skip
+            pos = _skip(buf, pos, wire)
+            continue
+        if f.kind == "message":
+            assert wire == _WIRE_LEN
+            ln, pos = _read_varint(buf, pos)
+            sub = decode(f.message, buf[pos:pos + ln])
+            pos += ln
+            _store(msg, f, sub)
+        elif f.kind in ("bytes", "string"):
+            assert wire == _WIRE_LEN
+            ln, pos = _read_varint(buf, pos)
+            raw = buf[pos:pos + ln]
+            pos += ln
+            _store(msg, f, raw.decode("utf-8", "replace") if f.kind == "string" else bytes(raw))
+        elif f.kind == "int64":
+            if wire == _WIRE_LEN:  # packed repeated
+                ln, pos = _read_varint(buf, pos)
+                stop = pos + ln
+                while pos < stop:
+                    v, pos = _read_varint(buf, pos)
+                    _store(msg, f, _zigzag_signed(v))
+            else:
+                v, pos = _read_varint(buf, pos)
+                _store(msg, f, _zigzag_signed(v))
+        elif f.kind == "float":
+            if wire == _WIRE_LEN:
+                ln, pos = _read_varint(buf, pos)
+                vals = struct.unpack_from(f"<{ln // 4}f", buf, pos)
+                pos += ln
+                for v in vals:
+                    _store(msg, f, v)
+            else:
+                (v,) = struct.unpack_from("<f", buf, pos)
+                pos += 4
+                _store(msg, f, v)
+        elif f.kind == "double":
+            if wire == _WIRE_LEN:
+                ln, pos = _read_varint(buf, pos)
+                vals = struct.unpack_from(f"<{ln // 8}d", buf, pos)
+                pos += ln
+                for v in vals:
+                    _store(msg, f, v)
+            else:
+                (v,) = struct.unpack_from("<d", buf, pos)
+                pos += 8
+                _store(msg, f, v)
+        else:
+            raise ValueError(f"unhandled kind {f.kind}")
+    return msg
+
+
+def _store(msg: Msg, f: F, value: Any):
+    if f.repeated:
+        getattr(msg, f.name).append(value)
+    else:
+        setattr(msg, f.name, value)
+
+
+def _skip(buf: bytes, pos: int, wire: int) -> int:
+    if wire == _WIRE_VARINT:
+        _, pos = _read_varint(buf, pos)
+    elif wire == _WIRE_FIXED64:
+        pos += 8
+    elif wire == _WIRE_FIXED32:
+        pos += 4
+    elif wire == _WIRE_LEN:
+        ln, pos = _read_varint(buf, pos)
+        pos += ln
+    else:
+        raise ValueError(f"cannot skip wire type {wire}")
+    return pos
+
+
+# ---------------------------------------------------------------------------
+# Encode
+# ---------------------------------------------------------------------------
+
+def encode(msg: Msg) -> bytes:
+    out = bytearray()
+    for f in _SCHEMAS[msg._schema]:
+        val = getattr(msg, f.name)
+        if val is None or (f.repeated and not val):
+            continue
+        values = val if f.repeated else [val]
+        if f.kind == "message":
+            for v in values:
+                payload = encode(v)
+                _write_varint(out, (f.num << 3) | _WIRE_LEN)
+                _write_varint(out, len(payload))
+                out.extend(payload)
+        elif f.kind in ("bytes", "string"):
+            for v in values:
+                raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                _write_varint(out, (f.num << 3) | _WIRE_LEN)
+                _write_varint(out, len(raw))
+                out.extend(raw)
+        elif f.kind == "int64":
+            if f.repeated and len(values) > 1:
+                payload = bytearray()
+                for v in values:
+                    _write_varint(payload, int(v))
+                _write_varint(out, (f.num << 3) | _WIRE_LEN)
+                _write_varint(out, len(payload))
+                out.extend(payload)
+            else:
+                for v in values:
+                    _write_varint(out, (f.num << 3) | _WIRE_VARINT)
+                    _write_varint(out, int(v))
+        elif f.kind == "float":
+            if f.repeated:
+                payload = struct.pack(f"<{len(values)}f", *values)
+                _write_varint(out, (f.num << 3) | _WIRE_LEN)
+                _write_varint(out, len(payload))
+                out.extend(payload)
+            else:
+                _write_varint(out, (f.num << 3) | _WIRE_FIXED32)
+                out.extend(struct.pack("<f", values[0]))
+        elif f.kind == "double":
+            if f.repeated:
+                payload = struct.pack(f"<{len(values)}d", *values)
+                _write_varint(out, (f.num << 3) | _WIRE_LEN)
+                _write_varint(out, len(payload))
+                out.extend(payload)
+            else:
+                _write_varint(out, (f.num << 3) | _WIRE_FIXED64)
+                out.extend(struct.pack("<d", values[0]))
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# TensorProto <-> numpy
+# ---------------------------------------------------------------------------
+
+# onnx TensorProto.DataType enum
+TENSOR_DTYPES: Dict[int, Any] = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+NP_TO_ONNX = {np.dtype(v): k for k, v in TENSOR_DTYPES.items()}
+_NP_TO_ONNX = NP_TO_ONNX  # back-compat alias
+DTYPE_STRING = 8
+DTYPE_BFLOAT16 = 16
+
+try:  # bfloat16 comes with jax's ml_dtypes (always present in this env)
+    import ml_dtypes
+    TENSOR_DTYPES[DTYPE_BFLOAT16] = ml_dtypes.bfloat16
+    _NP_TO_ONNX[np.dtype(ml_dtypes.bfloat16)] = DTYPE_BFLOAT16
+except ImportError:  # pragma: no cover
+    pass
+
+
+def tensor_to_numpy(t: Msg) -> np.ndarray:
+    dims = tuple(int(d) for d in t.dims)
+    dt = int(t.data_type or 0)
+    if dt == DTYPE_STRING:
+        arr = np.array([s.decode("utf-8", "replace") for s in t.string_data],
+                       dtype=object)
+        return arr.reshape(dims)
+    np_dtype = TENSOR_DTYPES.get(dt)
+    if np_dtype is None:
+        raise ValueError(f"unsupported tensor data_type {dt}")
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=np_dtype).reshape(dims).copy()
+    # typed repeated fields
+    if dt == 1:
+        data = np.asarray(t.float_data, dtype=np.float32)
+    elif dt == 11:
+        data = np.asarray(t.double_data, dtype=np.float64)
+    elif dt == 7:
+        data = np.asarray(t.int64_data, dtype=np.int64)
+    elif dt in (12, 13):
+        data = np.asarray(t.uint64_data, dtype=np.uint64).astype(np_dtype)
+    elif dt == 10:  # float16 stored bit-cast in int32_data
+        data = np.asarray(t.int32_data, dtype=np.uint16).view(np.float16)
+    elif dt == DTYPE_BFLOAT16:
+        data = np.asarray(t.int32_data, dtype=np.uint16).view(np_dtype)
+    else:  # int32/int16/int8/uint8/uint16/bool ride int32_data
+        data = np.asarray(t.int32_data, dtype=np.int64).astype(np_dtype)
+    return data.reshape(dims)
+
+
+def numpy_to_tensor(arr: np.ndarray, name: str = "") -> Msg:
+    arr = np.asarray(arr)  # NOT ascontiguousarray: that promotes 0-d to 1-d
+    t = Msg("TensorProto")
+    t.name = name
+    t.dims = list(arr.shape)
+    if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+        t.data_type = DTYPE_STRING
+        t.string_data = [str(s).encode("utf-8") for s in arr.reshape(-1)]
+        return t
+    dt = _NP_TO_ONNX.get(arr.dtype)
+    if dt is None:
+        raise ValueError(f"unsupported numpy dtype {arr.dtype}")
+    t.data_type = dt
+    t.raw_data = arr.tobytes()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Attribute helpers
+# ---------------------------------------------------------------------------
+
+def attr_value(a: Msg) -> Any:
+    """Extract the python value of an AttributeProto."""
+    # proto3 serializers omit zero-valued scalar fields entirely, so a typed
+    # attribute may arrive with its value field unset: default, don't crash.
+    ty = int(a.type or 0)
+    if ty == ATTR_FLOAT:
+        return float(a.f or 0.0)
+    if ty == ATTR_INT:
+        return int(a.i or 0)
+    if ty == ATTR_STRING:
+        return (a.s or b"").decode("utf-8", "replace")
+    if ty == ATTR_TENSOR:
+        return tensor_to_numpy(a.t)
+    if ty == ATTR_GRAPH:
+        return a.g
+    if ty == ATTR_FLOATS:
+        return [float(v) for v in a.floats]
+    if ty == ATTR_INTS:
+        return [int(v) for v in a.ints]
+    if ty == ATTR_STRINGS:
+        return [s.decode("utf-8", "replace") for s in a.strings]
+    if ty == ATTR_TENSORS:
+        return [tensor_to_numpy(t) for t in a.tensors]
+    # untyped (some emitters omit .type): best effort
+    if a.floats:
+        return list(a.floats)
+    if a.ints:
+        return list(a.ints)
+    if a.s:
+        return a.s.decode("utf-8", "replace")
+    if a.t is not None:
+        return tensor_to_numpy(a.t)
+    if a.i is not None:
+        return int(a.i)
+    if a.f is not None:
+        return float(a.f)
+    return None
+
+
+def node_attrs(node: Msg) -> Dict[str, Any]:
+    return {a.name: attr_value(a) for a in node.attribute}
+
+
+def make_attr(name: str, value: Any) -> Msg:
+    a = Msg("AttributeProto")
+    a.name = name
+    if isinstance(value, bool):
+        a.type, a.i = ATTR_INT, int(value)
+    elif isinstance(value, int):
+        a.type, a.i = ATTR_INT, value
+    elif isinstance(value, float):
+        a.type, a.f = ATTR_FLOAT, value
+    elif isinstance(value, str):
+        a.type, a.s = ATTR_STRING, value.encode("utf-8")
+    elif isinstance(value, np.ndarray):
+        a.type, a.t = ATTR_TENSOR, numpy_to_tensor(value)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            a.type, a.floats = ATTR_FLOATS, [float(v) for v in value]
+        elif value and isinstance(value[0], str):
+            a.type, a.strings = ATTR_STRINGS, [v.encode() for v in value]
+        else:
+            a.type, a.ints = ATTR_INTS, [int(v) for v in value]
+    else:
+        raise TypeError(f"cannot encode attribute {name}={value!r}")
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Model container helpers
+# ---------------------------------------------------------------------------
+
+def load_model(path_or_bytes) -> Msg:
+    """Parse a ``.onnx`` file (or raw bytes) into a ModelProto Msg."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as fh:
+            data = fh.read()
+    return decode("ModelProto", data)
+
+
+def save_model(model: Msg, path: str):
+    with open(path, "wb") as fh:
+        fh.write(encode(model))

@@ -1,0 +1,182 @@
+"""Bundled ONNX model constructors (ResNet family).
+
+The reference ships a ModelDownloader that fetches pretrained CNTK graphs from
+a remote repo (ref: deep-learning/src/main/scala/com/microsoft/ml/spark/cntk/downloader/ModelDownloader.scala:197-265).
+This environment has no network egress, so the zoo *constructs* the standard
+torchvision-layout ResNet graphs as real ``.onnx`` protobuf bytes with seeded
+He-initialized weights — the import / execution path exercised is byte-for-byte
+the same one a user's downloaded ResNet-50 file takes: protobuf parse ->
+node-by-node lowering -> jit. Weight dicts can also be supplied to build an
+ONNX file from externally-trained parameters (the export story).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from synapseml_tpu.onnx.builder import GraphBuilder
+
+
+class _Rng:
+    def __init__(self, seed: int):
+        self.rng = np.random.default_rng(seed)
+
+    def conv_w(self, out_c, in_c, kh, kw):
+        fan_in = in_c * kh * kw
+        std = np.sqrt(2.0 / fan_in)
+        return self.rng.normal(0, std, (out_c, in_c, kh, kw)).astype(np.float32)
+
+    def fc(self, out_f, in_f):
+        std = np.sqrt(1.0 / in_f)
+        w = self.rng.uniform(-std, std, (out_f, in_f)).astype(np.float32)
+        b = self.rng.uniform(-std, std, (out_f,)).astype(np.float32)
+        return w, b
+
+    def bn(self, c):
+        # running stats of a trained net are not identity; randomize mildly so
+        # numerical-equivalence tests exercise the real BN math
+        return (np.abs(self.rng.normal(1, 0.1, c)).astype(np.float32),
+                self.rng.normal(0, 0.1, c).astype(np.float32),
+                self.rng.normal(0, 0.5, c).astype(np.float32),
+                np.abs(self.rng.normal(1, 0.2, c)).astype(np.float32) + 0.1)
+
+
+def _bn_relu(g: GraphBuilder, r: _Rng, x: str, c: int, relu: bool = True) -> str:
+    s, b, m, v = r.bn(c)
+    y = g.batch_norm(x, s, b, m, v)
+    return g.relu(y) if relu else y
+
+
+def _basic_block(g, r, x, in_c, out_c, stride):
+    y = g.conv(x, r.conv_w(out_c, in_c, 3, 3), strides=(stride, stride),
+               pads=(1, 1, 1, 1))
+    y = _bn_relu(g, r, y, out_c)
+    y = g.conv(y, r.conv_w(out_c, out_c, 3, 3), pads=(1, 1, 1, 1))
+    y = _bn_relu(g, r, y, out_c, relu=False)
+    if stride != 1 or in_c != out_c:
+        sc = g.conv(x, r.conv_w(out_c, in_c, 1, 1), strides=(stride, stride))
+        sc = _bn_relu(g, r, sc, out_c, relu=False)
+    else:
+        sc = x
+    return g.relu(g.add_node("Add", [y, sc]))
+
+
+def _bottleneck(g, r, x, in_c, mid_c, stride):
+    out_c = mid_c * 4
+    y = g.conv(x, r.conv_w(mid_c, in_c, 1, 1))
+    y = _bn_relu(g, r, y, mid_c)
+    y = g.conv(y, r.conv_w(mid_c, mid_c, 3, 3), strides=(stride, stride),
+               pads=(1, 1, 1, 1))
+    y = _bn_relu(g, r, y, mid_c)
+    y = g.conv(y, r.conv_w(out_c, mid_c, 1, 1))
+    y = _bn_relu(g, r, y, out_c, relu=False)
+    if stride != 1 or in_c != out_c:
+        sc = g.conv(x, r.conv_w(out_c, in_c, 1, 1), strides=(stride, stride))
+        sc = _bn_relu(g, r, sc, out_c, relu=False)
+    else:
+        sc = x
+    return g.relu(g.add_node("Add", [y, sc]))
+
+
+def build_resnet(depths: Sequence[int], bottleneck: bool, num_classes: int = 1000,
+                 width: int = 64, image_size: int = 224, seed: int = 0,
+                 batch_dim="N") -> bytes:
+    """Emit a torchvision-layout ResNet as ONNX bytes."""
+    g = GraphBuilder(name=f"resnet{'_bn' if bottleneck else ''}", opset=17)
+    r = _Rng(seed)
+    x = g.add_input("data", np.float32, [batch_dim, 3, image_size, image_size])
+    y = g.conv(x, r.conv_w(width, 3, 7, 7), strides=(2, 2), pads=(3, 3, 3, 3))
+    y = _bn_relu(g, r, y, width)
+    y = g.add_node("MaxPool", [y], kernel_shape=[3, 3], strides=[2, 2],
+                   pads=[1, 1, 1, 1])
+    in_c = width
+    chan = width
+    for stage, n_blocks in enumerate(depths):
+        for blk in range(n_blocks):
+            stride = 2 if (stage > 0 and blk == 0) else 1
+            if bottleneck:
+                y = _bottleneck(g, r, y, in_c, chan, stride)
+                in_c = chan * 4
+            else:
+                y = _basic_block(g, r, y, in_c, chan, stride)
+                in_c = chan
+        chan *= 2
+    y = g.add_node("GlobalAveragePool", [y])
+    y = g.add_node("Flatten", [y], axis=1)
+    w, b = r.fc(num_classes, in_c)
+    y = g.gemm(y, w, b)
+    g.add_output(y, np.float32, [batch_dim, num_classes])
+    return g.to_bytes()
+
+
+def resnet50(num_classes: int = 1000, image_size: int = 224, seed: int = 0) -> bytes:
+    return build_resnet([3, 4, 6, 3], bottleneck=True, num_classes=num_classes,
+                        image_size=image_size, seed=seed)
+
+
+def resnet18(num_classes: int = 1000, image_size: int = 224, seed: int = 0) -> bytes:
+    return build_resnet([2, 2, 2, 2], bottleneck=False, num_classes=num_classes,
+                        image_size=image_size, seed=seed)
+
+
+def tiny_resnet(num_classes: int = 10, image_size: int = 32, seed: int = 0) -> bytes:
+    """Small ResNet for tests: same op inventory as resnet50, tiny shapes."""
+    return build_resnet([1, 1], bottleneck=True, num_classes=num_classes,
+                        width=8, image_size=image_size, seed=seed)
+
+
+def mlp(layer_sizes: Sequence[int], num_classes: int, seed: int = 0,
+        activation: str = "Relu") -> bytes:
+    """Plain MLP with a trailing Softmax — the classical-ML ONNX shape."""
+    g = GraphBuilder(name="mlp", opset=17)
+    r = _Rng(seed)
+    x = g.add_input("input", np.float32, ["N", layer_sizes[0]])
+    y = x
+    dims = list(layer_sizes[1:]) + [num_classes]
+    prev = layer_sizes[0]
+    for i, d in enumerate(dims):
+        w, b = r.fc(d, prev)
+        y = g.gemm(y, w, b)
+        if i < len(dims) - 1:
+            y = g.add_node(activation, [y])
+        prev = d
+    probs = g.add_node("Softmax", [y], axis=-1)
+    g.add_output(probs, np.float32, ["N", num_classes])
+    return g.to_bytes()
+
+
+def bilstm_tagger(vocab: int, embed: int, hidden: int, n_tags: int,
+                  seq_len: int = 64, seed: int = 0) -> bytes:
+    """Bidirectional-LSTM token tagger (the reference's BiLSTM medical-entity
+    config, BASELINE config #5) as an ONNX graph: Gather(embedding) -> LSTM
+    (bidirectional) -> Gemm per token."""
+    g = GraphBuilder(name="bilstm_tagger", opset=17)
+    r = _Rng(seed)
+    ids = g.add_input("tokens", np.int64, ["N", seq_len])
+    emb = g.add_initializer(
+        "embedding", r.rng.normal(0, 0.1, (vocab, embed)).astype(np.float32))
+    x = g.add_node("Gather", [emb, ids], axis=0)          # (N, S, E)
+    x = g.add_node("Transpose", [x], perm=[1, 0, 2])      # (S, N, E)
+    w = g.add_initializer("lstm_w", np.stack([
+        r.rng.normal(0, 0.1, (4 * hidden, embed)).astype(np.float32)
+        for _ in range(2)]))
+    rr = g.add_initializer("lstm_r", np.stack([
+        r.rng.normal(0, 0.1, (4 * hidden, hidden)).astype(np.float32)
+        for _ in range(2)]))
+    b = g.add_initializer(
+        "lstm_b", np.zeros((2, 8 * hidden), dtype=np.float32))
+    y = g.add_node("LSTM", [x, w, rr, b], outputs=["lstm_y", "lstm_h", "lstm_c"],
+                   hidden_size=hidden, direction="bidirectional")
+    y = y[0] if isinstance(y, list) else y
+    y = g.add_node("Transpose", [y], perm=[2, 0, 1, 3])   # (N, S, dirs, H)
+    shp = g.add_initializer("flat_shape", np.array([0, seq_len, 2 * hidden],
+                                                   dtype=np.int64))
+    y = g.add_node("Reshape", [y, shp])
+    wf, bf = r.fc(n_tags, 2 * hidden)
+    wn = g.add_initializer("head_w", np.ascontiguousarray(wf.T))
+    bn = g.add_initializer("head_b", bf)
+    y = g.add_node("MatMul", [y, wn])
+    y = g.add_node("Add", [y, bn])
+    g.add_output(y, np.float32, ["N", seq_len, n_tags])
+    return g.to_bytes()

@@ -60,12 +60,20 @@ class BatchedExecutor:
         min_bucket: int = 8,
         max_bucket: Optional[int] = None,
         static_batch: Optional[int] = None,
+        bound_args: Tuple[Any, ...] = (),
     ):
+        """``bound_args`` are prepended to every call unpadded — use for a
+        weights pytree so it is device-resident and *shared* across all shape
+        buckets instead of baked into each compiled program as constants."""
         self._device = device
         self._compute_dtype = compute_dtype
         self._min_bucket = min_bucket
         self._max_bucket = max_bucket
         self._static_batch = static_batch
+        self._bound = tuple(
+            jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, device) if device else jnp.asarray(a),
+                b) for b in bound_args)
         self._jit = jax.jit(fn)
 
     def _bucket(self, n: int) -> int:
@@ -102,7 +110,7 @@ class BatchedExecutor:
                 a = np.pad(a, pad)
             padded.append(
                 jax.device_put(a, self._device) if self._device else a)
-        out = self._jit(*padded)
+        out = self._jit(*self._bound, *padded)
         leaves = jax.tree_util.tree_leaves(out)
         host = [np.asarray(l)[:n] for l in leaves]
         return tuple(host)

@@ -213,6 +213,10 @@ class ComputePerInstanceStatistics(Transformer, HasLabelCol):
         mat = (np.stack(list(probs)) if probs.dtype == object
                else np.asarray(probs, np.float64))
         if self.label_values is not None:
+            if len(self.label_values) > mat.shape[1]:
+                raise ValueError(
+                    f"label_values has {len(self.label_values)} entries but the "
+                    f"probability matrix has {mat.shape[1]} columns")
             lookup = {float(v): i for i, v in enumerate(self.label_values)}
             try:
                 yi = np.asarray([lookup[float(v)] for v in y], int)
