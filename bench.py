@@ -1,9 +1,14 @@
 """Benchmark driver — prints ONE JSON line.
 
-North-star metric (BASELINE.md): ONNX ResNet-50 inference images/sec/chip,
-target >= 1x GPU-VM throughput on the "ONNX - Inference on Spark" workload.
-The reference publishes no number; we take 1000 images/sec/chip as the
-nominal GPU-VM (T4-class, ORT-CUDA fp16, bs128) baseline for vs_baseline.
+North-star metrics (BASELINE.md / BASELINE.json):
+1. ONNX ResNet-50 inference images/sec/chip through the *imported* ONNX graph
+   (protobuf parse -> node lowering -> jit), the "ONNX - Inference on Spark"
+   workload. Primary metric. Nominal GPU-VM baseline: 1000 img/s (T4-class,
+   ORT-CUDA fp16, bs128).
+2. LightGBM training rows/sec/chip on an Adult-census-scale workload
+   (32561 rows x 14 features, 100 iterations, 31 leaves), the
+   "LightGBM - Overview" workload. Nominal GPU-VM baseline: 1.0e6
+   rows*iters/sec (lib_lightgbm CUDA on T4 trains this in ~3.3s).
 
 Runs on whatever jax.devices() provides (the real TPU chip under the driver).
 """
@@ -15,43 +20,100 @@ import time
 import numpy as np
 
 
-def main():
+def bench_onnx_resnet50():
+    """(device_resident_img_s, host_feed_img_s) through the imported graph.
+
+    Device-resident isolates chip throughput (the ORT-CUDA analogue: data
+    already in device memory); host-feed includes the host->device copy per
+    batch, which on this driver rides a network tunnel to the chip and is
+    bandwidth-bound — on a co-located TPU-VM host it approaches the former.
+    """
     import jax
     import jax.numpy as jnp
 
-    from synapseml_tpu.dl.resnet import init_resnet, resnet50
+    from synapseml_tpu.onnx import ONNXModel, import_model, zoo
 
     batch = 128
-    model = resnet50(num_classes=1000, dtype=jnp.bfloat16)
-    variables = init_resnet(model, jax.random.PRNGKey(0), image_size=224)
+    blob = zoo.resnet50(num_classes=1000)
+    images_np = np.random.default_rng(0).standard_normal(
+        (batch, 3, 224, 224)).astype(np.float32)
+
+    # -- device-resident path: jitted imported graph, input stays in HBM.
+    # The N forwards run inside one fori_loop with a data dependency (the
+    # accumulated sum feeds the next input) so XLA cannot hoist the body,
+    # and a single scalar fetch at the end forces real completion —
+    # block_until_ready is unreliable on tunneled device platforms.
+    graph = import_model(blob)
+    fwd_fn = graph.bind(cast_dtype=jnp.bfloat16)
+    iters = 30
 
     @jax.jit
-    def forward(images):
-        return model.apply(variables, images, train=False)
+    def loop(img):
+        def body(i, acc):
+            x = img + (acc * 0).astype(img.dtype)
+            return acc + fwd_fn(x)[0].sum().astype(jnp.float32)
+        return jax.lax.fori_loop(0, iters, body, jnp.float32(0.0))
 
-    images = jnp.asarray(
-        np.random.default_rng(0).standard_normal((batch, 224, 224, 3)),
-        dtype=jnp.bfloat16)
-
-    # compile + warmup
-    forward(images).block_until_ready()
-    for _ in range(3):
-        forward(images).block_until_ready()
-
-    iters = 20
+    images_dev = jnp.asarray(images_np, jnp.bfloat16)
+    float(loop(images_dev))  # compile + warmup, forced by the value fetch
     start = time.perf_counter()
-    for _ in range(iters):
-        out = forward(images)
-    out.block_until_ready()
-    elapsed = time.perf_counter() - start
+    float(loop(images_dev))
+    dev_img_s = batch * iters / (time.perf_counter() - start)
 
-    images_per_sec = batch * iters / elapsed
-    gpu_vm_baseline = 1000.0  # nominal GPU-VM ResNet-50 fp16 inference img/s
+    # -- host-feed path: the full ONNXModel executor incl. per-batch copy
+    model = ONNXModel(model_bytes=blob, mini_batch_size=batch,
+                      compute_dtype="bfloat16")
+    executor = model._executor()
+    executor(images_np)
+    start = time.perf_counter()
+    for _ in range(5):
+        out = executor(images_np)
+    np.asarray(out[0])  # sync
+    host_img_s = batch * 5 / (time.perf_counter() - start)
+    return dev_img_s, host_img_s
+
+
+def bench_gbdt_train():
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.gbdt.estimators import LightGBMClassifier
+
+    n, d = 32561, 14
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logits = x @ rng.normal(size=(d,)) + 0.5 * np.sin(3 * x[:, 0]) * x[:, 1]
+    y = (logits + rng.normal(scale=0.5, size=n) > 0).astype(np.int32)
+    table = Table({"features": x, "label": y})
+
+    est = LightGBMClassifier(num_iterations=100, num_leaves=31,
+                             learning_rate=0.1)
+    est.fit(table)  # warmup: compile of binning + grower loop
+    start = time.perf_counter()
+    est.fit(table)
+    elapsed = time.perf_counter() - start
+    return n * 100 / elapsed
+
+
+def main():
+    img_s, host_img_s = bench_onnx_resnet50()
+    rows_s = bench_gbdt_train()
+    gpu_img_baseline = 1000.0
+    gpu_rows_baseline = 1.0e6
     print(json.dumps({
-        "metric": "resnet50_inference_images_per_sec_per_chip",
-        "value": round(images_per_sec, 2),
+        "metric": "onnx_resnet50_images_per_sec_per_chip",
+        "value": round(img_s, 2),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / gpu_vm_baseline, 3),
+        "vs_baseline": round(img_s / gpu_img_baseline, 3),
+        "secondary": [{
+            "metric": "lightgbm_train_rows_iters_per_sec_per_chip",
+            "value": round(rows_s, 2),
+            "unit": "rows*iters/sec",
+            "vs_baseline": round(rows_s / gpu_rows_baseline, 3),
+        }, {
+            "metric": "onnx_resnet50_hostfeed_images_per_sec",
+            "value": round(host_img_s, 2),
+            "unit": "images/sec",
+            "vs_baseline": round(host_img_s / gpu_img_baseline, 3),
+        }],
     }))
 
 

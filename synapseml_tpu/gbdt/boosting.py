@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -198,6 +198,10 @@ class _ValidTracker:
             m = float(self.metric_fn(vscore, vy.astype(jnp.int32)))
         else:
             m = float(self.metric_fn(vscore[:, 0], vy))
+        return self.record(m, it)
+
+    def record(self, m: float, it: int) -> bool:
+        """Record a precomputed metric value; True = stop early."""
         self.history[self.metric_name].append(m)
         improved = (m > self.best_score if self.larger_better
                     else m < self.best_score)
@@ -406,6 +410,164 @@ def _leaf_index_stack(stack, x):
     return leaves.T
 
 
+@lru_cache(maxsize=64)
+def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
+                  track_dev: bool, track_rank: bool,
+                  metric_name: Optional[str]):
+    """Build (and cache) the jitted chunked-scan trainer for one static
+    config. Data rides in through the ``consts`` argument, so repeated fits
+    with the same hyperparameters reuse the compiled executable instead of
+    re-tracing a fresh closure per ``fit`` call."""
+    obj_fn = _objective_fn(p)
+    is_rank = p.objective in ("lambdarank", "rank_xendcg")
+    use_goss = p.boosting_type == "goss"
+    is_rf = p.boosting_type == "rf"
+    use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
+    feature_frac = p.feature_fraction
+    renew_alpha = None
+    if k == 1 and p.objective in ("regression_l1", "l1", "mae"):
+        renew_alpha = 0.5
+    elif k == 1 and p.objective == "quantile":
+        renew_alpha = p.alpha
+    metric_fn = (obj.METRICS.get(metric_name, (None, False))[0]
+                 if metric_name else None)
+    axis_name = None
+    bdev = gp.max_bin
+
+    def scan(carry, steps, consts):
+        binned, yd, wd = consts["binned"], consts["yd"], consts["wd"]
+        group_ids, thresholds = consts["gids"], consts["thr"]
+        init = consts["init"]
+        vx_d, vy_d = consts["vx"], consts["vy"]
+        n, f = binned.shape
+        y_onehot = jax.nn.one_hot(yd.astype(jnp.int32), k) if k > 1 else None
+
+        def compute_grad(scores, class_idx):
+            if k > 1:
+                g, h = obj_fn(scores, y_onehot, wd)
+                return g[:, class_idx], h[:, class_idx]
+            if is_rank:
+                g, h = obj.lambdarank_grad(scores, yd, group_ids,
+                                           max_dcg_pos=p.max_position)
+                if wd is not None:
+                    g, h = g * wd, h * wd
+                return g, h
+            return obj_fn(scores, yd, wd)
+
+        def sample_mask_and_weights(grad, hess, key):
+            """bagging / GOSS row selection; returns (mask, grad, hess)."""
+            if use_goss:
+                a, b = p.top_rate, p.other_rate
+                n_top = max(1, int(a * n))
+                thresh = -jnp.sort(-jnp.abs(grad))[n_top - 1]
+                top = jnp.abs(grad) >= thresh
+                rand = jax.random.uniform(key, (n,)) < b
+                amp = (1.0 - a) / max(b, 1e-12)
+                small = (~top) & rand
+                mask = top | small
+                g = jnp.where(small, grad * amp, grad)
+                h = jnp.where(small, hess * amp, hess)
+                return mask, g, h
+            if use_bagging:
+                frac = p.bagging_fraction if not is_rf else (
+                    p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632)
+                mask = jax.random.uniform(key, (n,)) < frac
+                return mask, grad, hess
+            return jnp.ones(n, jnp.bool_), grad, hess
+
+        def feature_mask(key):
+            if feature_frac >= 1.0:
+                return None
+            keep = max(1, int(round(feature_frac * f)))
+            perm = jax.random.permutation(key, f)
+            mask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
+            return mask
+
+        def iteration(scores, key, class_idx):
+            base = jnp.full_like(scores, init) if is_rf else scores
+            g, h = compute_grad(base, class_idx)
+            k1, k2 = jax.random.split(key)
+            mask, g2, h2 = sample_mask_and_weights(g, h, k1)
+            fmask = feature_mask(k2)
+            gb = binned
+            if fmask is not None:
+                # masked-out features get the missing bin -> never split
+                gb = jnp.where(fmask[None, :], binned, bdev - 1)
+            tree, row_slot, slot_value, slot_node = build_tree(
+                gb, g2, h2, mask, thresholds, gp, axis_name)
+            if renew_alpha is not None:
+                # L1-family leaf renewal (LightGBM RenewTreeOutput): leaf
+                # output := alpha-quantile of residuals of rows in the leaf.
+                residual = yd - scores
+
+                def leaf_quantile(slot):
+                    r = jnp.where(row_slot == slot, residual, jnp.nan)
+                    return jnp.nanquantile(r, renew_alpha)
+
+                renewed = jax.vmap(leaf_quantile)(jnp.arange(gp.num_leaves))
+                slot_value = jnp.where(jnp.isnan(renewed), slot_value, renewed)
+                # rebuild node-level leaf values from renewed slot values
+                m_nodes = tree.leaf_value.shape[0]
+                nsel = ((slot_node[:, None] == jnp.arange(m_nodes))
+                        & (slot_node >= 0)[:, None])
+                new_leaf = jnp.sum(nsel * slot_value[:, None], axis=0)
+                tree = Tree(
+                    split_feature=tree.split_feature, threshold=tree.threshold,
+                    threshold_bin=tree.threshold_bin,
+                    left_child=tree.left_child,
+                    right_child=tree.right_child, leaf_value=new_leaf,
+                    cover=tree.cover, gain=tree.gain)
+            lr = 1.0 if is_rf else p.learning_rate
+            delta = lr * slot_value[row_slot]
+            if k > 1:
+                # one-hot column add (a traced-column scatter is a
+                # fixed-latency op per call; this is a fused select)
+                new_scores = scores + delta[:, None] * jax.nn.one_hot(
+                    class_idx, k, dtype=scores.dtype)
+            else:
+                new_scores = scores + delta
+            scaled = Tree(
+                split_feature=tree.split_feature,
+                threshold=tree.threshold,
+                threshold_bin=tree.threshold_bin,
+                left_child=tree.left_child,
+                right_child=tree.right_child,
+                leaf_value=tree.leaf_value * lr,
+                cover=tree.cover,
+                gain=tree.gain,
+            )
+            return new_scores, scaled
+
+        def scan_step(carry, step):
+            scores, vsum, rng = carry
+            rng, key = jax.random.split(rng)
+            c = step % k
+            it = step // k
+            new_scores, tree = iteration(scores, key, c)
+            out: Tuple = (tree,)
+            if track:
+                vt = predict_tree(
+                    (tree.split_feature, tree.threshold, tree.left_child,
+                     tree.right_child, tree.leaf_value), vx_d)
+                vsum = vsum + vt[:, None] * jax.nn.one_hot(
+                    c, k, dtype=vsum.dtype)
+            if track_dev:
+                scale = (1.0 / (it + 1.0)) if is_rf else 1.0
+                vscore = vsum * scale + init
+                if k > 1:
+                    m = metric_fn(vscore, vy_d.astype(jnp.int32))
+                else:
+                    m = metric_fn(vscore[:, 0], vy_d)
+                out = out + (m,)
+            elif track_rank:
+                out = out + (vsum[:, 0],)
+            return (new_scores, vsum, rng), out
+
+        return jax.lax.scan(scan_step, carry, steps)
+
+    return jax.jit(scan, donate_argnums=0)
+
+
 def train(
     p: BoostParams,
     x: np.ndarray,
@@ -450,119 +612,12 @@ def train(
     yd = jnp.asarray(y)
     wd = jnp.asarray(weight, jnp.float32) if weight is not None else None
     group_ids = jnp.asarray(group, jnp.int32) if group is not None else None
+    is_rf = p.boosting_type == "rf"
 
     if k > 1:
-        y_onehot = jax.nn.one_hot(yd.astype(jnp.int32), k)
         scores = jnp.zeros((n, k), jnp.float32) + init
     else:
         scores = jnp.zeros(n, jnp.float32) + init
-
-    # -- jitted single-iteration step ----------------------------------
-    use_goss = p.boosting_type == "goss"
-    is_rf = p.boosting_type == "rf"
-    use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
-
-    feature_frac = p.feature_fraction
-
-    def compute_grad(scores, class_idx):
-        if k > 1:
-            g, h = obj_fn(scores, y_onehot, wd)
-            return g[:, class_idx], h[:, class_idx]
-        if is_rank:
-            g, h = obj.lambdarank_grad(scores, yd, group_ids,
-                                       max_dcg_pos=p.max_position)
-            if wd is not None:
-                g, h = g * wd, h * wd
-            return g, h
-        return obj_fn(scores, yd, wd)
-
-    def sample_mask_and_weights(grad, hess, key):
-        """bagging / GOSS row selection; returns (mask, grad, hess)."""
-        if use_goss:
-            a, b = p.top_rate, p.other_rate
-            n_top = max(1, int(a * n))
-            thresh = -jnp.sort(-jnp.abs(grad))[n_top - 1]
-            top = jnp.abs(grad) >= thresh
-            rand = jax.random.uniform(key, (n,)) < b
-            amp = (1.0 - a) / max(b, 1e-12)
-            small = (~top) & rand
-            mask = top | small
-            g = jnp.where(small, grad * amp, grad)
-            h = jnp.where(small, hess * amp, hess)
-            return mask, g, h
-        if use_bagging:
-            frac = p.bagging_fraction if not is_rf else (
-                p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632)
-            mask = jax.random.uniform(key, (n,)) < frac
-            return mask, grad, hess
-        return jnp.ones(n, jnp.bool_), grad, hess
-
-    def feature_mask(key):
-        if feature_frac >= 1.0:
-            return None
-        keep = max(1, int(round(feature_frac * f)))
-        perm = jax.random.permutation(key, f)
-        mask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
-        return mask
-
-    axis_name = None
-    renew_alpha = None
-    if k == 1 and p.objective in ("regression_l1", "l1", "mae"):
-        renew_alpha = 0.5
-    elif k == 1 and p.objective == "quantile":
-        renew_alpha = p.alpha
-
-    @jax.jit
-    def iteration(scores, key, class_idx):
-        base = jnp.full_like(scores, init) if is_rf else scores
-        g, h = compute_grad(base, class_idx)
-        k1, k2 = jax.random.split(key)
-        mask, g2, h2 = sample_mask_and_weights(g, h, k1)
-        fmask = feature_mask(k2)
-        gb = binned
-        if fmask is not None:
-            # masked-out features get the missing bin everywhere -> never split
-            gb = jnp.where(fmask[None, :], binned, bdev - 1)
-        tree, row_slot, slot_value, slot_node = build_tree(
-            gb, g2, h2, mask, thresholds, gp, axis_name)
-        if renew_alpha is not None:
-            # L1-family leaf renewal (LightGBM RenewTreeOutput): leaf output
-            # := alpha-quantile of residuals of the rows in the leaf.
-            residual = yd - scores
-
-            def leaf_quantile(slot):
-                r = jnp.where(row_slot == slot, residual, jnp.nan)
-                return jnp.nanquantile(r, renew_alpha)
-
-            renewed = jax.vmap(leaf_quantile)(jnp.arange(gp.num_leaves))
-            slot_value = jnp.where(jnp.isnan(renewed), slot_value, renewed)
-            # rebuild node-level leaf values from renewed slot values
-            m_nodes = tree.leaf_value.shape[0]
-            widx = jnp.where(slot_node >= 0, slot_node, m_nodes)
-            new_leaf = jnp.zeros(m_nodes, jnp.float32).at[widx].set(
-                slot_value, mode="drop")
-            tree = Tree(
-                split_feature=tree.split_feature, threshold=tree.threshold,
-                threshold_bin=tree.threshold_bin, left_child=tree.left_child,
-                right_child=tree.right_child, leaf_value=new_leaf,
-                cover=tree.cover, gain=tree.gain)
-        lr = 1.0 if is_rf else p.learning_rate
-        delta = lr * slot_value[row_slot]
-        if k > 1:
-            new_scores = scores.at[:, class_idx].add(delta)
-        else:
-            new_scores = scores + delta
-        scaled = Tree(
-            split_feature=tree.split_feature,
-            threshold=tree.threshold,
-            threshold_bin=tree.threshold_bin,
-            left_child=tree.left_child,
-            right_child=tree.right_child,
-            leaf_value=tree.leaf_value * lr,
-            cover=tree.cover,
-            gain=tree.gain,
-        )
-        return new_scores, scaled
 
     if p.boosting_type == "dart":
         if k > 1:
@@ -573,29 +628,88 @@ def train(
     # -- validation state ----------------------------------------------
     tracker = _ValidTracker(p, k, init, valid_sets)
 
-    trees: List[Tree] = []
-    rng = jax.random.PRNGKey(p.seed)
+    # -- device-resident boosting loop ---------------------------------
+    # The whole loop runs as lax.scan chunks: trees stream out as stacked
+    # arrays, validation margins accumulate in the carry, and the host sees
+    # one transfer per chunk — instead of a device->host round trip per tree,
+    # which dominates wall-clock when the chip sits behind a network tunnel.
+    # (TPU-native replacement for trainCore's per-iteration native calls,
+    # ref: lightgbm/.../TrainUtils.scala:92-159.)
+    track_dev = tracker.enabled and not tracker.is_rank_metric
+    track_rank = tracker.enabled and tracker.is_rank_metric
+    if tracker.enabled:
+        vg_h = tracker.sets[0][3]
+        vsum0 = tracker.sets[0][2]
+        vy_h = np.asarray(tracker.sets[0][1])
+    else:
+        vsum0 = jnp.zeros((0, k), jnp.float32)
 
-    for it in range(p.num_iterations):
-        for c in range(k):
-            rng, key = jax.random.split(rng)
-            scores, tree = iteration(scores, key, c)
-            tracker.add_tree(tree, c)
-            trees.append(jax.tree_util.tree_map(np.asarray, tree))
-        if tracker.step(it, is_rf):
-            break
+    consts = dict(
+        binned=binned, yd=yd, wd=wd, gids=group_ids, thr=thresholds,
+        init=jnp.float32(init),
+        vx=tracker.sets[0][0] if tracker.enabled else None,
+        vy=tracker.sets[0][1] if tracker.enabled else None)
+    # normalize cache-key fields the traced scan never reads (seed, iteration
+    # counts, binning/categorical config) so e.g. a 100-seed ensemble reuses
+    # one compiled trainer instead of compiling 100
+    key_p = dataclasses.replace(
+        p, seed=0, num_iterations=1, early_stopping_round=0, verbosity=-1,
+        categorical_features=(), metric=None, max_bin=0,
+        deterministic=True)
+    scan_fn = _make_scan_fn(
+        key_p, gp, k, tracker.enabled, track_dev, track_rank,
+        tracker.metric_name if tracker.enabled else None)
 
-    t_total = len(trees)
+    esr = p.early_stopping_round
+    total_iters = p.num_iterations
+    # without early stopping one scan covers the run; with it, chunk so an
+    # early exit wastes at most one chunk of device work
+    chunk = max(esr, 16) if (tracker.enabled and esr > 0) else total_iters
+    chunk = max(1, min(chunk, total_iters))
+
+    carry = (scores, vsum0, jax.random.PRNGKey(p.seed))
+    tree_chunks = []
+    stop_steps: Optional[int] = None
+    done_iters = 0
+    while done_iters < total_iters and stop_steps is None:
+        # every chunk is full-length (a shorter remainder would recompile the
+        # whole scan); surplus iterations past num_iterations are sliced off
+        steps = jnp.arange(done_iters * k, (done_iters + chunk) * k)
+        carry, ys = scan_fn(carry, steps, consts)
+        tree_chunks.append(jax.tree_util.tree_map(np.asarray, ys[0]))
+        n_it = min(chunk, total_iters - done_iters)
+        if track_dev:
+            per_iter = np.asarray(ys[1])[k - 1::k][:n_it]
+        elif track_rank:
+            vsnap = np.asarray(ys[1])  # [chunk, Nv]; k == 1 for ranking
+            per_iter = [
+                _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position)
+                for i in range(n_it)
+            ]
+        else:
+            per_iter = []
+        for i, m in enumerate(per_iter):
+            if tracker.record(float(m), done_iters + i):
+                stop_steps = (done_iters + i + 1) * k
+                break
+        done_iters += chunk
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.concatenate(xs, axis=0), *tree_chunks)
+    keep_steps = stop_steps if stop_steps is not None else total_iters * k
+    stacked = jax.tree_util.tree_map(lambda a: a[:keep_steps], stacked)
+
+    t_total = stacked.split_feature.shape[0]
     tree_weights = np.full(t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0,
                            np.float32)
     booster = Booster(
-        trees_feature=np.stack([t.split_feature for t in trees]),
-        trees_threshold=np.stack([t.threshold for t in trees]),
-        trees_left=np.stack([t.left_child for t in trees]),
-        trees_right=np.stack([t.right_child for t in trees]),
-        trees_value=np.stack([t.leaf_value for t in trees]),
-        trees_cover=np.stack([t.cover for t in trees]),
-        trees_gain=np.stack([t.gain for t in trees]),
+        trees_feature=stacked.split_feature,
+        trees_threshold=stacked.threshold,
+        trees_left=stacked.left_child,
+        trees_right=stacked.right_child,
+        trees_value=stacked.leaf_value,
+        trees_cover=stacked.cover,
+        trees_gain=stacked.gain,
         tree_weights=tree_weights,
         params=p,
         init_score=init,

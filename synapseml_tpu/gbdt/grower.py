@@ -57,14 +57,33 @@ class Tree:
 
 
 def histogram(binned, grad, hess, mask, n_bins: int, axis_name: Optional[str] = None):
-    """[F, B, 3] histogram of (grad, hess, count) via one segment_sum."""
+    """[F, B, 3] histogram of (grad, hess, count) as a one-hot contraction.
+
+    MXU-native formulation: the bin one-hot is fused by XLA into the dot's
+    operand (never materialized in HBM), so a histogram costs one pass over
+    the [N, F] uint8 matrix — versus a serialized scatter-add for the
+    equivalent ``segment_sum``, which measured ~100x slower per tree on
+    a v5e chip.
+    """
     n, f = binned.shape
-    ids = binned.astype(jnp.int32) + jnp.arange(f, dtype=jnp.int32)[None, :] * n_bins
-    w = mask.astype(grad.dtype)
+    w = mask.astype(jnp.float32)
     data = jnp.stack([grad * w, hess * w, w], axis=-1)          # [N, 3]
-    data = jnp.broadcast_to(data[:, None, :], (n, f, 3)).reshape(n * f, 3)
-    seg = jax.ops.segment_sum(data, ids.reshape(-1), num_segments=f * n_bins)
-    hist = seg.reshape(f, n_bins, 3)
+    if jax.default_backend() == "tpu":
+        oh = jax.nn.one_hot(binned.astype(jnp.int32), n_bins,
+                            dtype=jnp.float32)
+        # HIGHEST: default MXU precision would truncate grad/hess to bf16
+        # inside the dot and perturb split decisions vs the CPU path
+        hist = jnp.einsum("nfb,nc->fbc", oh, data,
+                          preferred_element_type=jnp.float32,
+                          precision=lax.Precision.HIGHEST)
+    else:
+        # CPU/GPU: scatter-add beats materializing the one-hot
+        ids = (binned.astype(jnp.int32)
+               + jnp.arange(f, dtype=jnp.int32)[None, :] * n_bins)
+        flat = jnp.broadcast_to(data[:, None, :], (n, f, 3)).reshape(n * f, 3)
+        hist = jax.ops.segment_sum(
+            flat, ids.reshape(-1), num_segments=f * n_bins
+        ).reshape(f, n_bins, 3)
     if axis_name is not None:
         hist = lax.psum(hist, axis_name)
     return hist
@@ -138,6 +157,23 @@ def build_tree(
         node_gain=jnp.zeros(M, jnp.float32),
     )
 
+    # Every per-slot state update below is a one-hot select, not a scatter:
+    # single-element scatters inside the loop each cost fixed device latency
+    # (~1ms of pure overhead per split on a remote chip), while a masked
+    # vector select is one fused VPU pass.
+    arL = jnp.arange(L)
+    arM = jnp.arange(M)
+    binned_T = binned.T  # [F, N]: traced-feature column reads as dynamic slices
+
+    def _putL(arr, slot, val):
+        """arr[slot] = val for [L]-indexed state; slot == L drops the write."""
+        sel = arL == slot
+        return jnp.where(sel, val, arr)
+
+    def _putM(arr, idx, val):
+        sel = arM == idx
+        return jnp.where(sel, val, arr)
+
     def split_step(s, st):
         leaf = jnp.argmax(st["best_gain"]).astype(jnp.int32)
         gain = st["best_gain"][leaf]
@@ -149,16 +185,17 @@ def build_tree(
         left_id = 2 * s - 1
         right_id = 2 * s
 
-        # record the internal node (drop writes when not splitting)
-        widx = jnp.where(do, parent, M)  # M = out-of-range -> dropped
-        st["node_feature"] = st["node_feature"].at[widx].set(feat, mode="drop")
-        st["node_bin"] = st["node_bin"].at[widx].set(thr_bin, mode="drop")
-        st["node_left"] = st["node_left"].at[widx].set(left_id, mode="drop")
-        st["node_right"] = st["node_right"].at[widx].set(right_id, mode="drop")
-        st["node_gain"] = st["node_gain"].at[widx].set(gain, mode="drop")
+        # record the internal node (index M = out-of-range -> dropped)
+        widx = jnp.where(do, parent, M)
+        st["node_feature"] = _putM(st["node_feature"], widx, feat)
+        st["node_bin"] = _putM(st["node_bin"], widx, thr_bin)
+        st["node_left"] = _putM(st["node_left"], widx, left_id)
+        st["node_right"] = _putM(st["node_right"], widx, right_id)
+        st["node_gain"] = _putM(st["node_gain"], widx, gain)
 
         # partition rows of the split leaf
-        col = jnp.take(binned, feat, axis=1).astype(jnp.int32)
+        col = lax.dynamic_index_in_dim(
+            binned_T, feat, axis=0, keepdims=False).astype(jnp.int32)
         in_leaf = st["row_slot"] == leaf
         go_right = in_leaf & (col > thr_bin)
         st["row_slot"] = jnp.where(do & go_right, s, st["row_slot"])
@@ -174,33 +211,35 @@ def build_tree(
 
         lslot = jnp.where(do, leaf, L)   # dropped when no split
         rslot = jnp.where(do, s, L)
-        st["hist"] = st["hist"].at[lslot].set(hist_l, mode="drop")
-        st["hist"] = st["hist"].at[rslot].set(hist_r, mode="drop")
-        st["totals"] = st["totals"].at[lslot].set(tot_l, mode="drop")
-        st["totals"] = st["totals"].at[rslot].set(tot_r, mode="drop")
+        lsel = (arL == lslot)[:, None, None, None]
+        rsel = (arL == rslot)[:, None, None, None]
+        st["hist"] = jnp.where(lsel, hist_l[None], st["hist"])
+        st["hist"] = jnp.where(rsel, hist_r[None], st["hist"])
+        st["totals"] = jnp.where((arL == lslot)[:, None], tot_l[None],
+                                 st["totals"])
+        st["totals"] = jnp.where((arL == rslot)[:, None], tot_r[None],
+                                 st["totals"])
 
         new_depth = st["slot_depth"][leaf] + 1
-        st["slot_depth"] = st["slot_depth"].at[lslot].set(new_depth, mode="drop")
-        st["slot_depth"] = st["slot_depth"].at[rslot].set(new_depth, mode="drop")
-        st["slot_node"] = st["slot_node"].at[lslot].set(left_id, mode="drop")
-        st["slot_node"] = st["slot_node"].at[rslot].set(right_id, mode="drop")
+        st["slot_depth"] = _putL(st["slot_depth"], lslot, new_depth)
+        st["slot_depth"] = _putL(st["slot_depth"], rslot, new_depth)
+        st["slot_node"] = _putL(st["slot_node"], lslot, left_id)
+        st["slot_node"] = _putL(st["slot_node"], rslot, right_id)
         lnode = jnp.where(do, left_id, M)
         rnode = jnp.where(do, right_id, M)
-        st["node_cover"] = st["node_cover"].at[lnode].set(tot_l[2], mode="drop")
-        st["node_cover"] = st["node_cover"].at[rnode].set(tot_r[2], mode="drop")
+        st["node_cover"] = _putM(st["node_cover"], lnode, tot_l[2])
+        st["node_cover"] = _putM(st["node_cover"], rnode, tot_r[2])
 
         depth_ok = True if p.max_depth <= 0 else (new_depth < p.max_depth)
         gl, fl, bl = best_split(hist_l, tot_l, p, depth_ok)
         gr, fr, br = best_split(hist_r, tot_r, p, depth_ok)
         neg = jnp.float32(-jnp.inf)
-        st["best_gain"] = st["best_gain"].at[lslot].set(
-            jnp.where(do, gl, neg), mode="drop")
-        st["best_gain"] = st["best_gain"].at[rslot].set(
-            jnp.where(do, gr, neg), mode="drop")
-        st["best_feat"] = st["best_feat"].at[lslot].set(fl, mode="drop")
-        st["best_feat"] = st["best_feat"].at[rslot].set(fr, mode="drop")
-        st["best_bin"] = st["best_bin"].at[lslot].set(bl, mode="drop")
-        st["best_bin"] = st["best_bin"].at[rslot].set(br, mode="drop")
+        st["best_gain"] = _putL(st["best_gain"], lslot, jnp.where(do, gl, neg))
+        st["best_gain"] = _putL(st["best_gain"], rslot, jnp.where(do, gr, neg))
+        st["best_feat"] = _putL(st["best_feat"], lslot, fl)
+        st["best_feat"] = _putL(st["best_feat"], rslot, fr)
+        st["best_bin"] = _putL(st["best_bin"], lslot, bl)
+        st["best_bin"] = _putL(st["best_bin"], rslot, br)
         return st
 
     state = lax.fori_loop(1, L, split_step, state)
@@ -211,10 +250,10 @@ def build_tree(
     slot_value = -_l1_threshold(g, p.lambda_l1) / (h + p.lambda_l2 + 1e-12)
     slot_value = jnp.where(state["slot_node"] >= 0, slot_value, 0.0)
 
-    # scatter leaf values into node table
-    leaf_value = jnp.zeros(M, jnp.float32)
-    widx = jnp.where(state["slot_node"] >= 0, state["slot_node"], M)
-    leaf_value = leaf_value.at[widx].set(slot_value, mode="drop")
+    # place leaf values into the node table (one-hot contraction, no scatter)
+    sel = ((state["slot_node"][:, None] == arM)
+           & (state["slot_node"] >= 0)[:, None])
+    leaf_value = jnp.sum(sel * slot_value[:, None], axis=0)
 
     # raw-value thresholds for prediction on unbinned features
     thr = threshold_values[state["node_feature"].clip(0), state["node_bin"]]

@@ -249,9 +249,15 @@ def _gemm(ctx, a, b, c=None):
         a = a.T
     if ctx.attr("transB", 0):
         b = b.T
-    y = alpha * jnp.matmul(a, b)
+    y = jnp.matmul(a, b)
+    dt = y.dtype
+    # dtype-pinned scalars: a bare python float would make numpy promote
+    # host-side bf16 weights to f32 and poison the whole tail of the graph
+    if alpha != 1.0:
+        y = y * np.asarray(alpha, dtype=dt)
     if c is not None:
-        y = y + beta * c
+        cc = np.asarray(c, dtype=dt) if isinstance(c, np.ndarray) else c.astype(dt)
+        y = y + (np.asarray(beta, dtype=dt) * cc if beta != 1.0 else cc)
     return y
 
 
@@ -435,8 +441,15 @@ def _lrn(ctx, x):
 def _batch_norm(ctx, x, scale, b, mean, var):
     eps = ctx.attr("epsilon", 1e-5)
     shape = (1, -1) + (1,) * (x.ndim - 2)
-    inv = lax.rsqrt(var + eps)
-    return (x - mean.reshape(shape)) * (inv * scale).reshape(shape) + b.reshape(shape)
+    # fold running stats into one multiply-add, computed in f32 then cast to
+    # the activation dtype — keeps bf16 graphs bf16 (numpy would promote the
+    # host-side `var + eps` to f32) and hands XLA a fuse-friendly affine op
+    dt = x.dtype
+    f32 = jnp.float32
+    inv = lax.rsqrt(var.astype(f32) + eps)
+    w = (inv * scale.astype(f32)).astype(dt)
+    bias = (b.astype(f32) - mean.astype(f32) * inv * scale.astype(f32)).astype(dt)
+    return x * w.reshape(shape) + bias.reshape(shape)
 
 
 @op("InstanceNormalization")
