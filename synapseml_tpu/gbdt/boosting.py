@@ -366,11 +366,19 @@ class Booster:
         )
 
     def save_string(self) -> str:
-        return json.dumps(self.to_dict())
+        """Serialize in LightGBM's native text model format (interoperable
+        with lightgbm-python / SHAP tooling; ref LightGBMBooster.scala:454)."""
+        from synapseml_tpu.gbdt.lgbm_format import booster_to_native_string
+        return booster_to_native_string(self)
 
     @staticmethod
     def load_string(s: str) -> "Booster":
-        return Booster.from_dict(json.loads(s))
+        """Parse either the native LightGBM text format or the legacy
+        (round-1) JSON format, auto-detected."""
+        if s.lstrip().startswith("{"):
+            return Booster.from_dict(json.loads(s))
+        from synapseml_tpu.gbdt.lgbm_format import booster_from_native_string
+        return booster_from_native_string(s)
 
 
 @partial(jax.jit, static_argnums=(3, 4))
@@ -665,6 +673,11 @@ def train(
     # without early stopping one scan covers the run; with it, chunk so an
     # early exit wastes at most one chunk of device work
     chunk = max(esr, 16) if (tracker.enabled and esr > 0) else total_iters
+    if track_rank:
+        # the rank path stacks a [chunk, Nv] margin snapshot on device;
+        # bound it to ~16 MB so huge valid sets cannot OOM the chip
+        nv = max(1, int(vsum0.shape[0]))
+        chunk = min(chunk, max(1, 4_000_000 // nv))
     chunk = max(1, min(chunk, total_iters))
 
     carry = (scores, vsum0, jax.random.PRNGKey(p.seed))

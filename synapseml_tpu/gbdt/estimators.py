@@ -135,16 +135,28 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         return tree_shap(self.booster, self._features(table))
 
     def save_native_model(self, path: str):
+        """Write the booster in LightGBM's native text format
+        (ref: LightGBMBooster.scala:454 saveNativeModel)."""
         with open(path, "w") as f:
             f.write(self.booster.save_string())
 
-    # serde: booster goes to a side file
+    @classmethod
+    def load_native_model(cls, path: str, **kw):
+        """Load a native LightGBM text model file into a fitted model
+        (ref: LightGBMClassifier.scala loadNativeModelFromFile)."""
+        with open(path) as f:
+            return cls(booster=Booster.load_string(f.read()), **kw)
+
+    # serde: booster goes to a side file (native LightGBM text format)
     def _save_extra(self, path: str):
-        with open(os.path.join(path, "booster.json"), "w") as f:
+        with open(os.path.join(path, "booster.txt"), "w") as f:
             f.write(self.booster.save_string())
 
     def _load_extra(self, path: str):
-        with open(os.path.join(path, "booster.json")) as f:
+        p = os.path.join(path, "booster.txt")
+        if not os.path.exists(p):  # round-1 artifacts
+            p = os.path.join(path, "booster.json")
+        with open(p) as f:
             self.booster = Booster.load_string(f.read())
 
 

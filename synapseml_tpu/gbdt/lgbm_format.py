@@ -1,0 +1,421 @@
+"""LightGBM native model-string serde (text format, both directions).
+
+The reference saves/loads boosters in lib_lightgbm's text format via
+``saveNativeModel``/``loadNativeModelFromFile``
+(ref: lightgbm/src/main/scala/com/microsoft/ml/spark/lightgbm/booster/LightGBMBooster.scala:454-480,
+LightGBMClassifier.scala loadNativeModel). This module speaks the same
+format — ``tree\nversion=v3`` header, per-tree ``Tree=i`` blocks with
+``split_feature``/``threshold``/``decision_type``/``left_child``/... arrays,
+``feature_importances:`` and ``parameters:`` sections — so models trained
+here run under lightgbm-python/SHAP tooling and vice versa.
+
+Conventions bridged:
+- LightGBM child pointers: ``c >= 0`` -> internal node ``c``; ``c < 0`` ->
+  leaf ``~c``. Our Booster keeps one flat node table per tree (leaves are
+  rows with ``split_feature == -1``); the walk below converts both ways.
+- The training-time init score is folded into the first tree of each class
+  on save (exactly what lib_lightgbm's boost_from_average does before
+  serializing), and tree weights (dart/rf) are folded into leaf values, so
+  ``sum of trees`` reproduces our predictions with no side channel.
+- decision_type: we emit ``8`` (numerical split, missing=NaN goes right,
+  matching our training semantics). Categorical splits (bit 0) are rejected
+  on load; ``default_left`` models load but NaN feature values would take
+  the right branch here.
+"""
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from synapseml_tpu.gbdt.boosting import Booster, BoostParams
+
+
+def _objective_string(p: BoostParams, k: int) -> str:
+    o = p.objective
+    if o in ("binary", "binary_logloss"):
+        return f"binary sigmoid:{p.sigmoid:g}"
+    if o in ("multiclass", "softmax"):
+        return f"multiclass num_class:{k}"
+    if o == "multiclassova":
+        return f"multiclassova num_class:{k} sigmoid:{p.sigmoid:g}"
+    if o in ("lambdarank", "rank_xendcg"):
+        return o
+    if o == "quantile":
+        return f"quantile alpha:{p.alpha:g}"
+    if o == "huber":
+        return f"huber alpha:{p.alpha:g}"
+    if o == "tweedie":
+        return f"tweedie tweedie_variance_power:{p.tweedie_variance_power:g}"
+    if o in ("regression_l1", "l1", "mae"):
+        return "regression_l1"
+    if o == "poisson":
+        return "poisson"
+    return "regression"
+
+
+def _parse_objective(s: str) -> Dict[str, object]:
+    toks = s.split()
+    if not toks:
+        return {}
+    out: Dict[str, object] = {"objective": toks[0]}
+    for t in toks[1:]:
+        if ":" not in t:
+            continue
+        key, val = t.split(":", 1)
+        if key == "sigmoid":
+            out["sigmoid"] = float(val)
+        elif key == "num_class":
+            out["num_class"] = int(val)
+        elif key == "alpha":
+            out["alpha"] = float(val)
+        elif key == "tweedie_variance_power":
+            out["tweedie_variance_power"] = float(val)
+    return out
+
+
+def _walk_tree(feat, left, right) -> Tuple[List[int], List[int]]:
+    """Preorder (internal_nodes, leaf_nodes) as node-table indices."""
+    internals: List[int] = []
+    leaves: List[int] = []
+    stack = [0]
+    while stack:
+        nid = stack.pop()
+        if feat[nid] < 0:
+            leaves.append(nid)
+        else:
+            internals.append(nid)
+            # preorder with left first
+            stack.append(right[nid])
+            stack.append(left[nid])
+    return internals, leaves
+
+
+def _fmt(vals, spec="{:.17g}") -> str:
+    return " ".join(spec.format(v) for v in vals)
+
+
+def booster_to_native_string(b: Booster) -> str:
+    k = b.num_class
+    t_total = b.num_trees
+    if b.best_iteration >= 0:
+        # lib_lightgbm's saveNativeModel truncates to the early-stopping
+        # best iteration; match it so external scorers see the same model
+        t_total = min(t_total, (b.best_iteration + 1) * k)
+    f = b.num_features if b.num_features > 0 else (
+        int(b.trees_feature.max()) + 1 if t_total else 1)
+    names = b.feature_names or [f"Column_{i}" for i in range(f)]
+
+    # feature_infos: numerical [min:max] ranges; reconstruct a loose range
+    # from the thresholds actually used so lightgbm's loader accepts it
+    lo = np.full(f, np.inf)
+    hi = np.full(f, -np.inf)
+    internal_mask = b.trees_feature >= 0
+    for fi, th in zip(b.trees_feature[internal_mask],
+                      b.trees_threshold[internal_mask]):
+        lo[fi] = min(lo[fi], th)
+        hi[fi] = max(hi[fi], th)
+    infos = []
+    for i in range(f):
+        if np.isfinite(lo[i]):
+            infos.append(f"[{lo[i] - 1:.17g}:{hi[i] + 1:.17g}]")
+        else:
+            infos.append("none")
+
+    tree_blocks: List[str] = []
+    for ti in range(t_total):
+        feat = b.trees_feature[ti]
+        thr = b.trees_threshold[ti]
+        left = b.trees_left[ti]
+        right = b.trees_right[ti]
+        cover = b.trees_cover[ti]
+        gain = b.trees_gain[ti]
+        is_rf = b.params.boosting_type == "rf"
+        # fold per-tree weights (dart) into leaf values so sum-of-trees is
+        # the prediction; rf leaf values stay raw — the reader re-derives
+        # the 1/T averaging from [boosting: rf] in the parameters section
+        value = b.trees_value[ti].astype(np.float64) * (
+            1.0 if is_rf else float(b.tree_weights[ti]))
+        if is_rf:
+            # averaging preserves a constant added to every tree
+            value = value + float(b.init_score)
+        elif ti < k:
+            # fold the init score into the first tree of each class (what
+            # lib_lightgbm's boost_from_average does before saving)
+            value = value + float(b.init_score)
+
+        internals, leaves = _walk_tree(feat, left, right)
+        n_leaves = len(leaves)
+        iidx = {nid: i for i, nid in enumerate(internals)}
+        lidx = {nid: i for i, nid in enumerate(leaves)}
+
+        def child_ref(c):
+            return iidx[c] if feat[c] >= 0 else -(lidx[c] + 1)
+
+        lines = [f"Tree={ti}", f"num_leaves={n_leaves}", "num_cat=0"]
+        if internals:
+            lines += [
+                "split_feature=" + _fmt((feat[n] for n in internals), "{:d}"),
+                "split_gain=" + _fmt((max(float(gain[n]), 0.0) for n in internals)),
+                "threshold=" + _fmt((float(thr[n]) for n in internals)),
+                "decision_type=" + _fmt((8 for _ in internals), "{:d}"),
+                "left_child=" + _fmt((child_ref(left[n]) for n in internals), "{:d}"),
+                "right_child=" + _fmt((child_ref(right[n]) for n in internals), "{:d}"),
+            ]
+        else:
+            lines += ["split_feature=", "split_gain=", "threshold=",
+                      "decision_type=", "left_child=", "right_child="]
+        lines += [
+            "leaf_value=" + _fmt((float(value[n]) for n in leaves)),
+            "leaf_weight=" + _fmt((float(cover[n]) for n in leaves)),
+            "leaf_count=" + _fmt((int(cover[n]) for n in leaves), "{:d}"),
+            "internal_value=" + _fmt((0.0 for _ in internals)),
+            "internal_weight=" + _fmt((float(cover[n]) for n in internals)),
+            "internal_count=" + _fmt((int(cover[n]) for n in internals), "{:d}"),
+            "is_linear=0",
+            f"shrinkage={b.params.learning_rate:g}",
+        ]
+        tree_blocks.append("\n".join(lines) + "\n")
+
+    header = [
+        "tree",
+        "version=v3",
+        f"num_class={k}",
+        f"num_tree_per_iteration={k}",
+        "label_index=0",
+        f"max_feature_idx={f - 1}",
+        f"objective={_objective_string(b.params, k)}",
+    ]
+    if b.params.boosting_type == "rf":
+        # the literal token LightGBM's loader keys average_output_ on;
+        # without it external scorers would sum instead of average
+        header.append("average_output")
+    header += [
+        "feature_names=" + " ".join(names),
+        "feature_infos=" + " ".join(infos),
+        "tree_sizes=" + " ".join(str(len(tb) + 1) for tb in tree_blocks),
+        "",
+    ]
+
+    imp = b.feature_importance_split
+    if imp is None:
+        imp = np.zeros(f)
+    order = np.argsort(-np.asarray(imp), kind="stable")
+    imp_lines = [f"{names[i]}={int(imp[i])}" for i in order if imp[i] > 0]
+
+    param_lines = ["parameters:"]
+    # non-standard but ignored by other parsers: keeps early-stopping
+    # truncation alive across a native round trip
+    if b.best_iteration >= 0:
+        param_lines.append(f"[best_iteration: {b.best_iteration}]")
+    for fld in dataclasses.fields(b.params):
+        v = getattr(b.params, fld.name)
+        if fld.name == "boosting_type":
+            param_lines.append(f"[boosting: {v}]")
+            continue
+        if fld.name == "categorical_features":
+            v = ",".join(str(i) for i in v)
+        elif fld.name == "metric":
+            v = "" if v is None else v
+        param_lines.append(f"[{fld.name}: {v}]")
+    param_lines.append("end of parameters")
+
+    # blocks end with "\n", so joining on "\n" leaves a blank line between
+    body = "\n".join(tree_blocks)
+    return ("\n".join(header) + "\n"
+            + body + "\n"
+            + "end of trees\n\n"
+            + "feature_importances:\n"
+            + ("\n".join(imp_lines) + "\n" if imp_lines else "")
+            + "\n" + "\n".join(param_lines) + "\n\n"
+            + "pandas_categorical:null\n")
+
+
+_BOOL_FIELDS = {"boost_from_average", "deterministic"}
+
+
+def _parse_params_section(lines: List[str]) -> Dict[str, object]:
+    fields = {f.name: f for f in dataclasses.fields(BoostParams)}
+    out: Dict[str, object] = {}
+    for ln in lines:
+        ln = ln.strip()
+        if not (ln.startswith("[") and ln.endswith("]") and ":" in ln):
+            continue
+        key, val = ln[1:-1].split(":", 1)
+        key, val = key.strip(), val.strip()
+        if key == "boosting":
+            key = "boosting_type"
+        if key not in fields:
+            continue
+        ftype = fields[key].type
+        try:
+            if key == "categorical_features":
+                out[key] = tuple(int(x) for x in val.split(",") if x != "")
+            elif key == "metric":
+                out[key] = val or None
+            elif key in _BOOL_FIELDS:
+                out[key] = val.lower() in ("true", "1")
+            elif "int" in str(ftype):
+                out[key] = int(float(val))
+            elif "float" in str(ftype):
+                out[key] = float(val)
+            else:
+                out[key] = val
+        except ValueError:
+            continue
+    return out
+
+
+def booster_from_native_string(s: str) -> Booster:
+    lines = s.splitlines()
+    header: Dict[str, str] = {}
+    i = 0
+    while i < len(lines):
+        ln = lines[i].strip()
+        if ln.startswith("Tree="):
+            break
+        if ln == "average_output":
+            header["average_output"] = "1"
+        elif "=" in ln:
+            key, val = ln.split("=", 1)
+            header[key] = val
+        i += 1
+
+    k = int(header.get("num_class", "1"))
+    max_feat = int(header.get("max_feature_idx", "0"))
+    feature_names = header.get("feature_names", "").split() or None
+    obj_info = _parse_objective(header.get("objective", "regression"))
+
+    # split tree blocks
+    blocks: List[Dict[str, str]] = []
+    cur: Optional[Dict[str, str]] = None
+    param_lines: List[str] = []
+    in_params = False
+    best_iteration = -1
+    for ln in lines[i:]:
+        sln = ln.strip()
+        if sln.startswith("Tree="):
+            cur = {}
+            blocks.append(cur)
+            continue
+        if sln == "end of trees":
+            cur = None
+            continue
+        if sln == "parameters:":
+            in_params = True
+            continue
+        if sln == "end of parameters":
+            in_params = False
+            continue
+        if in_params:
+            if sln.startswith("[best_iteration:"):
+                best_iteration = int(sln[1:-1].split(":", 1)[1])
+            param_lines.append(sln)
+            continue
+        if cur is not None and "=" in sln:
+            key, val = sln.split("=", 1)
+            cur[key] = val
+
+    def ints(s_):
+        return np.array([int(x) for x in s_.split()], np.int32) \
+            if s_.strip() else np.zeros(0, np.int32)
+
+    def floats(s_):
+        return np.array([float(x) for x in s_.split()], np.float64) \
+            if s_.strip() else np.zeros(0, np.float64)
+
+    parsed = []
+    max_leaves = 1
+    for tb in blocks:
+        nl = int(tb.get("num_leaves", "1"))
+        if int(tb.get("num_cat", "0") or 0) > 0:
+            raise NotImplementedError(
+                "categorical splits in native LightGBM models are not "
+                "supported yet")
+        dt = ints(tb.get("decision_type", ""))
+        if np.any(dt & 1):
+            raise NotImplementedError(
+                "categorical decision_type bit set in native model")
+        missing_type = (dt >> 2) & 3
+        if np.any(missing_type == 1):
+            raise NotImplementedError(
+                "zero-as-missing splits (missing_type=Zero) cannot be "
+                "represented by this predictor; retrain without "
+                "zero_as_missing or use missing_type NaN/None")
+        if np.any((missing_type == 2) & ((dt >> 1) & 1 == 1)):
+            warnings.warn(
+                "model uses default_left with NaN missing values; this "
+                "predictor routes NaN to the right child, so predictions "
+                "differ from lib_lightgbm only on rows containing NaN",
+                RuntimeWarning, stacklevel=2)
+        parsed.append(dict(
+            nl=nl,
+            sf=ints(tb.get("split_feature", "")),
+            gain=floats(tb.get("split_gain", "")),
+            thr=floats(tb.get("threshold", "")),
+            lc=ints(tb.get("left_child", "")),
+            rc=ints(tb.get("right_child", "")),
+            lv=floats(tb.get("leaf_value", "")),
+            lcount=floats(tb.get("leaf_count", "")),
+            icount=floats(tb.get("internal_count", "")),
+        ))
+        max_leaves = max(max_leaves, nl)
+
+    t_total = len(parsed)
+    m = 2 * max_leaves - 1
+    tf = np.full((t_total, m), -1, np.int32)
+    tt = np.zeros((t_total, m), np.float32)
+    tl = np.zeros((t_total, m), np.int32)
+    tr = np.zeros((t_total, m), np.int32)
+    tv = np.zeros((t_total, m), np.float32)
+    tc = np.zeros((t_total, m), np.float32)
+    tg = np.zeros((t_total, m), np.float32)
+
+    for ti, tb in enumerate(parsed):
+        nl = tb["nl"]
+        ni = nl - 1  # internal count
+        # table layout: internal i -> i, leaf j -> ni + j (root stays 0;
+        # single-leaf trees have the leaf at slot 0)
+        for j in range(ni):
+            tf[ti, j] = tb["sf"][j]
+            tt[ti, j] = tb["thr"][j]
+            tg[ti, j] = tb["gain"][j] if j < len(tb["gain"]) else 0.0
+            if j < len(tb["icount"]):
+                tc[ti, j] = tb["icount"][j]
+            lc, rc = tb["lc"][j], tb["rc"][j]
+            tl[ti, j] = lc if lc >= 0 else ni + (-lc - 1)
+            tr[ti, j] = rc if rc >= 0 else ni + (-rc - 1)
+        for j in range(nl):
+            slot = ni + j if ni else 0
+            tv[ti, slot] = tb["lv"][j] if j < len(tb["lv"]) else 0.0
+            if j < len(tb["lcount"]):
+                tc[ti, slot] = tb["lcount"][j]
+
+    pkw = _parse_params_section(param_lines)
+    pkw.update(obj_info)
+    if header.get("average_output") and "boosting_type" not in pkw:
+        pkw["boosting_type"] = "rf"  # files written by other emitters may
+        # carry only the header token, not a parameters section
+    pkw.setdefault("num_class", k)
+    if t_total and k:
+        pkw["num_iterations"] = t_total // k
+    known = {f.name for f in dataclasses.fields(BoostParams)}
+    params = BoostParams(**{kk: vv for kk, vv in pkw.items() if kk in known})
+
+    booster = Booster(
+        trees_feature=tf, trees_threshold=tt, trees_left=tl, trees_right=tr,
+        trees_value=tv, trees_cover=tc, trees_gain=tg,
+        tree_weights=np.ones(t_total, np.float32),
+        params=params,
+        init_score=0.0,  # folded into the first trees by the writer
+        num_class=k,
+        best_iteration=best_iteration,
+        num_features=max_feat + 1,
+        feature_names=feature_names,
+    )
+    from synapseml_tpu.gbdt.boosting import _importances
+    booster.feature_importance_split, booster.feature_importance_gain = (
+        _importances(booster, max_feat + 1))
+    return booster

@@ -40,7 +40,10 @@ def test_classifier_estimator_table_api(cancer, tmp_path):
     model.save(p)
     model2 = PipelineStage.load(p)
     out2 = model2.transform(Table({"features": Xv, "label": yv}))
-    np.testing.assert_allclose(out2["probability"], out["probability"], rtol=1e-6)
+    # booster now round-trips through the native LightGBM text format, which
+    # folds init_score into tree-0 leaves: one f32 rounding step (~1e-7)
+    np.testing.assert_allclose(out2["probability"], out["probability"],
+                               rtol=1e-5, atol=1e-7)
 
 
 def test_multiclass(cancer):
