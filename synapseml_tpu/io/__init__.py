@@ -1,0 +1,31 @@
+"""IO layer: HTTP-on-tables, serving, PowerBI, binary/image readers.
+
+TPU-native rebuild of the reference's L5 serving & IO layer (SURVEY.md §2.3):
+HTTP schema/clients/transformers (``io.http``), per-shard serving servers
+with reply routing + replay (``io.serving``), PowerBI writer
+(``io.powerbi``), binary file format (``io.binary``).
+"""
+from synapseml_tpu.io.http import (  # noqa: F401
+    AsyncHTTPClient,
+    CustomInputParser,
+    CustomOutputParser,
+    HandlingUtils,
+    HTTPRequestData,
+    HTTPResponseData,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    SimpleHTTPTransformer,
+    SingleThreadedHTTPClient,
+    StringOutputParser,
+    string_to_request,
+)
+from synapseml_tpu.io.serving import (  # noqa: F401
+    ContinuousServer,
+    HTTPSourceStateHolder,
+    WorkerServer,
+    make_reply,
+    parse_request,
+    requests_to_table,
+    send_replies,
+)

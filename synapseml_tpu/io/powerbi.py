@@ -1,0 +1,49 @@
+"""PowerBI streaming-dataset writer.
+
+Rebuild of the reference's PowerBI writer
+(ref: core/src/main/scala/com/microsoft/ml/spark/io/powerbi/PowerBIWriter.scala:17-114):
+rows are grouped into JSON-array batches and POSTed to the dataset push URL
+with the retrying client; batch + "streaming" (table-at-once) modes.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.http import (HandlingUtils, HTTPRequestData,
+                                   SingleThreadedHTTPClient)
+
+
+from synapseml_tpu.core.param import _json_default
+
+
+def _row_jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
+    return row  # numpy values handled by json.dumps(default=_json_default)
+
+
+def write_to_powerbi(table: Table, url: str, batch_size: int = 100,
+                     backoffs_ms=(100, 500, 1000, 5000),
+                     client: Optional[SingleThreadedHTTPClient] = None
+                     ) -> List[int]:
+    """POST the table to a PowerBI push URL in row batches; returns the
+    status code per batch. Raises on any non-2xx after retries (the
+    reference surfaces failures through the stream, :96-114)."""
+    client = client or SingleThreadedHTTPClient(
+        HandlingUtils.advanced(*backoffs_ms))
+    statuses: List[int] = []
+    rows = [_row_jsonable(r) for r in table.rows()]
+    for start in range(0, len(rows), batch_size):
+        body = json.dumps(rows[start:start + batch_size],
+                          default=_json_default).encode("utf-8")
+        resp = client.send(HTTPRequestData(
+            url=url, method="POST",
+            headers={"Content-Type": "application/json"}, entity=body))
+        statuses.append(resp.status_code)
+        if not 200 <= resp.status_code < 300:
+            raise RuntimeError(
+                f"PowerBI POST failed with {resp.status_code}: "
+                f"{resp.text[:500]}")
+    return statuses

@@ -1,0 +1,58 @@
+"""Fault-tolerance utilities.
+
+Rebuild of the reference's FaultToleranceUtils + the exponential-backoff
+retry pattern used around native/network init
+(ref: core/src/main/scala/com/microsoft/ml/spark/core/utils/FaultToleranceUtils.scala:1-33,
+lightgbm/.../TrainUtils.scala:279-295 networkInit backoff retries).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+logger = logging.getLogger("synapseml_tpu")
+
+
+def retry_with_timeout(fn: Callable[[], T], timeout_s: float,
+                       max_retries: int = 3) -> T:
+    """Run ``fn`` with a wall-clock timeout, retrying on failure/timeout
+    (ref: FaultToleranceUtils.retryWithTimeout:1-33). The attempt runs in a
+    worker thread; on timeout the attempt is abandoned and retried."""
+    last: Optional[BaseException] = None
+    for attempt in range(max_retries):
+        # no `with`: __exit__ would wait for a hung attempt, defeating the
+        # timeout. shutdown(wait=False) genuinely abandons the thread.
+        pool = concurrent.futures.ThreadPoolExecutor(1)
+        fut = pool.submit(fn)
+        try:
+            return fut.result(timeout=timeout_s)
+        except concurrent.futures.TimeoutError:
+            last = TimeoutError(
+                f"attempt {attempt + 1} timed out after {timeout_s}s")
+        except Exception as e:  # noqa: BLE001 - mirror reference catch-all
+            last = e
+        finally:
+            pool.shutdown(wait=False)
+        logger.warning("retry_with_timeout attempt %d failed: %s",
+                       attempt + 1, last)
+    raise last  # type: ignore[misc]
+
+
+def retry_with_backoff(fn: Callable[[], T],
+                       backoffs_ms: Tuple[int, ...] = (100, 500, 1000, 5000),
+                       retryable: Tuple[Type[BaseException], ...] = (Exception,)
+                       ) -> T:
+    """Exponential-backoff retry (ref: TrainUtils.networkInit:279-295)."""
+    last: Optional[BaseException] = None
+    for i in range(len(backoffs_ms) + 1):
+        try:
+            return fn()
+        except retryable as e:
+            last = e
+            if i < len(backoffs_ms):
+                logger.warning("retrying after %dms: %s", backoffs_ms[i], e)
+                time.sleep(backoffs_ms[i] / 1000.0)
+    raise last  # type: ignore[misc]

@@ -1,0 +1,183 @@
+"""Serving layer tests: real HTTP through a pipeline with a model scorer,
+reply routing, epoch replay, consolidation — the HTTPv2Suite /
+DistributedHTTPSuite analogue (ref: core/src/test/scala/.../io/split2/,
+430+423 LoC of real-server suites).
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.serving import (ContinuousServer, HTTPSourceStateHolder,
+                                      WorkerServer, make_reply, parse_request,
+                                      requests_to_table, send_replies)
+
+
+def _post(url, obj, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_worker_server_round_trip():
+    srv = WorkerServer("t_rt")
+    try:
+        results = {}
+
+        def client():
+            results["resp"] = _post(srv.url, {"x": 5})
+
+        ct = threading.Thread(target=client)
+        ct.start()
+        batch = srv.get_batch(max_rows=4, timeout=5.0)
+        assert len(batch) == 1
+        table = parse_request(requests_to_table(batch))
+        assert table["value"][0] == {"x": 5}
+        table = table.with_column(
+            "reply", np.array([make_reply({"y": 10})], dtype=object))
+        assert send_replies(srv, table) == 1
+        ct.join(timeout=5)
+        assert results["resp"] == (200, {"y": 10})
+    finally:
+        srv.stop()
+
+
+def test_continuous_server_pipeline_with_model_scorer():
+    """End-to-end: real HTTP requests -> pipeline containing a jax-scored
+    model -> replies (the serving north-star path)."""
+    from synapseml_tpu.onnx import ONNXModel, zoo
+
+    model = ONNXModel(model_bytes=zoo.mlp([4, 8], num_classes=3, seed=3),
+                      argmax_output_col="pred")
+
+    def pipeline(table: Table) -> Table:
+        feats = np.stack([np.asarray(v["features"], np.float32)
+                          for v in table["value"]])
+        scored = model.transform(Table({"input": feats}))
+        replies = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            replies[i] = make_reply({"pred": int(scored["pred"][i])})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("t_model", pipeline, max_batch=16).start()
+    try:
+        rng = np.random.default_rng(0)
+        feats = rng.normal(size=(12, 4)).astype(np.float32)
+        statuses, preds = [], []
+        lock = threading.Lock()
+
+        def client(i):
+            st, body = _post(cs.url, {"features": feats[i].tolist()})
+            with lock:
+                statuses.append(st)
+                preds.append((i, body["pred"]))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert cs.errors == []
+        assert statuses == [200] * 12
+        # replies must match direct model scoring per row
+        direct = model.transform(Table({"input": feats}))["pred"]
+        for i, p in preds:
+            assert p == int(direct[i])
+    finally:
+        cs.stop()
+
+
+def test_serving_latency_single_row():
+    """Round-trip latency through a trivial pipeline — the reference claims
+    'sub-millisecond' for the serving hop alone; assert a loose bound that
+    catches structural regressions (polling, lock convoys)."""
+    def pipeline(table: Table) -> Table:
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply({"ok": v["n"]})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("t_lat", pipeline, max_batch=1).start()
+    try:
+        _post(cs.url, {"n": 0})  # warm
+        lat = []
+        for i in range(20):
+            t0 = time.perf_counter()
+            st, body = _post(cs.url, {"n": i})
+            lat.append(time.perf_counter() - t0)
+            assert st == 200 and body["ok"] == i
+        p50 = sorted(lat)[len(lat) // 2]
+        assert p50 < 0.25, f"p50 serving latency {p50 * 1000:.1f}ms"
+    finally:
+        cs.stop()
+
+
+def test_epoch_replay_on_worker_restart():
+    """Uncommitted requests are replayed after a simulated task retry
+    (ref: HTTPSourceV2.scala:488-505 recoveredPartitions)."""
+    srv = WorkerServer("t_replay", reply_timeout=30.0)
+    try:
+        results = {}
+
+        def client():
+            results["resp"] = _post(srv.url, {"job": 1}, timeout=30)
+
+        ct = threading.Thread(target=client)
+        ct.start()
+        batch = srv.get_batch(timeout=5.0)
+        assert len(batch) == 1
+        # worker "dies" before replying or committing; retry recovers
+        recovered = srv.recover()
+        assert recovered == 1
+        batch2 = srv.get_batch(timeout=5.0)
+        assert len(batch2) == 1
+        assert batch2[0].rid == batch[0].rid
+        table = requests_to_table(batch2).with_column(
+            "reply", np.array([make_reply({"done": True})], dtype=object))
+        send_replies(srv, table)
+        srv.commit(batch2[0].epoch)
+        ct.join(timeout=10)
+        assert results["resp"] == (200, {"done": True})
+        # committed epochs do not replay
+        assert srv.recover() == 0
+    finally:
+        srv.stop()
+
+
+def test_pipeline_error_returns_500_and_keeps_serving():
+    calls = {"n": 0}
+
+    def pipeline(table: Table) -> Table:
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient scorer failure")
+        replies = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            replies[i] = make_reply({"ok": True})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("t_err", pipeline, max_batch=1).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(cs.url, {"a": 1})
+        assert ei.value.code == 500
+        st, body = _post(cs.url, {"a": 2})
+        assert st == 200 and body["ok"] is True
+    finally:
+        cs.stop()
+
+
+def test_registry_shared_server():
+    s1 = HTTPSourceStateHolder.get_or_create_server("t_reg")
+    s2 = HTTPSourceStateHolder.get_or_create_server("t_reg")
+    assert s1 is s2
+    HTTPSourceStateHolder.remove("t_reg")
+
