@@ -1,0 +1,21 @@
+"""Image subsystem: jax op pipeline, stages, IO, transfer-learning featurizer.
+
+TPU-native rebuild of the reference's opencv module + core image stages
+(SURVEY.md §2.4 image stages, §2.8 ImageTransformer/ImageSetAugmenter,
+§2.6 ImageFeaturizer).
+"""
+from synapseml_tpu.image import ops  # noqa: F401
+from synapseml_tpu.image.featurizer import ImageFeaturizer  # noqa: F401
+from synapseml_tpu.image.reader import (  # noqa: F401
+    decode_image,
+    from_spark_layout,
+    read_image_files,
+    to_spark_layout,
+)
+from synapseml_tpu.image.transformer import (  # noqa: F401
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollBinaryImage,
+    UnrollImage,
+)

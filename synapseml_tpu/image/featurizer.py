@@ -1,0 +1,133 @@
+"""ImageFeaturizer: transfer learning via a headless imported network.
+
+Rebuild of the reference's ImageFeaturizer
+(ref: deep-learning/src/main/scala/com/microsoft/ml/spark/cntk/ImageFeaturizer.scala:40-197
+— resize -> unroll -> truncated CNTK net via ``cutOutputLayers``:100;
+headless featurization or full predictions, image or binary input column).
+
+Here the backbone is an imported ONNX graph (any user ``.onnx`` file or a
+``synapseml_tpu.onnx.zoo`` constructor): ``cut_output_layers`` drops the
+last N graph nodes (``ImportedGraph.truncated``), images are resized on
+device, normalized, and run through the jit-cached BatchedExecutor in NCHW.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from synapseml_tpu.core.param import (ComplexParam, HasInputCol,
+                                      HasOutputCol, Param)
+from synapseml_tpu.core.pipeline import Transformer
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.image import ops
+from synapseml_tpu.onnx.importer import ImportedGraph, import_model
+from synapseml_tpu.runtime.executor import BatchedExecutor
+
+_DTYPES = {"float32": np.float32, "bfloat16": "bfloat16"}
+
+
+class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
+    """Featurize an image column through a truncated deep network.
+
+    ``cut_output_layers=0`` returns the model's full output (predictions);
+    ``>=1`` removes that many trailing graph nodes and returns the last
+    surviving activation, flattened — the transfer-learning feature vector
+    (reference semantics, ImageFeaturizer.scala:100 cutOutputLayers).
+    """
+
+    model_payload = ComplexParam("raw .onnx backbone bytes")
+    cut_output_layers = Param("trailing graph nodes to drop", default=1)
+    image_size = Param("square input side fed to the net", default=224)
+    mean = Param("per-channel normalization mean (0-1 scale)",
+                 default=(0.485, 0.456, 0.406))
+    std = Param("per-channel normalization std", default=(0.229, 0.224, 0.225))
+    compute_dtype = Param("float32|bfloat16", default="float32")
+    mini_batch_size = Param("max rows per device batch", default=64)
+
+    def __init__(self, model_path: Optional[str] = None,
+                 model_bytes: Optional[bytes] = None, **kw):
+        super().__init__(**kw)
+        if model_path is not None:
+            with open(model_path, "rb") as fh:
+                model_bytes = fh.read()
+        if model_bytes is not None:
+            self.set(model_payload=bytes(model_bytes))
+
+    def _post_copy(self, src):
+        super()._post_copy(src)
+        self.__dict__.pop("_feat_cache", None)
+
+    def _load_extra(self, path: str):
+        self.__dict__.pop("_feat_cache", None)
+
+    def _pieces(self):
+        cache = self.__dict__.get("_feat_cache")
+        key = (self.cut_output_layers, self.compute_dtype,
+               self.mini_batch_size, tuple(self.mean), tuple(self.std),
+               hash(self.model_payload))
+        if cache is not None and cache[0] == key:
+            return cache[1]
+        graph: ImportedGraph = import_model(self.model_payload)
+        if self.cut_output_layers > 0:
+            graph = graph.truncated(self.cut_output_layers)
+        params = graph.params
+        if self.compute_dtype != "float32":
+            dt = _DTYPES[self.compute_dtype]
+            params = {
+                k: (v.astype(dt) if np.issubdtype(v.dtype, np.floating)
+                    else v)
+                for k, v in params.items()
+            }
+        mean = jnp.asarray(self.mean, jnp.float32).reshape(1, -1, 1, 1)
+        std = jnp.asarray(self.std, jnp.float32).reshape(1, -1, 1, 1)
+
+        def fn(p, imgs_nchw):
+            x = (imgs_nchw.astype(jnp.float32) / 255.0 - mean) / std
+            if self.compute_dtype != "float32":
+                x = x.astype(jnp.bfloat16)
+            (out,) = graph.apply(p, x)
+            return out.reshape(out.shape[0], -1).astype(jnp.float32)
+
+        executor = BatchedExecutor(fn, max_bucket=self.mini_batch_size,
+                                   bound_args=(params,))
+        self.__dict__["_feat_cache"] = (key, executor)
+        return executor
+
+    def _prepare(self, v: Any) -> Optional[np.ndarray]:
+        """Anything image-ish -> [size, size, 3] float32 HWC."""
+        if v is None:
+            return None
+        if isinstance(v, (bytes, bytearray)):
+            from synapseml_tpu.image.reader import decode_image
+            v = decode_image(bytes(v))
+            if v is None:
+                return None
+        arr = np.asarray(v, dtype=np.float32)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        if arr.shape[-1] == 1:
+            arr = np.repeat(arr, 3, axis=-1)
+        size = self.image_size
+        if arr.shape[0] != size or arr.shape[1] != size:
+            arr = np.asarray(ops.resize(jnp.asarray(arr), height=size,
+                                        width=size))
+        return arr
+
+    def _transform(self, table: Table) -> Table:
+        imgs = [self._prepare(v) for v in table[self.input_col]]
+        valid = [i for i, v in enumerate(imgs) if v is not None]
+        if not valid:
+            return table.with_column(
+                self.output_col, np.empty(table.num_rows, dtype=object))
+        batch = np.stack([imgs[i] for i in valid]).transpose(0, 3, 1, 2)
+        (feats,) = self._pieces()(batch)
+        feats = np.asarray(feats, np.float32)
+        if len(valid) == table.num_rows:
+            return table.with_column(self.output_col, feats)
+        out = np.empty(table.num_rows, dtype=object)
+        for j, i in enumerate(valid):
+            out[i] = feats[j]
+        return table.with_column(self.output_col, out)

@@ -215,12 +215,12 @@ class JSONInputParser(Transformer, HasInputCol, HasOutputCol):
     headers = Param("extra headers", default=None)
 
     def _transform(self, table: Table) -> Table:
+        from synapseml_tpu.core.param import _json_default
+
         vals = table[self.input_col]
         out = np.empty(len(vals), dtype=object)
         for i, v in enumerate(vals):
-            if isinstance(v, np.ndarray):
-                v = v.tolist()
-            body = json.dumps(v).encode("utf-8")
+            body = json.dumps(v, default=_json_default).encode("utf-8")
             headers = {"Content-Type": "application/json",
                        **(self.headers or {})}
             out[i] = HTTPRequestData(url=self.url, method=self.method,

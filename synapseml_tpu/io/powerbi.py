@@ -8,20 +8,12 @@ with the retrying client; batch + "streaming" (table-at-once) modes.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional
+from typing import List, Optional
 
-import numpy as np
-
+from synapseml_tpu.core.param import _json_default
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import (HandlingUtils, HTTPRequestData,
                                    SingleThreadedHTTPClient)
-
-
-from synapseml_tpu.core.param import _json_default
-
-
-def _row_jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
-    return row  # numpy values handled by json.dumps(default=_json_default)
 
 
 def write_to_powerbi(table: Table, url: str, batch_size: int = 100,
@@ -34,7 +26,7 @@ def write_to_powerbi(table: Table, url: str, batch_size: int = 100,
     client = client or SingleThreadedHTTPClient(
         HandlingUtils.advanced(*backoffs_ms))
     statuses: List[int] = []
-    rows = [_row_jsonable(r) for r in table.rows()]
+    rows = list(table.rows())  # numpy values handled by _json_default
     for start in range(0, len(rows), batch_size):
         body = json.dumps(rows[start:start + batch_size],
                           default=_json_default).encode("utf-8")

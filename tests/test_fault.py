@@ -1,0 +1,63 @@
+"""Fault-tolerance utility tests (ref: FaultToleranceUtils.scala:1-33,
+TrainUtils.scala:279-295 backoff retries)."""
+import time
+
+import pytest
+
+from synapseml_tpu.utils.fault import retry_with_backoff, retry_with_timeout
+
+
+def test_retry_with_timeout_succeeds_after_failures():
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("boom")
+        return "ok"
+
+    assert retry_with_timeout(fn, timeout_s=5, max_retries=3) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_with_timeout_abandons_hung_attempts():
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        retry_with_timeout(lambda: time.sleep(30), timeout_s=0.2,
+                           max_retries=2)
+    # the hung attempts were abandoned, not joined
+    assert time.monotonic() - t0 < 5
+
+
+def test_retry_with_timeout_raises_last_error():
+    with pytest.raises(ValueError, match="always"):
+        retry_with_timeout(lambda: (_ for _ in ()).throw(ValueError("always")),
+                           timeout_s=1, max_retries=2)
+
+
+def test_retry_with_backoff():
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return 42
+
+    assert retry_with_backoff(fn, backoffs_ms=(1, 1, 1)) == 42
+
+    with pytest.raises(ConnectionError):
+        retry_with_backoff(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                           backoffs_ms=(1,))
+
+    # non-retryable types propagate immediately
+    calls["n"] = 0
+
+    def typed():
+        calls["n"] += 1
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        retry_with_backoff(typed, backoffs_ms=(1, 1),
+                           retryable=(ConnectionError,))
+    assert calls["n"] == 1
