@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from synapseml_tpu.gbdt import objectives as obj
 from synapseml_tpu.gbdt.binning import BinMapper
@@ -614,7 +615,7 @@ def train(
     if mesh is not None:
         return _train_distributed(
             p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
-            thresholds, valid_sets, feature_names)
+            thresholds, valid_sets, feature_names, group=group)
 
     binned = jnp.asarray(binned_np)
     yd = jnp.asarray(y)
@@ -747,37 +748,107 @@ def _importances(b: Booster, num_features: int):
 
 
 def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
-                       bdev, thresholds, valid_sets, feature_names):
-    """dp-sharded training: shard_map over the mesh's 'dp' axis.
+                       bdev, thresholds, valid_sets, feature_names,
+                       group=None):
+    """dp-sharded training: shard_map over the mesh's 'dp' axis, with the
+    boosting loop scanned on device (one host sync per chunk, as in the
+    single-chip path).
 
-    Supports row-wise objectives (binary / multiclass / regression family).
-    Ranking and GOSS need cross-shard coordination and currently fall back
-    to per-shard approximations or raise.
+    Every boosting mode runs on the mesh:
+    - gbdt / rf: per-shard histograms psum'd over ICI (the TPU-native
+      replacement for tree_learner=data_parallel's socket reduce-scatter,
+      ref: lightgbm/.../TrainUtils.scala networkInit + SURVEY.md §2.10);
+    - goss: the top-rate threshold is *global* — a psum'd |grad| histogram
+      yields the mesh-wide quantile (512-bin approximation), so row
+      selection matches single-device GOSS up to bin granularity;
+    - lambdarank / rank_xendcg: group-aligned sharding — whole queries are
+      packed onto shards (ref: repartitionByGroupingColumn,
+      LightGBMBase.scala prepareDataframe), pairwise gradients stay local;
+    - dart: the drop schedule and weight trajectory depend only on host RNG,
+      so they are precomputed and the scan carries a per-shard prediction
+      stack; dropped-ensemble scores are one einsum per step.
+
+    Validation margins/metrics accumulate on device exactly like the
+    single-chip path (valid set replicated on every rank).
     """
     from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if p.objective in ("lambdarank", "rank_xendcg"):
-        raise NotImplementedError(
-            "distributed lambdarank needs group-aligned sharding; "
-            "train single-device or pre-shard by query")
-    if p.boosting_type in ("goss", "dart"):
-        raise NotImplementedError(
-            f"distributed {p.boosting_type} needs cross-shard coordination; "
-            "use boosting_type='gbdt' or 'rf' on a mesh")
+    is_rank = p.objective in ("lambdarank", "rank_xendcg")
+    is_dart = p.boosting_type == "dart"
+    use_goss = p.boosting_type == "goss"
+    is_rf = p.boosting_type == "rf"
+    use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
+    if is_dart and k > 1:
+        raise NotImplementedError("dart + multiclass not yet supported")
+    if is_rank and group is None:
+        raise ValueError("ranking objectives need a group array")
+    renew_alpha = None
+    if k == 1 and not is_dart:
+        if p.objective in ("regression_l1", "l1", "mae"):
+            renew_alpha = 0.5
+        elif p.objective == "quantile":
+            renew_alpha = p.alpha
 
     dpn = mesh.shape["dp"]
     n0, f = binned_np.shape
-    pad = (-n0) % dpn
-    pad_mask_np = np.ones(n0 + pad, bool)
-    if pad:
-        binned_np = np.vstack([binned_np,
-                               np.zeros((pad, f), binned_np.dtype)])
-        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+
+    # -- row layout ------------------------------------------------------
+    if is_rank:
+        # group-aligned sharding: greedily pack whole queries onto the
+        # least-loaded shard, then pad shards to a common length.
+        # O(n log n): one stable argsort groups rows; np.split slices them.
+        group = np.asarray(group)
+        sort_idx = np.argsort(group, kind="stable")
+        sorted_g = group[sort_idx]
+        bounds = np.nonzero(sorted_g[1:] != sorted_g[:-1])[0] + 1
+        query_rows = np.split(sort_idx, bounds)
+        # keep first-appearance query order (matches the reference's
+        # repartitionByGroupingColumn stability)
+        query_rows.sort(key=lambda rows: int(rows.min()))
+        shard_rows: List[List[np.ndarray]] = [[] for _ in range(dpn)]
+        loads = np.zeros(dpn, np.int64)
+        for rows in query_rows:
+            tgt = int(np.argmin(loads))
+            shard_rows[tgt].append(rows)
+            loads[tgt] += len(rows)
+        shard_idx = [
+            np.concatenate(rs) if rs else np.zeros(0, np.int64)
+            for rs in shard_rows
+        ]
+        per = int(loads.max())
+        pad_mask_np = np.ones(per * dpn, bool)
+        gids_np = np.full(per * dpn, -1, np.int64)
+        for s, rows in enumerate(shard_idx):
+            base_off = s * per
+            gids_np[base_off:base_off + len(rows)] = group[rows]
+            pad_mask_np[base_off + len(rows):base_off + per] = False
+
+        def lay(arr, fill=0):
+            out = np.full((per * dpn,) + arr.shape[1:], fill, arr.dtype)
+            for s, rows in enumerate(shard_idx):
+                out[s * per: s * per + len(rows)] = arr[rows]
+            return out
+        binned_np = lay(binned_np, fill=bdev - 1)
+        y = lay(y)
         if weight is not None:
-            weight = np.concatenate([weight, np.zeros(pad, weight.dtype)])
-        pad_mask_np[n0:] = False
-    n = n0 + pad
+            weight = lay(weight)
+        # padded rows get unique negative ids -> no pairs -> zero gradients
+        padidx = np.nonzero(~pad_mask_np)[0]
+        gids_np[padidx] = -(np.arange(len(padidx)) + 1)
+        n = per * dpn
+    else:
+        pad = (-n0) % dpn
+        pad_mask_np = np.ones(n0 + pad, bool)
+        if pad:
+            binned_np = np.vstack([binned_np,
+                                   np.zeros((pad, f), binned_np.dtype)])
+            y = np.concatenate([y, np.zeros(pad, y.dtype)])
+            if weight is not None:
+                weight = np.concatenate([weight, np.zeros(pad, weight.dtype)])
+            pad_mask_np[n0:] = False
+        n = n0 + pad
+        gids_np = None
 
     row_spec = P("dp")
     mat_spec = P("dp", None)
@@ -790,6 +861,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     yd = put(y.astype(np.float32), row_spec)
     wd = put(weight.astype(np.float32), row_spec) if weight is not None else None
     padm = put(pad_mask_np, row_spec)
+    gids = put(gids_np, row_spec) if gids_np is not None else None
     y_onehot_spec = P("dp", None)
     if k > 1:
         yoh = put(jax.nn.one_hot(jnp.asarray(y.astype(np.int32)), k), y_onehot_spec)
@@ -798,80 +870,277 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         yoh = None
         scores = put(np.zeros(n, np.float32) + init, row_spec)
 
-    use_bagging = p.bagging_freq > 0 and p.bagging_fraction < 1.0
-    is_rf = p.boosting_type == "rf"
+    total_steps = p.num_iterations * k
 
-    def local_iter(binned_l, yd_l, yoh_l, wd_l, padm_l, scores_l, key, cls):
-        base = jnp.full_like(scores_l, init) if is_rf else scores_l
-        if k > 1:
-            g, h = obj_fn(base, yoh_l, wd_l)
-            g, h = g[:, cls], h[:, cls]
-        else:
-            g, h = obj_fn(base, yd_l, wd_l)
-        mask = padm_l
-        if use_bagging or is_rf:
-            frac = p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632
-            bkey = jax.random.fold_in(key, jax.lax.axis_index("dp"))
-            mask = mask & (jax.random.uniform(bkey, mask.shape) < frac)
-        binned_use = binned_l
-        if p.feature_fraction < 1.0:
-            # same key on every rank -> identical feature subset mesh-wide
-            keep = max(1, int(round(p.feature_fraction * f)))
-            perm = jax.random.permutation(jax.random.fold_in(key, 17), f)
-            fmask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
-            binned_use = jnp.where(fmask[None, :], binned_l, bdev - 1)
-        tree, row_slot, slot_value, _ = build_tree(
-            binned_use, g, h, mask, thresholds, gp, axis_name="dp")
-        lr = 1.0 if is_rf else p.learning_rate
-        delta = lr * slot_value[row_slot]
-        if k > 1:
-            new_scores = scores_l.at[:, cls].add(delta)
-        else:
-            new_scores = scores_l + delta
-        scaled = Tree(
-            split_feature=tree.split_feature, threshold=tree.threshold,
-            threshold_bin=tree.threshold_bin, left_child=tree.left_child,
-            right_child=tree.right_child, leaf_value=tree.leaf_value * lr,
-            cover=tree.cover, gain=tree.gain)
-        return new_scores, scaled
+    # -- dart schedule (host RNG only; fully precomputable) --------------
+    if is_dart:
+        drng = np.random.default_rng(p.seed)
+        w_used_mat = np.zeros((total_steps, total_steps), np.float32)
+        cur = np.zeros(total_steps, np.float32)
+        for t in range(total_steps):
+            if t == 0 or drng.random() < p.skip_drop:
+                dropped = np.empty(0, np.int64)
+            else:
+                sel = drng.random(t) < p.drop_rate
+                dropped = np.nonzero(sel)[0][: p.max_drop]
+            w_used = cur.copy()
+            w_used[dropped] = 0.0
+            w_used_mat[t] = w_used
+            kd = len(dropped)
+            if kd:
+                cur[dropped] *= kd / (kd + 1.0)
+                cur[t] = p.learning_rate / (kd + 1.0)
+            else:
+                cur[t] = p.learning_rate
+        dart_w_final = cur
+        wmat = put(w_used_mat, rep)
+        preds0 = put(np.zeros((total_steps, n), np.float32), P(None, "dp"))
+    else:
+        wmat = None
+        preds0 = None
 
-    score_spec = y_onehot_spec if k > 1 else row_spec
-    tree_spec = Tree(*([rep] * 8))
-
-    smapped = shard_map(
-        local_iter, mesh=mesh,
-        in_specs=(mat_spec, row_spec, (y_onehot_spec if k > 1 else None),
-                  (row_spec if wd is not None else None), row_spec,
-                  score_spec, rep, rep),
-        out_specs=(score_spec, tree_spec),
-        check_vma=False)
-    jitted = jax.jit(smapped)
-
+    # -- validation state ------------------------------------------------
     tracker = _ValidTracker(p, k, init, valid_sets)
+    track = tracker.enabled and not is_dart
+    track_dev = track and not tracker.is_rank_metric
+    track_rank = track and tracker.is_rank_metric
+    if track:
+        vx_d = put(np.asarray(tracker.sets[0][0]), rep)
+        vy_d = put(np.asarray(tracker.sets[0][1]), rep)
+        vg_h = tracker.sets[0][3]
+        vy_h = np.asarray(tracker.sets[0][1])
+        vsum0 = put(np.zeros((vy_h.shape[0], k), np.float32), rep)
+    else:
+        vx_d = vy_d = None
+        vsum0 = put(np.zeros((0, k), np.float32), rep)
+    metric_fn = tracker.metric_fn if track_dev else None
 
-    trees: List[Tree] = []
-    rng = jax.random.PRNGKey(p.seed)
-    for it in range(p.num_iterations):
-        for c in range(k):
+    nbins_goss = 512
+
+    def chunk_fn(binned_l, yd_l, yoh_l, wd_l, padm_l, gids_l, vx_r, vy_r,
+                 wmat_r, carry, steps):
+        n_l = binned_l.shape[0]
+
+        def goss_select(g, h, key):
+            """Global top-rate threshold from a psum'd |grad| histogram."""
+            absg = jnp.where(padm_l, jnp.abs(g), 0.0)
+            gmax = lax.pmax(absg.max(), "dp") + 1e-12
+            idx = jnp.clip((absg / gmax * nbins_goss).astype(jnp.int32),
+                           0, nbins_goss - 1)
+            oh = jax.nn.one_hot(idx, nbins_goss, dtype=jnp.float32)
+            hist = lax.psum(
+                jnp.einsum("nb,n->b", oh, padm_l.astype(jnp.float32)), "dp")
+            total = lax.psum(padm_l.sum().astype(jnp.float32), "dp")
+            n_top = jnp.maximum(1.0, jnp.floor(p.top_rate * total))
+            from_top = jnp.cumsum(hist[::-1])[::-1]
+            tbin = jnp.maximum((from_top >= n_top).sum() - 1, 0)
+            thresh = tbin.astype(jnp.float32) * gmax / nbins_goss
+            top = absg >= thresh
+            rkey = jax.random.fold_in(key, lax.axis_index("dp"))
+            rand = jax.random.uniform(rkey, (n_l,)) < p.other_rate
+            amp = (1.0 - p.top_rate) / max(p.other_rate, 1e-12)
+            small = (~top) & rand & padm_l
+            mask = (top | small) & padm_l
+            g2 = jnp.where(small, g * amp, g)
+            h2 = jnp.where(small, h * amp, h)
+            return mask, g2, h2
+
+        def step_fn(c_in, st):
+            scores_l, vsum_r, preds_l, rng = c_in
             rng, key = jax.random.split(rng)
-            scores, tree = jitted(binned, yd, yoh, wd, padm, scores, key,
-                                  jnp.int32(c))
-            tracker.add_tree(tree, c)
-            trees.append(jax.tree_util.tree_map(np.asarray, tree))
-        if tracker.step(it, is_rf):
-            break
+            cidx = st % k
+            it = st // k
 
-    t_total = len(trees)
-    tree_weights = np.full(
-        t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0, np.float32)
+            if is_dart:
+                base = init + jnp.einsum("t,tn->n", wmat_r[st], preds_l)
+            elif is_rf:
+                base = jnp.full_like(scores_l, init)
+            else:
+                base = scores_l
+
+            if k > 1:
+                g, h = obj_fn(base, yoh_l, wd_l)
+                g, h = g[:, cidx], h[:, cidx]
+            elif is_rank:
+                g, h = obj.lambdarank_grad(base, yd_l, gids_l,
+                                           max_dcg_pos=p.max_position)
+                if wd_l is not None:
+                    g, h = g * wd_l, h * wd_l
+            else:
+                g, h = obj_fn(base, yd_l, wd_l)
+
+            # dart fits on the full data / full features, exactly like the
+            # single-device _train_dart — same BoostParams must give the
+            # same ensemble with or without a mesh
+            if use_goss:
+                mask, g, h = goss_select(g, h, key)
+            elif use_bagging and not is_dart:
+                frac = p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632
+                bkey = jax.random.fold_in(key, lax.axis_index("dp"))
+                mask = padm_l & (jax.random.uniform(bkey, (n_l,)) < frac)
+            else:
+                mask = padm_l
+
+            binned_use = binned_l
+            if p.feature_fraction < 1.0 and not is_dart:
+                # same key on every rank -> identical feature subset mesh-wide
+                keep = max(1, int(round(p.feature_fraction * f)))
+                perm = jax.random.permutation(jax.random.fold_in(key, 17), f)
+                fmask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
+                binned_use = jnp.where(fmask[None, :], binned_l, bdev - 1)
+
+            tree, row_slot, slot_value, slot_node = build_tree(
+                binned_use, g, h, mask, thresholds, gp, axis_name="dp")
+
+            if renew_alpha is not None:
+                # L1-family leaf renewal needs *global* per-leaf quantiles:
+                # all_gather the residuals + slots over dp (a [n] f32 vector,
+                # cheap next to the per-split histograms), then quantile —
+                # the single-device scan path's semantics, exactly
+                residual_l = jnp.where(padm_l, yd_l - scores_l, jnp.nan)
+                residual_g = lax.all_gather(residual_l, "dp", tiled=True)
+                row_slot_g = lax.all_gather(row_slot, "dp", tiled=True)
+
+                def leaf_quantile(slot):
+                    r = jnp.where(row_slot_g == slot, residual_g, jnp.nan)
+                    return jnp.nanquantile(r, renew_alpha)
+
+                renewed = jax.vmap(leaf_quantile)(jnp.arange(gp.num_leaves))
+                slot_value = jnp.where(jnp.isnan(renewed), slot_value, renewed)
+                m_nodes = tree.leaf_value.shape[0]
+                nsel = ((slot_node[:, None] == jnp.arange(m_nodes))
+                        & (slot_node >= 0)[:, None])
+                new_leaf = jnp.sum(nsel * slot_value[:, None], axis=0)
+                tree = Tree(
+                    split_feature=tree.split_feature, threshold=tree.threshold,
+                    threshold_bin=tree.threshold_bin,
+                    left_child=tree.left_child, right_child=tree.right_child,
+                    leaf_value=new_leaf, cover=tree.cover, gain=tree.gain)
+
+            if is_dart:
+                pred = slot_value[row_slot]
+                preds_l = preds_l.at[st].set(pred)
+                new_scores = scores_l
+                scaled = tree  # dart leaf values stay raw; weights carry scale
+            else:
+                lr = 1.0 if is_rf else p.learning_rate
+                delta = lr * slot_value[row_slot]
+                if k > 1:
+                    new_scores = scores_l + delta[:, None] * jax.nn.one_hot(
+                        cidx, k, dtype=scores_l.dtype)
+                else:
+                    new_scores = scores_l + delta
+                scaled = Tree(
+                    split_feature=tree.split_feature, threshold=tree.threshold,
+                    threshold_bin=tree.threshold_bin,
+                    left_child=tree.left_child, right_child=tree.right_child,
+                    leaf_value=tree.leaf_value * lr, cover=tree.cover,
+                    gain=tree.gain)
+
+            out: Tuple = (scaled,)
+            if track:
+                vt = predict_tree(
+                    (scaled.split_feature, scaled.threshold, scaled.left_child,
+                     scaled.right_child, scaled.leaf_value), vx_r)
+                vsum_r = vsum_r + vt[:, None] * jax.nn.one_hot(
+                    cidx, k, dtype=vsum_r.dtype)
+            if track_dev:
+                scale = (1.0 / (it + 1.0)) if is_rf else 1.0
+                vscore = vsum_r * scale + init
+                if k > 1:
+                    m = metric_fn(vscore, vy_r.astype(jnp.int32))
+                else:
+                    m = metric_fn(vscore[:, 0], vy_r)
+                out = out + (m,)
+            elif track_rank:
+                out = out + (vsum_r[:, 0],)
+            return (new_scores, vsum_r, preds_l, rng), out
+
+        return lax.scan(step_fn, carry, steps)
+
+    carry_spec = (
+        y_onehot_spec if k > 1 else row_spec,            # scores
+        rep,                                             # vsum
+        P(None, "dp") if is_dart else rep,               # preds stack
+        rep,                                             # rng
+    )
+    in_specs = (
+        mat_spec, row_spec,
+        (y_onehot_spec if k > 1 else None),
+        (row_spec if wd is not None else None),
+        row_spec,
+        (row_spec if gids is not None else None),
+        rep, rep, rep,
+        carry_spec, rep,
+    )
+    tree_spec = Tree(*([rep] * 8))
+    ys_spec: Tuple = (tree_spec,)
+    if track_dev:
+        ys_spec = ys_spec + (rep,)
+    elif track_rank:
+        ys_spec = ys_spec + (rep,)
+    out_specs = (carry_spec, ys_spec)
+
+    smapped = shard_map(chunk_fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=False)
+    jitted = jax.jit(smapped, donate_argnums=9)
+
+    esr = p.early_stopping_round
+    total_iters = p.num_iterations
+    chunk = max(esr, 16) if (track and esr > 0) else total_iters
+    if track_rank:
+        nv = max(1, int(vy_h.shape[0]))
+        chunk = min(chunk, max(1, 4_000_000 // nv))
+    chunk = max(1, min(chunk, total_iters))
+
+    carry = (scores, vsum0,
+             preds0 if is_dart else put(np.zeros((1, 1), np.float32), rep),
+             put(jax.random.PRNGKey(p.seed), rep))
+    tree_chunks = []
+    stop_steps: Optional[int] = None
+    done_iters = 0
+    while done_iters < total_iters and stop_steps is None:
+        steps = put(np.arange(done_iters * k, (done_iters + chunk) * k), rep)
+        carry, ys = jitted(binned, yd, yoh, wd, padm, gids, vx_d, vy_d,
+                           wmat, carry, steps)
+        tree_chunks.append(jax.tree_util.tree_map(np.asarray, ys[0]))
+        n_it = min(chunk, total_iters - done_iters)
+        if track_dev:
+            per_iter = np.asarray(ys[1])[k - 1::k][:n_it]
+        elif track_rank:
+            vsnap = np.asarray(ys[1])
+            per_iter = [
+                _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position)
+                for i in range(n_it)
+            ]
+        else:
+            per_iter = []
+        for i, m in enumerate(per_iter):
+            if tracker.record(float(m), done_iters + i):
+                stop_steps = (done_iters + i + 1) * k
+                break
+        done_iters += chunk
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.concatenate(xs, axis=0), *tree_chunks)
+    keep_steps = stop_steps if stop_steps is not None else total_iters * k
+    stacked = jax.tree_util.tree_map(lambda a: a[:keep_steps], stacked)
+
+    t_total = stacked.split_feature.shape[0]
+    if is_dart:
+        tree_weights = dart_w_final[:t_total]
+    else:
+        tree_weights = np.full(
+            t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0,
+            np.float32)
     booster = Booster(
-        trees_feature=np.stack([t.split_feature for t in trees]),
-        trees_threshold=np.stack([t.threshold for t in trees]),
-        trees_left=np.stack([t.left_child for t in trees]),
-        trees_right=np.stack([t.right_child for t in trees]),
-        trees_value=np.stack([t.leaf_value for t in trees]),
-        trees_cover=np.stack([t.cover for t in trees]),
-        trees_gain=np.stack([t.gain for t in trees]),
+        trees_feature=stacked.split_feature,
+        trees_threshold=stacked.threshold,
+        trees_left=stacked.left_child,
+        trees_right=stacked.right_child,
+        trees_value=stacked.leaf_value,
+        trees_cover=stacked.cover,
+        trees_gain=stacked.gain,
         tree_weights=tree_weights,
         params=p, init_score=init, num_class=k, num_features=f,
         best_iteration=tracker.final_best_iter(), feature_names=feature_names,

@@ -1,0 +1,213 @@
+"""Multi-host distributed bootstrap: rendezvous + jax.distributed init.
+
+Rebuild of the reference's driver-rendezvous control plane
+(ref: lightgbm/src/main/scala/com/microsoft/ml/spark/lightgbm/LightGBMBase.scala:394-432
+``createDriverNodesThread`` — driver ServerSocket collects each task's
+``host:port``, broadcasts the full node list; TrainUtils.scala:236-295
+``getNetworkInitNodes``/``networkInit`` with exponential-backoff retries;
+vw/.../VowpalWabbitBase.scala:434-462 spanning-tree rendezvous).
+
+TPU-native difference: the exchanged roster does not seed a native socket
+ring — it seeds ``jax.distributed.initialize``, after which the data plane
+is XLA collectives over ICI/DCN. The rendezvous only runs once per job to
+agree on (coordinator_address, num_processes, process_id); per-iteration
+traffic never touches these sockets.
+
+Typical multi-host flow (one process per TPU host):
+    roster = rendezvous(driver_addr, my_host, num_workers)   # all hosts
+    initialize_from_roster(roster)                           # jax.distributed
+    mesh = build_mesh(jax.devices(), want={"dp": ...})       # global mesh
+Single-host (or driverless) use: ``initialize()`` no-ops when jax is
+already initialized or when num_processes == 1.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from synapseml_tpu.utils.fault import retry_with_backoff
+
+_COORD_PORT_DEFAULT = 12421  # near the reference's DefaultLocalListenPort
+_state = {"initialized": False}
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerInfo:
+    """One process's identity in the rendezvous roster."""
+    host: str
+    rank_hint: int = -1
+
+    def to_json(self) -> str:
+        return json.dumps({"host": self.host, "rank_hint": self.rank_hint})
+
+    @staticmethod
+    def from_json(s: str) -> "WorkerInfo":
+        d = json.loads(s)
+        return WorkerInfo(host=d["host"], rank_hint=d.get("rank_hint", -1))
+
+
+class DriverRendezvous:
+    """Driver-side roster collector (createDriverNodesThread analogue).
+
+    Accepts ``num_workers`` connections; each worker sends one JSON line
+    (its :class:`WorkerInfo`), the driver replies to every worker with the
+    full ordered roster plus the worker's assigned process index.
+    """
+
+    def __init__(self, num_workers: int, host: str = "0.0.0.0",
+                 port: int = 0, timeout: float = 120.0):
+        self.num_workers = num_workers
+        self.timeout = timeout
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(num_workers)
+        self.port = self._srv.getsockname()[1]
+        self._thread: Optional[threading.Thread] = None
+        self.roster: List[WorkerInfo] = []
+        self.error: Optional[BaseException] = None
+
+    def run(self):
+        """Block until all workers announced and were answered."""
+        conns = []
+        self._srv.settimeout(self.timeout)
+        try:
+            while len(conns) < self.num_workers:
+                conn, _ = self._srv.accept()
+                # per-connection deadline: a connected-but-silent worker
+                # must not hang the whole rendezvous
+                conn.settimeout(self.timeout)
+                line = conn.makefile("r").readline()
+                conns.append((conn, WorkerInfo.from_json(line)))
+            # deterministic order: by rank hint, then host, then arrival
+            order = sorted(range(len(conns)),
+                           key=lambda i: (conns[i][1].rank_hint,
+                                          conns[i][1].host, i))
+            self.roster = [conns[i][1] for i in order]
+            ranks = {i: r for r, i in enumerate(order)}
+            payload_base = [dataclasses.asdict(w) for w in self.roster]
+            for i, (conn, _) in enumerate(conns):
+                msg = json.dumps({"roster": payload_base,
+                                  "process_id": ranks[i]}) + "\n"
+                conn.sendall(msg.encode())
+        except BaseException as e:  # noqa: BLE001 - surfaced via wait()
+            self.error = e
+        finally:
+            for conn, _ in conns:
+                conn.close()
+            self._srv.close()
+
+    def start(self) -> "DriverRendezvous":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def wait(self):
+        """Join the collector; raises if rendezvous failed or is incomplete
+        (a silent empty roster must not look like success)."""
+        if self._thread is not None:
+            self._thread.join(self.timeout)
+        if self.error is not None:
+            raise RuntimeError(
+                f"rendezvous failed after collecting "
+                f"{len(self.roster)}/{self.num_workers} workers"
+            ) from self.error
+        if len(self.roster) != self.num_workers:
+            raise RuntimeError(
+                f"rendezvous incomplete: {len(self.roster)}/"
+                f"{self.num_workers} workers announced")
+
+
+def announce(driver_host: str, driver_port: int, info: WorkerInfo,
+             timeout: float = 120.0) -> Dict:
+    """Worker side (getNetworkInitNodes analogue): send identity, receive
+    ``{"roster": [...], "process_id": int}``. Retries with backoff — the
+    driver may not be listening yet (TrainUtils.scala:279-295)."""
+
+    def attempt():
+        with socket.create_connection((driver_host, driver_port),
+                                      timeout=timeout) as s:
+            s.sendall((info.to_json() + "\n").encode())
+            data = s.makefile("r").readline()
+            return json.loads(data)
+
+    # ~2-minute ladder: worker pods routinely start before the driver binds
+    # its port (ref: TrainUtils.networkInit's long retry window)
+    return retry_with_backoff(
+        attempt,
+        backoffs_ms=(100, 500, 1000, 2000, 5000, 10000, 15000, 30000, 60000),
+        retryable=(ConnectionError, OSError, json.JSONDecodeError))
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None) -> bool:
+    """Join the jax distributed runtime (DCN control plane).
+
+    Falls back to env (JAX's own vars, then ``SYNAPSEML_COORDINATOR`` /
+    ``SYNAPSEML_NUM_PROCESSES`` / ``SYNAPSEML_PROCESS_ID``). No-op (returns
+    False) for single-process jobs or when already initialized; retries
+    with backoff otherwise, mirroring the reference's networkInit ladder.
+    """
+    if _state["initialized"]:
+        return False
+    coordinator_address = coordinator_address or os.environ.get(
+        "SYNAPSEML_COORDINATOR")
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("SYNAPSEML_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("SYNAPSEML_PROCESS_ID", "0"))
+    if num_processes <= 1 and coordinator_address is None:
+        return False
+
+    def attempt():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids)
+        return True
+
+    retry_with_backoff(attempt, backoffs_ms=(500, 1000, 5000, 10000))
+    _state["initialized"] = True
+    return True
+
+
+def initialize_from_roster(reply: Dict,
+                           coordinator_port: int = _COORD_PORT_DEFAULT
+                           ) -> bool:
+    """Turn a rendezvous reply into a jax.distributed join: roster[0] hosts
+    the coordination service."""
+    roster = reply["roster"]
+    return initialize(
+        coordinator_address=f"{roster[0]['host']}:{coordinator_port}",
+        num_processes=len(roster),
+        process_id=int(reply["process_id"]))
+
+
+def rendezvous_and_initialize(driver_host: str, driver_port: int,
+                              my_host: Optional[str] = None,
+                              rank_hint: int = -1,
+                              coordinator_port: int = _COORD_PORT_DEFAULT
+                              ) -> Dict:
+    """One-call worker bootstrap: announce to the driver, then join the
+    distributed runtime with the agreed roster. Returns the reply dict."""
+    info = WorkerInfo(host=my_host or socket.gethostname(),
+                      rank_hint=rank_hint)
+    reply = announce(driver_host, driver_port, info)
+    initialize_from_roster(reply, coordinator_port)
+    return reply
+
+
+def global_mesh(want: Optional[Dict[str, int]] = None):
+    """All-process mesh over every device in the (initialized) job."""
+    from synapseml_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(jax.devices(), want=want)

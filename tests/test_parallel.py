@@ -1,3 +1,5 @@
+import json
+
 import numpy as np
 import pytest
 
@@ -102,3 +104,102 @@ def test_tagger_train_step_full_mesh():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # learns on a fixed batch
+
+
+def test_rendezvous_roster_and_ranks():
+    """Driver rendezvous collects workers and assigns deterministic ranks
+    (ref: LightGBMBase.createDriverNodesThread:394-432,
+    TrainUtils.getNetworkInitNodes:236-277)."""
+    import threading
+
+    from synapseml_tpu.parallel.distributed import (DriverRendezvous,
+                                                    WorkerInfo, announce)
+
+    drv = DriverRendezvous(num_workers=3, host="127.0.0.1").start()
+    replies = {}
+    lock = threading.Lock()
+
+    def worker(name, hint):
+        r = announce("127.0.0.1", drv.port, WorkerInfo(host=name,
+                                                       rank_hint=hint))
+        with lock:
+            replies[name] = r
+
+    ts = [threading.Thread(target=worker, args=(f"host{i}", 2 - i))
+          for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    drv.wait()
+    assert len(replies) == 3
+    # rank order follows rank_hint: host2 (hint 0) -> 0, host1 -> 1, host0 -> 2
+    assert replies["host2"]["process_id"] == 0
+    assert replies["host0"]["process_id"] == 2
+    rosters = {json.dumps(r["roster"]) for r in replies.values()}
+    assert len(rosters) == 1  # everyone sees the identical roster
+    assert [w["host"] for w in replies["host0"]["roster"]] == [
+        "host2", "host1", "host0"]
+
+
+def test_worker_announce_retries_until_driver_up():
+    """Workers may start before the driver: announce retries with backoff
+    (ref: TrainUtils.networkInit:279-295)."""
+    import threading
+    import time
+
+    from synapseml_tpu.parallel.distributed import (DriverRendezvous,
+                                                    WorkerInfo, announce)
+    from synapseml_tpu.io.serving import find_open_port
+
+    port = find_open_port(24500)
+    result = {}
+
+    def worker():
+        result["r"] = announce("127.0.0.1", port, WorkerInfo(host="w0"))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.4)  # let the first connection attempt fail
+    drv = DriverRendezvous(num_workers=1, host="127.0.0.1", port=port).start()
+    t.join(timeout=30)
+    drv.wait()
+    assert result["r"]["process_id"] == 0
+
+
+def test_initialize_noop_single_process():
+    from synapseml_tpu.parallel import distributed
+
+    assert distributed.initialize() is False  # 1 process -> no-op
+
+
+def test_distributed_initialize_subprocess():
+    """jax.distributed.initialize in a clean subprocess: 1-process job with
+    an explicit coordinator — the full code path the multi-host deployment
+    takes, minus the extra hosts."""
+    import subprocess
+    import sys
+
+    from synapseml_tpu.io.serving import find_open_port
+
+    port = find_open_port(25500)
+    code = f"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from synapseml_tpu.parallel.distributed import initialize, global_mesh
+ok = initialize(coordinator_address="127.0.0.1:{port}", num_processes=1,
+                process_id=0)
+assert ok, "explicit coordinator must initialize"
+import jax
+assert jax.process_count() == 1
+mesh = global_mesh()
+print("subprocess ok", dict(mesh.shape))
+"""
+    env = dict(**__import__("os").environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "."
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "subprocess ok" in out.stdout
