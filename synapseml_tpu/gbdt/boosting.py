@@ -419,6 +419,90 @@ def _leaf_index_stack(stack, x):
     return leaves.T
 
 
+def _compute_chunk(p: BoostParams, tracker, track_rank: bool,
+                   total_iters: int, nv: int) -> int:
+    """Chunk sizing shared by the single-chip and mesh loops: one scan when
+    nothing can stop early; otherwise chunks so an early exit wastes at most
+    one chunk; rank-metric chunks bounded to ~16MB of margin snapshot."""
+    esr = p.early_stopping_round
+    chunk = max(esr, 16) if (tracker.enabled and esr > 0) else total_iters
+    if track_rank:
+        chunk = min(chunk, max(1, 4_000_000 // max(1, nv)))
+    return max(1, min(chunk, total_iters))
+
+
+def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
+                        total_iters: int, chunk: int, track_dev: bool,
+                        track_rank: bool, vy_h, vg_h):
+    """Drive the jitted chunk scans; metrics/early-stop applied host-side.
+
+    ``run(carry, steps, chunk_start_iter) -> (carry, ys)`` where ``ys[0]``
+    is the stacked tree pytree and ``ys[1]`` (when tracking) the per-step
+    metric or margin snapshot. Every chunk is full-length — a shorter
+    remainder would recompile the scan — and surplus steps are sliced off.
+    Returns the stacked trees truncated to the kept steps.
+    """
+    tree_chunks = []
+    stop_steps: Optional[int] = None
+    done_iters = 0
+    while done_iters < total_iters and stop_steps is None:
+        steps = jnp.arange(done_iters * k, (done_iters + chunk) * k)
+        carry, ys = run(carry, steps, done_iters)
+        tree_chunks.append(jax.tree_util.tree_map(np.asarray, ys[0]))
+        n_it = min(chunk, total_iters - done_iters)
+        if track_dev:
+            per_iter = np.asarray(ys[1])[k - 1::k][:n_it]
+        elif track_rank:
+            vsnap = np.asarray(ys[1])  # [chunk, Nv]; k == 1 for ranking
+            per_iter = [
+                _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position)
+                for i in range(n_it)
+            ]
+        else:
+            per_iter = []
+        for i, m in enumerate(per_iter):
+            if tracker.record(float(m), done_iters + i):
+                stop_steps = (done_iters + i + 1) * k
+                break
+        done_iters += chunk
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.concatenate(xs, axis=0), *tree_chunks)
+    keep = stop_steps if stop_steps is not None else total_iters * k
+    return jax.tree_util.tree_map(lambda a: a[:keep], stacked)
+
+
+def _assemble_booster(stacked, p: BoostParams, k: int, init: float, f: int,
+                      feature_names, tracker, dart_w_final=None) -> Booster:
+    t_total = stacked.split_feature.shape[0]
+    if dart_w_final is not None:
+        tree_weights = np.asarray(dart_w_final[:t_total], np.float32)
+    else:
+        is_rf = p.boosting_type == "rf"
+        tree_weights = np.full(
+            t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0,
+            np.float32)
+    booster = Booster(
+        trees_feature=stacked.split_feature,
+        trees_threshold=stacked.threshold,
+        trees_left=stacked.left_child,
+        trees_right=stacked.right_child,
+        trees_value=stacked.leaf_value,
+        trees_cover=stacked.cover,
+        trees_gain=stacked.gain,
+        tree_weights=tree_weights,
+        params=p,
+        init_score=init,
+        num_class=k,
+        best_iteration=tracker.final_best_iter(),
+        num_features=f,
+        feature_names=feature_names,
+        eval_history=tracker.history,
+    )
+    booster.feature_importance_split, booster.feature_importance_gain = (
+        _importances(booster, f))
+    return booster
+
+
 @lru_cache(maxsize=64)
 def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
                   track_dev: bool, track_rank: bool,
@@ -669,73 +753,16 @@ def train(
         key_p, gp, k, tracker.enabled, track_dev, track_rank,
         tracker.metric_name if tracker.enabled else None)
 
-    esr = p.early_stopping_round
     total_iters = p.num_iterations
-    # without early stopping one scan covers the run; with it, chunk so an
-    # early exit wastes at most one chunk of device work
-    chunk = max(esr, 16) if (tracker.enabled and esr > 0) else total_iters
-    if track_rank:
-        # the rank path stacks a [chunk, Nv] margin snapshot on device;
-        # bound it to ~16 MB so huge valid sets cannot OOM the chip
-        nv = max(1, int(vsum0.shape[0]))
-        chunk = min(chunk, max(1, 4_000_000 // nv))
-    chunk = max(1, min(chunk, total_iters))
-
+    chunk = _compute_chunk(p, tracker, track_rank, total_iters,
+                           int(vsum0.shape[0]))
     carry = (scores, vsum0, jax.random.PRNGKey(p.seed))
-    tree_chunks = []
-    stop_steps: Optional[int] = None
-    done_iters = 0
-    while done_iters < total_iters and stop_steps is None:
-        # every chunk is full-length (a shorter remainder would recompile the
-        # whole scan); surplus iterations past num_iterations are sliced off
-        steps = jnp.arange(done_iters * k, (done_iters + chunk) * k)
-        carry, ys = scan_fn(carry, steps, consts)
-        tree_chunks.append(jax.tree_util.tree_map(np.asarray, ys[0]))
-        n_it = min(chunk, total_iters - done_iters)
-        if track_dev:
-            per_iter = np.asarray(ys[1])[k - 1::k][:n_it]
-        elif track_rank:
-            vsnap = np.asarray(ys[1])  # [chunk, Nv]; k == 1 for ranking
-            per_iter = [
-                _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position)
-                for i in range(n_it)
-            ]
-        else:
-            per_iter = []
-        for i, m in enumerate(per_iter):
-            if tracker.record(float(m), done_iters + i):
-                stop_steps = (done_iters + i + 1) * k
-                break
-        done_iters += chunk
-
-    stacked = jax.tree_util.tree_map(
-        lambda *xs: np.concatenate(xs, axis=0), *tree_chunks)
-    keep_steps = stop_steps if stop_steps is not None else total_iters * k
-    stacked = jax.tree_util.tree_map(lambda a: a[:keep_steps], stacked)
-
-    t_total = stacked.split_feature.shape[0]
-    tree_weights = np.full(t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0,
-                           np.float32)
-    booster = Booster(
-        trees_feature=stacked.split_feature,
-        trees_threshold=stacked.threshold,
-        trees_left=stacked.left_child,
-        trees_right=stacked.right_child,
-        trees_value=stacked.leaf_value,
-        trees_cover=stacked.cover,
-        trees_gain=stacked.gain,
-        tree_weights=tree_weights,
-        params=p,
-        init_score=init,
-        num_class=k,
-        best_iteration=tracker.final_best_iter(),
-        num_features=f,
-        feature_names=feature_names,
-        eval_history=tracker.history,
-    )
-    booster.feature_importance_split, booster.feature_importance_gain = (
-        _importances(booster, f))
-    return booster
+    stacked = _chunked_boost_loop(
+        lambda c, steps, start: scan_fn(c, steps, consts),
+        carry, tracker, p, k, total_iters, chunk, track_dev, track_rank,
+        vy_h if tracker.enabled else None,
+        vg_h if tracker.enabled else None)
+    return _assemble_booster(stacked, p, k, init, f, feature_names, tracker)
 
 
 def _importances(b: Booster, num_features: int):
@@ -817,11 +844,14 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             for rs in shard_rows
         ]
         per = int(loads.max())
+        # device-side group ids are dense 0..nq-1 (user ids may themselves
+        # be negative; the pad rows below rely on negatives being free)
+        _, dense_gid = np.unique(group, return_inverse=True)
         pad_mask_np = np.ones(per * dpn, bool)
         gids_np = np.full(per * dpn, -1, np.int64)
         for s, rows in enumerate(shard_idx):
             base_off = s * per
-            gids_np[base_off:base_off + len(rows)] = group[rows]
+            gids_np[base_off:base_off + len(rows)] = dense_gid[rows]
             pad_mask_np[base_off + len(rows):base_off + per] = False
 
         def lay(arr, fill=0):
@@ -873,9 +903,13 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     total_steps = p.num_iterations * k
 
     # -- dart schedule (host RNG only; fully precomputable) --------------
+    # Drop sets + final weights are simulated once; the dense per-step
+    # drop-weight rows are materialized per chunk ([chunk*k, total_steps])
+    # instead of a replicated [T, T] matrix, which would be O(T^2) device
+    # memory at large iteration counts.
     if is_dart:
         drng = np.random.default_rng(p.seed)
-        w_used_mat = np.zeros((total_steps, total_steps), np.float32)
+        dart_drops: List[np.ndarray] = []
         cur = np.zeros(total_steps, np.float32)
         for t in range(total_steps):
             if t == 0 or drng.random() < p.skip_drop:
@@ -883,9 +917,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             else:
                 sel = drng.random(t) < p.drop_rate
                 dropped = np.nonzero(sel)[0][: p.max_drop]
-            w_used = cur.copy()
-            w_used[dropped] = 0.0
-            w_used_mat[t] = w_used
+            dart_drops.append(dropped)
             kd = len(dropped)
             if kd:
                 cur[dropped] *= kd / (kd + 1.0)
@@ -893,10 +925,37 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             else:
                 cur[t] = p.learning_rate
         dart_w_final = cur
-        wmat = put(w_used_mat, rep)
+
+        _dart_run = np.zeros(total_steps, np.float32)
+        _dart_next = [0]
+
+        def dart_wmat_slice(start_step: int, n_steps: int) -> np.ndarray:
+            """Replay the schedule incrementally for one chunk's rows;
+            steps past total_steps get all-zero rows (their trees are
+            sliced off by the chunk loop)."""
+            assert start_step == _dart_next[0], "chunks must be sequential"
+            out = np.zeros((n_steps, total_steps), np.float32)
+            for j in range(n_steps):
+                t = start_step + j
+                if t >= total_steps:
+                    break
+                w = _dart_run.copy()
+                w[dart_drops[t]] = 0.0
+                out[j] = w
+                kd = len(dart_drops[t])
+                if kd:
+                    _dart_run[dart_drops[t]] *= kd / (kd + 1.0)
+                    _dart_run[t] = p.learning_rate / (kd + 1.0)
+                else:
+                    _dart_run[t] = p.learning_rate
+                _dart_next[0] = t + 1
+            if start_step + n_steps > total_steps:
+                _dart_next[0] = start_step + n_steps
+            return out
+
         preds0 = put(np.zeros((total_steps, n), np.float32), P(None, "dp"))
     else:
-        wmat = None
+        dart_wmat_slice = None
         preds0 = None
 
     # -- validation state ------------------------------------------------
@@ -918,7 +977,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     nbins_goss = 512
 
     def chunk_fn(binned_l, yd_l, yoh_l, wd_l, padm_l, gids_l, vx_r, vy_r,
-                 wmat_r, carry, steps):
+                 wmat_r, step_off, carry, steps):
         n_l = binned_l.shape[0]
 
         def goss_select(g, h, key):
@@ -952,7 +1011,9 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             it = st // k
 
             if is_dart:
-                base = init + jnp.einsum("t,tn->n", wmat_r[st], preds_l)
+                # wmat_r holds only this chunk's schedule rows
+                base = init + jnp.einsum("t,tn->n", wmat_r[st - step_off],
+                                         preds_l)
             elif is_rf:
                 base = jnp.full_like(scores_l, init)
             else:
@@ -1070,7 +1131,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         (row_spec if wd is not None else None),
         row_spec,
         (row_spec if gids is not None else None),
-        rep, rep, rep,
+        rep, rep, rep, rep,
         carry_spec, rep,
     )
     tree_spec = Tree(*([rep] * 8))
@@ -1083,71 +1144,32 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
 
     smapped = shard_map(chunk_fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_vma=False)
-    jitted = jax.jit(smapped, donate_argnums=9)
+    jitted = jax.jit(smapped, donate_argnums=10)
 
-    esr = p.early_stopping_round
     total_iters = p.num_iterations
-    chunk = max(esr, 16) if (track and esr > 0) else total_iters
-    if track_rank:
-        nv = max(1, int(vy_h.shape[0]))
-        chunk = min(chunk, max(1, 4_000_000 // nv))
-    chunk = max(1, min(chunk, total_iters))
+    chunk = _compute_chunk(p, tracker, track_rank, total_iters,
+                           int(vsum0.shape[0]))
+    if is_dart:
+        # bound the replicated per-chunk schedule slice ([chunk*k, T])
+        chunk = min(chunk, max(1, 256 // max(1, k)))
+
+    def run(carry, steps, start_iter):
+        if is_dart:
+            wm = put(dart_wmat_slice(start_iter * k, len(steps)), rep)
+        else:
+            wm = None
+        off = put(np.int32(start_iter * k), rep)
+        return jitted(binned, yd, yoh, wd, padm, gids, vx_d, vy_d,
+                      wm, off, carry, put(np.asarray(steps), rep))
 
     carry = (scores, vsum0,
              preds0 if is_dart else put(np.zeros((1, 1), np.float32), rep),
              put(jax.random.PRNGKey(p.seed), rep))
-    tree_chunks = []
-    stop_steps: Optional[int] = None
-    done_iters = 0
-    while done_iters < total_iters and stop_steps is None:
-        steps = put(np.arange(done_iters * k, (done_iters + chunk) * k), rep)
-        carry, ys = jitted(binned, yd, yoh, wd, padm, gids, vx_d, vy_d,
-                           wmat, carry, steps)
-        tree_chunks.append(jax.tree_util.tree_map(np.asarray, ys[0]))
-        n_it = min(chunk, total_iters - done_iters)
-        if track_dev:
-            per_iter = np.asarray(ys[1])[k - 1::k][:n_it]
-        elif track_rank:
-            vsnap = np.asarray(ys[1])
-            per_iter = [
-                _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position)
-                for i in range(n_it)
-            ]
-        else:
-            per_iter = []
-        for i, m in enumerate(per_iter):
-            if tracker.record(float(m), done_iters + i):
-                stop_steps = (done_iters + i + 1) * k
-                break
-        done_iters += chunk
-
-    stacked = jax.tree_util.tree_map(
-        lambda *xs: np.concatenate(xs, axis=0), *tree_chunks)
-    keep_steps = stop_steps if stop_steps is not None else total_iters * k
-    stacked = jax.tree_util.tree_map(lambda a: a[:keep_steps], stacked)
-
-    t_total = stacked.split_feature.shape[0]
-    if is_dart:
-        tree_weights = dart_w_final[:t_total]
-    else:
-        tree_weights = np.full(
-            t_total, 1.0 / (t_total / max(k, 1)) if is_rf else 1.0,
-            np.float32)
-    booster = Booster(
-        trees_feature=stacked.split_feature,
-        trees_threshold=stacked.threshold,
-        trees_left=stacked.left_child,
-        trees_right=stacked.right_child,
-        trees_value=stacked.leaf_value,
-        trees_cover=stacked.cover,
-        trees_gain=stacked.gain,
-        tree_weights=tree_weights,
-        params=p, init_score=init, num_class=k, num_features=f,
-        best_iteration=tracker.final_best_iter(), feature_names=feature_names,
-        eval_history=tracker.history)
-    booster.feature_importance_split, booster.feature_importance_gain = (
-        _importances(booster, f))
-    return booster
+    stacked = _chunked_boost_loop(
+        run, carry, tracker, p, k, total_iters, chunk, track_dev, track_rank,
+        vy_h if track else None, vg_h if track else None)
+    return _assemble_booster(stacked, p, k, init, f, feature_names, tracker,
+                             dart_w_final=dart_w_final if is_dart else None)
 
 
 def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,

@@ -112,7 +112,10 @@ class DriverRendezvous:
         """Join the collector; raises if rendezvous failed or is incomplete
         (a silent empty roster must not look like success)."""
         if self._thread is not None:
-            self._thread.join(self.timeout)
+            # run() legitimately takes up to one timeout per worker (accept
+            # + readline each reset the clock); joining for less would
+            # declare failure while the thread later hands out ranks
+            self._thread.join(self.timeout * (self.num_workers + 1))
         if self.error is not None:
             raise RuntimeError(
                 f"rendezvous failed after collecting "
@@ -157,6 +160,12 @@ def initialize(coordinator_address: Optional[str] = None,
     with backoff otherwise, mirroring the reference's networkInit ladder.
     """
     if _state["initialized"]:
+        return False
+    already = getattr(jax.distributed, "is_initialized", None)
+    if already is not None and already():
+        # initialized outside this module (auto-init on a pod, another
+        # library): adopt it, don't retry into a permanent error
+        _state["initialized"] = True
         return False
     coordinator_address = coordinator_address or os.environ.get(
         "SYNAPSEML_COORDINATOR")
