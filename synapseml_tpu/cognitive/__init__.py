@@ -1,0 +1,25 @@
+"""Cognitive services layer — the reference's largest module (9,186 LoC
+Scala), rebuilt over the table-native HTTP stack (SURVEY.md §2.8).
+"""
+from synapseml_tpu.cognitive.base import (  # noqa: F401
+    BatchedTextServiceBase,
+    CognitiveServicesBase,
+    HasServiceParams,
+    ServiceParam,
+)
+from synapseml_tpu.cognitive.services import (  # noqa: F401
+    AnalyzeImage,
+    AzureSearchWriter,
+    BingImageSearch,
+    DescribeImage,
+    DetectEntireSeries,
+    DetectFace,
+    DetectLastAnomaly,
+    KeyPhraseExtractor,
+    LanguageDetector,
+    NER,
+    OCR,
+    SpeechToText,
+    TextSentiment,
+    Translate,
+)

@@ -1,0 +1,7 @@
+"""Cyber anomalous-access detection (SURVEY.md §2.4 cyber module —
+~1,800 LoC of Python in the reference)."""
+from synapseml_tpu.cyber.anomaly import (  # noqa: F401
+    AccessAnomaly,
+    AccessAnomalyModel,
+    ComplementAccessTransformer,
+)

@@ -433,7 +433,7 @@ def _compute_chunk(p: BoostParams, tracker, track_rank: bool,
 
 def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
                         total_iters: int, chunk: int, track_dev: bool,
-                        track_rank: bool, vy_h, vg_h):
+                        track_rank: bool, vy_h, vg_h, on_chunk=None):
     """Drive the jitted chunk scans; metrics/early-stop applied host-side.
 
     ``run(carry, steps, chunk_start_iter) -> (carry, ys)`` where ``ys[0]``
@@ -465,6 +465,14 @@ def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
                 stop_steps = (done_iters + i + 1) * k
                 break
         done_iters += chunk
+        if on_chunk is not None and stop_steps is None:
+            # hand over only this chunk's kept trees; the callback
+            # accumulates (keeps checkpoint overhead linear per chunk)
+            kept = max(0, min(done_iters, total_iters)
+                       - (done_iters - chunk)) * k
+            on_chunk(
+                jax.tree_util.tree_map(lambda a: a[:kept], tree_chunks[-1]),
+                min(done_iters, total_iters))
     stacked = jax.tree_util.tree_map(
         lambda *xs: np.concatenate(xs, axis=0), *tree_chunks)
     keep = stop_steps if stop_steps is not None else total_iters * k
@@ -472,7 +480,8 @@ def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
 
 
 def _assemble_booster(stacked, p: BoostParams, k: int, init: float, f: int,
-                      feature_names, tracker, dart_w_final=None) -> Booster:
+                      feature_names, tracker, dart_w_final=None,
+                      compute_importances: bool = True) -> Booster:
     t_total = stacked.split_feature.shape[0]
     if dart_w_final is not None:
         tree_weights = np.asarray(dart_w_final[:t_total], np.float32)
@@ -498,8 +507,9 @@ def _assemble_booster(stacked, p: BoostParams, k: int, init: float, f: int,
         feature_names=feature_names,
         eval_history=tracker.history,
     )
-    booster.feature_importance_split, booster.feature_importance_gain = (
-        _importances(booster, f))
+    if compute_importances:
+        booster.feature_importance_split, booster.feature_importance_gain = (
+            _importances(booster, f))
     return booster
 
 
@@ -670,8 +680,20 @@ def train(
     valid_sets: Sequence[Tuple[np.ndarray, np.ndarray]] = (),
     feature_names: Optional[List[str]] = None,
     mesh=None,
+    init_model: Optional[Booster] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
 ) -> Booster:
-    """Train a Booster. ``mesh`` enables dp-sharded histogram training."""
+    """Train a Booster. ``mesh`` enables dp-sharded histogram training.
+
+    ``init_model`` continues boosting from an existing booster's margins —
+    the reference's batch-model threading (``setModelString``,
+    ref: lightgbm/.../LightGBMBase.scala:49-61) and the resume half of
+    step-level checkpointing. ``checkpoint_dir`` + ``checkpoint_every``
+    write a loadable partial model every N iterations (see
+    :func:`save_checkpoint`/:func:`load_checkpoint`); a killed run resumes
+    via ``load_checkpoint`` + ``init_model``.
+    """
     x = np.asarray(x, dtype=np.float64)
     y = np.asarray(y, dtype=np.float32)
     n, f = x.shape
@@ -697,6 +719,10 @@ def train(
     # Dispatch happens BEFORE any host->device transfer so the large [N,F]
     # matrix is only placed once, with its mesh sharding.
     if mesh is not None:
+        if init_model is not None or checkpoint_dir is not None:
+            raise NotImplementedError(
+                "init_model/checkpointing are single-device for now; "
+                "fit the resumed model without a mesh")
         return _train_distributed(
             p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
             thresholds, valid_sets, feature_names, group=group)
@@ -707,7 +733,23 @@ def train(
     group_ids = jnp.asarray(group, jnp.int32) if group is not None else None
     is_rf = p.boosting_type == "rf"
 
-    if k > 1:
+    if init_model is not None:
+        if p.boosting_type in ("dart", "rf"):
+            raise NotImplementedError(
+                f"init_model continuation is not defined for "
+                f"{p.boosting_type} (dart rescales past trees; rf averages)")
+        if init_model.num_class != k:
+            raise ValueError("init_model num_class mismatch")
+        # continue from the existing margins; keep its init score so the
+        # combined booster's folded-init semantics stay consistent.
+        # num_iteration is passed explicitly: predict_raw would otherwise
+        # truncate at best_iteration while _with_init prepends ALL trees
+        init = float(init_model.init_score)
+        n_init_iters = init_model.num_trees // max(k, 1)
+        base_raw = init_model.predict_raw(x, num_iteration=n_init_iters)
+        scores = jnp.asarray(
+            base_raw.reshape(n, k) if k > 1 else base_raw, jnp.float32)
+    elif k > 1:
         scores = jnp.zeros((n, k), jnp.float32) + init
     else:
         scores = jnp.zeros(n, jnp.float32) + init
@@ -715,6 +757,10 @@ def train(
     if p.boosting_type == "dart":
         if k > 1:
             raise NotImplementedError("dart + multiclass not yet supported")
+        if checkpoint_dir is not None:
+            raise NotImplementedError(
+                "step checkpointing is not defined for dart (past trees "
+                "are rescaled every round)")
         return _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init,
                            n, f, valid_sets, feature_names)
 
@@ -734,6 +780,14 @@ def train(
         vg_h = tracker.sets[0][3]
         vsum0 = tracker.sets[0][2]
         vy_h = np.asarray(tracker.sets[0][1])
+        if init_model is not None:
+            # valid margins must include the resumed model's contribution
+            # (full stack, not best_iteration-truncated — see above)
+            vraw = init_model.predict_raw(
+                np.asarray(tracker.sets[0][0]),
+                num_iteration=init_model.num_trees // max(k, 1))
+            vsum0 = jnp.asarray(
+                vraw.reshape(-1, k) - init, jnp.float32)
     else:
         vsum0 = jnp.zeros((0, k), jnp.float32)
 
@@ -756,13 +810,109 @@ def train(
     total_iters = p.num_iterations
     chunk = _compute_chunk(p, tracker, track_rank, total_iters,
                            int(vsum0.shape[0]))
+    if checkpoint_dir is not None and checkpoint_every > 0:
+        chunk = min(chunk, max(1, int(checkpoint_every)))
+
+    def _with_init(stacked):
+        """Prepend init_model trees so the result is one whole booster."""
+        if init_model is None:
+            return stacked
+        m_new = stacked.split_feature.shape[1]
+        m_old = init_model.trees_feature.shape[1]
+        m = max(m_new, m_old)
+
+        def padc(a, fill):
+            w = m - a.shape[1]
+            return a if w == 0 else np.pad(
+                a, ((0, 0), (0, w)), constant_values=fill)
+
+        return Tree(
+            split_feature=np.concatenate(
+                [padc(init_model.trees_feature, -1),
+                 padc(stacked.split_feature, -1)]),
+            threshold=np.concatenate(
+                [padc(init_model.trees_threshold, 0),
+                 padc(stacked.threshold, 0)]),
+            threshold_bin=np.concatenate(
+                [padc(np.zeros_like(init_model.trees_feature), 0),
+                 padc(stacked.threshold_bin, 0)]),
+            left_child=np.concatenate(
+                [padc(init_model.trees_left, 0), padc(stacked.left_child, 0)]),
+            right_child=np.concatenate(
+                [padc(init_model.trees_right, 0),
+                 padc(stacked.right_child, 0)]),
+            leaf_value=np.concatenate(
+                [padc(init_model.trees_value
+                      * init_model.tree_weights[:, None], 0),
+                 padc(stacked.leaf_value, 0)]),
+            cover=np.concatenate(
+                [padc(init_model.trees_cover, 0), padc(stacked.cover, 0)]),
+            gain=np.concatenate(
+                [padc(init_model.trees_gain, 0), padc(stacked.gain, 0)]),
+        )
+
+    on_chunk = None
+    if checkpoint_dir is not None:
+        _ck_acc: List = []
+
+        def on_chunk(chunk_trees, iters_done):
+            _ck_acc.append(chunk_trees)
+            stacked_ck = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *_ck_acc)
+            booster = _assemble_booster(
+                _with_init(stacked_ck), p, k, init, f, feature_names,
+                tracker, compute_importances=False)
+            if init_model is not None and booster.best_iteration >= 0:
+                booster.best_iteration += init_model.num_trees // max(k, 1)
+            save_checkpoint(checkpoint_dir, booster, iters_done,
+                            p.num_iterations)
+
     carry = (scores, vsum0, jax.random.PRNGKey(p.seed))
     stacked = _chunked_boost_loop(
         lambda c, steps, start: scan_fn(c, steps, consts),
         carry, tracker, p, k, total_iters, chunk, track_dev, track_rank,
         vy_h if tracker.enabled else None,
-        vg_h if tracker.enabled else None)
-    return _assemble_booster(stacked, p, k, init, f, feature_names, tracker)
+        vg_h if tracker.enabled else None, on_chunk=on_chunk)
+    booster = _assemble_booster(_with_init(stacked), p, k, init, f,
+                                feature_names, tracker)
+    if init_model is not None and booster.best_iteration >= 0:
+        # best_iteration indexes the combined tree stack
+        booster.best_iteration += init_model.num_trees // max(k, 1)
+    return booster
+
+
+def save_checkpoint(path: str, booster: Booster, iterations_done: int,
+                    total_iterations: int):
+    """Atomic step-level checkpoint (the orbax-style step checkpoint
+    SURVEY.md §5 calls for; the reference only threads whole batch models).
+
+    One file, one os.replace: metadata and model can never disagree under
+    a mid-write kill.
+    """
+    import os
+    import tempfile
+
+    os.makedirs(path, exist_ok=True)
+    payload = json.dumps({
+        "iterations_done": int(iterations_done),
+        "total_iterations": int(total_iterations),
+        "model": booster.save_string(),
+    })
+    fd, tmp = tempfile.mkstemp(dir=path)
+    with os.fdopen(fd, "w") as fh:
+        fh.write(payload)
+    os.replace(tmp, os.path.join(path, "checkpoint.json"))
+
+
+def load_checkpoint(path: str) -> Tuple[Booster, Dict[str, int]]:
+    """Load a step checkpoint; resume with
+    ``train(replace(p, num_iterations=total-done), x, y, init_model=booster)``."""
+    import os
+
+    with open(os.path.join(path, "checkpoint.json")) as fh:
+        payload = json.load(fh)
+    booster = Booster.load_string(payload.pop("model"))
+    return booster, payload
 
 
 def _importances(b: Booster, num_features: int):

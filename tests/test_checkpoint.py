@@ -1,0 +1,118 @@
+"""Step-level checkpoint / resume tests (VERDICT missing #10; SURVEY.md §5
+checkpoint/resume — the reference only threads whole batch models via
+setModelString, ref: LightGBMBase.scala:49-61)."""
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.gbdt.boosting import (Booster, BoostParams,
+                                         load_checkpoint, train)
+
+RNG = np.random.default_rng(5)
+X = RNG.normal(size=(500, 6))
+Y = (X[:, 0] + X[:, 1] * X[:, 2] > 0).astype(np.float64)
+
+
+def test_init_model_continuation_equals_uninterrupted():
+    """Training 8 then 12-more iterations from init_model must equal one
+    uninterrupted 20-iteration run (deterministic gbdt)."""
+    p20 = BoostParams(objective="binary", num_iterations=20, num_leaves=7)
+    full = train(p20, X, Y)
+    first = train(dataclasses.replace(p20, num_iterations=8), X, Y)
+    resumed = train(dataclasses.replace(p20, num_iterations=12), X, Y,
+                    init_model=first)
+    assert resumed.num_trees == 20
+    np.testing.assert_allclose(resumed.predict(X), full.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_checkpoint_files_written_and_loadable(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    p = BoostParams(objective="binary", num_iterations=12, num_leaves=7)
+    train(p, X, Y, checkpoint_dir=ckpt, checkpoint_every=4)
+    b, meta = load_checkpoint(ckpt)
+    assert meta["total_iterations"] == 12
+    assert meta["iterations_done"] in (4, 8, 12)
+    assert b.num_trees == meta["iterations_done"]
+
+
+def test_kill_mid_fit_and_resume_to_equivalent_model(tmp_path):
+    """The VERDICT's done-when: kill a fit mid-run, resume to an
+    equivalent model. The child trains 400 slow iterations with
+    checkpoints every 3; the parent SIGKILLs it once a checkpoint lands,
+    then resumes the remaining iterations of a 20-iteration target."""
+    ckpt = str(tmp_path / "ck")
+    data = str(tmp_path / "data.npz")
+    np.savez(data, x=X, y=Y)
+    code = f"""
+import numpy as np
+from synapseml_tpu.gbdt.boosting import BoostParams, train
+d = np.load({data!r})
+p = BoostParams(objective="binary", num_iterations=400, num_leaves=7)
+train(p, d["x"], d["y"], checkpoint_dir={ckpt!r}, checkpoint_every=3)
+"""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "."
+    child = subprocess.Popen([sys.executable, "-c", code], env=env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 240
+        meta_path = os.path.join(ckpt, "checkpoint.json")
+        while time.monotonic() < deadline:
+            if os.path.exists(meta_path):
+                with open(meta_path) as fh:
+                    if json.load(fh)["iterations_done"] >= 3:
+                        break
+            if child.poll() is not None:
+                pytest.fail("child exited before being killed")
+            time.sleep(0.2)
+        else:
+            pytest.fail("no checkpoint appeared in time")
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+    booster, meta = load_checkpoint(ckpt)
+    done = meta["iterations_done"]
+    assert booster.num_trees == done
+    assert done < 400  # genuinely killed mid-run
+
+    # resume to a target past the kill point; must equal an uninterrupted
+    # run of the same total length (deterministic gbdt)
+    target = done + 10
+    resumed = train(
+        BoostParams(objective="binary", num_iterations=target - done,
+                    num_leaves=7), X, Y, init_model=booster)
+    full = train(BoostParams(objective="binary", num_iterations=target,
+                             num_leaves=7), X, Y)
+    assert resumed.num_trees == target
+    np.testing.assert_allclose(resumed.predict(X), full.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_resume_with_early_stopping_offsets_best_iteration():
+    Xv = RNG.normal(size=(150, 6))
+    yv = (Xv[:, 0] + Xv[:, 1] * Xv[:, 2] > 0).astype(np.float64)
+    first = train(BoostParams(objective="binary", num_iterations=5,
+                              num_leaves=5), X, Y)
+    resumed = train(
+        BoostParams(objective="binary", num_iterations=300, num_leaves=5,
+                    early_stopping_round=5), X, Y,
+        valid_sets=[(Xv, yv)], init_model=first)
+    assert resumed.best_iteration >= 5  # offset past the init trees
+    # truncated predict uses combined-stack indices and stays sane
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(yv, resumed.predict(Xv)) > 0.9

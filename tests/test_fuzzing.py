@@ -158,6 +158,40 @@ def _mock_server():
     httpd.server_close()
 
 
+def _svc(cls, **bindings):
+    """Construct a cognitive service against the echo mock; string values
+    bind columns, non-strings (or *_value suffix) set literals."""
+    svc = cls(url=_CTX["url"], backoffs=())
+    for name, v in bindings.items():
+        if isinstance(v, str):
+            svc.set_service_col(name, v)
+        else:
+            svc.set_service_value(name, v)
+    return svc
+
+
+def _series_table():
+    col = np.empty(2, dtype=object)
+    col[:] = [[(f"2024-01-0{i + 1}", float(i)) for i in range(4)]] * 2
+    return Table({"series": col})
+
+
+def _access_table():
+    rng = np.random.default_rng(RNG_SEED)
+    n = 40
+    return Table({
+        "user": np.array([f"u{rng.integers(0, 8)}" for _ in range(n)],
+                         dtype=object),
+        "res": np.array([f"r{rng.integers(0, 6)}" for _ in range(n)],
+                        dtype=object),
+    })
+
+
+def _url_table():
+    return Table({"url": np.array(["http://x/a.png", "http://x/b.png"],
+                                  dtype=object)})
+
+
 def _resp_table():
     from synapseml_tpu.io.http import HTTPTransformer
 
@@ -177,6 +211,14 @@ def _test_objects():
     from synapseml_tpu.automl.automl import (FindBestModel, HyperparamBuilder,
                                              MetricEvaluator,
                                              TuneHyperparameters)
+    from synapseml_tpu.cognitive import (AnalyzeImage, BingImageSearch,
+                                         DescribeImage, DetectEntireSeries,
+                                         DetectFace, DetectLastAnomaly,
+                                         KeyPhraseExtractor, LanguageDetector,
+                                         NER, OCR, SpeechToText,
+                                         TextSentiment, Translate)
+    from synapseml_tpu.cyber import (AccessAnomaly,
+                                     ComplementAccessTransformer)
     from synapseml_tpu.data.batching import (DynamicMiniBatchTransformer,
                                              FixedMiniBatchTransformer,
                                              FlattenBatch,
@@ -516,6 +558,40 @@ def _test_objects():
         "Timer": lambda: (st.Timer(stage=st.DropColumns(cols=["b"])), num()),
         "UnicodeNormalize": lambda: (st.UnicodeNormalize(
             input_col="cat", output_col="catN"), mixed_table()),
+        # cognitive (echo mock: shapes exercise request building + the
+        # parse/error plumbing; Azure-shaped replies live in test_cognitive)
+        "TextSentiment": lambda: (_svc(TextSentiment, text="text"),
+                                  _text_table()),
+        "NER": lambda: (_svc(NER, text="text"), _text_table()),
+        "KeyPhraseExtractor": lambda: (_svc(KeyPhraseExtractor, text="text"),
+                                       _text_table()),
+        "LanguageDetector": lambda: (_svc(LanguageDetector, text="text"),
+                                     _text_table()),
+        "DetectLastAnomaly": lambda: (_svc(DetectLastAnomaly,
+                                           series="series"), _series_table()),
+        "DetectEntireSeries": lambda: (_svc(DetectEntireSeries,
+                                            series="series"),
+                                       _series_table()),
+        "AnalyzeImage": lambda: (_svc(AnalyzeImage, image_url="url"),
+                                 _url_table()),
+        "DescribeImage": lambda: (_svc(DescribeImage, image_url="url"),
+                                  _url_table()),
+        "OCR": lambda: (_svc(OCR, image_url="url"), _url_table()),
+        "DetectFace": lambda: (_svc(DetectFace, image_url="url"),
+                               _url_table()),
+        "Translate": lambda: (_svc(Translate, text="text",
+                                   to_language=["fr"]), _text_table()),
+        "BingImageSearch": lambda: (_svc(BingImageSearch, query="text"),
+                                    _text_table()),
+        "SpeechToText": lambda: (_svc(SpeechToText, audio_bytes="audio"),
+                                 Table({"audio": np.array(
+                                     [b"RIFFxx", b"RIFFyy"], dtype=object)})),
+        # cyber ----------------------------------------------------------
+        "AccessAnomaly": lambda: (AccessAnomaly(
+            rank_param=4, max_iter=4, tenant_col=None), _access_table()),
+        "ComplementAccessTransformer": lambda: (ComplementAccessTransformer(
+            indexed_col_names=("user", "res"), complementset_factor=1),
+            _access_table()),
         # train ----------------------------------------------------------
         "TrainClassifier": lambda: (TrainClassifier(
             model=LightGBMClassifier(num_iterations=3, num_leaves=3),
@@ -538,6 +614,8 @@ EXEMPT = {
     "Pipeline", "PipelineModel",
     # abstract explainer base (concrete subclasses are all fuzzed)
     "LocalExplainer",
+    # abstract cognitive bases (every concrete service is fuzzed)
+    "CognitiveServicesBase", "BatchedTextServiceBase",
 }
 
 # fitted-model classes: covered transitively — the named estimator's fuzz
@@ -569,6 +647,7 @@ COVERED_BY_ESTIMATOR = {
     "TimerModel": "Timer",
     "TrainedClassifierModel": "TrainClassifier",
     "TrainedRegressorModel": "TrainRegressor",
+    "AccessAnomalyModel": "AccessAnomaly",
 }
 
 
