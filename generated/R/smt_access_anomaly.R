@@ -1,0 +1,38 @@
+#' AccessAnomaly
+#'
+#' Per-tenant ALS anomalous-access estimator
+#'
+#' @param apply_implicit_cf add complement-set negatives
+#' @param complementset_factor negative samples per observed row
+#' @param high_value scaled likelihood upper bound
+#' @param likelihood_col access likelihood/count column (None = 1.0)
+#' @param low_value scaled likelihood lower bound
+#' @param max_iter ALS iterations
+#' @param output_col anomaly score column
+#' @param rank_param latent factors
+#' @param reg_param ALS regularization
+#' @param res_col resource column
+#' @param seed rng seed
+#' @param tenant_col tenant column (None = single tenant)
+#' @param user_col user column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_access_anomaly <- function(apply_implicit_cf = TRUE, complementset_factor = 2, high_value = 10.0, likelihood_col = NULL, low_value = 5.0, max_iter = 25, output_col = "anomaly_score", rank_param = 10, reg_param = 0.1, res_col = "res", seed = 0, tenant_col = "tenant", user_col = "user") {
+  mod <- reticulate::import("synapseml_tpu.cyber.anomaly")
+  kwargs <- Filter(Negate(is.null), list(
+    apply_implicit_cf = apply_implicit_cf,
+    complementset_factor = complementset_factor,
+    high_value = high_value,
+    likelihood_col = likelihood_col,
+    low_value = low_value,
+    max_iter = max_iter,
+    output_col = output_col,
+    rank_param = rank_param,
+    reg_param = reg_param,
+    res_col = res_col,
+    seed = seed,
+    tenant_col = tenant_col,
+    user_col = user_col
+  ))
+  do.call(mod$AccessAnomaly, kwargs)
+}

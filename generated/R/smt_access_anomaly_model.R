@@ -1,0 +1,22 @@
+#' AccessAnomalyModel
+#'
+#' (ref: collaborative_filtering.py:161 AccessAnomalyModel).
+#'
+#' @param mappings per-tenant {users, user_vecs, ress, res_vecs, mean, std}
+#' @param output_col anomaly score column
+#' @param res_col resource column
+#' @param tenant_col tenant column
+#' @param user_col user column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_access_anomaly_model <- function(mappings = NULL, output_col = "anomaly_score", res_col = "res", tenant_col = "tenant", user_col = "user") {
+  mod <- reticulate::import("synapseml_tpu.cyber.anomaly")
+  kwargs <- Filter(Negate(is.null), list(
+    mappings = mappings,
+    output_col = output_col,
+    res_col = res_col,
+    tenant_col = tenant_col,
+    user_col = user_col
+  ))
+  do.call(mod$AccessAnomalyModel, kwargs)
+}

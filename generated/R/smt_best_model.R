@@ -1,0 +1,16 @@
+#' BestModel
+#'
+#' @param all_metrics metric per candidate
+#' @param best_metric winning metric
+#' @param best_model winning model
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_best_model <- function(all_metrics = NULL, best_metric = NULL, best_model = NULL) {
+  mod <- reticulate::import("synapseml_tpu.automl.automl")
+  kwargs <- Filter(Negate(is.null), list(
+    all_metrics = all_metrics,
+    best_metric = best_metric,
+    best_model = best_model
+  ))
+  do.call(mod$BestModel, kwargs)
+}

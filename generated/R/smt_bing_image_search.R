@@ -1,0 +1,30 @@
+#' BingImageSearch
+#'
+#' (ref: BingImageSearch.scala:309).
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param count results per query
+#' @param error_col error column
+#' @param output_col parsed output column
+#' @param query search query
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_bing_image_search <- function(backoffs = c(100, 500, 1000), concurrency = 4, count = NULL, error_col = "errors", output_col = "out", query = NULL, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    count = count,
+    error_col = error_col,
+    output_col = output_col,
+    query = query,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$BingImageSearch, kwargs)
+}

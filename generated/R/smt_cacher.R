@@ -1,0 +1,16 @@
+#' Cacher
+#'
+#' Materializes/pins the table (ref: stages/Cacher.scala:43).
+#'
+#' @param device_put stage numeric columns onto the default device
+#' @param disable pass-through when true
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_cacher <- function(device_put = TRUE, disable = FALSE) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    device_put = device_put,
+    disable = disable
+  ))
+  do.call(mod$Cacher, kwargs)
+}

@@ -1,0 +1,18 @@
+#' ClassBalancer
+#'
+#' Adds a weight column inversely proportional to class frequency
+#'
+#' @param broadcast_join kept for API parity; join is columnar here
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_class_balancer <- function(broadcast_join = TRUE, input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    broadcast_join = broadcast_join,
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$ClassBalancer, kwargs)
+}

@@ -1,0 +1,16 @@
+#' ClassBalancerModel
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param weights class -> weight
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_class_balancer_model <- function(input_col = "input", output_col = "output", weights = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    weights = weights
+  ))
+  do.call(mod$ClassBalancerModel, kwargs)
+}

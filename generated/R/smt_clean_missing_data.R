@@ -1,0 +1,20 @@
+#' CleanMissingData
+#'
+#' Impute missing values per column: mean / median / custom constant
+#'
+#' @param cleaning_mode 'Mean' | 'Median' | 'Custom'
+#' @param custom_value replacement for Custom mode
+#' @param input_cols columns to clean
+#' @param output_cols output column names
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_clean_missing_data <- function(cleaning_mode = "Mean", custom_value = NULL, input_cols = NULL, output_cols = NULL) {
+  mod <- reticulate::import("synapseml_tpu.featurize.clean")
+  kwargs <- Filter(Negate(is.null), list(
+    cleaning_mode = cleaning_mode,
+    custom_value = custom_value,
+    input_cols = input_cols,
+    output_cols = output_cols
+  ))
+  do.call(mod$CleanMissingData, kwargs)
+}

@@ -1,0 +1,16 @@
+#' CleanMissingDataModel
+#'
+#' @param fill_values column -> replacement value
+#' @param input_cols columns to clean
+#' @param output_cols output column names (default: in place)
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_clean_missing_data_model <- function(fill_values = NULL, input_cols = NULL, output_cols = NULL) {
+  mod <- reticulate::import("synapseml_tpu.featurize.clean")
+  kwargs <- Filter(Negate(is.null), list(
+    fill_values = fill_values,
+    input_cols = input_cols,
+    output_cols = output_cols
+  ))
+  do.call(mod$CleanMissingDataModel, kwargs)
+}

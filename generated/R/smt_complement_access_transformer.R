@@ -1,0 +1,20 @@
+#' ComplementAccessTransformer
+#'
+#' Sample (user, res) pairs NOT present in the input — negative
+#'
+#' @param complementset_factor complement rows per observed row
+#' @param indexed_col_names the (user, res) index columns
+#' @param partition_key tenant column (None = single tenant)
+#' @param seed rng seed
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_complement_access_transformer <- function(complementset_factor = 2, indexed_col_names = c("user", "res"), partition_key = NULL, seed = 0) {
+  mod <- reticulate::import("synapseml_tpu.cyber.anomaly")
+  kwargs <- Filter(Negate(is.null), list(
+    complementset_factor = complementset_factor,
+    indexed_col_names = indexed_col_names,
+    partition_key = partition_key,
+    seed = seed
+  ))
+  do.call(mod$ComplementAccessTransformer, kwargs)
+}

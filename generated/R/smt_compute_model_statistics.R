@@ -1,0 +1,20 @@
+#' ComputeModelStatistics
+#'
+#' Classification/regression metrics as a Transformer
+#'
+#' @param evaluation_metric classification | regression | auto
+#' @param label_col name of the label column
+#' @param scored_probabilities_col probability column (binary AUC)
+#' @param scores_col prediction column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_compute_model_statistics <- function(evaluation_metric = "auto", label_col = "label", scored_probabilities_col = "probability", scores_col = "prediction") {
+  mod <- reticulate::import("synapseml_tpu.train.train")
+  kwargs <- Filter(Negate(is.null), list(
+    evaluation_metric = evaluation_metric,
+    label_col = label_col,
+    scored_probabilities_col = scored_probabilities_col,
+    scores_col = scores_col
+  ))
+  do.call(mod$ComputeModelStatistics, kwargs)
+}

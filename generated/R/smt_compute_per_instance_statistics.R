@@ -1,0 +1,22 @@
+#' ComputePerInstanceStatistics
+#'
+#' Per-row residuals / log-loss (ref: ComputePerInstanceStatistics.scala:45).
+#'
+#' @param evaluation_metric classification | regression | auto
+#' @param label_col name of the label column
+#' @param label_values ordered class values; maps non 0..k-1 labels (e.g. {-1,1}) to probability-matrix columns, as the reference does with indexed labels
+#' @param scored_probabilities_col probability column
+#' @param scores_col prediction column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_compute_per_instance_statistics <- function(evaluation_metric = "auto", label_col = "label", label_values = NULL, scored_probabilities_col = "probability", scores_col = "prediction") {
+  mod <- reticulate::import("synapseml_tpu.train.train")
+  kwargs <- Filter(Negate(is.null), list(
+    evaluation_metric = evaluation_metric,
+    label_col = label_col,
+    label_values = label_values,
+    scored_probabilities_col = scored_probabilities_col,
+    scores_col = scores_col
+  ))
+  do.call(mod$ComputePerInstanceStatistics, kwargs)
+}

@@ -1,0 +1,24 @@
+#' ConditionalKNN
+#'
+#' kNN restricted per-query to an allowed label set
+#'
+#' @param conditioner_col per-query allowed label set column
+#' @param input_col name of the input column
+#' @param k neighbours per query
+#' @param label_col index label column
+#' @param output_col name of the output column
+#' @param values_col payload column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_conditional_knn <- function(conditioner_col = "conditioner", input_col = "input", k = 5, label_col = "labels", output_col = "output", values_col = NULL) {
+  mod <- reticulate::import("synapseml_tpu.knn.knn")
+  kwargs <- Filter(Negate(is.null), list(
+    conditioner_col = conditioner_col,
+    input_col = input_col,
+    k = k,
+    label_col = label_col,
+    output_col = output_col,
+    values_col = values_col
+  ))
+  do.call(mod$ConditionalKNN, kwargs)
+}

@@ -1,0 +1,24 @@
+#' ConditionalKNNModel
+#'
+#' @param conditioner_col per-query allowed label set column
+#' @param index [N, D] feature matrix
+#' @param input_col name of the input column
+#' @param k neighbours per query
+#' @param labels label per index row
+#' @param output_col name of the output column
+#' @param values payload per index row
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_conditional_knn_model <- function(conditioner_col = "conditioner", index = NULL, input_col = "input", k = 5, labels = NULL, output_col = "output", values = NULL) {
+  mod <- reticulate::import("synapseml_tpu.knn.knn")
+  kwargs <- Filter(Negate(is.null), list(
+    conditioner_col = conditioner_col,
+    index = index,
+    input_col = input_col,
+    k = k,
+    labels = labels,
+    output_col = output_col,
+    values = values
+  ))
+  do.call(mod$ConditionalKNNModel, kwargs)
+}

@@ -1,0 +1,16 @@
+#' CountSelector
+#'
+#' Drops vector slots that are zero for every row (ref: CountSelector.scala:23).
+#'
+#' @param input_col vector input column
+#' @param output_col output column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_count_selector <- function(input_col = "features", output_col = "features") {
+  mod <- reticulate::import("synapseml_tpu.featurize.clean")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$CountSelector, kwargs)
+}

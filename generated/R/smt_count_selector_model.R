@@ -1,0 +1,16 @@
+#' CountSelectorModel
+#'
+#' @param indices slot indices to keep
+#' @param input_col vector input column
+#' @param output_col output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_count_selector_model <- function(indices = NULL, input_col = "features", output_col = "features") {
+  mod <- reticulate::import("synapseml_tpu.featurize.clean")
+  kwargs <- Filter(Negate(is.null), list(
+    indices = indices,
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$CountSelectorModel, kwargs)
+}

@@ -1,0 +1,18 @@
+#' CustomInputParser
+#'
+#' User function row-value -> HTTPRequestData (ref: Parsers.scala).
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param udf value -> HTTPRequestData function
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_custom_input_parser <- function(input_col = "input", output_col = "output", udf = NULL) {
+  mod <- reticulate::import("synapseml_tpu.io.http")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    udf = udf
+  ))
+  do.call(mod$CustomInputParser, kwargs)
+}

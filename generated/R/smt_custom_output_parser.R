@@ -1,0 +1,18 @@
+#' CustomOutputParser
+#'
+#' User function HTTPResponseData -> value (ref: Parsers.scala).
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param udf HTTPResponseData -> value function
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_custom_output_parser <- function(input_col = "input", output_col = "output", udf = NULL) {
+  mod <- reticulate::import("synapseml_tpu.io.http")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    udf = udf
+  ))
+  do.call(mod$CustomOutputParser, kwargs)
+}

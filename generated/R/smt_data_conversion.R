@@ -1,0 +1,20 @@
+#' DataConversion
+#'
+#' Cast listed columns to a target type (ref: DataConversion.scala:21).
+#'
+#' @param categorical_models per-column fitted indexers, learned on first transform so repeated batches map values consistently
+#' @param cols columns to convert
+#' @param convert_to target type name
+#' @param date_format strftime format for date→string
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_data_conversion <- function(categorical_models = NULL, cols = NULL, convert_to = "double", date_format = "yyyy-MM-dd HH:mm:ss") {
+  mod <- reticulate::import("synapseml_tpu.featurize.clean")
+  kwargs <- Filter(Negate(is.null), list(
+    categorical_models = categorical_models,
+    cols = cols,
+    convert_to = convert_to,
+    date_format = date_format
+  ))
+  do.call(mod$DataConversion, kwargs)
+}

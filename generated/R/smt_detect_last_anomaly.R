@@ -1,0 +1,34 @@
+#' DetectLastAnomaly
+#'
+#' Is the latest point anomalous? (ref: AnomalyDetector.scala
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param granularity series granularity
+#' @param max_anomaly_ratio max anomaly ratio
+#' @param output_col parsed output column
+#' @param sensitivity anomaly sensitivity
+#' @param series list of {timestamp, value} points
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_detect_last_anomaly <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", granularity = NULL, max_anomaly_ratio = NULL, output_col = "out", sensitivity = NULL, series = NULL, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    granularity = granularity,
+    max_anomaly_ratio = max_anomaly_ratio,
+    output_col = output_col,
+    sensitivity = sensitivity,
+    series = series,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$DetectLastAnomaly, kwargs)
+}

@@ -1,0 +1,14 @@
+#' DropColumns
+#'
+#' Drop the named columns (ref: stages/DropColumns.scala).
+#'
+#' @param cols columns to drop
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_drop_columns <- function(cols = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    cols = cols
+  ))
+  do.call(mod$DropColumns, kwargs)
+}

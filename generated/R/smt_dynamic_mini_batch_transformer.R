@@ -1,0 +1,14 @@
+#' DynamicMiniBatchTransformer
+#'
+#' Batch everything currently available (ref: MiniBatchTransformer.scala:52).
+#'
+#' @param max_batch_size maximum rows per batch
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_dynamic_mini_batch_transformer <- function(max_batch_size = 2147483647) {
+  mod <- reticulate::import("synapseml_tpu.data.batching")
+  kwargs <- Filter(Negate(is.null), list(
+    max_batch_size = max_batch_size
+  ))
+  do.call(mod$DynamicMiniBatchTransformer, kwargs)
+}

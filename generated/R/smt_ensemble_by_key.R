@@ -1,0 +1,22 @@
+#' EnsembleByKey
+#'
+#' Group rows by key columns and average the named vector/scalar columns
+#'
+#' @param collapse_group emit one row per key when true
+#' @param cols value columns to ensemble
+#' @param keys key columns
+#' @param strategy only 'mean' is supported, as in the reference
+#' @param vector_dims optional {col: dim} checks
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_ensemble_by_key <- function(collapse_group = TRUE, cols = NULL, keys = NULL, strategy = "mean", vector_dims = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    collapse_group = collapse_group,
+    cols = cols,
+    keys = keys,
+    strategy = strategy,
+    vector_dims = vector_dims
+  ))
+  do.call(mod$EnsembleByKey, kwargs)
+}

@@ -1,0 +1,16 @@
+#' Explode
+#'
+#' One output row per element of an array column (ref: stages/Explode.scala:43).
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_explode <- function(input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$Explode, kwargs)
+}

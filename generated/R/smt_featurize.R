@@ -1,0 +1,22 @@
+#' Featurize
+#'
+#' Auto-featurization (ref: Featurize.scala:36): per input column pick a
+#'
+#' @param impute_missing mean-impute numeric NaNs
+#' @param input_cols columns to featurize (default: all but output)
+#' @param num_features hash slots for high-cardinality/text columns
+#' @param one_hot_encode_categoricals one-hot if cardinality below this
+#' @param output_col name of the output column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_featurize <- function(impute_missing = TRUE, input_cols = NULL, num_features = 256, one_hot_encode_categoricals = 64, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.assemble")
+  kwargs <- Filter(Negate(is.null), list(
+    impute_missing = impute_missing,
+    input_cols = input_cols,
+    num_features = num_features,
+    one_hot_encode_categoricals = one_hot_encode_categoricals,
+    output_col = output_col
+  ))
+  do.call(mod$Featurize, kwargs)
+}

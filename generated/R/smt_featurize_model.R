@@ -1,0 +1,14 @@
+#' FeaturizeModel
+#'
+#' @param inner fitted internal pipeline
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_featurize_model <- function(inner = NULL, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.assemble")
+  kwargs <- Filter(Negate(is.null), list(
+    inner = inner,
+    output_col = output_col
+  ))
+  do.call(mod$FeaturizeModel, kwargs)
+}

@@ -1,0 +1,16 @@
+#' FindBestModel
+#'
+#' Evaluate pre-built models on one dataset, keep the best
+#'
+#' @param evaluator metric Evaluator
+#' @param models candidate fitted models OR estimators
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_find_best_model <- function(evaluator = NULL, models = NULL) {
+  mod <- reticulate::import("synapseml_tpu.automl.automl")
+  kwargs <- Filter(Negate(is.null), list(
+    evaluator = evaluator,
+    models = models
+  ))
+  do.call(mod$FindBestModel, kwargs)
+}

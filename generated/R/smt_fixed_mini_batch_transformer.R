@@ -1,0 +1,18 @@
+#' FixedMiniBatchTransformer
+#'
+#' Pack rows into fixed-size batches (ref: MiniBatchTransformer.scala:150).
+#'
+#' @param batch_size rows per batch
+#' @param buffered unused compat flag (reference buffers on a thread)
+#' @param max_buffer_size compat
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_fixed_mini_batch_transformer <- function(batch_size = 32, buffered = FALSE, max_buffer_size = 2147483647) {
+  mod <- reticulate::import("synapseml_tpu.data.batching")
+  kwargs <- Filter(Negate(is.null), list(
+    batch_size = batch_size,
+    buffered = buffered,
+    max_buffer_size = max_buffer_size
+  ))
+  do.call(mod$FixedMiniBatchTransformer, kwargs)
+}

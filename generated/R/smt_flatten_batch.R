@@ -1,0 +1,13 @@
+#' FlattenBatch
+#'
+#' Unpack batched rows back to scalar rows (ref: MiniBatchTransformer.scala:186).
+#'
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_flatten_batch <- function() {
+  mod <- reticulate::import("synapseml_tpu.data.batching")
+  kwargs <- Filter(Negate(is.null), list(
+
+  ))
+  do.call(mod$FlattenBatch, kwargs)
+}

@@ -1,0 +1,20 @@
+#' HashingTF
+#'
+#' Token lists → dense hashed term-frequency matrix (murmur3 slots).
+#'
+#' @param binary presence instead of counts
+#' @param input_col name of the input column
+#' @param num_features hash space size
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_hashing_tf <- function(binary = FALSE, input_col = "input", num_features = 4096, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    binary = binary,
+    input_col = input_col,
+    num_features = num_features,
+    output_col = output_col
+  ))
+  do.call(mod$HashingTF, kwargs)
+}

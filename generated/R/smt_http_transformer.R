@@ -1,0 +1,22 @@
+#' HTTPTransformer
+#'
+#' Column of requests -> column of responses
+#'
+#' @param backoffs retry backoff schedule in ms
+#' @param concurrency max in-flight requests
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param timeout per-request timeout seconds
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_http_transformer <- function(backoffs = c(100, 500, 1000), concurrency = 8, input_col = "input", output_col = "output", timeout = 60.0) {
+  mod <- reticulate::import("synapseml_tpu.io.http")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    input_col = input_col,
+    output_col = output_col,
+    timeout = timeout
+  ))
+  do.call(mod$HTTPTransformer, kwargs)
+}

@@ -1,0 +1,16 @@
+#' IDF
+#'
+#' @param input_col name of the input column
+#' @param min_doc_freq slots below this doc-freq get idf 0
+#' @param output_col name of the output column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_idf <- function(input_col = "input", min_doc_freq = 0, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    min_doc_freq = min_doc_freq,
+    output_col = output_col
+  ))
+  do.call(mod$IDF, kwargs)
+}

@@ -1,0 +1,16 @@
+#' IDFModel
+#'
+#' @param idf per-slot inverse document frequencies
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_idf_model <- function(idf = NULL, input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    idf = idf,
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$IDFModel, kwargs)
+}

@@ -1,0 +1,30 @@
+#' ImageFeaturizer
+#'
+#' Featurize an image column through a truncated deep network.
+#'
+#' @param compute_dtype float32|bfloat16
+#' @param cut_output_layers trailing graph nodes to drop
+#' @param image_size square input side fed to the net
+#' @param input_col name of the input column
+#' @param mean per-channel normalization mean (0-1 scale)
+#' @param mini_batch_size max rows per device batch
+#' @param model_payload raw .onnx backbone bytes
+#' @param output_col name of the output column
+#' @param std per-channel normalization std
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_image_featurizer <- function(compute_dtype = "float32", cut_output_layers = 1, image_size = 224, input_col = "input", mean = c(0.485, 0.456, 0.406), mini_batch_size = 64, model_payload = NULL, output_col = "output", std = c(0.229, 0.224, 0.225)) {
+  mod <- reticulate::import("synapseml_tpu.image.featurizer")
+  kwargs <- Filter(Negate(is.null), list(
+    compute_dtype = compute_dtype,
+    cut_output_layers = cut_output_layers,
+    image_size = image_size,
+    input_col = input_col,
+    mean = mean,
+    mini_batch_size = mini_batch_size,
+    model_payload = model_payload,
+    output_col = output_col,
+    std = std
+  ))
+  do.call(mod$ImageFeaturizer, kwargs)
+}

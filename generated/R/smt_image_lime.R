@@ -1,0 +1,38 @@
+#' ImageLIME
+#'
+#' Superpixel-masking LIME (ref: ImageLIME.scala:38).
+#'
+#' @param background_value fill for masked superpixels
+#' @param cell_size superpixel cell size
+#' @param input_col name of the input column
+#' @param kernel_width LIME kernel width
+#' @param model the Transformer being explained
+#' @param modifier superpixel color/spatial balance
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param regularization lasso alpha
+#' @param seed rng seed
+#' @param superpixel_col output column with [H, W] assignments
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_image_lime <- function(background_value = 0.0, cell_size = 16.0, input_col = "input", kernel_width = 0.75, model = NULL, modifier = 130.0, num_samples = NULL, output_col = "output", regularization = 0.0, seed = 0, superpixel_col = "superpixels", target_classes = c(0), target_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    background_value = background_value,
+    cell_size = cell_size,
+    input_col = input_col,
+    kernel_width = kernel_width,
+    model = model,
+    modifier = modifier,
+    num_samples = num_samples,
+    output_col = output_col,
+    regularization = regularization,
+    seed = seed,
+    superpixel_col = superpixel_col,
+    target_classes = target_classes,
+    target_col = target_col
+  ))
+  do.call(mod$ImageLIME, kwargs)
+}

@@ -1,0 +1,20 @@
+#' ImageSetAugmenter
+#'
+#' Dataset augmentation by flips: emits the original rows plus one row
+#'
+#' @param flip_left_right add left-right flipped copies
+#' @param flip_up_down add up-down flipped copies
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_image_set_augmenter <- function(flip_left_right = TRUE, flip_up_down = FALSE, input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.image.transformer")
+  kwargs <- Filter(Negate(is.null), list(
+    flip_left_right = flip_left_right,
+    flip_up_down = flip_up_down,
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$ImageSetAugmenter, kwargs)
+}

@@ -1,0 +1,34 @@
+#' ImageSHAP
+#'
+#' Superpixel-coalition KernelSHAP (ref: ImageSHAP.scala:35).
+#'
+#' @param background_value fill for masked superpixels
+#' @param cell_size superpixel cell size
+#' @param input_col name of the input column
+#' @param model the Transformer being explained
+#' @param modifier superpixel color/spatial balance
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param seed rng seed
+#' @param superpixel_col output column with [H, W] assignments
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_image_shap <- function(background_value = 0.0, cell_size = 16.0, input_col = "input", model = NULL, modifier = 130.0, num_samples = NULL, output_col = "output", seed = 0, superpixel_col = "superpixels", target_classes = c(0), target_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    background_value = background_value,
+    cell_size = cell_size,
+    input_col = input_col,
+    model = model,
+    modifier = modifier,
+    num_samples = num_samples,
+    output_col = output_col,
+    seed = seed,
+    superpixel_col = superpixel_col,
+    target_classes = target_classes,
+    target_col = target_col
+  ))
+  do.call(mod$ImageSHAP, kwargs)
+}

@@ -1,0 +1,20 @@
+#' ImageTransformer
+#'
+#' Apply a list of param-map stages to an image column
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param stages list of stage param-maps
+#' @param to_uint8 clip+cast output back to uint8
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_image_transformer <- function(input_col = "input", output_col = "output", stages = NULL, to_uint8 = FALSE) {
+  mod <- reticulate::import("synapseml_tpu.image.transformer")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    stages = stages,
+    to_uint8 = to_uint8
+  ))
+  do.call(mod$ImageTransformer, kwargs)
+}

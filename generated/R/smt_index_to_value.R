@@ -1,0 +1,20 @@
+#' IndexToValue
+#'
+#' Inverse map: indices back to original levels (ref: IndexToValue.scala:29).
+#'
+#' @param default_value value emitted for the missing index
+#' @param input_col name of the input column
+#' @param levels ordered distinct levels
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_index_to_value <- function(default_value = NULL, input_col = "input", levels = NULL, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.indexer")
+  kwargs <- Filter(Negate(is.null), list(
+    default_value = default_value,
+    input_col = input_col,
+    levels = levels,
+    output_col = output_col
+  ))
+  do.call(mod$IndexToValue, kwargs)
+}

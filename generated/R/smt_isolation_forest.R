@@ -1,0 +1,28 @@
+#' IsolationForest
+#'
+#' ref: core/.../isolationforest/IsolationForest.scala:18 (param names
+#'
+#' @param contamination expected anomaly fraction (sets the threshold)
+#' @param features_col name of the features column
+#' @param max_features feature subsample fraction
+#' @param max_samples subsample size per tree
+#' @param num_estimators number of trees
+#' @param prediction_col name of the prediction column
+#' @param random_seed rng seed
+#' @param score_col anomaly score column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_isolation_forest <- function(contamination = 0.0, features_col = "features", max_features = 1.0, max_samples = 256, num_estimators = 100, prediction_col = "prediction", random_seed = 1, score_col = "outlierScore") {
+  mod <- reticulate::import("synapseml_tpu.isolationforest.iforest")
+  kwargs <- Filter(Negate(is.null), list(
+    contamination = contamination,
+    features_col = features_col,
+    max_features = max_features,
+    max_samples = max_samples,
+    num_estimators = num_estimators,
+    prediction_col = prediction_col,
+    random_seed = random_seed,
+    score_col = score_col
+  ))
+  do.call(mod$IsolationForest, kwargs)
+}

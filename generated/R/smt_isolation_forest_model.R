@@ -1,0 +1,24 @@
+#' IsolationForestModel
+#'
+#' @param c_norm c(sample_size) score normalizer
+#' @param features_col name of the features column
+#' @param max_depth tree depth cap used at fit time
+#' @param prediction_col name of the prediction column
+#' @param score_col anomaly score column
+#' @param threshold score threshold for the 0/1 prediction
+#' @param trees stacked tree arrays (feature/threshold/left/right/depth)
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_isolation_forest_model <- function(c_norm = 1.0, features_col = "features", max_depth = 12, prediction_col = "prediction", score_col = "outlierScore", threshold = 0.5, trees = NULL) {
+  mod <- reticulate::import("synapseml_tpu.isolationforest.iforest")
+  kwargs <- Filter(Negate(is.null), list(
+    c_norm = c_norm,
+    features_col = features_col,
+    max_depth = max_depth,
+    prediction_col = prediction_col,
+    score_col = score_col,
+    threshold = threshold,
+    trees = trees
+  ))
+  do.call(mod$IsolationForestModel, kwargs)
+}

@@ -1,0 +1,22 @@
+#' JSONInputParser
+#'
+#' Rows -> JSON POST requests (ref: Parsers.scala JSONInputParser).
+#'
+#' @param headers extra headers
+#' @param input_col name of the input column
+#' @param method HTTP method
+#' @param output_col name of the output column
+#' @param url target URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_json_input_parser <- function(headers = NULL, input_col = "input", method = "POST", output_col = "output", url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.io.http")
+  kwargs <- Filter(Negate(is.null), list(
+    headers = headers,
+    input_col = input_col,
+    method = method,
+    output_col = output_col,
+    url = url
+  ))
+  do.call(mod$JSONInputParser, kwargs)
+}

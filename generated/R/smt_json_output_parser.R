@@ -1,0 +1,18 @@
+#' JSONOutputParser
+#'
+#' Response -> parsed JSON objects (ref: Parsers.scala JSONOutputParser;
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param post_process optional parsed-json -> value function
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_json_output_parser <- function(input_col = "input", output_col = "output", post_process = NULL) {
+  mod <- reticulate::import("synapseml_tpu.io.http")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    post_process = post_process
+  ))
+  do.call(mod$JSONOutputParser, kwargs)
+}

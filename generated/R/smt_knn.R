@@ -1,0 +1,20 @@
+#' KNN
+#'
+#' Fit stores the feature matrix + payload values (ref: KNN.scala:48).
+#'
+#' @param input_col name of the input column
+#' @param k neighbours per query
+#' @param output_col name of the output column
+#' @param values_col column carried as the match payload
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_knn <- function(input_col = "input", k = 5, output_col = "output", values_col = NULL) {
+  mod <- reticulate::import("synapseml_tpu.knn.knn")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    k = k,
+    output_col = output_col,
+    values_col = values_col
+  ))
+  do.call(mod$KNN, kwargs)
+}

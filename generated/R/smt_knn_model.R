@@ -1,0 +1,22 @@
+#' KNNModel
+#'
+#' Batched exact top-k search (ref: KNNModel.scala:78).
+#'
+#' @param index [N, D] feature matrix
+#' @param input_col name of the input column
+#' @param k neighbours per query
+#' @param output_col name of the output column
+#' @param values payload per index row
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_knn_model <- function(index = NULL, input_col = "input", k = 5, output_col = "output", values = NULL) {
+  mod <- reticulate::import("synapseml_tpu.knn.knn")
+  kwargs <- Filter(Negate(is.null), list(
+    index = index,
+    input_col = input_col,
+    k = k,
+    output_col = output_col,
+    values = values
+  ))
+  do.call(mod$KNNModel, kwargs)
+}

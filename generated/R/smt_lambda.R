@@ -1,0 +1,14 @@
+#' Lambda
+#'
+#' Arbitrary Table -> Table function as a stage (ref: stages/Lambda.scala:22).
+#'
+#' @param fn table -> table callable
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_lambda <- function(fn = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    fn = fn
+  ))
+  do.call(mod$Lambda, kwargs)
+}

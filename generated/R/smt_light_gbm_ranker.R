@@ -1,0 +1,76 @@
+#' LightGBMRanker
+#'
+#' ref: lightgbm/.../LightGBMRanker.scala:26-177.
+#'
+#' @param bagging_fraction row subsample
+#' @param bagging_freq bagging frequency
+#' @param boosting_type gbdt|rf|dart|goss
+#' @param categorical_slot_indexes categorical feature slots
+#' @param early_stopping_round early stopping patience
+#' @param evaluate_at eval positions
+#' @param feature_cols explicit list of scalar feature columns
+#' @param feature_fraction feature subsample per tree
+#' @param features_col features column (2-D) or None to use feature_cols
+#' @param group_col query/group id column
+#' @param label_col label column
+#' @param lambda_l1 L1 regularization
+#' @param lambda_l2 L2 regularization
+#' @param learning_rate shrinkage
+#' @param max_bin histogram bins
+#' @param max_depth max depth, 0=unlimited
+#' @param max_position NDCG truncation
+#' @param metric eval metric override
+#' @param min_data_in_leaf min rows per leaf
+#' @param min_gain_to_split min split gain
+#' @param min_sum_hessian_in_leaf min hessian per leaf
+#' @param num_iterations boosting rounds
+#' @param num_leaves max leaves per tree
+#' @param objective lambdarank
+#' @param other_rate GOSS other rate
+#' @param parallelism distributed tree learner; data_parallel (dp-mesh psum histograms) is the implemented strategy
+#' @param prediction_col prediction column
+#' @param seed random seed
+#' @param top_rate GOSS top rate
+#' @param validation_indicator_col bool column marking validation rows
+#' @param verbosity verbosity
+#' @param weight_col sample weight column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_light_gbm_ranker <- function(bagging_fraction = 1.0, bagging_freq = 0, boosting_type = "gbdt", categorical_slot_indexes = NULL, early_stopping_round = 0, evaluate_at = NULL, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", group_col = "query", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, max_position = 30, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_iterations = 100, num_leaves = 31, objective = "lambdarank", other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
+  mod <- reticulate::import("synapseml_tpu.gbdt.estimators")
+  kwargs <- Filter(Negate(is.null), list(
+    bagging_fraction = bagging_fraction,
+    bagging_freq = bagging_freq,
+    boosting_type = boosting_type,
+    categorical_slot_indexes = categorical_slot_indexes,
+    early_stopping_round = early_stopping_round,
+    evaluate_at = evaluate_at,
+    feature_cols = feature_cols,
+    feature_fraction = feature_fraction,
+    features_col = features_col,
+    group_col = group_col,
+    label_col = label_col,
+    lambda_l1 = lambda_l1,
+    lambda_l2 = lambda_l2,
+    learning_rate = learning_rate,
+    max_bin = max_bin,
+    max_depth = max_depth,
+    max_position = max_position,
+    metric = metric,
+    min_data_in_leaf = min_data_in_leaf,
+    min_gain_to_split = min_gain_to_split,
+    min_sum_hessian_in_leaf = min_sum_hessian_in_leaf,
+    num_iterations = num_iterations,
+    num_leaves = num_leaves,
+    objective = objective,
+    other_rate = other_rate,
+    parallelism = parallelism,
+    prediction_col = prediction_col,
+    seed = seed,
+    top_rate = top_rate,
+    validation_indicator_col = validation_indicator_col,
+    verbosity = verbosity,
+    weight_col = weight_col
+  ))
+  do.call(mod$LightGBMRanker, kwargs)
+}

@@ -1,0 +1,24 @@
+#' LocalExplainer
+#'
+#' Common scoring plumbing (ref: LocalExplainer.scala:16-130).
+#'
+#' @param model the Transformer being explained
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param seed rng seed
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_local_explainer <- function(model = NULL, num_samples = NULL, output_col = "output", seed = 0, target_classes = c(0), target_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    model = model,
+    num_samples = num_samples,
+    output_col = output_col,
+    seed = seed,
+    target_classes = target_classes,
+    target_col = target_col
+  ))
+  do.call(mod$LocalExplainer, kwargs)
+}

@@ -1,0 +1,20 @@
+#' MetricEvaluator
+#'
+#' Simple column-based evaluator for tuning (accuracy / mse / auc).
+#'
+#' @param label_col label column
+#' @param metric accuracy | mse | auc
+#' @param prediction_col prediction column
+#' @param probability_col probability column (auc)
+#' @return a synapseml_tpu evaluator handle
+#' @export
+smt_metric_evaluator <- function(label_col = "label", metric = "accuracy", prediction_col = "prediction", probability_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.automl.automl")
+  kwargs <- Filter(Negate(is.null), list(
+    label_col = label_col,
+    metric = metric,
+    prediction_col = prediction_col,
+    probability_col = probability_col
+  ))
+  do.call(mod$MetricEvaluator, kwargs)
+}

@@ -1,0 +1,18 @@
+#' MultiColumnAdapter
+#'
+#' Apply one single-column transformer across many column pairs
+#'
+#' @param base_stage single-col transformer/estimator to replicate
+#' @param input_cols input columns
+#' @param output_cols output columns
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_multi_column_adapter <- function(base_stage = NULL, input_cols = NULL, output_cols = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    base_stage = base_stage,
+    input_cols = input_cols,
+    output_cols = output_cols
+  ))
+  do.call(mod$MultiColumnAdapter, kwargs)
+}

@@ -1,0 +1,12 @@
+#' MultiColumnAdapterModel
+#'
+#' @param stages fitted per-column stages
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_multi_column_adapter_model <- function(stages = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    stages = stages
+  ))
+  do.call(mod$MultiColumnAdapterModel, kwargs)
+}

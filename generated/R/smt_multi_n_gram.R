@@ -1,0 +1,18 @@
+#' MultiNGram
+#'
+#' All n-gram sizes in one output list (ref: MultiNGram.scala:26).
+#'
+#' @param input_col name of the input column
+#' @param lengths gram sizes to include
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_multi_n_gram <- function(input_col = "input", lengths = c(1, 2, 3), output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    lengths = lengths,
+    output_col = output_col
+  ))
+  do.call(mod$MultiNGram, kwargs)
+}

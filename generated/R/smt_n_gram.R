@@ -1,0 +1,16 @@
+#' NGram
+#'
+#' @param input_col name of the input column
+#' @param n gram size
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_n_gram <- function(input_col = "input", n = 2, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    n = n,
+    output_col = output_col
+  ))
+  do.call(mod$NGram, kwargs)
+}

@@ -1,0 +1,32 @@
+#' NER
+#'
+#' Named entity recognition (ref: TextAnalytics.scala NER).
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param batch_size documents per request
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param language document language
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param text input text
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_ner <- function(backoffs = c(100, 500, 1000), batch_size = 10, concurrency = 4, error_col = "errors", language = NULL, output_col = "out", subscription_key = NULL, text = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    batch_size = batch_size,
+    concurrency = concurrency,
+    error_col = error_col,
+    language = language,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    text = text,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$NER, kwargs)
+}

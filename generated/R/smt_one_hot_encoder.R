@@ -1,0 +1,20 @@
+#' OneHotEncoder
+#'
+#' Index column → one-hot rows. ``size`` must cover the missing slot.
+#'
+#' @param drop_last drop the last (missing) slot
+#' @param input_col index input column
+#' @param output_col one-hot output column
+#' @param size number of slots
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_one_hot_encoder <- function(drop_last = TRUE, input_col = "input", output_col = "output", size = NULL) {
+  mod <- reticulate::import("synapseml_tpu.featurize.assemble")
+  kwargs <- Filter(Negate(is.null), list(
+    drop_last = drop_last,
+    input_col = input_col,
+    output_col = output_col,
+    size = size
+  ))
+  do.call(mod$OneHotEncoder, kwargs)
+}

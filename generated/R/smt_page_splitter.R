@@ -1,0 +1,22 @@
+#' PageSplitter
+#'
+#' Splits long strings into pages within [min,max] bytes, preferring
+#'
+#' @param boundary_regex split-preferred boundary
+#' @param input_col name of the input column
+#' @param maximum_page_length max page chars
+#' @param minimum_page_length min page chars before forced split
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_page_splitter <- function(boundary_regex = "\s", input_col = "input", maximum_page_length = 5000, minimum_page_length = 4500, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    boundary_regex = boundary_regex,
+    input_col = input_col,
+    maximum_page_length = maximum_page_length,
+    minimum_page_length = minimum_page_length,
+    output_col = output_col
+  ))
+  do.call(mod$PageSplitter, kwargs)
+}

@@ -1,0 +1,18 @@
+#' PartitionConsolidator
+#'
+#' Funnel many shards' rows through one worker (rate-limited services)
+#'
+#' @param concurrency number of concurrent consumers after consolidation
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_partition_consolidator <- function(concurrency = 1, input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    concurrency = concurrency,
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$PartitionConsolidator, kwargs)
+}

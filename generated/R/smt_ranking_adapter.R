@@ -1,0 +1,20 @@
+#' RankingAdapter
+#'
+#' Wraps a recommender so its output evaluates as ranking lists
+#'
+#' @param item_col indexed item column
+#' @param k recommendations per user
+#' @param recommender inner Estimator (e.g. SAR)
+#' @param user_col indexed user column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_ranking_adapter <- function(item_col = "itemIdx", k = 10, recommender = NULL, user_col = "userIdx") {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    item_col = item_col,
+    k = k,
+    recommender = recommender,
+    user_col = user_col
+  ))
+  do.call(mod$RankingAdapter, kwargs)
+}

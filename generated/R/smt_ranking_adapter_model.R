@@ -1,0 +1,18 @@
+#' RankingAdapterModel
+#'
+#' @param item_col indexed item column
+#' @param k recommendations per user
+#' @param recommender_model fitted recommender
+#' @param user_col indexed user column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_ranking_adapter_model <- function(item_col = "itemIdx", k = 10, recommender_model = NULL, user_col = "userIdx") {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    item_col = item_col,
+    k = k,
+    recommender_model = recommender_model,
+    user_col = user_col
+  ))
+  do.call(mod$RankingAdapterModel, kwargs)
+}

@@ -1,0 +1,20 @@
+#' RankingEvaluator
+#'
+#' ref: RankingEvaluator.scala:100.
+#'
+#' @param k cutoff
+#' @param label_col ground-truth items column
+#' @param metric_name ndcgAt | map | precisionAtk | recallAtK
+#' @param prediction_col recommendations column
+#' @return a synapseml_tpu evaluator handle
+#' @export
+smt_ranking_evaluator <- function(k = 10, label_col = "label", metric_name = "ndcgAt", prediction_col = "recommendations") {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    k = k,
+    label_col = label_col,
+    metric_name = metric_name,
+    prediction_col = prediction_col
+  ))
+  do.call(mod$RankingEvaluator, kwargs)
+}

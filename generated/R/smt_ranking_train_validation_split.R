@@ -1,0 +1,22 @@
+#' RankingTrainValidationSplit
+#'
+#' Per-user holdout split + fit + ranking eval
+#'
+#' @param estimator RankingAdapter to fit
+#' @param evaluator RankingEvaluator
+#' @param seed split seed
+#' @param train_ratio per-user train fraction
+#' @param user_col indexed user column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_ranking_train_validation_split <- function(estimator = NULL, evaluator = NULL, seed = 0, train_ratio = 0.75, user_col = "userIdx") {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    estimator = estimator,
+    evaluator = evaluator,
+    seed = seed,
+    train_ratio = train_ratio,
+    user_col = user_col
+  ))
+  do.call(mod$RankingTrainValidationSplit, kwargs)
+}

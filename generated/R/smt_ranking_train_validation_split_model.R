@@ -1,0 +1,14 @@
+#' RankingTrainValidationSplitModel
+#'
+#' @param best_model fitted inner model
+#' @param validation_metric holdout ranking metric
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_ranking_train_validation_split_model <- function(best_model = NULL, validation_metric = NULL) {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    best_model = best_model,
+    validation_metric = validation_metric
+  ))
+  do.call(mod$RankingTrainValidationSplitModel, kwargs)
+}

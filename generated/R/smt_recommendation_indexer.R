@@ -1,0 +1,22 @@
+#' RecommendationIndexer
+#'
+#' Indexes user and item id columns to dense ints
+#'
+#' @param item_input_col raw item column
+#' @param item_output_col indexed item column
+#' @param rating_col rating column
+#' @param user_input_col raw user column
+#' @param user_output_col indexed user column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_recommendation_indexer <- function(item_input_col = "item", item_output_col = "itemIdx", rating_col = "rating", user_input_col = "user", user_output_col = "userIdx") {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    item_input_col = item_input_col,
+    item_output_col = item_output_col,
+    rating_col = rating_col,
+    user_input_col = user_input_col,
+    user_output_col = user_output_col
+  ))
+  do.call(mod$RecommendationIndexer, kwargs)
+}

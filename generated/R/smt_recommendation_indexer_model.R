@@ -1,0 +1,14 @@
+#' RecommendationIndexerModel
+#'
+#' @param item_indexer fitted item ValueIndexerModel
+#' @param user_indexer fitted user ValueIndexerModel
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_recommendation_indexer_model <- function(item_indexer = NULL, user_indexer = NULL) {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    item_indexer = item_indexer,
+    user_indexer = user_indexer
+  ))
+  do.call(mod$RecommendationIndexerModel, kwargs)
+}

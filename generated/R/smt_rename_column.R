@@ -1,0 +1,16 @@
+#' RenameColumn
+#'
+#' Rename one column (ref: stages/RenameColumn.scala).
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_rename_column <- function(input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$RenameColumn, kwargs)
+}

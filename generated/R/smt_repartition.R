@@ -1,0 +1,16 @@
+#' Repartition
+#'
+#' Re-chunk the table into ``n`` near-equal shards.
+#'
+#' @param disable pass-through when true
+#' @param n number of partitions
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_repartition <- function(disable = FALSE, n = 1) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    disable = disable,
+    n = n
+  ))
+  do.call(mod$Repartition, kwargs)
+}

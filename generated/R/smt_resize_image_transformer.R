@@ -1,0 +1,24 @@
+#' ResizeImageTransformer
+#'
+#' Standalone resize stage (ref: core/.../image/ResizeImageTransformer.scala:110).
+#'
+#' @param height target height
+#' @param input_col name of the input column
+#' @param keep_aspect_ratio preserve aspect ratio
+#' @param output_col name of the output column
+#' @param size shorter-side size (keepAspectRatio)
+#' @param width target width
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_resize_image_transformer <- function(height = NULL, input_col = "input", keep_aspect_ratio = FALSE, output_col = "output", size = NULL, width = NULL) {
+  mod <- reticulate::import("synapseml_tpu.image.transformer")
+  kwargs <- Filter(Negate(is.null), list(
+    height = height,
+    input_col = input_col,
+    keep_aspect_ratio = keep_aspect_ratio,
+    output_col = output_col,
+    size = size,
+    width = width
+  ))
+  do.call(mod$ResizeImageTransformer, kwargs)
+}

@@ -1,0 +1,28 @@
+#' SAR
+#'
+#' ref: SAR.scala:36 (fit :66-76). Affinity = time-decayed weighted
+#'
+#' @param item_col indexed item column
+#' @param rating_col rating column
+#' @param similarity_function jaccard | lift | cooccurrence
+#' @param start_time reference time (seconds; default max(time))
+#' @param support_threshold min co-occurrence for similarity
+#' @param time_col timestamp column (seconds); None = no decay
+#' @param time_decay_coeff half-life in days
+#' @param user_col indexed user column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_sar <- function(item_col = "itemIdx", rating_col = "rating", similarity_function = "jaccard", start_time = NULL, support_threshold = 4, time_col = NULL, time_decay_coeff = 30, user_col = "userIdx") {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    item_col = item_col,
+    rating_col = rating_col,
+    similarity_function = similarity_function,
+    start_time = start_time,
+    support_threshold = support_threshold,
+    time_col = time_col,
+    time_decay_coeff = time_decay_coeff,
+    user_col = user_col
+  ))
+  do.call(mod$SAR, kwargs)
+}

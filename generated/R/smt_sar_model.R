@@ -1,0 +1,26 @@
+#' SARModel
+#'
+#' ref: SARModel.scala:22.
+#'
+#' @param item_col indexed item column
+#' @param item_similarity [I, I] similarity matrix
+#' @param prediction_col score output column
+#' @param rating_col rating column
+#' @param seen [U, I] binarized seen mask
+#' @param user_col indexed user column
+#' @param user_item_affinity [U, I] affinity matrix
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_sar_model <- function(item_col = "itemIdx", item_similarity = NULL, prediction_col = "prediction", rating_col = "rating", seen = NULL, user_col = "userIdx", user_item_affinity = NULL) {
+  mod <- reticulate::import("synapseml_tpu.recommendation.sar")
+  kwargs <- Filter(Negate(is.null), list(
+    item_col = item_col,
+    item_similarity = item_similarity,
+    prediction_col = prediction_col,
+    rating_col = rating_col,
+    seen = seen,
+    user_col = user_col,
+    user_item_affinity = user_item_affinity
+  ))
+  do.call(mod$SARModel, kwargs)
+}

@@ -1,0 +1,14 @@
+#' SelectColumns
+#'
+#' Keep only the named columns (ref: stages/SelectColumns.scala).
+#'
+#' @param cols columns to keep
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_select_columns <- function(cols = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    cols = cols
+  ))
+  do.call(mod$SelectColumns, kwargs)
+}

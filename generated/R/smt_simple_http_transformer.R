@@ -1,0 +1,30 @@
+#' SimpleHTTPTransformer
+#'
+#' input parse -> HTTP (retrying, concurrent) -> output parse, with an
+#'
+#' @param backoffs retry backoff schedule in ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column name
+#' @param input_col name of the input column
+#' @param input_parser Transformer producing request col
+#' @param output_col name of the output column
+#' @param output_parser Transformer consuming response col
+#' @param timeout per-request timeout seconds
+#' @param url target URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_simple_http_transformer <- function(backoffs = c(100, 500, 1000), concurrency = 8, error_col = "errors", input_col = "input", input_parser = NULL, output_col = "output", output_parser = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.io.http")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    input_col = input_col,
+    input_parser = input_parser,
+    output_col = output_col,
+    output_parser = output_parser,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$SimpleHTTPTransformer, kwargs)
+}

@@ -1,0 +1,32 @@
+#' SpeechToText
+#'
+#' REST short-audio recognition (ref: SpeechToText.scala:131; the
+#'
+#' @param audio_bytes wav audio bytes
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param format result format
+#' @param language recognition language
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_speech_to_text <- function(audio_bytes = NULL, backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", format = NULL, language = NULL, output_col = "out", subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    audio_bytes = audio_bytes,
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    format = format,
+    language = language,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$SpeechToText, kwargs)
+}

@@ -1,0 +1,16 @@
+#' StopWordsRemover
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param stop_words words to remove
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_stop_words_remover <- function(input_col = "input", output_col = "output", stop_words = NULL) {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    stop_words = stop_words
+  ))
+  do.call(mod$StopWordsRemover, kwargs)
+}

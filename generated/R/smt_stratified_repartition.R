@@ -1,0 +1,18 @@
+#' StratifiedRepartition
+#'
+#' Rebalance rows so each shard sees every label
+#'
+#' @param label_col name of the label column
+#' @param mode equal | original | mixed
+#' @param n number of partitions
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_stratified_repartition <- function(label_col = "label", mode = "mixed", n = 1) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    label_col = label_col,
+    mode = mode,
+    n = n
+  ))
+  do.call(mod$StratifiedRepartition, kwargs)
+}

@@ -1,0 +1,16 @@
+#' StringOutputParser
+#'
+#' Response -> body string (ref: Parsers.scala StringOutputParser).
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_string_output_parser <- function(input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.io.http")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$StringOutputParser, kwargs)
+}

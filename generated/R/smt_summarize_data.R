@@ -1,0 +1,22 @@
+#' SummarizeData
+#'
+#' Counts / quantiles / missing / basic stats per column
+#'
+#' @param basic emit basic block
+#' @param counts emit count block
+#' @param error_threshold quantile error (parity; exact here)
+#' @param percentiles emit percentile block
+#' @param sample emit sample quantile block
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_summarize_data <- function(basic = TRUE, counts = TRUE, error_threshold = 0.0, percentiles = TRUE, sample = TRUE) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    basic = basic,
+    counts = counts,
+    error_threshold = error_threshold,
+    percentiles = percentiles,
+    sample = sample
+  ))
+  do.call(mod$SummarizeData, kwargs)
+}

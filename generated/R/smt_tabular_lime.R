@@ -1,0 +1,32 @@
+#' TabularLIME
+#'
+#' LIME over raw table columns: off-features resample from background
+#'
+#' @param background_data background Table for feature stats (default: the explained table)
+#' @param input_cols numeric columns to explain
+#' @param kernel_width LIME kernel width
+#' @param model the Transformer being explained
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param regularization lasso alpha (0 -> least squares)
+#' @param seed rng seed
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_tabular_lime <- function(background_data = NULL, input_cols = NULL, kernel_width = 0.75, model = NULL, num_samples = NULL, output_col = "output", regularization = 0.0, seed = 0, target_classes = c(0), target_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    background_data = background_data,
+    input_cols = input_cols,
+    kernel_width = kernel_width,
+    model = model,
+    num_samples = num_samples,
+    output_col = output_col,
+    regularization = regularization,
+    seed = seed,
+    target_classes = target_classes,
+    target_col = target_col
+  ))
+  do.call(mod$TabularLIME, kwargs)
+}

@@ -1,0 +1,28 @@
+#' TabularSHAP
+#'
+#' KernelSHAP over raw table columns (ref: TabularSHAP.scala).
+#'
+#' @param background_data background Table for feature stats (default: the explained table)
+#' @param input_cols numeric columns to explain
+#' @param model the Transformer being explained
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param seed rng seed
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_tabular_shap <- function(background_data = NULL, input_cols = NULL, model = NULL, num_samples = NULL, output_col = "output", seed = 0, target_classes = c(0), target_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    background_data = background_data,
+    input_cols = input_cols,
+    model = model,
+    num_samples = num_samples,
+    output_col = output_col,
+    seed = seed,
+    target_classes = target_classes,
+    target_col = target_col
+  ))
+  do.call(mod$TabularSHAP, kwargs)
+}

@@ -1,0 +1,36 @@
+#' TextFeaturizer
+#'
+#' One-stop text pipeline (ref: TextFeaturizer.scala:196): tokenize →
+#'
+#' @param binary binary TF
+#' @param input_col name of the input column
+#' @param min_doc_freq IDF min doc freq
+#' @param n_gram_length gram size
+#' @param num_features hash space size
+#' @param output_col name of the output column
+#' @param to_lowercase lowercase
+#' @param tokenizer_pattern token regex
+#' @param use_idf apply IDF rescaling
+#' @param use_ngram emit n-grams
+#' @param use_stop_words_remover remove stopwords
+#' @param use_tokenizer run tokenizer
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_text_featurizer <- function(binary = FALSE, input_col = "input", min_doc_freq = 1, n_gram_length = 2, num_features = 4096, output_col = "output", to_lowercase = TRUE, tokenizer_pattern = "[A-Za-z0-9_']+", use_idf = TRUE, use_ngram = FALSE, use_stop_words_remover = FALSE, use_tokenizer = TRUE) {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    binary = binary,
+    input_col = input_col,
+    min_doc_freq = min_doc_freq,
+    n_gram_length = n_gram_length,
+    num_features = num_features,
+    output_col = output_col,
+    to_lowercase = to_lowercase,
+    tokenizer_pattern = tokenizer_pattern,
+    use_idf = use_idf,
+    use_ngram = use_ngram,
+    use_stop_words_remover = use_stop_words_remover,
+    use_tokenizer = use_tokenizer
+  ))
+  do.call(mod$TextFeaturizer, kwargs)
+}

@@ -1,0 +1,16 @@
+#' TextFeaturizerModel
+#'
+#' @param inner fitted internal pipeline
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_text_featurizer_model <- function(inner = NULL, input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    inner = inner,
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$TextFeaturizerModel, kwargs)
+}

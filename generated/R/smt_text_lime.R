@@ -1,0 +1,32 @@
+#' TextLIME
+#'
+#' Token-masking LIME (ref: TextLIME.scala).
+#'
+#' @param input_col name of the input column
+#' @param kernel_width LIME kernel width
+#' @param model the Transformer being explained
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param regularization lasso alpha
+#' @param seed rng seed
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @param tokens_col output column holding the token list
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_text_lime <- function(input_col = "input", kernel_width = 0.75, model = NULL, num_samples = NULL, output_col = "output", regularization = 0.0, seed = 0, target_classes = c(0), target_col = "probability", tokens_col = "tokens") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    kernel_width = kernel_width,
+    model = model,
+    num_samples = num_samples,
+    output_col = output_col,
+    regularization = regularization,
+    seed = seed,
+    target_classes = target_classes,
+    target_col = target_col,
+    tokens_col = tokens_col
+  ))
+  do.call(mod$TextLIME, kwargs)
+}

@@ -1,0 +1,20 @@
+#' TextPreprocessor
+#'
+#' Longest-match replacement via a trie over the map keys
+#'
+#' @param input_col name of the input column
+#' @param map substring -> replacement map
+#' @param normalize_pattern chars-to-strip regex (applied before match)
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_text_preprocessor <- function(input_col = "input", map = NULL, normalize_pattern = NULL, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    map = map,
+    normalize_pattern = normalize_pattern,
+    output_col = output_col
+  ))
+  do.call(mod$TextPreprocessor, kwargs)
+}

@@ -1,0 +1,28 @@
+#' TextSHAP
+#'
+#' Token-coalition KernelSHAP (ref: TextSHAP.scala).
+#'
+#' @param input_col name of the input column
+#' @param model the Transformer being explained
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param seed rng seed
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @param tokens_col output column holding the token list
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_text_shap <- function(input_col = "input", model = NULL, num_samples = NULL, output_col = "output", seed = 0, target_classes = c(0), target_col = "probability", tokens_col = "tokens") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    model = model,
+    num_samples = num_samples,
+    output_col = output_col,
+    seed = seed,
+    target_classes = target_classes,
+    target_col = target_col,
+    tokens_col = tokens_col
+  ))
+  do.call(mod$TextSHAP, kwargs)
+}

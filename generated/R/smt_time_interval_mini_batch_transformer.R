@@ -1,0 +1,16 @@
+#' TimeIntervalMiniBatchTransformer
+#'
+#' Batch by wall-clock interval (ref: MiniBatchTransformer.scala:76).
+#'
+#' @param max_batch_size maximum rows per batch
+#' @param milliseconds interval in ms
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_time_interval_mini_batch_transformer <- function(max_batch_size = 2147483647, milliseconds = 1000) {
+  mod <- reticulate::import("synapseml_tpu.data.batching")
+  kwargs <- Filter(Negate(is.null), list(
+    max_batch_size = max_batch_size,
+    milliseconds = milliseconds
+  ))
+  do.call(mod$TimeIntervalMiniBatchTransformer, kwargs)
+}

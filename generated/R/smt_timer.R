@@ -1,0 +1,18 @@
+#' Timer
+#'
+#' Wrap a stage; log wall-clock of its fit/transform
+#'
+#' @param disable pass-through when true
+#' @param log_to_scala kept for parity; logs via python logging
+#' @param stage wrapped stage
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_timer <- function(disable = FALSE, log_to_scala = TRUE, stage = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    disable = disable,
+    log_to_scala = log_to_scala,
+    stage = stage
+  ))
+  do.call(mod$Timer, kwargs)
+}

@@ -1,0 +1,14 @@
+#' TimerModel
+#'
+#' @param disable pass-through when true
+#' @param stage wrapped fitted stage
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_timer_model <- function(disable = FALSE, stage = NULL) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    disable = disable,
+    stage = stage
+  ))
+  do.call(mod$TimerModel, kwargs)
+}

@@ -1,0 +1,22 @@
+#' Tokenizer
+#'
+#' Regex tokenizer (default: split on non-word chars, lowercase).
+#'
+#' @param input_col name of the input column
+#' @param min_token_length drop shorter tokens
+#' @param output_col name of the output column
+#' @param pattern token regex
+#' @param to_lowercase lowercase before tokenizing
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_tokenizer <- function(input_col = "input", min_token_length = 1, output_col = "output", pattern = "[A-Za-z0-9_']+", to_lowercase = TRUE) {
+  mod <- reticulate::import("synapseml_tpu.featurize.text")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    min_token_length = min_token_length,
+    output_col = output_col,
+    pattern = pattern,
+    to_lowercase = to_lowercase
+  ))
+  do.call(mod$Tokenizer, kwargs)
+}

@@ -1,0 +1,20 @@
+#' TrainClassifier
+#'
+#' Featurize + reindex labels + fit (ref: TrainClassifier.scala:49,
+#'
+#' @param features_col assembled features column
+#' @param label_col name of the label column
+#' @param model inner classifier estimator (default: LightGBMClassifier)
+#' @param number_of_features hash slots for high-cardinality columns
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_train_classifier <- function(features_col = "TrainClassifier_features", label_col = "label", model = NULL, number_of_features = 256) {
+  mod <- reticulate::import("synapseml_tpu.train.train")
+  kwargs <- Filter(Negate(is.null), list(
+    features_col = features_col,
+    label_col = label_col,
+    model = model,
+    number_of_features = number_of_features
+  ))
+  do.call(mod$TrainClassifier, kwargs)
+}

@@ -1,0 +1,20 @@
+#' TrainRegressor
+#'
+#' ref: TrainRegressor.scala:20.
+#'
+#' @param features_col assembled features column
+#' @param label_col name of the label column
+#' @param model inner regressor estimator (default: LightGBMRegressor)
+#' @param number_of_features hash slots for high-cardinality columns
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_train_regressor <- function(features_col = "TrainRegressor_features", label_col = "label", model = NULL, number_of_features = 256) {
+  mod <- reticulate::import("synapseml_tpu.train.train")
+  kwargs <- Filter(Negate(is.null), list(
+    features_col = features_col,
+    label_col = label_col,
+    model = model,
+    number_of_features = number_of_features
+  ))
+  do.call(mod$TrainRegressor, kwargs)
+}

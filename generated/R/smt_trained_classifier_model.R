@@ -1,0 +1,20 @@
+#' TrainedClassifierModel
+#'
+#' ref: TrainClassifier.scala:280.
+#'
+#' @param featurizer fitted Featurize model
+#' @param inner_model fitted inner classifier
+#' @param label_col name of the label column
+#' @param label_indexer optional fitted label indexer
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_trained_classifier_model <- function(featurizer = NULL, inner_model = NULL, label_col = "label", label_indexer = NULL) {
+  mod <- reticulate::import("synapseml_tpu.train.train")
+  kwargs <- Filter(Negate(is.null), list(
+    featurizer = featurizer,
+    inner_model = inner_model,
+    label_col = label_col,
+    label_indexer = label_indexer
+  ))
+  do.call(mod$TrainedClassifierModel, kwargs)
+}

@@ -1,0 +1,16 @@
+#' TrainedRegressorModel
+#'
+#' @param featurizer fitted Featurize model
+#' @param inner_model fitted inner regressor
+#' @param label_col name of the label column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_trained_regressor_model <- function(featurizer = NULL, inner_model = NULL, label_col = "label") {
+  mod <- reticulate::import("synapseml_tpu.train.train")
+  kwargs <- Filter(Negate(is.null), list(
+    featurizer = featurizer,
+    inner_model = inner_model,
+    label_col = label_col
+  ))
+  do.call(mod$TrainedRegressorModel, kwargs)
+}

@@ -1,0 +1,26 @@
+#' TuneHyperparameters
+#'
+#' Randomized/grid search over estimators with k-fold CV
+#'
+#' @param evaluator metric Evaluator (larger-better aware)
+#' @param models candidate estimators
+#' @param number_of_folds k in k-fold CV
+#' @param number_of_runs random samples per estimator
+#' @param parallelism concurrent candidate fits
+#' @param param_space ParamSpace/GridSpace or list of param maps
+#' @param seed cv split seed
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_tune_hyperparameters <- function(evaluator = NULL, models = NULL, number_of_folds = 3, number_of_runs = 8, parallelism = 4, param_space = NULL, seed = 0) {
+  mod <- reticulate::import("synapseml_tpu.automl.automl")
+  kwargs <- Filter(Negate(is.null), list(
+    evaluator = evaluator,
+    models = models,
+    number_of_folds = number_of_folds,
+    number_of_runs = number_of_runs,
+    parallelism = parallelism,
+    param_space = param_space,
+    seed = seed
+  ))
+  do.call(mod$TuneHyperparameters, kwargs)
+}

@@ -1,0 +1,20 @@
+#' TuneHyperparametersModel
+#'
+#' ref: TuneHyperparameters.scala:225.
+#'
+#' @param all_metrics metric per candidate
+#' @param best_metric winning CV metric
+#' @param best_model winning fitted model
+#' @param best_params winning param map
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_tune_hyperparameters_model <- function(all_metrics = NULL, best_metric = NULL, best_model = NULL, best_params = NULL) {
+  mod <- reticulate::import("synapseml_tpu.automl.automl")
+  kwargs <- Filter(Negate(is.null), list(
+    all_metrics = all_metrics,
+    best_metric = best_metric,
+    best_model = best_model,
+    best_params = best_params
+  ))
+  do.call(mod$TuneHyperparametersModel, kwargs)
+}

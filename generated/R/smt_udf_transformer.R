@@ -1,0 +1,22 @@
+#' UDFTransformer
+#'
+#' Apply a per-row (or whole-column when ``vectorized``) function
+#'
+#' @param input_col name of the input column
+#' @param input_cols names of the input columns
+#' @param output_col name of the output column
+#' @param udf row function
+#' @param vectorized when true, udf receives whole column array(s)
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_udf_transformer <- function(input_col = "input", input_cols = NULL, output_col = "output", udf = NULL, vectorized = FALSE) {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    input_cols = input_cols,
+    output_col = output_col,
+    udf = udf,
+    vectorized = vectorized
+  ))
+  do.call(mod$UDFTransformer, kwargs)
+}

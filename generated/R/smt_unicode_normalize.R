@@ -1,0 +1,20 @@
+#' UnicodeNormalize
+#'
+#' NFC/NFD/NFKC/NFKD + optional lower-casing (ref: stages/UnicodeNormalize.scala:22).
+#'
+#' @param form unicode normal form
+#' @param input_col name of the input column
+#' @param lower lower-case the output
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_unicode_normalize <- function(form = "NFKD", input_col = "input", lower = TRUE, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.stages.transformers")
+  kwargs <- Filter(Negate(is.null), list(
+    form = form,
+    input_col = input_col,
+    lower = lower,
+    output_col = output_col
+  ))
+  do.call(mod$UnicodeNormalize, kwargs)
+}

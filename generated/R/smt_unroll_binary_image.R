@@ -1,0 +1,16 @@
+#' UnrollBinaryImage
+#'
+#' Decode bytes then unroll (ref: core/.../image/UnrollImage.scala
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_unroll_binary_image <- function(input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.image.transformer")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$UnrollBinaryImage, kwargs)
+}

@@ -1,0 +1,16 @@
+#' ValueIndexer
+#'
+#' Learns distinct levels of a column (ref: ValueIndexer.scala:56).
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_value_indexer <- function(input_col = "input", output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.indexer")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col
+  ))
+  do.call(mod$ValueIndexer, kwargs)
+}

@@ -1,0 +1,20 @@
+#' ValueIndexerModel
+#'
+#' Maps raw categorical values to dense int32 indices.
+#'
+#' @param data_type original value kind: 'string'|'int'|'float'|'bool'
+#' @param input_col name of the input column
+#' @param levels ordered distinct levels (missing excluded)
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_value_indexer_model <- function(data_type = "string", input_col = "input", levels = NULL, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.indexer")
+  kwargs <- Filter(Negate(is.null), list(
+    data_type = data_type,
+    input_col = input_col,
+    levels = levels,
+    output_col = output_col
+  ))
+  do.call(mod$ValueIndexerModel, kwargs)
+}

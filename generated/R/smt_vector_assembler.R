@@ -1,0 +1,16 @@
+#' VectorAssembler
+#'
+#' Concatenates scalar and vector columns into one 2-D float32 matrix.
+#'
+#' @param input_cols columns to assemble
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vector_assembler <- function(input_cols = NULL, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.featurize.assemble")
+  kwargs <- Filter(Negate(is.null), list(
+    input_cols = input_cols,
+    output_col = output_col
+  ))
+  do.call(mod$VectorAssembler, kwargs)
+}

@@ -1,0 +1,32 @@
+#' VectorLIME
+#'
+#' LIME over a dense feature vector (ref: VectorLIME.scala).
+#'
+#' @param background background row [D] (default: column mean of the explained batch)
+#' @param input_col name of the input column
+#' @param kernel_width LIME kernel width
+#' @param model the Transformer being explained
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param regularization lasso alpha (0 -> least squares)
+#' @param seed rng seed
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vector_lime <- function(background = NULL, input_col = "input", kernel_width = 0.75, model = NULL, num_samples = NULL, output_col = "output", regularization = 0.0, seed = 0, target_classes = c(0), target_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    background = background,
+    input_col = input_col,
+    kernel_width = kernel_width,
+    model = model,
+    num_samples = num_samples,
+    output_col = output_col,
+    regularization = regularization,
+    seed = seed,
+    target_classes = target_classes,
+    target_col = target_col
+  ))
+  do.call(mod$VectorLIME, kwargs)
+}

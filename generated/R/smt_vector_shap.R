@@ -1,0 +1,28 @@
+#' VectorSHAP
+#'
+#' KernelSHAP over a dense feature vector (ref: VectorSHAP.scala).
+#'
+#' @param background background row [D] (default: column mean of the explained batch)
+#' @param input_col name of the input column
+#' @param model the Transformer being explained
+#' @param num_samples perturbations per row
+#' @param output_col name of the output column
+#' @param seed rng seed
+#' @param target_classes indices into the output vector
+#' @param target_col model output column to explain
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vector_shap <- function(background = NULL, input_col = "input", model = NULL, num_samples = NULL, output_col = "output", seed = 0, target_classes = c(0), target_col = "probability") {
+  mod <- reticulate::import("synapseml_tpu.explainers.local")
+  kwargs <- Filter(Negate(is.null), list(
+    background = background,
+    input_col = input_col,
+    model = model,
+    num_samples = num_samples,
+    output_col = output_col,
+    seed = seed,
+    target_classes = target_classes,
+    target_col = target_col
+  ))
+  do.call(mod$VectorSHAP, kwargs)
+}

@@ -1,0 +1,16 @@
+#' VectorZipper
+#'
+#' Zip several columns into one sequence column
+#'
+#' @param input_cols columns to zip
+#' @param output_col name of the output column
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vector_zipper <- function(input_cols = NULL, output_col = "output") {
+  mod <- reticulate::import("synapseml_tpu.linear.featurizer")
+  kwargs <- Filter(Negate(is.null), list(
+    input_cols = input_cols,
+    output_col = output_col
+  ))
+  do.call(mod$VectorZipper, kwargs)
+}

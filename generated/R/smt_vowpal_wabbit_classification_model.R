@@ -1,0 +1,24 @@
+#' VowpalWabbitClassificationModel
+#'
+#' @param features_col hashed features column prefix
+#' @param performance_statistics training perf stats
+#' @param prediction_col name of the prediction column
+#' @param probability_col probability column name
+#' @param raw_prediction_col raw prediction (margin) column
+#' @param state trained VWState
+#' @param train_params VWParams used at fit time
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vowpal_wabbit_classification_model <- function(features_col = "features", performance_statistics = NULL, prediction_col = "prediction", probability_col = "probability", raw_prediction_col = "rawPrediction", state = NULL, train_params = NULL) {
+  mod <- reticulate::import("synapseml_tpu.linear.estimators")
+  kwargs <- Filter(Negate(is.null), list(
+    features_col = features_col,
+    performance_statistics = performance_statistics,
+    prediction_col = prediction_col,
+    probability_col = probability_col,
+    raw_prediction_col = raw_prediction_col,
+    state = state,
+    train_params = train_params
+  ))
+  do.call(mod$VowpalWabbitClassificationModel, kwargs)
+}

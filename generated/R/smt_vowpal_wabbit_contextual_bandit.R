@@ -1,0 +1,54 @@
+#' VowpalWabbitContextualBandit
+#'
+#' Contextual bandit with action-dependent features
+#'
+#' @param action_features_col per-action hashed features column
+#' @param batch_size minibatch size
+#' @param chosen_action_col 1-based chosen action index column
+#' @param cost_col cost column (lower is better)
+#' @param features_col hashed features column prefix (expects _idx/_val)
+#' @param initial_model warm-start state (ref: initialModel bytes)
+#' @param initial_t lr schedule offset
+#' @param l1 L1 regularization
+#' @param l2 L2 regularization
+#' @param label_col name of the label column
+#' @param learning_rate initial learning rate
+#' @param num_bits hash space = 2^num_bits
+#' @param num_passes passes over the data
+#' @param optimizer sgd | adagrad | ftrl
+#' @param power_t lr decay exponent
+#' @param prediction_col name of the prediction column
+#' @param probability_col logging-policy probability column
+#' @param seed shuffle seed
+#' @param shared_col hashed shared-context column prefix
+#' @param use_mesh psum gradients over the dp mesh axis
+#' @param weight_col name of the sample-weight column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_vowpal_wabbit_contextual_bandit <- function(action_features_col = "action_features", batch_size = 256, chosen_action_col = "chosenAction", cost_col = "cost", features_col = "features", initial_model = NULL, initial_t = 0.0, l1 = 0.0, l2 = 0.0, label_col = "label", learning_rate = 0.5, num_bits = 18, num_passes = 1, optimizer = "adagrad", power_t = 0.5, prediction_col = "prediction", probability_col = "probability", seed = 0, shared_col = "shared", use_mesh = FALSE, weight_col = NULL) {
+  mod <- reticulate::import("synapseml_tpu.linear.estimators")
+  kwargs <- Filter(Negate(is.null), list(
+    action_features_col = action_features_col,
+    batch_size = batch_size,
+    chosen_action_col = chosen_action_col,
+    cost_col = cost_col,
+    features_col = features_col,
+    initial_model = initial_model,
+    initial_t = initial_t,
+    l1 = l1,
+    l2 = l2,
+    label_col = label_col,
+    learning_rate = learning_rate,
+    num_bits = num_bits,
+    num_passes = num_passes,
+    optimizer = optimizer,
+    power_t = power_t,
+    prediction_col = prediction_col,
+    probability_col = probability_col,
+    seed = seed,
+    shared_col = shared_col,
+    use_mesh = use_mesh,
+    weight_col = weight_col
+  ))
+  do.call(mod$VowpalWabbitContextualBandit, kwargs)
+}

@@ -1,0 +1,24 @@
+#' VowpalWabbitContextualBanditModel
+#'
+#' @param action_features_col per-action hashed features column
+#' @param features_col hashed features column prefix
+#' @param performance_statistics training perf stats
+#' @param prediction_col name of the prediction column
+#' @param shared_col hashed shared-context column prefix
+#' @param state trained VWState
+#' @param train_params VWParams used at fit time
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vowpal_wabbit_contextual_bandit_model <- function(action_features_col = "action_features", features_col = "features", performance_statistics = NULL, prediction_col = "prediction", shared_col = "shared", state = NULL, train_params = NULL) {
+  mod <- reticulate::import("synapseml_tpu.linear.estimators")
+  kwargs <- Filter(Negate(is.null), list(
+    action_features_col = action_features_col,
+    features_col = features_col,
+    performance_statistics = performance_statistics,
+    prediction_col = prediction_col,
+    shared_col = shared_col,
+    state = state,
+    train_params = train_params
+  ))
+  do.call(mod$VowpalWabbitContextualBanditModel, kwargs)
+}

@@ -1,0 +1,22 @@
+#' VowpalWabbitFeaturizer
+#'
+#' Hash scalar/string/token columns into (idx, val) pairs.
+#'
+#' @param input_cols columns to featurize
+#' @param num_bits hash space = 2^num_bits
+#' @param output_col name of the output column
+#' @param seed murmur seed (namespace analogue)
+#' @param sum_collisions sum colliding values (vs overwrite)
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vowpal_wabbit_featurizer <- function(input_cols = NULL, num_bits = 18, output_col = "output", seed = 0, sum_collisions = TRUE) {
+  mod <- reticulate::import("synapseml_tpu.linear.featurizer")
+  kwargs <- Filter(Negate(is.null), list(
+    input_cols = input_cols,
+    num_bits = num_bits,
+    output_col = output_col,
+    seed = seed,
+    sum_collisions = sum_collisions
+  ))
+  do.call(mod$VowpalWabbitFeaturizer, kwargs)
+}

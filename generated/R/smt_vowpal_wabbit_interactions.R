@@ -1,0 +1,20 @@
+#' VowpalWabbitInteractions
+#'
+#' Quadratic interaction features over already-hashed (idx, val) columns
+#'
+#' @param left_col first hashed column prefix
+#' @param num_bits hash space = 2^num_bits
+#' @param output_col name of the output column
+#' @param right_col second hashed column prefix
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vowpal_wabbit_interactions <- function(left_col = NULL, num_bits = 18, output_col = "output", right_col = NULL) {
+  mod <- reticulate::import("synapseml_tpu.linear.featurizer")
+  kwargs <- Filter(Negate(is.null), list(
+    left_col = left_col,
+    num_bits = num_bits,
+    output_col = output_col,
+    right_col = right_col
+  ))
+  do.call(mod$VowpalWabbitInteractions, kwargs)
+}

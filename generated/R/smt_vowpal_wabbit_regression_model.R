@@ -1,0 +1,20 @@
+#' VowpalWabbitRegressionModel
+#'
+#' @param features_col hashed features column prefix
+#' @param performance_statistics training perf stats
+#' @param prediction_col name of the prediction column
+#' @param state trained VWState
+#' @param train_params VWParams used at fit time
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_vowpal_wabbit_regression_model <- function(features_col = "features", performance_statistics = NULL, prediction_col = "prediction", state = NULL, train_params = NULL) {
+  mod <- reticulate::import("synapseml_tpu.linear.estimators")
+  kwargs <- Filter(Negate(is.null), list(
+    features_col = features_col,
+    performance_statistics = performance_statistics,
+    prediction_col = prediction_col,
+    state = state,
+    train_params = train_params
+  ))
+  do.call(mod$VowpalWabbitRegressionModel, kwargs)
+}

@@ -1,0 +1,48 @@
+#' VowpalWabbitRegressor
+#'
+#' Squared / quantile loss regressor (ref: VowpalWabbitRegressor.scala).
+#'
+#' @param batch_size minibatch size
+#' @param features_col hashed features column prefix (expects _idx/_val)
+#' @param initial_model warm-start state (ref: initialModel bytes)
+#' @param initial_t lr schedule offset
+#' @param l1 L1 regularization
+#' @param l2 L2 regularization
+#' @param label_col name of the label column
+#' @param learning_rate initial learning rate
+#' @param loss_function squared | quantile
+#' @param num_bits hash space = 2^num_bits
+#' @param num_passes passes over the data
+#' @param optimizer sgd | adagrad | ftrl
+#' @param power_t lr decay exponent
+#' @param prediction_col name of the prediction column
+#' @param quantile_tau quantile loss tau
+#' @param seed shuffle seed
+#' @param use_mesh psum gradients over the dp mesh axis
+#' @param weight_col name of the sample-weight column
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_vowpal_wabbit_regressor <- function(batch_size = 256, features_col = "features", initial_model = NULL, initial_t = 0.0, l1 = 0.0, l2 = 0.0, label_col = "label", learning_rate = 0.5, loss_function = "squared", num_bits = 18, num_passes = 1, optimizer = "adagrad", power_t = 0.5, prediction_col = "prediction", quantile_tau = 0.5, seed = 0, use_mesh = FALSE, weight_col = NULL) {
+  mod <- reticulate::import("synapseml_tpu.linear.estimators")
+  kwargs <- Filter(Negate(is.null), list(
+    batch_size = batch_size,
+    features_col = features_col,
+    initial_model = initial_model,
+    initial_t = initial_t,
+    l1 = l1,
+    l2 = l2,
+    label_col = label_col,
+    learning_rate = learning_rate,
+    loss_function = loss_function,
+    num_bits = num_bits,
+    num_passes = num_passes,
+    optimizer = optimizer,
+    power_t = power_t,
+    prediction_col = prediction_col,
+    quantile_tau = quantile_tau,
+    seed = seed,
+    use_mesh = use_mesh,
+    weight_col = weight_col
+  ))
+  do.call(mod$VowpalWabbitRegressor, kwargs)
+}

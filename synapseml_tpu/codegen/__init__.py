@@ -1,0 +1,197 @@
+"""Codegen: reflection-driven R wrappers + API reference generation.
+
+Rebuild of the reference's codegen layer
+(ref: core/src/main/scala/com/microsoft/ml/spark/codegen/CodeGen.scala:22-199
+— reflects over the compiled jar and emits .py/.R wrapper files per
+Wrappable stage; Wrappable.scala:19-515 param-type -> wrapper-type mapping;
+GenerationUtils.scala camelToSnake helpers).
+
+Python is this framework's source of truth (the reference's single source
+is Scala, SURVEY.md §2.1), so the generated surface is:
+- sparklyr-style R wrappers calling through ``reticulate`` (one .R file
+  per stage, roxygen docs from Param docstrings, defaults preserved);
+- a markdown API reference over every registered stage.
+
+Run: ``python -m synapseml_tpu.codegen [out_dir]`` (writes ``generated/``).
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import pkgutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from synapseml_tpu.core.param import ComplexParam, Param
+from synapseml_tpu.core.pipeline import (Estimator, Evaluator, Transformer,
+                                         _STAGE_REGISTRY)
+
+
+def import_all_modules() -> None:
+    """Load every submodule so the stage registry is complete
+    (JarLoadingUtils reflection-scan analogue)."""
+    import synapseml_tpu as pkg
+
+    for m in pkgutil.walk_packages(pkg.__path__, pkg.__name__ + "."):
+        try:
+            importlib.import_module(m.name)
+        except Exception:  # noqa: BLE001 - optional deps must not break codegen
+            continue
+
+
+def public_stages() -> Dict[str, type]:
+    """Concrete public library stages, qualified-name keyed."""
+    import_all_modules()
+    out = {}
+    for qual, cls in sorted(_STAGE_REGISTRY.items()):
+        if not qual.startswith("synapseml_tpu."):
+            continue
+        name = qual.rsplit(".", 1)[1]
+        if name.startswith("_"):
+            continue
+        if name in ("Estimator", "Transformer", "Model", "Evaluator",
+                    "Pipeline", "PipelineModel", "PipelineStage"):
+            continue
+        out[qual] = cls
+    return out
+
+
+def stage_params(cls: type) -> List[Tuple[str, Param]]:
+    return sorted(cls.params().items())
+
+
+def _camel(snake: str) -> str:
+    parts = snake.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _r_default(p: Param) -> str:
+    if not p.has_default() or isinstance(p, ComplexParam):
+        return "NULL"
+    d = p.default
+    if d is None:
+        return "NULL"
+    if isinstance(d, bool):
+        return "TRUE" if d else "FALSE"
+    if isinstance(d, (int, float)):
+        return repr(d)
+    if isinstance(d, str):
+        return f'"{d}"'
+    if isinstance(d, (tuple, list)):
+        inner = ", ".join(_r_default_value(v) for v in d)
+        return f"c({inner})" if inner else "NULL"
+    return "NULL"
+
+
+def _r_default_value(v: Any) -> str:
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    return f'"{v}"'
+
+
+def _snake_r(name: str) -> str:
+    """CamelCase -> snake, keeping acronym runs together (LightGBMRanker ->
+    light_gbm_ranker, OCR -> ocr) — camelToSnake, GenerationUtils.scala."""
+    import re
+
+    s = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", name)
+    s = re.sub(r"(?<=[A-Z])(?=[A-Z][a-z])", "_", s)
+    return s.lower()
+
+
+def generate_r_wrapper(qual: str, cls: type) -> str:
+    """One sparklyr-style wrapper function (ref: Wrappable.scala RWrappable)."""
+    name = qual.rsplit(".", 1)[1]
+    fn_name = f"smt_{_snake_r(name)}"
+    params = stage_params(cls)
+    kind = ("estimator" if issubclass(cls, Estimator)
+            else "evaluator" if issubclass(cls, Evaluator)
+            else "transformer")
+
+    lines = [f"#' {name}", "#'"]
+    doc = (cls.__doc__ or "").strip().splitlines()
+    if doc:
+        lines.append(f"#' {doc[0]}")
+        lines.append("#'")
+    for pname, p in params:
+        lines.append(f"#' @param {pname} {p.doc or pname}")
+    lines.append(f"#' @return a synapseml_tpu {kind} handle")
+    lines.append("#' @export")
+    args = ", ".join(f"{pname} = {_r_default(p)}" for pname, p in params)
+    lines.append(f"{fn_name} <- function({args}) {{")
+    lines.append('  mod <- reticulate::import("' +
+                 qual.rsplit(".", 1)[0] + '")')
+    lines.append("  kwargs <- Filter(Negate(is.null), list(")
+    lines.append(",\n".join(f"    {pname} = {pname}"
+                            for pname, _ in params))
+    lines.append("  ))")
+    lines.append(f'  do.call(mod${name}, kwargs)')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def generate_r(out_dir: str) -> List[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for qual, cls in public_stages().items():
+        name = qual.rsplit(".", 1)[1]
+        path = os.path.join(out_dir, f"smt_{_snake_r(name)}.R")
+        with open(path, "w") as fh:
+            fh.write(generate_r_wrapper(qual, cls))
+        written.append(path)
+    return written
+
+
+def generate_api_reference(out_path: str) -> str:
+    """Markdown API reference over every registered stage."""
+    stages = public_stages()
+    by_module: Dict[str, List[Tuple[str, type]]] = {}
+    for qual, cls in stages.items():
+        mod = qual.rsplit(".", 2)[0]
+        by_module.setdefault(mod, []).append((qual, cls))
+    lines = ["# synapseml_tpu API reference", "",
+             f"{len(stages)} pipeline stages (generated by "
+             "`python -m synapseml_tpu.codegen`).", ""]
+    for mod in sorted(by_module):
+        lines.append(f"## {mod}")
+        lines.append("")
+        for qual, cls in by_module[mod]:
+            name = qual.rsplit(".", 1)[1]
+            kind = ("Estimator" if issubclass(cls, Estimator)
+                    else "Evaluator" if issubclass(cls, Evaluator)
+                    else "Transformer")
+            doc = (cls.__doc__ or "").strip().splitlines()
+            head = doc[0] if doc else ""
+            lines.append(f"### {name} ({kind})")
+            lines.append("")
+            if head:
+                lines.append(head)
+                lines.append("")
+            params = stage_params(cls)
+            if params:
+                lines.append("| param | default | doc |")
+                lines.append("|---|---|---|")
+                for pname, p in params:
+                    d = (repr(p.default)
+                         if p.has_default() and not isinstance(p, ComplexParam)
+                         else "—")
+                    lines.append(f"| `{pname}` | `{d}` | {p.doc} |")
+                lines.append("")
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    content = "\n".join(lines)
+    with open(out_path, "w") as fh:
+        fh.write(content)
+    return content
+
+
+def main(out_dir: str = "generated"):
+    r_files = generate_r(os.path.join(out_dir, "R"))
+    generate_api_reference(os.path.join(out_dir, "api.md"))
+    print(f"wrote {len(r_files)} R wrappers + api.md under {out_dir}/")
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else "generated")

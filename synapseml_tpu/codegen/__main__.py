@@ -1,0 +1,6 @@
+from synapseml_tpu.codegen import main
+
+if __name__ == "__main__":
+    import sys
+
+    main(sys.argv[1] if len(sys.argv) > 1 else "generated")

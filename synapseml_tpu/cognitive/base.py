@@ -125,16 +125,14 @@ class CognitiveServicesBase(Transformer, HasServiceParams):
             self.timeout)
         resps = client.send_all(reqs)
 
+        from synapseml_tpu.io.http import response_to_error
+
         out = np.empty(n, dtype=object)
         errors = np.empty(n, dtype=object)
         for i, r in enumerate(resps):
             out[i] = None
-            errors[i] = None
-            if r is None:
-                continue
-            if not 200 <= r.status_code < 300:
-                errors[i] = {"status_code": r.status_code,
-                             "reason": r.reason, "body": r.text[:2048]}
+            errors[i] = None if r is None else response_to_error(r)
+            if r is None or errors[i] is not None:
                 continue
             try:
                 out[i] = self._parse_response(r.json())
@@ -198,11 +196,11 @@ class BatchedTextServiceBase(CognitiveServicesBase):
         errors = np.empty(n, dtype=object)
         out[:] = None
         errors[:] = None
+        from synapseml_tpu.io.http import response_to_error
+
         for (start, stop), r in zip(spans, resps):
-            if r is None or not 200 <= r.status_code < 300:
-                err = None if r is None else {
-                    "status_code": r.status_code, "reason": r.reason,
-                    "body": r.text[:2048]}
+            err = response_to_error(r)
+            if r is None or err is not None:
                 for i in range(start, stop):
                     errors[i] = err
                 continue

@@ -1,0 +1,168 @@
+"""ModelDownloader: pretrained-model repository with hash verification.
+
+Rebuild of the reference's downloader
+(ref: deep-learning/src/main/scala/com/microsoft/ml/spark/cntk/downloader/ModelDownloader.scala:197-265
+— remote repo (DefaultModelRepo:112) + local/HDFS repo (HDFSRepo:42),
+hash-verified download :233-260; Schema.scala:53-72 ``ModelSchema``
+carrying the input node + layer names the ImageFeaturizer needs).
+
+Repos here are a directory (or base URL) containing ``manifest.json``:
+``{"models": [{"name", "file", "sha256", "format", "input_name",
+"image_size", ...}]}``. Downloads verify sha256 before the artifact is
+admitted to the local cache; corrupt bytes never land.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from synapseml_tpu.io.http import (HandlingUtils, HTTPRequestData,
+                                   SingleThreadedHTTPClient)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSchema:
+    """(ref: downloader/Schema.scala:53-72)."""
+    name: str
+    file: str
+    sha256: str
+    format: str = "onnx"
+    input_name: Optional[str] = None
+    image_size: Optional[int] = None
+    num_layers: Optional[int] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ModelSchema":
+        known = {f.name for f in dataclasses.fields(ModelSchema)} - {"extra"}
+        return ModelSchema(
+            **{k: v for k, v in d.items() if k in known},
+            extra={k: v for k, v in d.items() if k not in known})
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class ModelDownloader:
+    """Fetch models from a repo (dir or http(s) base URL) into a local
+    cache, verifying hashes (ref: ModelDownloader.scala downloadModel
+    :233-260)."""
+
+    def __init__(self, local_cache: str,
+                 repo: Optional[str] = None):
+        self.local_cache = local_cache
+        self.repo = repo
+        os.makedirs(local_cache, exist_ok=True)
+        self._client = SingleThreadedHTTPClient(
+            HandlingUtils.advanced(100, 500, 1000))
+
+    # -- repo IO --------------------------------------------------------
+    def _is_remote(self) -> bool:
+        return bool(self.repo) and self.repo.startswith(("http://",
+                                                         "https://"))
+
+    def _fetch(self, rel: str) -> bytes:
+        if self.repo is None:
+            raise ValueError("no repo configured")
+        if self._is_remote():
+            resp = self._client.send(HTTPRequestData(
+                url=f"{self.repo.rstrip('/')}/{rel}", method="GET"))
+            if not 200 <= resp.status_code < 300:
+                raise FileNotFoundError(
+                    f"{rel}: HTTP {resp.status_code} from {self.repo}")
+            return resp.entity or b""
+        with open(os.path.join(self.repo, rel), "rb") as fh:
+            return fh.read()
+
+    # -- public surface -------------------------------------------------
+    def list_models(self) -> List[ModelSchema]:
+        """(ref: ModelDownloader.remoteModels)."""
+        manifest = json.loads(self._fetch("manifest.json").decode("utf-8"))
+        return [ModelSchema.from_dict(m) for m in manifest["models"]]
+
+    def local_models(self) -> List[ModelSchema]:
+        """Models already admitted to the cache."""
+        out = []
+        for name in sorted(os.listdir(self.local_cache)):
+            if name.endswith(".json"):
+                with open(os.path.join(self.local_cache, name)) as fh:
+                    out.append(ModelSchema.from_dict(json.load(fh)))
+        return out
+
+    def download_by_name(self, name: str) -> str:
+        """Returns the local path; verifies sha256 before admitting
+        (a corrupt or tampered artifact raises and is discarded)."""
+        schema = next((m for m in self.list_models() if m.name == name),
+                      None)
+        if schema is None:
+            raise KeyError(f"model {name!r} not in repo manifest")
+        target = os.path.join(self.local_cache, schema.file)
+        if os.path.exists(target) and _sha256(target) == schema.sha256:
+            return target
+        data = self._fetch(schema.file)
+        fd, tmp = tempfile.mkstemp(dir=self.local_cache)
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(data)
+            got = _sha256(tmp)
+            if got != schema.sha256:
+                raise IOError(
+                    f"hash mismatch for {name}: manifest {schema.sha256}, "
+                    f"downloaded {got}")
+            os.replace(tmp, target)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        with open(os.path.join(self.local_cache,
+                               f"{schema.name}.json"), "w") as fh:
+            json.dump(dataclasses.asdict(schema), fh)
+        return target
+
+    def get_bytes(self, name: str) -> bytes:
+        with open(self.download_by_name(name), "rb") as fh:
+            return fh.read()
+
+    def load_onnx_model(self, name: str, **kw):
+        """Straight to an ONNXModel transformer."""
+        from synapseml_tpu.onnx.model import ONNXModel
+
+        return ONNXModel(model_bytes=self.get_bytes(name), **kw)
+
+    def load_image_featurizer(self, name: str, **kw):
+        """Straight to an ImageFeaturizer, schema-informed."""
+        from synapseml_tpu.image.featurizer import ImageFeaturizer
+
+        schema = next((m for m in self.list_models() if m.name == name))
+        if schema.image_size is not None:
+            kw.setdefault("image_size", schema.image_size)
+        return ImageFeaturizer(model_bytes=self.get_bytes(name), **kw)
+
+
+def make_repo(path: str, models: Dict[str, bytes],
+              schemas: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
+    """Author a repo directory from model bytes (the publishing half;
+    tests and airgapped deployments build repos this way)."""
+    os.makedirs(path, exist_ok=True)
+    entries = []
+    for name, blob in models.items():
+        fname = f"{name}.onnx"
+        with open(os.path.join(path, fname), "wb") as fh:
+            fh.write(blob)
+        entry = {"name": name, "file": fname,
+                 "sha256": hashlib.sha256(blob).hexdigest(),
+                 "format": "onnx"}
+        entry.update((schemas or {}).get(name, {}))
+        entries.append(entry)
+    with open(os.path.join(path, "manifest.json"), "w") as fh:
+        json.dump({"models": entries}, fh, indent=1)
+    return path

@@ -893,10 +893,15 @@ def save_checkpoint(path: str, booster: Booster, iterations_done: int,
     import tempfile
 
     os.makedirs(path, exist_ok=True)
+    # serialize the FULL stack: the native writer truncates at
+    # best_iteration, which would silently drop trees past the current
+    # best during an early-stopping run; best_iteration rides in metadata
+    full = dataclasses.replace(booster, best_iteration=-1)
     payload = json.dumps({
         "iterations_done": int(iterations_done),
         "total_iterations": int(total_iterations),
-        "model": booster.save_string(),
+        "best_iteration": int(booster.best_iteration),
+        "model": full.save_string(),
     })
     fd, tmp = tempfile.mkstemp(dir=path)
     with os.fdopen(fd, "w") as fh:
@@ -912,6 +917,7 @@ def load_checkpoint(path: str) -> Tuple[Booster, Dict[str, int]]:
     with open(os.path.join(path, "checkpoint.json")) as fh:
         payload = json.load(fh)
     booster = Booster.load_string(payload.pop("model"))
+    booster.best_iteration = int(payload.get("best_iteration", -1))
     return booster, payload
 
 

@@ -289,6 +289,16 @@ class CustomOutputParser(Transformer, HasInputCol, HasOutputCol):
         return table.with_column(self.output_col, out)
 
 
+def response_to_error(r: Optional[HTTPResponseData]) -> Optional[Dict[str, Any]]:
+    """The shared error-column shape for non-2xx responses
+    ({status_code, reason, body}) — used by SimpleHTTPTransformer and the
+    cognitive services so error schemas never diverge."""
+    if r is None or 200 <= r.status_code < 300:
+        return None
+    return {"status_code": r.status_code, "reason": r.reason,
+            "body": r.text[:2048]}
+
+
 class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     """input parse -> HTTP (retrying, concurrent) -> output parse, with an
     error column keeping failed rows flowing
@@ -333,14 +343,8 @@ class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
         errors = np.empty(len(resps), dtype=object)
         ok = np.zeros(len(resps), dtype=bool)
         for i, r in enumerate(resps):
-            if r is not None and 200 <= r.status_code < 300:
-                ok[i] = True
-                errors[i] = None
-            else:
-                errors[i] = None if r is None else {
-                    "status_code": r.status_code, "reason": r.reason,
-                    "body": r.text[:2048],
-                }
+            errors[i] = response_to_error(r)
+            ok[i] = r is not None and errors[i] is None
         # blank failed responses so the output parser yields None rows
         cleaned = np.empty(len(resps), dtype=object)
         for i, r in enumerate(resps):

@@ -1,0 +1,14 @@
+"""Native host bridge: C++ hot loops behind ctypes, built on demand.
+
+SURVEY.md §2.9: the reference's native layer (JNI jars + NativeLoader).
+The TPU compute path is XLA; this layer accelerates host-side ingest and
+hashing, with pure-Python fallbacks everywhere (check ``available()``).
+"""
+from synapseml_tpu.native.loader import (  # noqa: F401
+    available,
+    load,
+    murmur3_32,
+    murmur3_32_batch,
+    parse_csv_floats,
+    unroll_chw,
+)

@@ -1,0 +1,176 @@
+"""NativeLoader: build-on-demand + dlopen of the C++ host bridge.
+
+Rebuild of the reference's NativeLoader
+(ref: core/src/main/java/com/microsoft/ml/spark/core/env/NativeLoader.java:28-140
+— extracts ``.so``/``.dll`` from jar resources into a temp dir and
+``System.load``s them, OS-prefix aware). Here the artifact is built from
+bundled C++ source with the system toolchain on first use and cached next
+to the package (wheels could ship the prebuilt ``.so`` in the same slot);
+``ctypes`` stands in for JNI. Everything degrades gracefully: callers
+check :func:`available` and keep a pure-Python path.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("synapseml_tpu")
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "synapse_native.cpp")
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB_NAME = "libsynapse_native.so"
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_state: dict = {"lib": None, "tried": False}
+
+
+def _compile(out_path: str) -> bool:
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    # build into a temp file then rename: concurrent processes race safely
+    fd, tmp = tempfile.mkstemp(suffix=".so",
+                               dir=os.path.dirname(out_path))
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", tmp, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.info("native build unavailable: %s", e)
+        os.unlink(tmp)
+        return False
+    if proc.returncode != 0:
+        logger.warning("native build failed: %s", proc.stderr[-2000:])
+        os.unlink(tmp)
+        return False
+    os.replace(tmp, out_path)
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+
+    lib.synapse_abi_version.restype = ctypes.c_int
+    lib.synapse_murmur3_32.restype = ctypes.c_uint32
+    lib.synapse_murmur3_32.argtypes = [u8p, ctypes.c_uint64,
+                                       ctypes.c_uint32]
+    lib.synapse_murmur3_32_batch.restype = None
+    lib.synapse_murmur3_32_batch.argtypes = [
+        u8p, u64p, ctypes.c_uint64, ctypes.c_uint32, u32p]
+    lib.synapse_parse_csv.restype = ctypes.c_uint64
+    lib.synapse_parse_csv.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char, f64p,
+        ctypes.c_uint64, u64p]
+    lib.synapse_unroll_chw.restype = None
+    lib.synapse_unroll_chw.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, f64p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The dlopen entry point; returns None when no toolchain/artifact."""
+    with _lock:
+        if _state["tried"]:
+            return _state["lib"]
+        _state["tried"] = True
+        path = os.path.join(_CACHE_DIR, _LIB_NAME)
+        if not os.path.exists(path) and not _compile(path):
+            return None
+        try:
+            lib = _bind(ctypes.CDLL(path))
+            if lib.synapse_abi_version() != _ABI_VERSION:
+                logger.warning("stale native build; recompiling")
+                os.unlink(path)
+                if not _compile(path):
+                    return None
+                lib = _bind(ctypes.CDLL(path))
+            _state["lib"] = lib
+        except OSError as e:
+            logger.warning("native load failed: %s", e)
+            _state["lib"] = None
+        return _state["lib"]
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# numpy-facing wrappers
+# ---------------------------------------------------------------------------
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native bridge unavailable")
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
+        else (ctypes.c_uint8 * 1)()
+    return int(lib.synapse_murmur3_32(buf, len(data), seed & 0xFFFFFFFF))
+
+
+def murmur3_32_batch(tokens, seed: int = 0) -> np.ndarray:
+    """Hash a sequence of str/bytes tokens in one native call."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native bridge unavailable")
+    blobs = [t.encode("utf-8") if isinstance(t, str) else bytes(t)
+             for t in tokens]
+    n = len(blobs)
+    offsets = np.zeros(n + 1, np.uint64)
+    for i, b in enumerate(blobs):
+        offsets[i + 1] = offsets[i] + len(b)
+    buffer = np.frombuffer(b"".join(blobs) or b"\x00", dtype=np.uint8).copy()
+    out = np.zeros(n, np.uint32)
+    lib.synapse_murmur3_32_batch(
+        buffer.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        n, seed & 0xFFFFFFFF,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
+
+
+def parse_csv_floats(text: bytes, delim: str = ",",
+                     max_vals: Optional[int] = None):
+    """(values[float64], n_rows) from delimiter-separated text; empty or
+    non-numeric fields become NaN."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native bridge unavailable")
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    cap = max_vals if max_vals is not None else text.count(
+        delim.encode()) + text.count(b"\n") + 2
+    out = np.zeros(cap, np.float64)
+    rows = ctypes.c_uint64(0)
+    n = lib.synapse_parse_csv(
+        text, len(text), delim.encode()[0:1][0] if isinstance(delim, str)
+        else delim,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), cap,
+        ctypes.byref(rows))
+    return out[:n], int(rows.value)
+
+
+def unroll_chw(img: np.ndarray) -> np.ndarray:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native bridge unavailable")
+    arr = np.ascontiguousarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    h, w, c = arr.shape
+    out = np.zeros(h * w * c, np.float64)
+    lib.synapse_unroll_chw(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w, c,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out

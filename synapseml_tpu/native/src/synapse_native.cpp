@@ -1,0 +1,126 @@
+// Native host bridge for synapseml_tpu.
+//
+// The reference ships prebuilt C++ engines behind JNI (SURVEY.md §2.9:
+// lib_lightgbm, vw-jni, opencv — loaded by NativeLoader.java:28-140). The
+// TPU compute path here is XLA, so the native layer covers the *host-side*
+// hot loops instead: feature hashing over raw bytes (the JVM-side work of
+// VowpalWabbitFeaturizer / HashingTF) and text ingest — exposed as a plain
+// C ABI for ctypes (no pybind11 in the image).
+//
+// Build: g++ -O3 -shared -fPIC -o libsynapse_native.so synapse_native.cpp
+// (done on demand by synapseml_tpu.native.loader, cached next to the
+// source — the NativeLoader extract-and-dlopen analogue).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// MurmurHash3 x86_32 — bit-exact with synapseml_tpu.utils.hashing.murmur3_32
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+uint32_t synapse_murmur3_32(const uint8_t* data, uint64_t len, uint32_t seed) {
+    const uint64_t nblocks = len / 4;
+    uint32_t h = seed;
+    const uint32_t c1 = 0xcc9e2d51u;
+    const uint32_t c2 = 0x1b873593u;
+
+    for (uint64_t i = 0; i < nblocks; i++) {
+        uint32_t k;
+        std::memcpy(&k, data + i * 4, 4);  // little-endian load
+        k *= c1;
+        k = rotl32(k, 15);
+        k *= c2;
+        h ^= k;
+        h = rotl32(h, 13);
+        h = h * 5 + 0xe6546b64u;
+    }
+
+    const uint8_t* tail = data + nblocks * 4;
+    uint32_t k = 0;
+    switch (len & 3) {
+        case 3: k ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+        case 2: k ^= (uint32_t)tail[1] << 8;  [[fallthrough]];
+        case 1: k ^= (uint32_t)tail[0];
+                k *= c1; k = rotl32(k, 15); k *= c2; h ^= k;
+    }
+
+    h ^= (uint32_t)len;
+    h ^= h >> 16;
+    h *= 0x85ebca6bu;
+    h ^= h >> 13;
+    h *= 0xc2b2ae35u;
+    h ^= h >> 16;
+    return h;
+}
+
+// Batch: hash n byte strings packed into one buffer with prefix offsets.
+// offsets has n+1 entries; string i spans [offsets[i], offsets[i+1]).
+void synapse_murmur3_32_batch(const uint8_t* buffer, const uint64_t* offsets,
+                              uint64_t n, uint32_t seed, uint32_t* out) {
+    for (uint64_t i = 0; i < n; i++) {
+        out[i] = synapse_murmur3_32(buffer + offsets[i],
+                                    offsets[i + 1] - offsets[i], seed);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Fast float CSV/TSV ingest (the SWIG chunked-array streaming analogue,
+// SURVEY.md §3.1 HOT LOOP #1: row marshalling into native arrays)
+// ---------------------------------------------------------------------------
+
+// Parse up to max_vals doubles from delimiter-separated text. Returns the
+// number of values written; *rows receives the number of newline-terminated
+// rows consumed. Empty fields parse as NaN (missing), matching the
+// engine's missing-value routing.
+uint64_t synapse_parse_csv(const char* text, uint64_t len, char delim,
+                           double* out, uint64_t max_vals, uint64_t* rows) {
+    uint64_t nvals = 0;
+    uint64_t nrows = 0;
+    const char* p = text;
+    const char* end = text + len;
+    while (p < end && nvals < max_vals) {
+        const char* field_start = p;
+        while (p < end && *p != delim && *p != '\n') p++;
+        if (p == field_start) {
+            out[nvals++] = __builtin_nan("");
+        } else {
+            char* parse_end = nullptr;
+            double v = std::strtod(field_start, &parse_end);
+            out[nvals++] = (parse_end == field_start)
+                ? __builtin_nan("") : v;
+        }
+        if (p < end) {
+            if (*p == '\n') nrows++;
+            p++;
+        }
+    }
+    // count a trailing row without a final newline
+    if (len > 0 && text[len - 1] != '\n' && nvals > 0) nrows++;
+    *rows = nrows;
+    return nvals;
+}
+
+// ---------------------------------------------------------------------------
+// UnrollImage: HWC uint8 -> CHW float64 (core/.../image/UnrollImage.scala
+// layout), the per-image inner loop of the binary->vector path
+// ---------------------------------------------------------------------------
+
+void synapse_unroll_chw(const uint8_t* img, uint64_t h, uint64_t w,
+                        uint64_t c, double* out) {
+    for (uint64_t ch = 0; ch < c; ch++)
+        for (uint64_t y = 0; y < h; y++)
+            for (uint64_t x = 0; x < w; x++)
+                out[ch * h * w + y * w + x] =
+                    (double)img[(y * w + x) * c + ch];
+}
+
+int synapse_abi_version() { return 1; }
+
+}  // extern "C"

@@ -59,10 +59,45 @@ def murmur3_32(data: Union[bytes, str], seed: int = 0) -> int:
     return h
 
 
+def _native_murmur():
+    try:
+        from synapseml_tpu import native
+        if native.available():
+            return native.murmur3_32
+    except Exception:  # noqa: BLE001 - any native failure -> pure python
+        pass
+    return None
+
+
+@lru_cache(maxsize=1)
+def _scalar_hash_impl():
+    return _native_murmur() or (lambda b, seed=0: murmur3_32(b, seed))
+
+
 @lru_cache(maxsize=1 << 20)
 def hash_token(token: str, seed: int = 0) -> int:
-    """Memoized murmur3 of a token — each distinct token hashed once per process."""
-    return murmur3_32(token, seed)
+    """Memoized murmur3 of a token — each distinct token hashed once per
+    process; the C++ bridge computes it when available (NativeLoader
+    analogue, synapseml_tpu.native)."""
+    return int(_scalar_hash_impl()(token.encode("utf-8"), seed))
+
+
+def hash_tokens_batch(tokens, seed: int = 0) -> np.ndarray:
+    """Batch token hashing: one native call when the bridge is present,
+    else the memoized scalar path."""
+    try:
+        from synapseml_tpu import native
+        if native.available():
+            return native.murmur3_32_batch(tokens, seed).astype(np.int64)
+    except Exception:  # noqa: BLE001
+        pass
+    # encode exactly like the native wrapper: bytes pass through, str
+    # utf-8 — indices must not depend on whether the bridge compiled
+    return np.array([
+        murmur3_32(bytes(t) if isinstance(t, (bytes, bytearray))
+                   else str(t), seed)
+        for t in tokens
+    ], np.int64)
 
 
 def hash_index(token: str, num_features: int, seed: int = 0) -> int:

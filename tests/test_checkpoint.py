@@ -116,3 +116,20 @@ def test_resume_with_early_stopping_offsets_best_iteration():
     # truncated predict uses combined-stack indices and stays sane
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(yv, resumed.predict(Xv)) > 0.9
+
+
+def test_checkpoint_under_early_stopping_keeps_full_stack(tmp_path):
+    """Early stopping must not truncate checkpointed trees: the stored
+    model carries the full stack, best_iteration rides metadata."""
+    Xv = RNG.normal(size=(120, 6))
+    yv = (Xv[:, 0] + Xv[:, 1] * Xv[:, 2] > 0).astype(np.float64)
+    ckpt = str(tmp_path / "ck")
+    p = BoostParams(objective="binary", num_iterations=30, num_leaves=5,
+                    early_stopping_round=50)
+    b = train(p, X, Y, valid_sets=[(Xv, yv)], checkpoint_dir=ckpt,
+              checkpoint_every=5)
+    loaded, meta = load_checkpoint(ckpt)
+    assert loaded.num_trees == meta["iterations_done"]
+    assert loaded.best_iteration == meta["best_iteration"]
+    np.testing.assert_allclose(loaded.predict(X), b.predict(X),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,93 @@
+"""ModelDownloader tests (ref: deep-learning/.../downloader/
+ModelDownloader.scala:197-265 — local + remote repos, hash verification)."""
+import functools
+import http.server
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.dl.downloader import ModelDownloader, make_repo
+from synapseml_tpu.onnx import zoo
+
+
+@pytest.fixture(scope="module")
+def repo(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("repo"))
+    make_repo(path, {
+        "tiny_mlp": zoo.mlp([6, 12], num_classes=3, seed=4),
+        "tiny_resnet": zoo.tiny_resnet(image_size=24),
+    }, schemas={
+        "tiny_resnet": {"input_name": "data", "image_size": 24},
+        "tiny_mlp": {"input_name": "input"},
+    })
+    return path
+
+
+def test_local_repo_download_and_cache(repo, tmp_path):
+    cache = str(tmp_path / "cache")
+    dl = ModelDownloader(cache, repo=repo)
+    names = [m.name for m in dl.list_models()]
+    assert set(names) == {"tiny_mlp", "tiny_resnet"}
+    p = dl.download_by_name("tiny_mlp")
+    assert os.path.exists(p)
+    # cached: second call returns the same artifact without re-fetch
+    assert dl.download_by_name("tiny_mlp") == p
+    assert [m.name for m in dl.local_models()] == ["tiny_mlp"]
+
+
+def test_hash_verification_rejects_tampering(repo, tmp_path):
+    # corrupt the repo artifact after the manifest was written
+    with open(os.path.join(repo, "tiny_resnet.onnx"), "r+b") as fh:
+        fh.seek(10)
+        fh.write(b"\xff\xff\xff")
+    dl = ModelDownloader(str(tmp_path / "cache2"), repo=repo)
+    with pytest.raises(IOError, match="hash mismatch"):
+        dl.download_by_name("tiny_resnet")
+    # nothing admitted to the cache
+    assert dl.local_models() == []
+    # restore for other tests
+    make_repo(repo, {
+        "tiny_mlp": zoo.mlp([6, 12], num_classes=3, seed=4),
+        "tiny_resnet": zoo.tiny_resnet(image_size=24),
+    }, schemas={
+        "tiny_resnet": {"input_name": "data", "image_size": 24},
+        "tiny_mlp": {"input_name": "input"},
+    })
+
+
+def test_http_repo(repo, tmp_path):
+    handler = functools.partial(
+        http.server.SimpleHTTPRequestHandler, directory=repo)
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        dl = ModelDownloader(
+            str(tmp_path / "cache3"),
+            repo=f"http://127.0.0.1:{httpd.server_address[1]}")
+        model = dl.load_onnx_model("tiny_mlp", argmax_output_col="pred")
+        x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+        out = model.transform(Table({"input": x}))
+        assert np.asarray(out["pred"]).shape == (4,)
+        with pytest.raises(KeyError):
+            dl.download_by_name("nope")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_featurizer_from_schema(repo, tmp_path):
+    dl = ModelDownloader(str(tmp_path / "cache4"), repo=repo)
+    feat = dl.load_image_featurizer("tiny_resnet", input_col="image",
+                                    output_col="f")
+    assert feat.image_size == 24  # schema-informed
+    img = np.random.default_rng(1).integers(0, 256, (24, 24, 3)).astype(
+        np.uint8)
+    col = np.empty(1, dtype=object)
+    col[0] = img
+    out = feat.transform(Table({"image": col}))
+    assert np.asarray(out["f"]).ndim == 2

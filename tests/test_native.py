@@ -1,0 +1,69 @@
+"""Native C++ bridge tests (NativeLoader analogue,
+ref: core/src/main/java/com/microsoft/ml/spark/core/env/NativeLoader.java:28-140;
+SWIG array streaming, SURVEY.md §3.1 HOT LOOP #1)."""
+import numpy as np
+import pytest
+
+from synapseml_tpu import native
+from synapseml_tpu.utils.hashing import (hash_token, hash_tokens_batch,
+                                         murmur3_32)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for the native bridge")
+
+
+def test_murmur_bit_exact_with_python():
+    tokens = ["", "a", "ab", "abc", "abcd", "abcde", "hello world",
+              "émoji ☃ bytes", "x" * 257] + [f"t{i}" for i in range(100)]
+    for seed in (0, 42, 0xDEADBEEF):
+        for t in tokens:
+            assert native.murmur3_32(t.encode(), seed) == murmur3_32(t, seed)
+        batch = native.murmur3_32_batch(tokens, seed)
+        for i, t in enumerate(tokens):
+            assert int(batch[i]) == murmur3_32(t, seed)
+
+
+def test_hash_tokens_batch_uses_native_and_matches():
+    toks = [f"feature_{i}" for i in range(500)]
+    got = hash_tokens_batch(toks, seed=7)
+    want = [murmur3_32(t, 7) for t in toks]
+    np.testing.assert_array_equal(got, want)
+    # scalar memoized path agrees too
+    assert hash_token("feature_3", 7) == want[3]
+
+
+def test_csv_parser_matches_numpy():
+    rng = np.random.default_rng(0)
+    mat = rng.normal(size=(50, 8))
+    text = "\n".join(",".join(f"{v:.17g}" for v in row) for row in mat)
+    vals, rows = native.parse_csv_floats(text.encode())
+    assert rows == 50
+    np.testing.assert_allclose(vals.reshape(50, 8), mat)
+
+
+def test_csv_parser_missing_and_garbage():
+    vals, rows = native.parse_csv_floats(b"1,,3\nx,5,\n")
+    assert rows == 2
+    assert vals[0] == 1 and np.isnan(vals[1]) and vals[2] == 3
+    assert np.isnan(vals[3]) and vals[4] == 5 and np.isnan(vals[5])
+
+
+def test_unroll_matches_python():
+    from synapseml_tpu.image.ops import unroll_chw as py_unroll
+
+    img = np.random.default_rng(1).integers(0, 256, (9, 6, 3)).astype(np.uint8)
+    np.testing.assert_array_equal(native.unroll_chw(img), py_unroll(img))
+    gray = img[..., 0]
+    np.testing.assert_array_equal(native.unroll_chw(gray), py_unroll(gray))
+
+
+def test_loader_caches_artifact():
+    import os
+
+    from synapseml_tpu.native import loader
+
+    lib1 = loader.load()
+    lib2 = loader.load()
+    assert lib1 is lib2
+    assert os.path.exists(os.path.join(loader._CACHE_DIR, loader._LIB_NAME))
+    assert lib1.synapse_abi_version() == loader._ABI_VERSION

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Test runner (ref: tools/pytest/run_all_tests.py): package-sharded pytest,
+# mirroring the reference CI's per-package UnitTests matrix.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+shards=(
+  "tests/test_core.py tests/test_stages.py tests/test_featurize.py"
+  "tests/test_gbdt.py tests/test_lgbm_format.py tests/test_gates.py tests/test_checkpoint.py"
+  "tests/test_linear.py tests/test_knn_iforest.py tests/test_train_automl_rec.py"
+  "tests/test_onnx.py tests/test_runtime_dl.py tests/test_image.py tests/test_downloader.py"
+  "tests/test_parallel.py"
+  "tests/test_io_http.py tests/test_serving.py tests/test_cognitive.py tests/test_cyber.py"
+  "tests/test_fuzzing.py tests/test_explainers.py tests/test_native.py tests/test_codegen.py tests/test_fault.py"
+)
+for shard in "${shards[@]}"; do
+  echo "=== $shard"
+  python -m pytest $shard -q
+done
