@@ -1,0 +1,28 @@
+#' CNTKModel
+#'
+#' Runs a CNTK-lineage network (exported to ONNX) as a transformer.
+#'
+#' @param argmax_output_col column for argmax of first output
+#' @param compute_dtype device compute dtype: float32|bfloat16|float16
+#' @param cut_layers trailing graph nodes dropped (headless featurization; persists across serde)
+#' @param feed_dict graph input name -> input column
+#' @param fetch_dict output column -> graph output name
+#' @param mini_batch_size max rows per device batch
+#' @param model_payload raw .onnx protobuf bytes
+#' @param softmax_output_col column for softmax of first output
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_cntk_model <- function(argmax_output_col = NULL, compute_dtype = "float32", cut_layers = 0, feed_dict = NULL, fetch_dict = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
+  mod <- reticulate::import("synapseml_tpu.dl.cntk")
+  kwargs <- Filter(Negate(is.null), list(
+    argmax_output_col = argmax_output_col,
+    compute_dtype = compute_dtype,
+    cut_layers = cut_layers,
+    feed_dict = feed_dict,
+    fetch_dict = fetch_dict,
+    mini_batch_size = mini_batch_size,
+    model_payload = model_payload,
+    softmax_output_col = softmax_output_col
+  ))
+  do.call(mod$CNTKModel, kwargs)
+}

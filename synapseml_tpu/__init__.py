@@ -17,9 +17,13 @@ Layer map (SURVEY.md §1 → TPU-native):
   knn/       BallTree KNN / ConditionalKNN
   recommendation/ SAR recommender + ranking evaluators
   image/     image ops, ImageFeaturizer
-  dl/        deep-learning models (ResNet, tagger) + distributed trainer
-  io/        HTTP-on-Spark analogue, serving
-  utils/     cluster/fault/timing utilities
+  dl/        deep-learning models (ResNet, tagger), CNTKModel, ModelDownloader
+  io/        HTTP-on-tables, serving, PowerBI, binary reader
+  cognitive/ value-or-column ServiceParams + Azure-shaped service zoo
+  cyber/     AccessAnomaly collaborative-filtering anomaly detection
+  native/    C++ host bridge (NativeLoader analogue) via ctypes
+  codegen/   reflection-driven R wrappers + API reference
+  utils/     fault tolerance, hashing, profiling utilities
 """
 __version__ = "0.1.0"
 

@@ -69,13 +69,22 @@ def histogram(binned, grad, hess, mask, n_bins: int, axis_name: Optional[str] = 
     w = mask.astype(jnp.float32)
     data = jnp.stack([grad * w, hess * w, w], axis=-1)          # [N, 3]
     if jax.default_backend() == "tpu":
-        oh = jax.nn.one_hot(binned.astype(jnp.int32), n_bins,
-                            dtype=jnp.float32)
-        # HIGHEST: default MXU precision would truncate grad/hess to bf16
-        # inside the dot and perturb split decisions vs the CPU path
-        hist = jnp.einsum("nfb,nc->fbc", oh, data,
-                          preferred_element_type=jnp.float32,
-                          precision=lax.Precision.HIGHEST)
+        from synapseml_tpu.gbdt import pallas_kernels
+
+        # shape bounds keep the kernel's VMEM blocks + static F-unroll sane;
+        # wide-feature / huge-bin cases route to the XLA formulation
+        if (pallas_kernels.available() and f <= 128 and n_bins <= 512
+                and n >= 512):
+            # VMEM-resident accumulator kernel: one HBM pass over the rows
+            hist = pallas_kernels.histogram_tpu(binned, data, n_bins)
+        else:
+            oh = jax.nn.one_hot(binned.astype(jnp.int32), n_bins,
+                                dtype=jnp.float32)
+            # HIGHEST: default MXU precision would truncate grad/hess to
+            # bf16 inside the dot and perturb split decisions
+            hist = jnp.einsum("nfb,nc->fbc", oh, data,
+                              preferred_element_type=jnp.float32,
+                              precision=lax.Precision.HIGHEST)
     else:
         # CPU/GPU: scatter-add beats materializing the one-hot
         ids = (binned.astype(jnp.int32)

@@ -1,0 +1,133 @@
+"""Tracing / profiling utilities (SURVEY.md §5: the reference has only
+StopWatch-based per-component timing — VW per-partition stats DataFrames,
+vw/.../VowpalWabbitBase.scala:294-328,480-489, and the Timer stage; the
+TPU build is told to replace these with jax profiler hooks + per-stage
+device timing).
+
+Three tiers:
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace directory (XLA op timeline, HBM usage);
+- :class:`StopWatch` — the reference's accumulating stopwatch
+  (core/.../core/utils/StopWatch.scala:35), device-sync aware;
+- :func:`stage_stats` — per-stage wall/device timing over a pipeline run,
+  the VW perf-DataFrame analogue, returned as a Table.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from synapseml_tpu.data.table import Table
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: int = 2):
+    """jax.profiler trace around a block; view in TensorBoard/XProf.
+    Degrades to a no-op where the profiler is unsupported."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir,
+                                 create_perfetto_link=False)
+        started = True
+    except Exception:  # noqa: BLE001 - profiling must never break the job
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def annotate(name: str):
+    """Named region in the device trace (TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def _sync():
+    """Block until all dispatched device work completes (so wall times
+    include device execution, not just dispatch)."""
+    import jax
+
+    try:
+        for d in jax.live_arrays():
+            d.block_until_ready()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class StopWatch:
+    """(ref: core/.../core/utils/StopWatch.scala) — accumulating timer with
+    optional device synchronization at measure boundaries."""
+
+    def __init__(self, sync_device: bool = False):
+        self.elapsed = 0.0
+        self._start: Optional[float] = None
+        self.sync_device = sync_device
+
+    def start(self):
+        if self.sync_device:
+            _sync()
+        self._start = time.perf_counter()
+        return self
+
+    def stop(self) -> float:
+        if self.sync_device:
+            _sync()
+        if self._start is not None:
+            self.elapsed += time.perf_counter() - self._start
+            self._start = None
+        return self.elapsed
+
+    @contextlib.contextmanager
+    def measure(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+
+def stage_stats(pipeline_stages, table: Table,
+                sync_device: bool = True) -> tuple:
+    """Run stages sequentially, timing each (fit+transform for estimators);
+    returns (final_table, stats_table) — the per-partition perf-stats
+    DataFrame analogue (VowpalWabbitBase.scala:480-489)."""
+    from synapseml_tpu.core.pipeline import Estimator
+
+    names: List[str] = []
+    kinds: List[str] = []
+    seconds: List[float] = []
+    rows_in: List[int] = []
+    current = table
+    for stage in pipeline_stages:
+        sw = StopWatch(sync_device=sync_device)
+        n_in = current.num_rows
+        with sw.measure():
+            if isinstance(stage, Estimator):
+                fitted = stage.fit(current)
+                current = fitted.transform(current)
+                kinds.append("estimator")
+            else:
+                current = stage.transform(current)
+                kinds.append("transformer")
+        names.append(type(stage).__name__)
+        seconds.append(sw.elapsed)
+        rows_in.append(n_in)
+    total = sum(seconds) or 1.0
+    stats = Table({
+        "stage": np.array(names, dtype=object),
+        "kind": np.array(kinds, dtype=object),
+        "seconds": np.array(seconds, np.float64),
+        "pct": np.array([s / total * 100.0 for s in seconds], np.float64),
+        "rows_in": np.array(rows_in, np.int64),
+    })
+    return current, stats

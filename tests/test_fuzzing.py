@@ -176,6 +176,15 @@ def _series_table():
     return Table({"series": col})
 
 
+def _cntk_model():
+    from synapseml_tpu.dl.cntk import CNTKModel
+    from synapseml_tpu.onnx import zoo
+
+    m = CNTKModel(model_bytes=zoo.mlp([4, 8], num_classes=2, seed=6))
+    return m.set_input_node(0, column="features").set_output_node(
+        0, column="probs")
+
+
 def _access_table():
     rng = np.random.default_rng(RNG_SEED)
     n = 40
@@ -513,7 +522,8 @@ def _test_objects():
             num_bits=10), vw_table()),
         "VectorZipper": lambda: (VectorZipper(
             input_cols=["a", "b"], output_col="zipped"), num()),
-        # onnx -----------------------------------------------------------
+        # onnx / cntk ----------------------------------------------------
+        "CNTKModel": lambda: (_cntk_model(), num()),
         "ONNXModel": lambda: (ONNXModel(
             model_bytes=zoo.mlp([4, 8], num_classes=3, seed=2),
             feed_dict={"input": "features"}, argmax_output_col="pred"),
