@@ -87,10 +87,12 @@ def bench_gbdt_train():
     est = LightGBMClassifier(num_iterations=100, num_leaves=31,
                              learning_rate=0.1)
     est.fit(table)  # warmup: compile of binning + grower loop
-    start = time.perf_counter()
-    est.fit(table)
-    elapsed = time.perf_counter() - start
-    return n * 100 / elapsed
+    best = float("inf")
+    for _ in range(3):  # best-of-3: the tunnel adds run-to-run jitter
+        start = time.perf_counter()
+        est.fit(table)
+        best = min(best, time.perf_counter() - start)
+    return n * 100 / best
 
 
 def main():

@@ -28,6 +28,13 @@ from synapseml_tpu.core.param import Param
 from synapseml_tpu.onnx.model import ONNXModel
 
 
+_NATIVE_CNTK_MSG = (
+    "this is a native CNTK v2 .model file; its runtime (CNTK 2.4 JNI) has "
+    "no TPU port. Export it to ONNX once with the CNTK python package — "
+    "z.save('model.onnx', format=cntk.ModelFormat.ONNX) — and load that "
+    "file here")
+
+
 def _looks_like_onnx(payload: bytes) -> bool:
     # ONNX files are a protobuf ModelProto: field 1 (ir_version) varint or
     # field 7/8; CNTK v2 binary models start with the magic "B\x00C\x00N\x00"
@@ -57,12 +64,7 @@ class CNTKModel(ONNXModel):
                 model_bytes = fh.read()
             model_path = None
         if model_bytes is not None and not _looks_like_onnx(model_bytes):
-            raise ValueError(
-                "this is a native CNTK v2 .model file; its runtime (CNTK "
-                "2.4 JNI) has no TPU port. Export it to ONNX once with "
-                "the CNTK python package — "
-                "z.save('model.onnx', format=cntk.ModelFormat.ONNX) — "
-                "and load that file here")
+            raise ValueError(_NATIVE_CNTK_MSG)
         super().__init__(model_bytes=model_bytes, **kw)
 
     # -- truncation-aware graph (param-backed: survives save/load/copy) --
@@ -72,6 +74,11 @@ class CNTKModel(ONNXModel):
         cache = self.__dict__.get("_cntk_graph")
         if cache is not None and cache[0] == cut:
             return cache[1]
+        payload = self.model_payload
+        if payload is not None and not _looks_like_onnx(bytes(payload)):
+            # covers every assignment path (model_payload=... via set(),
+            # the generated R wrapper, load) — not just __init__ kwargs
+            raise ValueError(_NATIVE_CNTK_MSG)
         g = ONNXModel.graph.fget(self)
         if cut:
             g = g.truncated(cut)

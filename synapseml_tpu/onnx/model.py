@@ -106,9 +106,11 @@ class ONNXModel(Transformer):
 
     def _executor(self) -> BatchedExecutor:
         cache = self.__dict__.setdefault("_executor_cache", {})
-        key = (self.mini_batch_size, self.compute_dtype)
+        g = self.graph
+        # graph identity in the key: subclasses (CNTKModel cut_layers) can
+        # swap the graph under us; a stale executor would run the old one
+        key = (id(g), self.mini_batch_size, self.compute_dtype)
         if key not in cache:
-            g = self.graph
             dtype = _DTYPES[self.compute_dtype]
             params = g.params
             if self.compute_dtype != "float32":

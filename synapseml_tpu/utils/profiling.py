@@ -30,11 +30,20 @@ def trace(log_dir: str, host_tracer_level: int = 2):
     import jax
 
     try:
-        jax.profiler.start_trace(log_dir,
-                                 create_perfetto_link=False)
+        kwargs = {"create_perfetto_link": False}
+        opts_cls = getattr(jax.profiler, "ProfileOptions", None)
+        if opts_cls is not None:
+            opts = opts_cls()
+            opts.host_tracer_level = host_tracer_level
+            kwargs["profiler_options"] = opts
+        jax.profiler.start_trace(log_dir, **kwargs)
         started = True
     except Exception:  # noqa: BLE001 - profiling must never break the job
-        started = False
+        try:  # older jax: no profiler_options kwarg
+            jax.profiler.start_trace(log_dir, create_perfetto_link=False)
+            started = True
+        except Exception:  # noqa: BLE001
+            started = False
     try:
         yield
     finally:

@@ -203,3 +203,78 @@ print("subprocess ok", dict(mesh.shape))
                          capture_output=True, text=True, timeout=120)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "subprocess ok" in out.stdout
+
+
+def test_two_process_distributed_mesh():
+    """Full multi-host bootstrap, for real: two OS processes rendezvous,
+    join jax.distributed, build a global mesh spanning both, and psum over
+    DCN — the driver-rendezvous -> NetworkInit -> collectives path
+    (SURVEY.md §2.10) with actual process isolation."""
+    import os
+    import subprocess
+    import sys
+
+    from synapseml_tpu.io.serving import find_open_port
+
+    rdv_port = find_open_port(26500)
+    coord_port = find_open_port(26600)
+    worker_code = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+rank_hint = int(sys.argv[1])
+from synapseml_tpu.parallel.distributed import (DriverRendezvous,
+                                                rendezvous_and_initialize)
+if rank_hint == 0:
+    drv = DriverRendezvous(num_workers=2, host="127.0.0.1",
+                           port={rdv_port}).start()
+reply = rendezvous_and_initialize("127.0.0.1", {rdv_port},
+                                  my_host="127.0.0.1", rank_hint=rank_hint,
+                                  coordinator_port={coord_port})
+import jax
+import jax.numpy as jnp
+import numpy as np
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4  # 2 local per process
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+out = jax.jit(shard_map(
+    lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+    in_specs=P("dp"), out_specs=P("dp"), check_vma=False),
+    out_shardings=NamedSharding(mesh, P("dp")))(
+        jnp.arange(8, dtype=jnp.float32))
+local = np.asarray(
+    [s.data for s in out.addressable_shards][0]).reshape(-1)
+print("RANK", reply["process_id"], "PSUM", float(local[0]), flush=True)
+""".replace("{rdv_port}", str(rdv_port)).replace("{coord_port}",
+                                                 str(coord_port))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = "."
+    procs = [
+        subprocess.Popen([sys.executable, "-c", worker_code, str(i)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("two-process distributed run hung")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err[-3000:]
+    ranks = sorted(line.split()[1] for rc, out, _ in outs
+                   for line in out.splitlines() if line.startswith("RANK"))
+    assert ranks == ["0", "1"]
+    # psum over the global 4-device mesh of arange(8) sharded by dp:
+    # every shard's first element sums the 4 shard leads 0+2+4+6 = 12
+    for rc, out, _ in outs:
+        for line in out.splitlines():
+            if line.startswith("RANK"):
+                assert line.split()[3] == "12.0", line

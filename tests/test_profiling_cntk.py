@@ -127,3 +127,26 @@ def test_cntk_multi_input_feed_merge():
     x = np.ones((2, 3), np.float32)
     out = m.transform(Table({"left": x, "right": x * 2}))
     np.testing.assert_allclose(np.asarray(out["sum"]), x * 3)
+
+
+def test_cntk_cut_via_param_api_refreshes_executor():
+    """Setting cut_layers through the public param surface must not reuse
+    a stale full-graph executor."""
+    blob = zoo.tiny_resnet(image_size=24)
+    m = CNTKModel(model_bytes=blob, feed_dict={"data": "img"},
+                  fetch_dict=None)
+    x = np.random.default_rng(0).normal(size=(2, 3, 24, 24)).astype(
+        np.float32)
+    full = np.asarray(m.transform(Table({"img": x}))[m.graph.output_names[0]])
+    m.set(cut_layers=1)  # plain param write, no helper
+    feats = np.asarray(
+        m.transform(Table({"img": x}))[m.graph.output_names[0]])
+    assert feats.shape != full.shape  # truncated output, not head logits
+
+
+def test_cntk_payload_param_path_also_rejected():
+    fake = "BCNTK".encode("utf-16-le") + b"\x00" * 64
+    m = CNTKModel()
+    m.set(model_payload=fake)  # the generated-wrapper path
+    with pytest.raises(ValueError, match="Export it to ONNX"):
+        _ = m.graph
