@@ -71,10 +71,10 @@ class CNTKModel(ONNXModel):
     @property
     def graph(self):
         cut = int(self.cut_layers or 0)
-        cache = self.__dict__.get("_cntk_graph")
-        if cache is not None and cache[0] == cut:
-            return cache[1]
         payload = self.model_payload
+        cache = self.__dict__.get("_cntk_graph")
+        if cache is not None and cache[0] == (cut, id(payload)):
+            return cache[1]
         if payload is not None and not _looks_like_onnx(bytes(payload)):
             # covers every assignment path (model_payload=... via set(),
             # the generated R wrapper, load) — not just __init__ kwargs
@@ -82,7 +82,7 @@ class CNTKModel(ONNXModel):
         g = ONNXModel.graph.fget(self)
         if cut:
             g = g.truncated(cut)
-        self.__dict__["_cntk_graph"] = (cut, g)
+        self.__dict__["_cntk_graph"] = ((cut, id(payload)), g)
         return g
 
     def _post_copy(self, src):

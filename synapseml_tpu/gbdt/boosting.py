@@ -516,7 +516,7 @@ def _assemble_booster(stacked, p: BoostParams, k: int, init: float, f: int,
 @lru_cache(maxsize=64)
 def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
                   track_dev: bool, track_rank: bool,
-                  metric_name: Optional[str]):
+                  metric_name: Optional[str], blocked_rank: bool = False):
     """Build (and cache) the jitted chunked-scan trainer for one static
     config. Data rides in through the ``consts`` argument, so repeated fits
     with the same hyperparameters reuse the compiled executable instead of
@@ -550,8 +550,15 @@ def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
                 g, h = obj_fn(scores, y_onehot, wd)
                 return g[:, class_idx], h[:, class_idx]
             if is_rank:
-                g, h = obj.lambdarank_grad(scores, yd, group_ids,
-                                           max_dcg_pos=p.max_position)
+                if blocked_rank:
+                    # block-diagonal: O(N*Gmax) — the dense pair matrix
+                    # would be O(N^2) over the whole dataset
+                    g, h = obj.lambdarank_grad_blocked(
+                        scores, yd, consts["qidx"], consts["qmask"],
+                        consts["qinv"], max_dcg_pos=p.max_position)
+                else:  # pathological skew: dense is cheaper
+                    g, h = obj.lambdarank_grad(
+                        scores, yd, group_ids, max_dcg_pos=p.max_position)
                 if wd is not None:
                     g, h = g * wd, h * wd
                 return g, h
@@ -791,9 +798,25 @@ def train(
     else:
         vsum0 = jnp.zeros((0, k), jnp.float32)
 
+    blocked_rank = False
+    qidx = qmask = qinv = None
+    if is_rank:
+        if group is None:
+            raise ValueError("ranking objectives need a group array")
+        qidx_np, qmask_np, qinv_np = obj.build_query_blocks(group)
+        q, gmax = qidx_np.shape
+        # blocked is O(Q*Gmax^2): a skewed group-size distribution (one
+        # huge query among many tiny ones) can exceed the dense O(N^2)
+        # pair matrix it replaces — use whichever is cheaper
+        blocked_rank = q * gmax * gmax <= n * n and q * gmax <= 8 * n
+        if blocked_rank:
+            qidx, qmask, qinv = (jnp.asarray(qidx_np),
+                                 jnp.asarray(qmask_np),
+                                 jnp.asarray(qinv_np))
     consts = dict(
         binned=binned, yd=yd, wd=wd, gids=group_ids, thr=thresholds,
         init=jnp.float32(init),
+        qidx=qidx, qmask=qmask, qinv=qinv,
         vx=tracker.sets[0][0] if tracker.enabled else None,
         vy=tracker.sets[0][1] if tracker.enabled else None)
     # normalize cache-key fields the traced scan never reads (seed, iteration
@@ -805,7 +828,8 @@ def train(
         deterministic=True)
     scan_fn = _make_scan_fn(
         key_p, gp, k, tracker.enabled, track_dev, track_rank,
-        tracker.metric_name if tracker.enabled else None)
+        tracker.metric_name if tracker.enabled else None,
+        blocked_rank=blocked_rank)
 
     total_iters = p.num_iterations
     chunk = _compute_chunk(p, tracker, track_rank, total_iters,

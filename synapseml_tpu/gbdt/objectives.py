@@ -126,6 +126,65 @@ def lambdarank_grad(preds, labels, group_ids, max_dcg_pos: int = 30,
     return grad, jnp.maximum(hess, 1e-6)
 
 
+def build_query_blocks(group_ids):
+    """Host-side layout for block-diagonal lambdarank: rows gathered into
+    [Q, G] query blocks (G = max group size). Returns
+    ``(row_index [Q, G] int32, pad_mask [Q, G] bool, inv [N] int64)``
+    where ``inv`` maps each flat row to its block position (for the
+    gather back)."""
+    import numpy as np
+
+    group_ids = np.asarray(group_ids)
+    order = np.argsort(group_ids, kind="stable")
+    sorted_g = group_ids[order]
+    bounds = np.nonzero(sorted_g[1:] != sorted_g[:-1])[0] + 1
+    groups = np.split(order, bounds)
+    gmax = max((len(g) for g in groups), default=1)
+    q = len(groups)
+    row_index = np.zeros((q, gmax), np.int32)
+    pad_mask = np.zeros((q, gmax), bool)
+    inv = np.zeros(len(group_ids), np.int64)
+    for i, rows in enumerate(groups):
+        row_index[i, : len(rows)] = rows
+        pad_mask[i, : len(rows)] = True
+        inv[rows] = i * gmax + np.arange(len(rows))
+    return row_index, pad_mask, inv
+
+
+def lambdarank_grad_blocked(preds, labels, row_index, pad_mask, inv,
+                            max_dcg_pos: int = 30, sigmoid: float = 2.0):
+    """Block-diagonal LambdaRank: O(N·G) instead of the dense O(N²) pair
+    matrix — pairs only form within a query, so each [G, G] block is
+    computed independently under ``vmap`` (layout from
+    :func:`build_query_blocks`). Identical math to
+    :func:`lambdarank_grad` on the same data.
+    """
+    p = preds[row_index]
+    lab = labels[row_index]
+
+    def one_query(p, lab, valid):
+        pair = (valid[:, None] & valid[None, :]
+                & ((lab[:, None] - lab[None, :]) > 0))
+        order = jnp.argsort(jnp.where(valid, -p, jnp.inf))
+        ranks = jnp.argsort(order)
+        disc = 1.0 / jnp.log2(
+            2.0 + jnp.minimum(ranks, max_dcg_pos).astype(jnp.float32))
+        gain = (2.0 ** lab - 1.0) * valid
+        delta = jnp.abs((gain[:, None] - gain[None, :])
+                        * (disc[:, None] - disc[None, :]))
+        s = jax.nn.sigmoid(-sigmoid * (p[:, None] - p[None, :]))
+        lam = -sigmoid * s * delta * pair
+        grad = lam.sum(axis=1) - lam.sum(axis=0)
+        hp = (sigmoid ** 2) * s * (1 - s) * delta * pair
+        hess = hp.sum(axis=1) + hp.sum(axis=0)
+        return grad, hess
+
+    g, h = jax.vmap(one_query)(p, lab, pad_mask)
+    grad = g.reshape(-1)[inv]
+    hess = h.reshape(-1)[inv]
+    return grad, jnp.maximum(hess, 1e-6)
+
+
 # -- metrics ----------------------------------------------------------------
 
 def auc_metric(preds, labels, weight=None):

@@ -59,14 +59,17 @@ class ONNXModel(Transformer):
     # -- graph access ---------------------------------------------------
     @property
     def graph(self) -> ImportedGraph:
-        cache = self.__dict__.setdefault("_graph_cache", None)
-        if cache is None:
-            payload = self.model_payload
-            if payload is None:
-                raise ValueError("ONNXModel has no model_payload set")
-            cache = import_model(payload)
-            self.__dict__["_graph_cache"] = cache
-        return cache
+        payload = self.model_payload
+        if payload is None:
+            raise ValueError("ONNXModel has no model_payload set")
+        cache = self.__dict__.get("_graph_cache")
+        # payload identity in the key: set(model_payload=...) must not
+        # keep serving the previously imported graph
+        if cache is not None and cache[0] == id(payload):
+            return cache[1]
+        g = import_model(payload)
+        self.__dict__["_graph_cache"] = (id(payload), g)
+        return g
 
     def model_metadata(self) -> Dict[str, Any]:
         g = self.graph
@@ -122,6 +125,10 @@ class ONNXModel(Transformer):
             compute = None if self.compute_dtype == "float32" else dtype
             # params ride as a bound argument pytree: device-resident once,
             # shared by every shape bucket (vs baked-in jit constants)
+            # bound: each executor pins a device copy of the weights; graph
+            # swaps (payload/cut_layers changes) must not accumulate them
+            while len(cache) >= 4:
+                cache.pop(next(iter(cache)))
             cache[key] = BatchedExecutor(
                 g.apply, compute_dtype=compute,
                 max_bucket=self.mini_batch_size, bound_args=(params,))

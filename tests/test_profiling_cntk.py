@@ -150,3 +150,23 @@ def test_cntk_payload_param_path_also_rejected():
     m.set(model_payload=fake)  # the generated-wrapper path
     with pytest.raises(ValueError, match="Export it to ONNX"):
         _ = m.graph
+
+
+def test_payload_swap_refreshes_graph():
+    """set(model_payload=...) after a transform must re-import, not serve
+    the stale cached graph."""
+    blob2 = zoo.mlp([6, 12], num_classes=2, seed=9)
+    blob4 = zoo.mlp([6, 12], num_classes=4, seed=9)
+    m = CNTKModel(model_bytes=blob2)
+    x = np.random.default_rng(0).normal(size=(3, 6)).astype(np.float32)
+    out2 = np.asarray(m.transform(Table({"input": x}))[
+        m.graph.output_names[0]])
+    assert out2.shape == (3, 2)
+    m.set(model_payload=blob4)
+    out4 = np.asarray(m.transform(Table({"input": x}))[
+        m.graph.output_names[0]])
+    assert out4.shape == (3, 4)
+    # native payload swapped in via set() is rejected at next use
+    m.set(model_payload="BCNTK".encode("utf-16-le") + b"\x00" * 64)
+    with pytest.raises(ValueError, match="Export it to ONNX"):
+        _ = m.graph
