@@ -73,8 +73,10 @@ class CNTKModel(ONNXModel):
         cut = int(self.cut_layers or 0)
         payload = self.model_payload
         cache = self.__dict__.get("_cntk_graph")
-        if cache is not None and cache[0] == (cut, id(payload)):
-            return cache[1]
+        # `is` on the retained payload object (not id(): reuse-safe)
+        if (cache is not None and cache[0] == cut
+                and cache[1] is payload):
+            return cache[2]
         if payload is not None and not _looks_like_onnx(bytes(payload)):
             # covers every assignment path (model_payload=... via set(),
             # the generated R wrapper, load) — not just __init__ kwargs
@@ -82,7 +84,7 @@ class CNTKModel(ONNXModel):
         g = ONNXModel.graph.fget(self)
         if cut:
             g = g.truncated(cut)
-        self.__dict__["_cntk_graph"] = ((cut, id(payload)), g)
+        self.__dict__["_cntk_graph"] = (cut, payload, g)
         return g
 
     def _post_copy(self, src):

@@ -120,8 +120,42 @@ def _default_metric(p: BoostParams) -> str:
 
 
 def _ndcg_score(scores: np.ndarray, labels: np.ndarray, group_ids: np.ndarray,
-                at: int) -> float:
-    """Mean NDCG@at over query groups (numpy; valid sets are small)."""
+                at: int, blocks=None) -> float:
+    """Mean NDCG@at over query groups — vectorized over [Q, Gmax] query
+    blocks (this runs once per boosting iteration in the rank eval path;
+    the per-query python loop dominated eval at large Q). Pass ``blocks``
+    (from :func:`objectives.build_query_blocks`) to reuse the layout
+    across iterations — the group array never changes during a fit."""
+    scores = np.asarray(scores)
+    labels = np.asarray(labels)
+    if len(scores) == 0:
+        return 0.0
+    if blocks is None:
+        blocks = obj.build_query_blocks(np.asarray(group_ids))
+    row_index, pad_mask, _ = blocks
+    if row_index.size > 8 * len(scores):
+        # heavy group-size skew: dense [Q, Gmax] blocks would dwarf the
+        # data — per-group loop is cheaper
+        return _ndcg_score_loop(scores, labels, np.asarray(group_ids), at)
+    s = np.where(pad_mask, scores[row_index], -np.inf)
+    rel = np.where(pad_mask, labels[row_index], 0.0)
+    gmax = s.shape[1]
+    cols = min(at, gmax)
+    # pads sort last (score -inf, gain 0): identical to per-group slicing
+    order = np.argsort(-s, axis=1, kind="stable")[:, :cols]
+    gains = np.take_along_axis(2.0 ** rel - 1.0, order, axis=1)
+    disc = 1.0 / np.log2(np.arange(2, cols + 2))
+    dcg = (gains * disc).sum(axis=1)
+    ideal = -np.sort(-(2.0 ** rel - 1.0), axis=1)[:, :cols]
+    idcg = (ideal * disc).sum(axis=1)
+    valid = idcg > 0
+    if not valid.any():
+        return 0.0
+    return float((dcg[valid] / idcg[valid]).mean())
+
+
+def _ndcg_score_loop(scores, labels, group_ids, at: int) -> float:
+    """Per-group fallback for pathologically skewed group sizes."""
     total, count = 0.0, 0
     for g in np.unique(group_ids):
         sel = group_ids == g
@@ -174,6 +208,11 @@ class _ValidTracker:
         self.best_iter = -1
         self.history: Dict[str, List[float]] = {self.metric_name: []}
         self._pt = jax.jit(predict_tree)
+        # rank eval reuses the query-block layout across every iteration
+        self.ndcg_blocks = None
+        if self.is_rank_metric and self.sets and self.sets[0][3] is not None:
+            self.ndcg_blocks = obj.build_query_blocks(
+                np.asarray(self.sets[0][3]))
 
     def add_tree(self, tree, class_idx: int):
         if not self.enabled:
@@ -194,7 +233,7 @@ class _ValidTracker:
         vscore = vsum * scale + self.init
         if self.is_rank_metric:
             m = _ndcg_score(np.asarray(vscore[:, 0]), np.asarray(vy), vg,
-                            self.p.max_position)
+                            self.p.max_position, blocks=self.ndcg_blocks)
         elif self.k > 1:
             m = float(self.metric_fn(vscore, vy.astype(jnp.int32)))
         else:
@@ -455,7 +494,8 @@ def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
         elif track_rank:
             vsnap = np.asarray(ys[1])  # [chunk, Nv]; k == 1 for ranking
             per_iter = [
-                _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position)
+                _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position,
+                            blocks=tracker.ndcg_blocks)
                 for i in range(n_it)
             ]
         else:

@@ -226,6 +226,17 @@ def _where(ctx, cond, a, b):
     return xp.where(cond, a, b)
 
 
+@op("Trilu")
+def _trilu(ctx, x, k=None):
+    """Upper/lower triangle (causal-mask construction in transformer
+    graphs)."""
+    kk = int(np.asarray(k).reshape(())) if k is not None else 0
+    xp = np if _all_host((x,)) else jnp
+    if ctx.attr("upper", 1):
+        return xp.triu(x, kk)
+    return xp.tril(x, kk)
+
+
 @op("Mod")
 def _mod(ctx, a, b):
     if ctx.attr("fmod", 0):
@@ -1100,10 +1111,39 @@ class ImportedGraph:
     def __init__(self, graph: Msg, opset: int):
         self.graph = graph
         self.opset = opset
-        self.params: Dict[str, np.ndarray] = {
-            t.name: tensor_to_numpy(t) for t in graph.initializer
+        all_inits = {t.name: tensor_to_numpy(t) for t in graph.initializer}
+        # Shape-consuming initializers (Reshape targets, Slice starts,
+        # Resize scales, masks...) must stay STATIC: when params ride as
+        # jit arguments (BatchedExecutor bound_args) a traced shape tensor
+        # breaks those ops at trace time. Static = every non-float
+        # initializer, plus any initializer (float included — Resize
+        # scales/roi) feeding a shape-position input slot. Float weights
+        # stay in the donated/castable params pytree.
+        shape_consumers = {
+            "Reshape": (1,), "Expand": (1,), "Tile": (1,),
+            "Slice": (1, 2, 3, 4), "Resize": (1, 2, 3), "Upsample": (1,),
+            "ConstantOfShape": (0,), "Range": (0, 1, 2), "TopK": (1,),
+            "OneHot": (1,), "Pad": (1, 2, 3), "Unsqueeze": (1,),
+            "Squeeze": (1,), "Split": (1,), "Trilu": (1,),
+            "ReduceSum": (1,), "ReduceMean": (1,), "ReduceMax": (1,),
+            "ReduceMin": (1,), "ReduceProd": (1,), "CenterCropPad": (1,),
         }
-        init_names = set(self.params)
+        shape_fed = set()
+        for node in graph.node:
+            slots = shape_consumers.get(node.op_type)
+            if not slots:
+                continue
+            for i in slots:
+                if i < len(node.input) and node.input[i]:
+                    shape_fed.add(node.input[i])
+        self.static_params: Dict[str, np.ndarray] = {
+            k: v for k, v in all_inits.items()
+            if not np.issubdtype(v.dtype, np.floating) or k in shape_fed
+        }
+        self.params: Dict[str, np.ndarray] = {
+            k: v for k, v in all_inits.items() if k not in self.static_params
+        }
+        init_names = set(all_inits)
         self.input_names: List[str] = [
             vi.name for vi in graph.input if vi.name not in init_names
         ]
@@ -1138,7 +1178,8 @@ class ImportedGraph:
 
     def apply(self, params: Dict[str, Any], *inputs, **named_inputs):
         """Run the graph. Inputs positional (graph order) or by name."""
-        env: Dict[str, Any] = dict(params)
+        env: Dict[str, Any] = dict(self.static_params)
+        env.update(params)
         for name, val in zip(self.input_names, inputs):
             env[name] = val
         env.update(named_inputs)
@@ -1171,7 +1212,8 @@ class ImportedGraph:
         return fn
 
     def param_bytes(self) -> int:
-        return sum(v.nbytes for v in self.params.values())
+        return (sum(v.nbytes for v in self.params.values())
+                + sum(v.nbytes for v in self.static_params.values()))
 
     def truncated(self, cut_layers: int = 1) -> "ImportedGraph":
         """Headless copy with the last ``cut_layers`` nodes removed — the
@@ -1192,6 +1234,9 @@ class ImportedGraph:
         for _, _, in_names, _ in out._nodes:
             used.update(in_names)
         out.params = {k: v for k, v in self.params.items() if k in used}
+        out.static_params = {
+            k: v for k, v in self.static_params.items() if k in used
+        }
         return out
 
     def __repr__(self):

@@ -63,12 +63,13 @@ class ONNXModel(Transformer):
         if payload is None:
             raise ValueError("ONNXModel has no model_payload set")
         cache = self.__dict__.get("_graph_cache")
-        # payload identity in the key: set(model_payload=...) must not
-        # keep serving the previously imported graph
-        if cache is not None and cache[0] == id(payload):
+        # payload identity via `is` with the object retained in the cache
+        # tuple: set(model_payload=...) must not keep serving the previous
+        # graph, and holding the reference rules out CPython id reuse
+        if cache is not None and cache[0] is payload:
             return cache[1]
         g = import_model(payload)
-        self.__dict__["_graph_cache"] = (id(payload), g)
+        self.__dict__["_graph_cache"] = (payload, g)
         return g
 
     def model_metadata(self) -> Dict[str, Any]:
@@ -125,8 +126,12 @@ class ONNXModel(Transformer):
             compute = None if self.compute_dtype == "float32" else dtype
             # params ride as a bound argument pytree: device-resident once,
             # shared by every shape bucket (vs baked-in jit constants)
-            # bound: each executor pins a device copy of the weights; graph
-            # swaps (payload/cut_layers changes) must not accumulate them
+            # each executor pins a device copy of the weights: evict the
+            # ones built for graphs that are no longer current (payload or
+            # cut_layers swaps), and cap live-graph configs (a batch-size
+            # sweep must not accumulate unbounded weight copies)
+            for stale in [kk for kk in cache if kk[0] != id(g)]:
+                del cache[stale]
             while len(cache) >= 4:
                 cache.pop(next(iter(cache)))
             cache[key] = BatchedExecutor(

@@ -180,3 +180,88 @@ def bilstm_tagger(vocab: int, embed: int, hidden: int, n_tags: int,
     y = g.add_node("Add", [y, bn])
     g.add_output(y, np.float32, ["N", seq_len, n_tags])
     return g.to_bytes()
+
+
+def transformer_encoder(vocab: int, d_model: int, n_heads: int,
+                        ffn_dim: int, n_layers: int, seq_len: int = 32,
+                        causal: bool = False, seed: int = 0) -> bytes:
+    """Pre-LN transformer encoder as an ONNX graph (the BERT-era op diet:
+    Gather embeddings, MatMul/Transpose/Softmax attention,
+    LayerNormalization, Gelu FFN, Trilu causal mask when requested) —
+    exercises the importer on modern-architecture graphs the way resnet50
+    exercises the CNN opset."""
+    assert d_model % n_heads == 0
+    hd = d_model // n_heads
+    # opset 20: Gelu joined the default ai.onnx domain at 20 (Trilu needs
+    # >=14, LayerNormalization >=17) — a lower opset would be spec-invalid
+    g = GraphBuilder(name="transformer_encoder", opset=20)
+    r = _Rng(seed)
+
+    ids = g.add_input("tokens", np.int64, ["N", seq_len])
+    emb = g.add_initializer(
+        "tok_emb", r.rng.normal(0, 0.05, (vocab, d_model)).astype(np.float32))
+    pos = g.add_initializer(
+        "pos_emb", r.rng.normal(0, 0.05, (seq_len, d_model)).astype(np.float32))
+    x = g.add_node("Gather", [emb, ids], axis=0)          # (N, S, D)
+    x = g.add_node("Add", [x, pos])
+
+    if causal:
+        ones = g.add_initializer(
+            "mask_ones", np.ones((seq_len, seq_len), np.float32))
+        upper = g.add_node("Trilu", [ones], upper=1)
+        diag = g.add_node("Trilu", [upper], upper=0)      # identity diag
+        strict_upper = g.add_node("Sub", [upper, diag])
+        neg = g.add_initializer("neg_inf", np.float32(-1e9))
+        causal_bias = g.add_node("Mul", [strict_upper, neg])  # (S, S)
+
+    def lin(x, out_f, in_f, name):
+        w, b = r.fc(out_f, in_f)
+        wn = g.add_initializer(f"{name}_w", np.ascontiguousarray(w.T))
+        bn = g.add_initializer(f"{name}_b", b)
+        y = g.add_node("MatMul", [x, wn])
+        return g.add_node("Add", [y, bn])
+
+    def layer_norm(x, name):
+        s = g.add_initializer(f"{name}_s", np.ones(d_model, np.float32))
+        b = g.add_initializer(f"{name}_b", np.zeros(d_model, np.float32))
+        return g.add_node("LayerNormalization", [x, s, b], axis=-1)
+
+    heads_shape = g.add_initializer(
+        "heads_shape", np.array([0, seq_len, n_heads, hd], np.int64))
+    merge_shape = g.add_initializer(
+        "merge_shape", np.array([0, seq_len, d_model], np.int64))
+    scale = g.add_initializer("attn_scale",
+                              np.float32(1.0 / np.sqrt(hd)))
+
+    for li in range(n_layers):
+        ln1 = layer_norm(x, f"l{li}_ln1")
+        q = lin(ln1, d_model, d_model, f"l{li}_q")
+        k = lin(ln1, d_model, d_model, f"l{li}_k")
+        v = lin(ln1, d_model, d_model, f"l{li}_v")
+
+        def split_heads(t):
+            t = g.add_node("Reshape", [t, heads_shape])   # (N, S, H, hd)
+            return g.add_node("Transpose", [t], perm=[0, 2, 1, 3])
+
+        qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
+        kt = g.add_node("Transpose", [kh], perm=[0, 1, 3, 2])
+        logits = g.add_node("MatMul", [qh, kt])           # (N, H, S, S)
+        logits = g.add_node("Mul", [logits, scale])
+        if causal:
+            logits = g.add_node("Add", [logits, causal_bias])
+        attn = g.add_node("Softmax", [logits], axis=-1)
+        ctxv = g.add_node("MatMul", [attn, vh])           # (N, H, S, hd)
+        ctxv = g.add_node("Transpose", [ctxv], perm=[0, 2, 1, 3])
+        ctxv = g.add_node("Reshape", [ctxv, merge_shape])
+        proj = lin(ctxv, d_model, d_model, f"l{li}_o")
+        x = g.add_node("Add", [x, proj])
+
+        ln2 = layer_norm(x, f"l{li}_ln2")
+        h = lin(ln2, ffn_dim, d_model, f"l{li}_ff1")
+        h = g.add_node("Gelu", [h])
+        h = lin(h, d_model, ffn_dim, f"l{li}_ff2")
+        x = g.add_node("Add", [x, h])
+
+    x = layer_norm(x, "final_ln")
+    g.add_output(x, np.float32, ["N", seq_len, d_model])
+    return g.to_bytes()

@@ -414,3 +414,51 @@ def test_lambdarank_blocked_matches_dense():
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(h_blk), np.asarray(h_dense),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_ndcg_vectorized_matches_reference_loop():
+    from synapseml_tpu.gbdt.boosting import _ndcg_score
+
+    rng = np.random.default_rng(8)
+    sizes = [1, 4, 9, 2, 15, 7, 3]
+    gid = np.concatenate([np.full(s, i * 10) for i, s in enumerate(sizes)])
+    perm = rng.permutation(len(gid))
+    gid = gid[perm]
+    scores = rng.normal(size=len(gid))
+    labels = rng.integers(0, 4, len(gid)).astype(float)
+
+    def loop_ndcg(scores, labels, group_ids, at):
+        total, count = 0.0, 0
+        for g in np.unique(group_ids):
+            sel = group_ids == g
+            rel = labels[sel]
+            order = np.argsort(-scores[sel], kind="stable")[:at]
+            discounts = 1.0 / np.log2(np.arange(2, len(order) + 2))
+            dcg = float(np.sum((2.0 ** rel[order] - 1.0) * discounts))
+            ideal = np.sort(rel)[::-1][:at]
+            idcg = float(np.sum((2.0 ** ideal - 1.0)
+                                / np.log2(np.arange(2, len(ideal) + 2))))
+            if idcg > 0:
+                total += dcg / idcg
+                count += 1
+        return total / max(count, 1)
+
+    for at in (1, 3, 10, 30):
+        assert _ndcg_score(scores, labels, gid, at) == pytest.approx(
+            loop_ndcg(scores, labels, gid, at), rel=1e-9)
+    # all-zero relevance: no valid queries
+    assert _ndcg_score(scores, np.zeros(len(gid)), gid, 10) == 0.0
+
+
+def test_ndcg_skewed_groups_fallback():
+    from synapseml_tpu.gbdt.boosting import _ndcg_score, _ndcg_score_loop
+
+    rng = np.random.default_rng(9)
+    # one 400-doc query among 200 singletons: blocked layout would pad
+    # 201x400; the skew guard must route to the loop with equal results
+    gid = np.concatenate([np.zeros(400), np.arange(1, 201)])
+    scores = rng.normal(size=len(gid))
+    labels = rng.integers(0, 3, len(gid)).astype(float)
+    got = _ndcg_score(scores, labels, gid, 10)
+    want = _ndcg_score_loop(scores, labels, gid, 10)
+    assert got == pytest.approx(want, rel=1e-9)

@@ -319,3 +319,77 @@ def test_onnx_model_metadata():
     meta = m.model_metadata()
     assert meta["inputs"]["data"][1][1:] == [3, 32, 32]
     assert meta["param_bytes"] > 0
+
+
+def test_transformer_encoder_matches_torch():
+    """BERT-era opset: the zoo transformer (Gather embeddings, multi-head
+    MatMul/Softmax attention, LayerNormalization, Gelu FFN, Trilu causal
+    mask) must match an independent torch implementation on the same
+    weights."""
+    vocab, d, heads, ffn, layers, S = 37, 16, 4, 40, 2, 10
+    hd = d // heads
+    blob = zoo.transformer_encoder(vocab, d, heads, ffn, layers,
+                                   seq_len=S, causal=True, seed=5)
+    g = import_model(blob)
+    P = {k: torch.tensor(np.asarray(v)) for k, v in g.params.items()}
+
+    ids = np.random.default_rng(1).integers(0, vocab, (3, S))
+    (ours,) = g.apply(g.params, ids)
+
+    def lin(x, name):
+        return x @ P[f"{name}_w"] + P[f"{name}_b"]
+
+    def ln(x, name):
+        return torch.nn.functional.layer_norm(
+            x, (d,), P[f"{name}_s"], P[f"{name}_b"], eps=1e-5)
+
+    with torch.no_grad():
+        x = P["tok_emb"][torch.tensor(ids)] + P["pos_emb"]
+        mask = torch.triu(torch.ones(S, S), diagonal=1) * -1e9
+        for li in range(layers):
+            h1 = ln(x, f"l{li}_ln1")
+            q = lin(h1, f"l{li}_q").view(3, S, heads, hd).transpose(1, 2)
+            k = lin(h1, f"l{li}_k").view(3, S, heads, hd).transpose(1, 2)
+            v = lin(h1, f"l{li}_v").view(3, S, heads, hd).transpose(1, 2)
+            logits = q @ k.transpose(-1, -2) / np.sqrt(hd) + mask
+            ctx = torch.softmax(logits, dim=-1) @ v
+            ctx = ctx.transpose(1, 2).reshape(3, S, d)
+            x = x + lin(ctx, f"l{li}_o")
+            h2 = ln(x, f"l{li}_ln2")
+            h2 = torch.nn.functional.gelu(lin(h2, f"l{li}_ff1"))
+            x = x + lin(h2, f"l{li}_ff2")
+        theirs = ln(x, "final_ln").numpy()
+
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-4,
+                               atol=2e-4)
+    # causal: truncating future tokens must not change earlier positions
+    ids2 = ids.copy()
+    ids2[:, -1] = (ids2[:, -1] + 1) % vocab
+    (ours2,) = g.apply(g.params, ids2)
+    np.testing.assert_allclose(np.asarray(ours2)[:, :-1],
+                               np.asarray(ours)[:, :-1], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_executor_path_keeps_shape_initializers_static():
+    """Graphs whose Reshape/Slice targets are initializers must run through
+    the BatchedExecutor (params ride as traced jit arguments; integer
+    initializers stay static so shape ops keep concrete shapes)."""
+    from synapseml_tpu.onnx.model import ONNXModel
+
+    blob = zoo.transformer_encoder(30, 8, 2, 16, 1, seq_len=6, causal=True)
+    m = ONNXModel(model_bytes=blob, feed_dict={"tokens": "toks"})
+    ids = np.random.default_rng(2).integers(0, 30, (4, 6))
+    out = m.transform(Table({"toks": ids}))
+    enc = np.asarray(out[m.graph.output_names[0]])
+    assert enc.shape == (4, 6, 8) and np.isfinite(enc).all()
+    # executor result must equal the direct host apply
+    g = m.graph
+    (direct,) = g.apply(g.params, ids)
+    np.testing.assert_allclose(enc, np.asarray(direct), rtol=1e-4,
+                               atol=1e-5)
+    # weights pytree carries only floats; shape tensors are static
+    assert all(np.issubdtype(v.dtype, np.floating)
+               for v in g.params.values())
+    assert any(np.issubdtype(v.dtype, np.integer)
+               for v in g.static_params.values())
