@@ -208,11 +208,16 @@ class _ValidTracker:
         self.best_iter = -1
         self.history: Dict[str, List[float]] = {self.metric_name: []}
         self._pt = jax.jit(predict_tree)
-        # rank eval reuses the query-block layout across every iteration
+        # rank eval reuses the query-block layout across every iteration —
+        # but only when the padded layout is sane: under heavy group-size
+        # skew _ndcg_score's guard takes the per-group loop anyway, and
+        # building the blocks here would be the very allocation it avoids
         self.ndcg_blocks = None
         if self.is_rank_metric and self.sets and self.sets[0][3] is not None:
-            self.ndcg_blocks = obj.build_query_blocks(
-                np.asarray(self.sets[0][3]))
+            vg = np.asarray(self.sets[0][3])
+            _, counts = np.unique(vg, return_counts=True)
+            if len(counts) * counts.max() <= 8 * len(vg):
+                self.ndcg_blocks = obj.build_query_blocks(vg)
 
     def add_tree(self, tree, class_idx: int):
         if not self.enabled:
