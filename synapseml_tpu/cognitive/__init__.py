@@ -4,22 +4,54 @@ Scala), rebuilt over the table-native HTTP stack (SURVEY.md §2.8).
 from synapseml_tpu.cognitive.base import (  # noqa: F401
     BatchedTextServiceBase,
     CognitiveServicesBase,
+    HasAsyncReply,
     HasServiceParams,
     ServiceParam,
+)
+from synapseml_tpu.cognitive.face import (  # noqa: F401
+    FindSimilarFace,
+    GroupFaces,
+    IdentifyFaces,
+    VerifyFaces,
+)
+from synapseml_tpu.cognitive.form import (  # noqa: F401
+    AnalyzeBusinessCards,
+    AnalyzeCustomModel,
+    AnalyzeIDDocuments,
+    AnalyzeInvoices,
+    AnalyzeLayout,
+    AnalyzeReceipts,
+    GetCustomModel,
+    ListCustomModels,
+    flatten_document_results,
+    flatten_read_results,
 )
 from synapseml_tpu.cognitive.services import (  # noqa: F401
     AnalyzeImage,
     AzureSearchWriter,
     BingImageSearch,
+    BreakSentence,
     DescribeImage,
+    DescribeImageExtended,
+    Detect,
     DetectEntireSeries,
     DetectFace,
     DetectLastAnomaly,
+    DictionaryExamples,
+    DictionaryLookup,
+    DocumentTranslator,
+    GenerateThumbnails,
     KeyPhraseExtractor,
     LanguageDetector,
     NER,
     OCR,
+    ReadImage,
+    RecognizeDomainSpecificContent,
+    RecognizeText,
     SpeechToText,
+    TagImage,
     TextSentiment,
     Translate,
+    Transliterate,
+    get_speaker_profile,
 )

@@ -18,6 +18,7 @@ A service transformer here:
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -27,6 +28,18 @@ from synapseml_tpu.core.pipeline import Transformer
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import (AsyncHTTPClient, HandlingUtils,
                                    HTTPRequestData, HTTPResponseData)
+
+
+def with_url_params(url: str, **params: Any) -> str:
+    """Append non-None params to a URL, properly encoded — row-bound
+    values must never be spliced raw into query strings."""
+    from urllib.parse import urlencode
+
+    items = {k: v for k, v in params.items() if v is not None}
+    if not items:
+        return url
+    sep = "&" if "?" in url else "?"
+    return f"{url}{sep}{urlencode(items)}"
 
 
 class ServiceParam(Param):
@@ -92,6 +105,18 @@ class CognitiveServicesBase(Transformer, HasServiceParams):
         """Service-specific extraction from the response JSON."""
         return parsed_json
 
+    def _extract_output(self, resp: HTTPResponseData) -> Any:
+        """Full-response hook; binary services (thumbnails) override this
+        to bypass JSON parsing."""
+        return self._parse_response(resp.json())
+
+    def _handle_response(self, client, req: HTTPRequestData,
+                         resp: Optional[HTTPResponseData]
+                         ) -> Optional[HTTPResponseData]:
+        """Post-send hook; the async-reply mixin turns a 202 +
+        Operation-Location into the polled final response here."""
+        return resp
+
     def _service_param_names(self) -> List[str]:
         return [
             name for name, p in type(self).params().items()
@@ -123,7 +148,8 @@ class CognitiveServicesBase(Transformer, HasServiceParams):
         client = AsyncHTTPClient(
             self.concurrency, HandlingUtils.advanced(*self.backoffs),
             self.timeout)
-        resps = client.send_all(reqs)
+        resps = client.send_all(
+            reqs, post=lambda q, r: self._handle_response(client, q, r))
 
         from synapseml_tpu.io.http import response_to_error
 
@@ -135,7 +161,7 @@ class CognitiveServicesBase(Transformer, HasServiceParams):
             if r is None or errors[i] is not None:
                 continue
             try:
-                out[i] = self._parse_response(r.json())
+                out[i] = self._extract_output(r)
             except (json.JSONDecodeError, KeyError, TypeError,
                     IndexError) as e:
                 errors[i] = {"status_code": r.status_code,
@@ -143,6 +169,76 @@ class CognitiveServicesBase(Transformer, HasServiceParams):
                              "body": r.text[:2048]}
         return table.with_columns({self.output_col: out,
                                    self.error_col: errors})
+
+
+class HasAsyncReply(Params):
+    """Long-running-operation reply handling: a 202 Accepted with an
+    ``Operation-Location`` header is polled (GET + key header) until the
+    body's ``status`` reaches succeeded/failed
+    (ref: ComputerVision.scala BasicAsyncReply:211-257, HasAsyncReply
+    :259-288 — backoffs/maxPollingRetries/pollingDelay params).
+
+    A polling timeout becomes a synthetic 504 response so the failure
+    lands in the error column instead of aborting the batch (the
+    reference throws; the error-col contract here is stronger).
+    """
+
+    polling_delay_ms = Param("ms between polls", default=300)
+    max_polling_retries = Param("number of times to poll", default=1000)
+
+    #: statuses that mean "keep polling"; anything else is terminal (an
+    #: unknown or missing status — e.g. an expired-op error body — must
+    #: flow to the error column, never crash the batch)
+    _PENDING_STATUSES = frozenset(
+        {"notstarted", "running", "analyzing", "cancelling", "queued"})
+    _FAILED_STATUSES = frozenset(
+        {"failed", "cancelled", "validationfailed"})
+
+    def _query_for_result(self, client, key: Optional[str],
+                          location: str) -> Optional[HTTPResponseData]:
+        headers = {} if not key else {"Ocp-Apim-Subscription-Key": str(key)}
+        resp = client.send(HTTPRequestData(
+            url=location, method="GET", headers=headers))
+        try:
+            status = str(resp.json().get("status", "")).lower()
+        except (json.JSONDecodeError, AttributeError):
+            return resp  # non-JSON terminal body
+        if status in self._PENDING_STATUSES:
+            return None
+        if status in self._FAILED_STATUSES:
+            # surface as a non-2xx so response_to_error catches it
+            # instead of the row masquerading as an empty success
+            return HTTPResponseData(
+                status_code=502,
+                reason=f"async operation ended in status {status!r}",
+                headers=resp.headers, entity=resp.entity)
+        return resp
+
+    def _handle_response(self, client, req, resp):
+        if resp is None or resp.status_code != 202:
+            return resp
+        location = next(
+            (v for k, v in (resp.headers or {}).items()
+             if k.lower() == "operation-location"), None)
+        if location is None:
+            # a 202 with no operation to poll can never produce a result;
+            # surface it instead of masquerading as an empty success
+            return HTTPResponseData(
+                status_code=502,
+                reason="202 Accepted without Operation-Location header",
+                headers=resp.headers, entity=resp.entity)
+        key = next(
+            (v for k, v in (req.headers or {}).items()
+             if k.lower() == "ocp-apim-subscription-key"), None)
+        for _ in range(int(self.max_polling_retries)):
+            final = self._query_for_result(client, key, location)
+            if final is not None:
+                return final
+            time.sleep(self.polling_delay_ms / 1000.0)
+        return HTTPResponseData(
+            status_code=504,
+            reason=f"async operation did not complete within "
+                   f"{self.max_polling_retries} polls")
 
 
 class BatchedTextServiceBase(CognitiveServicesBase):

@@ -1,0 +1,103 @@
+"""Face API services beyond detection.
+
+Rebuild of the reference's Face module
+(ref: cognitive/src/main/scala/com/microsoft/ml/spark/cognitive/Face.scala —
+FindSimilarFace:96, GroupFaces:186, IdentifyFaces:208, VerifyFaces:278;
+each posts a JSON body assembled from ServiceParams, with exactly the
+set-or-omitted field semantics of ``prepareEntity``).
+"""
+from __future__ import annotations
+
+from synapseml_tpu.cognitive.base import CognitiveServicesBase, ServiceParam
+
+
+class _FaceJsonService(CognitiveServicesBase):
+    """Shared body assembly: every non-None ServiceParam value lands in
+    the JSON body under its camelCase field name (ref: Face.scala
+    prepareEntity pattern :77-88, :352-356)."""
+
+    _body_fields: tuple = ()
+    _required_any: tuple = ()
+
+    @staticmethod
+    def _camel(name: str) -> str:
+        head, *rest = name.split("_")
+        return head + "".join(w.capitalize() for w in rest)
+
+    def _build_request(self, rv):
+        body = {
+            self._camel(f): rv[f]
+            for f in self._body_fields if rv.get(f) is not None
+        }
+        if self._required_any and not any(
+                rv.get(f) is not None for f in self._required_any):
+            return None
+        return self._post(body, rv["subscription_key"])
+
+
+class FindSimilarFace(_FaceJsonService):
+    """Similar-face search against a face list / large face list / raw
+    faceId array (ref: Face.scala FindSimilarFace:96-184)."""
+
+    face_id = ServiceParam("query faceId from DetectFace", required=True)
+    face_list_id = ServiceParam("faceListId to search")
+    large_face_list_id = ServiceParam("largeFaceListId to search")
+    face_ids = ServiceParam("candidate faceId array (max 1000)")
+    max_num_of_candidates_returned = ServiceParam("top candidates (1-1000)")
+    mode = ServiceParam("matchPerson or matchFace")
+
+    _body_fields = ("face_id", "face_list_id", "large_face_list_id",
+                    "face_ids", "max_num_of_candidates_returned", "mode")
+    _required_any = ("face_id",)
+
+
+class GroupFaces(_FaceJsonService):
+    """Divide candidate faces into groups by similarity
+    (ref: Face.scala GroupFaces:186-206)."""
+
+    face_ids = ServiceParam("candidate faceId array (max 1000)",
+                            required=True)
+
+    _body_fields = ("face_ids",)
+    _required_any = ("face_ids",)
+
+    def _parse_response(self, parsed):
+        return {"groups": parsed.get("groups", []),
+                "messyGroup": parsed.get("messyGroup", [])}
+
+
+class IdentifyFaces(_FaceJsonService):
+    """1-to-many identification against a person group
+    (ref: Face.scala IdentifyFaces:208-276)."""
+
+    face_ids = ServiceParam("query faceIds (1-10)", required=True)
+    person_group_id = ServiceParam("personGroupId to search")
+    large_person_group_id = ServiceParam("largePersonGroupId to search")
+    max_num_of_candidates_returned = ServiceParam("top candidates (1-5)")
+    confidence_threshold = ServiceParam("custom identification threshold")
+
+    _body_fields = ("face_ids", "person_group_id", "large_person_group_id",
+                    "max_num_of_candidates_returned", "confidence_threshold")
+    _required_any = ("face_ids",)
+
+
+class VerifyFaces(_FaceJsonService):
+    """Face-to-face or face-to-person verification
+    (ref: Face.scala VerifyFaces:278-355 — faceId1+faceId2, or
+    faceId+personId+{personGroupId|largePersonGroupId}; response is
+    {isIdentical, confidence} :286-287)."""
+
+    face_id1 = ServiceParam("first faceId")
+    face_id2 = ServiceParam("second faceId")
+    face_id = ServiceParam("faceId for face-to-person")
+    person_group_id = ServiceParam("personGroupId of the person")
+    large_person_group_id = ServiceParam("largePersonGroupId of the person")
+    person_id = ServiceParam("personId to verify against")
+
+    _body_fields = ("face_id1", "face_id2", "face_id", "person_id",
+                    "person_group_id", "large_person_group_id")
+    _required_any = ("face_id1", "face_id")
+
+    def _parse_response(self, parsed):
+        return {"isIdentical": parsed.get("isIdentical"),
+                "confidence": parsed.get("confidence")}

@@ -1,0 +1,192 @@
+"""Form Recognizer services (async long-running analyses).
+
+Rebuild of the reference's FormRecognizer module
+(ref: cognitive/src/main/scala/com/microsoft/ml/spark/cognitive/FormRecognizer.scala —
+FormRecognizerBase:19-33 (url-or-bytes payload + BasicAsyncReply),
+HasPages:37/HasTextDetails:52/HasModelID:64/HasLocale:72 URL-param
+traits, AnalyzeLayout:170, AnalyzeReceipts:203, AnalyzeBusinessCards:217,
+AnalyzeInvoices:231, AnalyzeIDDocuments:245, ListCustomModels:259,
+GetCustomModel:284, AnalyzeCustomModel:326; FormsFlatteners text
+extraction :86-110).
+
+Every analyze call POSTs the document (URL as ``{"source": url}`` JSON or
+raw bytes as octet-stream), receives 202 + ``Operation-Location`` and is
+polled to completion by :class:`HasAsyncReply`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from synapseml_tpu.cognitive.base import (CognitiveServicesBase,
+                                          HasAsyncReply, ServiceParam,
+                                          with_url_params)
+from synapseml_tpu.io.http import HTTPRequestData
+
+
+def flatten_read_results(analyze_json: Optional[Dict[str, Any]]) -> str:
+    """Joined text of all read results (ref: FormsFlatteners
+    .flattenReadResults:86-110)."""
+    if not analyze_json:
+        return ""
+    pages = analyze_json.get("analyzeResult", {}).get("readResults", [])
+    return " ".join(
+        " ".join(ln.get("text", "") for ln in page.get("lines", []))
+        for page in pages).strip()
+
+
+def flatten_document_results(analyze_json: Optional[Dict[str, Any]]
+                             ) -> List[Dict[str, Any]]:
+    """Per-document field dictionaries (ref: FormsFlatteners
+    .flattenDocumentResults analogue)."""
+    if not analyze_json:
+        return []
+    return [
+        doc.get("fields", {})
+        for doc in analyze_json.get("analyzeResult", {})
+                               .get("documentResults", [])
+    ]
+
+
+class FormRecognizerBase(HasAsyncReply, CognitiveServicesBase):
+    """(ref: FormRecognizerBase:19-33)."""
+
+    image_url = ServiceParam("document URL")
+    image_bytes = ServiceParam("raw document bytes")
+    pages = ServiceParam("page selection, e.g. '1-3,5'")
+
+    def _url_params(self, rv) -> Dict[str, Any]:
+        out = {}
+        if rv.get("pages") is not None:
+            out["pages"] = rv["pages"]
+        return out
+
+    def _target_url(self, rv) -> Optional[str]:
+        return self.url
+
+    def _build_request(self, rv):
+        base = self._target_url(rv)
+        if base is None:
+            return None
+        url = with_url_params(base, **self._url_params(rv))
+        if rv.get("image_url") is not None:
+            return self._post({"source": rv["image_url"]},
+                              rv["subscription_key"], url=url)
+        if rv.get("image_bytes") is not None:
+            return HTTPRequestData(
+                url=url, method="POST",
+                headers={**self._headers(rv["subscription_key"]),
+                         "Content-Type": "application/octet-stream"},
+                entity=bytes(rv["image_bytes"]))
+        return None
+
+    def _parse_response(self, parsed):
+        return parsed
+
+
+class AnalyzeLayout(FormRecognizerBase):
+    """(ref: FormRecognizer.scala AnalyzeLayout:170-201 — language and
+    readingOrder URL params)."""
+
+    language = ServiceParam("BCP-47 language code override")
+    reading_order = ServiceParam("basic or natural")
+
+    def _url_params(self, rv):
+        out = super()._url_params(rv)
+        if rv.get("language") is not None:
+            out["language"] = rv["language"]
+        if rv.get("reading_order") is not None:
+            out["readingOrder"] = rv["reading_order"]
+        return out
+
+
+class _PrebuiltAnalyzeBase(FormRecognizerBase):
+    """Receipt/businessCard/invoice/idDocument analyses share
+    includeTextDetails and locale (ref: HasTextDetails:52, HasLocale:72)."""
+
+    include_text_details = ServiceParam("include text lines in result")
+    locale = ServiceParam("document locale, e.g. en-US")
+
+    def _url_params(self, rv):
+        out = super()._url_params(rv)
+        if rv.get("include_text_details") is not None:
+            out["includeTextDetails"] = (
+                "true" if rv["include_text_details"] else "false")
+        if rv.get("locale") is not None:
+            out["locale"] = rv["locale"]
+        return out
+
+
+class AnalyzeReceipts(_PrebuiltAnalyzeBase):
+    """(ref: FormRecognizer.scala AnalyzeReceipts:203)."""
+
+
+class AnalyzeBusinessCards(_PrebuiltAnalyzeBase):
+    """(ref: FormRecognizer.scala AnalyzeBusinessCards:217)."""
+
+
+class AnalyzeInvoices(_PrebuiltAnalyzeBase):
+    """(ref: FormRecognizer.scala AnalyzeInvoices:231)."""
+
+
+class AnalyzeIDDocuments(_PrebuiltAnalyzeBase):
+    """(ref: FormRecognizer.scala AnalyzeIDDocuments:245)."""
+
+
+class AnalyzeCustomModel(FormRecognizerBase):
+    """Analysis through a user-trained model; the modelId rides the URL
+    path (ref: FormRecognizer.scala AnalyzeCustomModel:326 —
+    /custom/models/{modelId}/analyze)."""
+
+    model_id = ServiceParam("custom model id", required=True)
+    include_text_details = ServiceParam("include text lines in result")
+
+    def _url_params(self, rv):
+        out = super()._url_params(rv)
+        if rv.get("include_text_details") is not None:
+            out["includeTextDetails"] = (
+                "true" if rv["include_text_details"] else "false")
+        return out
+
+    def _target_url(self, rv):
+        if rv.get("model_id") is None:
+            return None
+        from urllib.parse import quote
+
+        return f"{self.url}/{quote(str(rv['model_id']), safe='')}/analyze"
+
+
+class ListCustomModels(CognitiveServicesBase):
+    """GET the account's custom models (ref: FormRecognizer.scala
+    ListCustomModels:259-282 — op URL param: summary or full)."""
+
+    op = ServiceParam("summary or full")
+
+    def _build_request(self, rv):
+        url = with_url_params(self.url, op=rv.get("op"))
+        return HTTPRequestData(
+            url=url, method="GET",
+            headers=self._headers(rv["subscription_key"]))
+
+    def _parse_response(self, parsed):
+        return parsed
+
+
+class GetCustomModel(CognitiveServicesBase):
+    """GET one custom model's info (ref: FormRecognizer.scala
+    GetCustomModel:284-324 — modelId path, includeKeys URL param)."""
+
+    model_id = ServiceParam("custom model id", required=True)
+    include_keys = ServiceParam("include extracted keys")
+
+    def _build_request(self, rv):
+        if rv.get("model_id") is None:
+            return None
+        from urllib.parse import quote
+
+        url = with_url_params(
+            f"{self.url}/{quote(str(rv['model_id']), safe='')}",
+            includeKeys=None if rv.get("include_keys") is None
+            else ("true" if rv["include_keys"] else "false"))
+        return HTTPRequestData(
+            url=url, method="GET",
+            headers=self._headers(rv["subscription_key"]))

@@ -25,7 +25,8 @@ import numpy as np
 from synapseml_tpu.core.param import Param, _json_default
 from synapseml_tpu.cognitive.base import (BatchedTextServiceBase,
                                           CognitiveServicesBase,
-                                          ServiceParam)
+                                          HasAsyncReply, ServiceParam,
+                                          with_url_params)
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import HTTPRequestData
 
@@ -198,6 +199,101 @@ class DetectFace(_ImageServiceBase):
         return self._image_request(rv, url=url)
 
 
+class TagImage(_ImageServiceBase):
+    """(ref: ComputerVision.scala TagImage:512)."""
+
+    def _build_request(self, rv):
+        return self._image_request(rv)
+
+    def _parse_response(self, parsed):
+        return parsed.get("tags", [])
+
+
+class DescribeImageExtended(DescribeImage):
+    """DescribeImage with maxCandidates (ref: ComputerVision.scala
+    DescribeImage:540 maxCandidates param); kept separate so the plain
+    class stays payload-identical with round-1 serde fixtures."""
+
+    max_candidates = Param("caption candidates", default=1)
+
+    def _build_request(self, rv):
+        req = self._image_request(rv)
+        if req is not None:
+            req.url = with_url_params(
+                req.url, maxCandidates=int(self.max_candidates))
+        return req
+
+
+class GenerateThumbnails(_ImageServiceBase):
+    """Returns raw thumbnail bytes, not JSON
+    (ref: ComputerVision.scala GenerateThumbnails:380 — BasicAsyncReply
+    not needed; output is the binary entity)."""
+
+    width = Param("thumbnail width", default=64)
+    height = Param("thumbnail height", default=64)
+    smart_cropping = Param("smart cropping", default=True)
+
+    def _build_request(self, rv):
+        url = (f"{self.url}?width={int(self.width)}&height={int(self.height)}"
+               f"&smartCropping={'true' if self.smart_cropping else 'false'}")
+        return self._image_request(rv, url=url)
+
+    def _extract_output(self, resp):
+        return resp.entity
+
+
+class RecognizeDomainSpecificContent(_ImageServiceBase):
+    """Domain-model analysis; the model rides the URL path
+    (ref: ComputerVision.scala RecognizeDomainSpecificContent:487 —
+    /models/{model}/analyze)."""
+
+    model = Param("domain model, e.g. celebrities/landmarks",
+                  default="celebrities")
+
+    def _build_request(self, rv):
+        return self._image_request(rv, url=f"{self.url}/{self.model}/analyze")
+
+    def _parse_response(self, parsed):
+        return parsed.get("result", parsed)
+
+
+class RecognizeText(HasAsyncReply, _ImageServiceBase):
+    """Printed/handwritten text via the async recognizeText API
+    (ref: ComputerVision.scala RecognizeText:301 — 202 + Operation-Location
+    polling, mode query param; flattened text like :200-205)."""
+
+    mode = Param("Printed or Handwritten", default="Printed")
+
+    def _build_request(self, rv):
+        return self._image_request(
+            rv, url=with_url_params(self.url, mode=self.mode))
+
+    def _parse_response(self, parsed):
+        rr = parsed.get("recognitionResult", {})
+        lines = rr.get("lines", [])
+        return {"lines": lines,
+                "text": " ".join(ln.get("text", "") for ln in lines)}
+
+
+class ReadImage(HasAsyncReply, _ImageServiceBase):
+    """The Read API (successor of OCR/recognizeText)
+    (ref: ComputerVision.scala ReadImage:347 — async reply, language
+    param, analyzeResult.readResults)."""
+
+    language = ServiceParam("read language hint")
+
+    def _build_request(self, rv):
+        url = with_url_params(self.url, language=rv.get("language"))
+        return self._image_request(rv, url=url)
+
+    def _parse_response(self, parsed):
+        results = parsed.get("analyzeResult", {}).get("readResults", [])
+        text = " ".join(
+            ln.get("text", "") for page in results
+            for ln in page.get("lines", []))
+        return {"readResults": results, "text": text}
+
+
 # ---------------------------------------------------------------------------
 # Translator
 # ---------------------------------------------------------------------------
@@ -214,14 +310,133 @@ class Translate(CognitiveServicesBase):
             return None
         to = rv["to_language"]
         to_list = [to] if isinstance(to, str) else list(to)
-        url = f"{self.url}?to={','.join(to_list)}"
-        if rv["from_language"]:
-            url += f"&from={rv['from_language']}"
+        url = with_url_params(self.url, to=",".join(to_list),
+                              **({"from": rv["from_language"]}
+                                 if rv["from_language"] else {}))
         return self._post([{"text": str(rv["text"])}],
                           rv["subscription_key"], url=url)
 
     def _parse_response(self, parsed):
         return parsed[0].get("translations", []) if parsed else []
+
+
+class Transliterate(CognitiveServicesBase):
+    """Script conversion (ref: TextTranslator.scala Transliterate:283 —
+    language/fromScript/toScript query params)."""
+
+    text = ServiceParam("text to transliterate", required=True)
+    language = ServiceParam("language of the text", required=True)
+    from_script = ServiceParam("source script", required=True)
+    to_script = ServiceParam("target script", required=True)
+
+    def _build_request(self, rv):
+        if rv["text"] is None:
+            return None
+        url = with_url_params(
+            self.url, language=rv["language"],
+            fromScript=rv["from_script"], toScript=rv["to_script"])
+        return self._post([{"text": str(rv["text"])}],
+                          rv["subscription_key"], url=url)
+
+    def _parse_response(self, parsed):
+        return parsed[0] if parsed else None
+
+
+class Detect(CognitiveServicesBase):
+    """Language detection via the Translator API
+    (ref: TextTranslator.scala Detect:318)."""
+
+    text = ServiceParam("text to detect", required=True)
+
+    def _build_request(self, rv):
+        if rv["text"] is None:
+            return None
+        return self._post([{"text": str(rv["text"])}],
+                          rv["subscription_key"])
+
+    def _parse_response(self, parsed):
+        return parsed[0] if parsed else None
+
+
+class BreakSentence(CognitiveServicesBase):
+    """Sentence boundary detection (ref: TextTranslator.scala
+    BreakSentence:331)."""
+
+    text = ServiceParam("text to split", required=True)
+    language = ServiceParam("language hint")
+
+    def _build_request(self, rv):
+        if rv["text"] is None:
+            return None
+        url = with_url_params(self.url, language=rv.get("language"))
+        return self._post([{"text": str(rv["text"])}],
+                          rv["subscription_key"], url=url)
+
+    def _parse_response(self, parsed):
+        return parsed[0] if parsed else None
+
+
+class _DictionaryBase(CognitiveServicesBase):
+    from_language = ServiceParam("source language", required=True)
+    to_language = ServiceParam("target language", required=True)
+
+    def _dict_url(self, rv):
+        return with_url_params(
+            self.url, **{"from": rv["from_language"],
+                         "to": rv["to_language"]})
+
+    def _parse_response(self, parsed):
+        return parsed[0] if parsed else None
+
+
+class DictionaryLookup(_DictionaryBase):
+    """Alternative translations for a word (ref: TextTranslator.scala
+    DictionaryLookup:360)."""
+
+    text = ServiceParam("word to look up", required=True)
+
+    def _build_request(self, rv):
+        if rv["text"] is None:
+            return None
+        return self._post([{"text": str(rv["text"])}],
+                          rv["subscription_key"], url=self._dict_url(rv))
+
+
+class DictionaryExamples(_DictionaryBase):
+    """Usage examples for a (text, translation) pair
+    (ref: TextTranslator.scala DictionaryExamples:389)."""
+
+    text = ServiceParam("source word", required=True)
+    translation = ServiceParam("target-language translation", required=True)
+
+    def _build_request(self, rv):
+        if rv["text"] is None:
+            return None
+        return self._post(
+            [{"text": str(rv["text"]),
+              "translation": str(rv["translation"])}],
+            rv["subscription_key"], url=self._dict_url(rv))
+
+
+class DocumentTranslator(HasAsyncReply, CognitiveServicesBase):
+    """Batch blob-to-blob document translation: POST the batches request,
+    then poll the operation (ref: DocumentTranslator.scala:28-120 —
+    submits to /translator/text/batch/v1.0/batches, 202 +
+    Operation-Location, status field polling)."""
+
+    source_url = ServiceParam("source container URL", required=True)
+    target_url = ServiceParam("target container URL", required=True)
+    target_language = ServiceParam("target language", required=True)
+
+    def _build_request(self, rv):
+        if rv["source_url"] is None:
+            return None
+        body = {"inputs": [{
+            "source": {"sourceUrl": rv["source_url"]},
+            "targets": [{"targetUrl": rv["target_url"],
+                         "language": rv["target_language"]}],
+        }]}
+        return self._post(body, rv["subscription_key"])
 
 
 # ---------------------------------------------------------------------------
@@ -264,8 +479,9 @@ class SpeechToText(CognitiveServicesBase):
     def _build_request(self, rv):
         if rv["audio_bytes"] is None:
             return None
-        url = (f"{self.url}?language={rv['language'] or 'en-US'}"
-               f"&format={rv['format'] or 'simple'}")
+        url = with_url_params(self.url,
+                              language=rv["language"] or "en-US",
+                              format=rv["format"] or "simple")
         return HTTPRequestData(
             url=url, method="POST",
             headers={**self._headers(rv["subscription_key"]),
@@ -275,6 +491,29 @@ class SpeechToText(CognitiveServicesBase):
     def _parse_response(self, parsed):
         return {"DisplayText": parsed.get("DisplayText"),
                 "RecognitionStatus": parsed.get("RecognitionStatus")}
+
+
+def get_speaker_profile(audio_bytes: bytes, key: str, url: str,
+                        backoffs_ms=(100, 500, 1000)) -> str:
+    """Voice-signature helper for conversation transcription
+    (ref: SpeechAPI.scala getSpeakerProfile:20-48 — multipart POST,
+    returns the Signature field; here the wav rides as octet-stream,
+    which the signature service also accepts).
+    """
+    from synapseml_tpu.io.http import (HandlingUtils,
+                                       SingleThreadedHTTPClient)
+
+    client = SingleThreadedHTTPClient(HandlingUtils.advanced(*backoffs_ms))
+    resp = client.send(HTTPRequestData(
+        url=url, method="POST",
+        headers={"Ocp-Apim-Subscription-Key": key,
+                 "Content-Type": "application/octet-stream"},
+        entity=bytes(audio_bytes)))
+    if not 200 <= resp.status_code < 300:
+        raise RuntimeError(
+            f"speaker profile request failed: {resp.status_code} "
+            f"{resp.text[:500]}")
+    return json.dumps(resp.json().get("Signature"))
 
 
 # ---------------------------------------------------------------------------

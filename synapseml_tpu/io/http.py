@@ -150,9 +150,14 @@ class SingleThreadedHTTPClient:
     def send(self, req: HTTPRequestData) -> HTTPResponseData:
         return self.handler(lambda: _send_once(req, self.timeout))
 
-    def send_all(self, reqs: Sequence[Optional[HTTPRequestData]]
-                 ) -> List[Optional[HTTPResponseData]]:
-        return [None if r is None else self.send(r) for r in reqs]
+    def send_all(self, reqs: Sequence[Optional[HTTPRequestData]],
+                 post=None) -> List[Optional[HTTPResponseData]]:
+        """``post(req, resp) -> resp`` runs per request in the worker —
+        long-running-operation polling hooks in here so polls overlap
+        under the async client instead of serializing after the sends."""
+        if post is None:
+            return [None if r is None else self.send(r) for r in reqs]
+        return [None if r is None else post(r, self.send(r)) for r in reqs]
 
 
 class AsyncHTTPClient(SingleThreadedHTTPClient):
@@ -165,11 +170,15 @@ class AsyncHTTPClient(SingleThreadedHTTPClient):
         super().__init__(handler, timeout)
         self.concurrency = max(1, int(concurrency))
 
-    def send_all(self, reqs):
+    def send_all(self, reqs, post=None):
+        def work(r):
+            resp = self.send(r)
+            return resp if post is None else post(r, resp)
+
         out: List[Optional[HTTPResponseData]] = [None] * len(reqs)
         with concurrent.futures.ThreadPoolExecutor(self.concurrency) as pool:
             futs = {
-                pool.submit(self.send, r): i
+                pool.submit(work, r): i
                 for i, r in enumerate(reqs) if r is not None
             }
             for fut in concurrent.futures.as_completed(futs):

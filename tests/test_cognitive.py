@@ -11,11 +11,20 @@ import threading
 import numpy as np
 import pytest
 
-from synapseml_tpu.cognitive import (AnalyzeImage, AzureSearchWriter,
-                                     BingImageSearch, DetectEntireSeries,
-                                     DetectLastAnomaly, KeyPhraseExtractor,
-                                     LanguageDetector, NER, OCR,
-                                     SpeechToText, TextSentiment, Translate)
+from synapseml_tpu.cognitive import (AnalyzeImage, AnalyzeLayout,
+                                     AnalyzeReceipts, AzureSearchWriter,
+                                     BingImageSearch, BreakSentence,
+                                     Detect, DetectEntireSeries,
+                                     DetectLastAnomaly, DictionaryExamples,
+                                     DictionaryLookup, FindSimilarFace,
+                                     GenerateThumbnails, GetCustomModel,
+                                     GroupFaces, IdentifyFaces,
+                                     KeyPhraseExtractor, LanguageDetector,
+                                     ListCustomModels, NER, OCR, ReadImage,
+                                     RecognizeDomainSpecificContent,
+                                     RecognizeText, SpeechToText, TagImage,
+                                     TextSentiment, Translate, Transliterate,
+                                     VerifyFaces, flatten_read_results)
 from synapseml_tpu.core.pipeline import PipelineStage
 from synapseml_tpu.data.table import Table
 
@@ -23,21 +32,63 @@ from synapseml_tpu.data.table import Table
 class _AzureMock(http.server.BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     seen = []
+    # operation id -> {"polls_left": n, "result": payload}
+    operations = {}
+    op_counter = [0]
 
     def log_message(self, *a):
         pass
 
-    def _reply(self, code, obj):
+    def _reply(self, code, obj, headers=None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _reply_bytes(self, code, body, content_type="image/jpeg"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _start_operation(self, result, polls=1):
+        """202 + Operation-Location; the op returns running `polls` times."""
+        _AzureMock.op_counter[0] += 1
+        op = str(_AzureMock.op_counter[0])
+        _AzureMock.operations[op] = {"polls_left": polls, "result": result}
+        host = self.headers.get("Host")
+        self._reply(202, {}, headers={
+            "Operation-Location": f"http://{host}/operations/{op}"})
 
     def do_GET(self):
         if self.path.startswith("/bing/images/search"):
             self._reply(200, {"value": [{"name": "img1"}, {"name": "img2"}]})
+        elif self.path.startswith("/operations/"):
+            op = self.path.rsplit("/", 1)[1]
+            state = _AzureMock.operations.get(op)
+            if state is None:
+                self._reply(404, {})
+            elif state["polls_left"] > 0:
+                state["polls_left"] -= 1
+                self._reply(200, {"status": "running"})
+            elif state["result"] is None:
+                self._reply(200, {"status": "failed",
+                                  "error": {"code": "InternalServerError"}})
+            else:
+                self._reply(200, {"status": "succeeded", **state["result"]})
+        elif self.path.startswith("/formrecognizer/custom/models/"):
+            model = self.path.split("/models/", 1)[1].split("?")[0]
+            self._reply(200, {"modelInfo": {"modelId": model,
+                                            "status": "ready"}})
+        elif self.path.startswith("/formrecognizer/custom/models"):
+            self._reply(200, {"modelList": [
+                {"modelId": "m1", "status": "ready"},
+                {"modelId": "m2", "status": "creating"}]})
         else:
             self._reply(404, {})
 
@@ -98,6 +149,70 @@ class _AzureMock(http.server.BaseHTTPRequestHandler):
         elif path.startswith("/vision/v3.2/ocr"):
             self._reply(200, {"regions": [{"lines": [{"words": [
                 {"text": "HELLO"}, {"text": "WORLD"}]}]}]})
+        elif path.startswith("/vision/v3.2/tag"):
+            self._reply(200, {"tags": [{"name": "cat", "confidence": 0.98}]})
+        elif path.startswith("/vision/v3.2/generateThumbnail"):
+            self._reply_bytes(200, b"\xff\xd8JPEGTHUMB")
+        elif path.startswith("/vision/v3.2/models/"):
+            model = path.split("/models/", 1)[1].split("/")[0]
+            self._reply(200, {"result": {model: [{"name": "Satya"}]}})
+        elif path.startswith("/vision/v3.2/failingRead"):
+            self._start_operation(None)
+        elif path.startswith("/vision/v3.2/recognizeText"):
+            self._start_operation({"recognitionResult": {"lines": [
+                {"text": "ASYNC"}, {"text": "TEXT"}]}})
+        elif path.startswith("/vision/v3.2/read/analyze"):
+            self._start_operation({"analyzeResult": {"readResults": [
+                {"lines": [{"text": "READ"}, {"text": "RESULT"}]}]}})
+        elif path.startswith("/face/v1.0/findsimilars"):
+            req = json.loads(body)
+            assert "faceId" in req
+            self._reply(200, [{"faceId": "f2", "confidence": 0.92}])
+        elif path.startswith("/face/v1.0/group"):
+            req = json.loads(body)
+            ids = req["faceIds"]
+            self._reply(200, {"groups": [ids[:2]], "messyGroup": ids[2:]})
+        elif path.startswith("/face/v1.0/identify"):
+            req = json.loads(body)
+            self._reply(200, [
+                {"faceId": fid, "candidates": [
+                    {"personId": "p1", "confidence": 0.9}]}
+                for fid in req["faceIds"]])
+        elif path.startswith("/face/v1.0/verify"):
+            req = json.loads(body)
+            same = (req.get("faceId1") == req.get("faceId2")
+                    or "personId" in req)
+            self._reply(200, {"isIdentical": same,
+                              "confidence": 0.95 if same else 0.1})
+        elif path.startswith("/formrecognizer/"):
+            # layout/receipt/custom analyses all reply via the LRO
+            self._start_operation({"analyzeResult": {
+                "readResults": [{"lines": [{"text": "INVOICE"},
+                                           {"text": "TOTAL 42"}]}],
+                "documentResults": [{"fields": {
+                    "Total": {"type": "number", "valueNumber": 42}}}],
+            }})
+        elif path.startswith("/translator/transliterate"):
+            texts = json.loads(body)
+            self._reply(200, [
+                {"text": t["text"].upper(), "script": "Latn"}
+                for t in texts])
+        elif path.startswith("/translator/detect"):
+            self._reply(200, [
+                {"language": "fr", "score": 0.97}
+                for _ in json.loads(body)])
+        elif path.startswith("/translator/breaksentence"):
+            self._reply(200, [
+                {"sentLen": [len(t["text"])]} for t in json.loads(body)])
+        elif path.startswith("/translator/dictionary/lookup"):
+            self._reply(200, [
+                {"translations": [{"normalizedTarget": t["text"] + "_fr"}]}
+                for t in json.loads(body)])
+        elif path.startswith("/translator/dictionary/examples"):
+            self._reply(200, [
+                {"examples": [{"sourcePrefix": t["text"],
+                               "targetPrefix": t["translation"]}]}
+                for t in json.loads(body)])
         elif path.startswith("/translator/translate"):
             texts = json.loads(body)
             self._reply(200, [
@@ -259,6 +374,182 @@ def test_service_serde_roundtrip(tmp_path, mock):
     assert s2.batch_size == 2
     out = s2.transform(_texts())
     assert out["sentiment"][0]["sentiment"] == "positive"
+
+
+def _img_table():
+    return Table({"img": np.array([b"\x89PNGfakebytes"], dtype=object)})
+
+
+def test_vision_extras_tag_thumbnail_domain(mock):
+    t = _img_table()
+    tag = TagImage(url=f"{mock}/vision/v3.2/tag", output_col="tags")
+    tag.set_service_col("image_bytes", "img")
+    assert tag.transform(t)["tags"][0][0]["name"] == "cat"
+
+    th = GenerateThumbnails(url=f"{mock}/vision/v3.2/generateThumbnail",
+                            width=32, height=32, output_col="thumb")
+    th.set_service_col("image_bytes", "img")
+    out = th.transform(t)
+    assert out["thumb"][0].startswith(b"\xff\xd8")
+    assert out["errors"][0] is None
+
+    dom = RecognizeDomainSpecificContent(
+        url=f"{mock}/vision/v3.2/models", model="celebrities",
+        output_col="celebs")
+    dom.set_service_col("image_bytes", "img")
+    assert dom.transform(t)["celebs"][0]["celebrities"][0]["name"] == "Satya"
+
+
+def test_async_reply_recognize_text_and_read(mock):
+    """202 + Operation-Location is polled through running -> succeeded
+    (ref: ComputerVision.scala BasicAsyncReply:211-257)."""
+    t = _img_table()
+    rt = RecognizeText(url=f"{mock}/vision/v3.2/recognizeText",
+                       output_col="rt", polling_delay_ms=10)
+    rt.set_service_col("image_bytes", "img")
+    out = rt.transform(t)
+    assert out["rt"][0]["text"] == "ASYNC TEXT"
+    assert out["errors"][0] is None
+
+    rd = ReadImage(url=f"{mock}/vision/v3.2/read/analyze",
+                   output_col="rd", polling_delay_ms=10)
+    rd.set_service_col("image_bytes", "img")
+    assert rd.transform(t)["rd"][0]["text"] == "READ RESULT"
+
+
+def test_async_failed_operation_lands_in_error_col(mock):
+    """A terminal failed/cancelled LRO must not masquerade as an empty
+    success — it becomes a non-2xx error row."""
+    rd = ReadImage(url=f"{mock}/vision/v3.2/failingRead",
+                   output_col="rd", polling_delay_ms=10)
+    rd.set_service_col("image_bytes", "img")
+    out = rd.transform(_img_table())
+    assert out["rd"][0] is None
+    err = out["errors"][0]
+    assert err is not None and err["status_code"] == 502
+    assert "failed" in err["reason"]
+
+
+def test_row_bound_query_params_are_url_encoded(mock):
+    """Reserved characters in a column value must not inject query params
+    (review finding: raw f-string splicing)."""
+    t = Table({"text": np.array(["salut"], dtype=object)})
+    tr = Transliterate(url=f"{mock}/translator/transliterate",
+                       output_col="o")
+    tr.set_service_col("text", "text")
+    tr.set_service_value("language", "fr&toScript=Cyrl")
+    tr.set_service_value("from_script", "Latn")
+    tr.set_service_value("to_script", "Latn")
+    out = tr.transform(t)
+    # the encoded value rides as ONE parameter; the mock still answers
+    assert out["o"][0]["text"] == "SALUT"
+    path = [p for p, _, _ in _AzureMock.seen
+            if p.startswith("/translator/transliterate")][-1]
+    assert "fr%26toScript%3DCyrl" in path
+
+
+def test_face_services(mock):
+    t = Table({"fid": np.array(["f1"], dtype=object),
+               "fids": np.empty(1, dtype=object)})
+    t["fids"][0] = ["f1", "f2", "f3"]
+
+    fs = FindSimilarFace(url=f"{mock}/face/v1.0/findsimilars",
+                         output_col="sim")
+    fs.set_service_col("face_id", "fid")
+    fs.set_service_col("face_ids", "fids")
+    assert fs.transform(t)["sim"][0][0]["confidence"] == 0.92
+
+    g = GroupFaces(url=f"{mock}/face/v1.0/group", output_col="groups")
+    g.set_service_col("face_ids", "fids")
+    out = g.transform(t)
+    assert out["groups"][0]["groups"] == [["f1", "f2"]]
+    assert out["groups"][0]["messyGroup"] == ["f3"]
+
+    idf = IdentifyFaces(url=f"{mock}/face/v1.0/identify", output_col="id")
+    idf.set_service_col("face_ids", "fids")
+    idf.set_service_value("person_group_id", "pg1")
+    res = idf.transform(t)["id"][0]
+    assert res[0]["candidates"][0]["personId"] == "p1"
+
+    v = VerifyFaces(url=f"{mock}/face/v1.0/verify", output_col="ver")
+    v.set_service_value("face_id1", "f1")
+    v.set_service_value("face_id2", "f1")
+    assert v.transform(Table({"x": np.array([1])}))["ver"][0][
+        "isIdentical"] is True
+
+    # missing both faceId1 and faceId -> null row, no crash
+    v2 = VerifyFaces(url=f"{mock}/face/v1.0/verify", output_col="ver")
+    out = v2.transform(Table({"x": np.array([1])}))
+    assert out["ver"][0] is None
+
+
+def test_form_recognizer_async_and_flatteners(mock):
+    t = _img_table()
+    lay = AnalyzeLayout(url=f"{mock}/formrecognizer/v2.1/layout/analyze",
+                        output_col="layout", polling_delay_ms=10)
+    lay.set_service_col("image_bytes", "img")
+    out = lay.transform(t)
+    assert flatten_read_results(out["layout"][0]) == "INVOICE TOTAL 42"
+
+    rec = AnalyzeReceipts(
+        url=f"{mock}/formrecognizer/v2.1/prebuilt/receipt/analyze",
+        output_col="rec", polling_delay_ms=10)
+    rec.set_service_col("image_bytes", "img")
+    rec.set_service_value("include_text_details", True)
+    out = rec.transform(t)
+    fields = out["rec"][0]["analyzeResult"]["documentResults"][0]["fields"]
+    assert fields["Total"]["valueNumber"] == 42
+
+    lst = ListCustomModels(url=f"{mock}/formrecognizer/custom/models",
+                           output_col="models")
+    lst.set_service_value("op", "full")
+    models = lst.transform(Table({"x": np.array([1])}))["models"][0]
+    assert [m["modelId"] for m in models["modelList"]] == ["m1", "m2"]
+
+    getm = GetCustomModel(url=f"{mock}/formrecognizer/custom/models",
+                          output_col="m")
+    getm.set_service_value("model_id", "m1")
+    getm.set_service_value("include_keys", True)
+    out = getm.transform(Table({"x": np.array([1])}))
+    assert out["m"][0]["modelInfo"]["modelId"] == "m1"
+
+
+def test_translator_family(mock):
+    t = Table({"text": np.array(["salut"], dtype=object)})
+
+    tr = Transliterate(url=f"{mock}/translator/transliterate",
+                       output_col="o")
+    tr.set_service_col("text", "text")
+    tr.set_service_value("language", "fr")
+    tr.set_service_value("from_script", "Latn")
+    tr.set_service_value("to_script", "Latn")
+    assert tr.transform(t)["o"][0]["text"] == "SALUT"
+
+    d = Detect(url=f"{mock}/translator/detect", output_col="o")
+    d.set_service_col("text", "text")
+    assert d.transform(t)["o"][0]["language"] == "fr"
+
+    bs = BreakSentence(url=f"{mock}/translator/breaksentence",
+                       output_col="o")
+    bs.set_service_col("text", "text")
+    assert bs.transform(t)["o"][0]["sentLen"] == [5]
+
+    dl = DictionaryLookup(url=f"{mock}/translator/dictionary/lookup",
+                          output_col="o")
+    dl.set_service_col("text", "text")
+    dl.set_service_value("from_language", "fr")
+    dl.set_service_value("to_language", "en")
+    out = dl.transform(t)
+    assert out["o"][0]["translations"][0]["normalizedTarget"] == "salut_fr"
+
+    de = DictionaryExamples(url=f"{mock}/translator/dictionary/examples",
+                            output_col="o")
+    de.set_service_col("text", "text")
+    de.set_service_value("translation", "hi")
+    de.set_service_value("from_language", "fr")
+    de.set_service_value("to_language", "en")
+    out = de.transform(t)
+    assert out["o"][0]["examples"][0]["targetPrefix"] == "hi"
 
 
 def test_azure_search_writer(mock):

@@ -163,11 +163,20 @@ def _svc(cls, **bindings):
     bind columns, non-strings (or *_value suffix) set literals."""
     svc = cls(url=_CTX["url"], backoffs=())
     for name, v in bindings.items():
-        if isinstance(v, str):
+        if name.endswith("_value"):
+            svc.set_service_value(name[:-6], v)
+        elif isinstance(v, str):
             svc.set_service_col(name, v)
         else:
             svc.set_service_value(name, v)
     return svc
+
+
+def _face_table():
+    fids = np.empty(2, dtype=object)
+    fids[:] = [["f1", "f2"], ["f3", "f4"]]
+    return Table({"fid": np.array(["f1", "f2"], dtype=object),
+                  "fids": fids})
 
 
 def _series_table():
@@ -220,12 +229,26 @@ def _test_objects():
     from synapseml_tpu.automl.automl import (FindBestModel, HyperparamBuilder,
                                              MetricEvaluator,
                                              TuneHyperparameters)
-    from synapseml_tpu.cognitive import (AnalyzeImage, BingImageSearch,
-                                         DescribeImage, DetectEntireSeries,
-                                         DetectFace, DetectLastAnomaly,
+    from synapseml_tpu.cognitive import (AnalyzeBusinessCards,
+                                         AnalyzeCustomModel,
+                                         AnalyzeIDDocuments, AnalyzeImage,
+                                         AnalyzeInvoices, AnalyzeLayout,
+                                         AnalyzeReceipts, BingImageSearch,
+                                         BreakSentence, DescribeImage,
+                                         DescribeImageExtended, Detect,
+                                         DetectEntireSeries, DetectFace,
+                                         DetectLastAnomaly,
+                                         DictionaryExamples, DictionaryLookup,
+                                         DocumentTranslator, FindSimilarFace,
+                                         GenerateThumbnails, GetCustomModel,
+                                         GroupFaces, IdentifyFaces,
                                          KeyPhraseExtractor, LanguageDetector,
-                                         NER, OCR, SpeechToText,
-                                         TextSentiment, Translate)
+                                         ListCustomModels, NER, OCR,
+                                         ReadImage,
+                                         RecognizeDomainSpecificContent,
+                                         RecognizeText, SpeechToText,
+                                         TagImage, TextSentiment, Translate,
+                                         Transliterate, VerifyFaces)
     from synapseml_tpu.cyber import (AccessAnomaly,
                                      ComplementAccessTransformer)
     from synapseml_tpu.data.batching import (DynamicMiniBatchTransformer,
@@ -596,6 +619,67 @@ def _test_objects():
         "SpeechToText": lambda: (_svc(SpeechToText, audio_bytes="audio"),
                                  Table({"audio": np.array(
                                      [b"RIFFxx", b"RIFFyy"], dtype=object)})),
+        "TagImage": lambda: (_svc(TagImage, image_url="url"), _url_table()),
+        "DescribeImageExtended": lambda: (_svc(DescribeImageExtended,
+                                               image_url="url"),
+                                          _url_table()),
+        "GenerateThumbnails": lambda: (_svc(GenerateThumbnails,
+                                            image_url="url"), _url_table()),
+        "RecognizeDomainSpecificContent": lambda: (_svc(
+            RecognizeDomainSpecificContent, image_url="url"), _url_table()),
+        "RecognizeText": lambda: (_svc(RecognizeText, image_url="url"),
+                                  _url_table()),
+        "ReadImage": lambda: (_svc(ReadImage, image_url="url"),
+                              _url_table()),
+        "FindSimilarFace": lambda: (_svc(FindSimilarFace, face_id="fid",
+                                         face_ids="fids"), _face_table()),
+        "GroupFaces": lambda: (_svc(GroupFaces, face_ids="fids"),
+                               _face_table()),
+        "IdentifyFaces": lambda: (_svc(IdentifyFaces, face_ids="fids",
+                                       person_group_id_value="pg"),
+                                  _face_table()),
+        "VerifyFaces": lambda: (_svc(VerifyFaces, face_id1="fid",
+                                     face_id2="fid"), _face_table()),
+        "AnalyzeLayout": lambda: (_svc(AnalyzeLayout, image_url="url"),
+                                  _url_table()),
+        "AnalyzeReceipts": lambda: (_svc(AnalyzeReceipts, image_url="url",
+                                         include_text_details_value=True),
+                                    _url_table()),
+        "AnalyzeBusinessCards": lambda: (_svc(AnalyzeBusinessCards,
+                                              image_url="url"), _url_table()),
+        "AnalyzeInvoices": lambda: (_svc(AnalyzeInvoices, image_url="url"),
+                                    _url_table()),
+        "AnalyzeIDDocuments": lambda: (_svc(AnalyzeIDDocuments,
+                                            image_url="url"), _url_table()),
+        "AnalyzeCustomModel": lambda: (_svc(AnalyzeCustomModel,
+                                            image_url="url",
+                                            model_id_value="m1"),
+                                       _url_table()),
+        "ListCustomModels": lambda: (_svc(ListCustomModels, op_value="full"),
+                                     _url_table()),
+        "GetCustomModel": lambda: (_svc(GetCustomModel, model_id_value="m1"),
+                                   _url_table()),
+        "Transliterate": lambda: (_svc(Transliterate, text="text",
+                                       language_value="fr",
+                                       from_script_value="Latn",
+                                       to_script_value="Latn"),
+                                  _text_table()),
+        "Detect": lambda: (_svc(Detect, text="text"), _text_table()),
+        "BreakSentence": lambda: (_svc(BreakSentence, text="text"),
+                                  _text_table()),
+        "DictionaryLookup": lambda: (_svc(DictionaryLookup, text="text",
+                                          from_language_value="fr",
+                                          to_language_value="en"),
+                                     _text_table()),
+        "DictionaryExamples": lambda: (_svc(DictionaryExamples, text="text",
+                                            translation_value="hi",
+                                            from_language_value="fr",
+                                            to_language_value="en"),
+                                       _text_table()),
+        "DocumentTranslator": lambda: (_svc(
+            DocumentTranslator, source_url_value="http://s/c1",
+            target_url_value="http://t/c2", target_language_value="fr"),
+            _url_table()),
         # cyber ----------------------------------------------------------
         "AccessAnomaly": lambda: (AccessAnomaly(
             rank_param=4, max_iter=4, tenant_col=None), _access_table()),
@@ -625,7 +709,7 @@ EXEMPT = {
     # abstract explainer base (concrete subclasses are all fuzzed)
     "LocalExplainer",
     # abstract cognitive bases (every concrete service is fuzzed)
-    "CognitiveServicesBase", "BatchedTextServiceBase",
+    "CognitiveServicesBase", "BatchedTextServiceBase", "FormRecognizerBase",
 }
 
 # fitted-model classes: covered transitively — the named estimator's fuzz
