@@ -163,7 +163,7 @@ class CognitiveServicesBase(Transformer, HasServiceParams):
             try:
                 out[i] = self._extract_output(r)
             except (json.JSONDecodeError, KeyError, TypeError,
-                    IndexError) as e:
+                    IndexError, AttributeError) as e:
                 errors[i] = {"status_code": r.status_code,
                              "reason": f"parse error: {e}",
                              "body": r.text[:2048]}

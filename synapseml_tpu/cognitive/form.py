@@ -99,18 +99,32 @@ class AnalyzeLayout(FormRecognizerBase):
         return out
 
 
-class _PrebuiltAnalyzeBase(FormRecognizerBase):
+def _bool_param(v: Any) -> Optional[str]:
+    """Azure URL params spell booleans lowercase."""
+    return None if v is None else ("true" if v else "false")
+
+
+class _HasTextDetails(FormRecognizerBase):
+    """includeTextDetails URL param (ref: HasTextDetails:52)."""
+
+    include_text_details = ServiceParam("include text lines in result")
+
+    def _url_params(self, rv):
+        out = super()._url_params(rv)
+        td = _bool_param(rv.get("include_text_details"))
+        if td is not None:
+            out["includeTextDetails"] = td
+        return out
+
+
+class _PrebuiltAnalyzeBase(_HasTextDetails):
     """Receipt/businessCard/invoice/idDocument analyses share
     includeTextDetails and locale (ref: HasTextDetails:52, HasLocale:72)."""
 
-    include_text_details = ServiceParam("include text lines in result")
     locale = ServiceParam("document locale, e.g. en-US")
 
     def _url_params(self, rv):
         out = super()._url_params(rv)
-        if rv.get("include_text_details") is not None:
-            out["includeTextDetails"] = (
-                "true" if rv["include_text_details"] else "false")
         if rv.get("locale") is not None:
             out["locale"] = rv["locale"]
         return out
@@ -132,20 +146,12 @@ class AnalyzeIDDocuments(_PrebuiltAnalyzeBase):
     """(ref: FormRecognizer.scala AnalyzeIDDocuments:245)."""
 
 
-class AnalyzeCustomModel(FormRecognizerBase):
+class AnalyzeCustomModel(_HasTextDetails):
     """Analysis through a user-trained model; the modelId rides the URL
     path (ref: FormRecognizer.scala AnalyzeCustomModel:326 —
     /custom/models/{modelId}/analyze)."""
 
     model_id = ServiceParam("custom model id", required=True)
-    include_text_details = ServiceParam("include text lines in result")
-
-    def _url_params(self, rv):
-        out = super()._url_params(rv)
-        if rv.get("include_text_details") is not None:
-            out["includeTextDetails"] = (
-                "true" if rv["include_text_details"] else "false")
-        return out
 
     def _target_url(self, rv):
         if rv.get("model_id") is None:
@@ -185,8 +191,7 @@ class GetCustomModel(CognitiveServicesBase):
 
         url = with_url_params(
             f"{self.url}/{quote(str(rv['model_id']), safe='')}",
-            includeKeys=None if rv.get("include_keys") is None
-            else ("true" if rv["include_keys"] else "false"))
+            includeKeys=_bool_param(rv.get("include_keys")))
         return HTTPRequestData(
             url=url, method="GET",
             headers=self._headers(rv["subscription_key"]))

@@ -234,8 +234,9 @@ class GenerateThumbnails(_ImageServiceBase):
     smart_cropping = Param("smart cropping", default=True)
 
     def _build_request(self, rv):
-        url = (f"{self.url}?width={int(self.width)}&height={int(self.height)}"
-               f"&smartCropping={'true' if self.smart_cropping else 'false'}")
+        url = with_url_params(
+            self.url, width=int(self.width), height=int(self.height),
+            smartCropping="true" if self.smart_cropping else "false")
         return self._image_request(rv, url=url)
 
     def _extract_output(self, resp):

@@ -5,3 +5,13 @@ from synapseml_tpu.cyber.anomaly import (  # noqa: F401
     AccessAnomalyModel,
     ComplementAccessTransformer,
 )
+from synapseml_tpu.cyber.feature import (  # noqa: F401
+    IdIndexer,
+    IdIndexerModel,
+    LinearScalarScaler,
+    LinearScalarScalerModel,
+    MultiIndexer,
+    MultiIndexerModel,
+    StandardScalarScaler,
+    StandardScalarScalerModel,
+)

@@ -1,0 +1,267 @@
+"""Cyber feature engineering: per-tenant indexers and scalers.
+
+Rebuild of the reference's cyber feature module
+(ref: core/src/main/python/mmlspark/cyber/feature/indexers.py —
+IdIndexerModel:12 (vocab join, unknown -> 0, input col dropped),
+IdIndexer:46 (1-based ids, reset_per_partition), MultiIndexer:130;
+feature/scalers.py — PerPartitionScalarScalerModel:18,
+StandardScalarScaler:189 (per-partition mean/std_pop, std==0 falls back
+to centering), LinearScalarScaler:289 (per-partition [min,max] ->
+[min_required, max_required], degenerate range -> midpoint)).
+
+Table-native differences: the Spark joins become vectorized dict lookups
+over numpy columns; per-group stats persist as plain dicts through
+ComplexParam side files instead of cached DataFrames. ``partition_key=None``
+means one global group (the reference's unpartitioned mode). Unlike the
+reference's unpartitioned standard scaler (which divides by zero
+unguarded), std==0 falls back to centering in both modes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from synapseml_tpu.core.param import (ComplexParam, HasInputCol,
+                                      HasOutputCol, Param)
+from synapseml_tpu.core.pipeline import Estimator, Model, Transformer
+from synapseml_tpu.data.table import Table
+
+_GLOBAL = "__global__"
+
+
+def _partitions(table: Table, partition_key: Optional[str]) -> np.ndarray:
+    if partition_key is None:
+        part = np.empty(table.num_rows, dtype=object)
+        part[:] = _GLOBAL
+        return part
+    return np.asarray(table[partition_key])
+
+
+class IdIndexerModel(Model, HasInputCol, HasOutputCol):
+    """Maps (partition, value) to a learned 1-based id; unseen values map
+    to 0 (ref: indexers.py IdIndexerModel._transform:31-43)."""
+
+    partition_key = Param("tenant column (None = single tenant)",
+                          default=None)
+    vocab = ComplexParam("{(partition, value): id} learned at fit")
+
+    def _transform(self, table: Table) -> Table:
+        parts = _partitions(table, self.partition_key)
+        vals = table[self.input_col]
+        lut: Dict[Tuple[Any, Any], int] = self.vocab or {}
+        idx = np.fromiter(
+            (lut.get((p, v), 0) for p, v in zip(parts, vals)),
+            dtype=np.int64, count=len(vals))
+        # the reference drops the raw value column after indexing
+        out = table.with_column(self.output_col, idx)
+        if self.input_col != self.output_col:
+            out = out.drop(self.input_col)
+        return out
+
+    def undo_transform(self, table: Table) -> Table:
+        """(partition, id) back to the original value
+        (ref: indexers.py IdIndexerModel.undo_transform:25-29)."""
+        parts = _partitions(table, self.partition_key)
+        ids = np.asarray(table[self.output_col])
+        inv: Dict[Tuple[Any, int], Any] = {
+            (p, i): v for (p, v), i in (self.vocab or {}).items()
+        }
+        vals = np.empty(len(ids), dtype=object)
+        for j, (p, i) in enumerate(zip(parts, ids)):
+            vals[j] = inv.get((p, int(i)))
+        return table.with_column(self.input_col, vals)
+
+
+class IdIndexer(Estimator, HasInputCol, HasOutputCol):
+    """Learns consecutive 1-based ids over distinct (partition, value)
+    pairs (ref: indexers.py IdIndexer:46-91; ids restart per partition
+    when ``reset_per_partition``)."""
+
+    partition_key = Param("tenant column (None = single tenant)",
+                          default=None)
+    reset_per_partition = Param(
+        "restart ids at 1 within each partition", default=True)
+
+    def _fit(self, table: Table) -> IdIndexerModel:
+        parts = _partitions(table, self.partition_key)
+        vals = table[self.input_col]
+        pairs = sorted(
+            {(p, v) for p, v in zip(parts, vals)},
+            key=lambda pv: (str(pv[0]), str(pv[1])))
+        vocab: Dict[Tuple[Any, Any], int] = {}
+        if self.reset_per_partition:
+            counters: Dict[Any, int] = {}
+            for p, v in pairs:
+                counters[p] = counters.get(p, 0) + 1
+                vocab[(p, v)] = counters[p]
+        else:
+            for i, pv in enumerate(pairs, start=1):
+                vocab[pv] = i
+        return IdIndexerModel(
+            input_col=self.input_col, output_col=self.output_col,
+            partition_key=self.partition_key, vocab=vocab)
+
+
+class MultiIndexerModel(Model):
+    """Applies several IdIndexerModels in sequence
+    (ref: indexers.py MultiIndexerModel:94-127)."""
+
+    models = ComplexParam("list of fitted IdIndexerModels")
+
+    def get_model_by_input_col(self, input_col: str
+                               ) -> Optional[IdIndexerModel]:
+        for m in self.models or []:
+            if m.input_col == input_col:
+                return m
+        return None
+
+    def get_model_by_output_col(self, output_col: str
+                                ) -> Optional[IdIndexerModel]:
+        for m in self.models or []:
+            if m.output_col == output_col:
+                return m
+        return None
+
+    def _transform(self, table: Table) -> Table:
+        for m in self.models or []:
+            table = m.transform(table)
+        return table
+
+    def undo_transform(self, table: Table) -> Table:
+        for m in self.models or []:
+            table = m.undo_transform(table)
+        return table
+
+
+class MultiIndexer(Estimator):
+    """Fits a set of IdIndexers on one pass of fit() calls
+    (ref: indexers.py MultiIndexer:130-135)."""
+
+    indexers = ComplexParam("list of IdIndexer estimators")
+
+    def _fit(self, table: Table) -> MultiIndexerModel:
+        return MultiIndexerModel(
+            models=[ix.fit(table) for ix in self.indexers or []])
+
+
+# ---------------------------------------------------------------------------
+# per-partition scalers
+# ---------------------------------------------------------------------------
+
+class PerPartitionScalarScalerModel(Model, HasInputCol, HasOutputCol):
+    """Shared plumbing: look up this row's group stats, apply the
+    subclass's normalization (ref: scalers.py
+    PerPartitionScalarScalerModel:18-85). Rows from unseen partitions
+    get NaN (the reference's left-join null)."""
+
+    partition_key = Param("tenant column (None = single tenant)",
+                          default=None)
+    per_group_stats = ComplexParam("{partition: {stat: value}}")
+
+    def _norm(self, x: np.ndarray, stats: Dict[str, float]) -> np.ndarray:
+        raise NotImplementedError
+
+    def _transform(self, table: Table) -> Table:
+        parts = _partitions(table, self.partition_key)
+        x = np.asarray(table[self.input_col], dtype=np.float64)
+        out = np.full(len(x), np.nan)
+        stats_map: Dict[Any, Dict[str, float]] = self.per_group_stats or {}
+        for p in np.unique(parts) if parts.dtype != object else set(parts):
+            stats = stats_map.get(p)
+            if stats is None:
+                continue
+            sel = parts == p
+            out[sel] = self._norm(x[sel], stats)
+        return table.with_column(self.output_col, out)
+
+
+class PerPartitionScalarScalerEstimator(Estimator, HasInputCol,
+                                        HasOutputCol):
+    """(ref: scalers.py PerPartitionScalarScalerEstimator:88-124)."""
+
+    partition_key = Param("tenant column (None = single tenant)",
+                          default=None)
+
+    def _group_stats(self, x: np.ndarray) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def _create_model(self, stats: Dict[Any, Dict[str, float]]
+                      ) -> PerPartitionScalarScalerModel:
+        raise NotImplementedError
+
+    def _fit(self, table: Table) -> PerPartitionScalarScalerModel:
+        parts = _partitions(table, self.partition_key)
+        x = np.asarray(table[self.input_col], dtype=np.float64)
+        stats: Dict[Any, Dict[str, float]] = {}
+        for p in set(parts):
+            stats[p] = self._group_stats(x[parts == p])
+        return self._create_model(stats)
+
+
+class StandardScalarScalerModel(PerPartitionScalarScalerModel):
+    """coef * (x - mean) / std per group; std == 0 falls back to plain
+    centering WITHOUT the coefficient — deliberately matching the
+    reference's ``otherwise(x - mean)`` branch (ref: scalers.py
+    StandardScalarScalerModel._make_partitioned_stats_method:162-170)."""
+
+    coefficient_factor = Param("post-scale multiplier", default=1.0)
+
+    def _norm(self, x, stats):
+        mean, std = stats["mean"], stats["std"]
+        if std == 0.0:
+            return x - mean
+        return self.coefficient_factor * (x - mean) / std
+
+
+class StandardScalarScaler(PerPartitionScalarScalerEstimator):
+    """(ref: scalers.py StandardScalarScaler:189-224 — mean + stddev_pop
+    per partition)."""
+
+    coefficient_factor = Param("post-scale multiplier", default=1.0)
+
+    def _group_stats(self, x):
+        return {"mean": float(np.mean(x)), "std": float(np.std(x))}
+
+    def _create_model(self, stats):
+        return StandardScalarScalerModel(
+            input_col=self.input_col, output_col=self.output_col,
+            partition_key=self.partition_key, per_group_stats=stats,
+            coefficient_factor=self.coefficient_factor)
+
+
+class LinearScalarScalerModel(PerPartitionScalarScalerModel):
+    """Affine map of the group's [min,max] onto [min_required,
+    max_required]; a degenerate range maps to the midpoint
+    (ref: scalers.py LinearScalarScalerModel:232-286)."""
+
+    min_required_value = Param("output range lower bound", default=0.0)
+    max_required_value = Param("output range upper bound", default=1.0)
+
+    def _norm(self, x, stats):
+        lo, hi = stats["min"], stats["max"]
+        delta = hi - lo
+        if delta == 0.0:
+            a = 0.0
+            b = (self.min_required_value + self.max_required_value) / 2.0
+        else:
+            a = (self.max_required_value - self.min_required_value) / delta
+            b = self.max_required_value - a * hi
+        return a * x + b
+
+
+class LinearScalarScaler(PerPartitionScalarScalerEstimator):
+    """(ref: scalers.py LinearScalarScaler:289-325)."""
+
+    min_required_value = Param("output range lower bound", default=0.0)
+    max_required_value = Param("output range upper bound", default=1.0)
+
+    def _group_stats(self, x):
+        return {"min": float(np.min(x)), "max": float(np.max(x))}
+
+    def _create_model(self, stats):
+        return LinearScalarScalerModel(
+            input_col=self.input_col, output_col=self.output_col,
+            partition_key=self.partition_key, per_group_stats=stats,
+            min_required_value=self.min_required_value,
+            max_required_value=self.max_required_value)

@@ -102,3 +102,130 @@ def test_complement_access_transformer():
         assert (u, r) not in seen  # strictly from the complement set
     # entities come from the observed vocabulary
     assert set(np.asarray(out["user"])) <= set(np.asarray(t["user"]))
+
+
+# ---------------------------------------------------------------------------
+# feature module: indexers + per-partition scalers
+# ---------------------------------------------------------------------------
+
+def _access_log():
+    return Table({
+        "tenant": np.array(["t1", "t1", "t1", "t2", "t2"], dtype=object),
+        "user": np.array(["alice", "bob", "alice", "bob", "carol"],
+                         dtype=object),
+        "score": np.array([1.0, 3.0, 5.0, 10.0, 30.0]),
+    })
+
+
+def test_id_indexer_reset_per_partition():
+    from synapseml_tpu.cyber import IdIndexer
+
+    t = _access_log()
+    model = IdIndexer(input_col="user", output_col="user_idx",
+                      partition_key="tenant",
+                      reset_per_partition=True).fit(t)
+    out = model.transform(t)
+    assert "user" not in out.columns  # raw value column is dropped
+    idx = np.asarray(out["user_idx"])
+    # per-tenant 1-based: t1 has {alice:1, bob:2}; t2 restarts {bob:1, carol:2}
+    assert idx.tolist() == [1, 2, 1, 1, 2]
+
+    # global numbering when reset_per_partition=False
+    g = IdIndexer(input_col="user", output_col="user_idx",
+                  partition_key="tenant",
+                  reset_per_partition=False).fit(t)
+    gi = np.asarray(g.transform(t)["user_idx"])
+    assert sorted(set(gi.tolist())) == [1, 2, 3, 4]
+
+    # unseen values map to 0
+    unseen = Table({"tenant": np.array(["t1"], dtype=object),
+                    "user": np.array(["mallory"], dtype=object)})
+    assert np.asarray(model.transform(unseen)["user_idx"]).tolist() == [0]
+
+    # undo_transform restores the original values by (tenant, id)
+    restored = model.undo_transform(out)
+    assert np.asarray(restored["user"]).tolist() == [
+        "alice", "bob", "alice", "bob", "carol"]
+
+
+def test_multi_indexer_and_serde(tmp_path):
+    from synapseml_tpu.core.pipeline import PipelineStage
+    from synapseml_tpu.cyber import IdIndexer, MultiIndexer
+
+    t = Table({
+        "tenant": np.array(["t1", "t1", "t2"], dtype=object),
+        "user": np.array(["u1", "u2", "u1"], dtype=object),
+        "res": np.array(["r1", "r1", "r2"], dtype=object),
+    })
+    mi = MultiIndexer(indexers=[
+        IdIndexer(input_col="user", output_col="uidx",
+                  partition_key="tenant"),
+        IdIndexer(input_col="res", output_col="ridx",
+                  partition_key="tenant"),
+    ])
+    model = mi.fit(t)
+    out = model.transform(t)
+    assert set(out.columns) == {"tenant", "uidx", "ridx"}
+    assert model.get_model_by_input_col("user").output_col == "uidx"
+    assert model.get_model_by_output_col("ridx").input_col == "res"
+
+    p = str(tmp_path / "mi")
+    model.save(p)
+    model2 = PipelineStage.load(p)
+    out2 = model2.transform(t)
+    assert np.asarray(out2["uidx"]).tolist() == \
+        np.asarray(out["uidx"]).tolist()
+
+
+def test_standard_scaler_per_partition():
+    from synapseml_tpu.cyber import StandardScalarScaler
+
+    t = _access_log()
+    model = StandardScalarScaler(input_col="score", output_col="z",
+                                 partition_key="tenant").fit(t)
+    z = np.asarray(model.transform(t)["z"])
+    # each tenant normalized with ITS OWN mean/std_pop
+    t1 = np.array([1.0, 3.0, 5.0])
+    t2 = np.array([10.0, 30.0])
+    np.testing.assert_allclose(z[:3], (t1 - t1.mean()) / t1.std())
+    np.testing.assert_allclose(z[3:], (t2 - t2.mean()) / t2.std())
+
+    # unseen partition -> NaN (the reference's left-join null)
+    unk = Table({"tenant": np.array(["t9"], dtype=object),
+                 "score": np.array([1.0])})
+    assert np.isnan(np.asarray(model.transform(unk)["z"])).all()
+
+    # degenerate std falls back to centering
+    const = Table({"tenant": np.array(["c", "c"], dtype=object),
+                   "score": np.array([7.0, 7.0])})
+    m2 = StandardScalarScaler(input_col="score", output_col="z",
+                              partition_key="tenant").fit(const)
+    np.testing.assert_allclose(
+        np.asarray(m2.transform(const)["z"]), [0.0, 0.0])
+
+
+def test_linear_scaler_per_partition():
+    from synapseml_tpu.cyber import LinearScalarScaler
+
+    t = _access_log()
+    model = LinearScalarScaler(input_col="score", output_col="s",
+                               partition_key="tenant",
+                               min_required_value=0.0,
+                               max_required_value=1.0).fit(t)
+    s = np.asarray(model.transform(t)["s"])
+    np.testing.assert_allclose(s[:3], [0.0, 0.5, 1.0])  # t1: [1,5] -> [0,1]
+    np.testing.assert_allclose(s[3:], [0.0, 1.0])       # t2: [10,30] -> [0,1]
+
+    # degenerate range maps to the midpoint
+    const = Table({"tenant": np.array(["c"], dtype=object),
+                   "score": np.array([7.0])})
+    m2 = LinearScalarScaler(input_col="score", output_col="s",
+                            partition_key="tenant", min_required_value=2.0,
+                            max_required_value=4.0).fit(const)
+    np.testing.assert_allclose(np.asarray(m2.transform(const)["s"]), [3.0])
+
+    # unpartitioned mode: one global group
+    g = LinearScalarScaler(input_col="score", output_col="s").fit(t)
+    gs = np.asarray(g.transform(t)["s"])
+    np.testing.assert_allclose(gs, (np.asarray(t["score"]) - 1.0) / 29.0,
+                               atol=1e-12)

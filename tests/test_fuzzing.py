@@ -250,7 +250,9 @@ def _test_objects():
                                          TagImage, TextSentiment, Translate,
                                          Transliterate, VerifyFaces)
     from synapseml_tpu.cyber import (AccessAnomaly,
-                                     ComplementAccessTransformer)
+                                     ComplementAccessTransformer, IdIndexer,
+                                     LinearScalarScaler, MultiIndexer,
+                                     StandardScalarScaler)
     from synapseml_tpu.data.batching import (DynamicMiniBatchTransformer,
                                              FixedMiniBatchTransformer,
                                              FlattenBatch,
@@ -681,6 +683,17 @@ def _test_objects():
             target_url_value="http://t/c2", target_language_value="fr"),
             _url_table()),
         # cyber ----------------------------------------------------------
+        "IdIndexer": lambda: (IdIndexer(
+            input_col="user", output_col="uidx", partition_key=None),
+            _access_table()),
+        "MultiIndexer": lambda: (MultiIndexer(indexers=[
+            IdIndexer(input_col="user", output_col="uidx"),
+            IdIndexer(input_col="res", output_col="ridx")]),
+            _access_table()),
+        "StandardScalarScaler": lambda: (StandardScalarScaler(
+            input_col="a", output_col="z"), num()),
+        "LinearScalarScaler": lambda: (LinearScalarScaler(
+            input_col="a", output_col="s"), num()),
         "AccessAnomaly": lambda: (AccessAnomaly(
             rank_param=4, max_iter=4, tenant_col=None), _access_table()),
         "ComplementAccessTransformer": lambda: (ComplementAccessTransformer(
@@ -710,6 +723,8 @@ EXEMPT = {
     "LocalExplainer",
     # abstract cognitive bases (every concrete service is fuzzed)
     "CognitiveServicesBase", "BatchedTextServiceBase", "FormRecognizerBase",
+    # abstract per-partition scaler bases (concrete scalers are fuzzed)
+    "PerPartitionScalarScalerEstimator", "PerPartitionScalarScalerModel",
 }
 
 # fitted-model classes: covered transitively — the named estimator's fuzz
@@ -742,6 +757,10 @@ COVERED_BY_ESTIMATOR = {
     "TrainedClassifierModel": "TrainClassifier",
     "TrainedRegressorModel": "TrainRegressor",
     "AccessAnomalyModel": "AccessAnomaly",
+    "IdIndexerModel": "IdIndexer",
+    "MultiIndexerModel": "MultiIndexer",
+    "StandardScalarScalerModel": "StandardScalarScaler",
+    "LinearScalarScalerModel": "LinearScalarScaler",
 }
 
 
