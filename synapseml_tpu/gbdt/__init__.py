@@ -1,6 +1,7 @@
 from synapseml_tpu.gbdt.boosting import BoostParams, Booster, train
 from synapseml_tpu.gbdt.estimators import (
     LightGBMClassificationModel,
+    LightGBMDelegate,
     LightGBMClassifier,
     LightGBMRanker,
     LightGBMRankerModel,
@@ -10,6 +11,7 @@ from synapseml_tpu.gbdt.estimators import (
 
 __all__ = [
     "BoostParams", "Booster", "LightGBMClassificationModel",
+    "LightGBMDelegate",
     "LightGBMClassifier", "LightGBMRanker", "LightGBMRankerModel",
     "LightGBMRegressionModel", "LightGBMRegressor", "train",
 ]

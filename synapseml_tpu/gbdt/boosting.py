@@ -477,7 +477,8 @@ def _compute_chunk(p: BoostParams, tracker, track_rank: bool,
 
 def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
                         total_iters: int, chunk: int, track_dev: bool,
-                        track_rank: bool, vy_h, vg_h, on_chunk=None):
+                        track_rank: bool, vy_h, vg_h, on_chunk=None,
+                        on_stop=None):
     """Drive the jitted chunk scans; metrics/early-stop applied host-side.
 
     ``run(carry, steps, chunk_start_iter) -> (carry, ys)`` where ``ys[0]``
@@ -518,6 +519,10 @@ def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
             on_chunk(
                 jax.tree_util.tree_map(lambda a: a[:kept], tree_chunks[-1]),
                 min(done_iters, total_iters))
+    if stop_steps is not None and on_stop is not None:
+        # early stop skips on_chunk (a stopped run must not checkpoint);
+        # iteration observers still need to hear about the kept iterations
+        on_stop(stop_steps // k)
     stacked = jax.tree_util.tree_map(
         lambda *xs: np.concatenate(xs, axis=0), *tree_chunks)
     keep = stop_steps if stop_steps is not None else total_iters * k
@@ -561,7 +566,8 @@ def _assemble_booster(stacked, p: BoostParams, k: int, init: float, f: int,
 @lru_cache(maxsize=64)
 def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
                   track_dev: bool, track_rank: bool,
-                  metric_name: Optional[str], blocked_rank: bool = False):
+                  metric_name: Optional[str], blocked_rank: bool = False,
+                  use_lr_schedule: bool = False):
     """Build (and cache) the jitted chunked-scan trainer for one static
     config. Data rides in through the ``consts`` argument, so repeated fits
     with the same hyperparameters reuse the compiled executable instead of
@@ -638,7 +644,7 @@ def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
             mask = jnp.zeros(f, jnp.bool_).at[perm[:keep]].set(True)
             return mask
 
-        def iteration(scores, key, class_idx):
+        def iteration(scores, key, class_idx, lr_it=None):
             base = jnp.full_like(scores, init) if is_rf else scores
             g, h = compute_grad(base, class_idx)
             k1, k2 = jax.random.split(key)
@@ -672,7 +678,12 @@ def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
                     left_child=tree.left_child,
                     right_child=tree.right_child, leaf_value=new_leaf,
                     cover=tree.cover, gain=tree.gain)
-            lr = 1.0 if is_rf else p.learning_rate
+            if is_rf:
+                lr = 1.0
+            elif lr_it is not None:  # delegate-driven per-iteration LR
+                lr = lr_it
+            else:
+                lr = p.learning_rate
             delta = lr * slot_value[row_slot]
             if k > 1:
                 # one-hot column add (a traced-column scatter is a
@@ -698,7 +709,8 @@ def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
             rng, key = jax.random.split(rng)
             c = step % k
             it = step // k
-            new_scores, tree = iteration(scores, key, c)
+            lr_it = consts["lrs"][it] if use_lr_schedule else None
+            new_scores, tree = iteration(scores, key, c, lr_it)
             out: Tuple = (tree,)
             if track:
                 vt = predict_tree(
@@ -735,6 +747,8 @@ def train(
     init_model: Optional[Booster] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
+    learning_rates: Optional[np.ndarray] = None,
+    iteration_hook=None,
 ) -> Booster:
     """Train a Booster. ``mesh`` enables dp-sharded histogram training.
 
@@ -745,6 +759,14 @@ def train(
     write a loadable partial model every N iterations (see
     :func:`save_checkpoint`/:func:`load_checkpoint`); a killed run resumes
     via ``load_checkpoint`` + ``init_model``.
+
+    ``learning_rates`` is an optional per-iteration shrinkage schedule
+    (the delegate's dynamic-LR hook, ref: LightGBMDelegate.scala
+    getLearningRate:57-61); it rides the scan as data so every schedule
+    reuses one compiled trainer. ``iteration_hook(iters_done)`` fires at
+    every device-chunk boundary — the TPU loop runs whole chunks on
+    device, so this is the granularity at which the reference's
+    afterTrainIteration callback surfaces here.
     """
     x = np.asarray(x, dtype=np.float64)
     y = np.asarray(y, dtype=np.float32)
@@ -775,6 +797,9 @@ def train(
             raise NotImplementedError(
                 "init_model/checkpointing are single-device for now; "
                 "fit the resumed model without a mesh")
+        if learning_rates is not None:
+            raise NotImplementedError(
+                "per-iteration learning_rates are single-device for now")
         return _train_distributed(
             p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
             thresholds, valid_sets, feature_names, group=group)
@@ -809,6 +834,10 @@ def train(
     if p.boosting_type == "dart":
         if k > 1:
             raise NotImplementedError("dart + multiclass not yet supported")
+        if learning_rates is not None:
+            raise NotImplementedError(
+                "per-iteration learning_rates are not defined for dart "
+                "(tree weights are renormalized every round)")
         if checkpoint_dir is not None:
             raise NotImplementedError(
                 "step checkpointing is not defined for dart (past trees "
@@ -858,9 +887,25 @@ def train(
             qidx, qmask, qinv = (jnp.asarray(qidx_np),
                                  jnp.asarray(qmask_np),
                                  jnp.asarray(qinv_np))
+    if learning_rates is not None and is_rf:
+        raise NotImplementedError(
+            "rf averages unshrunk trees; a learning-rate schedule "
+            "does not apply")
+    use_lr_schedule = learning_rates is not None
+    lrs_d = None
+    if use_lr_schedule:
+        lrs = np.asarray(learning_rates, np.float32)
+        if lrs.shape != (p.num_iterations,):
+            raise ValueError(
+                f"learning_rates must have shape ({p.num_iterations},), "
+                f"got {lrs.shape}")
+        # chunked scans index past num_iterations on the final (surplus)
+        # steps; pad with the last value so those reads stay in range
+        lrs_d = jnp.asarray(np.concatenate([lrs, np.repeat(lrs[-1:],
+                                                           len(lrs))]))
     consts = dict(
         binned=binned, yd=yd, wd=wd, gids=group_ids, thr=thresholds,
-        init=jnp.float32(init),
+        init=jnp.float32(init), lrs=lrs_d,
         qidx=qidx, qmask=qmask, qinv=qinv,
         vx=tracker.sets[0][0] if tracker.enabled else None,
         vy=tracker.sets[0][1] if tracker.enabled else None)
@@ -870,11 +915,13 @@ def train(
     key_p = dataclasses.replace(
         p, seed=0, num_iterations=1, early_stopping_round=0, verbosity=-1,
         categorical_features=(), metric=None, max_bin=0,
-        deterministic=True)
+        deterministic=True,
+        # with a schedule the static base LR is never read either
+        learning_rate=0.0 if use_lr_schedule else p.learning_rate)
     scan_fn = _make_scan_fn(
         key_p, gp, k, tracker.enabled, track_dev, track_rank,
         tracker.metric_name if tracker.enabled else None,
-        blocked_rank=blocked_rank)
+        blocked_rank=blocked_rank, use_lr_schedule=use_lr_schedule)
 
     total_iters = p.num_iterations
     chunk = _compute_chunk(p, tracker, track_rank, total_iters,
@@ -920,11 +967,11 @@ def train(
                 [padc(init_model.trees_gain, 0), padc(stacked.gain, 0)]),
         )
 
-    on_chunk = None
+    ckpt_chunk = None
     if checkpoint_dir is not None:
         _ck_acc: List = []
 
-        def on_chunk(chunk_trees, iters_done):
+        def ckpt_chunk(chunk_trees, iters_done):
             _ck_acc.append(chunk_trees)
             stacked_ck = jax.tree_util.tree_map(
                 lambda *xs: np.concatenate(xs, axis=0), *_ck_acc)
@@ -936,12 +983,21 @@ def train(
             save_checkpoint(checkpoint_dir, booster, iters_done,
                             p.num_iterations)
 
+    on_chunk = None
+    if ckpt_chunk is not None or iteration_hook is not None:
+        def on_chunk(chunk_trees, iters_done):
+            if ckpt_chunk is not None:
+                ckpt_chunk(chunk_trees, iters_done)
+            if iteration_hook is not None:
+                iteration_hook(min(iters_done, p.num_iterations))
+
     carry = (scores, vsum0, jax.random.PRNGKey(p.seed))
     stacked = _chunked_boost_loop(
         lambda c, steps, start: scan_fn(c, steps, consts),
         carry, tracker, p, k, total_iters, chunk, track_dev, track_rank,
         vy_h if tracker.enabled else None,
-        vg_h if tracker.enabled else None, on_chunk=on_chunk)
+        vg_h if tracker.enabled else None, on_chunk=on_chunk,
+        on_stop=iteration_hook)
     booster = _assemble_booster(_with_init(stacked), p, k, init, f,
                                 feature_names, tracker)
     if init_model is not None and booster.best_iteration >= 0:

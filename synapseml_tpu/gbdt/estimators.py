@@ -18,10 +18,43 @@ from typing import List, Optional
 
 import numpy as np
 
-from synapseml_tpu.core.param import Param
+from synapseml_tpu.core.param import ComplexParam, Param
 from synapseml_tpu.core.pipeline import Estimator, Model
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.gbdt.boosting import Booster, BoostParams, train
+
+
+class LightGBMDelegate:
+    """User callback hooks around training
+    (ref: lightgbm/.../LightGBMDelegate.scala:12-62).
+
+    Subclass and override; attach via the estimator's ``delegate`` param.
+    ``after_train_iteration`` fires at device-chunk boundaries (the TPU
+    boosting loop runs whole ``lax.scan`` chunks on device — per-tree
+    host callbacks would serialize the device pipeline), with the number
+    of iterations completed so far. ``get_learning_rate`` is consulted
+    once per iteration BEFORE the run to assemble a shrinkage schedule
+    (it sees batch index, iteration and the previous rate — the same
+    signature contract as the reference's dynamic-LR delegate).
+    """
+
+    def before_train_batch(self, batch_index: int, table: Table,
+                           prev_model) -> None:
+        """(ref: LightGBMDelegate.scala beforeTrainBatch:13)."""
+
+    def after_train_batch(self, batch_index: int, table: Table,
+                          model) -> None:
+        """(ref: LightGBMDelegate.scala afterTrainBatch:18)."""
+
+    def after_train_iteration(self, batch_index: int,
+                              iterations_done: int) -> None:
+        """(ref: LightGBMDelegate.scala afterTrainIteration:49; chunk
+        granularity here)."""
+
+    def get_learning_rate(self, batch_index: int, iteration: int,
+                          previous_rate: float) -> float:
+        """(ref: LightGBMDelegate.scala getLearningRate:57)."""
+        return previous_rate
 
 
 class _LightGBMParams:
@@ -160,21 +193,94 @@ class _LightGBMModelBase(Model, _LightGBMParams):
             self.booster = Booster.load_string(f.read())
 
 
-class LightGBMClassifier(Estimator, _LightGBMParams):
+class _LightGBMEstimatorBase(Estimator, _LightGBMParams):
+    """Batch-training driver shared by the three learners
+    (ref: LightGBMBase.scala train:46-61 — randomSplit into numBatches,
+    thread the booster via setModelString, before/afterTrainBatch hooks).
+
+    ``num_batches``/``delegate`` live here, NOT on the shared param
+    mixin: they are training-only knobs, and a fitted model must never
+    pickle the user's callback object into its saved artifact.
+    """
+
+    num_batches = Param(
+        "split training into N sequential batches, threading the booster "
+        "from each into the next (ref: LightGBMBase.scala train:46-61)",
+        default=0)
+    delegate = ComplexParam(
+        "optional LightGBMDelegate with batch/iteration/LR hooks")
+
+    def _delegate_train_kwargs(self, batch_index: int) -> dict:
+        """learning-rate schedule + iteration hook from the delegate."""
+        d = self.get("delegate")
+        out: dict = {}
+        if d is None:
+            return out
+        if (type(d).get_learning_rate
+                is not LightGBMDelegate.get_learning_rate):
+            lrs, prev = [], float(self.learning_rate)
+            for it in range(int(self.num_iterations)):
+                prev = float(d.get_learning_rate(batch_index, it, prev))
+                lrs.append(prev)
+            out["learning_rates"] = np.asarray(lrs, np.float32)
+        if (type(d).after_train_iteration
+                is not LightGBMDelegate.after_train_iteration):
+            out["iteration_hook"] = (
+                lambda iters: d.after_train_iteration(batch_index, iters))
+        return out
+
+    def _batch_context(self, table: Table) -> dict:
+        """Whole-dataset state every batch must share (e.g. the label
+        mapping — a batch may not contain every class)."""
+        return {}
+
+    def _fit_single(self, table: Table, init_booster: Optional[Booster],
+                    batch_index: int, ctx: dict):
+        raise NotImplementedError
+
+    def _fit(self, table: Table):
+        nb = int(self.num_batches or 0)
+        ctx = self._batch_context(table)
+        if nb <= 1:
+            return self._fit_single(table, None, 0, ctx)
+        d = self.get("delegate")
+        parts = table.random_split([1.0 / nb] * nb, seed=int(self.seed))
+        model = None
+        for bi, part in enumerate(parts):
+            if part.num_rows == 0:
+                continue  # tiny-table splits can leave an empty batch
+            if d is not None:
+                d.before_train_batch(bi, part, model)
+            model = self._fit_single(
+                part, model.booster if model is not None else None, bi, ctx)
+            if d is not None:
+                d.after_train_batch(bi, part, model)
+        if model is None:
+            raise ValueError("no non-empty training batch")
+        return model
+
+
+class LightGBMClassifier(_LightGBMEstimatorBase):
     """ref: lightgbm/.../LightGBMClassifier.scala:26-92."""
 
     objective = Param("binary|multiclass", default="binary")
     probability_col = Param("probability column", default="probability")
     raw_prediction_col = Param("raw margin column", default="rawPrediction")
 
-    def _fit(self, table: Table) -> "LightGBMClassificationModel":
+    def _batch_context(self, table: Table) -> dict:
+        # the class mapping must come from ALL batches' labels
+        return {"classes": np.unique(
+            np.asarray(table[self.label_col], np.float64))}
+
+    def _fit_single(self, table: Table, init_booster, batch_index,
+                    ctx) -> "LightGBMClassificationModel":
         train_t, valid_t = self._split_validation(table)
         x = self._features(train_t)
         y_raw = np.asarray(train_t[self.label_col], np.float64)
         # remap arbitrary class labels to dense 0..k-1 (the reference gets
         # this via label reindexing in TrainClassifier / native LightGBM
         # validation); predictions map back through label_values
-        classes = np.unique(y_raw)
+        classes = ctx["classes"]
         y = np.searchsorted(classes, y_raw).astype(np.float64)
         num_class = len(classes)
         objective = self.objective
@@ -199,7 +305,9 @@ class LightGBMClassifier(Estimator, _LightGBMParams):
         booster = train(
             self._boost_params(objective,
                                num_class if objective != "binary" else 1),
-            x, y, weight=weight, valid_sets=valid)
+            x, y, weight=weight, valid_sets=valid,
+            init_model=init_booster,
+            **self._delegate_train_kwargs(batch_index))
         model = self._make_model(LightGBMClassificationModel, booster)
         label_values = [float(c) for c in classes]
         while len(label_values) < 2:  # single-class fit still emits 2 prob cols
@@ -235,7 +343,7 @@ class LightGBMClassificationModel(_LightGBMModelBase):
         })
 
 
-class LightGBMRegressor(Estimator, _LightGBMParams):
+class LightGBMRegressor(_LightGBMEstimatorBase):
     """ref: lightgbm/.../LightGBMRegressor.scala:38-154."""
 
     objective = Param(
@@ -244,7 +352,8 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
     alpha = Param("huber/quantile alpha", default=0.9)
     tweedie_variance_power = Param("tweedie power", default=1.5)
 
-    def _fit(self, table: Table) -> "LightGBMRegressionModel":
+    def _fit_single(self, table: Table, init_booster, batch_index,
+                    ctx) -> "LightGBMRegressionModel":
         train_t, valid_t = self._split_validation(table)
         x = self._features(train_t)
         y = np.asarray(train_t[self.label_col], np.float64)
@@ -258,7 +367,9 @@ class LightGBMRegressor(Estimator, _LightGBMParams):
             self._boost_params(self.objective),
             alpha=float(self.alpha),
             tweedie_variance_power=float(self.tweedie_variance_power))
-        booster = train(bp, x, y, weight=weight, valid_sets=valid)
+        booster = train(bp, x, y, weight=weight, valid_sets=valid,
+                        init_model=init_booster,
+                        **self._delegate_train_kwargs(batch_index))
         return self._make_model(LightGBMRegressionModel, booster)
 
 
@@ -268,7 +379,7 @@ class LightGBMRegressionModel(_LightGBMModelBase):
         return table.with_column(self.prediction_col, pred.astype(np.float64))
 
 
-class LightGBMRanker(Estimator, _LightGBMParams):
+class LightGBMRanker(_LightGBMEstimatorBase):
     """ref: lightgbm/.../LightGBMRanker.scala:26-177."""
 
     objective = Param("lambdarank", default="lambdarank")
@@ -276,7 +387,8 @@ class LightGBMRanker(Estimator, _LightGBMParams):
     max_position = Param("NDCG truncation", default=30)
     evaluate_at = Param("eval positions", default=None)
 
-    def _fit(self, table: Table) -> "LightGBMRankerModel":
+    def _fit_single(self, table: Table, init_booster, batch_index,
+                    ctx) -> "LightGBMRankerModel":
         # repartition-by-group analogue: sort so each query is contiguous
         # (ref: repartitionByGroupingColumn, lightgbm/.../LightGBMBase.scala)
         table = table.sort(self.group_col)
@@ -297,7 +409,8 @@ class LightGBMRanker(Estimator, _LightGBMParams):
         bp = dataclasses.replace(self._boost_params("lambdarank"),
                                  max_position=int(self.max_position))
         booster = train(bp, x, y, weight=weight, group=group_ids,
-                        valid_sets=valid)
+                        valid_sets=valid, init_model=init_booster,
+                        **self._delegate_train_kwargs(batch_index))
         return self._make_model(LightGBMRankerModel, booster)
 
 

@@ -462,3 +462,122 @@ def test_ndcg_skewed_groups_fallback():
     got = _ndcg_score(scores, labels, gid, 10)
     want = _ndcg_score_loop(scores, labels, gid, 10)
     assert got == pytest.approx(want, rel=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# batch training + delegate hooks (ref: LightGBMBase.scala train:46-61,
+# LightGBMDelegate.scala:12-62)
+# ---------------------------------------------------------------------------
+
+def _batch_table(n=400, d=5, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return Table({"features": x, "label": y})
+
+
+def test_num_batches_threads_booster():
+    from synapseml_tpu.gbdt import LightGBMClassifier, LightGBMDelegate
+
+    calls = []
+
+    class Spy(LightGBMDelegate):
+        def before_train_batch(self, bi, table, prev_model):
+            calls.append(("before", bi, prev_model is not None))
+
+        def after_train_batch(self, bi, table, model):
+            calls.append(("after", bi, model.booster.num_trees))
+
+    t = _batch_table()
+    est = LightGBMClassifier(num_iterations=8, num_leaves=7,
+                             num_batches=2, delegate=Spy())
+    model = est.fit(t)
+    # batch 2 continues from batch 1's booster: 8 + 8 trees total
+    assert model.booster.num_trees == 16
+    assert calls[0] == ("before", 0, False)
+    assert calls[1][0] == "after" and calls[1][1] == 0
+    assert calls[2] == ("before", 1, True)
+    assert calls[3][2] == 16
+    # the combined model still separates the classes
+    probs = np.asarray(model.transform(t)["probability"])[:, 1]
+    y = np.asarray(t["label"])
+    assert probs[y == 1].mean() > probs[y == 0].mean() + 0.2
+
+
+def test_delegate_constant_lr_schedule_matches_static():
+    """A delegate returning a constant rate must train the same model as
+    the plain learning_rate param (schedule rides as data)."""
+    from synapseml_tpu.gbdt import LightGBMRegressor, LightGBMDelegate
+
+    class ConstLR(LightGBMDelegate):
+        def get_learning_rate(self, bi, it, prev):
+            return 0.05
+
+    t = _batch_table(seed=3)
+    t = Table({"features": t["features"],
+               "label": np.asarray(t["features"])[:, 0].astype(np.float64)})
+    base = LightGBMRegressor(num_iterations=10, num_leaves=7,
+                             learning_rate=0.05).fit(t)
+    sched = LightGBMRegressor(num_iterations=10, num_leaves=7,
+                              learning_rate=0.9,  # overridden by delegate
+                              delegate=ConstLR()).fit(t)
+    np.testing.assert_allclose(
+        np.asarray(sched.transform(t)["prediction"]),
+        np.asarray(base.transform(t)["prediction"]), rtol=1e-5)
+
+
+def test_delegate_decaying_lr_and_iteration_hook():
+    from synapseml_tpu.gbdt import LightGBMRegressor, LightGBMDelegate
+
+    iters_seen = []
+
+    class Decay(LightGBMDelegate):
+        def get_learning_rate(self, bi, it, prev):
+            return 0.2 / (1 + it)
+
+        def after_train_iteration(self, bi, iters_done):
+            iters_seen.append(iters_done)
+
+    t = _batch_table(seed=5)
+    model = LightGBMRegressor(num_iterations=6, num_leaves=7,
+                              delegate=Decay()).fit(t)
+    assert model.booster.num_trees == 6
+    # hook fired with monotonically increasing completed-iteration counts,
+    # ending at the full run
+    assert iters_seen and iters_seen[-1] == 6
+    assert all(b > a for a, b in zip(iters_seen, iters_seen[1:]))
+
+
+def test_iteration_hook_on_early_stop_and_unpicklable_delegate(tmp_path):
+    """The hook must report kept iterations even when early stopping cuts
+    the run, and a locally-defined (unpicklable) delegate must never leak
+    into the saved model artifact."""
+    from synapseml_tpu.core.pipeline import PipelineStage
+    from synapseml_tpu.gbdt import LightGBMRegressor, LightGBMDelegate
+
+    seen = []
+
+    class LocalSpy(LightGBMDelegate):  # local class: pickle would fail
+        def after_train_iteration(self, bi, iters):
+            seen.append(iters)
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    y = rng.normal(size=300)  # pure noise -> valid metric plateaus fast
+    val = np.zeros(300, bool)
+    val[200:] = True
+    t = Table({"features": x, "label": y, "val": val})
+    est = LightGBMRegressor(num_iterations=60, num_leaves=7,
+                            early_stopping_round=3,
+                            validation_indicator_col="val",
+                            delegate=LocalSpy())
+    model = est.fit(t)
+    kept = model.booster.num_trees
+    assert seen and seen[-1] == kept
+
+    p = str(tmp_path / "m")
+    model.save(p)  # would raise if the delegate were copied to the model
+    m2 = PipelineStage.load(p)
+    np.testing.assert_allclose(
+        np.asarray(m2.transform(t)["prediction"]),
+        np.asarray(model.transform(t)["prediction"]), rtol=1e-6)
