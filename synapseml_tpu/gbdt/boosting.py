@@ -493,7 +493,9 @@ def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
     while done_iters < total_iters and stop_steps is None:
         steps = jnp.arange(done_iters * k, (done_iters + chunk) * k)
         carry, ys = run(carry, steps, done_iters)
-        tree_chunks.append(jax.tree_util.tree_map(np.asarray, ys[0]))
+        # one batched device->host fetch: per-leaf np.asarray pays a full
+        # tunnel round trip per array (~8x latency on remote chips)
+        tree_chunks.append(jax.device_get(ys[0]))
         n_it = min(chunk, total_iters - done_iters)
         if track_dev:
             per_iter = np.asarray(ys[1])[k - 1::k][:n_it]
@@ -1504,7 +1506,7 @@ def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
                 weights[d] *= factor
         else:
             new_w = p.learning_rate
-        trees.append(jax.tree_util.tree_map(np.asarray, tree))
+        trees.append(jax.device_get(tree))  # batched fetch, one round trip
         preds.append(pred)
         weights.append(float(new_w))
 
