@@ -1,0 +1,40 @@
+#' AnalyzeReceipts
+#'
+#' (ref: FormRecognizer.scala AnalyzeReceipts:203).
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param image_bytes raw document bytes
+#' @param image_url document URL
+#' @param include_text_details include text lines in result
+#' @param locale document locale, e.g. en-US
+#' @param max_polling_retries number of times to poll
+#' @param output_col parsed output column
+#' @param pages page selection, e.g. '1-3,5'
+#' @param polling_delay_ms ms between polls
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_analyze_receipts <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", image_bytes = NULL, image_url = NULL, include_text_details = NULL, locale = NULL, max_polling_retries = 1000, output_col = "out", pages = NULL, polling_delay_ms = 300, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.form")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    image_bytes = image_bytes,
+    image_url = image_url,
+    include_text_details = include_text_details,
+    locale = locale,
+    max_polling_retries = max_polling_retries,
+    output_col = output_col,
+    pages = pages,
+    polling_delay_ms = polling_delay_ms,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$AnalyzeReceipts, kwargs)
+}

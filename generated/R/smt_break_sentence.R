@@ -1,0 +1,30 @@
+#' BreakSentence
+#'
+#' Sentence boundary detection (ref: TextTranslator.scala
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param language language hint
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param text text to split
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_break_sentence <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", language = NULL, output_col = "out", subscription_key = NULL, text = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    language = language,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    text = text,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$BreakSentence, kwargs)
+}

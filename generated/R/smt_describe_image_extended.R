@@ -1,0 +1,32 @@
+#' DescribeImageExtended
+#'
+#' DescribeImage with maxCandidates (ref: ComputerVision.scala
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param image_bytes raw image bytes
+#' @param image_url image URL
+#' @param max_candidates caption candidates
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_describe_image_extended <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", image_bytes = NULL, image_url = NULL, max_candidates = 1, output_col = "out", subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    image_bytes = image_bytes,
+    image_url = image_url,
+    max_candidates = max_candidates,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$DescribeImageExtended, kwargs)
+}

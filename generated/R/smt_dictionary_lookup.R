@@ -1,0 +1,32 @@
+#' DictionaryLookup
+#'
+#' Alternative translations for a word (ref: TextTranslator.scala
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param from_language source language
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param text word to look up
+#' @param timeout per-request timeout seconds
+#' @param to_language target language
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_dictionary_lookup <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", from_language = NULL, output_col = "out", subscription_key = NULL, text = NULL, timeout = 60.0, to_language = NULL, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    from_language = from_language,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    text = text,
+    timeout = timeout,
+    to_language = to_language,
+    url = url
+  ))
+  do.call(mod$DictionaryLookup, kwargs)
+}

@@ -1,0 +1,36 @@
+#' DocumentTranslator
+#'
+#' Batch blob-to-blob document translation: POST the batches request,
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param max_polling_retries number of times to poll
+#' @param output_col parsed output column
+#' @param polling_delay_ms ms between polls
+#' @param source_url source container URL
+#' @param subscription_key API key (value or column)
+#' @param target_language target language
+#' @param target_url target container URL
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_document_translator <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", max_polling_retries = 1000, output_col = "out", polling_delay_ms = 300, source_url = NULL, subscription_key = NULL, target_language = NULL, target_url = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    max_polling_retries = max_polling_retries,
+    output_col = output_col,
+    polling_delay_ms = polling_delay_ms,
+    source_url = source_url,
+    subscription_key = subscription_key,
+    target_language = target_language,
+    target_url = target_url,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$DocumentTranslator, kwargs)
+}

@@ -1,0 +1,38 @@
+#' FindSimilarFace
+#'
+#' Similar-face search against a face list / large face list / raw
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param face_id query faceId from DetectFace
+#' @param face_ids candidate faceId array (max 1000)
+#' @param face_list_id faceListId to search
+#' @param large_face_list_id largeFaceListId to search
+#' @param max_num_of_candidates_returned top candidates (1-1000)
+#' @param mode matchPerson or matchFace
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_find_similar_face <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", face_id = NULL, face_ids = NULL, face_list_id = NULL, large_face_list_id = NULL, max_num_of_candidates_returned = NULL, mode = NULL, output_col = "out", subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.face")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    face_id = face_id,
+    face_ids = face_ids,
+    face_list_id = face_list_id,
+    large_face_list_id = large_face_list_id,
+    max_num_of_candidates_returned = max_num_of_candidates_returned,
+    mode = mode,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$FindSimilarFace, kwargs)
+}

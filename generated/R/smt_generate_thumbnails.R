@@ -1,0 +1,36 @@
+#' GenerateThumbnails
+#'
+#' Returns raw thumbnail bytes, not JSON
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param height thumbnail height
+#' @param image_bytes raw image bytes
+#' @param image_url image URL
+#' @param output_col parsed output column
+#' @param smart_cropping smart cropping
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @param width thumbnail width
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_generate_thumbnails <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", height = 64, image_bytes = NULL, image_url = NULL, output_col = "out", smart_cropping = TRUE, subscription_key = NULL, timeout = 60.0, url = NULL, width = 64) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    height = height,
+    image_bytes = image_bytes,
+    image_url = image_url,
+    output_col = output_col,
+    smart_cropping = smart_cropping,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url,
+    width = width
+  ))
+  do.call(mod$GenerateThumbnails, kwargs)
+}

@@ -1,0 +1,30 @@
+#' GetCustomModel
+#'
+#' GET one custom model's info (ref: FormRecognizer.scala
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param include_keys include extracted keys
+#' @param model_id custom model id
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_get_custom_model <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", include_keys = NULL, model_id = NULL, output_col = "out", subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.form")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    include_keys = include_keys,
+    model_id = model_id,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$GetCustomModel, kwargs)
+}

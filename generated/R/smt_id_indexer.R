@@ -1,0 +1,20 @@
+#' IdIndexer
+#'
+#' Learns consecutive 1-based ids over distinct (partition, value)
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @param reset_per_partition restart ids at 1 within each partition
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_id_indexer <- function(input_col = "input", output_col = "output", partition_key = NULL, reset_per_partition = TRUE) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    partition_key = partition_key,
+    reset_per_partition = reset_per_partition
+  ))
+  do.call(mod$IdIndexer, kwargs)
+}

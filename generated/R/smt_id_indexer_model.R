@@ -1,0 +1,20 @@
+#' IdIndexerModel
+#'
+#' Maps (partition, value) to a learned 1-based id; unseen values map
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @param vocab {(partition, value): id} learned at fit
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_id_indexer_model <- function(input_col = "input", output_col = "output", partition_key = NULL, vocab = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    partition_key = partition_key,
+    vocab = vocab
+  ))
+  do.call(mod$IdIndexerModel, kwargs)
+}

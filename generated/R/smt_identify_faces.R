@@ -1,0 +1,36 @@
+#' IdentifyFaces
+#'
+#' 1-to-many identification against a person group
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param confidence_threshold custom identification threshold
+#' @param error_col error column
+#' @param face_ids query faceIds (1-10)
+#' @param large_person_group_id largePersonGroupId to search
+#' @param max_num_of_candidates_returned top candidates (1-5)
+#' @param output_col parsed output column
+#' @param person_group_id personGroupId to search
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_identify_faces <- function(backoffs = c(100, 500, 1000), concurrency = 4, confidence_threshold = NULL, error_col = "errors", face_ids = NULL, large_person_group_id = NULL, max_num_of_candidates_returned = NULL, output_col = "out", person_group_id = NULL, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.face")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    confidence_threshold = confidence_threshold,
+    error_col = error_col,
+    face_ids = face_ids,
+    large_person_group_id = large_person_group_id,
+    max_num_of_candidates_returned = max_num_of_candidates_returned,
+    output_col = output_col,
+    person_group_id = person_group_id,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$IdentifyFaces, kwargs)
+}

@@ -6,6 +6,7 @@
 #' @param bagging_freq bagging frequency
 #' @param boosting_type gbdt|rf|dart|goss
 #' @param categorical_slot_indexes categorical feature slots
+#' @param delegate optional LightGBMDelegate with batch/iteration/LR hooks
 #' @param early_stopping_round early stopping patience
 #' @param feature_cols explicit list of scalar feature columns
 #' @param feature_fraction feature subsample per tree
@@ -20,6 +21,7 @@
 #' @param min_data_in_leaf min rows per leaf
 #' @param min_gain_to_split min split gain
 #' @param min_sum_hessian_in_leaf min hessian per leaf
+#' @param num_batches split training into N sequential batches, threading the booster from each into the next (ref: LightGBMBase.scala train:46-61)
 #' @param num_iterations boosting rounds
 #' @param num_leaves max leaves per tree
 #' @param objective binary|multiclass
@@ -35,13 +37,14 @@
 #' @param weight_col sample weight column
 #' @return a synapseml_tpu estimator handle
 #' @export
-smt_light_gbm_classifier <- function(bagging_fraction = 1.0, bagging_freq = 0, boosting_type = "gbdt", categorical_slot_indexes = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_iterations = 100, num_leaves = 31, objective = "binary", other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", probability_col = "probability", raw_prediction_col = "rawPrediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
+smt_light_gbm_classifier <- function(bagging_fraction = 1.0, bagging_freq = 0, boosting_type = "gbdt", categorical_slot_indexes = NULL, delegate = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_batches = 0, num_iterations = 100, num_leaves = 31, objective = "binary", other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", probability_col = "probability", raw_prediction_col = "rawPrediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.gbdt.estimators")
   kwargs <- Filter(Negate(is.null), list(
     bagging_fraction = bagging_fraction,
     bagging_freq = bagging_freq,
     boosting_type = boosting_type,
     categorical_slot_indexes = categorical_slot_indexes,
+    delegate = delegate,
     early_stopping_round = early_stopping_round,
     feature_cols = feature_cols,
     feature_fraction = feature_fraction,
@@ -56,6 +59,7 @@ smt_light_gbm_classifier <- function(bagging_fraction = 1.0, bagging_freq = 0, b
     min_data_in_leaf = min_data_in_leaf,
     min_gain_to_split = min_gain_to_split,
     min_sum_hessian_in_leaf = min_sum_hessian_in_leaf,
+    num_batches = num_batches,
     num_iterations = num_iterations,
     num_leaves = num_leaves,
     objective = objective,

@@ -1,0 +1,22 @@
+#' LinearScalarScaler
+#'
+#' (ref: scalers.py LinearScalarScaler:289-325).
+#'
+#' @param input_col name of the input column
+#' @param max_required_value output range upper bound
+#' @param min_required_value output range lower bound
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_linear_scalar_scaler <- function(input_col = "input", max_required_value = 1.0, min_required_value = 0.0, output_col = "output", partition_key = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    max_required_value = max_required_value,
+    min_required_value = min_required_value,
+    output_col = output_col,
+    partition_key = partition_key
+  ))
+  do.call(mod$LinearScalarScaler, kwargs)
+}

@@ -1,0 +1,24 @@
+#' LinearScalarScalerModel
+#'
+#' Affine map of the group's [min,max] onto [min_required,
+#'
+#' @param input_col name of the input column
+#' @param max_required_value output range upper bound
+#' @param min_required_value output range lower bound
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @param per_group_stats {partition: {stat: value}}
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_linear_scalar_scaler_model <- function(input_col = "input", max_required_value = 1.0, min_required_value = 0.0, output_col = "output", partition_key = NULL, per_group_stats = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    max_required_value = max_required_value,
+    min_required_value = min_required_value,
+    output_col = output_col,
+    partition_key = partition_key,
+    per_group_stats = per_group_stats
+  ))
+  do.call(mod$LinearScalarScalerModel, kwargs)
+}

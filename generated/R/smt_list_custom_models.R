@@ -1,0 +1,28 @@
+#' ListCustomModels
+#'
+#' GET the account's custom models (ref: FormRecognizer.scala
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param op summary or full
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_list_custom_models <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", op = NULL, output_col = "out", subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.form")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    op = op,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$ListCustomModels, kwargs)
+}

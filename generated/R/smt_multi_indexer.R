@@ -1,0 +1,14 @@
+#' MultiIndexer
+#'
+#' Fits a set of IdIndexers on one pass of fit() calls
+#'
+#' @param indexers list of IdIndexer estimators
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_multi_indexer <- function(indexers = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    indexers = indexers
+  ))
+  do.call(mod$MultiIndexer, kwargs)
+}

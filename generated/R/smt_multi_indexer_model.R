@@ -1,0 +1,14 @@
+#' MultiIndexerModel
+#'
+#' Applies several IdIndexerModels in sequence
+#'
+#' @param models list of fitted IdIndexerModels
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_multi_indexer_model <- function(models = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    models = models
+  ))
+  do.call(mod$MultiIndexerModel, kwargs)
+}

@@ -1,0 +1,18 @@
+#' PerPartitionScalarScalerEstimator
+#'
+#' (ref: scalers.py PerPartitionScalarScalerEstimator:88-124).
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_per_partition_scalar_scaler_estimator <- function(input_col = "input", output_col = "output", partition_key = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    partition_key = partition_key
+  ))
+  do.call(mod$PerPartitionScalarScalerEstimator, kwargs)
+}

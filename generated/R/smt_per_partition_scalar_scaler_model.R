@@ -1,0 +1,20 @@
+#' PerPartitionScalarScalerModel
+#'
+#' Shared plumbing: look up this row's group stats, apply the
+#'
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @param per_group_stats {partition: {stat: value}}
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_per_partition_scalar_scaler_model <- function(input_col = "input", output_col = "output", partition_key = NULL, per_group_stats = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    input_col = input_col,
+    output_col = output_col,
+    partition_key = partition_key,
+    per_group_stats = per_group_stats
+  ))
+  do.call(mod$PerPartitionScalarScalerModel, kwargs)
+}

@@ -1,0 +1,36 @@
+#' ReadImage
+#'
+#' The Read API (successor of OCR/recognizeText)
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param image_bytes raw image bytes
+#' @param image_url image URL
+#' @param language read language hint
+#' @param max_polling_retries number of times to poll
+#' @param output_col parsed output column
+#' @param polling_delay_ms ms between polls
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_read_image <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", image_bytes = NULL, image_url = NULL, language = NULL, max_polling_retries = 1000, output_col = "out", polling_delay_ms = 300, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    image_bytes = image_bytes,
+    image_url = image_url,
+    language = language,
+    max_polling_retries = max_polling_retries,
+    output_col = output_col,
+    polling_delay_ms = polling_delay_ms,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$ReadImage, kwargs)
+}

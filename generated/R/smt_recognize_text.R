@@ -1,0 +1,36 @@
+#' RecognizeText
+#'
+#' Printed/handwritten text via the async recognizeText API
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param image_bytes raw image bytes
+#' @param image_url image URL
+#' @param max_polling_retries number of times to poll
+#' @param mode Printed or Handwritten
+#' @param output_col parsed output column
+#' @param polling_delay_ms ms between polls
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_recognize_text <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", image_bytes = NULL, image_url = NULL, max_polling_retries = 1000, mode = "Printed", output_col = "out", polling_delay_ms = 300, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    image_bytes = image_bytes,
+    image_url = image_url,
+    max_polling_retries = max_polling_retries,
+    mode = mode,
+    output_col = output_col,
+    polling_delay_ms = polling_delay_ms,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$RecognizeText, kwargs)
+}

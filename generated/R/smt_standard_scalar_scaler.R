@@ -1,0 +1,20 @@
+#' StandardScalarScaler
+#'
+#' (ref: scalers.py StandardScalarScaler:189-224 — mean + stddev_pop
+#'
+#' @param coefficient_factor post-scale multiplier
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @return a synapseml_tpu estimator handle
+#' @export
+smt_standard_scalar_scaler <- function(coefficient_factor = 1.0, input_col = "input", output_col = "output", partition_key = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    coefficient_factor = coefficient_factor,
+    input_col = input_col,
+    output_col = output_col,
+    partition_key = partition_key
+  ))
+  do.call(mod$StandardScalarScaler, kwargs)
+}

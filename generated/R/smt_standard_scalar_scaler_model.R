@@ -1,0 +1,22 @@
+#' StandardScalarScalerModel
+#'
+#' coef * (x - mean) / std per group; std == 0 falls back to plain
+#'
+#' @param coefficient_factor post-scale multiplier
+#' @param input_col name of the input column
+#' @param output_col name of the output column
+#' @param partition_key tenant column (None = single tenant)
+#' @param per_group_stats {partition: {stat: value}}
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_standard_scalar_scaler_model <- function(coefficient_factor = 1.0, input_col = "input", output_col = "output", partition_key = NULL, per_group_stats = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cyber.feature")
+  kwargs <- Filter(Negate(is.null), list(
+    coefficient_factor = coefficient_factor,
+    input_col = input_col,
+    output_col = output_col,
+    partition_key = partition_key,
+    per_group_stats = per_group_stats
+  ))
+  do.call(mod$StandardScalarScalerModel, kwargs)
+}

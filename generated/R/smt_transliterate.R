@@ -1,0 +1,34 @@
+#' Transliterate
+#'
+#' Script conversion (ref: TextTranslator.scala Transliterate:283 —
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param from_script source script
+#' @param language language of the text
+#' @param output_col parsed output column
+#' @param subscription_key API key (value or column)
+#' @param text text to transliterate
+#' @param timeout per-request timeout seconds
+#' @param to_script target script
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_transliterate <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", from_script = NULL, language = NULL, output_col = "out", subscription_key = NULL, text = NULL, timeout = 60.0, to_script = NULL, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.services")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    from_script = from_script,
+    language = language,
+    output_col = output_col,
+    subscription_key = subscription_key,
+    text = text,
+    timeout = timeout,
+    to_script = to_script,
+    url = url
+  ))
+  do.call(mod$Transliterate, kwargs)
+}

@@ -1,0 +1,38 @@
+#' VerifyFaces
+#'
+#' Face-to-face or face-to-person verification
+#'
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param error_col error column
+#' @param face_id faceId for face-to-person
+#' @param face_id1 first faceId
+#' @param face_id2 second faceId
+#' @param large_person_group_id largePersonGroupId of the person
+#' @param output_col parsed output column
+#' @param person_group_id personGroupId of the person
+#' @param person_id personId to verify against
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_verify_faces <- function(backoffs = c(100, 500, 1000), concurrency = 4, error_col = "errors", face_id = NULL, face_id1 = NULL, face_id2 = NULL, large_person_group_id = NULL, output_col = "out", person_group_id = NULL, person_id = NULL, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.face")
+  kwargs <- Filter(Negate(is.null), list(
+    backoffs = backoffs,
+    concurrency = concurrency,
+    error_col = error_col,
+    face_id = face_id,
+    face_id1 = face_id1,
+    face_id2 = face_id2,
+    large_person_group_id = large_person_group_id,
+    output_col = output_col,
+    person_group_id = person_group_id,
+    person_id = person_id,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$VerifyFaces, kwargs)
+}

@@ -494,13 +494,16 @@ def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
         steps = jnp.arange(done_iters * k, (done_iters + chunk) * k)
         carry, ys = run(carry, steps, done_iters)
         # one batched device->host fetch: per-leaf np.asarray pays a full
-        # tunnel round trip per array (~8x latency on remote chips)
-        tree_chunks.append(jax.device_get(ys[0]))
+        # tunnel round trip per array (~8x latency on remote chips); the
+        # metric snapshot rides the same fetch when tracking is on
+        fetched = jax.device_get(ys if (track_dev or track_rank)
+                                 else ys[:1])
+        tree_chunks.append(fetched[0])
         n_it = min(chunk, total_iters - done_iters)
         if track_dev:
-            per_iter = np.asarray(ys[1])[k - 1::k][:n_it]
+            per_iter = fetched[1][k - 1::k][:n_it]
         elif track_rank:
-            vsnap = np.asarray(ys[1])  # [chunk, Nv]; k == 1 for ranking
+            vsnap = fetched[1]  # [chunk, Nv]; k == 1 for ranking
             per_iter = [
                 _ndcg_score(vsnap[i], vy_h, vg_h, p.max_position,
                             blocks=tracker.ndcg_blocks)

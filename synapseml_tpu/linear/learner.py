@@ -198,17 +198,17 @@ def train(p: VWParams, idx: np.ndarray, val: np.ndarray, y: np.ndarray,
                 pad = bs - len(sl)
                 sl = np.concatenate([sl, np.zeros(pad, sl.dtype)])
                 bw = np.concatenate([bw, np.zeros(pad, np.float32)])
+            # one batched host->device put per step, not four round trips
+            bidx, bval, by, bwd = jax.device_put(
+                (idx[sl], val[sl], y[sl], bw))
             if mesh is not None:
-                state, loss = step_fn(state, jnp.asarray(idx[sl]),
-                                      jnp.asarray(val[sl]), jnp.asarray(y[sl]),
-                                      jnp.asarray(bw), p)
+                state, loss = step_fn(state, bidx, bval, by, bwd, p)
                 loss = jnp.mean(loss)
             else:
-                state, loss = train_step(state, jnp.asarray(idx[sl]),
-                                         jnp.asarray(val[sl]),
-                                         jnp.asarray(y[sl]),
-                                         jnp.asarray(bw), p)
-            losses.append(float(loss))
+                state, loss = train_step(state, bidx, bval, by, bwd, p)
+            # keep the scalar on device: float(loss) here would block the
+            # dispatch pipeline with one host round trip per step
+            losses.append(loss)
     if not losses:
         raise RuntimeError("no optimizer step executed (empty input)")
-    return state, losses
+    return state, [float(l) for l in jax.device_get(losses)]

@@ -111,9 +111,10 @@ class BatchedExecutor:
             padded.append(
                 jax.device_put(a, self._device) if self._device else a)
         out = self._jit(*self._bound, *padded)
-        leaves = jax.tree_util.tree_leaves(out)
-        host = [np.asarray(l)[:n] for l in leaves]
-        return tuple(host)
+        # one batched device->host fetch — per-leaf np.asarray pays a
+        # transfer round trip per output on remote chips
+        leaves = jax.device_get(jax.tree_util.tree_leaves(out))
+        return tuple(l[:n] for l in leaves)
 
 
 class JitCache:
