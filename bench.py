@@ -95,6 +95,19 @@ def bench_gbdt_train():
     return n * 100 / best
 
 
+def bench_serving_latency():
+    """p50 request->pipeline->reply latency through the serving layer
+    (ContinuousServer + parse/make_reply), echo pipeline — isolates the
+    framework's own serving overhead, the reference's "sub-millisecond"
+    continuous-mode claim (README.md:22, docs/mmlspark-serving.md:142).
+    Model scoring cost is excluded: on this driver the chip sits behind
+    a network tunnel, which no co-located deployment would pay."""
+    from synapseml_tpu.utils.profiling import serving_echo_latency
+
+    lat = serving_echo_latency(samples=300, warmup=50, name="bench")
+    return lat[len(lat) // 2] * 1e3  # p50 ms
+
+
 def _with_retries(fn, attempts=3):
     """The tunneled device occasionally drops remote_compile connections;
     a transient failure must not zero out the recorded benchmark."""
@@ -112,8 +125,10 @@ def _with_retries(fn, attempts=3):
 def main():
     img_s, host_img_s = _with_retries(bench_onnx_resnet50)
     rows_s = _with_retries(bench_gbdt_train)
+    serving_p50_ms = _with_retries(bench_serving_latency)
     gpu_img_baseline = 1000.0
     gpu_rows_baseline = 1.0e6
+    serving_baseline_ms = 1.0  # the reference's "sub-millisecond" claim
     print(json.dumps({
         "metric": "onnx_resnet50_images_per_sec_per_chip",
         "value": round(img_s, 2),
@@ -129,6 +144,12 @@ def main():
             "value": round(host_img_s, 2),
             "unit": "images/sec",
             "vs_baseline": round(host_img_s / gpu_img_baseline, 3),
+        }, {
+            "metric": "serving_roundtrip_p50_ms",
+            "value": round(serving_p50_ms, 3),
+            "unit": "ms",
+            # higher = better for vs_baseline: baseline_ms / measured_ms
+            "vs_baseline": round(serving_baseline_ms / serving_p50_ms, 3),
         }],
     }))
 

@@ -94,6 +94,12 @@ class WorkerServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # without these, headers and body leave as separate unbuffered
+            # TCP segments and Nagle + delayed-ACK stalls every keep-alive
+            # request ~40ms; buffered writes + TCP_NODELAY keep the reply
+            # to one immediate segment (sub-millisecond round trips)
+            disable_nagle_algorithm = True
+            wbufsize = 64 * 1024
 
             def log_message(self, *a):  # quiet
                 pass

@@ -140,3 +140,49 @@ def stage_stats(pipeline_stages, table: Table,
         "rows_in": np.array(rows_in, np.int64),
     })
     return current, stats
+
+
+def serving_echo_latency(samples: int = 300, warmup: int = 50,
+                         name: str = "latency_probe") -> List[float]:
+    """Sorted request->pipeline->reply latencies (seconds) through a
+    ContinuousServer echo pipeline over one keep-alive connection.
+
+    Shared by bench.py's ``serving_roundtrip_p50_ms`` metric and the
+    serving regression test; raises if any reply is non-200 so a broken
+    pipeline can never masquerade as a fast one.
+    """
+    import http.client
+    import json
+    import time as _time
+
+    from synapseml_tpu.io.serving import ContinuousServer, make_reply
+
+    def pipeline(table):
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply({"echo": v})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer(name, pipeline, max_batch=8).start()
+    try:
+        conn = http.client.HTTPConnection(
+            cs.url.split("//")[1].rstrip("/"), timeout=10)
+        body = json.dumps({"x": 1}).encode()
+
+        def once():
+            start = _time.perf_counter()
+            conn.request("POST", "/", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"echo pipeline replied {resp.status}; latency sample "
+                    f"would be meaningless")
+            return _time.perf_counter() - start
+
+        for _ in range(warmup):
+            once()
+        return sorted(once() for _ in range(samples))
+    finally:
+        cs.stop()
