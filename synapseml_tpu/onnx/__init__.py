@@ -5,10 +5,11 @@ TPU-native replacement of the reference's onnxruntime-backed ONNXModel
 """
 from synapseml_tpu.onnx.builder import GraphBuilder
 from synapseml_tpu.onnx.importer import ImportedGraph, import_model, supported_ops
+from synapseml_tpu.onnx.convert import convert_lightgbm
 from synapseml_tpu.onnx.model import ONNXModel
 from synapseml_tpu.onnx import proto, zoo
 
 __all__ = [
-    "GraphBuilder", "ImportedGraph", "ONNXModel", "import_model",
-    "supported_ops", "proto", "zoo",
+    "GraphBuilder", "ImportedGraph", "ONNXModel", "convert_lightgbm",
+    "import_model", "supported_ops", "proto", "zoo",
 ]

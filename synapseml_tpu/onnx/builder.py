@@ -54,6 +54,7 @@ class GraphBuilder:
         self._initializers: List[Msg] = []
         self._inputs: List[Msg] = []
         self._outputs: List[Msg] = []
+        self._domains: set = set()
         self._counter = 0
 
     def fresh(self, prefix: str = "t") -> str:
@@ -74,8 +75,11 @@ class GraphBuilder:
 
     def add_node(self, op_type: str, inputs: Sequence[str],
                  outputs: Optional[Sequence[str]] = None,
-                 name: Optional[str] = None, **attrs) -> Union[str, List[str]]:
-        """Append a node; returns its (single) output name or list of names."""
+                 name: Optional[str] = None, domain: str = "",
+                 **attrs) -> Union[str, List[str]]:
+        """Append a node; returns its (single) output name or list of names.
+        ``domain="ai.onnx.ml"`` marks classical-ML ops; the matching
+        opset_import entry is added at build()."""
         if outputs is None:
             outputs = [self.fresh(op_type.lower())]
         node = Msg("NodeProto")
@@ -83,6 +87,9 @@ class GraphBuilder:
         node.output = list(outputs)
         node.op_type = op_type
         node.name = name or self.fresh(f"n_{op_type.lower()}")
+        if domain:
+            node.domain = domain
+            self._domains.add(domain)
         node.attribute = [make_attr(k, v) for k, v in attrs.items()
                           if v is not None]
         self._nodes.append(node)
@@ -133,6 +140,11 @@ class GraphBuilder:
         osi.domain = ""
         osi.version = self.opset
         m.opset_import = [osi]
+        for dom in sorted(getattr(self, "_domains", ())):
+            extra = Msg("OperatorSetIdProto")
+            extra.domain = dom
+            extra.version = 3 if dom == "ai.onnx.ml" else 1
+            m.opset_import.append(extra)
         m.graph = g
         return m
 

@@ -1259,3 +1259,8 @@ def import_model(path_or_bytes) -> ImportedGraph:
 
 def supported_ops() -> List[str]:
     return sorted(_REGISTRY)
+
+
+# ai.onnx.ml domain ops register themselves on import (bottom import keeps
+# the circular edge harmless: everything ml_ops needs is defined above)
+from synapseml_tpu.onnx import ml_ops  # noqa: E402,F401

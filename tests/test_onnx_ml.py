@@ -1,0 +1,254 @@
+"""ai.onnx.ml domain: tree ensembles + classical-ML ops, and the
+reference's flagship ONNX workload end-to-end (LightGBM -> ONNX ->
+ONNXModel, ref: notebooks/ONNX - Inference on Spark.ipynb).
+"""
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.gbdt.estimators import (LightGBMClassifier,
+                                           LightGBMRegressor)
+from synapseml_tpu.onnx import (GraphBuilder, ONNXModel, convert_lightgbm,
+                                import_model)
+
+
+def _binary_data(n=500, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(
+        np.float64)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# the notebook path: train -> convert -> import -> score
+# ---------------------------------------------------------------------------
+
+def test_lightgbm_binary_to_onnx_matches_booster():
+    x, y = _binary_data()
+    model = LightGBMClassifier(num_iterations=20, num_leaves=15).fit(
+        Table({"features": x, "label": y}))
+    blob = convert_lightgbm(model)
+    g = import_model(blob)
+    label, probs = g.apply(g.params, x)
+    want = model.booster.predict(x)
+    np.testing.assert_allclose(np.asarray(probs)[:, 1], want, atol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(label), (want > 0.5).astype(np.int64))
+
+
+def test_lightgbm_multiclass_to_onnx_matches_booster():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(400, 5)).astype(np.float32)
+    y = np.argmax(x[:, :3] + 0.1 * rng.normal(size=(400, 3)), axis=1).astype(
+        np.float64)
+    model = LightGBMClassifier(num_iterations=12, num_leaves=7,
+                               objective="multiclass").fit(
+        Table({"features": x, "label": y}))
+    blob = convert_lightgbm(model)
+    g = import_model(blob)
+    label, probs = g.apply(g.params, x)
+    want = model.booster.predict(x)
+    np.testing.assert_allclose(np.asarray(probs), want, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(label), want.argmax(-1))
+
+
+def test_lightgbm_regressor_to_onnx_matches_booster():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (x[:, 0] * 2 - x[:, 2]).astype(np.float64)
+    model = LightGBMRegressor(num_iterations=15, num_leaves=15).fit(
+        Table({"features": x, "label": y}))
+    blob = convert_lightgbm(model)
+    g = import_model(blob)
+    (pred,) = g.apply(g.params, x)
+    np.testing.assert_allclose(np.asarray(pred)[:, 0],
+                               model.booster.predict(x), atol=1e-4)
+
+
+def test_goss_and_nan_features_roundtrip():
+    """GOSS boosting + missing values: NaN takes the false branch in both
+    engines (grower.predict_tree NaN-comparisons-False convention)."""
+    x, y = _binary_data(seed=7)
+    x[::17, 2] = np.nan
+    model = LightGBMClassifier(num_iterations=15, num_leaves=7,
+                               boosting_type="goss").fit(
+        Table({"features": x, "label": y}))
+    blob = convert_lightgbm(model)
+    g = import_model(blob)
+    _, probs = g.apply(g.params, x)
+    np.testing.assert_allclose(np.asarray(probs)[:, 1],
+                               model.booster.predict(x), atol=1e-5)
+
+
+def test_onnx_model_transformer_notebook_flow():
+    """The full ONNXModel path with feed/fetch-style columns
+    (ref notebook: setFeedDict input->features, fetch probabilities)."""
+    x, y = _binary_data(seed=11)
+    est = LightGBMClassifier(num_iterations=10, num_leaves=7)
+    model = est.fit(Table({"features": x, "label": y}))
+    onnx_ml = ONNXModel(model_bytes=convert_lightgbm(model),
+                        feed_dict={"input": "features"},
+                        mini_batch_size=128)
+    out = onnx_ml.transform(Table({"features": x}))
+    probs = np.stack([np.asarray(v) for v in out["probabilities"]]) \
+        if out["probabilities"].dtype == object \
+        else np.asarray(out["probabilities"])
+    np.testing.assert_allclose(probs[:, 1], model.booster.predict(x),
+                               atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# classical-ML op unit tests
+# ---------------------------------------------------------------------------
+
+def _ml_graph(op, in_shape, out_shape, out_dtype=np.float32, n_outputs=1,
+              extra_inputs=(), **attrs):
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, list(in_shape))
+    ins = [x]
+    for nm, arr in extra_inputs:
+        ins.append(g.add_initializer(nm, arr))
+    outs = [f"o{i}" for i in range(n_outputs)]
+    g.add_node(op, ins, outputs=outs, domain="ai.onnx.ml", **attrs)
+    for o in outs:
+        g.add_output(o, out_dtype, list(out_shape))
+    return import_model(g.to_bytes())
+
+
+def test_scaler_normalizer_binarizer_imputer():
+    x = np.array([[1.0, -2.0, np.nan], [4.0, 0.0, 2.0]], np.float32)
+
+    g = _ml_graph("Scaler", ["N", 3], ["N", 3],
+                  offset=[1.0, 0.0, 0.0], scale=[2.0, 1.0, 1.0])
+    np.testing.assert_allclose(
+        np.asarray(g.apply(g.params, np.nan_to_num(x)))[0][0],
+        [(1 - 1) * 2, -2.0, 0.0])
+
+    g = _ml_graph("Imputer", ["N", 3], ["N", 3],
+                  imputed_value_floats=[9.0, 9.0, 9.0])
+    out = np.asarray(g.apply(g.params, x)[0])
+    assert out[0, 2] == 9.0 and out[1, 2] == 2.0
+
+    g = _ml_graph("Binarizer", ["N", 3], ["N", 3], threshold=0.5)
+    np.testing.assert_allclose(
+        np.asarray(g.apply(g.params, np.nan_to_num(x))[0]),
+        [[1, 0, 0], [1, 0, 1]])
+
+    g = _ml_graph("Normalizer", ["N", 3], ["N", 3], norm="L2")
+    out = np.asarray(g.apply(g.params, np.nan_to_num(x))[0])
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+
+def test_linear_classifier_and_regressor():
+    x = np.array([[1.0, 0.0], [0.0, 2.0], [-1.0, -1.0]], np.float32)
+    g = _ml_graph("LinearClassifier", ["N", 2], ["N", 2],
+                  n_outputs=2, out_dtype=np.float32,
+                  coefficients=[1.0, -1.0], intercepts=[0.1],
+                  classlabels_int64s=[0, 1], post_transform="LOGISTIC")
+    label, probs = g.apply(g.params, x)
+    s = x @ np.array([1.0, -1.0], np.float32) + 0.1
+    p = 1 / (1 + np.exp(-s))
+    np.testing.assert_allclose(np.asarray(probs)[:, 1], p, rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(label), (p > 0.5).astype(int))
+
+    g = _ml_graph("LinearRegressor", ["N", 2], ["N", 1],
+                  coefficients=[2.0, 0.5], intercepts=[1.0])
+    out = np.asarray(g.apply(g.params, x)[0])
+    np.testing.assert_allclose(out[:, 0], x @ [2.0, 0.5] + 1.0, rtol=1e-5)
+
+
+def test_array_feature_extractor_and_vectorizer():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    g = _ml_graph("ArrayFeatureExtractor", ["N", 4], ["N", 2],
+                  extra_inputs=[("idx", np.array([2, 0], np.int64))])
+    np.testing.assert_allclose(np.asarray(g.apply(g.params, x)[0]),
+                               x[:, [2, 0]])
+
+    gb = GraphBuilder(opset=17)
+    a = gb.add_input("a", np.float32, ["N", 2])
+    b = gb.add_input("b", np.float32, ["N", 1])
+    out = gb.add_node("FeatureVectorizer", [a, b], domain="ai.onnx.ml",
+                      inputdimensions=[2, 1])
+    gb.add_output(out, np.float32, ["N", 3])
+    g = import_model(gb.to_bytes())
+    av = np.ones((2, 2), np.float32)
+    bv = np.full((2, 1), 5.0, np.float32)
+    np.testing.assert_allclose(np.asarray(g.apply(g.params, av, bv)[0]),
+                               [[1, 1, 5]] * 2)
+
+
+def test_label_encoder_and_onehot():
+    g = _ml_graph("LabelEncoder", ["N"], ["N"], out_dtype=np.int64,
+                  keys_int64s=[10, 20, 30], values_int64s=[0, 1, 2],
+                  default_int64=-1)
+    x = np.array([20, 10, 99], np.int64)
+    np.testing.assert_array_equal(
+        np.asarray(g.apply(g.params, x)[0]), [1, 0, -1])
+
+    g = _ml_graph("OneHotEncoder", ["N"], ["N", 3],
+                  cats_int64s=[3, 5, 7])
+    x = np.array([5, 7, 4], np.int64)
+    np.testing.assert_allclose(
+        np.asarray(g.apply(g.params, x)[0]),
+        [[0, 1, 0], [0, 0, 1], [0, 0, 0]])
+
+
+def test_tree_modes_beyond_leq():
+    """Hand-built ensemble exercising BRANCH_GT and missing-tracks-true."""
+    g = _ml_graph(
+        "TreeEnsembleRegressor", ["N", 1], ["N", 1],
+        nodes_treeids=[0, 0, 0], nodes_nodeids=[0, 1, 2],
+        nodes_featureids=[0, 0, 0], nodes_modes=["BRANCH_GT", "LEAF", "LEAF"],
+        nodes_values=[1.5, 0.0, 0.0],
+        nodes_truenodeids=[1, 1, 2], nodes_falsenodeids=[2, 1, 2],
+        nodes_missing_value_tracks_true=[1, 0, 0],
+        target_treeids=[0, 0], target_nodeids=[1, 2], target_ids=[0, 0],
+        target_weights=[10.0, 20.0], n_targets=1)
+    x = np.array([[2.0], [1.0], [np.nan]], np.float32)
+    out = np.asarray(g.apply(g.params, x)[0])[:, 0]
+    # x>1.5 -> true(10); else false(20); NaN tracks true -> 10
+    np.testing.assert_allclose(out, [10.0, 20.0, 10.0])
+
+
+def test_binary_single_score_on_class_id_one():
+    """Spec-valid binary ensembles may scatter the single score into
+    class_id 1 (review finding: the [:1] slice dropped it)."""
+    g = _ml_graph(
+        "TreeEnsembleClassifier", ["N", 1], ["N", 2], n_outputs=2,
+        nodes_treeids=[0, 0, 0], nodes_nodeids=[0, 1, 2],
+        nodes_featureids=[0, 0, 0],
+        nodes_modes=["BRANCH_LEQ", "LEAF", "LEAF"],
+        nodes_values=[0.0, 0.0, 0.0],
+        nodes_truenodeids=[1, 1, 2], nodes_falsenodeids=[2, 1, 2],
+        class_treeids=[0, 0], class_nodeids=[1, 2], class_ids=[1, 1],
+        class_weights=[-2.0, 2.0], classlabels_int64s=[0, 1],
+        post_transform="LOGISTIC")
+    x = np.array([[-1.0], [1.0]], np.float32)
+    _, probs = g.apply(g.params, x)
+    sig = 1 / (1 + np.exp(-np.array([-2.0, 2.0])))
+    np.testing.assert_allclose(np.asarray(probs)[:, 1], sig, rtol=1e-5)
+
+
+def test_imputer_concrete_replaced_value_leaves_nan():
+    g = _ml_graph("Imputer", ["N", 3], ["N", 3],
+                  imputed_value_floats=[9.0, 9.0, 9.0],
+                  replaced_value_float=-1.0)
+    x = np.array([[np.nan, -1.0, 3.0]], np.float32)
+    out = np.asarray(g.apply(g.params, x)[0])[0]
+    assert np.isnan(out[0])           # NaN untouched
+    assert out[1] == 9.0 and out[2] == 3.0
+
+
+def test_multiclassova_conversion_raises():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(120, 4)).astype(np.float32)
+    y = rng.integers(0, 3, 120).astype(np.float64)
+    model = LightGBMClassifier(num_iterations=4, num_leaves=5,
+                               objective="multiclass").fit(
+        Table({"features": x, "label": y}))
+    import dataclasses
+    model.booster.params = dataclasses.replace(
+        model.booster.params, objective="multiclassova")
+    with pytest.raises(NotImplementedError, match="multiclassova"):
+        convert_lightgbm(model)
