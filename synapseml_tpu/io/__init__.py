@@ -22,7 +22,9 @@ from synapseml_tpu.io.http import (  # noqa: F401
 )
 from synapseml_tpu.io.serving import (  # noqa: F401
     ContinuousServer,
+    DistributedServer,
     HTTPSourceStateHolder,
+    MultiChannelMap,
     WorkerServer,
     make_reply,
     parse_request,

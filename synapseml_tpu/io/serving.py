@@ -34,6 +34,22 @@ from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
 _REGISTRY_LOCK = threading.Lock()
 
 
+def _drain_queue(q: "queue.Queue", max_rows: int,
+                 timeout: float) -> List["CachedRequest"]:
+    """Deadline-bounded drain: block for the first item only, then take
+    whatever else is immediately available."""
+    out: List[CachedRequest] = []
+    deadline = time.monotonic() + timeout
+    while len(out) < max_rows:
+        remaining = deadline - time.monotonic()
+        try:
+            out.append(q.get(
+                timeout=max(0.0, remaining) if not out else 0.0))
+        except queue.Empty:
+            break
+    return out
+
+
 def find_open_port(base: int = 12400, host: str = "127.0.0.1") -> int:
     """Ascending port search (ref: TrainUtils.findOpenPort:193-220)."""
     for port in range(base, base + 1000):
@@ -158,16 +174,14 @@ class WorkerServer:
     def get_batch(self, max_rows: int = 64, timeout: float = 0.1
                   ) -> List[CachedRequest]:
         """Drain up to ``max_rows`` requests as one epoch's batch."""
-        out: List[CachedRequest] = []
-        deadline = time.monotonic() + timeout
-        while len(out) < max_rows:
-            remaining = deadline - time.monotonic()
-            try:
-                item = self.requests.get(
-                    timeout=max(0.0, remaining) if not out else 0.0)
-            except queue.Empty:
-                break
-            out.append(item)
+        out = _drain_queue(self.requests, max_rows, timeout)
+        self._record_epoch(out)
+        return out
+
+    def _record_epoch(self, out: List[CachedRequest]):
+        """Stamp a batch with an epoch and park it in replay history —
+        every consumption path (direct or via DistributedServer channels)
+        must pass through here or recover() cannot replay it."""
         if out:
             with self._lock:
                 epoch = self.current_epoch
@@ -175,7 +189,6 @@ class WorkerServer:
                 for cr in out:
                     cr.epoch = epoch
                 self.history[epoch] = list(out)
-        return out
 
     def commit(self, epoch: int):
         """Prune replay history through ``epoch`` (ref: commit :555-567)."""
@@ -247,6 +260,123 @@ class HTTPSourceStateHolder:
             srv = cls._servers.pop(name, None)
         if srv is not None:
             srv.stop()
+
+
+class MultiChannelMap:
+    """Round-robin request distribution across N consumer channels
+    (ref: DistributedHTTPSource.scala MultiChannelMap:27-80 — adds rotate
+    through channel lists; updateNLists disperses orphaned channels on
+    elastic resize).
+
+    All channel-list access stays under the lock (queue puts included —
+    they never block, so holding the lock is safe): a put outside it
+    could land on a channel a concurrent shrink already drained, losing
+    the request."""
+
+    def __init__(self, n_channels: int):
+        self._lock = threading.Lock()
+        self._channels: List["queue.Queue[CachedRequest]"] = [
+            queue.Queue() for _ in range(max(1, n_channels))
+        ]
+        self._add_index = 0
+
+    @property
+    def n_channels(self) -> int:
+        with self._lock:
+            return len(self._channels)
+
+    def add(self, item: CachedRequest):
+        with self._lock:
+            i = self._add_index
+            self._add_index = (self._add_index + 1) % len(self._channels)
+            self._channels[i].put(item)
+
+    def channel(self, i: int) -> "queue.Queue[CachedRequest]":
+        """Current queue for channel ``i`` (clamped: a concurrent shrink
+        must degrade to serving a live channel, not IndexError)."""
+        with self._lock:
+            return self._channels[i % len(self._channels)]
+
+    def update_n_channels(self, n: int):
+        """Resize; requests parked on removed channels are re-dispersed
+        (ref: updateNLists:39-52)."""
+        n = max(1, n)
+        with self._lock:
+            orphaned: List[CachedRequest] = []
+            while len(self._channels) > n:
+                dead = self._channels.pop()
+                while True:
+                    try:
+                        orphaned.append(dead.get_nowait())
+                    except queue.Empty:
+                        break
+            while len(self._channels) < n:
+                self._channels.append(queue.Queue())
+            self._add_index %= len(self._channels)
+            for item in orphaned:
+                i = self._add_index
+                self._add_index = (self._add_index + 1) % len(self._channels)
+                self._channels[i].put(item)
+
+
+class DistributedServer:
+    """Serving v1 analogue: ONE shared HTTP server per host whose
+    requests distribute round-robin across worker channels
+    (ref: DistributedHTTPSource.scala JVMSharedServer:90 shared via
+    SharedSingleton :384, MultiChannelMap round-robin :27,
+    DistributedHTTPSink:364). Each shard drains its own channel with
+    ``get_batch(channel=i)`` and replies through the shared server."""
+
+    def __init__(self, name: str, n_channels: int,
+                 host: str = "127.0.0.1", port: Optional[int] = None,
+                 reply_timeout: float = 60.0):
+        self.server = HTTPSourceStateHolder.get_or_create_server(
+            name, host, port, reply_timeout=reply_timeout)
+        # exactly one distributor may own a server's request queue: a
+        # second consumer would silently steal an arbitrary subset
+        if getattr(self.server, "_dist_owner", None) is not None:
+            raise ValueError(
+                f"server {name!r} already has a DistributedServer "
+                f"attached; reuse that instance or pick another name")
+        self.server._dist_owner = self
+        self.channels = MultiChannelMap(n_channels)
+        self._stop = threading.Event()
+        self._distributor = threading.Thread(
+            target=self._distribute, name=f"dist-{name}", daemon=True)
+        self._distributor.start()
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def _distribute(self):
+        while not self._stop.is_set():
+            try:
+                item = self.server.requests.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self.channels.add(item)
+
+    def get_batch(self, channel: int, max_rows: int = 64,
+                  timeout: float = 0.1) -> List[CachedRequest]:
+        out = _drain_queue(self.channels.channel(channel), max_rows,
+                           timeout)
+        # same epoch/history bookkeeping as the direct path, so a shard
+        # that dies mid-batch stays replayable through server.recover()
+        self.server._record_epoch(out)
+        return out
+
+    def reply_to(self, rid: str, response: HTTPResponseData) -> bool:
+        return self.server.reply_to(rid, response)
+
+    def update_n_channels(self, n: int):
+        self.channels.update_n_channels(n)
+
+    def stop(self):
+        self._stop.set()
+        self._distributor.join(timeout=2)
+        self.server._dist_owner = None
+        HTTPSourceStateHolder.remove(self.server.name)
 
 
 # ---------------------------------------------------------------------------

@@ -195,3 +195,98 @@ def test_keepalive_roundtrip_is_submillisecond():
     # the stall this guards against is ~40ms per request; the median of
     # 100 samples clears 25ms even on an oversubscribed CI box
     assert p50 < 0.025, f"keep-alive p50 {p50*1e3:.1f}ms — Nagle stall?"
+
+
+def test_distributed_server_round_robin_and_resize():
+    """Serving v1 analogue: one shared server, requests round-robin
+    across channels; resize disperses orphaned requests
+    (ref: DistributedHTTPSource.scala MultiChannelMap:27-80)."""
+    from synapseml_tpu.io.serving import DistributedServer
+
+    ds = DistributedServer("t_dist", n_channels=3)
+    try:
+        results = {}
+        threads = []
+
+        def client(i):
+            results[i] = _post(ds.url, {"i": i})
+
+        # 8 requests: rotates _add_index to 2, so the follow-up request
+        # lands on channel 2 — the one the shrink below removes
+        for i in range(8):
+            th = threading.Thread(target=client, args=(i,))
+            th.start()
+            threads.append(th)
+
+        # wait until the distributor has fanned out all 8 requests
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and sum(
+                ds.channels.channel(c).qsize() for c in range(3)) < 8:
+            time.sleep(0.01)
+
+        # round-robin: channels get 3 / 3 / 2 of the 8 requests
+        per_channel = []
+        got = []
+        for c in range(3):
+            batch = ds.get_batch(c, max_rows=10, timeout=5.0)
+            per_channel.append(len(batch))
+            got.extend(batch)
+        assert per_channel == [3, 3, 2]
+
+        for cr in got:
+            body = json.loads(cr.request.entity.decode())
+            ds.reply_to(cr.rid, make_reply({"ok": body["i"]}))
+        for th in threads:
+            th.join(timeout=5)
+        assert sorted(r[1]["ok"] for r in results.values()) == list(range(8))
+
+        # elastic shrink: request 99 parks on channel 2, which the resize
+        # removes — it must re-disperse to a surviving channel, not drop
+        t2 = threading.Thread(target=client, args=(99,))
+        t2.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                ds.channels.channel(2).qsize() < 1:
+            time.sleep(0.01)
+        assert ds.channels.channel(2).qsize() == 1
+        ds.update_n_channels(1)
+        batch = ds.get_batch(0, max_rows=4, timeout=5.0)
+        assert len(batch) == 1
+        ds.reply_to(batch[0].rid, make_reply({"ok": 99}))
+        t2.join(timeout=5)
+        assert results[99][1]["ok"] == 99
+    finally:
+        ds.stop()
+
+
+def test_distributed_server_replay_and_ownership():
+    """Channel consumption records epochs, so a dead shard's batch is
+    replayable; a second DistributedServer on the same name is refused."""
+    from synapseml_tpu.io.serving import DistributedServer
+
+    ds = DistributedServer("t_dist2", n_channels=2)
+    try:
+        with pytest.raises(ValueError, match="already has"):
+            DistributedServer("t_dist2", n_channels=2)
+
+        results = {}
+
+        def client():
+            results["r"] = _post(ds.url, {"v": 7}, timeout=30)
+
+        th = threading.Thread(target=client)
+        th.start()
+        batch = ds.get_batch(0, max_rows=4, timeout=5.0) or \
+            ds.get_batch(1, max_rows=4, timeout=5.0)
+        assert len(batch) == 1
+        # shard "dies" before replying: recover() replays through the
+        # distributor back onto a channel
+        assert ds.server.recover() == 1
+        batch2 = ds.get_batch(0, max_rows=4, timeout=5.0) or \
+            ds.get_batch(1, max_rows=4, timeout=5.0)
+        assert len(batch2) == 1 and batch2[0].rid == batch[0].rid
+        ds.reply_to(batch2[0].rid, make_reply({"done": True}))
+        th.join(timeout=10)
+        assert results["r"] == (200, {"done": True})
+    finally:
+        ds.stop()
