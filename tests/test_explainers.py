@@ -146,3 +146,46 @@ def test_image_lime_and_shap():
         center_cluster = sp[8, 8]
         vals = coefs[1:] if cls is ImageSHAP else coefs
         assert int(np.argmax(vals[:sp.max() + 1])) == int(center_cluster)
+
+
+def test_tabular_shap_over_onnx_scorer():
+    """The north-star explainer config: KernelSHAP attributing a REAL
+    imported-ONNX scorer (LightGBM -> convert -> ONNXModel), not a toy
+    python function (BASELINE config #4 'explainers over TPU scorer')."""
+    from synapseml_tpu.core.pipeline import Transformer
+    from synapseml_tpu.gbdt.estimators import LightGBMClassifier
+    from synapseml_tpu.onnx import ONNXModel, convert_lightgbm
+
+    rng = np.random.default_rng(2)
+    n = 300
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    # only feature 0 matters: attributions must concentrate there
+    y = (x[:, 0] > 0).astype(np.float64)
+    lgbm = LightGBMClassifier(num_iterations=15, num_leaves=7).fit(
+        Table({"features": x, "label": y}))
+    scorer = ONNXModel(model_bytes=convert_lightgbm(lgbm),
+                       feed_dict={"input": "features"})
+
+    class OnnxScorer(Transformer):
+        """Adapter: assemble feature cols -> ONNX scorer -> probability."""
+
+        def _transform(self, table):
+            feats = np.column_stack([
+                np.asarray(table[c], np.float32) for c in ("f0", "f1", "f2")])
+            scored = scorer.transform(Table({"features": feats}))
+            return table.with_column(
+                "probability", np.asarray(scored["probabilities"]))
+
+        def transform(self, table):  # bypass telemetry wrapper simplicity
+            return self._transform(table)
+
+    t = Table({"f0": x[:24, 0].astype(np.float64),
+               "f1": x[:24, 1].astype(np.float64),
+               "f2": x[:24, 2].astype(np.float64)})
+    shap = TabularSHAP(model=OnnxScorer(), input_cols=["f0", "f1", "f2"],
+                       target_col="probability", target_classes=(1,),
+                       num_samples=32, seed=0)
+    phis = np.asarray(shap.transform(t)["output"])  # [N, 1, D+1]
+    # mean |phi| of the informative feature dominates the noise features
+    mag = np.abs(phis[:, 0, 1:]).mean(axis=0)
+    assert mag[0] > 3 * max(mag[1], mag[2]), mag
