@@ -444,6 +444,158 @@ def _lrn(ctx, x):
     return x / jnp.power(bias + (alpha / size) * window, beta)
 
 
+@op("ScatterND")
+def _scatter_nd(ctx, data, indices, updates):
+    reduction = ctx.attr("reduction", "none")
+    if _all_host((data, indices, updates)):
+        # stay on host so integer results can still feed shape slots
+        out = np.array(data)
+        idx = tuple(np.moveaxis(np.asarray(indices), -1, 0))
+        upd = np.asarray(updates)
+        if reduction == "add":
+            np.add.at(out, idx, upd)
+        elif reduction in ("mul", "multiply"):
+            np.multiply.at(out, idx, upd)
+        elif reduction == "min":
+            np.minimum.at(out, idx, upd)
+        elif reduction == "max":
+            np.maximum.at(out, idx, upd)
+        else:
+            out[idx] = upd
+        return out
+    ref = jnp.asarray(data).at[
+        tuple(jnp.moveaxis(jnp.asarray(indices), -1, 0))]
+    if reduction == "add":
+        return ref.add(updates)
+    if reduction in ("mul", "multiply"):
+        return ref.multiply(updates)
+    if reduction == "min":
+        return ref.min(updates)
+    if reduction == "max":
+        return ref.max(updates)
+    return ref.set(updates)
+
+
+@op("GridSample")
+def _grid_sample(ctx, x, grid):
+    """Bilinear/nearest sampling on [N,C,H,W] with a [-1,1] grid
+    (torch-exported spatial transformers)."""
+    mode = ctx.attr("mode", "bilinear")
+    padding = ctx.attr("padding_mode", "zeros")
+    align = bool(ctx.attr("align_corners", 0))
+    if mode not in ("bilinear", "linear", "nearest"):
+        raise NotImplementedError(f"GridSample mode {mode!r}")
+    if padding not in ("zeros", "border"):
+        raise NotImplementedError(f"GridSample padding_mode {padding!r}")
+    if np.ndim(x) != 4:
+        raise NotImplementedError(
+            "GridSample: only 4-D [N,C,H,W] input is supported "
+            f"(got {np.ndim(x)}-D)")
+    from jax.scipy.ndimage import map_coordinates
+
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    n, c, h, w = x.shape
+
+    def unnorm(g, size):
+        if align:
+            return (g + 1.0) * (size - 1) / 2.0
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    xs = unnorm(grid[..., 0], w)  # [N, Ho, Wo]
+    ys = unnorm(grid[..., 1], h)
+    order = 1 if mode in ("bilinear", "linear") else 0
+    nd_mode = "constant" if padding == "zeros" else "nearest"
+
+    def sample_img(img, ys_i, xs_i):     # img [C,H,W]
+        return jax.vmap(lambda ch: map_coordinates(
+            ch, [ys_i, xs_i], order=order, mode=nd_mode, cval=0.0))(img)
+
+    return jax.vmap(sample_img)(x, ys, xs)
+
+
+def _lower_nodes(nodes, opset: int):
+    """Pre-extract (impl, ctx, inputs, outputs) per node — shared by
+    ImportedGraph.__init__ and subgraph lowering, so apply()/If
+    execution does no proto work per call."""
+    lowered = []
+    for node in nodes:
+        impl = _REGISTRY.get(node.op_type)
+        if impl is None:
+            raise NotImplementedError(
+                f"ONNX op {node.op_type!r} (node {node.name!r}) is not "
+                f"supported by the importer; supported: "
+                f"{sorted(_REGISTRY)}")
+        # positional arity: through the last *used* output slot — ONNX
+        # marks skipped optional outputs with "" placeholders
+        arity = max((i + 1 for i, o in enumerate(node.output) if o),
+                    default=0)
+        ctx = OpContext(node_attrs(node), opset, node.name, node.op_type,
+                        arity)
+        lowered.append((impl, ctx, list(node.input), list(node.output)))
+    return lowered
+
+
+def _run_nodes(lowered, env: Dict[str, Any]):
+    for impl, ctx, in_names, out_names in lowered:
+        args = [env[n] if n else None for n in in_names]
+        if getattr(impl, "_needs_env", False):
+            # control-flow ops (If) run subgraphs that capture outer
+            # names beyond their declared inputs
+            out = impl(ctx, *args, env=env)
+        else:
+            out = impl(ctx, *args)
+        if not isinstance(out, tuple):
+            out = (out,)
+        for name, val in zip(out_names, out):
+            if name:  # "" marks a skipped optional output
+                env[name] = val
+
+
+class _Subgraph:
+    """A branch GraphProto lowered once at first use."""
+
+    def __init__(self, graph: Msg, opset: int):
+        self.inits = {t.name: tensor_to_numpy(t) for t in graph.initializer}
+        self.lowered = _lower_nodes(graph.node, opset)
+        self.output_names = [vi.name for vi in graph.output]
+
+    def run(self, env: Dict[str, Any]):
+        sub_env = dict(env)
+        sub_env.update(self.inits)
+        _run_nodes(self.lowered, sub_env)
+        return tuple(sub_env[n] for n in self.output_names)
+
+
+@op("If")
+def _if(ctx, cond, env=None):
+    """then/else subgraphs with outer capture. A host-side condition
+    picks one branch at trace time (the common exported pattern:
+    shape-derived flags); a traced condition runs both branches and
+    selects elementwise, so their output shapes must match."""
+    branches = ctx.attrs.get("__lowered__")
+    if branches is None:
+        branches = (_Subgraph(ctx.attr("then_branch"), ctx.opset),
+                    _Subgraph(ctx.attr("else_branch"), ctx.opset))
+        ctx.attrs["__lowered__"] = branches
+    then_b, else_b = branches
+    env = env or {}
+    if _is_host(cond):
+        branch = then_b if bool(np.asarray(cond).reshape(())) else else_b
+        out = branch.run(env)
+    else:
+        t_out = then_b.run(env)
+        e_out = else_b.run(env)
+        c = jnp.asarray(cond).reshape(())
+        out = tuple(
+            jnp.where(c, jnp.asarray(t), jnp.asarray(e))
+            for t, e in zip(t_out, e_out))
+    return out if len(out) != 1 else out[0]
+
+
+_if._needs_env = True
+
+
 # ---------------------------------------------------------------------------
 # Normalization
 # ---------------------------------------------------------------------------
@@ -1160,21 +1312,7 @@ class ImportedGraph:
                     shape.append(int(d.dim_value) if d.dim_value else None)
             self.input_info[vi.name] = (dtype, shape)
         # pre-extract node metadata so apply() does no proto work per trace
-        self._nodes = []
-        for node in graph.node:
-            impl = _REGISTRY.get(node.op_type)
-            if impl is None:
-                raise NotImplementedError(
-                    f"ONNX op {node.op_type!r} (node {node.name!r}) is not "
-                    f"supported by the importer; supported: "
-                    f"{sorted(_REGISTRY)}")
-            # positional arity: through the last *used* output slot — ONNX
-            # marks skipped optional outputs with "" placeholders
-            arity = max((i + 1 for i, o in enumerate(node.output) if o),
-                        default=0)
-            ctx = OpContext(node_attrs(node), opset, node.name, node.op_type,
-                            arity)
-            self._nodes.append((impl, ctx, list(node.input), list(node.output)))
+        self._nodes = _lower_nodes(graph.node, opset)
 
     def apply(self, params: Dict[str, Any], *inputs, **named_inputs):
         """Run the graph. Inputs positional (graph order) or by name."""
@@ -1186,14 +1324,7 @@ class ImportedGraph:
         missing = [n for n in self.input_names if n not in env]
         if missing:
             raise ValueError(f"missing graph inputs: {missing}")
-        for impl, ctx, in_names, out_names in self._nodes:
-            args = [env[n] if n else None for n in in_names]
-            out = impl(ctx, *args)
-            if not isinstance(out, tuple):
-                out = (out,)
-            for name, val in zip(out_names, out):
-                if name:  # "" marks a skipped optional output
-                    env[name] = val
+        _run_nodes(self._nodes, env)
         return tuple(env[n] for n in self.output_names)
 
     def bind(self, cast_dtype=None):

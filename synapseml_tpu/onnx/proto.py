@@ -458,6 +458,8 @@ def make_attr(name: str, value: Any) -> Msg:
         a.type, a.s = ATTR_STRING, value.encode("utf-8")
     elif isinstance(value, np.ndarray):
         a.type, a.t = ATTR_TENSOR, numpy_to_tensor(value)
+    elif isinstance(value, Msg) and value._schema == "GraphProto":
+        a.type, a.g = ATTR_GRAPH, value
     elif isinstance(value, (list, tuple)):
         if value and isinstance(value[0], float):
             a.type, a.floats = ATTR_FLOATS, [float(v) for v in value]

@@ -92,6 +92,38 @@ def _lgbm_metric(row, Xt, Xv, yt, yv):
     return float(roc_auc_score(yv, pred))
 
 
+def _ranker_metric(row):
+    """Mean NDCG@10 on held-out queries of a synthetic graded-relevance
+    ranking task (the reference gates lambdarank through its ranker
+    suites; sklearn ships no ranking dataset, so the task is generated
+    with a fixed seed)."""
+    from sklearn.metrics import ndcg_score
+
+    rng = np.random.default_rng(0)
+    n_q, per_q, d = 100, 12, 8
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n_q * per_q, d))
+    util = X @ w + 0.3 * rng.normal(size=n_q * per_q)
+    edges = np.quantile(util, [0.5, 0.75, 0.9, 0.97])
+    rel = np.digitize(util, edges).astype(np.float64)  # grades 0..4
+    groups = np.repeat(np.arange(n_q), per_q)
+    train_q = groups < 70
+    Xt, yt, gt = X[train_q], rel[train_q], groups[train_q]
+    Xv, yv, gv = X[~train_q], rel[~train_q], groups[~train_q]
+
+    p = BoostParams(objective="lambdarank",
+                    boosting_type=row["variant"], num_iterations=40,
+                    num_leaves=15, min_data_in_leaf=5, learning_rate=0.08,
+                    seed=0, max_position=10)
+    b = train(p, Xt, yt, group=gt)
+    scores = b.predict(Xv)
+    vals = [
+        ndcg_score(yv[gv == q][None], scores[gv == q][None], k=10)
+        for q in np.unique(gv)
+    ]
+    return float(np.mean(vals))
+
+
 def _vw_table(X, y=None):
     from synapseml_tpu.linear.featurizer import VowpalWabbitFeaturizer
 
@@ -123,6 +155,9 @@ def _vw_metric(row, Xt, Xv, yt, yv):
     "row", _rows(),
     ids=[f"{r['task']}-{r['dataset']}-{r['variant']}" for r in _rows()])
 def test_gate(row):
+    if row["task"] == "lightgbm_ranker":
+        _check(row, _ranker_metric(row))
+        return
     Xt, Xv, yt, yv = _DATASETS[row["dataset"]]()
     if row["task"].startswith("lightgbm"):
         measured = _lgbm_metric(row, Xt, Xv, yt, yv)

@@ -393,3 +393,93 @@ def test_executor_path_keeps_shape_initializers_static():
                for v in g.params.values())
     assert any(np.issubdtype(v.dtype, np.integer)
                for v in g.static_params.values())
+
+
+def test_scatter_nd_set_and_add():
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, [3, 4])
+    idx = g.add_initializer("idx", np.array([[0], [2]], np.int64))
+    upd = g.add_initializer("upd", np.full((2, 4), 9.0, np.float32))
+    y = g.add_node("ScatterND", [x, idx, upd])
+    g.add_output(y, np.float32, [3, 4])
+    gi = import_model(g.to_bytes())
+    xv = np.zeros((3, 4), np.float32)
+    out = np.asarray(gi.apply(gi.params, xv)[0])
+    np.testing.assert_allclose(out, [[9] * 4, [0] * 4, [9] * 4])
+
+    g2 = GraphBuilder(opset=17)
+    x = g2.add_input("x", np.float32, [3])
+    idx = g2.add_initializer("idx", np.array([[1], [1]], np.int64))
+    upd = g2.add_initializer("upd", np.array([2.0, 3.0], np.float32))
+    y = g2.add_node("ScatterND", [x, idx, upd], reduction="add")
+    g2.add_output(y, np.float32, [3])
+    gi2 = import_model(g2.to_bytes())
+    out = np.asarray(gi2.apply(gi2.params, np.ones(3, np.float32))[0])
+    np.testing.assert_allclose(out, [1.0, 6.0, 1.0])  # duplicate adds
+
+
+def test_grid_sample_matches_torch():
+    th_x = torch.arange(16, dtype=torch.float32).reshape(1, 1, 4, 4)
+    th_grid = (torch.rand(1, 3, 5, 2) * 2 - 1) * 0.9
+    for mode in ("bilinear", "nearest"):
+        for align in (True, False):
+            want = torch.nn.functional.grid_sample(
+                th_x, th_grid, mode=mode, padding_mode="zeros",
+                align_corners=align).numpy()
+            g = GraphBuilder(opset=17)
+            x = g.add_input("x", np.float32, [1, 1, 4, 4])
+            gr = g.add_initializer("grid", th_grid.numpy())
+            y = g.add_node("GridSample", [x, gr], mode=mode,
+                           padding_mode="zeros",
+                           align_corners=1 if align else 0)
+            g.add_output(y, np.float32, [1, 1, 3, 5])
+            gi = import_model(g.to_bytes())
+            got = np.asarray(gi.apply(gi.params, th_x.numpy())[0])
+            np.testing.assert_allclose(got, want, atol=1e-5,
+                                       err_msg=f"{mode} align={align}")
+
+
+def _branch_graph(name, mult):
+    from synapseml_tpu.onnx.proto import Msg, make_attr, numpy_to_tensor
+
+    g = Msg("GraphProto")
+    g.name = name
+    node = Msg("NodeProto")
+    node.op_type = "Mul"
+    node.input = ["x", f"{name}_c"]
+    node.output = [f"{name}_out"]
+    node.name = f"{name}_mul"
+    node.attribute = []
+    init = numpy_to_tensor(np.float32(mult) * np.ones(1, np.float32),
+                           f"{name}_c")
+    g.initializer = [init]
+    g.node = [node]
+    out = Msg("ValueInfoProto")
+    out.name = f"{name}_out"
+    g.output = [out]
+    g.input = []
+    g.value_info = []
+    return g
+
+
+def test_if_subgraphs_capture_outer_scope():
+    """If with then/else branches multiplying the OUTER graph's x."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, ["N"])
+    cond = g.add_input("cond", np.bool_, [])
+    y = g.add_node("If", [cond], then_branch=_branch_graph("thenb", 2.0),
+                   else_branch=_branch_graph("elseb", 10.0))
+    g.add_output(y, np.float32, ["N"])
+    gi = import_model(g.to_bytes())
+    xv = np.array([1.0, 3.0], np.float32)
+    # host-static condition: single branch executes
+    np.testing.assert_allclose(
+        np.asarray(gi.apply(gi.params, xv, np.bool_(True))[0]), [2, 6])
+    np.testing.assert_allclose(
+        np.asarray(gi.apply(gi.params, xv, np.bool_(False))[0]), [10, 30])
+    # traced condition under jit: elementwise select of both branches
+    import jax
+
+    fn = jax.jit(lambda xv, c: gi.apply(gi.params, xv, c)[0])
+    np.testing.assert_allclose(np.asarray(fn(xv, True)), [2, 6])
+    np.testing.assert_allclose(np.asarray(fn(xv, False)), [10, 30])
