@@ -532,6 +532,15 @@ def _lower_nodes(nodes, opset: int):
                     default=0)
         ctx = OpContext(node_attrs(node), opset, node.name, node.op_type,
                         arity)
+        # control-flow subgraphs lower EAGERLY so an unsupported op inside
+        # a branch is rejected at import time, not on live traffic
+        if node.op_type == "If":
+            ctx.attrs["__lowered__"] = (
+                _Subgraph(ctx.attr("then_branch"), opset),
+                _Subgraph(ctx.attr("else_branch"), opset))
+        elif node.op_type == "Loop":
+            ctx.attrs["__lowered_body__"] = _Subgraph(ctx.attr("body"),
+                                                      opset)
         lowered.append((impl, ctx, list(node.input), list(node.output)))
     return lowered
 
@@ -553,12 +562,27 @@ def _run_nodes(lowered, env: Dict[str, Any]):
 
 
 class _Subgraph:
-    """A branch GraphProto lowered once at first use."""
+    """A branch/body GraphProto lowered once at import time."""
 
     def __init__(self, graph: Msg, opset: int):
         self.inits = {t.name: tensor_to_numpy(t) for t in graph.initializer}
         self.lowered = _lower_nodes(graph.node, opset)
+        self.input_names = [vi.name for vi in graph.input]
         self.output_names = [vi.name for vi in graph.output]
+
+    def captured_names(self) -> set:
+        """Names read from the outer scope: node inputs not produced
+        inside the subgraph (recursively through nested control flow)."""
+        produced = set(self.input_names) | set(self.inits)
+        captured = set()
+        for impl, ctx, in_names, out_names in self.lowered:
+            for nm in in_names:
+                if nm and nm not in produced:
+                    captured.add(nm)
+            for sub in _subgraphs_of(ctx):
+                captured |= sub.captured_names() - produced
+            produced.update(n for n in out_names if n)
+        return captured
 
     def run(self, env: Dict[str, Any]):
         sub_env = dict(env)
@@ -567,18 +591,24 @@ class _Subgraph:
         return tuple(sub_env[n] for n in self.output_names)
 
 
+def _subgraphs_of(ctx) -> List["_Subgraph"]:
+    out = []
+    lowered = ctx.attrs.get("__lowered__")
+    if lowered:
+        out.extend(lowered)
+    body = ctx.attrs.get("__lowered_body__")
+    if body is not None:
+        out.append(body)
+    return out
+
+
 @op("If")
 def _if(ctx, cond, env=None):
     """then/else subgraphs with outer capture. A host-side condition
     picks one branch at trace time (the common exported pattern:
     shape-derived flags); a traced condition runs both branches and
     selects elementwise, so their output shapes must match."""
-    branches = ctx.attrs.get("__lowered__")
-    if branches is None:
-        branches = (_Subgraph(ctx.attr("then_branch"), ctx.opset),
-                    _Subgraph(ctx.attr("else_branch"), ctx.opset))
-        ctx.attrs["__lowered__"] = branches
-    then_b, else_b = branches
+    then_b, else_b = ctx.attrs["__lowered__"]  # lowered at import time
     env = env or {}
     if _is_host(cond):
         branch = then_b if bool(np.asarray(cond).reshape(())) else else_b
@@ -594,6 +624,84 @@ def _if(ctx, cond, env=None):
 
 
 _if._needs_env = True
+
+
+@op("Loop")
+def _loop(ctx, max_trip, cond, *v_initial, env=None):
+    """ONNX Loop with a host-static trip count / termination condition
+    (the exported for-range pattern). Body inputs: (iteration, cond_in,
+    *carried); outputs: (cond_out, *carried, *scan_outputs); scan
+    outputs stack along a new leading axis. Data-dependent trip counts
+    would need lax.while_loop with shape-invariant carries — out of
+    scope until a real model demands it."""
+    body = ctx.attrs["__lowered_body__"]  # lowered at import time
+    in_names = body.input_names
+    if max_trip is None and cond is None:
+        raise ValueError("Loop needs a trip count or a condition")
+    if max_trip is not None and not _is_host(max_trip):
+        raise NotImplementedError(
+            "Loop: data-dependent trip counts are not supported")
+    trips = int(np.asarray(max_trip).reshape(())) if max_trip is not None \
+        else None
+    keep_going = True if cond is None else bool(
+        np.asarray(cond).reshape(())) if _is_host(cond) else None
+    if keep_going is None:
+        raise NotImplementedError(
+            "Loop: traced entry conditions are not supported")
+
+    carried = list(v_initial)
+    n_carried = len(carried)
+    scan_acc: List[List[Any]] = []
+    i = 0
+    while keep_going and (trips is None or i < trips):
+        sub_env = dict(env or {})
+        vals = [np.int64(i), np.bool_(True)] + carried
+        for nm, v in zip(in_names, vals):
+            sub_env[nm] = v
+        outs = body.run(sub_env)
+        cond_out, outs = outs[0], outs[1:]
+        carried = list(outs[:n_carried])
+        scans = outs[n_carried:]
+        if not scan_acc:
+            scan_acc = [[] for _ in scans]
+        for acc, s in zip(scan_acc, scans):
+            acc.append(s)
+        if _is_host(cond_out):
+            keep_going = bool(np.asarray(cond_out).reshape(()))
+        else:
+            # a device-computed condition cannot drive this host loop;
+            # ignoring it would run all iterations and silently produce
+            # wrong results (ONNX continues while i < M AND cond)
+            raise NotImplementedError(
+                "Loop: data-dependent termination conditions are not "
+                "supported (the body's cond_out is a traced value)")
+        i += 1
+
+    n_scan = len(body.output_names) - 1 - n_carried
+    if i == 0 and n_scan > 0:
+        # zero-trip loops still owe empty scan outputs; probe the body
+        # once for their shapes (results discarded)
+        sub_env = dict(env or {})
+        vals = [np.int64(0), np.bool_(True)] + list(v_initial)
+        for nm, v in zip(in_names, vals):
+            sub_env[nm] = v
+        probe = body.run(sub_env)[1 + n_carried:]
+        stacked = [
+            np.zeros((0,) + tuple(np.shape(p)),
+                     dtype=np.asarray(p).dtype if _is_host(p) else p.dtype)
+            for p in probe
+        ]
+    else:
+        stacked = [
+            (np.stack(a) if _all_host(a) else jnp.stack(
+                [jnp.asarray(v) for v in a]))
+            for a in scan_acc
+        ]
+    out = tuple(carried) + tuple(stacked)
+    return out if len(out) != 1 else out[0]
+
+
+_loop._needs_env = True
 
 
 # ---------------------------------------------------------------------------
@@ -1362,8 +1470,12 @@ class ImportedGraph:
         out.input_info = dict(self.input_info)
         out.output_names = [out._nodes[-1][3][0]] if cut_layers else list(self.output_names)
         used = set()
-        for _, _, in_names, _ in out._nodes:
+        for _, ctx, in_names, _ in out._nodes:
             used.update(in_names)
+            # If/Loop subgraphs capture outer names beyond their node's
+            # declared inputs — dropping those params breaks apply()
+            for sub in _subgraphs_of(ctx):
+                used |= sub.captured_names()
         out.params = {k: v for k, v in self.params.items() if k in used}
         out.static_params = {
             k: v for k, v in self.static_params.items() if k in used

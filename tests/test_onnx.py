@@ -483,3 +483,151 @@ def test_if_subgraphs_capture_outer_scope():
     fn = jax.jit(lambda xv, c: gi.apply(gi.params, xv, c)[0])
     np.testing.assert_allclose(np.asarray(fn(xv, True)), [2, 6])
     np.testing.assert_allclose(np.asarray(fn(xv, False)), [10, 30])
+
+
+def test_loop_static_trip_count():
+    """Loop accumulating a carried sum and emitting scan outputs
+    (the exported for-range pattern; body: acc += x)."""
+    from synapseml_tpu.onnx.proto import Msg, numpy_to_tensor
+
+    body = Msg("GraphProto")
+    body.name = "body"
+    for nm in ("iter", "cond_in", "acc"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.input.append(vi)
+    add = Msg("NodeProto")
+    add.op_type = "Add"
+    add.input = ["acc", "x"]          # x captured from the outer scope
+    add.output = ["acc_out"]
+    add.name = "b_add"
+    add.attribute = []
+    ident = Msg("NodeProto")
+    ident.op_type = "Identity"
+    ident.input = ["cond_in"]
+    ident.output = ["cond_out"]
+    ident.name = "b_cond"
+    ident.attribute = []
+    body.node = [ident, add]
+    for nm in ("cond_out", "acc_out", "acc_out"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.output.append(vi)
+
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, [2])
+    trip = g.add_initializer("M", np.int64(3))
+    acc0 = g.add_initializer("acc0", np.zeros(2, np.float32))
+    cond0 = g.add_initializer("cond0", np.array(True))
+    outs = g.add_node("Loop", [trip, cond0, acc0],
+                      outputs=["final", "scanned"], body=body)
+    g.add_output("final", np.float32, [2])
+    g.add_output("scanned", np.float32, [3, 2])
+    gi = import_model(g.to_bytes())
+    xv = np.array([1.0, 2.0], np.float32)
+    final, scanned = gi.apply(gi.params, xv)
+    np.testing.assert_allclose(np.asarray(final), [3.0, 6.0])
+    np.testing.assert_allclose(np.asarray(scanned),
+                               [[1, 2], [2, 4], [3, 6]])
+
+
+def test_loop_zero_trips_and_traced_cond_rejected():
+    from synapseml_tpu.onnx.proto import Msg
+
+    body = Msg("GraphProto")
+    body.name = "body0"
+    for nm in ("iter", "cond_in", "acc"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.input.append(vi)
+    ident = Msg("NodeProto")
+    ident.op_type = "Identity"
+    ident.input = ["cond_in"]
+    ident.output = ["cond_out"]
+    ident.name = "b_cond"
+    ident.attribute = []
+    add = Msg("NodeProto")
+    add.op_type = "Add"
+    add.input = ["acc", "x"]
+    add.output = ["acc_out"]
+    add.name = "b_add"
+    add.attribute = []
+    body.node = [ident, add]
+    for nm in ("cond_out", "acc_out", "acc_out"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.output.append(vi)
+
+    g = GraphBuilder(opset=17)
+    g.add_input("x", np.float32, [2])
+    trip = g.add_initializer("M", np.int64(0))
+    acc0 = g.add_initializer("acc0", np.zeros(2, np.float32))
+    cond0 = g.add_initializer("cond0", np.array(True))
+    g.add_node("Loop", [trip, cond0, acc0],
+               outputs=["final", "scanned"], body=body)
+    g.add_output("final", np.float32, [2])
+    g.add_output("scanned", np.float32, [0, 2])
+    gi = import_model(g.to_bytes())
+    final, scanned = gi.apply(gi.params, np.ones(2, np.float32))
+    np.testing.assert_allclose(np.asarray(final), [0.0, 0.0])
+    assert np.asarray(scanned).shape == (0, 2)  # empty scan output
+
+
+def test_if_subgraph_unsupported_op_fails_at_import():
+    """Unsupported ops inside branches must be rejected at import time,
+    not on the first live request."""
+    from synapseml_tpu.onnx.proto import Msg
+
+    branch = Msg("GraphProto")
+    branch.name = "bad"
+    node = Msg("NodeProto")
+    node.op_type = "TotallyUnknownOp"
+    node.input = ["x"]
+    node.output = ["y"]
+    node.name = "bad_op"
+    node.attribute = []
+    branch.node = [node]
+    vi = Msg("ValueInfoProto")
+    vi.name = "y"
+    branch.output = [vi]
+
+    g = GraphBuilder(opset=17)
+    g.add_input("x", np.float32, ["N"])
+    cond = g.add_initializer("c", np.array(True))
+    g.add_node("If", [cond], outputs=["out"], then_branch=branch,
+               else_branch=branch)
+    g.add_output("out", np.float32, ["N"])
+    with pytest.raises(NotImplementedError, match="TotallyUnknownOp"):
+        import_model(g.to_bytes())
+
+
+def test_truncated_keeps_subgraph_captured_params():
+    from synapseml_tpu.onnx.proto import Msg
+
+    def branch(mult_name):
+        b = Msg("GraphProto")
+        b.name = f"br_{mult_name}"
+        node = Msg("NodeProto")
+        node.op_type = "Mul"
+        node.input = ["x", mult_name]   # captures outer initializer
+        node.output = [f"{mult_name}_o"]
+        node.name = f"mul_{mult_name}"
+        node.attribute = []
+        b.node = [node]
+        vi = Msg("ValueInfoProto")
+        vi.name = f"{mult_name}_o"
+        b.output = [vi]
+        return b
+
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, ["N"])
+    w = g.add_initializer("W", np.array([2.0], np.float32))
+    cond = g.add_input("cond", np.bool_, [])
+    y = g.add_node("If", [cond], then_branch=branch("W"),
+                   else_branch=branch("W"))
+    z = g.add_node("Relu", [y])
+    g.add_output(z, np.float32, ["N"])
+    gi = import_model(g.to_bytes())
+    t = gi.truncated(1)  # cut the Relu; the If + its captured W survive
+    out = t.apply(t.params, np.array([3.0], np.float32), np.bool_(True))
+    np.testing.assert_allclose(np.asarray(out[0]), [6.0])
