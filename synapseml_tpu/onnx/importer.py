@@ -538,7 +538,7 @@ def _lower_nodes(nodes, opset: int):
             ctx.attrs["__lowered__"] = (
                 _Subgraph(ctx.attr("then_branch"), opset),
                 _Subgraph(ctx.attr("else_branch"), opset))
-        elif node.op_type == "Loop":
+        elif node.op_type in ("Loop", "Scan"):
             ctx.attrs["__lowered_body__"] = _Subgraph(ctx.attr("body"),
                                                       opset)
         lowered.append((impl, ctx, list(node.input), list(node.output)))
@@ -702,6 +702,59 @@ def _loop(ctx, max_trip, cond, *v_initial, env=None):
 
 
 _loop._needs_env = True
+
+
+@op("Scan")
+def _scan(ctx, *inputs, env=None):
+    """Scan: per-iteration slices of the scan inputs drive the body
+    (the pre-Loop RNN export pattern, opset 9+ layout — no
+    sequence_lens). State variables carry across iterations; scan
+    outputs stack on axis 0. Non-zero scan axes and reverse directions
+    are supported; the sequence length is a static shape, so the host
+    loop unrolls under jit exactly like the LSTM lowering."""
+    body = ctx.attrs.get("__lowered_body__")
+    if body is None:
+        body = _Subgraph(ctx.attr("body"), ctx.opset)
+        ctx.attrs["__lowered_body__"] = body
+    m = int(ctx.attr("num_scan_inputs"))
+    n_state = len(inputs) - m
+    state = list(inputs[:n_state])
+    scans = list(inputs[n_state:])
+    in_axes = list(ctx.attr("scan_input_axes", [0] * m))
+    in_dirs = list(ctx.attr("scan_input_directions", [0] * m))
+    n_scan_out = len(body.output_names) - n_state
+    out_axes = list(ctx.attr("scan_output_axes", [0] * n_scan_out))
+    out_dirs = list(ctx.attr("scan_output_directions", [0] * n_scan_out))
+
+    xp0 = np if _all_host(scans) else jnp
+    scans = [xp0.moveaxis(xp0.asarray(s), in_axes[j] % np.ndim(s), 0)
+             for j, s in enumerate(scans)]
+    length = int(scans[0].shape[0]) if scans else 0
+    acc: List[List[Any]] = [[] for _ in range(n_scan_out)]
+    for i in range(length):
+        sub_env = dict(env or {})
+        vals = list(state) + [
+            s[length - 1 - i] if in_dirs[j] else s[i]
+            for j, s in enumerate(scans)
+        ]
+        for nm, v in zip(body.input_names, vals):
+            sub_env[nm] = v
+        outs = body.run(sub_env)
+        state = list(outs[:n_state])
+        for a, s in zip(acc, outs[n_state:]):
+            a.append(s)
+    stacked = []
+    for j, a in enumerate(acc):
+        if out_dirs[j]:
+            a = a[::-1]
+        xp = np if _all_host(a) else jnp
+        st = xp.stack([xp.asarray(v) for v in a])
+        stacked.append(xp.moveaxis(st, 0, out_axes[j] % st.ndim))
+    out = tuple(state) + tuple(stacked)
+    return out if len(out) != 1 else out[0]
+
+
+_scan._needs_env = True
 
 
 # ---------------------------------------------------------------------------

@@ -631,3 +631,43 @@ def test_truncated_keeps_subgraph_captured_params():
     t = gi.truncated(1)  # cut the Relu; the If + its captured W survive
     out = t.apply(t.params, np.array([3.0], np.float32), np.bool_(True))
     np.testing.assert_allclose(np.asarray(out[0]), [6.0])
+
+
+def test_scan_cumsum_forward_and_reverse():
+    """Scan as running sum over a sequence, forward and reverse
+    directions (the pre-Loop RNN export pattern)."""
+    from synapseml_tpu.onnx.proto import Msg
+
+    body = Msg("GraphProto")
+    body.name = "scan_body"
+    for nm in ("s_in", "x_t"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.input.append(vi)
+    add = Msg("NodeProto")
+    add.op_type = "Add"
+    add.input = ["s_in", "x_t"]
+    add.output = ["s_out"]
+    add.name = "sb_add"
+    add.attribute = []
+    body.node = [add]
+    for nm in ("s_out", "s_out"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.output.append(vi)
+
+    for reverse in (0, 1):
+        g = GraphBuilder(opset=17)
+        g.add_input("seq", np.float32, [4, 2])
+        s0 = g.add_initializer("s0", np.zeros(2, np.float32))
+        g.add_node("Scan", [s0, "seq"], outputs=["sfinal", "cums"],
+                   body=body, num_scan_inputs=1,
+                   scan_input_directions=[reverse])
+        g.add_output("sfinal", np.float32, [2])
+        g.add_output("cums", np.float32, [4, 2])
+        gi = import_model(g.to_bytes())
+        seq = np.arange(8, dtype=np.float32).reshape(4, 2)
+        sfinal, cums = gi.apply(gi.params, seq)
+        src = seq[::-1] if reverse else seq
+        np.testing.assert_allclose(np.asarray(sfinal), seq.sum(0))
+        np.testing.assert_allclose(np.asarray(cums), np.cumsum(src, 0))
