@@ -837,8 +837,6 @@ def train(
         scores = jnp.zeros(n, jnp.float32) + init
 
     if p.boosting_type == "dart":
-        if k > 1:
-            raise NotImplementedError("dart + multiclass not yet supported")
         if learning_rates is not None:
             raise NotImplementedError(
                 "per-iteration learning_rates are not defined for dart "
@@ -848,7 +846,7 @@ def train(
                 "step checkpointing is not defined for dart (past trees "
                 "are rescaled every round)")
         return _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init,
-                           n, f, valid_sets, feature_names)
+                           n, f, valid_sets, feature_names, k=k)
 
     # -- validation state ----------------------------------------------
     tracker = _ValidTracker(p, k, init, valid_sets)
@@ -1459,31 +1457,40 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
 
 
 def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
-                valid_sets, feature_names):
+                valid_sets, feature_names, k: int = 1):
     """DART boosting (Rashmi & Gilad-Bachrach): each round drops a random
-    subset of existing trees, fits the new tree against the reduced
-    ensemble, then renormalizes (paper normalization with shrinkage:
-    w_new = lr/(|D|+1), dropped *= |D|/(|D|+1)).
+    subset of existing iterations, fits the new tree(s) against the
+    reduced ensemble, then renormalizes (paper normalization with
+    shrinkage: w_new = lr/(|D|+1), dropped *= |D|/(|D|+1)).
 
-    Per-tree train predictions are cached on device so score
-    reconstruction is a weighted sum, not a re-traversal.
+    Multiclass fits k class trees per iteration; drops happen at
+    iteration granularity, so an iteration's k trees share one weight
+    (LightGBM's DART tracks drop candidates per iteration). Per-tree
+    train predictions are cached on device so score reconstruction is a
+    weighted sum, not a re-traversal.
     """
+    y_onehot = (jax.nn.one_hot(yd.astype(jnp.int32), k) if k > 1 else None)
+
     @jax.jit
-    def fit_at(score_used, key):
-        g, h = obj_fn(score_used, yd, wd)
+    def grads(score_used):
+        return obj_fn(score_used, y_onehot if k > 1 else yd, wd)
+
+    @jax.jit
+    def fit_tree(g, h, key):
         tree, row_slot, slot_value, _ = build_tree(
             binned, g, h, jnp.ones(n, jnp.bool_), thresholds, gp, None)
         return tree, slot_value[row_slot]
 
     rng = np.random.default_rng(p.seed)
     jkey = jax.random.PRNGKey(p.seed)
-    trees: List[Tree] = []
-    preds: List[jnp.ndarray] = []     # unscaled per-tree train predictions
-    weights: List[float] = []
-    base = jnp.zeros(n, jnp.float32) + init
+    trees: List[Tree] = []            # class-interleaved, t % k == class
+    iter_preds: List[jnp.ndarray] = []  # per iteration: [k, n] unscaled
+    weights: List[float] = []           # one weight per ITERATION
+    base = (jnp.zeros((n, k), jnp.float32) + init if k > 1
+            else jnp.zeros(n, jnp.float32) + init)
 
     for it in range(p.num_iterations):
-        t = len(trees)
+        t = len(iter_preds)
         if t == 0 or rng.random() < p.skip_drop:
             dropped = np.empty(0, np.int64)
         else:
@@ -1497,10 +1504,27 @@ def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
             w_used = w
         score_used = base
         if t:
-            score_used = base + jnp.einsum(
-                "t,tn->n", jnp.asarray(w_used), jnp.stack(preds))
-        jkey, sub = jax.random.split(jkey)
-        tree, pred = fit_at(score_used, sub)
+            if k > 1:
+                score_used = base + jnp.einsum(
+                    "i,ikn->nk", jnp.asarray(w_used),
+                    jnp.stack(iter_preds))
+            else:
+                score_used = base + jnp.einsum(
+                    "i,in->n", jnp.asarray(w_used),
+                    jnp.stack([pr[0] for pr in iter_preds]))
+        g, h = grads(score_used)
+        class_preds = []
+        iter_trees = []
+        for c in range(k):
+            jkey, sub = jax.random.split(jkey)
+            gc = g[:, c] if k > 1 else g
+            hc = h[:, c] if k > 1 else h
+            tree, pred = fit_tree(gc, hc, sub)
+            iter_trees.append(tree)
+            class_preds.append(pred)
+        # one batched device->host round trip for the iteration's k trees
+        trees.extend(jax.device_get(iter_trees))
+        iter_preds.append(jnp.stack(class_preds))
         kd = len(dropped)
         if kd:
             new_w = p.learning_rate / (kd + 1.0)
@@ -1509,10 +1533,10 @@ def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
                 weights[d] *= factor
         else:
             new_w = p.learning_rate
-        trees.append(jax.device_get(tree))  # batched fetch, one round trip
-        preds.append(pred)
         weights.append(float(new_w))
 
+    # expand iteration weights to the class-interleaved tree stack
+    tree_w = np.repeat(np.asarray(weights, np.float32), k)
     booster = Booster(
         trees_feature=np.stack([t.split_feature for t in trees]),
         trees_threshold=np.stack([t.threshold for t in trees]),
@@ -1521,8 +1545,8 @@ def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
         trees_value=np.stack([t.leaf_value for t in trees]),
         trees_cover=np.stack([t.cover for t in trees]),
         trees_gain=np.stack([t.gain for t in trees]),
-        tree_weights=np.asarray(weights, np.float32),
-        params=p, init_score=init, num_class=1, num_features=f,
+        tree_weights=tree_w,
+        params=p, init_score=init, num_class=k, num_features=f,
         feature_names=feature_names)
     booster.feature_importance_split, booster.feature_importance_gain = (
         _importances(booster, f))

@@ -712,10 +712,11 @@ def _scan(ctx, *inputs, env=None):
     outputs stack on axis 0. Non-zero scan axes and reverse directions
     are supported; the sequence length is a static shape, so the host
     loop unrolls under jit exactly like the LSTM lowering."""
-    body = ctx.attrs.get("__lowered_body__")
-    if body is None:
-        body = _Subgraph(ctx.attr("body"), ctx.opset)
-        ctx.attrs["__lowered_body__"] = body
+    if ctx.opset < 9:
+        raise NotImplementedError(
+            "Scan: the opset-8 layout (sequence_lens input, batch axis) "
+            "is not supported; re-export at opset >= 9")
+    body = ctx.attrs["__lowered_body__"]  # lowered at import time
     m = int(ctx.attr("num_scan_inputs"))
     n_state = len(inputs) - m
     state = list(inputs[:n_state])

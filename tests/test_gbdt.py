@@ -581,3 +581,28 @@ def test_iteration_hook_on_early_stop_and_unpicklable_delegate(tmp_path):
     np.testing.assert_allclose(
         np.asarray(m2.transform(t)["prediction"]),
         np.asarray(model.transform(t)["prediction"]), rtol=1e-6)
+
+
+def test_dart_multiclass():
+    """DART with k class trees per iteration (drops at iteration
+    granularity, one shared weight per iteration's tree group)."""
+    from synapseml_tpu.gbdt.boosting import BoostParams, train
+
+    rng = np.random.default_rng(9)
+    n, d, k = 400, 5, 3
+    x = rng.normal(size=(n, d))
+    y = np.argmax(x[:, :k] + 0.2 * rng.normal(size=(n, k)),
+                  axis=1).astype(np.float64)
+    p = BoostParams(objective="multiclass", num_class=k,
+                    boosting_type="dart", num_iterations=15, num_leaves=7,
+                    drop_rate=0.3, seed=0)
+    b = train(p, x, y)
+    assert b.num_trees == 15 * k
+    # iteration's k trees share one dart weight
+    tw = b.tree_weights.reshape(15, k)
+    assert np.allclose(tw, tw[:, :1])
+    probs = b.predict(x)
+    assert probs.shape == (n, k)
+    np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-5)
+    acc = (probs.argmax(-1) == y).mean()
+    assert acc > 0.85, acc
