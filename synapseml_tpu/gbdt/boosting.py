@@ -301,6 +301,12 @@ class Booster:
     feature_importance_split: Optional[np.ndarray] = None
     feature_importance_gain: Optional[np.ndarray] = None
     eval_history: Dict[str, List[float]] = dataclasses.field(default_factory=dict)
+    # categorical splits (native LightGBM interop): trees_cat[t, n] >= 0
+    # marks node n of tree t as categorical, indexing into the global
+    # bitset pool — int(x) in the set -> left child. None = all numeric.
+    trees_cat: Optional[np.ndarray] = None       # [T, M] int32, -1 = numeric
+    cat_bitsets: Optional[np.ndarray] = None     # [W] uint32 words
+    cat_boundaries: Optional[np.ndarray] = None  # [S+1] int32 word offsets
 
     @property
     def num_trees(self) -> int:
@@ -333,7 +339,14 @@ class Booster:
             # truncated predict (early stopping / num_iteration) must
             # renormalize from 1/T_total to 1/T_kept
             weights = jnp.full((t,), 1.0 / max(t // k, 1), jnp.float32)
-        out = _predict_stack(stack, weights, jnp.asarray(x), k, t)
+        if self.trees_cat is not None:
+            out = _predict_stack_cat(
+                stack + (jnp.asarray(self.trees_cat[:t]),),
+                weights, jnp.asarray(x),
+                jnp.asarray(self.cat_bitsets, jnp.uint32),
+                jnp.asarray(self.cat_boundaries, jnp.int32), k, t)
+        else:
+            out = _predict_stack(stack, weights, jnp.asarray(x), k, t)
         out = np.asarray(out) + self.init_score
         return out if k > 1 else out[:, 0]
 
@@ -358,6 +371,10 @@ class Booster:
     def predict_leaf(self, x) -> np.ndarray:
         """[N, T] leaf index per tree (parity with predictLeaf,
         ref: lightgbm/.../LightGBMModelMethods.scala)."""
+        if self.trees_cat is not None:
+            raise NotImplementedError(
+                "predict_leaf is not implemented for models with "
+                "categorical splits (loaded native LightGBM model)")
         x = np.asarray(x, dtype=np.float32)
         stack = (
             jnp.asarray(self.trees_feature),
@@ -386,6 +403,11 @@ class Booster:
                 "gain": self.trees_gain.tolist(),
                 "weights": self.tree_weights.tolist(),
             },
+            **({"categorical": {
+                "trees_cat": self.trees_cat.tolist(),
+                "bitsets": self.cat_bitsets.tolist(),
+                "boundaries": self.cat_boundaries.tolist(),
+            }} if self.trees_cat is not None else {}),
         }
 
     @staticmethod
@@ -408,6 +430,14 @@ class Booster:
             best_iteration=d.get("best_iteration", -1),
             num_features=d.get("num_features", -1),
             feature_names=d.get("feature_names"),
+            **({} if "categorical" not in d else {
+                "trees_cat": np.asarray(
+                    d["categorical"]["trees_cat"], np.int32),
+                "cat_bitsets": np.asarray(
+                    d["categorical"]["bitsets"], np.uint32),
+                "cat_boundaries": np.asarray(
+                    d["categorical"]["boundaries"], np.int32),
+            }),
         )
 
     def save_string(self) -> str:
@@ -433,6 +463,47 @@ def _predict_stack(stack, weights, x, k: int, t: int):
     def body(carry, tree_w):
         (feat, thr, left, right, value), w, idx = tree_w
         pred = predict_tree((feat, thr, left, right, value), x) * w
+        carry = carry.at[:, idx % k].add(pred)
+        return carry, None
+
+    out = jnp.zeros((n, k), jnp.float32)
+    idxs = jnp.arange(t, dtype=jnp.int32)
+    out, _ = jax.lax.scan(body, out, (stack, weights, idxs))
+    return out
+
+
+@partial(jax.jit, static_argnums=(5, 6))
+def _predict_stack_cat(stack, weights, x, bitsets, bounds, k: int, t: int):
+    """Predict scan for models with categorical splits: a cat node routes
+    LEFT iff int(x) is in its bitset (LightGBM FindInBitset semantics);
+    NaN, negative, and out-of-range categories go right."""
+    n = x.shape[0]
+    n_words = bitsets.shape[0]
+
+    def body(carry, tree_w):
+        (feat, thr, left, right, value, cat), w, idx = tree_w
+        node = jnp.zeros(n, jnp.int32)
+        max_depth = feat.shape[0] // 2 + 1
+
+        def step(_, node):
+            is_leaf = feat[node] < 0
+            xv = x[jnp.arange(n), feat[node].clip(0)]
+            ci = cat[node]                       # [n] cat-set id or -1
+            num_left = xv <= thr[node]
+            v = jnp.nan_to_num(xv, nan=-1.0).astype(jnp.int32)
+            start = bounds[ci.clip(0)]
+            width = (bounds[ci.clip(0) + 1] - start) * 32
+            word = bitsets[jnp.clip(start + jnp.clip(v, 0) // 32, 0,
+                                    n_words - 1)]
+            in_set = ((word >> (jnp.clip(v, 0) % 32).astype(jnp.uint32))
+                      & jnp.uint32(1)).astype(jnp.bool_)
+            cat_left = in_set & (v >= 0) & (v < width)
+            go_left = jnp.where(ci >= 0, cat_left, num_left)
+            nxt = jnp.where(go_left, left[node], right[node])
+            return jnp.where(is_leaf, node, nxt)
+
+        node = lax.fori_loop(0, max_depth, step, node)
+        pred = value[node] * w
         carry = carry.at[:, idx % k].add(pred)
         return carry, None
 

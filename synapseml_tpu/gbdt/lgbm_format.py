@@ -18,9 +18,12 @@ Conventions bridged:
   serializing), and tree weights (dart/rf) are folded into leaf values, so
   ``sum of trees`` reproduces our predictions with no side channel.
 - decision_type: we emit ``8`` (numerical split, missing=NaN goes right,
-  matching our training semantics). Categorical splits (bit 0) are rejected
-  on load; ``default_left`` models load but NaN feature values would take
-  the right branch here.
+  matching our training semantics). Categorical splits (bit 0) load into
+  the Booster's global bitset pool (``trees_cat``/``cat_bitsets``/
+  ``cat_boundaries``) and save back as per-tree ``cat_boundaries``/
+  ``cat_threshold`` rows; membership routes left (FindInBitset), with
+  NaN/negative/out-of-range categories going right. ``default_left``
+  models load but NaN feature values would take the right branch here.
 """
 from __future__ import annotations
 
@@ -113,6 +116,9 @@ def booster_to_native_string(b: Booster) -> str:
     lo = np.full(f, np.inf)
     hi = np.full(f, -np.inf)
     internal_mask = b.trees_feature >= 0
+    if b.trees_cat is not None:
+        # cat nodes carry set indices, not value thresholds
+        internal_mask = internal_mask & (b.trees_cat < 0)
     for fi, th in zip(b.trees_feature[internal_mask],
                       b.trees_threshold[internal_mask]):
         lo[fi] = min(lo[fi], th)
@@ -154,19 +160,47 @@ def booster_to_native_string(b: Booster) -> str:
         def child_ref(c):
             return iidx[c] if feat[c] >= 0 else -(lidx[c] + 1)
 
-        lines = [f"Tree={ti}", f"num_leaves={n_leaves}", "num_cat=0"]
+        # categorical nodes: rebuild this tree's cat_boundaries /
+        # cat_threshold from the global bitset pool; the node's threshold
+        # column holds the per-tree cat-set index, decision_type sets bit 0
+        cat = b.trees_cat[ti] if b.trees_cat is not None else None
+        tree_cat_bounds = [0]
+        tree_cat_words: List[int] = []
+        node_thr: Dict[int, float] = {}
+        node_dt: Dict[int, int] = {}
+        for nid in internals:
+            if cat is not None and cat[nid] >= 0:
+                ci = int(cat[nid])
+                lo_w = int(b.cat_boundaries[ci])
+                hi_w = int(b.cat_boundaries[ci + 1])
+                node_thr[nid] = float(len(tree_cat_bounds) - 1)
+                node_dt[nid] = 1  # categorical split bit
+                tree_cat_words.extend(int(w) for w in b.cat_bitsets[lo_w:hi_w])
+                tree_cat_bounds.append(len(tree_cat_words))
+            else:
+                node_thr[nid] = float(thr[nid])
+                node_dt[nid] = 8  # numerical, missing=NaN goes right
+        num_cat = len(tree_cat_bounds) - 1
+
+        lines = [f"Tree={ti}", f"num_leaves={n_leaves}",
+                 f"num_cat={num_cat}"]
         if internals:
             lines += [
                 "split_feature=" + _fmt((feat[n] for n in internals), "{:d}"),
                 "split_gain=" + _fmt((max(float(gain[n]), 0.0) for n in internals)),
-                "threshold=" + _fmt((float(thr[n]) for n in internals)),
-                "decision_type=" + _fmt((8 for _ in internals), "{:d}"),
+                "threshold=" + _fmt((node_thr[n] for n in internals)),
+                "decision_type=" + _fmt((node_dt[n] for n in internals), "{:d}"),
                 "left_child=" + _fmt((child_ref(left[n]) for n in internals), "{:d}"),
                 "right_child=" + _fmt((child_ref(right[n]) for n in internals), "{:d}"),
             ]
         else:
             lines += ["split_feature=", "split_gain=", "threshold=",
                       "decision_type=", "left_child=", "right_child="]
+        if num_cat:
+            lines += [
+                "cat_boundaries=" + _fmt(tree_cat_bounds, "{:d}"),
+                "cat_threshold=" + _fmt(tree_cat_words, "{:d}"),
+            ]
         lines += [
             "leaf_value=" + _fmt((float(value[n]) for n in leaves)),
             "leaf_weight=" + _fmt((float(cover[n]) for n in leaves)),
@@ -330,14 +364,7 @@ def booster_from_native_string(s: str) -> Booster:
     max_leaves = 1
     for tb in blocks:
         nl = int(tb.get("num_leaves", "1"))
-        if int(tb.get("num_cat", "0") or 0) > 0:
-            raise NotImplementedError(
-                "categorical splits in native LightGBM models are not "
-                "supported yet")
         dt = ints(tb.get("decision_type", ""))
-        if np.any(dt & 1):
-            raise NotImplementedError(
-                "categorical decision_type bit set in native model")
         missing_type = (dt >> 2) & 3
         if np.any(missing_type == 1):
             raise NotImplementedError(
@@ -360,6 +387,10 @@ def booster_from_native_string(s: str) -> Booster:
             lv=floats(tb.get("leaf_value", "")),
             lcount=floats(tb.get("leaf_count", "")),
             icount=floats(tb.get("internal_count", "")),
+            dt=dt,
+            num_cat=int(tb.get("num_cat", "0") or 0),
+            cat_bounds=ints(tb.get("cat_boundaries", "")),
+            cat_words=ints(tb.get("cat_threshold", "")),
         ))
         max_leaves = max(max_leaves, nl)
 
@@ -372,6 +403,10 @@ def booster_from_native_string(s: str) -> Booster:
     tv = np.zeros((t_total, m), np.float32)
     tc = np.zeros((t_total, m), np.float32)
     tg = np.zeros((t_total, m), np.float32)
+    any_cat = any(tb["num_cat"] > 0 for tb in parsed)
+    tcat = np.full((t_total, m), -1, np.int32) if any_cat else None
+    g_words: List[int] = []        # global bitset word pool
+    g_bounds: List[int] = [0]      # word offsets per global cat set
 
     for ti, tb in enumerate(parsed):
         nl = tb["nl"]
@@ -380,10 +415,31 @@ def booster_from_native_string(s: str) -> Booster:
         # single-leaf trees have the leaf at slot 0)
         for j in range(ni):
             tf[ti, j] = tb["sf"][j]
-            tt[ti, j] = tb["thr"][j]
             tg[ti, j] = tb["gain"][j] if j < len(tb["gain"]) else 0.0
             if j < len(tb["icount"]):
                 tc[ti, j] = tb["icount"][j]
+            is_cat = j < len(tb["dt"]) and bool(tb["dt"][j] & 1)
+            if is_cat:
+                # categorical: the threshold field is the per-tree cat-set
+                # index into cat_boundaries/cat_threshold; re-home its
+                # bitset words into the global pool
+                ci = int(tb["thr"][j])
+                if (ci + 1 >= len(tb["cat_bounds"])
+                        or int(tb["cat_bounds"][ci + 1])
+                        > len(tb["cat_words"])):
+                    raise ValueError(
+                        f"corrupt model: tree {ti} node {j} is a "
+                        f"categorical split but cat_boundaries/"
+                        f"cat_threshold rows are missing or too short")
+                lo = int(tb["cat_bounds"][ci])
+                hi = int(tb["cat_bounds"][ci + 1])
+                tcat[ti, j] = len(g_bounds) - 1
+                g_words.extend(
+                    int(w) & 0xFFFFFFFF for w in tb["cat_words"][lo:hi])
+                g_bounds.append(len(g_words))
+                tt[ti, j] = 0.0
+            else:
+                tt[ti, j] = tb["thr"][j]
             lc, rc = tb["lc"][j], tb["rc"][j]
             tl[ti, j] = lc if lc >= 0 else ni + (-lc - 1)
             tr[ti, j] = rc if rc >= 0 else ni + (-rc - 1)
@@ -414,6 +470,10 @@ def booster_from_native_string(s: str) -> Booster:
         best_iteration=best_iteration,
         num_features=max_feat + 1,
         feature_names=feature_names,
+        trees_cat=tcat,
+        cat_bitsets=(np.asarray(g_words, np.uint32) if any_cat else None),
+        cat_boundaries=(np.asarray(g_bounds, np.int32) if any_cat
+                        else None),
     )
     from synapseml_tpu.gbdt.boosting import _importances
     booster.feature_importance_split, booster.feature_importance_gain = (

@@ -118,6 +118,10 @@ def _expected_value(feat, left, right, value, cover, node=0) -> float:
 
 def tree_shap(booster, x: np.ndarray) -> np.ndarray:
     """SHAP contributions [N, F+1] (last column = expected value)."""
+    if getattr(booster, "trees_cat", None) is not None:
+        raise NotImplementedError(
+            "TreeSHAP is not implemented for models with categorical "
+            "splits (loaded native LightGBM model)")
     x = np.asarray(x, np.float64)
     n, f = x.shape
     k = booster.num_class

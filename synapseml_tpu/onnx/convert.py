@@ -47,6 +47,10 @@ def convert_lightgbm(model, input_size: Optional[int] = None,
     Respects ``best_iteration`` the way ``predict`` does.
     """
     b = _booster_of(model)
+    if getattr(b, "trees_cat", None) is not None:
+        raise NotImplementedError(
+            "ONNX tree ensembles cannot express LightGBM set-membership "
+            "categorical splits; convert a numerically-split model")
     k = max(1, b.num_class)
     t_total = b.num_trees
     if b.best_iteration >= 0:

@@ -142,9 +142,12 @@ def test_load_handcrafted_lightgbm_file():
     assert b.feature_importance_split.tolist() == [1.0, 1.0]
 
 
-def test_categorical_split_rejected():
+def test_malformed_categorical_block_raises():
+    """decision_type bit 0 without cat_boundaries/cat_threshold rows is a
+    corrupt model: the loader must raise, not mis-read thresholds.
+    (Well-formed categorical models load — see the categorical tests.)"""
     s = HANDMADE.replace("decision_type=2 2", "decision_type=1 1")
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="cat_boundaries"):
         Booster.load_string(s)
 
 
@@ -171,3 +174,122 @@ def test_legacy_json_still_loads():
     import json
     b2 = Booster.load_string(json.dumps(b.to_dict()))
     np.testing.assert_allclose(b2.predict(x), b.predict(x), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# categorical splits (native LightGBM interop)
+# ---------------------------------------------------------------------------
+
+def _cat_model_string():
+    """Hand-written native model: one tree, root categorical split on
+    feature 0 with left-set {1, 3, 40} (40 exercises the second bitset
+    word), then a numerical split on feature 1 in the left branch.
+
+    Node layout (LightGBM text): internal 0 = cat root, internal 1 =
+    numeric; leaves: -1, -2, -3.
+    """
+    # bitset for {1, 3}: word0 = 2^1 + 2^3 = 10; {40}: word1 = 2^8 = 256
+    return """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=regression
+feature_names=cat_f num_f
+feature_infos=none [0:10]
+tree_sizes=400
+
+Tree=0
+num_leaves=3
+num_cat=1
+split_feature=0 1
+split_gain=1 1
+threshold=0 5.0
+decision_type=1 8
+left_child=1 -1
+right_child=-3 -2
+cat_boundaries=0 2
+cat_threshold=10 256
+leaf_value=1.0 2.0 -3.0
+leaf_weight=1 1 1
+leaf_count=1 1 1
+internal_value=0 0
+internal_weight=2 2
+internal_count=2 2
+is_linear=0
+shrinkage=0.1
+
+end of trees
+
+feature_importances:
+
+parameters:
+[objective: regression]
+end of parameters
+
+pandas_categorical:null
+"""
+
+
+def test_categorical_native_model_loads_and_predicts():
+    b = Booster.load_string(_cat_model_string())
+    assert b.trees_cat is not None
+    # rows: [cat value, numeric value]
+    x = np.array([
+        [1, 2.0],    # cat in set -> left; 2 <= 5 -> leaf_value[0] = 1.0
+        [1, 9.0],    # cat in set -> left; 9 > 5 -> leaf 2.0
+        [3, 0.0],    # in set -> 1.0
+        [40, 0.0],   # second bitset word -> in set -> 1.0
+        [2, 0.0],    # not in set -> right leaf -3.0
+        [41, 0.0],   # not in set -> -3.0
+        [-5, 0.0],   # negative category -> right
+        [99, 0.0],   # out of range -> right
+        [np.nan, 0.0],  # missing -> right
+    ])
+    np.testing.assert_allclose(
+        b.predict(x), [1.0, 2.0, 1.0, 1.0, -3.0, -3.0, -3.0, -3.0, -3.0],
+        rtol=1e-6)
+
+
+def test_categorical_native_round_trip():
+    b = Booster.load_string(_cat_model_string())
+    s = b.save_string()
+    assert "num_cat=1" in s
+    assert "cat_threshold=10 256" in s
+    b2 = Booster.load_string(s)
+    x = np.array([[1, 2.0], [40, 0.0], [2, 0.0], [np.nan, 1.0]])
+    np.testing.assert_allclose(b2.predict(x), b.predict(x), rtol=1e-6)
+
+
+def test_categorical_model_guards():
+    import pytest as _pytest
+
+    b = Booster.load_string(_cat_model_string())
+    x = np.array([[1, 2.0]])
+    with _pytest.raises(NotImplementedError, match="categorical"):
+        b.predict_leaf(x)
+    from synapseml_tpu.gbdt.shap import tree_shap
+    with _pytest.raises(NotImplementedError, match="categorical"):
+        tree_shap(b, x)
+    from synapseml_tpu.onnx import convert_lightgbm
+    with _pytest.raises(NotImplementedError, match="categorical"):
+        convert_lightgbm(b, input_size=2)
+
+
+def test_categorical_json_round_trip():
+    """The legacy JSON serde must carry the cat tables too (review
+    finding: silent numeric downgrade)."""
+    import json as _json
+
+    b = Booster.load_string(_cat_model_string())
+    b2 = Booster.load_string(_json.dumps(b.to_dict()))
+    x = np.array([[1, 2.0], [40, 0.0], [2, 0.0]])
+    np.testing.assert_allclose(b2.predict(x), b.predict(x), rtol=1e-6)
+
+
+def test_truncated_cat_threshold_row_raises():
+    s = _cat_model_string().replace("cat_threshold=10 256",
+                                    "cat_threshold=10")
+    with pytest.raises(ValueError, match="cat_boundaries"):
+        Booster.load_string(s)
