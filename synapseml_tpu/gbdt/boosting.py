@@ -1161,8 +1161,6 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     use_goss = p.boosting_type == "goss"
     is_rf = p.boosting_type == "rf"
     use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
-    if is_dart and k > 1:
-        raise NotImplementedError("dart + multiclass not yet supported")
     if is_rank and group is None:
         raise ValueError("ranking objectives need a group array")
     renew_alpha = None
@@ -1263,15 +1261,21 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     # instead of a replicated [T, T] matrix, which would be O(T^2) device
     # memory at large iteration counts.
     if is_dart:
+        # drop granularity: per tree for k=1, per ITERATION for multiclass
+        # (LightGBM's convention — a round's k class trees share one
+        # weight; mirrors the single-device _train_dart)
         drng = np.random.default_rng(p.seed)
+        n_units = p.num_iterations if k > 1 else total_steps
         dart_drops: List[np.ndarray] = []
-        cur = np.zeros(total_steps, np.float32)
-        for t in range(total_steps):
+        cur = np.zeros(n_units, np.float32)
+        for t in range(n_units):
             if t == 0 or drng.random() < p.skip_drop:
                 dropped = np.empty(0, np.int64)
             else:
                 sel = drng.random(t) < p.drop_rate
-                dropped = np.nonzero(sel)[0][: p.max_drop]
+                dropped = np.nonzero(sel)[0]
+                if p.max_drop > 0:  # LightGBM: max_drop <= 0 = no limit
+                    dropped = dropped[: p.max_drop]
             dart_drops.append(dropped)
             kd = len(dropped)
             if kd:
@@ -1279,10 +1283,11 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                 cur[t] = p.learning_rate / (kd + 1.0)
             else:
                 cur[t] = p.learning_rate
-        dart_w_final = cur
+        dart_w_final = np.repeat(cur, k) if k > 1 else cur
 
-        _dart_run = np.zeros(total_steps, np.float32)
+        _dart_run = np.zeros(n_units, np.float32)
         _dart_next = [0]
+        _dart_row = [None]  # cached per-iteration row (k > 1)
 
         def dart_wmat_slice(start_step: int, n_steps: int) -> np.ndarray:
             """Replay the schedule incrementally for one chunk's rows;
@@ -1294,24 +1299,32 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                 t = start_step + j
                 if t >= total_steps:
                     break
-                w = _dart_run.copy()
-                w[dart_drops[t]] = 0.0
-                out[j] = w
-                kd = len(dart_drops[t])
-                if kd:
-                    _dart_run[dart_drops[t]] *= kd / (kd + 1.0)
-                    _dart_run[t] = p.learning_rate / (kd + 1.0)
-                else:
-                    _dart_run[t] = p.learning_rate
+                u, c = divmod(t, k)
+                if c == 0 or _dart_row[0] is None:
+                    w = _dart_run.copy()
+                    w[dart_drops[u]] = 0.0
+                    _dart_row[0] = np.repeat(w, k) if k > 1 else w
+                out[j] = _dart_row[0]
+                if c == k - 1:  # iteration complete
+                    kd = len(dart_drops[u])
+                    if kd:
+                        _dart_run[dart_drops[u]] *= kd / (kd + 1.0)
+                        _dart_run[u] = p.learning_rate / (kd + 1.0)
+                    else:
+                        _dart_run[u] = p.learning_rate
                 _dart_next[0] = t + 1
             if start_step + n_steps > total_steps:
                 _dart_next[0] = start_step + n_steps
             return out
 
         preds0 = put(np.zeros((total_steps, n), np.float32), P(None, "dp"))
+        # class of each step, for per-class dart score reconstruction
+        dart_class_oh = (np.eye(k, dtype=np.float32)[
+            np.arange(total_steps) % k] if k > 1 else None)
     else:
         dart_wmat_slice = None
         preds0 = None
+        dart_class_oh = None
 
     # -- validation state ------------------------------------------------
     tracker = _ValidTracker(p, k, init, valid_sets)
@@ -1360,23 +1373,44 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             return mask, g2, h2
 
         def step_fn(c_in, st):
-            scores_l, vsum_r, preds_l, rng = c_in
+            if is_dart and k > 1:
+                scores_l, vsum_r, preds_l, rng, d_g, d_h = c_in
+            else:
+                scores_l, vsum_r, preds_l, rng = c_in
             rng, key = jax.random.split(rng)
             cidx = st % k
             it = st // k
 
             if is_dart:
                 # wmat_r holds only this chunk's schedule rows
-                base = init + jnp.einsum("t,tn->n", wmat_r[st - step_off],
-                                         preds_l)
+                if k > 1:
+                    # base + all-class grads are identical across an
+                    # iteration's k steps (the iteration's own trees carry
+                    # weight 0 in its wmat row): recompute only on the
+                    # first class step, carry for the rest
+                    def recompute(_):
+                        b = init + jnp.einsum(
+                            "t,tn,tc->nc", wmat_r[st - step_off], preds_l,
+                            jnp.asarray(dart_class_oh))
+                        return obj_fn(b, yoh_l, wd_l)
+
+                    d_g, d_h = lax.cond(
+                        cidx == 0, recompute, lambda _: (d_g, d_h), None)
+                    base = None  # grads already taken below
+                else:
+                    base = init + jnp.einsum(
+                        "t,tn->n", wmat_r[st - step_off], preds_l)
             elif is_rf:
                 base = jnp.full_like(scores_l, init)
             else:
                 base = scores_l
 
             if k > 1:
-                g, h = obj_fn(base, yoh_l, wd_l)
-                g, h = g[:, cidx], h[:, cidx]
+                if is_dart:
+                    g, h = d_g[:, cidx], d_h[:, cidx]
+                else:
+                    g, h = obj_fn(base, yoh_l, wd_l)
+                    g, h = g[:, cidx], h[:, cidx]
             elif is_rank:
                 g, h = obj.lambdarank_grad(base, yd_l, gids_l,
                                            max_dcg_pos=p.max_position)
@@ -1470,6 +1504,8 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                 out = out + (m,)
             elif track_rank:
                 out = out + (vsum_r[:, 0],)
+            if is_dart and k > 1:
+                return (new_scores, vsum_r, preds_l, rng, d_g, d_h), out
             return (new_scores, vsum_r, preds_l, rng), out
 
         return lax.scan(step_fn, carry, steps)
@@ -1480,6 +1516,9 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         P(None, "dp") if is_dart else rep,               # preds stack
         rep,                                             # rng
     )
+    if is_dart and k > 1:
+        # carried all-class dart gradients (recomputed once per iteration)
+        carry_spec = carry_spec + (y_onehot_spec, y_onehot_spec)
     in_specs = (
         mat_spec, row_spec,
         (y_onehot_spec if k > 1 else None),
@@ -1520,6 +1559,10 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     carry = (scores, vsum0,
              preds0 if is_dart else put(np.zeros((1, 1), np.float32), rep),
              put(jax.random.PRNGKey(p.seed), rep))
+    if is_dart and k > 1:
+        carry = carry + (
+            put(np.zeros((n, k), np.float32), y_onehot_spec),
+            put(np.zeros((n, k), np.float32), y_onehot_spec))
     stacked = _chunked_boost_loop(
         run, carry, tracker, p, k, total_iters, chunk, track_dev, track_rank,
         vy_h if track else None, vg_h if track else None)
@@ -1566,7 +1609,9 @@ def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
             dropped = np.empty(0, np.int64)
         else:
             sel = rng.random(t) < p.drop_rate
-            dropped = np.nonzero(sel)[0][: p.max_drop]
+            dropped = np.nonzero(sel)[0]
+            if p.max_drop > 0:  # LightGBM: max_drop <= 0 = no limit
+                dropped = dropped[: p.max_drop]
         w = np.asarray(weights, np.float32)
         if len(dropped):
             w_used = w.copy()

@@ -606,3 +606,31 @@ def test_dart_multiclass():
     np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-5)
     acc = (probs.argmax(-1) == y).mean()
     assert acc > 0.85, acc
+
+
+def test_distributed_dart_multiclass_matches_single_device():
+    """Mesh dart multiclass: iteration-granular drops, per-class score
+    reconstruction; same seed must track the single-device ensemble."""
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(13)
+    n, d, k = 320, 5, 3
+    x = rng.normal(size=(n, d))
+    y = np.argmax(x[:, :k] + 0.2 * rng.normal(size=(n, k)),
+                  axis=1).astype(np.float64)
+    p = BoostParams(objective="multiclass", num_class=k,
+                    boosting_type="dart", num_iterations=6, num_leaves=7,
+                    drop_rate=0.4, skip_drop=0.0, seed=0)
+    b1 = train(p, x, y)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    b2 = train(p, x, y, mesh=mesh)
+    assert b2.num_trees == 6 * k
+    # iteration's k trees share one weight, matching single-device
+    np.testing.assert_allclose(b2.tree_weights, b1.tree_weights, rtol=1e-6)
+    tw = b2.tree_weights.reshape(6, k)
+    assert np.allclose(tw, tw[:, :1])
+    p1 = b1.predict(x)
+    p2 = b2.predict(x)
+    np.testing.assert_allclose(p2, p1, rtol=5e-3, atol=5e-3)
+    assert (p2.argmax(-1) == y).mean() > 0.8
