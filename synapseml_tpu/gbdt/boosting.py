@@ -546,6 +546,79 @@ def _compute_chunk(p: BoostParams, tracker, track_rank: bool,
     return max(1, min(chunk, total_iters))
 
 
+def _prepend_init_trees(init_model: Optional["Booster"], stacked):
+    """Prepend init_model's trees so the result is one whole booster
+    (the batch-model threading / resume half, shared by the single-chip
+    and mesh trainers)."""
+    if init_model is None:
+        return stacked
+    m_new = stacked.split_feature.shape[1]
+    m_old = init_model.trees_feature.shape[1]
+    m = max(m_new, m_old)
+
+    def padc(a, fill):
+        w = m - a.shape[1]
+        return a if w == 0 else np.pad(
+            a, ((0, 0), (0, w)), constant_values=fill)
+
+    return Tree(
+        split_feature=np.concatenate(
+            [padc(init_model.trees_feature, -1),
+             padc(stacked.split_feature, -1)]),
+        threshold=np.concatenate(
+            [padc(init_model.trees_threshold, 0),
+             padc(stacked.threshold, 0)]),
+        threshold_bin=np.concatenate(
+            [padc(np.zeros_like(init_model.trees_feature), 0),
+             padc(stacked.threshold_bin, 0)]),
+        left_child=np.concatenate(
+            [padc(init_model.trees_left, 0), padc(stacked.left_child, 0)]),
+        right_child=np.concatenate(
+            [padc(init_model.trees_right, 0),
+             padc(stacked.right_child, 0)]),
+        leaf_value=np.concatenate(
+            [padc(init_model.trees_value
+                  * init_model.tree_weights[:, None], 0),
+             padc(stacked.leaf_value, 0)]),
+        cover=np.concatenate(
+            [padc(init_model.trees_cover, 0), padc(stacked.cover, 0)]),
+        gain=np.concatenate(
+            [padc(init_model.trees_gain, 0), padc(stacked.gain, 0)]),
+    )
+
+
+def _chunk_callbacks(checkpoint_dir, init_model, p, k, init, f,
+                     feature_names, tracker, iteration_hook):
+    """Compose the per-chunk checkpoint writer and iteration observer —
+    shared by the single-chip and mesh trainers so checkpoint semantics
+    (init-tree prepending, best_iteration shifting, atomic save) cannot
+    drift between them."""
+    ckpt = None
+    if checkpoint_dir is not None:
+        acc: List = []
+
+        def ckpt(chunk_trees, iters_done):
+            acc.append(chunk_trees)
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *acc)
+            booster = _assemble_booster(
+                _prepend_init_trees(init_model, stacked), p, k, init, f,
+                feature_names, tracker, compute_importances=False)
+            if init_model is not None and booster.best_iteration >= 0:
+                booster.best_iteration += init_model.num_trees // max(k, 1)
+            save_checkpoint(checkpoint_dir, booster, iters_done,
+                            p.num_iterations)
+    if ckpt is None and iteration_hook is None:
+        return None
+
+    def on_chunk(chunk_trees, iters_done):
+        if ckpt is not None:
+            ckpt(chunk_trees, iters_done)
+        if iteration_hook is not None:
+            iteration_hook(min(iters_done, p.num_iterations))
+    return on_chunk
+
+
 def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
                         total_iters: int, chunk: int, track_dev: bool,
                         track_rank: bool, vy_h, vg_h, on_chunk=None,
@@ -868,24 +941,8 @@ def train(
     # tree_learner=data_parallel socket reduce-scatter, SURVEY.md 2.10).
     # Dispatch happens BEFORE any host->device transfer so the large [N,F]
     # matrix is only placed once, with its mesh sharding.
-    if mesh is not None:
-        if init_model is not None or checkpoint_dir is not None:
-            raise NotImplementedError(
-                "init_model/checkpointing are single-device for now; "
-                "fit the resumed model without a mesh")
-        if learning_rates is not None:
-            raise NotImplementedError(
-                "per-iteration learning_rates are single-device for now")
-        return _train_distributed(
-            p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
-            thresholds, valid_sets, feature_names, group=group)
-
-    binned = jnp.asarray(binned_np)
-    yd = jnp.asarray(y)
-    wd = jnp.asarray(weight, jnp.float32) if weight is not None else None
-    group_ids = jnp.asarray(group, jnp.int32) if group is not None else None
-    is_rf = p.boosting_type == "rf"
-
+    # init_model validation + margins, shared by both dispatch paths
+    init_margins = None
     if init_model is not None:
         if p.boosting_type in ("dart", "rf"):
             raise NotImplementedError(
@@ -893,15 +950,45 @@ def train(
                 f"{p.boosting_type} (dart rescales past trees; rf averages)")
         if init_model.num_class != k:
             raise ValueError("init_model num_class mismatch")
-        # continue from the existing margins; keep its init score so the
-        # combined booster's folded-init semantics stay consistent.
-        # num_iteration is passed explicitly: predict_raw would otherwise
-        # truncate at best_iteration while _with_init prepends ALL trees
+        if init_model.trees_cat is not None:
+            raise NotImplementedError(
+                "continuation from a model with categorical splits is not "
+                "supported (the combined booster cannot merge bitset pools "
+                "yet)")
+        # keep its init score so the combined booster's folded-init
+        # semantics stay consistent; num_iteration is passed explicitly:
+        # predict_raw would otherwise truncate at best_iteration while
+        # _prepend_init_trees prepends ALL trees
         init = float(init_model.init_score)
         n_init_iters = init_model.num_trees // max(k, 1)
-        base_raw = init_model.predict_raw(x, num_iteration=n_init_iters)
+        init_margins = init_model.predict_raw(
+            x, num_iteration=n_init_iters).reshape(n, k)
+    if checkpoint_dir is not None and p.boosting_type == "dart":
+        raise NotImplementedError(
+            "step checkpointing is not defined for dart (past trees "
+            "are rescaled every round)")
+
+    if mesh is not None:
+        if learning_rates is not None:
+            raise NotImplementedError(
+                "per-iteration learning_rates are single-device for now")
+        return _train_distributed(
+            p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
+            thresholds, valid_sets, feature_names, group=group,
+            init_model=init_model, init_margins=init_margins,
+            checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+            iteration_hook=iteration_hook)
+
+    binned = jnp.asarray(binned_np)
+    yd = jnp.asarray(y)
+    wd = jnp.asarray(weight, jnp.float32) if weight is not None else None
+    group_ids = jnp.asarray(group, jnp.int32) if group is not None else None
+    is_rf = p.boosting_type == "rf"
+
+    if init_margins is not None:
+        # continue from the existing margins (validated above)
         scores = jnp.asarray(
-            base_raw.reshape(n, k) if k > 1 else base_raw, jnp.float32)
+            init_margins if k > 1 else init_margins[:, 0], jnp.float32)
     elif k > 1:
         scores = jnp.zeros((n, k), jnp.float32) + init
     else:
@@ -912,10 +999,6 @@ def train(
             raise NotImplementedError(
                 "per-iteration learning_rates are not defined for dart "
                 "(tree weights are renormalized every round)")
-        if checkpoint_dir is not None:
-            raise NotImplementedError(
-                "step checkpointing is not defined for dart (past trees "
-                "are rescaled every round)")
         return _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init,
                            n, f, valid_sets, feature_names, k=k)
 
@@ -1003,67 +1086,8 @@ def train(
     if checkpoint_dir is not None and checkpoint_every > 0:
         chunk = min(chunk, max(1, int(checkpoint_every)))
 
-    def _with_init(stacked):
-        """Prepend init_model trees so the result is one whole booster."""
-        if init_model is None:
-            return stacked
-        m_new = stacked.split_feature.shape[1]
-        m_old = init_model.trees_feature.shape[1]
-        m = max(m_new, m_old)
-
-        def padc(a, fill):
-            w = m - a.shape[1]
-            return a if w == 0 else np.pad(
-                a, ((0, 0), (0, w)), constant_values=fill)
-
-        return Tree(
-            split_feature=np.concatenate(
-                [padc(init_model.trees_feature, -1),
-                 padc(stacked.split_feature, -1)]),
-            threshold=np.concatenate(
-                [padc(init_model.trees_threshold, 0),
-                 padc(stacked.threshold, 0)]),
-            threshold_bin=np.concatenate(
-                [padc(np.zeros_like(init_model.trees_feature), 0),
-                 padc(stacked.threshold_bin, 0)]),
-            left_child=np.concatenate(
-                [padc(init_model.trees_left, 0), padc(stacked.left_child, 0)]),
-            right_child=np.concatenate(
-                [padc(init_model.trees_right, 0),
-                 padc(stacked.right_child, 0)]),
-            leaf_value=np.concatenate(
-                [padc(init_model.trees_value
-                      * init_model.tree_weights[:, None], 0),
-                 padc(stacked.leaf_value, 0)]),
-            cover=np.concatenate(
-                [padc(init_model.trees_cover, 0), padc(stacked.cover, 0)]),
-            gain=np.concatenate(
-                [padc(init_model.trees_gain, 0), padc(stacked.gain, 0)]),
-        )
-
-    ckpt_chunk = None
-    if checkpoint_dir is not None:
-        _ck_acc: List = []
-
-        def ckpt_chunk(chunk_trees, iters_done):
-            _ck_acc.append(chunk_trees)
-            stacked_ck = jax.tree_util.tree_map(
-                lambda *xs: np.concatenate(xs, axis=0), *_ck_acc)
-            booster = _assemble_booster(
-                _with_init(stacked_ck), p, k, init, f, feature_names,
-                tracker, compute_importances=False)
-            if init_model is not None and booster.best_iteration >= 0:
-                booster.best_iteration += init_model.num_trees // max(k, 1)
-            save_checkpoint(checkpoint_dir, booster, iters_done,
-                            p.num_iterations)
-
-    on_chunk = None
-    if ckpt_chunk is not None or iteration_hook is not None:
-        def on_chunk(chunk_trees, iters_done):
-            if ckpt_chunk is not None:
-                ckpt_chunk(chunk_trees, iters_done)
-            if iteration_hook is not None:
-                iteration_hook(min(iters_done, p.num_iterations))
+    on_chunk = _chunk_callbacks(checkpoint_dir, init_model, p, k, init, f,
+                                feature_names, tracker, iteration_hook)
 
     carry = (scores, vsum0, jax.random.PRNGKey(p.seed))
     stacked = _chunked_boost_loop(
@@ -1072,8 +1096,9 @@ def train(
         vy_h if tracker.enabled else None,
         vg_h if tracker.enabled else None, on_chunk=on_chunk,
         on_stop=iteration_hook)
-    booster = _assemble_booster(_with_init(stacked), p, k, init, f,
-                                feature_names, tracker)
+    booster = _assemble_booster(
+        _prepend_init_trees(init_model, stacked), p, k, init, f,
+        feature_names, tracker)
     if init_model is not None and booster.best_iteration >= 0:
         # best_iteration indexes the combined tree stack
         booster.best_iteration += init_model.num_trees // max(k, 1)
@@ -1131,7 +1156,9 @@ def _importances(b: Booster, num_features: int):
 
 def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                        bdev, thresholds, valid_sets, feature_names,
-                       group=None):
+                       group=None, init_model=None, init_margins=None,
+                       checkpoint_dir=None, checkpoint_every=0,
+                       iteration_hook=None):
     """dp-sharded training: shard_map over the mesh's 'dp' axis, with the
     boosting loop scanned on device (one host sync per chunk, as in the
     single-chip path).
@@ -1216,6 +1243,8 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         y = lay(y)
         if weight is not None:
             weight = lay(weight)
+        if init_margins is not None:
+            init_margins = lay(init_margins)
         # padded rows get unique negative ids -> no pairs -> zero gradients
         padidx = np.nonzero(~pad_mask_np)[0]
         gids_np[padidx] = -(np.arange(len(padidx)) + 1)
@@ -1229,6 +1258,11 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             y = np.concatenate([y, np.zeros(pad, y.dtype)])
             if weight is not None:
                 weight = np.concatenate([weight, np.zeros(pad, weight.dtype)])
+            if init_margins is not None:
+                init_margins = np.vstack(
+                    [init_margins,
+                     np.zeros((pad, init_margins.shape[1]),
+                              init_margins.dtype)])
             pad_mask_np[n0:] = False
         n = n0 + pad
         gids_np = None
@@ -1248,10 +1282,15 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     y_onehot_spec = P("dp", None)
     if k > 1:
         yoh = put(jax.nn.one_hot(jnp.asarray(y.astype(np.int32)), k), y_onehot_spec)
-        scores = put(np.zeros((n, k), np.float32) + init, y_onehot_spec)
+        scores0 = (init_margins.astype(np.float32) if init_margins is not None
+                   else np.zeros((n, k), np.float32) + init)
+        scores = put(scores0, y_onehot_spec)
     else:
         yoh = None
-        scores = put(np.zeros(n, np.float32) + init, row_spec)
+        scores0 = (init_margins[:, 0].astype(np.float32)
+                   if init_margins is not None
+                   else np.zeros(n, np.float32) + init)
+        scores = put(scores0, row_spec)
 
     total_steps = p.num_iterations * k
 
@@ -1336,7 +1375,15 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         vy_d = put(np.asarray(tracker.sets[0][1]), rep)
         vg_h = tracker.sets[0][3]
         vy_h = np.asarray(tracker.sets[0][1])
-        vsum0 = put(np.zeros((vy_h.shape[0], k), np.float32), rep)
+        if init_model is not None:
+            # valid margins must include the resumed model's contribution
+            vraw = init_model.predict_raw(
+                np.asarray(tracker.sets[0][0]),
+                num_iteration=init_model.num_trees // max(k, 1))
+            vsum0 = put(np.asarray(vraw).reshape(-1, k).astype(np.float32)
+                        - init, rep)
+        else:
+            vsum0 = put(np.zeros((vy_h.shape[0], k), np.float32), rep)
     else:
         vx_d = vy_d = None
         vsum0 = put(np.zeros((0, k), np.float32), rep)
@@ -1563,11 +1610,23 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         carry = carry + (
             put(np.zeros((n, k), np.float32), y_onehot_spec),
             put(np.zeros((n, k), np.float32), y_onehot_spec))
+
+    if checkpoint_dir is not None and checkpoint_every > 0:
+        chunk = min(chunk, max(1, int(checkpoint_every)))
+    on_chunk = _chunk_callbacks(checkpoint_dir, init_model, p, k, init, f,
+                                feature_names, tracker, iteration_hook)
+
     stacked = _chunked_boost_loop(
         run, carry, tracker, p, k, total_iters, chunk, track_dev, track_rank,
-        vy_h if track else None, vg_h if track else None)
-    return _assemble_booster(stacked, p, k, init, f, feature_names, tracker,
-                             dart_w_final=dart_w_final if is_dart else None)
+        vy_h if track else None, vg_h if track else None, on_chunk=on_chunk,
+        on_stop=iteration_hook)
+    booster = _assemble_booster(
+        _prepend_init_trees(init_model, stacked), p, k, init, f,
+        feature_names, tracker,
+        dart_w_final=dart_w_final if is_dart else None)
+    if init_model is not None and booster.best_iteration >= 0:
+        booster.best_iteration += init_model.num_trees // max(k, 1)
+    return booster
 
 
 def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,

@@ -133,3 +133,74 @@ def test_checkpoint_under_early_stopping_keeps_full_stack(tmp_path):
     assert loaded.best_iteration == meta["best_iteration"]
     np.testing.assert_allclose(loaded.predict(X), b.predict(X),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_mesh_init_model_continuation_and_checkpoint(tmp_path):
+    """Distributed resume: init_model continuation and step checkpoints
+    on the dp mesh must track the single-device behavior."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    p20 = BoostParams(objective="binary", num_iterations=20, num_leaves=7)
+    full = train(p20, X, Y, mesh=mesh)
+    first = train(dataclasses.replace(p20, num_iterations=8), X, Y,
+                  mesh=mesh)
+    resumed = train(dataclasses.replace(p20, num_iterations=12), X, Y,
+                    mesh=mesh, init_model=first)
+    assert resumed.num_trees == 20
+    np.testing.assert_allclose(resumed.predict(X), full.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+    ckpt = str(tmp_path / "ck_mesh")
+    train(dataclasses.replace(p20, num_iterations=12), X, Y, mesh=mesh,
+          checkpoint_dir=ckpt, checkpoint_every=4)
+    b, meta = load_checkpoint(ckpt)
+    assert meta["iterations_done"] in (4, 8, 12)
+    assert b.num_trees == meta["iterations_done"]
+    # a checkpointed partial resumes on the mesh to the full ensemble
+    remaining = p20.num_iterations - meta["iterations_done"]
+    if remaining > 0:
+        resumed2 = train(
+            dataclasses.replace(p20, num_iterations=remaining), X, Y,
+            mesh=mesh, init_model=b)
+        assert resumed2.num_trees == 20
+
+
+def test_mesh_multiclass_init_model_continuation():
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(400, 5))
+    y = np.argmax(x[:, :3], axis=1).astype(np.float64)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    p = BoostParams(objective="multiclass", num_class=3,
+                    num_iterations=10, num_leaves=7)
+    full = train(p, x, y, mesh=mesh)
+    first = train(dataclasses.replace(p, num_iterations=4), x, y, mesh=mesh)
+    resumed = train(dataclasses.replace(p, num_iterations=6), x, y,
+                    mesh=mesh, init_model=first)
+    assert resumed.num_trees == 30
+    np.testing.assert_allclose(resumed.predict(x), full.predict(x),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_mesh_iteration_hook_and_cat_init_guard():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    seen = []
+    p = BoostParams(objective="binary", num_iterations=6, num_leaves=7)
+    train(p, X, Y, mesh=mesh, iteration_hook=lambda it: seen.append(it))
+    assert seen and seen[-1] == 6
+
+    # continuation from a categorical-split model must refuse loudly
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_lgbm_format import _cat_model_string
+
+    cat_b = Booster.load_string(_cat_model_string())
+    with pytest.raises(NotImplementedError, match="categorical"):
+        train(p, X[:, :2], Y, init_model=cat_b)
