@@ -731,6 +731,17 @@ def _scan(ctx, *inputs, env=None):
     scans = [xp0.moveaxis(xp0.asarray(s), in_axes[j] % np.ndim(s), 0)
              for j, s in enumerate(scans)]
     length = int(scans[0].shape[0]) if scans else 0
+
+    # long sequences compile as ONE lax.scan body instead of `length`
+    # unrolled copies (compile time would grow linearly otherwise); short
+    # ones unroll, which also tolerates bodies with host-static needs
+    if length > 16:
+        try:
+            return _scan_via_lax(body, env, state, scans, in_dirs,
+                                 out_dirs, out_axes, n_state, n_scan_out)
+        except Exception:  # noqa: BLE001 — body demands host-static values
+            pass
+
     acc: List[List[Any]] = [[] for _ in range(n_scan_out)]
     for i in range(length):
         sub_env = dict(env or {})
@@ -752,6 +763,36 @@ def _scan(ctx, *inputs, env=None):
         st = xp.stack([xp.asarray(v) for v in a])
         stacked.append(xp.moveaxis(st, 0, out_axes[j] % st.ndim))
     out = tuple(state) + tuple(stacked)
+    return out if len(out) != 1 else out[0]
+
+
+def _scan_via_lax(body, env, state, scans, in_dirs, out_dirs, out_axes,
+                  n_state: int, n_scan_out: int):
+    outer = dict(env or {})
+    state0 = tuple(jnp.asarray(s) for s in state)
+    xs = tuple(
+        jnp.flip(jnp.asarray(s), 0) if in_dirs[j] else jnp.asarray(s)
+        for j, s in enumerate(scans)
+    )
+
+    def body_fn(carry, slices):
+        sub_env = dict(outer)
+        vals = list(carry) + list(slices)
+        for nm, v in zip(body.input_names, vals):
+            sub_env[nm] = v
+        outs = body.run(sub_env)
+        new_state = tuple(jnp.asarray(o) for o in outs[:n_state])
+        scan_outs = tuple(jnp.asarray(o) for o in outs[n_state:])
+        return new_state, scan_outs
+
+    final_state, stacked_raw = lax.scan(body_fn, state0, xs)
+    stacked = []
+    for j in range(n_scan_out):
+        st = stacked_raw[j]
+        if out_dirs[j]:
+            st = jnp.flip(st, 0)
+        stacked.append(jnp.moveaxis(st, 0, out_axes[j] % st.ndim))
+    out = tuple(final_state) + tuple(stacked)
     return out if len(out) != 1 else out[0]
 
 

@@ -671,3 +671,50 @@ def test_scan_cumsum_forward_and_reverse():
         src = seq[::-1] if reverse else seq
         np.testing.assert_allclose(np.asarray(sfinal), seq.sum(0))
         np.testing.assert_allclose(np.asarray(cums), np.cumsum(src, 0))
+
+
+def test_scan_long_sequence_uses_lax_scan():
+    """Length > 16 lowers to one lax.scan body; results must match the
+    unrolled semantics (cumsum check at length 64, reverse direction)."""
+    from synapseml_tpu.onnx.proto import Msg
+
+    body = Msg("GraphProto")
+    body.name = "scan_body_long"
+    for nm in ("s_in", "x_t"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.input.append(vi)
+    add = Msg("NodeProto")
+    add.op_type = "Add"
+    add.input = ["s_in", "x_t"]
+    add.output = ["s_out"]
+    add.name = "sb_add"
+    add.attribute = []
+    body.node = [add]
+    for nm in ("s_out", "s_out"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.output.append(vi)
+
+    for reverse in (0, 1):
+        g = GraphBuilder(opset=17)
+        g.add_input("seq", np.float32, [64, 3])
+        s0 = g.add_initializer("s0", np.zeros(3, np.float32))
+        g.add_node("Scan", [s0, "seq"], outputs=["sfinal", "cums"],
+                   body=body, num_scan_inputs=1,
+                   scan_input_directions=[reverse])
+        g.add_output("sfinal", np.float32, [3])
+        g.add_output("cums", np.float32, [64, 3])
+        gi = import_model(g.to_bytes())
+        seq = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+        sfinal, cums = gi.apply(gi.params, seq)
+        src = seq[::-1] if reverse else seq
+        np.testing.assert_allclose(np.asarray(sfinal), seq.sum(0),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cums), np.cumsum(src, 0),
+                                   rtol=1e-4, atol=1e-5)
+        # jit the whole graph (the path real models take)
+        import jax
+        fn = jax.jit(gi.bind())
+        np.testing.assert_allclose(np.asarray(fn(seq)[1]),
+                                   np.cumsum(src, 0), rtol=1e-4, atol=1e-5)
