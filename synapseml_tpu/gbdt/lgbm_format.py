@@ -377,6 +377,13 @@ def booster_from_native_string(s: str) -> Booster:
                 "predictor routes NaN to the right child, so predictions "
                 "differ from lib_lightgbm only on rows containing NaN",
                 RuntimeWarning, stacklevel=2)
+        if np.any((dt & 1 == 1) & (missing_type != 2)):
+            warnings.warn(
+                "model has categorical splits with missing_type != NaN; "
+                "lib_lightgbm casts NaN to category 0 there, while this "
+                "predictor routes NaN right, so predictions differ from "
+                "lib_lightgbm only on rows with NaN in those features",
+                RuntimeWarning, stacklevel=2)
         parsed.append(dict(
             nl=nl,
             sf=ints(tb.get("split_feature", "")),

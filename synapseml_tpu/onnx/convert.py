@@ -19,6 +19,7 @@ weights so the standard LOGISTIC post-transform reproduces
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import numpy as np
@@ -84,7 +85,12 @@ def convert_lightgbm(model, input_size: Optional[int] = None,
     left = np.asarray(b.trees_left)
     right = np.asarray(b.trees_right)
     value = np.asarray(b.trees_value)
-    tw = np.asarray(b.tree_weights)
+    tw = np.asarray(b.tree_weights, dtype=np.float64).copy()
+    if b.params.boosting_type == "rf" and t_total > 0:
+        # rf margins average over the trees actually exported; a model
+        # truncated at best_iteration must renormalize from 1/T_total to
+        # 1/T_kept, exactly as Booster._raw_scores does
+        tw[:] = 1.0 / max(t_total // k, 1)
     m = feat.shape[1]
 
     for t in range(t_total):
@@ -123,12 +129,27 @@ def convert_lightgbm(model, input_size: Optional[int] = None,
         n_labels = k if k > 1 else 2
         post = "SOFTMAX" if k > 1 else "LOGISTIC"
         base = [init] * k if k > 1 else [init * scale]
+        # a fitted classification model remembers the original labels it
+        # remapped to dense ids; export those so the ONNX 'label' output
+        # agrees with model.transform's prediction column
+        labels = list(range(n_labels))
+        lv = getattr(model, "label_values", None)
+        if lv is not None and len(lv) >= n_labels:
+            if all(float(v) == int(v) for v in lv[:n_labels]):
+                labels = [int(v) for v in lv[:n_labels]]
+            else:
+                warnings.warn(
+                    f"label_values {list(lv[:n_labels])} are not integral; "
+                    f"classlabels_int64s cannot express them, so the ONNX "
+                    f"'label' output speaks dense indices 0..{n_labels - 1} "
+                    f"instead of the original labels",
+                    RuntimeWarning, stacklevel=2)
         g.add_node(
             "TreeEnsembleClassifier", [x],
             outputs=["label", "probabilities"], domain="ai.onnx.ml",
             class_treeids=w_tree, class_nodeids=w_node, class_ids=w_id,
             class_weights=[float(v) for v in w_val],
-            classlabels_int64s=list(range(n_labels)),
+            classlabels_int64s=labels,
             post_transform=post, base_values=[float(v) for v in base],
             **common)
         g.add_output("label", np.int64, ["N"])

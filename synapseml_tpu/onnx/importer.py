@@ -21,6 +21,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import math
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -628,29 +629,30 @@ _if._needs_env = True
 
 @op("Loop")
 def _loop(ctx, max_trip, cond, *v_initial, env=None):
-    """ONNX Loop with a host-static trip count / termination condition
-    (the exported for-range pattern). Body inputs: (iteration, cond_in,
-    *carried); outputs: (cond_out, *carried, *scan_outputs); scan
-    outputs stack along a new leading axis. Data-dependent trip counts
-    would need lax.while_loop with shape-invariant carries — out of
-    scope until a real model demands it."""
+    """ONNX Loop. Host-static trip counts / conditions (the exported
+    for-range pattern) run as a host loop with full scan-output support.
+    Traced (data-dependent) trip counts or termination conditions — the
+    scripted-while pattern real exporters emit — lower to
+    ``lax.while_loop`` with shape-invariant carries; scan outputs are
+    unsupported there because their length would be data-dependent,
+    which XLA's static-shape model cannot express."""
     body = ctx.attrs["__lowered_body__"]  # lowered at import time
     in_names = body.input_names
     if max_trip is None and cond is None:
         raise ValueError("Loop needs a trip count or a condition")
-    if max_trip is not None and not _is_host(max_trip):
-        raise NotImplementedError(
-            "Loop: data-dependent trip counts are not supported")
+    n_carried = len(v_initial)
+    n_scan = len(body.output_names) - 1 - n_carried
+    traced_entry = (
+        (max_trip is not None and not _is_host(max_trip))
+        or (cond is not None and not _is_host(cond)))
+    if traced_entry:
+        return _loop_via_while(body, env, max_trip, cond, v_initial, n_scan)
     trips = int(np.asarray(max_trip).reshape(())) if max_trip is not None \
         else None
     keep_going = True if cond is None else bool(
-        np.asarray(cond).reshape(())) if _is_host(cond) else None
-    if keep_going is None:
-        raise NotImplementedError(
-            "Loop: traced entry conditions are not supported")
+        np.asarray(cond).reshape(()))
 
     carried = list(v_initial)
-    n_carried = len(carried)
     scan_acc: List[List[Any]] = []
     i = 0
     while keep_going and (trips is None or i < trips):
@@ -669,15 +671,13 @@ def _loop(ctx, max_trip, cond, *v_initial, env=None):
         if _is_host(cond_out):
             keep_going = bool(np.asarray(cond_out).reshape(()))
         else:
-            # a device-computed condition cannot drive this host loop;
-            # ignoring it would run all iterations and silently produce
-            # wrong results (ONNX continues while i < M AND cond)
-            raise NotImplementedError(
-                "Loop: data-dependent termination conditions are not "
-                "supported (the body's cond_out is a traced value)")
+            # the body computes its own termination on device — restart
+            # as a lax.while_loop (the body is functional, so the partial
+            # host iteration above is discarded without side effects)
+            return _loop_via_while(
+                body, env, max_trip, cond, v_initial, n_scan)
         i += 1
 
-    n_scan = len(body.output_names) - 1 - n_carried
     if i == 0 and n_scan > 0:
         # zero-trip loops still owe empty scan outputs; probe the body
         # once for their shapes (results discarded)
@@ -702,6 +702,48 @@ def _loop(ctx, max_trip, cond, *v_initial, env=None):
 
 
 _loop._needs_env = True
+
+
+def _loop_via_while(body, env, max_trip, cond, v_initial, n_scan: int):
+    """Data-dependent Loop as ``lax.while_loop``: continue while
+    ``i < M  AND  cond`` with carry ``(i, cond, *carried)``. Carried
+    values must keep shape and dtype across iterations (the ONNX spec
+    allows shape changes; XLA does not — the jax error surfaces that).
+    Parity target: the reference executes these natively via
+    onnxruntime (deep-learning/.../onnx/ONNXModel.scala:173-193)."""
+    if n_scan > 0:
+        raise NotImplementedError(
+            "Loop: scan outputs with a data-dependent trip count have a "
+            "data-dependent shape, which XLA cannot express; restructure "
+            "the model to a static trip count or carried accumulators")
+    outer = dict(env or {})
+    in_names = body.input_names
+    trips = None if max_trip is None else jnp.asarray(max_trip).reshape(())
+    cond0 = jnp.asarray(True) if cond is None \
+        else jnp.asarray(cond).reshape(()).astype(bool)
+    carried0 = tuple(jnp.asarray(v) for v in v_initial)
+
+    def pred_fn(carry):
+        i, keep = carry[0], carry[1]
+        return keep if trips is None else jnp.logical_and(i < trips, keep)
+
+    def body_fn(carry):
+        i, keep, carried = carry[0], carry[1], carry[2:]
+        sub_env = dict(outer)
+        vals = [i, keep] + list(carried)
+        for nm, v in zip(in_names, vals):
+            sub_env[nm] = v
+        outs = body.run(sub_env)
+        cond_out = jnp.asarray(outs[0]).reshape(()).astype(bool)
+        new_carried = tuple(
+            jnp.asarray(o).astype(c.dtype)
+            for o, c in zip(outs[1:], carried))
+        return (i + 1, cond_out) + new_carried
+
+    init = (jnp.asarray(np.int64(0)), cond0) + carried0
+    final = jax.lax.while_loop(pred_fn, body_fn, init)
+    out = final[2:]
+    return out if len(out) != 1 else out[0]
 
 
 @op("Scan")
@@ -739,8 +781,21 @@ def _scan(ctx, *inputs, env=None):
         try:
             return _scan_via_lax(body, env, state, scans, in_dirs,
                                  out_dirs, out_axes, n_state, n_scan_out)
-        except Exception:  # noqa: BLE001 — body demands host-static values
-            pass
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError,
+                NotImplementedError, ValueError) as e:
+            # ConcretizationTypeError/Tracer*Error: int()/bool()/asarray on
+            # a tracer; ValueError: _static_int_list's "must be statically
+            # known"; NotImplementedError: ops that only do host execution.
+            # only host-static demands from the body justify trading the
+            # single compiled lax.scan body for `length` unrolled copies;
+            # genuine op bugs must surface, not silently unroll
+            warnings.warn(
+                f"Scan: body needs host-static values ({type(e).__name__}); "
+                f"falling back to unrolled execution over {length} steps",
+                RuntimeWarning, stacklevel=2)
 
     acc: List[List[Any]] = [[] for _ in range(n_scan_out)]
     for i in range(length):

@@ -24,6 +24,7 @@ cannot hold strings.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -36,6 +37,11 @@ from synapseml_tpu.onnx.importer import _all_host, _is_host, op
 # branch-mode codes for the vectorized comparator
 _MODES = {"BRANCH_LEQ": 0, "BRANCH_LT": 1, "BRANCH_GTE": 2, "BRANCH_GT": 3,
           "BRANCH_EQ": 4, "BRANCH_NEQ": 5, "LEAF": 6}
+
+# the dense GEMM formulation trades memory for MXU throughput; these bound
+# the [T, M, n_leaves] path tensor (see _TreeTables)
+_PATH_WARN_BYTES = 256 << 20
+_PATH_GUARD_BYTES = 2 << 30
 
 
 def _cached(ctx, key: str, build):
@@ -137,6 +143,25 @@ class _TreeTables:
                     stack.append((int(right[t, n]), pos, neg + [n]))
             leaves_per_tree.append(leaves)
         n_leaves = max(len(lv) for lv in leaves_per_tree)
+
+        # the dense [T, M, n_leaves] path tensor scales as trees x nodes x
+        # leaves: fine at notebook scale, but a 1000-tree deep ensemble
+        # would allocate gigabytes at import — surface that before numpy
+        # does it silently
+        path_bytes = tn * m * n_leaves * 4
+        if path_bytes > _PATH_GUARD_BYTES:
+            raise MemoryError(
+                f"tree-ensemble path tensor would allocate "
+                f"{path_bytes / (1 << 30):.1f} GiB "
+                f"({tn} trees x {m} nodes x {n_leaves} leaves); this "
+                f"GEMM formulation targets notebook-scale ensembles — "
+                f"score via the native GBDT predictor instead")
+        if path_bytes > _PATH_WARN_BYTES:
+            warnings.warn(
+                f"tree-ensemble path tensor allocates "
+                f"{path_bytes / (1 << 20):.0f} MiB "
+                f"({tn} trees x {m} nodes x {n_leaves} leaves)",
+                RuntimeWarning, stacklevel=2)
 
         path = np.zeros((tn, m, n_leaves), np.float32)   # +1 / -1 / 0
         c0 = np.zeros((tn, n_leaves), np.float32)        # sum of negatives

@@ -293,3 +293,24 @@ def test_truncated_cat_threshold_row_raises():
                                     "cat_threshold=10")
     with pytest.raises(ValueError, match="cat_boundaries"):
         Booster.load_string(s)
+
+
+def test_categorical_non_nan_missing_type_warns():
+    """lib_lightgbm casts NaN to category 0 when a categorical node has
+    missing_type != NaN; this predictor routes NaN right. The loader
+    surfaces the divergence the same way it does for default_left."""
+    import warnings as _warnings
+
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        Booster.load_string(_cat_model_string())  # decision_type=1: None
+    assert any("categorical splits with missing_type" in str(w.message)
+               for w in rec)
+    # missing_type=NaN categorical nodes (decision_type = 1 | 2<<2 = 9)
+    # are the faithful case: no warning
+    s = _cat_model_string().replace("decision_type=1 8",
+                                    "decision_type=9 8")
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        Booster.load_string(s)
+    assert not any("categorical splits" in str(w.message) for w in rec)

@@ -531,7 +531,122 @@ def test_loop_static_trip_count():
                                [[1, 2], [2, 4], [3, 6]])
 
 
-def test_loop_zero_trips_and_traced_cond_rejected():
+def _while_body(with_scan=False):
+    """Loop body: acc_out = acc + acc; cond_out = sum(acc_out) < limit
+    (limit captured from the outer scope) — the scripted-while pattern."""
+    from synapseml_tpu.onnx.proto import Msg
+
+    body = Msg("GraphProto")
+    body.name = "wbody"
+    for nm in ("iter", "cond_in", "acc"):
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.input.append(vi)
+    dbl = Msg("NodeProto")
+    dbl.op_type = "Add"
+    dbl.input = ["acc", "acc"]
+    dbl.output = ["acc_out"]
+    dbl.name = "w_dbl"
+    dbl.attribute = []
+    red = Msg("NodeProto")
+    red.op_type = "ReduceSum"
+    red.input = ["acc_out"]
+    red.output = ["s"]
+    red.name = "w_sum"
+    red.attribute = []
+    att = Msg("AttributeProto")
+    att.name = "keepdims"
+    att.type = 2  # INT
+    att.i = 0
+    red.attribute.append(att)
+    less = Msg("NodeProto")
+    less.op_type = "Less"
+    less.input = ["s", "limit"]  # limit captured from the outer scope
+    less.output = ["cond_out"]
+    less.name = "w_less"
+    less.attribute = []
+    body.node = [dbl, red, less]
+    outs = ["cond_out", "acc_out"] + (["acc_out"] if with_scan else [])
+    for nm in outs:
+        vi = Msg("ValueInfoProto")
+        vi.name = nm
+        body.output.append(vi)
+    return body
+
+
+def test_loop_traced_condition_lowers_to_while_loop():
+    """A body-computed (device) termination condition runs as
+    lax.while_loop — the pattern real exporters emit for scripted
+    `while` loops (ref delegates to onnxruntime, ONNXModel.scala:173)."""
+    import jax
+
+    g = GraphBuilder(opset=17)
+    acc0 = g.add_input("acc0", np.float32, [2])
+    g.add_input("limit", np.float32, [])
+    trip = g.add_initializer("M", np.int64(100))
+    cond0 = g.add_initializer("cond0", np.array(True))
+    g.add_node("Loop", [trip, cond0, acc0], outputs=["final"],
+               body=_while_body())
+    g.add_output("final", np.float32, [2])
+    gi = import_model(g.to_bytes())
+    # doubling [1,1] until sum >= 16 stops after [8,8]
+    final, = gi.apply(gi.params, np.ones(2, np.float32),
+                      np.float32(16.0))
+    np.testing.assert_allclose(np.asarray(final), [8.0, 8.0])
+    # and under jit, where everything is a tracer
+    fn = jax.jit(lambda a, lim: gi.apply(gi.params, a, lim)[0])
+    np.testing.assert_allclose(np.asarray(fn(np.ones(2, np.float32),
+                                             np.float32(16.0))), [8, 8])
+    np.testing.assert_allclose(np.asarray(fn(np.ones(2, np.float32),
+                                             np.float32(100.0))), [64, 64])
+
+
+def test_loop_traced_trip_count():
+    """A data-dependent trip count (graph input M) bounds the while_loop;
+    the smaller of M and the condition wins."""
+    import jax
+
+    g = GraphBuilder(opset=17)
+    acc0 = g.add_input("acc0", np.float32, [2])
+    g.add_input("limit", np.float32, [])
+    m_in = g.add_input("M", np.int64, [])
+    cond0 = g.add_initializer("cond0", np.array(True))
+    g.add_node("Loop", [m_in, cond0, acc0], outputs=["final"],
+               body=_while_body())
+    g.add_output("final", np.float32, [2])
+    gi = import_model(g.to_bytes())
+    fn = jax.jit(lambda a, lim, m: gi.apply(gi.params, a, lim, m)[0])
+    # trip bound cuts in first: 2 iterations only
+    np.testing.assert_allclose(
+        np.asarray(fn(np.ones(2, np.float32), np.float32(1e6),
+                      np.int64(2))), [4, 4])
+    # condition cuts in first
+    np.testing.assert_allclose(
+        np.asarray(fn(np.ones(2, np.float32), np.float32(16.0),
+                      np.int64(50))), [8, 8])
+
+
+def test_loop_traced_cond_with_scan_outputs_rejected():
+    """Scan outputs under a data-dependent trip count would have a
+    data-dependent shape; XLA cannot express that — clear error."""
+    import jax
+
+    g = GraphBuilder(opset=17)
+    acc0 = g.add_input("acc0", np.float32, [2])
+    g.add_input("limit", np.float32, [])
+    trip = g.add_initializer("M", np.int64(100))
+    cond_in = g.add_input("c0", np.bool_, [])
+    g.add_node("Loop", [trip, cond_in, acc0],
+               outputs=["final", "scanned"], body=_while_body(True))
+    g.add_output("final", np.float32, [2])
+    g.add_output("scanned", np.float32, ["T", 2])
+    gi = import_model(g.to_bytes())
+    fn = jax.jit(lambda a, lim, c: gi.apply(gi.params, a, lim, c))
+    with pytest.raises(NotImplementedError, match="data-dependent"):
+        fn(np.ones(2, np.float32), np.float32(16.0), np.bool_(True))
+
+
+def test_loop_zero_trips():
     from synapseml_tpu.onnx.proto import Msg
 
     body = Msg("GraphProto")
@@ -718,3 +833,112 @@ def test_scan_long_sequence_uses_lax_scan():
         fn = jax.jit(gi.bind())
         np.testing.assert_allclose(np.asarray(fn(seq)[1]),
                                    np.cumsum(src, 0), rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# full-network parity: zoo.resnet50 vs a torch twin with identical weights
+# ---------------------------------------------------------------------------
+
+class _TorchResNet(nn.Module):
+    """Twin of zoo.build_resnet: identical architecture, weights replayed
+    from the same seeded generator, so the imported ONNX graph and this
+    torch module compute the same function. Certifies the flagship bench
+    graph end-to-end (the reference certifies via onnxruntime,
+    deep-learning/.../ONNXModelSuite)."""
+
+    def __init__(self, depths, bottleneck, num_classes, width, seed):
+        super().__init__()
+        from synapseml_tpu.onnx.zoo import _Rng
+        r = _Rng(seed)
+
+        def conv(in_c, out_c, k, stride=1, pad=0):
+            m = nn.Conv2d(in_c, out_c, k, stride=stride, padding=pad,
+                          bias=False)
+            with torch.no_grad():
+                m.weight.copy_(torch.from_numpy(r.conv_w(out_c, in_c, k, k)))
+            return m
+
+        def bn(c):
+            m = nn.BatchNorm2d(c)
+            s, b, mean, var = r.bn(c)
+            with torch.no_grad():
+                m.weight.copy_(torch.from_numpy(s))
+                m.bias.copy_(torch.from_numpy(b))
+                m.running_mean.copy_(torch.from_numpy(mean))
+                m.running_var.copy_(torch.from_numpy(var))
+            return m
+
+        self.stem = nn.Sequential(conv(3, width, 7, stride=2, pad=3),
+                                  bn(width), nn.ReLU(),
+                                  nn.MaxPool2d(3, stride=2, padding=1))
+        blocks = []
+        in_c, chan = width, width
+        for stage, n_blocks in enumerate(depths):
+            for blk in range(n_blocks):
+                stride = 2 if (stage > 0 and blk == 0) else 1
+                if bottleneck:
+                    mid, out_c = chan, chan * 4
+                    main = nn.Sequential(
+                        conv(in_c, mid, 1), bn(mid), nn.ReLU(),
+                        conv(mid, mid, 3, stride=stride, pad=1), bn(mid),
+                        nn.ReLU(),
+                        conv(mid, out_c, 1), bn(out_c))
+                else:
+                    out_c = chan
+                    main = nn.Sequential(
+                        conv(in_c, out_c, 3, stride=stride, pad=1),
+                        bn(out_c), nn.ReLU(),
+                        conv(out_c, out_c, 3, pad=1), bn(out_c))
+                if stride != 1 or in_c != out_c:
+                    down = nn.Sequential(conv(in_c, out_c, 1, stride=stride),
+                                         bn(out_c))
+                else:
+                    down = nn.Identity()
+                blocks.append(nn.ModuleDict({"main": main, "down": down}))
+                in_c = out_c
+            chan *= 2
+        self.blocks = nn.ModuleList(blocks)
+        fcw, fcb = r.fc(num_classes, in_c)
+        self.fc = nn.Linear(in_c, num_classes)
+        with torch.no_grad():
+            self.fc.weight.copy_(torch.from_numpy(fcw))
+            self.fc.bias.copy_(torch.from_numpy(fcb))
+
+    def forward(self, x):
+        y = self.stem(x)
+        for blk in self.blocks:
+            y = torch.relu(blk["main"](y) + blk["down"](y))
+        y = y.mean(dim=(2, 3))
+        return self.fc(y)
+
+
+def test_resnet50_full_network_parity_vs_torch():
+    """The COMPLETE resnet50 graph ([3,4,6,3] bottlenecks, 1000 classes —
+    the bench flagship) at reduced spatial size, against torch with the
+    same weights: ~2.1e7 params through 53 convs + 53 BNs + fc."""
+    blob = zoo.resnet50(image_size=32, seed=5)
+    g = import_model(blob)
+    x = np.random.default_rng(0).normal(
+        size=(2, 3, 32, 32)).astype(np.float32)
+    got = np.asarray(g.apply(g.params, x)[0])
+
+    twin = _TorchResNet([3, 4, 6, 3], bottleneck=True, num_classes=1000,
+                        width=64, seed=5).eval()
+    with torch.no_grad():
+        want = twin(torch.from_numpy(x)).numpy()
+    assert got.shape == (2, 1000)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_resnet18_full_network_parity_vs_torch():
+    """Basic-block variant through the same twin machinery."""
+    blob = zoo.resnet18(image_size=32, seed=9)
+    g = import_model(blob)
+    x = np.random.default_rng(1).normal(
+        size=(2, 3, 32, 32)).astype(np.float32)
+    got = np.asarray(g.apply(g.params, x)[0])
+    twin = _TorchResNet([2, 2, 2, 2], bottleneck=False, num_classes=1000,
+                        width=64, seed=9).eval()
+    with torch.no_grad():
+        want = twin(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)

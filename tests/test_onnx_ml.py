@@ -252,3 +252,53 @@ def test_multiclassova_conversion_raises():
         model.booster.params, objective="multiclassova")
     with pytest.raises(NotImplementedError, match="multiclassova"):
         convert_lightgbm(model)
+
+
+def test_rf_truncated_at_best_iteration_matches_booster():
+    """rf margins average over the trees actually used; a converter that
+    keeps 1/T_total weights after best_iteration truncation diverges
+    from Booster.predict (advisor round-2 medium finding)."""
+    x, y = _binary_data(seed=21)
+    model = LightGBMClassifier(
+        num_iterations=10, num_leaves=7, boosting_type="rf",
+        bagging_fraction=0.8, bagging_freq=1).fit(
+        Table({"features": x, "label": y}))
+    model.booster.best_iteration = 3  # simulate early stopping at iter 3
+    blob = convert_lightgbm(model)
+    g = import_model(blob)
+    _, probs = g.apply(g.params, x)
+    np.testing.assert_allclose(np.asarray(probs)[:, 1],
+                               model.booster.predict(x), atol=1e-5)
+
+
+def test_converted_classifier_keeps_original_labels():
+    """A model fit on non-dense labels {3, 7} exports ONNX whose 'label'
+    output speaks the original labels, matching model.transform."""
+    x, y01 = _binary_data(seed=31)
+    y = np.where(y01 > 0.5, 7.0, 3.0)
+    model = LightGBMClassifier(num_iterations=10, num_leaves=7).fit(
+        Table({"features": x, "label": y}))
+    blob = convert_lightgbm(model)
+    g = import_model(blob)
+    label, probs = g.apply(g.params, x)
+    want = np.where(model.booster.predict(x) > 0.5, 7, 3)
+    np.testing.assert_array_equal(np.asarray(label), want)
+
+
+def test_tree_path_tensor_size_guard(monkeypatch):
+    """The dense [T, M, n_leaves] path tensor must refuse (not silently
+    allocate) gigabytes for very large ensembles."""
+    from synapseml_tpu.onnx import ml_ops
+
+    x, y = _binary_data(n=200, seed=41)
+    model = LightGBMClassifier(num_iterations=4, num_leaves=7).fit(
+        Table({"features": x, "label": y}))
+    blob = convert_lightgbm(model)
+    monkeypatch.setattr(ml_ops, "_PATH_WARN_BYTES", 16)
+    with pytest.warns(RuntimeWarning, match="path tensor allocates"):
+        g = import_model(blob)
+        g.apply(g.params, x[:8])
+    monkeypatch.setattr(ml_ops, "_PATH_GUARD_BYTES", 64)
+    with pytest.raises(MemoryError, match="path tensor would allocate"):
+        g = import_model(blob)
+        g.apply(g.params, x[:8])
