@@ -1,0 +1,67 @@
+"""E2E: the "Flower Image Classification" transfer-learning config
+(BASELINE #3): ImageFeaturizer (headless imported ONNX backbone) ->
+train a head -> evaluate -> score new images.
+ref: deep-learning/.../cntk/ImageFeaturizer.scala, notebooks/Flower
+Image Classification.
+"""
+import numpy as np
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.image.featurizer import ImageFeaturizer
+from synapseml_tpu.onnx import zoo
+
+
+def texture_dataset(n_per_class=40, size=32, seed=0):
+    """Two texture classes (the flower-photos stand-in: no egress)."""
+    rng = np.random.default_rng(seed)
+    imgs, labels = [], []
+    for cls in (0, 1):
+        for _ in range(n_per_class):
+            freq = rng.integers(2, 5)
+            ramp = np.arange(size) * freq * 2 * np.pi / size
+            wave = np.sin(ramp) * 100 + 128
+            img = np.tile(wave[None, :] if cls == 0 else wave[:, None],
+                          (size, 1) if cls == 0 else (1, size))
+            img = img[..., None].repeat(3, -1)
+            img = img + rng.normal(0, 20, img.shape)
+            imgs.append(np.clip(img, 0, 255).astype(np.uint8))
+            labels.append(cls)
+    idx = rng.permutation(len(imgs))
+    col = np.empty(len(imgs), dtype=object)
+    for i, j in enumerate(idx):
+        col[i] = imgs[j]
+    return col, np.asarray(labels)[idx]
+
+
+def main():
+    imgs, labels = texture_dataset()
+
+    # 1. headless backbone: imported ONNX ResNet with the head cut off
+    feat = ImageFeaturizer(model_bytes=zoo.tiny_resnet(image_size=32),
+                           cut_output_layers=1, image_size=32,
+                           input_col="image")
+    feats = np.asarray(feat.transform(Table({"image": imgs}))[
+        feat.output_col])
+    print(f"backbone features: {feats.shape}")
+
+    # 2. train the transfer head -> 3. evaluate
+    from sklearn.linear_model import LogisticRegression
+
+    n_train = 60
+    head = LogisticRegression(max_iter=2000).fit(
+        feats[:n_train], labels[:n_train])
+    acc = head.score(feats[n_train:], labels[n_train:])
+    print(f"transfer accuracy: {acc:.3f}")
+    assert acc >= 0.85
+
+    # 4. score fresh images end-to-end (featurize -> head)
+    fresh, fresh_y = texture_dataset(n_per_class=5, seed=9)
+    ff = np.asarray(feat.transform(Table({"image": fresh}))[feat.output_col])
+    fresh_acc = head.score(ff, fresh_y)
+    print(f"fresh-batch accuracy: {fresh_acc:.3f}")
+    assert fresh_acc >= 0.8
+    print("E2E image_transfer_learning: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""E2E: the "LightGBM - Overview" notebook config (BASELINE #1).
+
+train -> evaluate -> save native model -> reload -> export ONNX ->
+ONNXModel re-score -> live HTTP serving -> score over the wire.
+Runs on any backend (CI uses CPU); `tools/ci/pipeline.yaml` executes it.
+ref: notebooks/LightGBM - Overview.ipynb
+"""
+import json
+import tempfile
+import threading
+import urllib.request
+
+import numpy as np
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.gbdt.boosting import Booster
+from synapseml_tpu.gbdt.estimators import LightGBMClassifier
+from synapseml_tpu.io.serving import ContinuousServer, make_reply
+from synapseml_tpu.onnx import ONNXModel, convert_lightgbm
+
+
+def adult_census_shaped(n=4000, seed=0):
+    """Synthetic stand-in for Adult Census (14 features, income>50k)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 14)).astype(np.float32)
+    x[:, 0] = rng.integers(17, 80, n)                  # age
+    x[:, 4] = np.abs(rng.normal(40, 12, n))            # hours/week
+    logits = (0.04 * (x[:, 0] - 38) + 0.05 * (x[:, 4] - 40)
+              + x[:, 1] - 0.5 * x[:, 2] + 0.3 * x[:, 3] * x[:, 5])
+    y = (logits + rng.logistic(scale=0.7, size=n) > 0).astype(np.float64)
+    return x, y
+
+
+def main():
+    x, y = adult_census_shaped()
+    cut = 3000
+    train_t = Table({"features": x[:cut], "label": y[:cut]})
+
+    # 1. train (early stopping against a validation split)
+    model = LightGBMClassifier(
+        num_iterations=80, num_leaves=31, learning_rate=0.1).fit(train_t)
+
+    # 2. evaluate
+    from sklearn.metrics import roc_auc_score
+
+    auc = roc_auc_score(y[cut:], model.booster.predict(x[cut:]))
+    print(f"holdout AUC: {auc:.4f}")
+    assert auc > 0.85, "model quality regressed"
+
+    # 3. save native LightGBM text format -> 4. reload
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as fh:
+        fh.write(model.booster.save_string())
+        path = fh.name
+    with open(path) as fh:
+        reloaded = Booster.load_string(fh.read())
+    np.testing.assert_allclose(reloaded.predict(x[cut:]),
+                               model.booster.predict(x[cut:]), atol=1e-6)
+    print("native-format round trip: ok")
+
+    # 5. export ONNX, score through ONNXModel (the notebook's ONNX leg)
+    scorer = ONNXModel(model_bytes=convert_lightgbm(model),
+                       feed_dict={"input": "features"})
+    onnx_probs = np.asarray(
+        scorer.transform(Table({"features": x[cut:]}))["probabilities"])
+    np.testing.assert_allclose(onnx_probs[:, 1],
+                               model.booster.predict(x[cut:]), atol=1e-5)
+    print("ONNX export/rescore parity: ok")
+
+    # 6. serve live over HTTP -> 7. score over the wire
+    def pipeline(table: Table) -> Table:
+        feats = np.stack([np.asarray(v["features"], np.float32)
+                          for v in table["value"]])
+        probs = model.booster.predict(feats)
+        replies = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            replies[i] = make_reply({"p": float(probs[i])})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("e2e_lgbm", pipeline, max_batch=32).start()
+    try:
+        got = {}
+
+        def client(i):
+            req = urllib.request.Request(
+                cs.url, json.dumps(
+                    {"features": x[cut + i].tolist()}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                got[i] = json.loads(resp.read())["p"]
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        direct = model.booster.predict(x[cut:cut + 8])
+        for i in range(8):
+            assert abs(got[i] - direct[i]) < 1e-6
+        print("serving round trip x8: ok")
+    finally:
+        cs.stop()
+    print("E2E lightgbm_overview: PASS")
+
+
+if __name__ == "__main__":
+    main()

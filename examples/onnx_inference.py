@@ -1,0 +1,79 @@
+"""E2E: the "ONNX - Inference on Spark" notebook config (BASELINE #2).
+
+Import a full ResNet-50 ONNX graph (and a *foreign* torch-exported
+fixture) -> batched Table scoring through ONNXModel -> serve the scorer
+over HTTP. ref: notebooks/ONNX - Inference on Spark.ipynb,
+deep-learning/.../onnx/ONNXModel.scala
+"""
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.serving import ContinuousServer, make_reply
+from synapseml_tpu.onnx import ONNXModel, import_model, zoo
+
+
+def main():
+    # 1. the flagship graph: full-depth ResNet-50 (reduced spatial size so
+    # the example runs quickly on CPU CI; the bench runs 224x224 on chip)
+    blob = zoo.resnet50(num_classes=1000, image_size=32)
+    model = ONNXModel(model_bytes=blob, feed_dict={"data": "images"},
+                      argmax_output_col="prediction", mini_batch_size=8)
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(24, 3, 32, 32)).astype(np.float32)
+    out = model.transform(Table({"images": images}))
+    assert np.asarray(out["prediction"]).shape == (24,)
+    print("ResNet-50 batch scoring (24 imgs, bucketed): ok")
+
+    # 2. a REAL foreign file: torch.onnx-exported fixture with dynamic
+    # batch dims and Shape-chain Flatten (committed bytes + expected IO)
+    fx = os.path.join(os.path.dirname(__file__), os.pardir, "tests",
+                      "fixtures", "torch_cnn.onnx")
+    g = import_model(fx)
+    io = np.load(fx.replace(".onnx", "_io.npz"))
+    got = np.asarray(g.apply(g.params, io["input"])[0])
+    np.testing.assert_allclose(got, io["expected"], atol=1e-5, rtol=1e-5)
+    print("foreign torch-exported .onnx parity: ok")
+
+    # 3. serve the ONNX scorer over HTTP
+    def pipeline(table: Table) -> Table:
+        feats = np.stack([np.asarray(v["image"], np.float32)
+                          for v in table["value"]])
+        scored = model.transform(Table({"images": feats}))
+        replies = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            replies[i] = make_reply(
+                {"class": int(scored["prediction"][i])})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("e2e_onnx", pipeline, max_batch=8).start()
+    try:
+        got = {}
+
+        def client(i):
+            req = urllib.request.Request(
+                cs.url, json.dumps({"image": images[i].tolist()}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                got[i] = json.loads(resp.read())["class"]
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        direct = np.asarray(out["prediction"])[:4]
+        assert all(got[i] == direct[i] for i in range(4))
+        print("ONNX serving round trip x4: ok")
+    finally:
+        cs.stop()
+    print("E2E onnx_inference: PASS")
+
+
+if __name__ == "__main__":
+    main()

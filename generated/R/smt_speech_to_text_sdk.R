@@ -1,0 +1,44 @@
+#' SpeechToTextSDK
+#'
+#' Continuous recognition over REST: one request per detected
+#'
+#' @param audio_bytes full wav audio bytes
+#' @param backoffs retry backoff schedule ms
+#' @param concurrency max in-flight requests
+#' @param energy_threshold speech RMS threshold (of full scale)
+#' @param error_col error column
+#' @param format result format
+#' @param frame_ms endpointer frame size ms
+#' @param language recognition language
+#' @param min_utterance_ms drop utterances shorter than this
+#' @param output_col parsed output column
+#' @param profanity profanity handling
+#' @param silence_ms utterance-final silence ms
+#' @param stream_intermediate_results one output row per utterance (vs array per input row)
+#' @param subscription_key API key (value or column)
+#' @param timeout per-request timeout seconds
+#' @param url service endpoint URL
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_speech_to_text_sdk <- function(audio_bytes = NULL, backoffs = c(100, 500, 1000), concurrency = 4, energy_threshold = 0.01, error_col = "errors", format = NULL, frame_ms = 30, language = NULL, min_utterance_ms = 120, output_col = "out", profanity = NULL, silence_ms = 300, stream_intermediate_results = TRUE, subscription_key = NULL, timeout = 60.0, url = NULL) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.speech")
+  kwargs <- Filter(Negate(is.null), list(
+    audio_bytes = audio_bytes,
+    backoffs = backoffs,
+    concurrency = concurrency,
+    energy_threshold = energy_threshold,
+    error_col = error_col,
+    format = format,
+    frame_ms = frame_ms,
+    language = language,
+    min_utterance_ms = min_utterance_ms,
+    output_col = output_col,
+    profanity = profanity,
+    silence_ms = silence_ms,
+    stream_intermediate_results = stream_intermediate_results,
+    subscription_key = subscription_key,
+    timeout = timeout,
+    url = url
+  ))
+  do.call(mod$SpeechToTextSDK, kwargs)
+}

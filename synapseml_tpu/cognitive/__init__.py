@@ -26,6 +26,12 @@ from synapseml_tpu.cognitive.form import (  # noqa: F401
     flatten_document_results,
     flatten_read_results,
 )
+from synapseml_tpu.cognitive.speech import (  # noqa: F401
+    SpeechToTextSDK,
+    WavStream,
+    pcm_to_wav,
+    segment_utterances,
+)
 from synapseml_tpu.cognitive.services import (  # noqa: F401
     AnalyzeImage,
     AzureSearchWriter,

@@ -1,0 +1,265 @@
+"""Streaming speech recognition — the SpeechToTextSDK analogue.
+
+The reference pumps audio through the native Speech SDK: a WAV header is
+parsed and the PCM pulled in chunks (ref: cognitive/src/main/scala/com/
+microsoft/ml/spark/cognitive/AudioStreams.scala:17-94 — PCM mono 16 kHz
+16-bit asserted), the service segments speech and fires one ``recognized``
+event per utterance, and each event becomes an output row when
+``streamIntermediateResults`` is set (ref: SpeechToTextSDK.scala:431-509,
+transformAudioRows:315-347 flatMap).
+
+The native SDK is out of TPU scope (SURVEY §2.9), so the continuous-
+recognition loop is rebuilt on the REST short-audio endpoint: the WAV is
+parsed with the same format asserts, an energy-based endpointer segments
+the PCM into utterances (the service-side silence detection the SDK
+relies on), each utterance ships as its own WAV request through the
+retrying concurrent client, and results come back as per-utterance rows
+with Azure-convention ``Offset``/``Duration`` (100-ns ticks) — or as one
+array column per input row when ``stream_intermediate_results`` is off,
+matching the reference's two output schemas (SpeechToTextSDK.scala:417-429).
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from synapseml_tpu.cognitive.base import (CognitiveServicesBase, ServiceParam,
+                                          with_url_params)
+from synapseml_tpu.core.param import Param
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.http import (AsyncHTTPClient, HandlingUtils,
+                                   HTTPRequestData, response_to_error)
+
+_TICKS_PER_SEC = 10_000_000  # Azure offsets/durations are 100-ns ticks
+
+
+class WavStream:
+    """Parsed PCM WAV (ref AudioStreams.scala:38-83: RIFF/WAVE/fmt/data
+    walk with PCM, mono, 16 kHz, 16-bit asserts; extended fmt chunks are
+    skipped)."""
+
+    def __init__(self, wav_bytes: bytes, require_canonical: bool = True):
+        b = memoryview(bytes(wav_bytes))
+        if len(b) < 12 or bytes(b[0:4]) != b"RIFF" or bytes(b[8:12]) != b"WAVE":
+            raise ValueError("not a RIFF/WAVE file")
+        pos = 12
+        fmt = None
+        data = None
+        while pos + 8 <= len(b):
+            tag = bytes(b[pos:pos + 4])
+            size = struct.unpack_from("<I", b, pos + 4)[0]
+            body = b[pos + 8: pos + 8 + size]
+            if tag == b"fmt ":
+                fmt = body
+            elif tag == b"data":
+                data = body
+            pos += 8 + size + (size & 1)  # chunks are word-aligned
+        if fmt is None or data is None:
+            raise ValueError("WAV is missing fmt/data chunks")
+        (self.format_tag, self.channels, self.sample_rate, _, _,
+         self.bits_per_sample) = struct.unpack_from("<HHIIHH", fmt, 0)
+        if self.format_tag != 1:
+            raise ValueError("PCM required (formatTag == 1)")
+        if require_canonical:
+            # the reference's stream asserts (AudioStreams.scala:64-66)
+            if self.channels != 1:
+                raise ValueError("file needs to be single channel")
+            if self.sample_rate != 16000:
+                raise ValueError("file needs to have 16000 samples per second")
+            if self.bits_per_sample != 16:
+                raise ValueError("file needs to have 16 bits per sample")
+        self.pcm = np.frombuffer(data, dtype="<i2")
+        if self.channels > 1:
+            self.pcm = self.pcm.reshape(-1, self.channels)[:, 0]
+
+    def chunks(self, chunk_ms: int = 100):
+        """Pull-stream view: successive PCM chunks, the SDK read() loop."""
+        step = max(1, self.sample_rate * chunk_ms // 1000)
+        for i in range(0, len(self.pcm), step):
+            yield self.pcm[i:i + step]
+
+
+def pcm_to_wav(pcm: np.ndarray, sample_rate: int = 16000) -> bytes:
+    """Canonical 16-bit mono WAV bytes for one utterance's request."""
+    pcm = np.asarray(pcm, dtype="<i2")
+    raw = pcm.tobytes()
+    hdr = struct.pack(
+        "<4sI4s4sIHHIIHH4sI", b"RIFF", 36 + len(raw), b"WAVE", b"fmt ",
+        16, 1, 1, sample_rate, sample_rate * 2, 2, 16, b"data", len(raw))
+    return hdr + raw
+
+
+def segment_utterances(pcm: np.ndarray, sample_rate: int,
+                       frame_ms: int = 30, silence_ms: int = 300,
+                       min_utterance_ms: int = 120,
+                       energy_threshold: float = 0.01,
+                       padding_ms: int = 60) -> List[Tuple[int, int]]:
+    """Energy endpointer: (start_sample, end_sample) per utterance.
+
+    Stands in for the service-side segmentation behind the SDK's
+    ``recognized`` events: a frame is speech when its RMS exceeds
+    ``energy_threshold`` (relative to int16 full scale); utterances end
+    after ``silence_ms`` of non-speech and carry ``padding_ms`` context.
+    """
+    if len(pcm) == 0:
+        return []
+    x = pcm.astype(np.float32) / 32768.0
+    frame = max(1, sample_rate * frame_ms // 1000)
+    n_frames = (len(x) + frame - 1) // frame
+    pad = n_frames * frame - len(x)
+    rms = np.sqrt(np.mean(
+        np.pad(x, (0, pad)).reshape(n_frames, frame) ** 2, axis=1))
+    speech = rms > energy_threshold
+    gap_frames = max(1, silence_ms // frame_ms)
+    segs: List[Tuple[int, int]] = []
+    start = None
+    silence_run = 0
+    for i, s in enumerate(speech):
+        if s:
+            if start is None:
+                start = i
+            silence_run = 0
+        elif start is not None:
+            silence_run += 1
+            if silence_run >= gap_frames:
+                segs.append((start, i - silence_run + 1))
+                start, silence_run = None, 0
+    if start is not None:
+        segs.append((start, n_frames))
+    pad_f = padding_ms // frame_ms
+    out = []
+    for s, e in segs:
+        if (e - s) * frame_ms < min_utterance_ms:
+            continue
+        out.append((max(0, (s - pad_f)) * frame,
+                    min(len(pcm), (e + pad_f) * frame)))
+    return out
+
+
+class SpeechToTextSDK(CognitiveServicesBase):
+    """Continuous recognition over REST: one request per detected
+    utterance, per-utterance output rows (ref: SpeechToTextSDK.scala:431;
+    response shape ref: TranscriptionResponse in SpeechSchemas.scala).
+
+    ``stream_intermediate_results=True`` (the reference default) explodes
+    each input row into one output row per utterance; ``False`` collects
+    an array column. ``Offset``/``Duration`` are 100-ns ticks.
+    """
+
+    audio_bytes = ServiceParam("full wav audio bytes", required=True)
+    language = ServiceParam("recognition language", default="en-US")
+    format = ServiceParam("result format", default="simple")
+    profanity = ServiceParam("profanity handling", default="Masked")
+    stream_intermediate_results = Param(
+        "one output row per utterance (vs array per input row)",
+        default=True)
+    frame_ms = Param("endpointer frame size ms", default=30)
+    silence_ms = Param("utterance-final silence ms", default=300)
+    energy_threshold = Param("speech RMS threshold (of full scale)",
+                             default=0.01)
+    min_utterance_ms = Param("drop utterances shorter than this",
+                             default=120)
+
+    def _utterance_request(self, wav: bytes, language, fmt, profanity,
+                           key) -> HTTPRequestData:
+        url = with_url_params(self.url, language=language or "en-US",
+                              format=fmt or "simple",
+                              profanity=profanity or "Masked")
+        return HTTPRequestData(
+            url=url, method="POST",
+            headers={**self._headers(key),
+                     "Content-Type": "audio/wav; codecs=audio/pcm"},
+            entity=wav)
+
+    def _transform(self, table: Table) -> Table:
+        n = table.num_rows
+        names = self._service_param_names()
+        resolved = {name: self._resolve(name, table, n) for name in names}
+
+        # segment every row, then fire ALL utterances through one
+        # concurrent client (the SDK overlaps recognition with pumping)
+        reqs: List[Optional[HTTPRequestData]] = []
+        owners: List[int] = []
+        spans: List[Tuple[int, int, int]] = []  # (offset_ticks, dur_ticks, sr)
+        per_row_counts = [0] * n
+        for i in range(n):
+            audio = resolved["audio_bytes"][i]
+            if audio is None:
+                continue
+            ws = WavStream(audio)
+            segs = segment_utterances(
+                ws.pcm, ws.sample_rate, frame_ms=self.frame_ms,
+                silence_ms=self.silence_ms,
+                min_utterance_ms=self.min_utterance_ms,
+                energy_threshold=self.energy_threshold)
+            for s, e in segs:
+                reqs.append(self._utterance_request(
+                    pcm_to_wav(ws.pcm[s:e], ws.sample_rate),
+                    resolved["language"][i], resolved["format"][i],
+                    resolved["profanity"][i],
+                    resolved["subscription_key"][i]))
+                owners.append(i)
+                spans.append((s * _TICKS_PER_SEC // ws.sample_rate,
+                              (e - s) * _TICKS_PER_SEC // ws.sample_rate,
+                              ws.sample_rate))
+                per_row_counts[i] += 1
+
+        client = AsyncHTTPClient(
+            self.concurrency, HandlingUtils.advanced(*self.backoffs),
+            self.timeout)
+        resps = client.send_all(reqs)
+
+        results: List[Dict[str, Any]] = []
+        errors: List[Any] = []
+        for r, (off, dur, _) in zip(resps, spans):
+            err = None if r is None else response_to_error(r)
+            if r is None or err is not None:
+                results.append(None)
+                errors.append(err)
+                continue
+            try:
+                parsed = r.json()
+                results.append({
+                    "DisplayText": parsed.get("DisplayText"),
+                    "RecognitionStatus": parsed.get("RecognitionStatus"),
+                    "Offset": off, "Duration": dur,
+                })
+                errors.append(None)
+            except (json.JSONDecodeError, AttributeError) as e:
+                results.append(None)
+                errors.append({"status_code": r.status_code,
+                               "reason": f"parse error: {e}",
+                               "body": r.text[:2048]})
+
+        if self.stream_intermediate_results:
+            # flatMap: each utterance becomes a row (rows with no
+            # utterances keep one null row, as shouldSkip does)
+            counts = [max(1, c) for c in per_row_counts]
+            cols = {c: np.repeat(table[c], counts, axis=0)
+                    for c in table.columns}
+            out = np.empty(sum(counts), dtype=object)
+            errs = np.empty(sum(counts), dtype=object)
+            out[:] = None
+            errs[:] = None
+            row_base = np.cumsum([0] + counts[:-1])
+            cursor = [0] * n
+            for j, i in enumerate(owners):
+                k = row_base[i] + cursor[i]
+                out[k] = results[j]
+                errs[k] = errors[j]
+                cursor[i] += 1
+            return Table(dict(cols, **{self.output_col: out,
+                                       self.error_col: errs}))
+
+        out = np.empty(n, dtype=object)
+        errs = np.empty(n, dtype=object)
+        for i in range(n):
+            mine = [j for j, o in enumerate(owners) if o == i]
+            out[i] = [results[j] for j in mine]
+            errs[i] = next((errors[j] for j in mine
+                            if errors[j] is not None), None)
+        return table.with_columns({self.output_col: out,
+                                   self.error_col: errs})

@@ -86,12 +86,16 @@ class ComplexParam(Param):
 
 
 def _json_default(o):
+    if isinstance(o, np.bool_):  # before np.integer: bool_ is not integer,
+        return bool(o)           # but keep the explicit order regardless
     if isinstance(o, (np.integer,)):
         return int(o)
     if isinstance(o, (np.floating,)):
         return float(o)
     if isinstance(o, np.ndarray):
         return o.tolist()
+    if isinstance(o, bytes):
+        return o.decode("utf-8", "replace")
     raise TypeError(f"not JSON serializable: {type(o)}")
 
 

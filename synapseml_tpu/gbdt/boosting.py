@@ -587,6 +587,33 @@ def _prepend_init_trees(init_model: Optional["Booster"], stacked):
     )
 
 
+def _pad_lr_schedule(lrs: np.ndarray) -> np.ndarray:
+    """Double the schedule with its last value: chunked scans read past
+    num_iterations on surplus steps of the final chunk."""
+    lrs = np.asarray(lrs, np.float32)
+    return np.concatenate([lrs, np.repeat(lrs[-1:], len(lrs))])
+
+
+def _attach_init_categoricals(booster: "Booster",
+                              init_model: Optional["Booster"]) -> "Booster":
+    """Carry a categorical init_model's split sets into the combined
+    booster. The trainer itself only emits numeric splits, so the merge
+    is one-sided: old nodes keep their set indices into the init pool
+    (copied verbatim), new trees are all -1 (numeric). Parity target:
+    lib_lightgbm continues from categorical models transparently
+    (ref: lightgbm/.../LightGBMBase.scala:49-61 setModelString)."""
+    if init_model is None or init_model.trees_cat is None:
+        return booster
+    t_old, m_old = init_model.trees_cat.shape
+    t_total, m = booster.trees_feature.shape
+    cat = np.full((t_total, m), -1, np.int32)
+    cat[:t_old, :m_old] = init_model.trees_cat
+    booster.trees_cat = cat
+    booster.cat_bitsets = np.array(init_model.cat_bitsets, np.uint32)
+    booster.cat_boundaries = np.array(init_model.cat_boundaries, np.int32)
+    return booster
+
+
 def _chunk_callbacks(checkpoint_dir, init_model, p, k, init, f,
                      feature_names, tracker, iteration_hook):
     """Compose the per-chunk checkpoint writer and iteration observer —
@@ -603,7 +630,8 @@ def _chunk_callbacks(checkpoint_dir, init_model, p, k, init, f,
                 lambda *xs: np.concatenate(xs, axis=0), *acc)
             booster = _assemble_booster(
                 _prepend_init_trees(init_model, stacked), p, k, init, f,
-                feature_names, tracker, compute_importances=False)
+                feature_names, tracker, compute_importances=False,
+                init_model=init_model)
             if init_model is not None and booster.best_iteration >= 0:
                 booster.best_iteration += init_model.num_trees // max(k, 1)
             save_checkpoint(checkpoint_dir, booster, iters_done,
@@ -680,7 +708,11 @@ def _chunked_boost_loop(run, carry, tracker, p: BoostParams, k: int,
 
 def _assemble_booster(stacked, p: BoostParams, k: int, init: float, f: int,
                       feature_names, tracker, dart_w_final=None,
-                      compute_importances: bool = True) -> Booster:
+                      compute_importances: bool = True,
+                      init_model: Optional["Booster"] = None) -> Booster:
+    """``init_model`` (continuation) also carries its categorical split
+    sets into the combined booster — attached HERE so every assembly
+    site (single-chip, mesh, checkpoint writer) shares the semantics."""
     t_total = stacked.split_feature.shape[0]
     if dart_w_final is not None:
         tree_weights = np.asarray(dart_w_final[:t_total], np.float32)
@@ -709,7 +741,7 @@ def _assemble_booster(stacked, p: BoostParams, k: int, init: float, f: int,
     if compute_importances:
         booster.feature_importance_split, booster.feature_importance_gain = (
             _importances(booster, f))
-    return booster
+    return _attach_init_categoricals(booster, init_model)
 
 
 @lru_cache(maxsize=64)
@@ -950,11 +982,6 @@ def train(
                 f"{p.boosting_type} (dart rescales past trees; rf averages)")
         if init_model.num_class != k:
             raise ValueError("init_model num_class mismatch")
-        if init_model.trees_cat is not None:
-            raise NotImplementedError(
-                "continuation from a model with categorical splits is not "
-                "supported (the combined booster cannot merge bitset pools "
-                "yet)")
         # keep its init score so the combined booster's folded-init
         # semantics stay consistent; num_iteration is passed explicitly:
         # predict_raw would otherwise truncate at best_iteration while
@@ -967,17 +994,30 @@ def train(
         raise NotImplementedError(
             "step checkpointing is not defined for dart (past trees "
             "are rescaled every round)")
+    if learning_rates is not None:
+        # schedule semantics are boosting-type properties, not device
+        # properties — identical guards on and off the mesh
+        if p.boosting_type == "dart":
+            raise NotImplementedError(
+                "per-iteration learning_rates are not defined for dart "
+                "(tree weights are renormalized every round)")
+        if p.boosting_type == "rf":
+            raise NotImplementedError(
+                "rf averages unshrunk trees; a learning-rate schedule "
+                "does not apply")
+        learning_rates = np.asarray(learning_rates, np.float32)
+        if learning_rates.shape != (p.num_iterations,):
+            raise ValueError(
+                f"learning_rates must have shape ({p.num_iterations},), "
+                f"got {learning_rates.shape}")
 
     if mesh is not None:
-        if learning_rates is not None:
-            raise NotImplementedError(
-                "per-iteration learning_rates are single-device for now")
         return _train_distributed(
             p, mesh, binned_np, y, weight, k, init, obj_fn, gp, bdev,
             thresholds, valid_sets, feature_names, group=group,
             init_model=init_model, init_margins=init_margins,
             checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
-            iteration_hook=iteration_hook)
+            iteration_hook=iteration_hook, learning_rates=learning_rates)
 
     binned = jnp.asarray(binned_np)
     yd = jnp.asarray(y)
@@ -995,10 +1035,6 @@ def train(
         scores = jnp.zeros(n, jnp.float32) + init
 
     if p.boosting_type == "dart":
-        if learning_rates is not None:
-            raise NotImplementedError(
-                "per-iteration learning_rates are not defined for dart "
-                "(tree weights are renormalized every round)")
         return _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init,
                            n, f, valid_sets, feature_names, k=k)
 
@@ -1044,22 +1080,13 @@ def train(
             qidx, qmask, qinv = (jnp.asarray(qidx_np),
                                  jnp.asarray(qmask_np),
                                  jnp.asarray(qinv_np))
-    if learning_rates is not None and is_rf:
-        raise NotImplementedError(
-            "rf averages unshrunk trees; a learning-rate schedule "
-            "does not apply")
     use_lr_schedule = learning_rates is not None
     lrs_d = None
     if use_lr_schedule:
-        lrs = np.asarray(learning_rates, np.float32)
-        if lrs.shape != (p.num_iterations,):
-            raise ValueError(
-                f"learning_rates must have shape ({p.num_iterations},), "
-                f"got {lrs.shape}")
+        # schedule type/shape validated before mesh dispatch above;
         # chunked scans index past num_iterations on the final (surplus)
-        # steps; pad with the last value so those reads stay in range
-        lrs_d = jnp.asarray(np.concatenate([lrs, np.repeat(lrs[-1:],
-                                                           len(lrs))]))
+        # steps, so pad with the last value to keep those reads in range
+        lrs_d = jnp.asarray(_pad_lr_schedule(learning_rates))
     consts = dict(
         binned=binned, yd=yd, wd=wd, gids=group_ids, thr=thresholds,
         init=jnp.float32(init), lrs=lrs_d,
@@ -1098,7 +1125,7 @@ def train(
         on_stop=iteration_hook)
     booster = _assemble_booster(
         _prepend_init_trees(init_model, stacked), p, k, init, f,
-        feature_names, tracker)
+        feature_names, tracker, init_model=init_model)
     if init_model is not None and booster.best_iteration >= 0:
         # best_iteration indexes the combined tree stack
         booster.best_iteration += init_model.num_trees // max(k, 1)
@@ -1158,7 +1185,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                        bdev, thresholds, valid_sets, feature_names,
                        group=None, init_model=None, init_margins=None,
                        checkpoint_dir=None, checkpoint_every=0,
-                       iteration_hook=None):
+                       iteration_hook=None, learning_rates=None):
     """dp-sharded training: shard_map over the mesh's 'dp' axis, with the
     boosting loop scanned on device (one host sync per chunk, as in the
     single-chip path).
@@ -1392,7 +1419,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     nbins_goss = 512
 
     def chunk_fn(binned_l, yd_l, yoh_l, wd_l, padm_l, gids_l, vx_r, vy_r,
-                 wmat_r, step_off, carry, steps):
+                 wmat_r, step_off, lrs_r, carry, steps):
         n_l = binned_l.shape[0]
 
         def goss_select(g, h, key):
@@ -1520,7 +1547,12 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                 new_scores = scores_l
                 scaled = tree  # dart leaf values stay raw; weights carry scale
             else:
-                lr = 1.0 if is_rf else p.learning_rate
+                if is_rf:
+                    lr = 1.0
+                elif lrs_r is not None:  # per-iteration schedule (replicated)
+                    lr = lrs_r[it]
+                else:
+                    lr = p.learning_rate
                 delta = lr * slot_value[row_slot]
                 if k > 1:
                     new_scores = scores_l + delta[:, None] * jax.nn.one_hot(
@@ -1573,6 +1605,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         row_spec,
         (row_spec if gids is not None else None),
         rep, rep, rep, rep,
+        (rep if learning_rates is not None else None),
         carry_spec, rep,
     )
     tree_spec = Tree(*([rep] * 8))
@@ -1585,7 +1618,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
 
     smapped = shard_map(chunk_fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_vma=False)
-    jitted = jax.jit(smapped, donate_argnums=10)
+    jitted = jax.jit(smapped, donate_argnums=11)  # the carry
 
     total_iters = p.num_iterations
     chunk = _compute_chunk(p, tracker, track_rank, total_iters,
@@ -1594,6 +1627,10 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         # bound the replicated per-chunk schedule slice ([chunk*k, T])
         chunk = min(chunk, max(1, 256 // max(1, k)))
 
+    lrs_rep = None
+    if learning_rates is not None:
+        lrs_rep = put(_pad_lr_schedule(learning_rates), rep)
+
     def run(carry, steps, start_iter):
         if is_dart:
             wm = put(dart_wmat_slice(start_iter * k, len(steps)), rep)
@@ -1601,7 +1638,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             wm = None
         off = put(np.int32(start_iter * k), rep)
         return jitted(binned, yd, yoh, wd, padm, gids, vx_d, vy_d,
-                      wm, off, carry, put(np.asarray(steps), rep))
+                      wm, off, lrs_rep, carry, put(np.asarray(steps), rep))
 
     carry = (scores, vsum0,
              preds0 if is_dart else put(np.zeros((1, 1), np.float32), rep),
@@ -1623,7 +1660,8 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     booster = _assemble_booster(
         _prepend_init_trees(init_model, stacked), p, k, init, f,
         feature_names, tracker,
-        dart_w_final=dart_w_final if is_dart else None)
+        dart_w_final=dart_w_final if is_dart else None,
+        init_model=init_model)
     if init_model is not None and booster.best_iteration >= 0:
         booster.best_iteration += init_model.num_trees // max(k, 1)
     return booster

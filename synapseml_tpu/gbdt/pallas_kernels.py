@@ -50,11 +50,13 @@ def _hist_kernel(binned_ref, data_ref, out_ref, *, n_feat: int,
 
 
 def histogram_tpu(binned: jnp.ndarray, data: jnp.ndarray,
-                  n_bins: int) -> jnp.ndarray:
+                  n_bins: int, interpret: bool = False) -> jnp.ndarray:
     """[F, B, 3] histogram of ``data`` columns per (feature, bin).
 
     binned: [N, F] integer bins; data: [N, 3] f32 (already mask-weighted —
     masked rows must be zero in data, their bin values then don't matter).
+    ``interpret=True`` runs the kernel body under the pallas interpreter
+    (any backend) — CI numerics coverage where no TPU is attached.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -79,6 +81,7 @@ def histogram_tpu(binned: jnp.ndarray, data: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((f, 3, bp), lambda i: (0, 0, 0),
                                memory_space=pltpu.VMEM),
+        interpret=interpret,
     )(binned.astype(jnp.int32), data.T)
     return jnp.transpose(out, (0, 2, 1))[:, :n_bins, :]
 
@@ -86,7 +89,13 @@ def histogram_tpu(binned: jnp.ndarray, data: jnp.ndarray,
 @functools.lru_cache(maxsize=1)
 def available() -> bool:
     """One-time probe: compile + run the kernel on tiny shapes and compare
-    against the reference formulation."""
+    against the reference formulation.
+
+    The first call usually happens while TRACING the boosting scan
+    (grower.histogram); under an ambient trace, nested jit calls inline
+    and their results become tracers, so the probe must escape to
+    compile-time eval or it would cache a spurious False forever (the
+    round-2 'pallas never ran' bug, caught by bench r3)."""
     import os
 
     if os.environ.get("SYNAPSEML_GBDT_PALLAS", "1") == "0":
@@ -94,17 +103,20 @@ def available() -> bool:
     if jax.default_backend() != "tpu":
         return False
     try:
+        # trace-safe: concrete numpy in, AOT lower+compile+execute out.
+        # A plain jit call would INLINE into any ambient trace and hand
+        # back tracers; the compiled executable runs for real regardless.
         rng = np.random.default_rng(0)
-        binned = jnp.asarray(rng.integers(0, 7, (700, 3)), jnp.int32)
-        data = jnp.asarray(rng.normal(size=(700, 3)), jnp.float32)
-        got = np.asarray(jax.jit(
-            lambda b, d: histogram_tpu(b, d, 7))(binned, data))
-        oh = jax.nn.one_hot(np.asarray(binned), 7, dtype=jnp.float32)
-        # HIGHEST: a default-precision reference would itself carry bf16
-        # truncation error and could fail the comparison spuriously
-        want = np.asarray(jnp.einsum(
-            "nfb,nc->fbc", oh, data,
-            precision=jax.lax.Precision.HIGHEST))
+        binned = rng.integers(0, 7, (700, 3)).astype(np.int32)
+        data = rng.normal(size=(700, 3)).astype(np.float32)
+        compiled = jax.jit(
+            lambda b, d: histogram_tpu(b, d, 7)).lower(
+            binned, data).compile()
+        got = np.asarray(compiled(binned, data))
+        # reference in pure numpy (f64 accumulate: the bf16-free truth)
+        oh = (binned[..., None] == np.arange(7)).astype(np.float64)
+        want = np.einsum("nfb,nc->fbc", oh,
+                         data.astype(np.float64)).astype(np.float32)
         return bool(np.allclose(got, want, rtol=1e-3, atol=1e-3))
     except Exception:  # noqa: BLE001 - any failure means "use XLA"
         return False

@@ -153,8 +153,18 @@ class WorkerServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_GET(self):
+                if self.path == "/health":
+                    # k8s readiness fast-path: never rides the pipeline
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self._enqueue()
+
             do_POST = _enqueue
-            do_GET = _enqueue
             do_PUT = _enqueue
 
         self._httpd = http.server.ThreadingHTTPServer(
@@ -501,3 +511,78 @@ class ContinuousServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
         HTTPSourceStateHolder.remove(self.name)
+
+
+def _model_pipeline(model_path: str):
+    """JSON {"features": [...]} -> ONNX-scored reply — the deployment
+    entry's default pipeline (tools/k8s/chart serving template)."""
+    import numpy as np
+
+    from synapseml_tpu.onnx import ONNXModel
+
+    model = ONNXModel(model_path=model_path)
+    feed = model.graph.input_names[0]
+
+    def pipeline(table: Table) -> Table:
+        feats = np.stack([np.asarray(v["features"], np.float32)
+                          for v in table["value"]])
+        scored = model.transform(Table({feed: feats},),)
+        first_out = model.graph.output_names[0]
+        replies = np.empty(table.num_rows, dtype=object)
+        out_col = np.asarray(scored[first_out])
+        for i in range(table.num_rows):
+            replies[i] = make_reply({"output": out_col[i].tolist()})
+        return table.with_column("reply", replies)
+
+    # ONNXModel resolves feed_dict lazily; set it for the raw-name feed
+    model.set(feed_dict={feed: feed})
+    return pipeline
+
+
+def main(argv=None):
+    """``python -m synapseml_tpu.io.serving`` — the container entry the
+    k8s serving chart runs: load SYNAPSEML_MODEL_PATH (or echo when
+    unset), serve on --port with /health, block until signalled."""
+    import argparse
+    import os
+    import signal
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=8898)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--model", default=os.environ.get(
+        "SYNAPSEML_MODEL_PATH"))
+    ap.add_argument("--name", default="serving")
+    args = ap.parse_args(argv)
+
+    if args.model and not os.path.exists(args.model):
+        # a configured-but-missing model must NOT silently degrade to
+        # echo: the pod would go Ready and serve request bodies as
+        # "scores" — fail fast so k8s restarts against the mounted model
+        print(f"error: model path {args.model!r} does not exist",
+              flush=True)
+        return 2
+    if args.model:
+        pipeline = _model_pipeline(args.model)
+        what = f"scoring {args.model}"
+    else:
+        def pipeline(table: Table) -> Table:
+            replies = np.empty(table.num_rows, dtype=object)
+            for i, v in enumerate(table["value"]):
+                replies[i] = make_reply(v)
+            return table.with_column("reply", replies)
+        what = "echo (no SYNAPSEML_MODEL_PATH)"
+
+    cs = ContinuousServer(args.name, pipeline, host=args.host,
+                          port=args.port).start()
+    print(f"serving [{what}] on {cs.url} (GET /health ready)", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    cs.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -53,3 +53,82 @@ def barrier_sum(axis: AxisName):
     reference's BarrierTaskContext.barrier() gang scheduling
     (ref: lightgbm/.../LightGBMBase.scala:482-483)."""
     return lax.psum(jnp.ones((), jnp.int32), axis)
+
+
+# ---------------------------------------------------------------------------
+# Topology-aware strategies
+# ---------------------------------------------------------------------------
+
+def two_level_all_reduce(x, inner_axis: str, outer_axis: str,
+                         scatter_axis: int = 0):
+    """All-reduce over ``inner_axis`` x ``outer_axis`` that minimizes
+    traffic on the *outer* (slow) links — the multi-slice schedule for a
+    mesh whose inner axis rides ICI and outer axis rides DCN.
+
+    A flat ``psum`` over both axes moves the full payload across DCN per
+    step; this sends only ``1/|inner|`` of it: reduce-scatter inside the
+    slice (ICI), all-reduce the shard across slices (DCN), all-gather
+    back inside the slice (ICI). Equivalent to
+    ``psum(x, (inner, outer))`` — the reference's analogue is
+    lib_lightgbm's single-level socket allreduce, which has no topology
+    tiering at all (SURVEY.md §2.10).
+
+    ``x``'s ``scatter_axis`` dimension must be divisible by the inner
+    axis size (pad if needed).
+    """
+    shard = lax.psum_scatter(x, inner_axis, scatter_dimension=scatter_axis,
+                             tiled=True)                     # ICI
+    shard = lax.psum(shard, outer_axis)                      # DCN, 1/|inner|
+    return lax.all_gather(shard, inner_axis, axis=scatter_axis,
+                          tiled=True)                        # ICI
+
+
+def ring_all_reduce(x, axis: str, chunk_axis: int = 0):
+    """Explicit bidirectional-free ring all-reduce: 2(n-1) ``ppermute``
+    steps (n-1 reduce-scatter, n-1 all-gather), each moving ``1/n`` of
+    the payload to the ring neighbor.
+
+    XLA's own psum lowers to an equivalent schedule on an ICI ring; the
+    explicit form exists for fusion with per-chunk compute (the ring-
+    attention pattern, parallel/ring_attention.py) and as the measured
+    reference when validating psum performance. Requires
+    ``x.shape[chunk_axis] % n == 0``.
+    """
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    me = lax.axis_index(axis)
+    chunks = list(jnp.split(x, n, axis=chunk_axis))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # reduce-scatter phase: at step t rank r forwards its partial of
+    # chunk (r - t) and folds its local copy into the incoming partial of
+    # chunk (r - t - 1); after n-1 steps rank r holds the FULL sum of
+    # chunk (r + 1) % n  (me is traced -> dynamic chunk select)
+    acc = _select_chunk(chunks, me % n)
+    for t in range(n - 1):
+        acc = lax.ppermute(acc, axis, perm)
+        acc = acc + _select_chunk(chunks, (me - t - 1) % n)
+
+    # all-gather phase: circulate the finished chunk n-1 times
+    out_chunks = [acc]
+    cur = acc
+    for _ in range(n - 1):
+        cur = lax.ppermute(cur, axis, perm)
+        out_chunks.append(cur)
+    # after the gather phase, out_chunks[j] is the chunk finished by rank
+    # (me - j) % n, i.e. chunk id (me - j + 1) % n — reassemble in chunk
+    # order with a rank-dependent (traced) inverse permutation
+    stacked = jnp.stack(out_chunks, axis=0)  # [n, ...] j-th = chunk(me-j+1)
+    chunk_ids = (me - jnp.arange(n) + 1) % n
+    inv = jnp.zeros((n,), jnp.int32).at[chunk_ids].set(
+        jnp.arange(n, dtype=jnp.int32))
+    gathered = jnp.take(stacked, inv, axis=0)
+    return jnp.concatenate(
+        [jnp.squeeze(c, 0) for c in jnp.split(gathered, n, axis=0)],
+        axis=chunk_axis)
+
+
+def _select_chunk(chunks, idx):
+    """chunks[idx] with a traced idx: stack once, dynamic-index."""
+    return jnp.take(jnp.stack(chunks, axis=0), idx, axis=0)

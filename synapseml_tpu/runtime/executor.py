@@ -10,11 +10,18 @@ with a jit-cache-aware executor:
   compilations, then runs hot.
 - **dtype coercion**: host columns are coerced once (e.g. f64→f32→bf16) before
   a single contiguous ``device_put`` — no per-row marshalling hot loop.
+- **Pipelined feed**: jax dispatch is asynchronous, so the executor keeps
+  ``pipeline_depth`` batches in flight — batch N+1's host→device copy and
+  compute are dispatched *before* blocking on batch N's device→host fetch,
+  hiding transfer latency behind compute (the role ORT's IOBinding plays
+  for the reference). Inputs are donated to XLA on non-CPU backends so
+  same-bucket batches reuse device buffers instead of allocating.
 """
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +56,8 @@ class BatchedExecutor:
 
     ``fn`` must treat axis 0 of every argument as the batch axis. The executor
     pads the batch to a bucket size, runs the compiled program, and slices the
-    padding off the outputs.
+    padding off the outputs. Multi-batch calls are pipelined: up to
+    ``pipeline_depth`` batches are in flight at once.
     """
 
     def __init__(
@@ -61,20 +69,59 @@ class BatchedExecutor:
         max_bucket: Optional[int] = None,
         static_batch: Optional[int] = None,
         bound_args: Tuple[Any, ...] = (),
+        pipeline_depth: int = 2,
+        donate: Optional[bool] = None,
+        transfer_batches: Union[int, str, None] = None,
     ):
         """``bound_args`` are prepended to every call unpadded — use for a
         weights pytree so it is device-resident and *shared* across all shape
-        buckets instead of baked into each compiled program as constants."""
+        buckets instead of baked into each compiled program as constants.
+
+        ``donate=None`` donates batch inputs to XLA whenever the target
+        backend is not CPU (CPU ignores donation and would warn).
+
+        ``transfer_batches`` groups that many compute buckets into ONE
+        explicit host->device copy (compute then runs per bucket on
+        device-side slices); ``"auto"`` sizes the group to ~32MB per
+        copy. Default 1 — measured on the tunneled v5e, per-bucket
+        numpy arg-staging through the pipelined jit dispatch beats
+        explicit grouped device_put for BOTH large image batches
+        (100 vs 77 img/s) and small tabular rows (34k vs 26k rows/s);
+        the option exists for co-located topologies where explicit DMA
+        grouping can win (docs/perf.md records the A/Bs)."""
         self._device = device
         self._compute_dtype = compute_dtype
         self._min_bucket = min_bucket
         self._max_bucket = max_bucket
         self._static_batch = static_batch
+        self._depth = max(1, int(pipeline_depth))
         self._bound = tuple(
             jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, device) if device else jnp.asarray(a),
                 b) for b in bound_args)
-        self._jit = jax.jit(fn)
+        plat = (device.platform if device is not None
+                else jax.default_backend())
+        if donate is None:
+            donate = plat not in ("cpu",)
+        self._donate = bool(donate)
+        if transfer_batches is None:
+            transfer_batches = 1
+        elif transfer_batches != "auto":
+            transfer_batches = max(1, int(transfer_batches))
+        self._transfer_batches = transfer_batches  # "auto" = ~32MB groups
+        self._fn = fn
+        # donation indices depend on the call arity, which is only known at
+        # call time — one jitted callable per arity
+        self._jits: Dict[int, Callable] = {}
+
+    def _jit_for(self, n_args: int) -> Callable:
+        got = self._jits.get(n_args)
+        if got is None:
+            donate = tuple(range(len(self._bound), len(self._bound) + n_args)) \
+                if self._donate else ()
+            got = jax.jit(self._fn, donate_argnums=donate)
+            self._jits[n_args] = got
+        return got
 
     def _bucket(self, n: int) -> int:
         if self._static_batch is not None:
@@ -89,30 +136,84 @@ class BatchedExecutor:
         bucket = self._bucket(max(n, 1))
         if n == 0:
             # run one padded batch to learn output structure; slice to empty
-            return self._run_padded(list(host_arrays), 0, bucket)
+            return self._fetch(*self._dispatch(list(host_arrays), 0, bucket))
         outs = []
-        for start in range(0, n, bucket):
-            stop = min(start + bucket, n)
-            outs.append(self._run_padded(
-                [a[start:stop] for a in host_arrays], stop - start, bucket))
+        pending: deque = deque()
+
+        def push(item):
+            pending.append(item)
+            if len(pending) >= self._depth:
+                outs.append(self._fetch(*pending.popleft()))
+
+        tb = self._transfer_batches
+        if tb == "auto":
+            # group buckets up to ~32MB per explicit copy
+            row_bytes = 0
+            for a in host_arrays:
+                a0 = np.asarray(a)
+                itemsize = 2 if (self._compute_dtype is not None
+                                 and np.issubdtype(a0.dtype, np.floating)) \
+                    else min(a0.itemsize, 4)
+                row_bytes += int(np.prod(a0.shape[1:], dtype=np.int64)) \
+                    * itemsize
+            tb = max(1, (32 << 20) // max(1, bucket * row_bytes))
+        super_rows = bucket * tb
+        for sc_start in range(0, n, super_rows):
+            sc_stop = min(sc_start + super_rows, n)
+            sc_n = sc_stop - sc_start
+            if tb == 1 or sc_n <= bucket:
+                # dispatch is async: this batch's H2D copy and compute are
+                # in flight before an earlier batch's fetch blocks below
+                push(self._dispatch(
+                    [a[sc_start:sc_stop] for a in host_arrays], sc_n, bucket))
+                continue
+            # super-chunk: ONE coerce+pad+copy for transfer_batches buckets,
+            # then per-bucket compute on device-side slices. device_put is
+            # unconditional here — with device=None it targets the default
+            # device; leaving host numpy would quietly re-copy per bucket
+            # and void the whole point of grouping
+            rows = -(-sc_n // bucket) * bucket
+            devs = []
+            for a in host_arrays:
+                a = coerce_host_array(np.asarray(a[sc_start:sc_stop]),
+                                      self._compute_dtype)
+                if rows > sc_n:
+                    a = np.pad(a, [(0, rows - sc_n)] + [(0, 0)] * (a.ndim - 1))
+                devs.append(jax.device_put(a, self._device))
+            for b in range(0, sc_n, bucket):
+                push(self._dispatch(
+                    [d[b:b + bucket] for d in devs],
+                    min(bucket, sc_n - b), bucket))
+        while pending:
+            outs.append(self._fetch(*pending.popleft()))
         if len(outs) == 1:
             return outs[0]
         return tuple(
             np.concatenate([o[i] for o in outs]) for i in range(len(outs[0]))
         )
 
-    def _run_padded(self, arrays, n: int, bucket: int):
+    def _dispatch(self, arrays, n: int, bucket: int):
+        """Coerce+pad on host (device-resident slices pass through), start
+        the H2D copy and the compute; returns device futures without
+        blocking."""
         padded = []
         for a in arrays:
+            if isinstance(a, jax.Array):
+                padded.append(a)  # super-chunk slice: already on device
+                continue
             a = coerce_host_array(np.asarray(a), self._compute_dtype)
-            if n < bucket:
+            if n < bucket and len(a) < bucket:  # never re-pad a padded tail
                 pad = [(0, bucket - n)] + [(0, 0)] * (a.ndim - 1)
                 a = np.pad(a, pad)
             padded.append(
                 jax.device_put(a, self._device) if self._device else a)
-        out = self._jit(*self._bound, *padded)
-        # one batched device->host fetch — per-leaf np.asarray pays a
-        # transfer round trip per output on remote chips
+        out = self._jit_for(len(padded))(*self._bound, *padded)
+        return out, n
+
+    def _fetch(self, out, n: int):
+        """Block on one batch's device->host copy. One batched fetch —
+        per-leaf np.asarray pays a transfer round trip per output on
+        remote chips."""
         leaves = jax.device_get(jax.tree_util.tree_leaves(out))
         return tuple(l[:n] for l in leaves)
 

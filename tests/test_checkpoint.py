@@ -186,7 +186,7 @@ def test_mesh_multiclass_init_model_continuation():
                                rtol=1e-3, atol=1e-4)
 
 
-def test_mesh_iteration_hook_and_cat_init_guard():
+def test_mesh_iteration_hook():
     import jax
     from jax.sharding import Mesh
 
@@ -196,11 +196,80 @@ def test_mesh_iteration_hook_and_cat_init_guard():
     train(p, X, Y, mesh=mesh, iteration_hook=lambda it: seen.append(it))
     assert seen and seen[-1] == 6
 
-    # continuation from a categorical-split model must refuse loudly
+
+def test_categorical_init_model_continuation():
+    """Continuing from a native categorical model: old nodes keep their
+    split sets and pool, new trees are numeric; the combined booster
+    predicts init margins + new-tree contributions and round-trips
+    through the native format (lib_lightgbm continues from categorical
+    models transparently — round-2 guard removed)."""
     import sys
     sys.path.insert(0, os.path.dirname(__file__))
     from test_lgbm_format import _cat_model_string
 
     cat_b = Booster.load_string(_cat_model_string())
-    with pytest.raises(NotImplementedError, match="categorical"):
-        train(p, X[:, :2], Y, init_model=cat_b)
+    rng2 = np.random.default_rng(7)
+    x2 = np.column_stack([rng2.integers(0, 50, 300).astype(np.float64),
+                          rng2.uniform(0, 10, 300)])
+    y2 = (np.where(np.isin(x2[:, 0], [1, 3, 40]), 1.0, -3.0)
+          + 0.3 * x2[:, 1])
+    p = BoostParams(objective="regression", num_iterations=6, num_leaves=5)
+    resumed = train(p, x2, y2, init_model=cat_b)
+    assert resumed.num_trees == cat_b.num_trees + 6
+    assert resumed.trees_cat is not None
+    # old tree kept its categorical routing (set {1,3,40} on feature 0)
+    assert (resumed.trees_cat[0] >= 0).any()
+    assert (resumed.trees_cat[1:] == -1).all()
+
+    # combined = init margins + the new numeric trees' contribution
+    tail = Booster(
+        trees_feature=resumed.trees_feature[1:],
+        trees_threshold=resumed.trees_threshold[1:],
+        trees_left=resumed.trees_left[1:],
+        trees_right=resumed.trees_right[1:],
+        trees_value=resumed.trees_value[1:],
+        trees_cover=resumed.trees_cover[1:],
+        trees_gain=resumed.trees_gain[1:],
+        tree_weights=resumed.tree_weights[1:],
+        params=p, init_score=0.0, num_class=1, num_features=2)
+    want = cat_b.predict(x2) + tail.predict_raw(x2)
+    np.testing.assert_allclose(resumed.predict(x2), want,
+                               rtol=1e-5, atol=1e-5)
+    # native-format round trip of the combined model
+    back = Booster.load_string(resumed.save_string())
+    np.testing.assert_allclose(back.predict(x2), resumed.predict(x2),
+                               rtol=1e-5, atol=1e-5)
+    # NaN in the categorical feature still routes right (warned semantics)
+    xnan = x2.copy()
+    xnan[:5, 0] = np.nan
+    np.testing.assert_allclose(resumed.predict(xnan)[:5],
+                               cat_b.predict(xnan)[:5]
+                               + tail.predict_raw(xnan)[:5],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_learning_rate_schedule_on_mesh_matches_single_device():
+    """Per-iteration LR schedules run on the dp mesh (round-2 guard
+    removed): mesh == single-device for a decaying schedule, and a
+    constant schedule equals the static-LR path."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    p = BoostParams(objective="binary", num_iterations=10, num_leaves=7)
+    lrs = np.linspace(0.2, 0.05, 10).astype(np.float32)
+    single = train(p, X, Y, learning_rates=lrs)
+    meshed = train(p, X, Y, mesh=mesh, learning_rates=lrs)
+    assert meshed.num_trees == 10
+    np.testing.assert_allclose(meshed.predict(X), single.predict(X),
+                               rtol=1e-3, atol=1e-4)
+    const = train(p, X, Y, mesh=mesh,
+                  learning_rates=np.full(10, 0.1, np.float32))
+    base = train(p, X, Y, mesh=mesh)
+    np.testing.assert_allclose(const.predict(X), base.predict(X),
+                               rtol=1e-4, atol=1e-5)
+    # schedule-vs-boosting-type guards hold on the mesh too
+    with pytest.raises(NotImplementedError, match="rf"):
+        train(dataclasses.replace(p, boosting_type="rf",
+                                  bagging_fraction=0.8, bagging_freq=1),
+              X, Y, mesh=mesh, learning_rates=lrs)

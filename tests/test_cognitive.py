@@ -560,3 +560,92 @@ def test_azure_search_writer(mock):
                "score": np.array([0.5, 0.7, 0.9])})
     statuses = w.write(t)
     assert statuses == [200, 200]
+
+
+# ---------------------------------------------------------------------------
+# streaming speech (SpeechToTextSDK analogue)
+# ---------------------------------------------------------------------------
+
+def _multi_utterance_wav(n_utt=3, sr=16000, utt_ms=400, gap_ms=500):
+    """tone / silence / tone ... — n_utt bursts separated by gaps."""
+    from synapseml_tpu.cognitive import pcm_to_wav
+
+    t = np.arange(sr * utt_ms // 1000)
+    tone = (0.3 * np.sin(2 * np.pi * 440 * t / sr) * 32767).astype(np.int16)
+    gap = np.zeros(sr * gap_ms // 1000, np.int16)
+    parts = [gap]
+    for _ in range(n_utt):
+        parts += [tone, gap]
+    return pcm_to_wav(np.concatenate(parts), sr)
+
+
+def test_wav_stream_parses_and_asserts_format():
+    from synapseml_tpu.cognitive import WavStream, pcm_to_wav
+
+    wav = _multi_utterance_wav(1)
+    ws = WavStream(wav)
+    assert (ws.sample_rate, ws.channels, ws.bits_per_sample) == (16000, 1, 16)
+    assert len(ws.pcm) > 0
+    # the SDK pull loop: chunked reads cover the whole stream
+    total = sum(len(c) for c in ws.chunks(100))
+    assert total == len(ws.pcm)
+    # reference asserts (AudioStreams.scala:64-66)
+    import struct as _s
+
+    bad = bytearray(pcm_to_wav(np.zeros(100, np.int16), 8000))
+    with pytest.raises(ValueError, match="16000"):
+        WavStream(bytes(bad))
+    with pytest.raises(ValueError, match="RIFF"):
+        WavStream(b"nonsense")
+
+
+def test_segment_utterances_finds_bursts():
+    from synapseml_tpu.cognitive import WavStream, segment_utterances
+
+    ws = WavStream(_multi_utterance_wav(3))
+    segs = segment_utterances(ws.pcm, ws.sample_rate)
+    assert len(segs) == 3
+    # segments ordered, non-overlapping, each covering ~400ms of tone
+    for (s, e), nxt in zip(segs, segs[1:] + [(len(ws.pcm), 0)]):
+        assert e > s
+        assert e <= nxt[0]
+        assert 0.3 < (e - s) / ws.sample_rate < 0.7
+    assert segment_utterances(np.zeros(16000, np.int16), 16000) == []
+
+
+def test_speech_sdk_streams_per_utterance_rows(mock):
+    from synapseml_tpu.cognitive import SpeechToTextSDK
+
+    sdk = SpeechToTextSDK(url=f"{mock}/speech/recognition",
+                          output_col="utt").set_service_value(
+        "subscription_key", "k").set_service_col("audio_bytes", "audio")
+    t = Table({"audio": np.array([_multi_utterance_wav(3),
+                                  _multi_utterance_wav(2)], dtype=object),
+               "doc": np.array(["a", "b"], dtype=object)})
+    out = sdk.transform(t)
+    # flatMap semantics: 3 + 2 utterance rows, input columns repeated
+    assert out.num_rows == 5
+    assert list(out["doc"]) == ["a", "a", "a", "b", "b"]
+    utts = list(out["utt"])
+    assert all(u["RecognitionStatus"] == "Success" for u in utts)
+    # offsets are 100-ns ticks, strictly increasing within a document
+    offs = [u["Offset"] for u in utts[:3]]
+    assert offs == sorted(offs) and offs[0] > 0
+    assert all(u["Duration"] > 3_000_000 for u in utts)  # >300ms
+
+
+def test_speech_sdk_array_mode_and_empty_audio(mock):
+    from synapseml_tpu.cognitive import SpeechToTextSDK, pcm_to_wav
+
+    sdk = SpeechToTextSDK(url=f"{mock}/speech/recognition",
+                          output_col="utt",
+                          stream_intermediate_results=False)
+    sdk.set_service_value("subscription_key", "k")
+    sdk.set_service_col("audio_bytes", "audio")
+    silent = pcm_to_wav(np.zeros(16000, np.int16))
+    t = Table({"audio": np.array([_multi_utterance_wav(2), silent],
+                                 dtype=object)})
+    out = sdk.transform(t)
+    assert out.num_rows == 2
+    assert len(out["utt"][0]) == 2
+    assert out["utt"][1] == []  # no utterances in silence

@@ -1,0 +1,106 @@
+"""Deployment assets: chart rendering, container entry points, CI file.
+
+(ref: /root/reference/tools/helm — 3 charts; pipeline.yaml — CI. The
+chart-equivalent here is values.yaml + templates + a dependency-free
+renderer, tools/k8s/render.py.)
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def test_chart_renders_without_placeholders(tmp_path):
+    out = str(tmp_path / "rendered")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "k8s", "render.py"),
+         "--out", out], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    names = sorted(os.listdir(out))
+    assert names == ["serving.yaml", "train-job.yaml"]
+    for n in names:
+        text = open(os.path.join(out, n)).read()
+        assert "{{" not in text
+    assert "synapseml-serving" in open(
+        os.path.join(out, "serving.yaml")).read()
+
+
+def test_chart_renders_with_overridden_values(tmp_path):
+    vals = tmp_path / "values.yaml"
+    base = open(os.path.join(ROOT, "tools", "k8s", "chart",
+                             "values.yaml")).read()
+    vals.write_text(base.replace("replicas: 2", "replicas: 7"))
+    out = str(tmp_path / "r2")
+    subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "k8s", "render.py"),
+         "--values", str(vals), "--out", out], check=True)
+    assert "replicas: 7" in open(os.path.join(out, "serving.yaml")).read()
+
+
+def test_ci_pipeline_lists_all_e2e_scripts():
+    text = open(os.path.join(ROOT, "tools", "ci", "pipeline.yaml")).read()
+    examples = sorted(f for f in os.listdir(os.path.join(ROOT, "examples"))
+                      if f.endswith(".py"))
+    assert examples, "examples/ must contain the e2e scripts"
+    for f in examples:
+        assert f"examples/{f}" in text, f"pipeline.yaml must run {f}"
+
+
+@pytest.mark.parametrize("with_model", [False, True])
+def test_serving_container_entry(tmp_path, with_model):
+    """The chart's serving command: model scoring (or echo) + /health."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if with_model:
+        from synapseml_tpu.onnx import zoo
+
+        path = tmp_path / "model.onnx"
+        path.write_bytes(zoo.mlp([4, 8], num_classes=3, seed=0))
+        env["SYNAPSEML_MODEL_PATH"] = str(path)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "synapseml_tpu.io.serving", "--port", "0",
+         "--host", "127.0.0.1", "--name", f"dep{with_model}"],
+        env=env, stdout=subprocess.PIPE, text=True, cwd=ROOT)
+    try:
+        line = p.stdout.readline()
+        url = line.split("on ", 1)[1].split(" ")[0]
+        with urllib.request.urlopen(url.rstrip("/") + "/health",
+                                    timeout=10) as r:
+            assert r.read() == b"ok"
+        payload = {"features": [0.1, 0.2, 0.3, 0.4]} if with_model \
+            else {"ping": 1}
+        req = urllib.request.Request(
+            url, json.dumps(payload).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            body = json.loads(r.read())
+        if with_model:
+            probs = np.asarray(body["output"], np.float64)
+            np.testing.assert_allclose(probs.sum(), 1.0, atol=1e-4)
+        else:
+            assert body == payload
+    finally:
+        p.send_signal(signal.SIGTERM)
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def test_launch_entry_single_process_smoke():
+    """The chart's train command, single-process flavor: initializes (as
+    a no-op), runs the built-in dp smoke fit, exits 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=ROOT)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "synapseml_tpu.parallel.launch"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+    assert "smoke-fit acc=" in r.stdout

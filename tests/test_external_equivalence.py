@@ -1,0 +1,86 @@
+"""External-library equivalence for the GBDT engine.
+
+The reference gates against real lib_lightgbm outputs
+(lightgbm/src/test/resources/benchmarks/*.csv). The lightgbm wheel is
+not in this image, so the strongest offline cross-check is scikit-learn's
+**HistGradientBoosting** — an independent implementation of the same
+algorithm family (histogram binning + leaf-wise growth, explicitly
+modeled on LightGBM). With matched hyperparameters the two engines must
+produce near-identical models: these tests pin prediction-level
+agreement, not just metric-level, so a subtle gradient/split-gain bug
+cannot hide behind "AUC is still fine".
+
+(Measured at commit time: binary prob correlation 0.9990, decision
+agreement 0.994; regression prediction correlation 0.99999, RMSE match
+to 4 significant digits.)
+"""
+import numpy as np
+from sklearn.datasets import load_breast_cancer, load_diabetes
+from sklearn.ensemble import (HistGradientBoostingClassifier,
+                              HistGradientBoostingRegressor)
+from sklearn.metrics import mean_squared_error, roc_auc_score
+from sklearn.model_selection import train_test_split
+
+from synapseml_tpu.gbdt.boosting import BoostParams, train
+
+
+def test_binary_matches_sklearn_hist_gbdt():
+    X, y = load_breast_cancer(return_X_y=True)
+    Xt, Xv, yt, yv = train_test_split(X, y, test_size=0.3, random_state=7)
+    ours = train(
+        BoostParams(objective="binary", num_iterations=60, num_leaves=31,
+                    learning_rate=0.1, min_data_in_leaf=20),
+        Xt, yt.astype(np.float64))
+    sk = HistGradientBoostingClassifier(
+        max_iter=60, max_leaf_nodes=31, learning_rate=0.1,
+        min_samples_leaf=20, early_stopping=False).fit(Xt, yt)
+    p_ours = ours.predict(Xv)
+    p_sk = sk.predict_proba(Xv)[:, 1]
+    # engines agree at the prediction level, not just the metric level
+    assert np.corrcoef(p_ours, p_sk)[0, 1] > 0.995
+    assert ((p_ours > 0.5) == (p_sk > 0.5)).mean() > 0.98
+    auc_ours = roc_auc_score(yv, p_ours)
+    auc_sk = roc_auc_score(yv, p_sk)
+    assert abs(auc_ours - auc_sk) < 0.005
+    assert auc_ours > 0.99
+
+
+def test_regression_matches_sklearn_hist_gbdt():
+    X, y = load_diabetes(return_X_y=True)
+    Xt, Xv, yt, yv = train_test_split(X, y, test_size=0.3, random_state=7)
+    ours = train(
+        BoostParams(objective="regression", num_iterations=80,
+                    num_leaves=31, learning_rate=0.08, min_data_in_leaf=20),
+        Xt, yt)
+    sk = HistGradientBoostingRegressor(
+        max_iter=80, max_leaf_nodes=31, learning_rate=0.08,
+        min_samples_leaf=20, early_stopping=False).fit(Xt, yt)
+    p_ours = ours.predict(Xv)
+    p_sk = sk.predict(Xv)
+    assert np.corrcoef(p_ours, p_sk)[0, 1] > 0.9999
+    rmse_ours = float(np.sqrt(mean_squared_error(yv, p_ours)))
+    rmse_sk = float(np.sqrt(mean_squared_error(yv, p_sk)))
+    # measured: 56.667 vs 56.667 — a loose band still kills real bugs
+    assert abs(rmse_ours - rmse_sk) < 1.0
+
+
+def test_mesh_training_matches_sklearn_too():
+    """The dp-mesh trainer is held to the same external bar (its
+    histograms psum over shards; any reduction bug shows up here)."""
+    import jax
+    from jax.sharding import Mesh
+
+    X, y = load_breast_cancer(return_X_y=True)
+    Xt, Xv, yt, yv = train_test_split(X, y, test_size=0.3, random_state=7)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    ours = train(
+        BoostParams(objective="binary", num_iterations=40, num_leaves=31,
+                    learning_rate=0.1, min_data_in_leaf=20),
+        Xt, yt.astype(np.float64), mesh=mesh)
+    sk = HistGradientBoostingClassifier(
+        max_iter=40, max_leaf_nodes=31, learning_rate=0.1,
+        min_samples_leaf=20, early_stopping=False).fit(Xt, yt)
+    p_ours = ours.predict(Xv)
+    p_sk = sk.predict_proba(Xv)[:, 1]
+    assert np.corrcoef(p_ours, p_sk)[0, 1] > 0.99
+    assert ((p_ours > 0.5) == (p_sk > 0.5)).mean() > 0.97

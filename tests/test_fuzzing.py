@@ -158,6 +158,18 @@ def _mock_server():
     httpd.server_close()
 
 
+def _tiny_wav():
+    """200ms tone + 400ms silence + 200ms tone, canonical PCM16 mono."""
+    from synapseml_tpu.cognitive import pcm_to_wav
+
+    t = np.arange(3200)
+    tone = (0.4 * np.sin(2 * np.pi * 440 * t / 16000) * 32767).astype(
+        np.int16)
+    return pcm_to_wav(np.concatenate(
+        [np.zeros(3200, np.int16), tone, np.zeros(6400, np.int16), tone,
+         np.zeros(3200, np.int16)]))
+
+
 def _svc(cls, **bindings):
     """Construct a cognitive service against the echo mock; string values
     bind columns, non-strings (or *_value suffix) set literals."""
@@ -247,6 +259,7 @@ def _test_objects():
                                          ReadImage,
                                          RecognizeDomainSpecificContent,
                                          RecognizeText, SpeechToText,
+                                         SpeechToTextSDK,
                                          TagImage, TextSentiment, Translate,
                                          Transliterate, VerifyFaces)
     from synapseml_tpu.cyber import (AccessAnomaly,
@@ -621,6 +634,10 @@ def _test_objects():
         "SpeechToText": lambda: (_svc(SpeechToText, audio_bytes="audio"),
                                  Table({"audio": np.array(
                                      [b"RIFFxx", b"RIFFyy"], dtype=object)})),
+        "SpeechToTextSDK": lambda: (
+            _svc(SpeechToTextSDK, audio_bytes="audio"),
+            Table({"audio": np.array([_tiny_wav(), _tiny_wav()],
+                                     dtype=object)})),
         "TagImage": lambda: (_svc(TagImage, image_url="url"), _url_table()),
         "DescribeImageExtended": lambda: (_svc(DescribeImageExtended,
                                                image_url="url"),

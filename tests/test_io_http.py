@@ -179,3 +179,81 @@ def test_binary_file_reader(tmp_path):
     assert 20 <= t3.num_rows <= 80
     t4 = read_binary_files(str(many), sample_ratio=0.25, seed=1)
     assert list(t3["path"]) == list(t4["path"])
+
+
+# ---------------------------------------------------------------------------
+# PowerBI writer (round-2 weak #7: was the one untested component)
+# ---------------------------------------------------------------------------
+
+def _powerbi_mock():
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        batches = []
+        fail_next = [0]
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(
+                int(self.headers.get("Content-Length", 0)))
+            if Handler.fail_next[0] > 0:
+                Handler.fail_next[0] -= 1
+                self.send_response(503)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            Handler.batches.append(json.loads(body))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, Handler
+
+
+def test_powerbi_writer_batches_and_serializes_numpy():
+    from synapseml_tpu.io.powerbi import write_to_powerbi
+
+    httpd, handler = _powerbi_mock()
+    try:
+        t = Table({"name": np.array(["a", "b", "c"], dtype=object),
+                   "score": np.array([1.5, 2.5, np.nan], np.float64),
+                   "count": np.arange(3, dtype=np.int64),
+                   "flag": np.array([True, False, True])})
+        statuses = write_to_powerbi(
+            t, f"http://127.0.0.1:{httpd.server_address[1]}/push",
+            batch_size=2)
+        assert statuses == [200, 200]
+        assert [len(b) for b in handler.batches] == [2, 1]
+        row0 = handler.batches[0][0]
+        # numpy scalars serialized as plain JSON types by _json_default
+        assert row0 == {"name": "a", "score": 1.5, "count": 0,
+                        "flag": True}
+        assert handler.batches[1][0]["score"] is None or \
+            handler.batches[1][0]["score"] != handler.batches[1][0]["score"]
+    finally:
+        httpd.shutdown()
+
+
+def test_powerbi_writer_retries_then_raises():
+    from synapseml_tpu.io.powerbi import write_to_powerbi
+
+    httpd, handler = _powerbi_mock()
+    try:
+        t = Table({"x": np.arange(2, dtype=np.int64)})
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/push"
+        # one 503 is absorbed by the retry ladder
+        handler.fail_next[0] = 1
+        assert write_to_powerbi(t, url, backoffs_ms=(10, 20)) == [200]
+        # more failures than backoffs surface as an error
+        handler.fail_next[0] = 10
+        with pytest.raises(RuntimeError, match="PowerBI POST failed"):
+            write_to_powerbi(t, url, backoffs_ms=(10,))
+    finally:
+        httpd.shutdown()

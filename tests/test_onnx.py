@@ -626,6 +626,24 @@ def test_loop_traced_trip_count():
                       np.int64(50))), [8, 8])
 
 
+def test_loop_int64_max_trip_count_means_unbounded():
+    """torch exports scripted `while cond:` as Loop with M = INT64_MAX;
+    with x64 disabled a naive cast canonicalizes that to int32 -1 and
+    the loop would silently run ZERO iterations — it must be treated as
+    unbounded instead (round-3 review finding)."""
+    g = GraphBuilder(opset=17)
+    acc0 = g.add_input("acc0", np.float32, [2])
+    g.add_input("limit", np.float32, [])
+    trip = g.add_initializer("M", np.int64(2**63 - 1))
+    cond0 = g.add_initializer("cond0", np.array(True))
+    g.add_node("Loop", [trip, cond0, acc0], outputs=["final"],
+               body=_while_body())
+    g.add_output("final", np.float32, [2])
+    gi = import_model(g.to_bytes())
+    final, = gi.apply(gi.params, np.ones(2, np.float32), np.float32(16.0))
+    np.testing.assert_allclose(np.asarray(final), [8.0, 8.0])
+
+
 def test_loop_traced_cond_with_scan_outputs_rejected():
     """Scan outputs under a data-dependent trip count would have a
     data-dependent shape; XLA cannot express that — clear error."""

@@ -1,0 +1,78 @@
+"""Proto-level graph optimizer (onnx/optimize.py): parallel-MatMul/QKV
+packing. Mathematically exact, but XLA may accumulate the packed shape
+in a different order, so parity asserts float32 tightness. Ships off by
+default — docs/perf.md records the on-chip A/B that put it there."""
+import numpy as np
+
+from synapseml_tpu.onnx import import_model, proto, zoo
+from synapseml_tpu.onnx.optimize import pack_parallel_matmuls
+
+
+def _load_graph(blob):
+    return proto.load_model(blob).graph
+
+
+def test_qkv_packing_fires_and_is_exact():
+    blob = zoo.transformer_encoder(100, 64, 4, 128, 2, seq_len=16, seed=0)
+    g_ref = import_model(blob)                  # default: no rewrites
+    g_opt = import_model(blob, optimize=True)
+    # 2 layers x (3 MatMuls -> packed MatMul + Split): one node saved per
+    # layer and the packed weight replaces three
+    assert len(g_opt._nodes) == len(g_ref._nodes) - 2
+    ids = np.random.default_rng(0).integers(0, 100, (3, 16))
+    a = np.asarray(g_ref.apply(g_ref.params, ids)[0])
+    b = np.asarray(g_opt.apply(g_opt.params, ids)[0])
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_packing_respects_graph_outputs_and_shared_weights():
+    from synapseml_tpu.onnx import GraphBuilder
+
+    # two parallel MatMuls, but one output IS a graph output: must not pack
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, ["N", 4])
+    w1 = g.add_initializer("w1", np.ones((4, 3), np.float32))
+    w2 = g.add_initializer("w2", np.full((4, 5), 2.0, np.float32))
+    y1 = g.add_node("MatMul", [x, w1])
+    y2 = g.add_node("MatMul", [x, w2])
+    g.add_output(y1, np.float32, ["N", 3])
+    g.add_output(y2, np.float32, ["N", 5])
+    graph = _load_graph(g.to_bytes())
+    assert pack_parallel_matmuls(graph, opset=17) == 0
+
+    # a weight consumed twice must not be folded into a pack
+    g2 = GraphBuilder(opset=17)
+    x = g2.add_input("x", np.float32, ["N", 4])
+    w1 = g2.add_initializer("w1", np.ones((4, 3), np.float32))
+    w2 = g2.add_initializer("w2", np.full((4, 3), 2.0, np.float32))
+    a1 = g2.add_node("MatMul", [x, w1])
+    a2 = g2.add_node("MatMul", [x, w2])
+    extra = g2.add_node("MatMul", [a1, w2])  # second use of w2
+    s = g2.add_node("Add", [a2, extra])
+    g2.add_output(s, np.float32, ["N", 3])
+    graph2 = _load_graph(g2.to_bytes())
+    assert pack_parallel_matmuls(graph2, opset=17) == 0
+
+
+def test_packing_pre13_split_attribute_form():
+    from synapseml_tpu.onnx import GraphBuilder
+
+    g = GraphBuilder(opset=11)
+    x = g.add_input("x", np.float32, ["N", 4])
+    w1 = g.add_initializer("w1", np.arange(12, dtype=np.float32).reshape(4, 3))
+    w2 = g.add_initializer("w2", np.arange(20, dtype=np.float32).reshape(4, 5))
+    y1 = g.add_node("MatMul", [x, w1])
+    y2 = g.add_node("MatMul", [x, w2])
+    out = g.add_node("Concat", [y1, y2], axis=-1)
+    g.add_output(out, np.float32, ["N", 8])
+    blob = g.to_bytes()
+    ref = import_model(blob)
+    graph = _load_graph(blob)
+    assert pack_parallel_matmuls(graph, opset=11) == 1
+    from synapseml_tpu.onnx.importer import ImportedGraph
+
+    opt = ImportedGraph(graph, 11)
+    xv = np.random.default_rng(1).normal(size=(6, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ref.apply(ref.params, xv)[0]),
+        np.asarray(opt.apply(opt.params, xv)[0]), rtol=1e-6, atol=1e-6)

@@ -278,3 +278,51 @@ print("RANK", reply["process_id"], "PSUM", float(local[0]), flush=True)
         for line in out.splitlines():
             if line.startswith("RANK"):
                 assert line.split()[3] == "12.0", line
+
+
+def test_two_level_all_reduce_equals_flat_psum():
+    """DCN-aware schedule (reduce-scatter ICI -> psum DCN -> all-gather
+    ICI) must equal a flat psum over both axes."""
+    import jax
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    from synapseml_tpu.parallel.collectives import two_level_all_reduce
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("outer", "inner"))
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+
+    def flat(xl):
+        return lax.psum(xl, ("outer", "inner"))
+
+    def tiered(xl):
+        return two_level_all_reduce(xl, "inner", "outer", scatter_axis=1)
+
+    spec = P(("outer", "inner"), None)
+    args = dict(mesh=mesh, in_specs=spec, out_specs=spec)
+    a = np.asarray(jax.jit(shard_map(flat, **args))(x))
+    b = np.asarray(jax.jit(shard_map(tiered, **args))(x))
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_ring_all_reduce_equals_psum():
+    import jax
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    from synapseml_tpu.parallel.collectives import ring_all_reduce
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("r",))
+    x = np.random.default_rng(0).normal(size=(4, 8, 6)).astype(np.float32)
+
+    spec = P("r", None, None)
+    args = dict(mesh=mesh, in_specs=spec, out_specs=spec)
+    a = np.asarray(jax.jit(shard_map(
+        lambda xl: lax.psum(xl, "r"), **args))(x))
+    b = np.asarray(jax.jit(shard_map(
+        lambda xl: ring_all_reduce(xl, "r", chunk_axis=1), **args))(x))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)

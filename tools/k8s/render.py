@@ -1,0 +1,77 @@
+"""Dependency-free chart renderer: fills {{ dotted.path }} placeholders
+in tools/k8s/chart/templates/*.yaml from values.yaml (or an overrides
+file) — the helm-template equivalent for environments without helm
+(ref: /root/reference/tools/helm; same values layout, so the templates
+can migrate to helm unchanged).
+
+    python tools/k8s/render.py [--values my-values.yaml] [--out DIR]
+"""
+import argparse
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def parse_simple_yaml(text):
+    """Minimal YAML subset: nested maps, scalars, comments. Enough for
+    values files; no lists/anchors (use overrides for anything fancier)."""
+    root = {}
+    stack = [(-1, root)]
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        indent = len(line) - len(line.lstrip())
+        key, _, val = line.strip().partition(":")
+        while stack and indent <= stack[-1][0]:
+            stack.pop()
+        parent = stack[-1][1]
+        val = val.strip()
+        if val == "":
+            child = {}
+            parent[key] = child
+            stack.append((indent, child))
+        else:
+            parent[key] = val.strip("\"'")
+    return root
+
+
+def lookup(values, dotted):
+    cur = values
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            raise KeyError(f"values has no key {dotted!r}")
+        cur = cur[part]
+    return cur
+
+
+def render(template_text, values):
+    def sub(m):
+        return str(lookup(values, m.group(1).strip()))
+    return re.sub(r"\{\{([^}]+)\}\}", sub, template_text)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--values",
+                    default=os.path.join(_HERE, "chart", "values.yaml"))
+    ap.add_argument("--out", default=os.path.join(_HERE, "rendered"))
+    args = ap.parse_args(argv)
+    with open(args.values) as fh:
+        values = parse_simple_yaml(fh.read())
+    tdir = os.path.join(_HERE, "chart", "templates")
+    os.makedirs(args.out, exist_ok=True)
+    for name in sorted(os.listdir(tdir)):
+        with open(os.path.join(tdir, name)) as fh:
+            out = render(fh.read(), values)
+        dest = os.path.join(args.out, name)
+        with open(dest, "w") as fh:
+            fh.write(out)
+        print(f"rendered {dest}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

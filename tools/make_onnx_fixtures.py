@@ -1,0 +1,111 @@
+"""Generate committed foreign-exporter ONNX fixtures.
+
+Every other ONNX graph in this repo's tests is emitted by the in-repo
+``onnx/builder.py``; these fixtures instead come out of **torch.onnx** —
+a real third-party exporter with its own serializer and idioms (dynamic
+batch dims, Shape chains from Flatten, Identity/Dropout noise, traced
+size arithmetic) — so the importer is certified against bytes it did not
+write. The reference feeds arbitrary user .onnx files to onnxruntime
+(deep-learning/.../onnx/ONNXModel.scala:173-193); committed fixtures are
+the offline equivalent.
+
+Run from the repo root (writes tests/fixtures/*.onnx + expected .npz):
+
+    python tools/make_onnx_fixtures.py
+"""
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+# The TorchScript exporter produces the complete model bytes with torch's
+# own C++ protobuf serializer, then imports the `onnx` wheel only to
+# re-inject onnxscript custom functions (none are used here). This image
+# has no onnx wheel, so skip that no-op step and keep the raw bytes.
+from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+onnx_proto_utils._add_onnxscript_fn = lambda model_bytes, custom_opsets: \
+    model_bytes
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir, "tests", "fixtures")
+
+
+class SmallCNN(nn.Module):
+    """Conv/BN/pool classifier with the noise real exports carry:
+    Dropout (folds to Identity in eval), Flatten (a Shape->Gather->
+    Concat->Reshape chain under dynamic batch), and a log-softmax head.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2d(1, 8, 3, padding=1), nn.BatchNorm2d(8), nn.ReLU(),
+            nn.MaxPool2d(2),
+            nn.Conv2d(8, 16, 3, padding=1), nn.ReLU(),
+            nn.AdaptiveAvgPool2d(4),
+        )
+        self.drop = nn.Dropout(0.5)
+        self.fc1 = nn.Linear(16 * 4 * 4, 32)
+        self.fc2 = nn.Linear(32, 10)
+
+    def forward(self, x):
+        y = self.features(x)
+        y = torch.flatten(y, 1)
+        y = self.drop(torch.relu(self.fc1(y)))
+        return torch.log_softmax(self.fc2(y), dim=1)
+
+
+class GruSeq(nn.Module):
+    """GRU sequence model: embedding gather + recurrent cell + per-step
+    head — the RNN-era export shape (ONNX GRU op, Transpose layout
+    shuffles, Gather on a traced index)."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(50, 12)
+        self.gru = nn.GRU(12, 16, batch_first=True, bidirectional=True)
+        self.head = nn.Linear(32, 5)
+
+    def forward(self, ids):
+        x = self.emb(ids)
+        y, _ = self.gru(x)
+        # slice the final timestep through traced size arithmetic so the
+        # exporter emits a Shape/Gather/Slice chain
+        return self.head(y[:, y.shape[1] - 1, :])
+
+
+def export(model, args, name, dynamic_axes):
+    model.eval()
+    path = os.path.join(OUT, f"{name}.onnx")
+    with torch.no_grad():
+        expected = model(*args).numpy()
+    torch.onnx.export(
+        model, args, path, opset_version=17, dynamo=False,
+        input_names=["input"], output_names=["output"],
+        dynamic_axes=dynamic_axes, do_constant_folding=True)
+    np.savez(os.path.join(OUT, f"{name}_io.npz"),
+             input=args[0].numpy(), expected=expected)
+    print(f"{name}: {os.path.getsize(path)} bytes, out {expected.shape}")
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    torch.manual_seed(1234)
+    cnn = SmallCNN()
+    # non-trivial BN running stats, as a trained checkpoint would have
+    with torch.no_grad():
+        cnn.features[1].running_mean.normal_(0, 0.5)
+        cnn.features[1].running_var.uniform_(0.5, 2.0)
+    x = torch.randn(3, 1, 16, 16)
+    export(cnn, (x,), "torch_cnn",
+           {"input": {0: "batch"}, "output": {0: "batch"}})
+
+    gru = GruSeq()
+    ids = torch.randint(0, 50, (4, 9))
+    export(gru, (ids,), "torch_gru",
+           {"input": {0: "batch", 1: "seq"}, "output": {0: "batch"}})
+
+
+if __name__ == "__main__":
+    main()
