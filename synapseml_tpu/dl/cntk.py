@@ -6,17 +6,18 @@ Rebuild of the reference's CNTKModel
 by node NAME or INDEX (:196-338), minibatched transform :470-515;
 SerializableFunction.scala:85-143).
 
-Design decision (TPU-first, not a port): CNTK's binary ``.model`` format is
-executed in the reference by the CNTK 2.4 native runtime — dead since 2019
-and CUDA/CPU-only. CNTK's own supported interchange path is its ONNX
-export (``cntk.Function.save(..., format=ModelFormat.ONNX)``), so this
-transformer consumes that artifact and lowers it through the same
-ONNX->jax importer as everything else, while keeping CNTKModel's API
-surface: ``feed_dict``/``fetch_dict`` accept node names OR integer
-indices, ``set_output_node`` selects/truncates by name or index (the
-``cutOutputLayers`` sibling), and minibatching matches the reference.
-Raw ``.model`` bytes are detected and rejected with the conversion recipe
-instead of failing deep in a parser.
+Design decision (TPU-first, not a port): CNTK's native runtime (CNTK 2.4
+JNI) is dead since 2019 and CUDA/CPU-only, so nothing here executes it.
+Raw v2 ``.model`` bytes are parsed DIRECTLY — the CNTKv2 protobuf
+Dictionary format (dl/cntk_format.py: CompositeFunction layout,
+column-major NDShapes, uid-wired primitive functions) converts to ONNX
+and lowers through the same ONNX->jax importer as everything else.
+CNTK's own ONNX export is equally accepted (and remains the recipe for
+v1 binaries or recurrent graphs outside the direct reader's surface).
+CNTKModel's API surface is kept: ``feed_dict``/``fetch_dict`` accept
+node names OR integer indices, ``set_output_node`` selects/truncates by
+name or index (the ``cutOutputLayers`` sibling), minibatching matches
+the reference.
 """
 from __future__ import annotations
 
@@ -29,20 +30,58 @@ from synapseml_tpu.onnx.model import ONNXModel
 
 
 _NATIVE_CNTK_MSG = (
-    "this is a native CNTK v2 .model file; its runtime (CNTK 2.4 JNI) has "
-    "no TPU port. Export it to ONNX once with the CNTK python package — "
-    "z.save('model.onnx', format=cntk.ModelFormat.ONNX) — and load that "
-    "file here")
+    "this CNTK .model file could not be parsed: the direct reader covers "
+    "CNTK v2 feedforward graphs (dl/cntk_format.py); v1/BrainScript-era "
+    "binaries and recurrent v2 graphs need a one-time ONNX export with "
+    "the CNTK python package — z.save('model.onnx', "
+    "format=cntk.ModelFormat.ONNX) — load that file here instead")
+
+
+def _coerce_payload(payload: bytes) -> bytes:
+    """ONNX bytes pass through; CNTK v2 Dictionary bytes convert via the
+    direct reader (dl/cntk_format.py); anything else (v1 binaries,
+    unsupported graphs) raises with the export recipe."""
+    if _looks_like_onnx(payload):
+        return payload
+    from synapseml_tpu.dl.cntk_format import (cntk_to_onnx,
+                                              looks_like_cntk_v2)
+
+    if looks_like_cntk_v2(payload):
+        try:
+            return cntk_to_onnx(payload)
+        except (NotImplementedError, KeyError, ValueError, TypeError) as e:
+            # the class contract is "raises ValueError with the export
+            # recipe" — malformed composites must not leak bare KeyErrors
+            raise ValueError(f"{_NATIVE_CNTK_MSG} (reader said: {e})") \
+                from e
+    raise ValueError(_NATIVE_CNTK_MSG)
 
 
 def _looks_like_onnx(payload: bytes) -> bool:
-    # ONNX files are a protobuf ModelProto: field 1 (ir_version) varint or
-    # field 7/8; CNTK v2 binary models start with the magic "B\x00C\x00N\x00"
-    # UTF-16 header ("BCNTK...") or legacy "CNTK" tags.
+    # Both ONNX ModelProto and CNTK v2 Dictionary bytes open with a
+    # field-1 varint, so magic sniffing is not enough — but a FULL decode
+    # just to sniff would parse every weight tensor (and run up to three
+    # times on first use). Instead, skim the TOP-LEVEL wire fields only:
+    # ModelProto has graph at field 7 / opset_import at 8; the Dictionary
+    # has nothing above field 2. Sub-messages are skipped, not decoded.
     head = payload[:64]
     if b"C\x00N\x00T\x00K" in head or head.startswith(b"CNTK"):
         return False
-    return True
+    from synapseml_tpu.onnx.proto import _read_varint, _skip
+
+    pos, end = 0, len(payload)
+    try:
+        while pos < end:
+            tag, pos = _read_varint(payload, pos)
+            num, wire = tag >> 3, tag & 7
+            if num == 0 or num > 1000:
+                return False  # not a sane proto field
+            if num in (7, 8) and wire == 2:  # graph / opset_import
+                return True
+            pos = _skip(payload, pos, wire)
+        return False
+    except Exception:  # noqa: BLE001 - undecodable -> not ONNX
+        return False
 
 
 class CNTKModel(ONNXModel):
@@ -63,8 +102,8 @@ class CNTKModel(ONNXModel):
             with open(model_path, "rb") as fh:
                 model_bytes = fh.read()
             model_path = None
-        if model_bytes is not None and not _looks_like_onnx(model_bytes):
-            raise ValueError(_NATIVE_CNTK_MSG)
+        if model_bytes is not None:
+            model_bytes = _coerce_payload(bytes(model_bytes))
         super().__init__(model_bytes=model_bytes, **kw)
 
     # -- truncation-aware graph (param-backed: survives save/load/copy) --
@@ -80,7 +119,8 @@ class CNTKModel(ONNXModel):
         if payload is not None and not _looks_like_onnx(bytes(payload)):
             # covers every assignment path (model_payload=... via set(),
             # the generated R wrapper, load) — not just __init__ kwargs
-            raise ValueError(_NATIVE_CNTK_MSG)
+            payload = _coerce_payload(bytes(payload))
+            self.set(model_payload=payload)
         g = ONNXModel.graph.fget(self)
         if cut:
             g = g.truncated(cut)

@@ -1,0 +1,536 @@
+"""CNTK v2 binary ``.model`` reader: protobuf Dictionary -> ONNX -> jax.
+
+The reference executes native ``.model`` files through the CNTK 2.4 JNI
+runtime (ref: deep-learning/src/main/scala/com/microsoft/ml/spark/cntk/
+SerializableFunction.scala:85-143 — ``Function.load`` on broadcast
+bytes). That runtime is dead and CUDA/CPU-only, so here the *format*
+is parsed directly: CNTK-2.x model files are a serialized ``Dictionary``
+protobuf (the CNTKv2LibraryDll ``CNTK.proto`` schema — NDShape/Axis/
+NDArrayView/Vector/Dictionary/DictionaryValue messages) holding a
+``CompositeFunction``: a vector of primitive functions wired by variable
+uids (outputs follow the ``<func_uid>_Output_<k>`` convention) plus the
+parameter/constant payloads. The graph is re-emitted as ONNX and lowered
+through the standard importer, so every op lands on the same jit path as
+user ONNX files.
+
+Format notes (why the reshapes below look reversed): CNTK NDShapes store
+dimensions fastest-varying first and tensors column-major; reading the
+flat payload row-major with the dims REVERSED yields the numpy/ONNX
+layout directly (a conv kernel ``(kW,kH,Cin,Cout)`` becomes
+``(Cout,Cin,kH,kW)``). The batch axis is a dynamic axis — absent from
+shapes — and maps to the leading "N" dim; a CNTK static axis index k
+(0 = fastest) maps to negative numpy axis ``-(k+1)``.
+
+Supported op surface: the feedforward model-zoo diet (Times/Plus/
+activation chains, Convolution, Pooling, BatchNormalization, Reshape,
+Splice, Slice, TransposeAxes, ReduceElements, Clip, Dropout/NoOp
+passthrough, Combine). Recurrent ops (PastValue/OptimizedRNNStack)
+raise with the ONNX-export recipe, as before.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from synapseml_tpu.onnx import proto
+from synapseml_tpu.onnx.builder import GraphBuilder
+from synapseml_tpu.onnx.proto import F, Msg
+
+# ---------------------------------------------------------------------------
+# CNTK.proto subset (field numbers frozen by protobuf compatibility)
+# ---------------------------------------------------------------------------
+
+_CNTK_SCHEMAS = {
+    "CntkNDShape": [F(1, "shape_dim", "int64", repeated=True)],
+    "CntkAxis": [
+        F(1, "static_axis_idx", "int64"),
+        F(2, "name", "string"),
+        F(3, "is_ordered_dynamic_axis", "int64"),
+    ],
+    "CntkFloatValues": [F(1, "value", "float", repeated=True)],
+    "CntkDoubleValues": [F(1, "value", "double", repeated=True)],
+    "CntkNDArrayView": [
+        F(1, "data_type", "int64"),      # 1 = Float, 2 = Double
+        F(2, "storage_format", "int64"),  # 0 = Dense
+        F(3, "shape", "message", message="CntkNDShape"),
+        F(4, "float_values", "message", message="CntkFloatValues"),
+        F(5, "double_values", "message", message="CntkDoubleValues"),
+    ],
+    "CntkVector": [
+        F(1, "value", "message", repeated=True,
+          message="CntkDictionaryValue"),
+    ],
+    "CntkDictionary": [
+        F(1, "version", "int64"),
+        F(2, "data", "message", repeated=True,
+          message="CntkDictionaryEntry"),
+    ],
+    "CntkDictionaryEntry": [  # protobuf map<string, DictionaryValue> entry
+        F(1, "key", "string"),
+        F(2, "value", "message", message="CntkDictionaryValue"),
+    ],
+    "CntkDictionaryValue": [
+        F(1, "version", "int64"),
+        F(2, "bool_value", "int64"),
+        F(3, "int_value", "int64"),
+        F(4, "size_t_value", "int64"),
+        F(5, "float_value", "float"),
+        F(6, "double_value", "double"),
+        F(7, "string_value", "string"),
+        F(8, "nd_shape_value", "message", message="CntkNDShape"),
+        F(9, "axis_value", "message", message="CntkAxis"),
+        F(10, "vector_value", "message", message="CntkVector"),
+        F(11, "dictionary_value", "message", message="CntkDictionary"),
+        F(12, "nd_array_view_value", "message", message="CntkNDArrayView"),
+    ],
+}
+proto._SCHEMAS.update(_CNTK_SCHEMAS)
+
+_INFERRED = (1 << 64) - 1  # NDShape::InferredDimension, wraps to -1 signed
+
+
+class CntkAxisRef:
+    __slots__ = ("static_axis_idx", "name")
+
+    def __init__(self, idx: int, name: str = ""):
+        self.static_axis_idx = int(idx)
+        self.name = name
+
+
+def _shape_dims(shape_msg: Msg) -> List[int]:
+    return [int(d) for d in (shape_msg.shape_dim or [])]
+
+
+def _ndarray_to_numpy(view: Msg) -> np.ndarray:
+    dims = _shape_dims(view.shape) if view.shape is not None else []
+    if int(view.storage_format or 0) != 0:
+        raise NotImplementedError(
+            "sparse NDArrayView payloads are not supported")
+    if view.float_values is not None:
+        flat = np.asarray(view.float_values.value, np.float32)
+    elif view.double_values is not None:
+        flat = np.asarray(view.double_values.value, np.float64)
+    else:
+        flat = np.zeros(0, np.float32)
+    # CNTK stores column-major with fastest-varying dim first; reversing
+    # the dims makes the row-major read correct
+    return flat.reshape(tuple(reversed(dims))) if dims else flat
+
+
+def _numpy_to_ndarray(arr: np.ndarray) -> Msg:
+    view = Msg("CntkNDArrayView")
+    view.data_type = 2 if arr.dtype == np.float64 else 1
+    view.storage_format = 0
+    shp = Msg("CntkNDShape")
+    shp.shape_dim = [int(d) for d in reversed(arr.shape)]
+    view.shape = shp
+    vals = Msg("CntkDoubleValues" if arr.dtype == np.float64
+               else "CntkFloatValues")
+    vals.value = [float(v) for v in np.asarray(arr).reshape(-1)]
+    if arr.dtype == np.float64:
+        view.double_values = vals
+    else:
+        view.float_values = vals
+    return view
+
+
+def value_to_py(v: Msg) -> Any:
+    """DictionaryValue -> python (dict / list / ndarray / scalar)."""
+    if v.dictionary_value is not None:
+        return dict_to_py(v.dictionary_value)
+    if v.vector_value is not None:
+        return [value_to_py(e) for e in v.vector_value.value]
+    if v.nd_array_view_value is not None:
+        return _ndarray_to_numpy(v.nd_array_view_value)
+    if v.nd_shape_value is not None:
+        return _shape_dims(v.nd_shape_value)
+    if v.axis_value is not None:
+        return CntkAxisRef(v.axis_value.static_axis_idx or 0,
+                           v.axis_value.name or "")
+    if v.string_value is not None:
+        return v.string_value
+    if v.float_value is not None:
+        return float(v.float_value)
+    if v.double_value is not None:
+        return float(v.double_value)
+    if v.size_t_value is not None:
+        return int(v.size_t_value) & ((1 << 64) - 1)
+    if v.int_value is not None:
+        return int(v.int_value)
+    if v.bool_value is not None:
+        return bool(v.bool_value)
+    return None  # proto3 default (False / 0 / "") never reaches the wire
+
+
+def dict_to_py(d: Msg) -> Dict[str, Any]:
+    return {e.key: value_to_py(e.value) for e in (d.data or [])}
+
+
+def py_to_value(v: Any) -> Msg:
+    out = Msg("CntkDictionaryValue")
+    out.version = 1
+    if isinstance(v, dict):
+        out.dictionary_value = py_to_dict(v)
+    elif isinstance(v, (list, tuple)) and not isinstance(v, str):
+        if v and all(isinstance(x, (int, np.integer)) for x in v):
+            shp = Msg("CntkNDShape")
+            shp.shape_dim = [int(x) for x in v]
+            out.nd_shape_value = shp
+        else:
+            vec = Msg("CntkVector")
+            vec.value = [py_to_value(x) for x in v]
+            out.vector_value = vec
+    elif isinstance(v, np.ndarray):
+        out.nd_array_view_value = _numpy_to_ndarray(v)
+    elif isinstance(v, CntkAxisRef):
+        ax = Msg("CntkAxis")
+        ax.static_axis_idx = v.static_axis_idx
+        ax.name = v.name
+        out.axis_value = ax
+    elif isinstance(v, bool):
+        out.bool_value = int(v)
+    elif isinstance(v, (int, np.integer)):
+        # CNTK keeps signed attribute ints (slice begin/end) in
+        # int_value; size_t_value is unsigned and would mask negatives
+        # into 2^64-range garbage on the read side
+        if int(v) < 0:
+            out.int_value = int(v)
+        else:
+            out.size_t_value = int(v)
+    elif isinstance(v, float):
+        out.double_value = v
+    elif isinstance(v, str):
+        out.string_value = v
+    else:
+        raise TypeError(f"cannot serialize {type(v)} into a CNTK "
+                        f"DictionaryValue")
+    return out
+
+
+def py_to_dict(d: Dict[str, Any]) -> Msg:
+    out = Msg("CntkDictionary")
+    out.version = 1
+    entries = []
+    for k, v in d.items():
+        e = Msg("CntkDictionaryEntry")
+        e.key = k
+        e.value = py_to_value(v)
+        entries.append(e)
+    out.data = entries
+    return out
+
+
+def load_model_dictionary(payload: bytes) -> Dict[str, Any]:
+    return dict_to_py(proto.decode("CntkDictionary", payload))
+
+
+# ---------------------------------------------------------------------------
+# PrimitiveOpType (CNTK 2.x PrimitiveOpType.h enum order)
+# ---------------------------------------------------------------------------
+
+OP_NEGATE, OP_SIGMOID, OP_TANH, OP_RELU, OP_EXP, OP_LOG, OP_SQRT = range(7)
+OP_FLOOR, OP_ABS, OP_RECIPROCAL, OP_SOFTMAX, OP_HARDMAX = 7, 8, 9, 10, 11
+OP_TRANSPOSE_AXES, OP_WHERE, OP_SLICE, OP_DROPOUT, OP_RESHAPE = 12, 13, 14, 15, 16
+OP_POOLING, OP_SUM_ALL, OP_PLUS, OP_LOG_PLUS, OP_MINUS = 17, 18, 19, 20, 21
+OP_ELEMENT_TIMES, OP_EQUAL, OP_NOT_EQUAL, OP_LESS = 22, 23, 24, 25
+OP_LESS_EQUAL, OP_GREATER, OP_GREATER_EQUAL = 26, 27, 28
+OP_TIMES, OP_TRANSPOSE_TIMES, OP_CONVOLUTION = 32, 33, 34
+OP_PAST_VALUE, OP_FUTURE_VALUE, OP_REDUCE_ELEMENTS = 38, 39, 40
+OP_BATCH_NORM, OP_CLIP, OP_SELECT, OP_SPLICE, OP_COMBINE = 41, 42, 43, 44, 45
+OP_LOG_SOFTMAX, OP_NO_OP, OP_STOP_GRADIENT, OP_ELU = 52, 56, 58, 59
+
+_UNARY = {
+    OP_NEGATE: "Neg", OP_SIGMOID: "Sigmoid", OP_TANH: "Tanh",
+    OP_RELU: "Relu", OP_EXP: "Exp", OP_LOG: "Log", OP_SQRT: "Sqrt",
+    OP_FLOOR: "Floor", OP_ABS: "Abs", OP_RECIPROCAL: "Reciprocal",
+    OP_ELU: "Elu",
+}
+_BINARY = {OP_PLUS: "Add", OP_MINUS: "Sub", OP_ELEMENT_TIMES: "Mul"}
+
+
+class _Var:
+    __slots__ = ("uid", "kind", "shape", "value", "name")
+
+    def __init__(self, d: Dict[str, Any]):
+        self.uid = d["uid"]
+        self.kind = int(d.get("kind", 0))
+        self.shape = [int(s) for s in d.get("shape", [])]
+        self.value = d.get("value")
+        self.name = d.get("name", "")
+
+
+VAR_INPUT, VAR_OUTPUT, VAR_PARAMETER, VAR_CONSTANT, VAR_PLACEHOLDER = range(5)
+
+
+def cntk_to_onnx(payload: bytes) -> bytes:
+    """Parse ``.model`` bytes and re-emit the graph as ONNX bytes."""
+    top = load_model_dictionary(payload)
+    if top.get("type") != "CompositeFunction":
+        raise ValueError(
+            f"not a CNTK v2 CompositeFunction dictionary "
+            f"(type={top.get('type')!r})")
+    variables = {v["uid"]: _Var(v) for v in top.get("inputs", [])}
+    functions = top.get("primitive_functions", [])
+    root = top.get("root")
+
+    g = GraphBuilder(name=top.get("name") or "cntk_model", opset=17)
+    names: Dict[str, str] = {}   # cntk variable uid -> onnx tensor name
+
+    def resolve(uid: str, transpose_param: bool = False) -> str:
+        # a shared parameter may be consumed in BOTH orientations
+        # (weight tying): the cache key carries the flip
+        key = (uid, transpose_param)
+        if key in names:
+            return names[key]
+        var = variables.get(uid)
+        if var is None:
+            raise KeyError(f"dangling variable uid {uid!r}")
+        if var.kind in (VAR_PARAMETER, VAR_CONSTANT):
+            arr = np.asarray(var.value)
+            if transpose_param:
+                arr = np.ascontiguousarray(arr.T)
+            nm = g.add_initializer(g.fresh(var.name or uid), arr)
+        elif var.kind == VAR_INPUT:
+            if transpose_param:
+                raise NotImplementedError(
+                    "Times with a non-parameter weight operand needs a "
+                    "runtime transpose; export to ONNX with the cntk "
+                    "package for this graph")
+            if (uid, False) in names:
+                return names[(uid, False)]
+            nm = g.add_input(var.name or uid, np.float32,
+                             ["N"] + list(reversed(var.shape)))
+        else:
+            raise ValueError(f"unresolvable variable {uid!r} "
+                             f"(kind={var.kind})")
+        names[key] = nm
+        return nm
+
+    def np_axis(attr) -> int:
+        k = attr.static_axis_idx if isinstance(attr, CntkAxisRef) \
+            else int(attr)
+        return -(k + 1)
+
+    def is_param(uid: str) -> bool:
+        v = variables.get(uid)
+        return v is not None and v.kind in (VAR_PARAMETER, VAR_CONSTANT)
+
+    last_output = None
+    for fd in functions:
+        op = int(fd["op"])
+        uid = fd["uid"]
+        ins: List[str] = list(fd.get("inputs", []))
+        attrs: Dict[str, Any] = fd.get("attributes", {}) or {}
+        out_name = f"{uid}_Output_0"
+
+        if op in _UNARY:
+            y = g.add_node(_UNARY[op], [resolve(ins[0])])
+        elif op in _BINARY:
+            y = g.add_node(_BINARY[op], [resolve(ins[0]), resolve(ins[1])])
+        elif op in (OP_SOFTMAX, OP_LOG_SOFTMAX):
+            y = g.add_node("Softmax" if op == OP_SOFTMAX else "LogSoftmax",
+                           [resolve(ins[0])], axis=-1)
+        elif op in (OP_TIMES, OP_TRANSPOSE_TIMES):
+            # Times(x, W): y[o] = sum_i x[i] W[i,o]; the reversed-dims
+            # numpy read gives W_np[o,i], so the initializer transposes
+            # back. Times(W, x) (C++ arg order, W (out,in) -> W_np (in,
+            # out)) multiplies directly. TransposeTimes flips once more.
+            if int(attrs.get("outputRank", 1)) != 1:
+                raise NotImplementedError("Times with outputRank != 1")
+            p_right = is_param(ins[1]) and not is_param(ins[0])
+            if p_right:
+                x_uid, w_uid = ins[0], ins[1]
+            else:
+                w_uid, x_uid = ins[0], ins[1]
+            flip = p_right != (op == OP_TRANSPOSE_TIMES)
+            y = g.add_node("MatMul", [resolve(x_uid),
+                                      resolve(w_uid, transpose_param=flip)])
+        elif op == OP_CONVOLUTION:
+            w_uid, x_uid = ins[0], ins[1]
+            strides = list(reversed(attrs.get("strides", [1, 1])))
+            auto = attrs.get("autoPadding", [True])
+            kern = np.asarray(variables[w_uid].value)  # (Cout,Cin,kH,kW)
+            kw = dict(strides=[int(s) for s in strides[-2:]] or [1, 1],
+                      kernel_shape=[int(k) for k in kern.shape[2:]])
+            if any(bool(a) for a in auto):
+                kw["auto_pad"] = "SAME_UPPER"
+            y = g.add_node("Conv", [resolve(x_uid), resolve(w_uid)], **kw)
+        elif op == OP_POOLING:
+            window = list(reversed(attrs.get("poolingWindowShape", [])))
+            strides = list(reversed(attrs.get("strides", window)))
+            auto = attrs.get("autoPadding", [False])
+            kw = dict(kernel_shape=[int(k) for k in window],
+                      strides=[int(s) for s in strides] or None)
+            if kw["strides"] is None:
+                kw.pop("strides")
+            if any(bool(a) for a in auto):
+                kw["auto_pad"] = "SAME_UPPER"
+            pool = "MaxPool" if int(attrs.get("poolingType", 0)) == 0 \
+                else "AveragePool"
+            y = g.add_node(pool, [resolve(ins[0])], **kw)
+        elif op == OP_BATCH_NORM:
+            # CNTK input order: (x, scale, bias, runMean, runVar[, count])
+            y = g.add_node(
+                "BatchNormalization",
+                [resolve(ins[0]), resolve(ins[1]), resolve(ins[2]),
+                 resolve(ins[3]), resolve(ins[4])],
+                epsilon=float(attrs.get("epsilon", 1e-5)))
+        elif op == OP_RESHAPE:
+            new_shape = [int(s) for s in attrs.get("newShape", [])]
+            tgt = [0] + [(-1 if s in (_INFERRED, -1) else s)
+                         for s in reversed(new_shape)]
+            shp = g.add_initializer(
+                g.fresh("reshape_target"), np.asarray(tgt, np.int64))
+            y = g.add_node("Reshape", [resolve(ins[0]), shp])
+        elif op == OP_SPLICE:
+            y = g.add_node("Concat", [resolve(i) for i in ins],
+                           axis=np_axis(attrs.get("axis", 0)))
+        elif op == OP_SLICE:
+            ax = np_axis(attrs.get("axis", 0))
+            end = int(attrs.get("endIndex", 0))
+            # CNTK convention: endIndex 0 means "through the end of the
+            # axis" (negative ends count from the end, like ONNX)
+            if end == 0:
+                end = np.iinfo(np.int64).max
+            starts = g.add_initializer(g.fresh("sl_s"), np.asarray(
+                [int(attrs.get("beginIndex", 0))], np.int64))
+            ends = g.add_initializer(g.fresh("sl_e"), np.asarray(
+                [end], np.int64))
+            axes = g.add_initializer(g.fresh("sl_a"), np.asarray(
+                [ax], np.int64))
+            y = g.add_node("Slice", [resolve(ins[0]), starts, ends, axes])
+        elif op == OP_TRANSPOSE_AXES:
+            a1 = np_axis(attrs.get("axis1", 0))
+            a2 = np_axis(attrs.get("axis2", 1))
+            rank = 1 + len(variables[ins[0]].shape) \
+                if ins[0] in variables else None
+            if rank is None:
+                raise NotImplementedError(
+                    "TransposeAxes on intermediate tensors needs shape "
+                    "propagation; re-export via ONNX for this graph")
+            perm = list(range(rank))
+            perm[a1 % rank], perm[a2 % rank] = perm[a2 % rank], perm[a1 % rank]
+            y = g.add_node("Transpose", [resolve(ins[0])], perm=perm)
+        elif op == OP_REDUCE_ELEMENTS:
+            red = {"Sum": "ReduceSum", "Mean": "ReduceMean",
+                   "Max": "ReduceMax", "Min": "ReduceMin"}.get(
+                str(attrs.get("reductionOpName", "Sum")))
+            if red is None:
+                raise NotImplementedError(
+                    f"ReduceElements op "
+                    f"{attrs.get('reductionOpName')!r}")
+            axes = g.add_initializer(g.fresh("red_axes"), np.asarray(
+                [np_axis(attrs.get("axis", 0))], np.int64))
+            y = g.add_node(
+                red, [resolve(ins[0]), axes],
+                keepdims=int(bool(attrs.get("reductionKeepDimensions",
+                                            True))))
+        elif op == OP_CLIP:
+            y = g.add_node("Clip", [resolve(ins[0]), resolve(ins[1]),
+                                    resolve(ins[2])])
+        elif op in (OP_DROPOUT, OP_NO_OP, OP_STOP_GRADIENT):
+            y = g.add_node("Identity", [resolve(ins[0])])
+        elif op == OP_COMBINE:
+            for j, i_uid in enumerate(ins):
+                names[(f"{uid}_Output_{j}", False)] = resolve(i_uid)
+            last_output = names[(f"{uid}_Output_0", False)]
+            continue
+        elif op in (OP_PAST_VALUE, OP_FUTURE_VALUE):
+            raise NotImplementedError(
+                "recurrent CNTK graphs (PastValue/FutureValue) are not "
+                "supported by the direct reader; export the model to "
+                "ONNX with the cntk package and load that file")
+        else:
+            raise NotImplementedError(
+                f"CNTK primitive op code {op} ({fd.get('name') or uid}) "
+                f"is outside the supported feedforward surface; export "
+                f"to ONNX with the cntk package for full coverage")
+        names[(out_name, False)] = y
+        last_output = y
+
+    out_uid = f"{root}_Output_0" if root else None
+    out_name = names.get((out_uid, False), last_output)
+    if out_name is None:
+        raise ValueError("model has no computable output")
+    g.add_output(out_name, np.float32, None)
+    return g.to_bytes(producer="synapseml_tpu.dl.cntk_format")
+
+
+def looks_like_cntk_v2(payload: bytes) -> bool:
+    """Sniff: decodes as a Dictionary whose type says composite. The
+    FULL payload is decoded — a truncated parse of a length-delimited
+    format fails on any real-size model (round-3 review finding)."""
+    try:
+        top = load_model_dictionary(payload)
+        return top.get("type") == "CompositeFunction"
+    except Exception:  # noqa: BLE001 - any parse failure means "not cntk"
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Authoring half (the publishing/export story + test vectors)
+# ---------------------------------------------------------------------------
+
+class CntkModelBuilder:
+    """Compose a CNTK v2 ``.model`` byte blob (the serialization
+    conventions the reader consumes: uid-wired primitive functions,
+    ``_Output_k`` naming, reversed-dim NDShapes, column-major payloads).
+    Used by the round-trip tests and available as an export target."""
+
+    def __init__(self, name: str = "model"):
+        self.name = name
+        self._vars: List[Dict[str, Any]] = []
+        self._funcs: List[Dict[str, Any]] = []
+        self._n = 0
+
+    def _uid(self, tag: str) -> str:
+        self._n += 1
+        return f"{tag}{self._n}"
+
+    def add_input(self, sample_shape_np: Tuple[int, ...],
+                  name: str = "features") -> str:
+        uid = self._uid("Input")
+        self._vars.append({
+            "version": 1, "uid": uid, "kind": VAR_INPUT,
+            "data_type": 1, "is_sparse": False, "name": name,
+            "needs_gradient": False,
+            "shape": [int(s) for s in reversed(sample_shape_np)],
+        })
+        return uid
+
+    def add_parameter(self, arr_np: np.ndarray, name: str = "") -> str:
+        """``arr_np`` in numpy layout; stored reversed/column-major."""
+        uid = self._uid("Parameter")
+        self._vars.append({
+            "version": 1, "uid": uid, "kind": VAR_PARAMETER,
+            "data_type": 1, "is_sparse": False,
+            "name": name or uid, "needs_gradient": True,
+            "shape": [int(s) for s in reversed(arr_np.shape)],
+            "value": np.asarray(arr_np, np.float32),
+        })
+        return uid
+
+    def add_op(self, op: int, inputs: List[str],
+               attributes: Optional[Dict[str, Any]] = None,
+               name: str = "") -> str:
+        uid = self._uid("Func")
+        self._funcs.append({
+            "version": 1, "uid": uid, "op": int(op),
+            "inputs": list(inputs),
+            "attributes": dict(attributes or {}), "name": name,
+        })
+        return f"{uid}_Output_0"
+
+    def to_bytes(self, root_output: str) -> bytes:
+        root = root_output.rsplit("_Output_", 1)[0]
+        top = {
+            "version": 1,
+            "type": "CompositeFunction",
+            "root": root,
+            "uid": self._uid("Composite"),
+            "name": self.name,
+            "inputs": self._vars,
+            "primitive_functions": self._funcs,
+        }
+        return proto.encode(py_to_dict(top))

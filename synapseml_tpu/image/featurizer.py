@@ -44,6 +44,8 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     mean = Param("per-channel normalization mean (0-1 scale)",
                  default=(0.485, 0.456, 0.406))
     std = Param("per-channel normalization std", default=(0.229, 0.224, 0.225))
+    channels = Param("backbone input channels (3, or 1 for grayscale "
+                     "nets like the bundled digits-cnn)", default=3)
     compute_dtype = Param("float32|bfloat16", default="float32")
     mini_batch_size = Param("max rows per device batch", default=64)
 
@@ -67,7 +69,7 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         cache = self.__dict__.get("_feat_cache")
         key = (self.cut_output_layers, self.compute_dtype,
                self.mini_batch_size, tuple(self.mean), tuple(self.std),
-               hash(self.model_payload))
+               self.channels, hash(self.model_payload))
         if cache is not None and cache[0] == key:
             return cache[1]
         graph: ImportedGraph = import_model(self.model_payload)
@@ -81,8 +83,21 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                     else v)
                 for k, v in params.items()
             }
-        mean = jnp.asarray(self.mean, jnp.float32).reshape(1, -1, 1, 1)
-        std = jnp.asarray(self.std, jnp.float32).reshape(1, -1, 1, 1)
+        c = int(self.channels)
+
+        def per_channel(vals, what):
+            if len(vals) >= c:
+                return list(vals[:c])
+            if len(vals) == 1:  # scalar stat tiles across channels
+                return list(vals) * c
+            raise ValueError(
+                f"{what} has {len(vals)} entries but channels={c}; "
+                f"provide one value per channel (or a single scalar)")
+
+        mean = jnp.asarray(per_channel(self.mean, "mean"),
+                           jnp.float32).reshape(1, -1, 1, 1)
+        std = jnp.asarray(per_channel(self.std, "std"),
+                          jnp.float32).reshape(1, -1, 1, 1)
 
         def fn(p, imgs_nchw):
             x = (imgs_nchw.astype(jnp.float32) / 255.0 - mean) / std
@@ -108,8 +123,11 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         arr = np.asarray(v, dtype=np.float32)
         if arr.ndim == 2:
             arr = arr[..., None]
-        if arr.shape[-1] == 1:
+        c = int(self.channels)
+        if arr.shape[-1] == 1 and c == 3:
             arr = np.repeat(arr, 3, axis=-1)
+        elif arr.shape[-1] == 3 and c == 1:
+            arr = arr.mean(axis=-1, keepdims=True)  # luma for gray nets
         size = self.image_size
         if arr.shape[0] != size or arr.shape[1] != size:
             arr = np.asarray(ops.resize(jnp.asarray(arr), height=size,

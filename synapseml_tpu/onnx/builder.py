@@ -66,7 +66,14 @@ class GraphBuilder:
         return name
 
     def add_output(self, name: str, dtype, shape) -> str:
-        self._outputs.append(_value_info(name, dtype, shape))
+        """``shape=None`` emits an untyped ValueInfo (legal ONNX: shape
+        inference fills it; converters without shape propagation use it)."""
+        if shape is None:
+            vi = Msg("ValueInfoProto")
+            vi.name = name
+            self._outputs.append(vi)
+        else:
+            self._outputs.append(_value_info(name, dtype, shape))
         return name
 
     def add_initializer(self, name: str, array: np.ndarray) -> str:

@@ -74,7 +74,7 @@ def test_cntk_model_onnx_path_and_port_selection():
 
 def test_cntk_native_model_rejected_with_recipe():
     fake_cntk = "BCNTK".encode("utf-16-le") + b"\x00" * 64
-    with pytest.raises(ValueError, match="Export it to ONNX"):
+    with pytest.raises(ValueError, match="ONNX export with the CNTK python package"):
         CNTKModel(model_bytes=fake_cntk)
 
 
@@ -148,7 +148,7 @@ def test_cntk_payload_param_path_also_rejected():
     fake = "BCNTK".encode("utf-16-le") + b"\x00" * 64
     m = CNTKModel()
     m.set(model_payload=fake)  # the generated-wrapper path
-    with pytest.raises(ValueError, match="Export it to ONNX"):
+    with pytest.raises(ValueError, match="ONNX export with the CNTK python package"):
         _ = m.graph
 
 
@@ -168,5 +168,5 @@ def test_payload_swap_refreshes_graph():
     assert out4.shape == (3, 4)
     # native payload swapped in via set() is rejected at next use
     m.set(model_payload="BCNTK".encode("utf-16-le") + b"\x00" * 64)
-    with pytest.raises(ValueError, match="Export it to ONNX"):
+    with pytest.raises(ValueError, match="ONNX export with the CNTK python package"):
         _ = m.graph

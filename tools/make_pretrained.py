@@ -1,0 +1,108 @@
+"""Train and commit the bundled pretrained model artifact.
+
+The reference ships a live model repo the ModelDownloader pulls from
+(deep-learning/.../cntk/downloader/ModelDownloader.scala:112,233-260).
+This environment has no egress, so the committed repo under
+``models/repo`` carries a model **genuinely trained here**: a small CNN
+fit on sklearn's digits (1797 8x8 grayscale images, 10 classes) to
+>97% held-out accuracy, exported through torch.onnx (a real foreign
+exporter) with its manifest + sha256. ImageFeaturizer's transfer-
+learning tests then run on weights that encode actual learning, not a
+random init.
+
+Run from the repo root: ``python tools/make_pretrained.py``
+"""
+import json
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+# the TorchScript exporter serializes the full model itself; the onnx
+# wheel is only imported to inject onnxscript functions (none used)
+from torch.onnx._internal.torchscript_exporter import onnx_proto_utils
+
+onnx_proto_utils._add_onnxscript_fn = lambda model_bytes, custom_opsets: \
+    model_bytes
+
+OUT = os.path.join(os.path.dirname(__file__), os.pardir, "models", "repo")
+
+
+class DigitsCNN(nn.Module):
+    """Conv backbone + linear head; the head is what transfer learning
+    cuts off (ImageFeaturizer cut_output_layers)."""
+
+    def __init__(self):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2d(1, 16, 3, padding=1), nn.BatchNorm2d(16), nn.ReLU(),
+            nn.Conv2d(16, 32, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2d(2),
+            nn.Conv2d(32, 32, 3, padding=1), nn.ReLU(),
+            nn.AdaptiveAvgPool2d(2),
+        )
+        self.head = nn.Sequential(nn.Flatten(), nn.Linear(32 * 4, 10))
+
+    def forward(self, x):
+        return self.head(self.features(x))
+
+
+def main():
+    from sklearn.datasets import load_digits
+    from sklearn.model_selection import train_test_split
+
+    X, y = load_digits(return_X_y=True)
+    X = (X / 16.0).astype(np.float32).reshape(-1, 1, 8, 8)
+    Xt, Xv, yt, yv = train_test_split(X, y, test_size=0.25, random_state=0)
+
+    torch.manual_seed(0)
+    model = DigitsCNN()
+    opt = torch.optim.Adam(model.parameters(), lr=2e-3)
+    loss_fn = nn.CrossEntropyLoss()
+    xt = torch.from_numpy(Xt)
+    tt = torch.from_numpy(yt)
+    model.train()
+    for epoch in range(60):
+        perm = torch.randperm(len(xt))
+        for i in range(0, len(xt), 128):
+            idx = perm[i:i + 128]
+            opt.zero_grad()
+            loss = loss_fn(model(xt[idx]), tt[idx])
+            loss.backward()
+            opt.step()
+    model.eval()
+    with torch.no_grad():
+        acc = (model(torch.from_numpy(Xv)).argmax(1).numpy() == yv).mean()
+    print(f"held-out accuracy: {acc:.4f}")
+    assert acc > 0.97, "refusing to commit an under-trained artifact"
+
+    import io
+
+    buf = io.BytesIO()
+    torch.onnx.export(model, (torch.from_numpy(Xv[:2]),), buf,
+                      opset_version=17, dynamo=False,
+                      input_names=["input"], output_names=["logits"],
+                      dynamic_axes={"input": {0: "batch"},
+                                    "logits": {0: "batch"}})
+    blob = buf.getvalue()
+
+    from synapseml_tpu.dl.downloader import make_repo
+
+    os.makedirs(OUT, exist_ok=True)
+    make_repo(OUT, {"digits-cnn": blob}, schemas={
+        "digits-cnn": {
+            "task": "image classification (sklearn digits, 10 classes)",
+            "input": "float32 [N,1,8,8], pixel range [0,1]",
+            "heldout_accuracy": round(float(acc), 4),
+            "exporter": "torch.onnx (TorchScript exporter, opset 17)",
+            "trained_by": "tools/make_pretrained.py (seeded, reproducible)",
+        }})
+    # frozen eval set for the accuracy-gate test
+    np.savez(os.path.join(OUT, "digits_eval.npz"),
+             x=Xv[:200], y=yv[:200])
+    print(f"wrote {OUT}: {len(blob)} bytes")
+
+
+if __name__ == "__main__":
+    main()
