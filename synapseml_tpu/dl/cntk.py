@@ -43,12 +43,12 @@ def _coerce_payload(payload: bytes) -> bytes:
     unsupported graphs) raises with the export recipe."""
     if _looks_like_onnx(payload):
         return payload
-    from synapseml_tpu.dl.cntk_format import (cntk_to_onnx,
-                                              looks_like_cntk_v2)
+    from synapseml_tpu.dl.cntk_format import cntk_to_onnx, sniff_cntk_v2
 
-    if looks_like_cntk_v2(payload):
+    parsed = sniff_cntk_v2(payload)  # one decode, reused for conversion
+    if parsed is not None:
         try:
-            return cntk_to_onnx(payload)
+            return cntk_to_onnx(payload, parsed=parsed)
         except (NotImplementedError, KeyError, ValueError, TypeError) as e:
             # the class contract is "raises ValueError with the export
             # recipe" — malformed composites must not leak bare KeyErrors

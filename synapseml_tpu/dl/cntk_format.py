@@ -263,9 +263,12 @@ class _Var:
 VAR_INPUT, VAR_OUTPUT, VAR_PARAMETER, VAR_CONSTANT, VAR_PLACEHOLDER = range(5)
 
 
-def cntk_to_onnx(payload: bytes) -> bytes:
-    """Parse ``.model`` bytes and re-emit the graph as ONNX bytes."""
-    top = load_model_dictionary(payload)
+def cntk_to_onnx(payload: bytes,
+                 parsed: Optional[Dict[str, Any]] = None) -> bytes:
+    """Parse ``.model`` bytes and re-emit the graph as ONNX bytes.
+    ``parsed`` skips the (pure-Python, weight-heavy) protobuf decode when
+    the caller already holds the Dictionary from the sniff."""
+    top = parsed if parsed is not None else load_model_dictionary(payload)
     if top.get("type") != "CompositeFunction":
         raise ValueError(
             f"not a CNTK v2 CompositeFunction dictionary "
@@ -297,8 +300,6 @@ def cntk_to_onnx(payload: bytes) -> bytes:
                     "Times with a non-parameter weight operand needs a "
                     "runtime transpose; export to ONNX with the cntk "
                     "package for this graph")
-            if (uid, False) in names:
-                return names[(uid, False)]
             nm = g.add_input(var.name or uid, np.float32,
                              ["N"] + list(reversed(var.shape)))
         else:
@@ -403,12 +404,14 @@ def cntk_to_onnx(payload: bytes) -> bytes:
         elif op == OP_TRANSPOSE_AXES:
             a1 = np_axis(attrs.get("axis1", 0))
             a2 = np_axis(attrs.get("axis2", 1))
-            rank = 1 + len(variables[ins[0]].shape) \
-                if ins[0] in variables else None
-            if rank is None:
+            var = variables.get(ins[0])
+            if var is None:
                 raise NotImplementedError(
                     "TransposeAxes on intermediate tensors needs shape "
                     "propagation; re-export via ONNX for this graph")
+            # only data INPUTS carry the implicit leading batch dim;
+            # parameters/constants are emitted at their own rank
+            rank = len(var.shape) + (1 if var.kind == VAR_INPUT else 0)
             perm = list(range(rank))
             perm[a1 % rank], perm[a2 % rank] = perm[a2 % rank], perm[a1 % rank]
             y = g.add_node("Transpose", [resolve(ins[0])], perm=perm)
@@ -457,15 +460,19 @@ def cntk_to_onnx(payload: bytes) -> bytes:
     return g.to_bytes(producer="synapseml_tpu.dl.cntk_format")
 
 
-def looks_like_cntk_v2(payload: bytes) -> bool:
-    """Sniff: decodes as a Dictionary whose type says composite. The
-    FULL payload is decoded — a truncated parse of a length-delimited
-    format fails on any real-size model (round-3 review finding)."""
+def sniff_cntk_v2(payload: bytes) -> Optional[Dict[str, Any]]:
+    """Decode-and-sniff: the parsed Dictionary when the bytes are a v2
+    CompositeFunction, else None. Returning the dict lets the caller
+    skip a second full (pure-Python, weight-heavy) decode."""
     try:
         top = load_model_dictionary(payload)
-        return top.get("type") == "CompositeFunction"
     except Exception:  # noqa: BLE001 - any parse failure means "not cntk"
-        return False
+        return None
+    return top if top.get("type") == "CompositeFunction" else None
+
+
+def looks_like_cntk_v2(payload: bytes) -> bool:
+    return sniff_cntk_v2(payload) is not None
 
 
 # ---------------------------------------------------------------------------

@@ -86,10 +86,13 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         c = int(self.channels)
 
         def per_channel(vals, what):
-            if len(vals) >= c:
-                return list(vals[:c])
+            if len(vals) == c:
+                return list(vals)
             if len(vals) == 1:  # scalar stat tiles across channels
                 return list(vals) * c
+            # no silent truncation: the default ImageNet 3-tuple applied
+            # to a channels=1 net would quietly normalize with the RED
+            # channel's stats — make the user choose
             raise ValueError(
                 f"{what} has {len(vals)} entries but channels={c}; "
                 f"provide one value per channel (or a single scalar)")

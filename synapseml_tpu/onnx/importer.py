@@ -182,6 +182,84 @@ def _mish(ctx, x):
     return x * jnp.tanh(jax.nn.softplus(x))
 
 
+@op("Celu")
+def _celu(ctx, x):
+    a = ctx.attr("alpha", 1.0)
+    return jnp.maximum(x, 0) + jnp.minimum(0.0, a * (jnp.exp(x / a) - 1))
+
+
+@op("ThresholdedRelu")
+def _thresholded_relu(ctx, x):
+    a = ctx.attr("alpha", 1.0)
+    return jnp.where(x > a, x, 0.0)
+
+
+@op("Shrink")
+def _shrink(ctx, x):
+    lambd = ctx.attr("lambd", 0.5)
+    bias = ctx.attr("bias", 0.0)
+    return jnp.where(x < -lambd, x + bias,
+                     jnp.where(x > lambd, x - bias, 0.0))
+
+
+@op("BitShift")
+def _bit_shift(ctx, x, y):
+    xp = np if _all_host((x, y)) else jnp
+    if ctx.attr("direction", "LEFT") == "LEFT":
+        return xp.left_shift(x, y)
+    return xp.right_shift(x, y)
+
+
+@op("QuantizeLinear")
+def _quantize_linear(ctx, x, scale, zero_point=None):
+    """fp -> int8/uint8 affine quantization (the mobile-export idiom).
+    axis applies when scale is 1-D per-channel."""
+    dtype = np.uint8 if zero_point is None else np.asarray(zero_point).dtype
+    zp = 0 if zero_point is None else zero_point
+    axis = ctx.attr("axis", 1)
+    if np.ndim(scale) == 1 and np.ndim(x) > 1:
+        shape = [1] * np.ndim(x)
+        shape[axis] = -1
+        scale = jnp.reshape(jnp.asarray(scale), shape)
+        zp = jnp.reshape(jnp.asarray(zp), shape) if np.ndim(zp) == 1 else zp
+    info = np.iinfo(np.dtype(dtype))
+    q = jnp.round(jnp.asarray(x) / scale) + jnp.asarray(zp, jnp.float32)
+    return jnp.clip(q, info.min, info.max).astype(dtype)
+
+
+@op("DequantizeLinear")
+def _dequantize_linear(ctx, x, scale, zero_point=None):
+    axis = ctx.attr("axis", 1)
+    zp = 0 if zero_point is None else zero_point
+    if np.ndim(scale) == 1 and np.ndim(x) > 1:
+        shape = [1] * np.ndim(x)
+        shape[axis] = -1
+        scale = jnp.reshape(jnp.asarray(scale), shape)
+        zp = jnp.reshape(jnp.asarray(zp), shape) if np.ndim(zp) == 1 else zp
+    return (jnp.asarray(x).astype(jnp.float32)
+            - jnp.asarray(zp).astype(jnp.float32)) * scale
+
+
+@op("MatMulInteger")
+def _matmul_integer(ctx, a, b, a_zp=None, b_zp=None):
+    """int8 matmul accumulating in int32 (quantized-model compute).
+    On TPU the MXU takes the int operands directly."""
+    a32 = jnp.asarray(a).astype(jnp.int32)
+    b32 = jnp.asarray(b).astype(jnp.int32)
+    if a_zp is not None:
+        zp = jnp.asarray(a_zp).astype(jnp.int32)
+        if zp.ndim == 1:  # per-ROW zero point broadcasts down the rows
+            zp = zp[:, None]
+        a32 = a32 - zp
+    if b_zp is not None:  # 1-D b_zp is per-column: trailing-axis broadcast
+        b32 = b32 - jnp.asarray(b_zp).astype(jnp.int32)
+    return jax.lax.dot_general(
+        a32, b32,
+        (((a32.ndim - 1,), (b32.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.int32) if a32.ndim == 2 and b32.ndim == 2 \
+        else jnp.matmul(a32, b32)
+
+
 @op("Clip")
 def _clip(ctx, x, lo=None, hi=None):
     if ctx.opset < 11:
@@ -194,17 +272,19 @@ def _clip(ctx, x, lo=None, hi=None):
 
 @op("Min")
 def _min(ctx, *xs):
+    xp = np if _all_host(xs) else jnp  # shape chains clamp via Min/Max
     out = xs[0]
     for x in xs[1:]:
-        out = jnp.minimum(out, x)
+        out = xp.minimum(out, x)
     return out
 
 
 @op("Max")
 def _max(ctx, *xs):
+    xp = np if _all_host(xs) else jnp
     out = xs[0]
     for x in xs[1:]:
-        out = jnp.maximum(out, x)
+        out = xp.maximum(out, x)
     return out
 
 
@@ -240,9 +320,13 @@ def _trilu(ctx, x, k=None):
 
 @op("Mod")
 def _mod(ctx, a, b):
+    # host-preserving: exporters route SHAPE arithmetic through Mod
+    # (torch MultiheadAttention's head-split checks); a device result
+    # here would poison downstream Reshape/Slice static params
+    xp = np if _all_host((a, b)) else jnp
     if ctx.attr("fmod", 0):
-        return jnp.fmod(a, b)
-    return jnp.mod(a, b)
+        return xp.fmod(a, b)
+    return xp.mod(a, b)
 
 
 # ---------------------------------------------------------------------------
@@ -426,6 +510,28 @@ def _gap(ctx, x):
 @op("GlobalMaxPool")
 def _gmp(ctx, x):
     return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@op("LpPool")
+def _lp_pool(ctx, x):
+    rank = x.ndim - 2
+    p = ctx.attr("p", 2)
+    kernel = ctx.attr("kernel_shape")
+    strides = ctx.attr("strides", [1] * rank)
+    pads = _resolve_pads(ctx, x.shape[2:], kernel, strides, [1] * rank,
+                         ctx.attr("ceil_mode", 0))
+    s = lax.reduce_window(
+        jnp.abs(x) ** p, 0.0, lax.add,
+        (1, 1) + tuple(kernel), (1, 1) + tuple(strides),
+        padding=((0, 0), (0, 0)) + tuple(pads))
+    return s ** (1.0 / p)
+
+
+@op("GlobalLpPool")
+def _global_lp_pool(ctx, x):
+    p = ctx.attr("p", 2)
+    axes = tuple(range(2, x.ndim))
+    return jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
 
 
 @op("LRN")
@@ -904,6 +1010,44 @@ def _layer_norm(ctx, x, scale, b=None):
     return y
 
 
+@op("GroupNormalization")
+def _group_norm(ctx, x, scale, b):
+    eps = ctx.attr("epsilon", 1e-5)
+    groups = int(ctx.attr("num_groups"))
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    # opset 18 ships PER-GROUP scale/bias [num_groups]; opset 21 changed
+    # to per-channel [C] — distinguish by length and repeat groups out
+    if scale.shape[0] == groups and groups != c:
+        scale = jnp.repeat(scale, c // groups)
+        b = jnp.repeat(b, c // groups)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return y * scale.reshape(shape) + b.reshape(shape)
+
+
+@op("MeanVarianceNormalization")
+def _mvn(ctx, x):
+    axes = tuple(int(a) for a in ctx.attr("axes", [0, 2, 3]))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + 1e-9)
+
+
+@op("LpNormalization")
+def _lp_normalization(ctx, x):
+    axis = ctx.attr("axis", -1)
+    p = ctx.attr("p", 2)
+    if p == 1:
+        norm = jnp.sum(jnp.abs(x), axis=axis, keepdims=True)
+    else:
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, 1e-12)
+
+
 # ---------------------------------------------------------------------------
 # Shape / structure ops (host-foldable where possible)
 # ---------------------------------------------------------------------------
@@ -920,6 +1064,45 @@ def _shape(ctx, x):
 @op("Size")
 def _size(ctx, x):
     return np.asarray(int(np.prod(np.shape(x))), dtype=np.int64)
+
+
+@op("EyeLike")
+def _eye_like(ctx, x):
+    dt = proto.TENSOR_DTYPES.get(ctx.attr("dtype")) or \
+        (np.asarray(x).dtype if _is_host(x) else x.dtype)
+    k = ctx.attr("k", 0)
+    n, m = np.shape(x)
+    return np.eye(n, m, k=k, dtype=dt)  # shape-static: host constant
+
+
+@op("ReverseSequence")
+def _reverse_sequence(ctx, x, seq_lens):
+    batch_axis = ctx.attr("batch_axis", 1)
+    time_axis = ctx.attr("time_axis", 0)
+    xj = jnp.asarray(x)
+    t = xj.shape[time_axis]
+    idx = jnp.arange(t)
+    lens = jnp.asarray(seq_lens).astype(jnp.int32)
+
+    def rev_one(row_len):
+        # positions < row_len reverse; the rest stay in place
+        return jnp.where(idx < row_len, row_len - 1 - idx, idx)
+
+    gather_idx = jax.vmap(rev_one)(lens)          # [B, T]
+    moved = jnp.moveaxis(xj, (batch_axis, time_axis), (0, 1))
+    out = jnp.take_along_axis(
+        moved, gather_idx.reshape(gather_idx.shape + (1,) * (moved.ndim - 2)),
+        axis=1)
+    return jnp.moveaxis(out, (0, 1), (batch_axis, time_axis))
+
+
+@op("NonZero")
+def _non_zero(ctx, x):
+    if not _is_host(x):
+        raise NotImplementedError(
+            "NonZero on traced tensors has a data-dependent output shape, "
+            "which XLA cannot express; restructure with Where/masking")
+    return np.stack(np.nonzero(np.asarray(x))).astype(np.int64)
 
 
 @op("Reshape")

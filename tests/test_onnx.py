@@ -960,3 +960,158 @@ def test_resnet18_full_network_parity_vs_torch():
     with torch.no_grad():
         want = twin(torch.from_numpy(x)).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# round-3 op additions (quantization, norm/pool families, misc)
+# ---------------------------------------------------------------------------
+
+def _unary_graph(op_name, shape, **attrs):
+    g = GraphBuilder(opset=21)
+    x = g.add_input("x", np.float32, list(shape))
+    y = g.add_node(op_name, [x], **attrs)
+    g.add_output(y, np.float32, list(shape))
+    return import_model(g.to_bytes())
+
+
+def test_celu_thresholded_relu_shrink_match_torch():
+    x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32) * 2
+    xt = torch.from_numpy(x)
+    cases = [
+        ("Celu", dict(alpha=0.7), torch.celu(xt, alpha=0.7)),
+        ("ThresholdedRelu", dict(alpha=0.9),
+         torch.nn.functional.threshold(xt, 0.9, 0.0)),
+        ("Shrink", dict(lambd=0.5, bias=0.1),
+         torch.nn.functional.softshrink(xt, 0.5) if False else None),
+    ]
+    for op_name, attrs, want in cases:
+        g = _unary_graph(op_name, (4, 6), **attrs)
+        got = np.asarray(g.apply(g.params, x)[0])
+        if want is None:  # Shrink: manual reference (torch softshrink
+            # uses bias=lambd; ONNX separates them)
+            want_np = np.where(x < -0.5, x + 0.1,
+                               np.where(x > 0.5, x - 0.1, 0.0))
+            np.testing.assert_allclose(got, want_np, atol=1e-6)
+        else:
+            np.testing.assert_allclose(got, want.numpy(), atol=1e-5)
+
+
+def test_group_normalization_matches_torch():
+    n, c, h, w = 2, 8, 5, 5
+    x = np.random.default_rng(1).normal(size=(n, c, h, w)).astype(np.float32)
+    gn = nn.GroupNorm(4, c).eval()
+    with torch.no_grad():
+        gn.weight.normal_(1, 0.2)
+        gn.bias.normal_(0, 0.2)
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", c, h, w])
+    s = g.add_initializer("s", gn.weight.detach().numpy())
+    b = g.add_initializer("b", gn.bias.detach().numpy())
+    y = g.add_node("GroupNormalization", [xn, s, b], num_groups=4,
+                   epsilon=float(gn.eps))
+    g.add_output(y, np.float32, ["N", c, h, w])
+    gi = import_model(g.to_bytes())
+    with torch.no_grad():
+        want = gn(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.asarray(gi.apply(gi.params, x)[0]),
+                               want, atol=1e-5, rtol=1e-5)
+
+
+def test_quantize_dequantize_roundtrip_and_matmul_integer():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", 8])
+    scale = g.add_initializer("sc", np.float32(0.05))
+    zp = g.add_initializer("zp", np.uint8(128))
+    q = g.add_node("QuantizeLinear", [xn, scale, zp])
+    d = g.add_node("DequantizeLinear", [q, scale, zp])
+    g.add_output(d, np.float32, ["N", 8])
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    # torch reference for the same affine quantization
+    tq = torch.quantize_per_tensor(torch.from_numpy(x), 0.05, 128,
+                                   torch.quint8).dequantize().numpy()
+    np.testing.assert_allclose(got, tq, atol=1e-6)
+
+    # int8 matmul accumulates in int32
+    a = rng.integers(0, 255, (3, 4)).astype(np.uint8)
+    b = rng.integers(-127, 127, (4, 5)).astype(np.int8)
+    g2 = GraphBuilder(opset=21)
+    an = g2.add_input("a", np.uint8, ["N", 4])
+    bn_ = g2.add_initializer("b", b)
+    azp = g2.add_initializer("azp", np.uint8(10))
+    y = g2.add_node("MatMulInteger", [an, bn_, azp])
+    g2.add_output(y, np.int32, ["N", 5])
+    gi2 = import_model(g2.to_bytes())
+    want = (a.astype(np.int32) - 10) @ b.astype(np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(gi2.apply(gi2.params, a)[0]), want)
+
+
+def test_lp_pool_and_normalization_families():
+    x = np.random.default_rng(3).normal(size=(2, 3, 8, 8)).astype(
+        np.float32)
+    xt = torch.from_numpy(x)
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", 3, 8, 8])
+    y = g.add_node("LpPool", [xn], kernel_shape=[2, 2], strides=[2, 2], p=2)
+    g.add_output(y, np.float32, ["N", 3, 4, 4])
+    gi = import_model(g.to_bytes())
+    want = nn.LPPool2d(2, 2, stride=2)(xt).numpy()
+    np.testing.assert_allclose(np.asarray(gi.apply(gi.params, x)[0]), want,
+                               atol=1e-4, rtol=1e-4)
+
+    g2 = GraphBuilder(opset=21)
+    xn2 = g2.add_input("x", np.float32, ["N", 3, 8, 8])
+    y2 = g2.add_node("GlobalLpPool", [xn2], p=2)
+    g2.add_output(y2, np.float32, ["N", 3, 1, 1])
+    gi2 = import_model(g2.to_bytes())
+    want2 = np.sqrt((x ** 2).sum(axis=(2, 3), keepdims=True))
+    np.testing.assert_allclose(np.asarray(gi2.apply(gi2.params, x)[0]),
+                               want2, atol=1e-4, rtol=1e-4)
+
+    v = np.random.default_rng(4).normal(size=(5, 7)).astype(np.float32)
+    g3 = _unary_graph("LpNormalization", (5, 7), axis=-1, p=2)
+    np.testing.assert_allclose(
+        np.asarray(g3.apply(g3.params, v)[0]),
+        torch.nn.functional.normalize(torch.from_numpy(v), dim=-1).numpy(),
+        atol=1e-6)
+
+
+def test_eyelike_reverse_sequence_nonzero():
+    # EyeLike: host-static identity
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, [4, 5])
+    y = g.add_node("EyeLike", [xn], k=1)
+    g.add_output(y, np.float32, [4, 5])
+    gi = import_model(g.to_bytes())
+    np.testing.assert_array_equal(
+        np.asarray(gi.apply(gi.params, np.zeros((4, 5), np.float32))[0]),
+        np.eye(4, 5, k=1, dtype=np.float32))
+
+    # ReverseSequence matches manual per-row reversal
+    x = np.arange(24, dtype=np.float32).reshape(4, 3, 2)  # [T=4, B=3, 2]
+    lens = np.array([4, 2, 1], np.int64)
+    g2 = GraphBuilder(opset=21)
+    xn2 = g2.add_input("x", np.float32, [4, 3, 2])
+    ln = g2.add_initializer("lens", lens)
+    y2 = g2.add_node("ReverseSequence", [xn2, ln], batch_axis=1,
+                     time_axis=0)
+    g2.add_output(y2, np.float32, [4, 3, 2])
+    gi2 = import_model(g2.to_bytes())
+    want = x.copy()
+    for b, l in enumerate(lens):
+        want[:l, b] = x[:l, b][::-1]
+    np.testing.assert_array_equal(
+        np.asarray(gi2.apply(gi2.params, x)[0]), want)
+
+    # NonZero on a static initializer folds on host
+    from synapseml_tpu.onnx.importer import _non_zero
+
+    class _Ctx:
+        def attr(self, *a):
+            return a[1] if len(a) > 1 else None
+    m = np.array([[1, 0], [0, 2]], np.float32)
+    np.testing.assert_array_equal(_non_zero(_Ctx(), m),
+                                  np.stack(np.nonzero(m)))

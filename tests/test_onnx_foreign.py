@@ -79,7 +79,27 @@ def test_fixture_bytes_are_foreign():
     producer tag, not this repo's builder."""
     from synapseml_tpu.onnx import proto
 
-    for name in ("torch_cnn", "torch_gru"):
+    for name in ("torch_cnn", "torch_gru", "torch_transformer"):
         with open(os.path.join(FIXTURES, f"{name}.onnx"), "rb") as fh:
             m = proto.decode("ModelProto", fh.read())
         assert m.producer_name == "pytorch", m.producer_name
+
+
+def test_torch_transformer_fixture_parity():
+    """nn.TransformerEncoder export: MultiheadAttention's packed-QKV
+    slicing rides the densest shape-arithmetic idiom torch emits
+    (Shape -> Mod/Gather/Concat -> Reshape/Slice). Parity against the
+    frozen torch outputs, eagerly and under jit."""
+    import jax
+
+    g, io = _load("torch_transformer")
+    got = np.asarray(g.apply(g.params, io["input"])[0])
+    np.testing.assert_allclose(got, io["expected"], atol=1e-5, rtol=1e-5)
+    fn = jax.jit(lambda x: g.apply(g.params, x)[0])
+    np.testing.assert_allclose(np.asarray(fn(io["input"])),
+                               io["expected"], atol=1e-5, rtol=1e-5)
+    # batch axis is dynamic (seq is constant-folded by the exporter)
+    x2 = np.concatenate([io["input"]] * 2, axis=0)
+    got2 = np.asarray(g.apply(g.params, x2)[0])
+    np.testing.assert_allclose(got2[:3], io["expected"], atol=1e-5,
+                               rtol=1e-5)

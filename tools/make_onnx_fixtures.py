@@ -106,6 +106,19 @@ def main():
     export(gru, (ids,), "torch_gru",
            {"input": {0: "batch", 1: "seq"}, "output": {0: "batch"}})
 
+    # the transformer-era export: nn.MultiheadAttention lowers to the
+    # densest shape-arithmetic idiom the exporter emits (Shape chains
+    # through Mod/Gather/Concat feeding Reshape/Slice). The TorchScript
+    # exporter constant-folds the SEQUENCE length inside attention, so
+    # only the batch axis is dynamic in practice.
+    txf = nn.TransformerEncoder(
+        nn.TransformerEncoderLayer(d_model=32, nhead=4, dim_feedforward=64,
+                                   batch_first=True, dropout=0.1),
+        num_layers=2).eval()
+    xt = torch.randn(3, 10, 32)
+    export(txf, (xt,), "torch_transformer",
+           {"input": {0: "batch"}, "output": {0: "batch"}})
+
 
 if __name__ == "__main__":
     main()
