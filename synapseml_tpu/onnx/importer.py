@@ -210,18 +210,24 @@ def _bit_shift(ctx, x, y):
     return xp.right_shift(x, y)
 
 
+def _per_axis_qparams(x, axis, scale, zp):
+    """Reshape 1-D per-channel quantization scale/zero-point for
+    broadcast along ``axis`` of ``x`` (shared by Quantize/Dequantize)."""
+    if np.ndim(scale) == 1 and np.ndim(x) > 1:
+        shape = [1] * np.ndim(x)
+        shape[axis % np.ndim(x)] = -1
+        scale = jnp.reshape(jnp.asarray(scale), shape)
+        zp = jnp.reshape(jnp.asarray(zp), shape) if np.ndim(zp) == 1 else zp
+    return scale, zp
+
+
 @op("QuantizeLinear")
 def _quantize_linear(ctx, x, scale, zero_point=None):
     """fp -> int8/uint8 affine quantization (the mobile-export idiom).
     axis applies when scale is 1-D per-channel."""
     dtype = np.uint8 if zero_point is None else np.asarray(zero_point).dtype
     zp = 0 if zero_point is None else zero_point
-    axis = ctx.attr("axis", 1)
-    if np.ndim(scale) == 1 and np.ndim(x) > 1:
-        shape = [1] * np.ndim(x)
-        shape[axis] = -1
-        scale = jnp.reshape(jnp.asarray(scale), shape)
-        zp = jnp.reshape(jnp.asarray(zp), shape) if np.ndim(zp) == 1 else zp
+    scale, zp = _per_axis_qparams(x, ctx.attr("axis", 1), scale, zp)
     info = np.iinfo(np.dtype(dtype))
     q = jnp.round(jnp.asarray(x) / scale) + jnp.asarray(zp, jnp.float32)
     return jnp.clip(q, info.min, info.max).astype(dtype)
@@ -229,13 +235,8 @@ def _quantize_linear(ctx, x, scale, zero_point=None):
 
 @op("DequantizeLinear")
 def _dequantize_linear(ctx, x, scale, zero_point=None):
-    axis = ctx.attr("axis", 1)
     zp = 0 if zero_point is None else zero_point
-    if np.ndim(scale) == 1 and np.ndim(x) > 1:
-        shape = [1] * np.ndim(x)
-        shape[axis] = -1
-        scale = jnp.reshape(jnp.asarray(scale), shape)
-        zp = jnp.reshape(jnp.asarray(zp), shape) if np.ndim(zp) == 1 else zp
+    scale, zp = _per_axis_qparams(x, ctx.attr("axis", 1), scale, zp)
     return (jnp.asarray(x).astype(jnp.float32)
             - jnp.asarray(zp).astype(jnp.float32)) * scale
 
@@ -518,11 +519,13 @@ def _lp_pool(ctx, x):
     p = ctx.attr("p", 2)
     kernel = ctx.attr("kernel_shape")
     strides = ctx.attr("strides", [1] * rank)
-    pads = _resolve_pads(ctx, x.shape[2:], kernel, strides, [1] * rank,
+    dilations = ctx.attr("dilations", [1] * rank)  # opset 18+
+    pads = _resolve_pads(ctx, x.shape[2:], kernel, strides, dilations,
                          ctx.attr("ceil_mode", 0))
     s = lax.reduce_window(
         jnp.abs(x) ** p, 0.0, lax.add,
         (1, 1) + tuple(kernel), (1, 1) + tuple(strides),
+        window_dilation=(1, 1) + tuple(dilations),
         padding=((0, 0), (0, 0)) + tuple(pads))
     return s ** (1.0 / p)
 
