@@ -1,0 +1,68 @@
+"""Tensor-parallel inference for imported ONNX graphs.
+
+The reference's ONNX path is single-GPU-per-partition (one ORT session
+per executor, deep-learning/.../onnx/ONNXModel.scala:497-508); model
+parallelism is out of its reach. Here an imported graph's ``apply`` is a
+pure jax function, so sharding the PARAMETERS over a mesh axis is enough:
+GSPMD propagates the layouts through every matmul and inserts the
+all-reduces — no per-op rules, no graph surgery, any exporter's file.
+
+Heuristic (the Megatron column layout): 2-D float weights shard their
+LAST dim over ``axis``; 1-D biases that feed the same activations
+replicate (GSPMD re-shards them as needed). Weights whose dims don't
+divide the axis size stay replicated. For a transformer this puts each
+rank's slice of every projection in HBM — the model no longer needs to
+fit on one chip.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from synapseml_tpu.parallel.mesh import replicated
+
+
+def tp_shard_params(params: Dict[str, np.ndarray], mesh: Mesh,
+                    axis: str = "tp") -> Dict[str, Any]:
+    """Place a params dict on ``mesh`` with 2-D weights column-sharded
+    over ``axis`` (replicating anything that does not divide)."""
+    n = mesh.shape[axis]
+    rep = replicated(mesh)
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if (v.ndim == 2 and np.issubdtype(v.dtype, np.floating)
+                and v.shape[-1] % n == 0 and v.shape[-1] >= n):
+            out[k] = jax.device_put(
+                v, NamedSharding(mesh, P(None, axis)))
+        else:
+            out[k] = jax.device_put(v, rep)
+    return out
+
+
+def tp_jit(graph, mesh: Mesh, axis: str = "tp"):
+    """(sharded_params, jitted_fn): run ``graph`` tensor-parallel.
+
+    ``jitted_fn(params, *inputs)`` replicates inputs, lets GSPMD carry
+    the column-sharded weights through the graph, and returns replicated
+    outputs — numerically identical to single-device ``graph.apply``.
+    """
+    params = tp_shard_params(graph.params, mesh, axis)
+    rep = replicated(mesh)
+
+    def fn(p, *inputs):
+        return graph.apply(p, *inputs)
+
+    jitted = jax.jit(fn, out_shardings=rep)
+
+    def run(p, *inputs):
+        # device-resident inputs (a previous stage's output) re-shard
+        # without the D2H round trip np.asarray would force
+        placed = [jax.device_put(
+            x if isinstance(x, jax.Array) else np.asarray(x), rep)
+            for x in inputs]
+        return jitted(p, *placed)
+
+    return params, run

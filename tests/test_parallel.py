@@ -326,3 +326,41 @@ def test_ring_all_reduce_equals_psum():
     b = np.asarray(jax.jit(shard_map(
         lambda xl: ring_all_reduce(xl, "r", chunk_axis=1), **args))(x))
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_imported_onnx_graph_runs_tensor_parallel():
+    """An imported ONNX transformer runs tensor-parallel over the tp
+    axis: 2-D weights column-sharded, GSPMD propagates the layouts and
+    inserts collectives, outputs match single-device exactly. (The
+    reference's ORT sessions are single-device-per-partition —
+    ONNXModel.scala:497; model parallelism is TPU-native new ground.)"""
+    import jax
+    from jax.sharding import Mesh
+
+    from synapseml_tpu.onnx import import_model, zoo
+    from synapseml_tpu.parallel.onnx_tp import tp_jit
+
+    g = import_model(zoo.transformer_encoder(
+        100, 64, 4, 128, 2, seq_len=16, seed=3))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    params, run = tp_jit(g, mesh)
+    # every 2-D weight actually sharded over tp (64 and 128 divide by 4)
+    sharded = [k for k, v in params.items()
+               if getattr(v.sharding, "spec", None) is not None
+               and v.sharding.spec == jax.sharding.PartitionSpec(None, "tp")]
+    assert len(sharded) >= 12, sharded  # q/k/v/o + ffn per layer + embeddings
+    ids = np.random.default_rng(0).integers(0, 100, (3, 16))
+    want = np.asarray(g.apply(g.params, ids)[0])
+    got = np.asarray(run(params, ids)[0])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    # the foreign torch-exported CNN fixture rides the same machinery
+    import os
+
+    fx = os.path.join(os.path.dirname(__file__), "fixtures",
+                      "torch_cnn.onnx")
+    g2 = import_model(fx)
+    params2, run2 = tp_jit(g2, mesh)
+    io = np.load(fx.replace(".onnx", "_io.npz"))
+    got2 = np.asarray(run2(params2, io["input"])[0])
+    np.testing.assert_allclose(got2, io["expected"], atol=1e-5, rtol=1e-5)
