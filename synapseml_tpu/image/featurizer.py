@@ -130,7 +130,11 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         if arr.shape[-1] == 1 and c == 3:
             arr = np.repeat(arr, 3, axis=-1)
         elif arr.shape[-1] == 3 and c == 1:
-            arr = arr.mean(axis=-1, keepdims=True)  # luma for gray nets
+            # BT.601 luma on host (RGB weights matching ops.color_format
+            # COLOR_RGB2GRAY) — a device round trip per image would
+            # serialize tiny transfers through the tunnel
+            arr = arr[..., :3] @ np.array([0.299, 0.587, 0.114], np.float32)
+            arr = arr[..., None]
         size = self.image_size
         if arr.shape[0] != size or arr.shape[1] != size:
             arr = np.asarray(ops.resize(jnp.asarray(arr), height=size,

@@ -834,6 +834,13 @@ def _loop_via_while(body, env, max_trip, cond, v_initial, n_scan: int):
         # loop would silently run ZERO iterations — treat as unbounded
         max_trip = None
     trips = None if max_trip is None else jnp.asarray(max_trip).reshape(())
+    if trips is not None and trips.dtype == jnp.int32:
+        # with x64 disabled, a *traced* INT64_MAX trip count was already
+        # canonicalized to int32 upstream, overflowing to -1; the spec
+        # forbids negative trip counts, so negative means "unbounded".
+        # (Under x64 the dtype stays int64 and no reinterpretation is
+        # needed — INT64_MAX is unbounded in practice.)
+        trips = jnp.where(trips < 0, jnp.iinfo(jnp.int32).max, trips)
     cond0 = jnp.asarray(True) if cond is None \
         else jnp.asarray(cond).reshape(()).astype(bool)
     carried0 = tuple(jnp.asarray(v) for v in v_initial)

@@ -77,6 +77,11 @@ def pack_parallel_matmuls(graph: Msg, opset: int = 13,
         x, w = node.input
         if x in inits or w not in inits:
             continue
+        # an initializer that also appears in graph.input is an
+        # overridable feed — packing would bake it in and delete the
+        # override point for other consumers of the rewritten graph
+        if any(vi.name == w for vi in graph.input):
+            continue
         t = inits[w]
         dims = [int(d) for d in (t.dims or [])]
         if len(dims) != 2 or uses.get(w, 0) != 1:

@@ -123,6 +123,30 @@ class BatchedExecutor:
             self._jits[n_args] = got
         return got
 
+    def _stage_device_array(self, a: jax.Array, target_rows: int):
+        """Pad/coerce/place an already-device-resident array entirely on
+        device. Returns ``(array, fresh)`` — ``fresh`` is True when a new
+        buffer was definitely created (safe to donate)."""
+        fresh = False
+        if len(a) != target_rows:
+            pad = [(0, target_rows - len(a))] + [(0, 0)] * (a.ndim - 1)
+            a = jnp.pad(a, pad)
+            fresh = True
+        if (self._compute_dtype is not None
+                and jnp.issubdtype(a.dtype, jnp.floating)
+                and a.dtype != jnp.dtype(self._compute_dtype)):
+            a = a.astype(self._compute_dtype)
+            fresh = True
+        if self._device is not None:
+            try:
+                misplaced = a.device != self._device
+            except Exception:  # multi-device/sharded array
+                misplaced = True
+            if misplaced:
+                a = jax.device_put(a, self._device)
+                fresh = True
+        return a, fresh
+
     def _bucket(self, n: int) -> int:
         if self._static_batch is not None:
             return self._static_batch
@@ -147,13 +171,15 @@ class BatchedExecutor:
 
         tb = self._transfer_batches
         if tb == "auto":
-            # group buckets up to ~32MB per explicit copy
+            # group buckets up to ~32MB per explicit copy (shape/dtype
+            # only — np.asarray on a device array would force a D2H copy)
             row_bytes = 0
             for a in host_arrays:
-                a0 = np.asarray(a)
+                a0 = a if hasattr(a, "shape") and hasattr(a, "dtype") \
+                    else np.asarray(a)
                 itemsize = 2 if (self._compute_dtype is not None
-                                 and np.issubdtype(a0.dtype, np.floating)) \
-                    else min(a0.itemsize, 4)
+                                 and jnp.issubdtype(a0.dtype, jnp.floating)) \
+                    else min(a0.dtype.itemsize, 4)
                 row_bytes += int(np.prod(a0.shape[1:], dtype=np.int64)) \
                     * itemsize
             tb = max(1, (32 << 20) // max(1, bucket * row_bytes))
@@ -175,15 +201,21 @@ class BatchedExecutor:
             rows = -(-sc_n // bucket) * bucket
             devs = []
             for a in host_arrays:
-                a = coerce_host_array(np.asarray(a[sc_start:sc_stop]),
-                                      self._compute_dtype)
+                sl = a[sc_start:sc_stop]
+                if isinstance(sl, jax.Array):
+                    # already device-resident: pad/coerce on device, no
+                    # host round trip
+                    devs.append(self._stage_device_array(sl, rows)[0])
+                    continue
+                sl = coerce_host_array(np.asarray(sl), self._compute_dtype)
                 if rows > sc_n:
-                    a = np.pad(a, [(0, rows - sc_n)] + [(0, 0)] * (a.ndim - 1))
-                devs.append(jax.device_put(a, self._device))
+                    sl = np.pad(sl,
+                                [(0, rows - sc_n)] + [(0, 0)] * (sl.ndim - 1))
+                devs.append(jax.device_put(sl, self._device))
             for b in range(0, sc_n, bucket):
                 push(self._dispatch(
                     [d[b:b + bucket] for d in devs],
-                    min(bucket, sc_n - b), bucket))
+                    min(bucket, sc_n - b), bucket, internal=True))
         while pending:
             outs.append(self._fetch(*pending.popleft()))
         if len(outs) == 1:
@@ -192,14 +224,22 @@ class BatchedExecutor:
             np.concatenate([o[i] for o in outs]) for i in range(len(outs[0]))
         )
 
-    def _dispatch(self, arrays, n: int, bucket: int):
+    def _dispatch(self, arrays, n: int, bucket: int, internal: bool = False):
         """Coerce+pad on host (device-resident slices pass through), start
         the H2D copy and the compute; returns device futures without
-        blocking."""
+        blocking. ``internal`` marks super-chunk slices the executor
+        staged itself (safe to donate)."""
         padded = []
         for a in arrays:
             if isinstance(a, jax.Array):
-                padded.append(a)  # super-chunk slice: already on device
+                # super-chunk slices pass through; an *external* device
+                # array is padded/coerced on device so it lines up with
+                # bucket-padded host args
+                a, fresh = self._stage_device_array(a, bucket)
+                if self._donate and not (fresh or internal):
+                    # donation would delete the caller's own buffer
+                    a = jnp.copy(a)
+                padded.append(a)
                 continue
             a = coerce_host_array(np.asarray(a), self._compute_dtype)
             if n < bucket and len(a) < bucket:  # never re-pad a padded tail

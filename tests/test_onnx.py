@@ -644,6 +644,27 @@ def test_loop_int64_max_trip_count_means_unbounded():
     np.testing.assert_allclose(np.asarray(final), [8.0, 8.0])
 
 
+def test_loop_traced_int64_max_trip_count_means_unbounded():
+    """Same as above but M arrives as a *traced* graph input: jit's
+    boundary canonicalization turns INT64_MAX into int32 -1 before the
+    Loop op ever sees it, so the negative-means-unbounded clamp must
+    live inside the lowering too (round-3 advisor finding)."""
+    import jax
+
+    g = GraphBuilder(opset=17)
+    acc0 = g.add_input("acc0", np.float32, [2])
+    g.add_input("limit", np.float32, [])
+    m_in = g.add_input("M", np.int64, [])
+    cond0 = g.add_initializer("cond0", np.array(True))
+    g.add_node("Loop", [m_in, cond0, acc0], outputs=["final"],
+               body=_while_body())
+    g.add_output("final", np.float32, [2])
+    gi = import_model(g.to_bytes())
+    fn = jax.jit(lambda a, lim, m: gi.apply(gi.params, a, lim, m)[0])
+    out = fn(np.ones(2, np.float32), np.float32(16.0), np.int64(2**63 - 1))
+    np.testing.assert_allclose(np.asarray(out), [8.0, 8.0])
+
+
 def test_loop_traced_cond_with_scan_outputs_rejected():
     """Scan outputs under a data-dependent trip count would have a
     data-dependent shape; XLA cannot express that — clear error."""

@@ -38,6 +38,36 @@ def test_batched_executor_padding_and_bucketing():
     np.testing.assert_allclose(out, np.arange(20) * 2.0)
 
 
+def test_batched_executor_device_resident_partial_batch():
+    # an external caller may feed a device array with a partial batch;
+    # it must be padded/coerced like host args, not passed through raw
+    import jax.numpy as jnp
+
+    def fn(x, y):
+        assert x.shape == y.shape  # both bucket-padded
+        return x + y
+
+    ex = BatchedExecutor(fn, min_bucket=8, compute_dtype=jnp.float32)
+    dev = jnp.arange(5, dtype=jnp.bfloat16)
+    host = np.ones(5, dtype=np.float64)
+    out, = ex(dev, host)
+    assert out.shape == (5,)
+    np.testing.assert_allclose(np.asarray(out), np.arange(5) + 1.0)
+
+
+def test_batched_executor_full_bucket_device_array_not_donated():
+    # a full-bucket external device array must survive the call even
+    # with donation on (the executor copies before donating)
+    import jax.numpy as jnp
+
+    ex = BatchedExecutor(lambda x: x * 2.0, min_bucket=8, donate=True)
+    dev = jnp.arange(8, dtype=jnp.float32)
+    out, = ex(dev)
+    np.testing.assert_allclose(out, np.arange(8) * 2.0)
+    # caller's buffer still alive
+    np.testing.assert_allclose(np.asarray(dev), np.arange(8))
+
+
 def test_batched_executor_multi_output():
     def fn(x, y):
         return x + y, x - y
@@ -145,6 +175,24 @@ def test_executor_superchunk_groups_transfers(monkeypatch):
     np.testing.assert_allclose(y, x + 1.0)
     # 32 rows = 8 buckets = 2 super-chunks = 2 H2D copies of 16 rows
     assert puts == [(16,), (16,)], puts
+
+
+def test_executor_superchunk_device_resident_input():
+    """A device-resident input through the super-chunk path stays on
+    device (no host round trip), survives donation, and pads/coerces
+    like host args — including a ragged tail."""
+    import jax.numpy as jnp
+    from synapseml_tpu.runtime import executor as ex_mod
+
+    ex = ex_mod.BatchedExecutor(
+        lambda x: (x.astype(jnp.float32) * 2.0,),
+        min_bucket=4, max_bucket=4, transfer_batches=3, donate=True,
+        compute_dtype=jnp.float32)
+    dev = jnp.arange(22, dtype=jnp.bfloat16)  # ragged: 22 rows, 4-buckets
+    (y,) = ex(dev)
+    np.testing.assert_allclose(np.asarray(y), np.arange(22) * 2.0)
+    # caller's buffer survived donation of the staged slices
+    np.testing.assert_allclose(np.asarray(dev, np.float32), np.arange(22))
 
 
 def test_executor_superchunk_ragged_tail(monkeypatch):
