@@ -55,17 +55,26 @@ def test_batched_executor_device_resident_partial_batch():
     np.testing.assert_allclose(np.asarray(out), np.arange(5) + 1.0)
 
 
-def test_batched_executor_full_bucket_device_array_not_donated():
+def test_batched_executor_full_bucket_device_array_not_donated(monkeypatch):
     # a full-bucket external device array must survive the call even
-    # with donation on (the executor copies before donating)
+    # with donation on (the executor copies before donating). CPU
+    # ignores donation, so observe the defensive copy directly.
     import jax.numpy as jnp
+    from synapseml_tpu.runtime import executor as ex_mod
 
+    copies = []
+    orig_copy = ex_mod.jnp.copy
+    monkeypatch.setattr(
+        ex_mod.jnp, "copy",
+        lambda a, *k, **kw: (copies.append(np.shape(a)),
+                             orig_copy(a, *k, **kw))[1])
     ex = BatchedExecutor(lambda x: x * 2.0, min_bucket=8, donate=True)
     dev = jnp.arange(8, dtype=jnp.float32)
     out, = ex(dev)
     np.testing.assert_allclose(out, np.arange(8) * 2.0)
-    # caller's buffer still alive
+    # caller's buffer still alive, and the guard actually copied it
     np.testing.assert_allclose(np.asarray(dev), np.arange(8))
+    assert copies == [(8,)], copies
 
 
 def test_batched_executor_multi_output():
@@ -177,13 +186,20 @@ def test_executor_superchunk_groups_transfers(monkeypatch):
     assert puts == [(16,), (16,)], puts
 
 
-def test_executor_superchunk_device_resident_input():
+def test_executor_superchunk_device_resident_input(monkeypatch):
     """A device-resident input through the super-chunk path stays on
     device (no host round trip), survives donation, and pads/coerces
-    like host args — including a ragged tail."""
+    like host args — including a ragged tail. Internal staged slices
+    must NOT pay the external-array defensive copy."""
     import jax.numpy as jnp
     from synapseml_tpu.runtime import executor as ex_mod
 
+    copies = []
+    orig_copy = ex_mod.jnp.copy
+    monkeypatch.setattr(
+        ex_mod.jnp, "copy",
+        lambda a, *k, **kw: (copies.append(np.shape(a)),
+                             orig_copy(a, *k, **kw))[1])
     ex = ex_mod.BatchedExecutor(
         lambda x: (x.astype(jnp.float32) * 2.0,),
         min_bucket=4, max_bucket=4, transfer_batches=3, donate=True,
@@ -193,6 +209,7 @@ def test_executor_superchunk_device_resident_input():
     np.testing.assert_allclose(np.asarray(y), np.arange(22) * 2.0)
     # caller's buffer survived donation of the staged slices
     np.testing.assert_allclose(np.asarray(dev, np.float32), np.arange(22))
+    assert copies == [], copies  # internal slices pass through uncopied
 
 
 def test_executor_superchunk_ragged_tail(monkeypatch):
