@@ -1841,15 +1841,20 @@ class ImportedGraph:
                 f"params={len(self.params)}, opset={self.opset})")
 
 
-def import_model(path_or_bytes, optimize: bool = False) -> ImportedGraph:
+def import_model(path_or_bytes, optimize: bool = False,
+                 base_dir: Optional[str] = None) -> ImportedGraph:
     """Parse a ``.onnx`` file/bytes and lower it to an :class:`ImportedGraph`.
 
     ``optimize`` applies proto-level graph rewrites (parallel-MatMul/QKV
     packing — see :mod:`synapseml_tpu.onnx.optimize`) before lowering.
     Off by default: on v5e, XLA schedules the unpacked projections as
     well or better (docs/perf.md measures packing at -8% on BERT-base
-    bs=128); the pass exists for exporters/backends where it wins."""
-    model = proto.load_model(path_or_bytes)
+    bs=128); the pass exists for exporters/backends where it wins.
+
+    Models saved with external data (``save_as_external_data`` — the
+    default for >2GB exports) resolve their sidecar files relative to the
+    model's directory; pass ``base_dir`` when supplying raw bytes."""
+    model = proto.load_model(path_or_bytes, base_dir=base_dir)
     if model.graph is None:
         raise ValueError("ONNX model has no graph")
     opset = 13

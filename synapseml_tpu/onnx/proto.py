@@ -141,6 +141,13 @@ _SCHEMAS: Dict[str, List[F]] = {
         F(10, "double_data", "double", repeated=True),
         F(11, "uint64_data", "int64", repeated=True),
         F(12, "doc_string", "string"),
+        F(13, "external_data", "message", repeated=True,
+          message="StringStringEntryProto"),
+        F(14, "data_location", "int64"),  # 0 DEFAULT, 1 EXTERNAL
+    ],
+    "StringStringEntryProto": [
+        F(1, "key", "string"),
+        F(2, "value", "string"),
     ],
     "ValueInfoProto": [
         F(1, "name", "string"),
@@ -352,6 +359,12 @@ except ImportError:  # pragma: no cover
 
 
 def tensor_to_numpy(t: Msg) -> np.ndarray:
+    if int(t.data_location or 0) == 1:  # EXTERNAL, unresolved
+        raise ValueError(
+            f"tensor {t.name!r} stores its data in an external file "
+            f"({dict((e.key, e.value) for e in t.external_data)}); load the "
+            "model via import_model(path)/load_model(path) so sidecar files "
+            "resolve relative to the model directory, or pass base_dir=")
     dims = tuple(int(d) for d in t.dims)
     dt = int(t.data_type or 0)
     if dt == DTYPE_STRING:
@@ -476,16 +489,131 @@ def make_attr(name: str, value: Any) -> Msg:
 # Model container helpers
 # ---------------------------------------------------------------------------
 
-def load_model(path_or_bytes) -> Msg:
-    """Parse a ``.onnx`` file (or raw bytes) into a ModelProto Msg."""
+def _walk_tensors(graph: Msg):
+    """Yield every TensorProto reachable from ``graph`` (initializers and
+    attribute tensors, recursing through subgraphs)."""
+    for t in graph.initializer:
+        yield t
+    for node in graph.node:
+        for a in node.attribute or []:
+            if a.t is not None:
+                yield a.t
+            for t in a.tensors or []:
+                yield t
+            if a.g is not None:
+                yield from _walk_tensors(a.g)
+            for sg in a.graphs or []:
+                yield from _walk_tensors(sg)
+
+
+def resolve_external_data(model: Msg, base_dir: str) -> int:
+    """Load ``data_location: EXTERNAL`` tensor payloads from their sidecar
+    files into ``raw_data`` in place (the layout ``onnx.save_model(...,
+    save_as_external_data=True)`` and large torch exports produce: per-tensor
+    ``location``/``offset``/``length`` entries naming a file relative to the
+    model directory). Returns the number of tensors resolved. Parity target:
+    the reference hands arbitrary user model files to onnxruntime, which
+    resolves sidecars natively (deep-learning/.../onnx/ONNXModel.scala:173-193).
+    """
+    import os
+
+    base_dir = os.path.abspath(base_dir or ".")
+    handles: Dict[str, Any] = {}
+    resolved = 0
+    try:
+        for t in _walk_tensors(model.graph) if model.graph is not None else ():
+            if int(t.data_location or 0) != 1:
+                continue
+            info = {e.key: e.value for e in t.external_data}
+            loc = info.get("location")
+            if not loc:
+                raise ValueError(
+                    f"external tensor {t.name!r} has no location entry")
+            full = os.path.abspath(os.path.join(base_dir, loc))
+            if not (full == base_dir
+                    or full.startswith(base_dir + os.sep)):
+                raise ValueError(
+                    f"external tensor {t.name!r} location {loc!r} escapes "
+                    f"the model directory {base_dir!r}")
+            fh = handles.get(full)
+            if fh is None:
+                fh = handles[full] = open(full, "rb")
+            offset = int(info.get("offset", 0) or 0)
+            length = info.get("length")
+            fh.seek(offset)
+            data = fh.read(int(length)) if length is not None else fh.read()
+            if length is not None and len(data) != int(length):
+                raise ValueError(
+                    f"external tensor {t.name!r}: wanted {length} bytes at "
+                    f"offset {offset} of {loc!r}, file had {len(data)}")
+            t.raw_data = data
+            t.data_location = 0
+            t.external_data = []
+            resolved += 1
+    finally:
+        for fh in handles.values():
+            fh.close()
+    return resolved
+
+
+def load_model(path_or_bytes, base_dir: Optional[str] = None) -> Msg:
+    """Parse a ``.onnx`` file (or raw bytes) into a ModelProto Msg.
+
+    External-data tensors are resolved against the model's own directory
+    (or ``base_dir`` when raw bytes are given)."""
+    import os
+
     if isinstance(path_or_bytes, (bytes, bytearray)):
         data = bytes(path_or_bytes)
     else:
         with open(path_or_bytes, "rb") as fh:
             data = fh.read()
-    return decode("ModelProto", data)
+        if base_dir is None:
+            base_dir = os.path.dirname(os.path.abspath(path_or_bytes))
+    model = decode("ModelProto", data)
+    if base_dir is not None:
+        resolve_external_data(model, base_dir)
+    return model
 
 
-def save_model(model: Msg, path: str):
-    with open(path, "wb") as fh:
-        fh.write(encode(model))
+def save_model(model: Msg, path: str, external_data_threshold: Optional[int] = None):
+    """Serialize ``model`` to ``path``. With ``external_data_threshold``,
+    initializers of at least that many payload bytes move to one sidecar
+    ``<model>.data`` file (the standard ``save_as_external_data`` layout:
+    location/offset/length entries, 64-byte-aligned offsets)."""
+    import os
+
+    undo = []  # (tensor, raw, external_data, data_location) — the caller's
+    # in-memory model must come back untouched after serialization
+    try:
+        if external_data_threshold is not None and model.graph is not None:
+            loc = os.path.basename(path) + ".data"
+            sidecar = os.path.join(os.path.dirname(os.path.abspath(path)), loc)
+            offset = 0
+            chunks = []
+            for t in _walk_tensors(model.graph):
+                if not t.raw_data or len(t.raw_data) < external_data_threshold:
+                    continue
+                offset = (offset + 63) & ~63  # align like onnx's writer
+                entries = []
+                for k, v in (("location", loc), ("offset", str(offset)),
+                             ("length", str(len(t.raw_data)))):
+                    e = Msg("StringStringEntryProto")
+                    e.key, e.value = k, v
+                    entries.append(e)
+                chunks.append((offset, t.raw_data))
+                offset += len(t.raw_data)
+                undo.append((t, t.raw_data, t.external_data, t.data_location))
+                t.external_data = entries
+                t.data_location = 1
+                t.raw_data = b""
+            if chunks:
+                with open(sidecar, "wb") as fh:
+                    for off, payload in chunks:
+                        fh.seek(off)
+                        fh.write(payload)
+        with open(path, "wb") as fh:
+            fh.write(encode(model))
+    finally:
+        for t, raw, ext, dl in undo:
+            t.raw_data, t.external_data, t.data_location = raw, ext, dl

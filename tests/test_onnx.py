@@ -969,6 +969,80 @@ def test_resnet50_full_network_parity_vs_torch():
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
 
 
+def test_external_data_save_load_roundtrip(tmp_path):
+    """save_model(external_data_threshold=...) moves big initializers to
+    a ``.data`` sidecar; import_model(path) resolves them transparently
+    and the resolved graph matches the in-memory original."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, ["N", 8])
+    w = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    b = np.array([1.0, -1.0, 0.5, 0.0], np.float32)
+    y = g.add_node("MatMul", [x, g.add_initializer("w", w)])
+    y = g.add_node("Add", [y, g.add_initializer("b", b)])
+    g.add_output(y, np.float32, ["N", 4])
+    blob = g.to_bytes()
+
+    model = proto.load_model(blob)
+    path = tmp_path / "m.onnx"
+    proto.save_model(model, str(path), external_data_threshold=16)
+    assert (tmp_path / "m.onnx.data").exists()
+    # w (128 B) externalized, b (16 B) too; model file carries no payload
+    reparsed = proto.load_model(path.read_bytes())
+    assert all(not t.raw_data for t in reparsed.graph.initializer)
+
+    gi = import_model(str(path))
+    xv = np.random.default_rng(1).normal(size=(3, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(gi.apply(gi.params, xv)[0]), xv @ w + b, rtol=1e-5)
+
+    # the caller's in-memory model is untouched by externalizing save
+    assert all(t.raw_data and int(t.data_location or 0) == 0
+               for t in model.graph.initializer)
+
+
+def test_external_data_location_escape_rejected(tmp_path):
+    """A location that walks out of the model directory must be refused
+    (a hostile model file must not read arbitrary host paths)."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, ["N", 2])
+    y = g.add_node("Mul", [x, g.add_initializer(
+        "s", np.array([2.0, 3.0], np.float32))])
+    g.add_output(y, np.float32, ["N", 2])
+    model = proto.load_model(g.to_bytes())
+    t = model.graph.initializer[0]
+    e = proto.Msg("StringStringEntryProto")
+    e.key, e.value = "location", "../outside.bin"
+    t.external_data = [e]
+    t.data_location = 1
+    t.raw_data = b""
+    mdir = tmp_path / "mdl"
+    mdir.mkdir()
+    (tmp_path / "outside.bin").write_bytes(
+        np.array([9.0, 9.0], np.float32).tobytes())
+    path = mdir / "m.onnx"
+    proto.save_model(model, str(path))
+    with pytest.raises(ValueError, match="escapes"):
+        import_model(str(path))
+
+
+def test_resnet50_full_network_parity_vs_torch_224():
+    """The bench flagship at BENCH RESOLUTION (224x224, bs=1): certifies
+    the spatial-shape-dependent paths the 32px case can't — the 7x7/s2
+    stem pad arithmetic, every stride-2 transition at full extent, and
+    the final pool reduction window (round-3 review item)."""
+    blob = zoo.resnet50(image_size=224, seed=5)
+    g = import_model(blob)
+    x = np.random.default_rng(3).normal(
+        size=(1, 3, 224, 224)).astype(np.float32)
+    got = np.asarray(g.apply(g.params, x)[0])
+    twin = _TorchResNet([3, 4, 6, 3], bottleneck=True, num_classes=1000,
+                        width=64, seed=5).eval()
+    with torch.no_grad():
+        want = twin(torch.from_numpy(x)).numpy()
+    assert got.shape == (1, 1000)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
 def test_resnet18_full_network_parity_vs_torch():
     """Basic-block variant through the same twin machinery."""
     blob = zoo.resnet18(image_size=32, seed=9)
