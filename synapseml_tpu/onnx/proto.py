@@ -517,7 +517,8 @@ def resolve_external_data(model: Msg, base_dir: str) -> int:
     """
     import os
 
-    base_dir = os.path.abspath(base_dir or ".")
+    # realpath: a symlink inside the model dir must not smuggle reads out
+    base_dir = os.path.realpath(base_dir or ".")
     handles: Dict[str, Any] = {}
     resolved = 0
     try:
@@ -529,7 +530,7 @@ def resolve_external_data(model: Msg, base_dir: str) -> int:
             if not loc:
                 raise ValueError(
                     f"external tensor {t.name!r} has no location entry")
-            full = os.path.abspath(os.path.join(base_dir, loc))
+            full = os.path.realpath(os.path.join(base_dir, loc))
             if not (full == base_dir
                     or full.startswith(base_dir + os.sep)):
                 raise ValueError(

@@ -969,6 +969,51 @@ def test_resnet50_full_network_parity_vs_torch():
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
 
 
+def test_uint8_feed_device_cast_and_norm():
+    """uint8 pixel feeds ride the wire as 1 byte/px: the cast to float
+    (and optional (x-mean)*scale) happens ON DEVICE. Numerics must match
+    host-side float conversion exactly for the plain cast, and the
+    executor must see the uint8 array unwidened."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("data", np.float32, ["N", 4])
+    y = g.add_node("Mul", [x, g.add_initializer(
+        "w", np.array([1.0, 2.0, 3.0, 4.0], np.float32))])
+    g.add_output(y, np.float32, ["N", 4])
+    blob = g.to_bytes()
+    pix = np.arange(32, dtype=np.uint8).reshape(8, 4)
+
+    # default-on: integer feed to a float input casts device-side
+    m = ONNXModel(model_bytes=blob)
+    out = m._executor()(pix)[0]
+    np.testing.assert_allclose(
+        out, pix.astype(np.float32) * [1, 2, 3, 4], rtol=1e-6)
+
+    # with input_norm: fused (x - mean) * scale on device
+    m2 = ONNXModel(model_bytes=blob,
+                   input_norm={"data": {"mean": 16.0, "scale": 0.25}})
+    out2 = m2._executor()(pix)[0]
+    want = (pix.astype(np.float32) - 16.0) * 0.25 * [1, 2, 3, 4]
+    np.testing.assert_allclose(out2, want, rtol=1e-6)
+
+    # the wire carried uint8: host coercion must not widen the feed
+    from synapseml_tpu.runtime.executor import coerce_host_array
+    assert coerce_host_array(pix, compute_dtype="bfloat16").dtype == np.uint8
+
+
+def test_uint8_feed_integer_graph_input_not_cast():
+    """Integer feeds to graph inputs that WANT integers (token ids) must
+    stay integer — the device cast only fires for float-wanting inputs."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("ids", np.int32, ["N"])
+    y = g.add_node("Add", [x, g.add_initializer(
+        "one", np.array(1, np.int32))])
+    g.add_output(y, np.int32, ["N"])
+    m = ONNXModel(model_bytes=g.to_bytes(), compute_dtype="bfloat16")
+    out = m._executor()(np.arange(6, dtype=np.int32))[0]
+    assert out.dtype.kind == "i"
+    np.testing.assert_array_equal(out, np.arange(6) + 1)
+
+
 def test_external_data_save_load_roundtrip(tmp_path):
     """save_model(external_data_threshold=...) moves big initializers to
     a ``.data`` sidecar; import_model(path) resolves them transparently
@@ -1000,6 +1045,29 @@ def test_external_data_save_load_roundtrip(tmp_path):
                for t in model.graph.initializer)
 
 
+def test_external_data_via_onnxmodel_path(tmp_path):
+    """ONNXModel(model_path=...) must resolve sidecars against the model
+    directory and produce a self-contained payload (survives save/load
+    away from the sidecar)."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, ["N", 4])
+    w = np.random.default_rng(2).normal(size=(4, 3)).astype(np.float32)
+    y = g.add_node("MatMul", [x, g.add_initializer("w", w)])
+    g.add_output(y, np.float32, ["N", 3])
+    model = proto.load_model(g.to_bytes())
+    path = tmp_path / "m.onnx"
+    proto.save_model(model, str(path), external_data_threshold=1)
+
+    m = ONNXModel(model_path=str(path))
+    xv = np.random.default_rng(3).normal(size=(2, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        m._executor()(xv)[0], xv @ w, rtol=1e-5)
+    # payload is self-contained: no unresolved external references
+    reparsed = proto.load_model(m.model_payload)
+    assert all(int(t.data_location or 0) == 0
+               for t in reparsed.graph.initializer)
+
+
 def test_external_data_location_escape_rejected(tmp_path):
     """A location that walks out of the model directory must be refused
     (a hostile model file must not read arbitrary host paths)."""
@@ -1023,6 +1091,47 @@ def test_external_data_location_escape_rejected(tmp_path):
     proto.save_model(model, str(path))
     with pytest.raises(ValueError, match="escapes"):
         import_model(str(path))
+
+
+def test_external_data_symlink_escape_rejected(tmp_path):
+    """A symlink inside the model dir must not smuggle reads outside it
+    (realpath, not abspath, guards the boundary)."""
+    import os
+
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, ["N", 2])
+    y = g.add_node("Mul", [x, g.add_initializer(
+        "s", np.array([2.0, 3.0], np.float32))])
+    g.add_output(y, np.float32, ["N", 2])
+    model = proto.load_model(g.to_bytes())
+    t = model.graph.initializer[0]
+    e = proto.Msg("StringStringEntryProto")
+    e.key, e.value = "location", "link/secret.bin"
+    t.external_data = [e]
+    t.data_location = 1
+    t.raw_data = b""
+    mdir = tmp_path / "mdl"
+    mdir.mkdir()
+    outside = tmp_path / "outside"
+    outside.mkdir()
+    (outside / "secret.bin").write_bytes(
+        np.array([9.0, 9.0], np.float32).tobytes())
+    os.symlink(outside, mdir / "link")
+    path = mdir / "m.onnx"
+    proto.save_model(model, str(path))
+    with pytest.raises(ValueError, match="escapes"):
+        import_model(str(path))
+
+
+def test_input_norm_unknown_name_rejected():
+    g = GraphBuilder(opset=17)
+    x = g.add_input("data", np.float32, ["N", 2])
+    y = g.add_node("Relu", [x])
+    g.add_output(y, np.float32, ["N", 2])
+    m = ONNXModel(model_bytes=g.to_bytes(),
+                  input_norm={"Data": {"mean": 1.0}})  # typo'd case
+    with pytest.raises(KeyError, match="Data"):
+        m._executor()
 
 
 def test_resnet50_full_network_parity_vs_torch_224():
