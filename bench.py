@@ -293,27 +293,73 @@ def bench_serving_scored_latency():
             replies[i] = make_reply({"pred": int(scored["pred"][i])})
         return table.with_column("reply", replies)
 
-    cs = ContinuousServer("bench_scored", pipeline, max_batch=16).start()
+    # prewarm every pow2 bucket the varying micro-batch sizes can hit,
+    # so no jit compile lands inside a timed request
+    for n in (1, 9, 17):
+        model.transform(Table({"input": np.zeros((n, 16), np.float32)}))
+
+    body = json.dumps({"features": [0.1] * 16}).encode()
+
+    def post(url):
+        req = urllib.request.Request(
+            url, body, {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+
+    # -- sequential leg (linger 0: a lone client must not pay a wait)
+    cs = ContinuousServer("bench_scored", pipeline, max_batch=32).start()
     try:
-        body = json.dumps({"features": [0.1] * 16}).encode()
-
-        def post():
-            req = urllib.request.Request(
-                cs.url, body, {"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                resp.read()
-
         for _ in range(30):  # warm: compile + bucket
-            post()
+            post(cs.url)
         lat = []
         for _ in range(150):
             t0 = _time.perf_counter()
-            post()
+            post(cs.url)
             lat.append(_time.perf_counter() - t0)
         lat.sort()
-        return lat[len(lat) // 2] * 1e3
+        seq_p50_ms = lat[len(lat) // 2] * 1e3
     finally:
         cs.stop()
+
+    # -- concurrent leg: ~32 clients + 8 ms linger so get_batch actually
+    # coalesces and ONE device round trip amortizes over the micro-batch
+    # (the reference's serving pitch is concurrent throughput,
+    # ref: HTTPSourceV2.scala:475-696). Sequential p50 measures the full
+    # per-request tunnel RT; this measures the architecture.
+    cs2 = ContinuousServer("bench_scored_conc", pipeline, max_batch=32,
+                           batch_linger=0.008).start()
+    try:
+        n_clients, per_client = 32, 12
+        for _ in range(5):
+            post(cs2.url)  # warm this server's path too
+        clats: list = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(n_clients)
+
+        def client():
+            mine = []
+            barrier.wait()
+            for _ in range(per_client):
+                t0 = _time.perf_counter()
+                post(cs2.url)
+                mine.append(_time.perf_counter() - t0)
+            with lock:
+                clats.extend(mine)
+
+        threads = [threading.Thread(target=client) for _ in range(n_clients)]
+        t_all = _time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = _time.perf_counter() - t_all
+        clats.sort()
+        conc_p50_ms = clats[len(clats) // 2] * 1e3
+        conc_p99_ms = clats[int(len(clats) * 0.99)] * 1e3
+        conc_rps = len(clats) / wall
+        return seq_p50_ms, conc_p50_ms, conc_p99_ms, conc_rps
+    finally:
+        cs2.stop()
 
 
 def _with_retries(fn, attempts=3):
@@ -338,7 +384,8 @@ def main():
     hist_winner, hist_rows_s, hist_detail = _with_retries(
         bench_gbdt_histogram)
     serving_p50_ms = _with_retries(bench_serving_latency)
-    serving_scored_p50_ms = _with_retries(bench_serving_scored_latency)
+    (serving_scored_p50_ms, scored_conc_p50_ms, scored_conc_p99_ms,
+     scored_conc_rps) = _with_retries(bench_serving_scored_latency)
     gpu_img_baseline = 1000.0
     gpu_rows_baseline = 1.0e6
     gpu_tree_rows_baseline = 1.0e6
@@ -388,6 +435,18 @@ def main():
             "unit": "ms",
             "vs_baseline": round(
                 serving_baseline_ms / serving_scored_p50_ms, 3),
+        }, {
+            # ~32 concurrent clients: micro-batch coalescing amortizes
+            # the device round trip across the batch — the number that
+            # reflects the serving architecture rather than the tunnel
+            "metric": "serving_scored_concurrent_p50_ms",
+            "value": round(scored_conc_p50_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(
+                serving_baseline_ms / max(scored_conc_p50_ms, 1e-9), 3),
+            "detail": {"clients": 32,
+                       "p99_ms": round(scored_conc_p99_ms, 3),
+                       "requests_per_sec": round(scored_conc_rps, 1)},
         }, {
             # GBDT hot-op shootout: which histogram formulation ships
             # (pallas VMEM kernel vs XLA one-hot einsum), measured on

@@ -35,18 +35,29 @@ _REGISTRY_LOCK = threading.Lock()
 
 
 def _drain_queue(q: "queue.Queue", max_rows: int,
-                 timeout: float) -> List["CachedRequest"]:
-    """Deadline-bounded drain: block for the first item only, then take
-    whatever else is immediately available."""
+                 timeout: float, linger: float = 0.0) -> List["CachedRequest"]:
+    """Deadline-bounded drain: block up to ``timeout`` for the first item,
+    then keep collecting for up to ``linger`` seconds more (micro-batch
+    coalescing — with concurrent clients a few ms of linger turns N serial
+    device round trips into one batched trip; 0 preserves the
+    take-what's-there behavior for latency-first pipelines)."""
     out: List[CachedRequest] = []
     deadline = time.monotonic() + timeout
     while len(out) < max_rows:
-        remaining = deadline - time.monotonic()
+        if not out:
+            remaining = deadline - time.monotonic()
+        elif linger > 0:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+        else:
+            remaining = 0.0
         try:
-            out.append(q.get(
-                timeout=max(0.0, remaining) if not out else 0.0))
+            out.append(q.get(timeout=max(0.0, remaining)))
         except queue.Empty:
             break
+        if len(out) == 1:
+            deadline = time.monotonic() + linger
     return out
 
 
@@ -167,8 +178,12 @@ class WorkerServer:
             do_POST = _enqueue
             do_PUT = _enqueue
 
-        self._httpd = http.server.ThreadingHTTPServer(
-            (host, self.port), Handler)
+        class Server(http.server.ThreadingHTTPServer):
+            # default backlog (5) resets connections under concurrent
+            # client bursts — the whole point of micro-batch serving
+            request_queue_size = 128
+
+        self._httpd = Server((host, self.port), Handler)
         self.port = self._httpd.server_address[1]
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
@@ -181,10 +196,10 @@ class WorkerServer:
         return f"http://{self.host}:{self.port}{self.api_path}"
 
     # -- source side ----------------------------------------------------
-    def get_batch(self, max_rows: int = 64, timeout: float = 0.1
-                  ) -> List[CachedRequest]:
+    def get_batch(self, max_rows: int = 64, timeout: float = 0.1,
+                  linger: float = 0.0) -> List[CachedRequest]:
         """Drain up to ``max_rows`` requests as one epoch's batch."""
-        out = _drain_queue(self.requests, max_rows, timeout)
+        out = _drain_queue(self.requests, max_rows, timeout, linger)
         self._record_epoch(out)
         return out
 
@@ -463,12 +478,18 @@ class ContinuousServer:
     def __init__(self, name: str, pipeline_fn: Callable[[Table], Table],
                  host: str = "127.0.0.1", port: Optional[int] = None,
                  max_batch: int = 64, parse_json: bool = True,
-                 reply_col: str = "reply", reply_timeout: float = 60.0):
+                 reply_col: str = "reply", reply_timeout: float = 60.0,
+                 batch_linger: float = 0.0):
+        """``batch_linger``: seconds to keep collecting after the first
+        request of a batch arrives. A few ms turns concurrent clients'
+        requests into ONE scored micro-batch (one device round trip
+        amortized over the batch) instead of serial singletons."""
         self.server = HTTPSourceStateHolder.get_or_create_server(
             name, host, port, reply_timeout=reply_timeout)
         self.name = name
         self.pipeline_fn = pipeline_fn
         self.max_batch = max_batch
+        self.batch_linger = batch_linger
         self.parse_json = parse_json
         self.reply_col = reply_col
         self._stop = threading.Event()
@@ -481,7 +502,8 @@ class ContinuousServer:
 
     def _loop(self):
         while not self._stop.is_set():
-            batch = self.server.get_batch(self.max_batch, timeout=0.05)
+            batch = self.server.get_batch(self.max_batch, timeout=0.05,
+                                          linger=self.batch_linger)
             if not batch:
                 continue
             epoch = batch[0].epoch

@@ -54,11 +54,22 @@ class ONNXModel(Transformer):
                  model_bytes: Optional[bytes] = None, **kw):
         super().__init__(**kw)
         if model_path is not None:
-            # load via the path so external-data sidecars resolve against
-            # the model's directory, then re-encode: model_payload is
-            # always self-contained (and survives transformer save/load)
+            # keep the user's bytes verbatim (re-encoding through the
+            # mini-schema would drop fields it doesn't model, e.g.
+            # metadata_props) — re-encode ONLY when external-data
+            # sidecars had to be inlined to make the payload
+            # self-contained for transformer save/load
+            import os
+
             from synapseml_tpu.onnx import proto as _proto
-            model_bytes = _proto.encode(_proto.load_model(model_path))
+            with open(model_path, "rb") as fh:
+                raw = fh.read()
+            model = _proto.decode("ModelProto", raw)
+            if model.graph is not None and _proto.resolve_external_data(
+                    model, os.path.dirname(os.path.abspath(model_path))) > 0:
+                model_bytes = _proto.encode(model)
+            else:
+                model_bytes = raw
         if model_bytes is not None:
             self.set(model_payload=bytes(model_bytes))
         self._graph_cache: Optional[ImportedGraph] = None
@@ -127,6 +138,18 @@ class ONNXModel(Transformer):
             raise KeyError(
                 f"input_norm names {sorted(unknown)} are not graph inputs "
                 f"(inputs: {list(g.input_names)})")
+        for name, spec in norm.items():
+            bad = set(spec) - {"mean", "scale"}
+            if bad:
+                raise KeyError(
+                    f"input_norm[{name!r}]: unknown keys {sorted(bad)} "
+                    "(supported: 'mean', 'scale')")
+            want, _ = g.input_info.get(name, (None, None))
+            if want is not None and np.issubdtype(np.dtype(want), np.integer):
+                raise TypeError(
+                    f"input_norm[{name!r}]: graph declares an integer "
+                    f"input ({np.dtype(want).name}) — normalizing token "
+                    "ids is almost certainly a misconfiguration")
         # canonical, content-based key: dict order must not recompile,
         # array-valued mean/scale must not collide via summarized repr
         norm_key = tuple(

@@ -1067,6 +1067,13 @@ def test_external_data_via_onnxmodel_path(tmp_path):
     assert all(int(t.data_location or 0) == 0
                for t in reparsed.graph.initializer)
 
+    # a model with NO external data keeps its file bytes verbatim (no
+    # lossy re-encode through the mini-schema)
+    plain = tmp_path / "plain.onnx"
+    plain.write_bytes(g.to_bytes())
+    m_plain = ONNXModel(model_path=str(plain))
+    assert bytes(m_plain.model_payload) == g.to_bytes()
+
 
 def test_external_data_location_escape_rejected(tmp_path):
     """A location that walks out of the model directory must be refused
@@ -1132,6 +1139,20 @@ def test_input_norm_unknown_name_rejected():
                   input_norm={"Data": {"mean": 1.0}})  # typo'd case
     with pytest.raises(KeyError, match="Data"):
         m._executor()
+    # typo'd spec key ('std' instead of 'scale') must not silently no-op
+    m2 = ONNXModel(model_bytes=g.to_bytes(),
+                   input_norm={"data": {"mean": 1.0, "std": 2.0}})
+    with pytest.raises(KeyError, match="std"):
+        m2._executor()
+    # normalizing an integer-declared input is a misconfiguration
+    gi = GraphBuilder(opset=17)
+    x = gi.add_input("ids", np.int64, ["N"])
+    y = gi.add_node("Identity", [x])
+    gi.add_output(y, np.int64, ["N"])
+    m3 = ONNXModel(model_bytes=gi.to_bytes(),
+                   input_norm={"ids": {"mean": 0.5}})
+    with pytest.raises(TypeError, match="integer"):
+        m3._executor()
 
 
 def test_resnet50_full_network_parity_vs_torch_224():

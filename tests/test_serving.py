@@ -49,6 +49,39 @@ def test_worker_server_round_trip():
         srv.stop()
 
 
+def test_get_batch_linger_coalesces_concurrent_requests():
+    """With a linger window, a concurrent burst lands in ONE batch (one
+    amortized device round trip) instead of serial singletons; with
+    linger=0 the drain takes only what is immediately available."""
+    srv = WorkerServer("t_linger")
+    try:
+        n = 8
+        barrier = threading.Barrier(n + 1)
+        results = [None] * n
+
+        def client(i):
+            barrier.wait()
+            # stagger arrivals across a few ms like real concurrency
+            time.sleep(0.002 * i)
+            results[i] = _post(srv.url, {"i": i})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        batch = srv.get_batch(max_rows=64, timeout=5.0, linger=0.5)
+        assert len(batch) == n, f"linger should coalesce all {n}, got {len(batch)}"
+        table = requests_to_table(batch)
+        replies = np.array([make_reply({"ok": True})] * n, dtype=object)
+        send_replies(srv, table.with_column("reply", replies))
+        for t in threads:
+            t.join(timeout=5)
+        assert all(r == (200, {"ok": True}) for r in results)
+    finally:
+        srv.stop()
+
+
 def test_continuous_server_pipeline_with_model_scorer():
     """End-to-end: real HTTP requests -> pipeline containing a jax-scored
     model -> replies (the serving north-star path)."""
