@@ -69,6 +69,9 @@ class BoostParams:
     deterministic: bool = True
     categorical_features: Tuple[int, ...] = ()
     verbosity: int = -1
+    # "auto" = measure at fit time (grower.resolve_hist_backend);
+    # "pallas"/"xla" force a histogram formulation
+    hist_backend: str = "auto"
 
     def grower(self) -> GrowerParams:
         return GrowerParams(
@@ -80,6 +83,7 @@ class BoostParams:
             min_data_in_leaf=max(1, self.min_data_in_leaf),
             min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
             min_gain_to_split=self.min_gain_to_split,
+            hist_backend=self.hist_backend,
         )
 
 
@@ -960,6 +964,17 @@ def train(
     binned_np = mapper.transform(x)
     bdev = mapper.total_bins
     gp = dataclasses.replace(p.grower(), max_bin=bdev)
+    if gp.hist_backend == "auto":
+        # route the hot op on a cached in-context measurement, not a
+        # remembered experiment (see grower.resolve_hist_backend). On a
+        # dp mesh each shard builds histograms over n/dp rows — probe the
+        # shape that actually executes.
+        from synapseml_tpu.gbdt.grower import resolve_hist_backend
+        n_shard = n
+        if mesh is not None and "dp" in mesh.axis_names:
+            n_shard = max(1, n // int(mesh.shape["dp"]))
+        gp = dataclasses.replace(
+            gp, hist_backend=resolve_hist_backend(n_shard, f, bdev))
     thresholds = jnp.asarray(mapper.threshold_values(), jnp.float32)
 
     init = _init_score(p, y, weight)

@@ -93,6 +93,10 @@ class _LightGBMParams:
     metric = Param("eval metric override", default=None)
     seed = Param("random seed", default=0)
     verbosity = Param("verbosity", default=-1)
+    hist_backend = Param(
+        "histogram formulation: auto (measured probe) | pallas | xla",
+        default="auto",
+        type_check=lambda v: v in ("auto", "pallas", "xla"))
 
     def _features(self, table: Table) -> np.ndarray:
         cols = self.feature_cols
@@ -128,6 +132,7 @@ class _LightGBMParams:
             metric=self.get("metric"),
             seed=int(self.seed),
             categorical_features=tuple(self.categorical_slot_indexes or ()),
+            hist_backend=self.hist_backend,
         )
 
 

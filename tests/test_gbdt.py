@@ -634,3 +634,79 @@ def test_distributed_dart_multiclass_matches_single_device():
     p2 = b2.predict(x)
     np.testing.assert_allclose(p2, p1, rtol=5e-3, atol=5e-3)
     assert (p2.argmax(-1) == y).mean() > 0.8
+
+
+def test_hist_backend_routing(cancer, tmp_path, monkeypatch):
+    """hist_backend threads estimator -> BoostParams -> GrowerParams;
+    'auto' resolves via the measured router (trivially 'xla' off-TPU),
+    forced backends train identically on CPU (the backend only selects
+    a TPU formulation), and the route cache persists to disk."""
+    from synapseml_tpu.gbdt.grower import (_HIST_ROUTE_CACHE,
+                                           resolve_hist_backend)
+
+    Xt, Xv, yt, yv = cancer
+    p_forced = BoostParams(objective="binary", num_iterations=5,
+                           hist_backend="xla")
+    assert p_forced.grower().hist_backend == "xla"
+    b1 = train(p_forced, Xt, yt)
+    b2 = train(BoostParams(objective="binary", num_iterations=5), Xt, yt)
+    np.testing.assert_allclose(b1.predict(Xv), b2.predict(Xv), rtol=1e-6)
+
+    # off-TPU the router always answers xla (scatter path ignores it)
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    _HIST_ROUTE_CACHE.clear()
+    assert resolve_hist_backend(4096, 10, 256) == "xla"
+
+    est = LightGBMClassifier(num_iterations=3, hist_backend="pallas")
+    assert est._boost_params("binary").hist_backend == "pallas"
+    with pytest.raises(TypeError):
+        LightGBMClassifier(hist_backend="cuda")
+
+
+def test_hist_route_probe_and_disk_cache(tmp_path, monkeypatch):
+    """The probe + persistence path, exercised off-TPU by stubbing the
+    backend checks: a measured verdict is written to the cache file; a
+    fresh process (cleared in-process cache) reads it back WITHOUT
+    re-probing; a probe failure falls back to xla and is not persisted."""
+    import json
+
+    import jax.numpy as jnp
+
+    from synapseml_tpu.gbdt import grower, pallas_kernels
+
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(grower.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(pallas_kernels, "available", lambda: True)
+    calls = []
+
+    def fake_hist(binned, grad, hess, mask, n_bins, axis_name=None,
+                  backend="auto"):
+        calls.append(backend)
+        f = binned.shape[1]
+        return jnp.zeros((f, n_bins, 3), jnp.float32)
+
+    monkeypatch.setattr(grower, "histogram", fake_hist)
+    grower._HIST_ROUTE_CACHE.clear()
+    got = grower.resolve_hist_backend(4096, 6, 64)
+    assert got in ("pallas", "xla")
+    assert "pallas" in calls and "xla" in calls  # both legs timed
+    cache_file = tmp_path / "hist_routing.json"
+    disk = json.loads(cache_file.read_text())
+    assert list(disk.values()) == [got]
+
+    # fresh "process": disk answers, no probe runs
+    grower._HIST_ROUTE_CACHE.clear()
+    calls.clear()
+    assert grower.resolve_hist_backend(4096, 6, 64) == got
+    assert calls == []
+
+    # probe failure: xla fallback, nothing new persisted
+    def boom(*a, **k):
+        raise RuntimeError("mosaic lowering failed")
+
+    monkeypatch.setattr(grower, "histogram", boom)
+    grower._HIST_ROUTE_CACHE.clear()
+    cache_file.unlink()
+    assert grower.resolve_hist_backend(4096, 6, 64) == "xla"
+    assert not cache_file.exists()
+    grower._HIST_ROUTE_CACHE.clear()
