@@ -136,10 +136,15 @@ def bench_gbdt_train():
     ab = {"pallas_rows_iters_per_sec": round(leg("pallas"), 0),
           "xla_rows_iters_per_sec": round(leg("xla"), 0)}
     # the router is deterministic and cached: re-asking with the fit's
-    # exact shape reports what the auto leg actually ran
+    # exact shape reports what the auto leg actually ran. Derive the bin
+    # width from the estimator's OWN params so the key cannot drift.
     from synapseml_tpu.gbdt.binning import BinMapper
     from synapseml_tpu.gbdt.grower import resolve_hist_backend
-    bdev = BinMapper(max_bin=255).fit(x.astype(np.float64)).total_bins
+    bp = LightGBMClassifier(num_iterations=100, num_leaves=31,
+                            learning_rate=0.1)._boost_params("binary")
+    bdev = BinMapper(max_bin=bp.max_bin,
+                     categorical_features=bp.categorical_features,
+                     seed=bp.seed).fit(x.astype(np.float64)).total_bins
     ab["auto_routed_to"] = resolve_hist_backend(n, d, bdev)
     return auto_rows_s, ab
 

@@ -86,6 +86,12 @@ def histogram(binned, grad, hess, mask, n_bins: int,
 
         use_pallas = (backend != "xla" and pallas_kernels.available()
                       and _pallas_shape_ok(n, f, n_bins))
+        if backend == "pallas" and not use_pallas:
+            import warnings
+            warnings.warn(
+                f"hist_backend='pallas' requested but unusable for shape "
+                f"(n={n}, f={f}, bins={n_bins}) or kernel unavailable — "
+                "running the XLA formulation instead", stacklevel=2)
         if use_pallas:
             # VMEM-resident accumulator kernel: one HBM pass over the rows
             hist = pallas_kernels.histogram_tpu(binned, data, n_bins)
@@ -146,10 +152,13 @@ def resolve_hist_backend(n: int, f: int, n_bins: int,
     n_probe = int(min(max(n, 512), 65536))
     n_bucket = 1 << (n_probe - 1).bit_length()
     kind = jax.devices()[0].device_kind
-    # versioned key: a jaxlib/kernel upgrade can flip the winner, and a
-    # stale persisted verdict would be the "remembered experiment"
-    # failure mode this router exists to eliminate
-    key = f"v1|jax{jax.__version__}|{kind}|{n_bucket}|{f}|{n_bins}"
+    # versioned key: a jaxlib OR in-package kernel upgrade can flip the
+    # winner, and a stale persisted verdict would be the "remembered
+    # experiment" failure mode this router exists to eliminate
+    import synapseml_tpu as _pkg
+    pkg_v = getattr(_pkg, "__version__", "0")
+    key = (f"v1|jax{jax.__version__}|pkg{pkg_v}|{kind}|"
+           f"{n_bucket}|{f}|{n_bins}")
     got = _HIST_ROUTE_CACHE.get(key)
     if got is not None:
         return got
@@ -165,7 +174,11 @@ def resolve_hist_backend(n: int, f: int, n_bins: int,
 
     import numpy as np
     rng = np.random.default_rng(0)
-    binned = jnp.asarray(rng.integers(0, n_bins, (n_bucket, f)), jnp.uint8)
+    # match production dtype (binning.transform: uint8 only up to 256
+    # bins) — probing uint8 for a uint16 workload would time half the
+    # HBM traffic and wrap the bin values
+    bin_dtype = jnp.uint8 if n_bins <= 256 else jnp.uint16
+    binned = jnp.asarray(rng.integers(0, n_bins, (n_bucket, f)), bin_dtype)
     grad = jnp.asarray(rng.normal(size=n_bucket), jnp.float32)
     hess = jnp.asarray(rng.random(n_bucket), jnp.float32)
 

@@ -12,11 +12,16 @@ LAST dim over ``axis``; 1-D biases that feed the same activations
 replicate (GSPMD re-shards them as needed). Weights whose dims don't
 divide the axis size stay replicated. For a transformer this puts each
 rank's slice of every projection in HBM — the model no longer needs to
-fit on one chip.
+fit on one chip (``param_bytes_per_device`` makes that claim checkable,
+and the test suite asserts it).
+
+Activations are replicated by default (right for classifier-shaped
+outputs); ``batch_axis`` keeps inputs/outputs batch-sharded instead so
+activation-heavy graphs don't re-materialize full tensors per device.
 """
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
@@ -42,27 +47,69 @@ def tp_shard_params(params: Dict[str, np.ndarray], mesh: Mesh,
     return out
 
 
-def tp_jit(graph, mesh: Mesh, axis: str = "tp"):
+def param_bytes_per_device(params: Dict[str, Any]) -> Dict[Any, int]:
+    """Actual parameter bytes resident on each device — the tested form
+    of the "model no longer needs to fit on one chip" claim."""
+    per_dev: Dict[Any, int] = {}
+    for v in params.values():
+        for s in v.addressable_shards:
+            per_dev[s.device] = per_dev.get(s.device, 0) + s.data.nbytes
+    return per_dev
+
+
+def tp_jit(graph, mesh: Mesh, axis: str = "tp",
+           batch_axis: Optional[str] = None):
     """(sharded_params, jitted_fn): run ``graph`` tensor-parallel.
 
-    ``jitted_fn(params, *inputs)`` replicates inputs, lets GSPMD carry
-    the column-sharded weights through the graph, and returns replicated
-    outputs — numerically identical to single-device ``graph.apply``.
+    ``jitted_fn(params, *inputs)`` lets GSPMD carry the column-sharded
+    weights through the graph — numerically identical to single-device
+    ``graph.apply``.
+
+    With ``batch_axis=None`` (default) inputs and outputs replicate —
+    right for classifiers, where activations are small next to weights.
+    With ``batch_axis="tp"`` (or any mesh axis) inputs/outputs stay
+    sharded over their leading batch dimension, so an activation-heavy
+    graph never materializes a full-batch tensor on any one device;
+    the leading dim of every array input must divide the axis size.
     """
     params = tp_shard_params(graph.params, mesh, axis)
     rep = replicated(mesh)
+    n_b = mesh.shape[batch_axis] if batch_axis is not None else 1
+    io_sh = NamedSharding(mesh, P(batch_axis)) if batch_axis else rep
 
     def fn(p, *inputs):
         return graph.apply(p, *inputs)
 
-    jitted = jax.jit(fn, out_shardings=rep)
+    jitted = jax.jit(fn, out_shardings=io_sh)
+
+    checked_out = []
 
     def run(p, *inputs):
         # device-resident inputs (a previous stage's output) re-shard
         # without the D2H round trip np.asarray would force
-        placed = [jax.device_put(
-            x if isinstance(x, jax.Array) else np.asarray(x), rep)
-            for x in inputs]
+        placed = []
+        for x in inputs:
+            x = x if isinstance(x, jax.Array) else np.asarray(x)
+            if batch_axis is not None and x.ndim:
+                if x.shape[0] % n_b:
+                    raise ValueError(
+                        f"batch_axis={batch_axis!r}: leading dim "
+                        f"{x.shape[0]} does not divide axis size {n_b}")
+                placed.append(jax.device_put(x, io_sh))
+            else:
+                placed.append(jax.device_put(x, rep))
+        if batch_axis is not None and not checked_out:
+            # validate every OUTPUT is batch-shardable before GSPMD
+            # fails compilation with an error naming no tensor
+            outs = jax.eval_shape(fn, p, *placed)
+            for i, o in enumerate(jax.tree_util.tree_leaves(outs)):
+                if not o.shape or o.shape[0] % n_b:
+                    raise ValueError(
+                        f"batch_axis={batch_axis!r}: graph output {i} has "
+                        f"shape {o.shape}, whose leading dim cannot shard "
+                        f"over axis size {n_b} — use batch_axis=None for "
+                        "graphs with reduced/batchless outputs")
+            checked_out.append(True)
         return jitted(p, *placed)
 
     return params, run

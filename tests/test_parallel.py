@@ -354,6 +354,46 @@ def test_imported_onnx_graph_runs_tensor_parallel():
     got = np.asarray(run(params, ids)[0])
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
+    # the memory claim is an invariant, not prose: per-device parameter
+    # bytes must be ~total/n (exactly: sharded/n + replicated remainder)
+    from synapseml_tpu.parallel.onnx_tp import param_bytes_per_device
+    total = sum(v.nbytes for v in g.params.values())
+    sharded_total = sum(
+        g.params[k].nbytes for k in sharded)
+    expected = sharded_total // 4 + (total - sharded_total)
+    per_dev = param_bytes_per_device(params)
+    assert len(per_dev) == 4
+    assert max(per_dev.values()) == expected, (per_dev, expected)
+    # the dominant weights really shard: per-device ≲ 40% of the model
+    assert expected < 0.4 * total, (expected, total)
+
+    # batch-sharded activations: outputs stay sharded over the axis, and
+    # numerics still match (batch 4 divides the 4-device axis)
+    params_b, run_b = tp_jit(g, mesh, batch_axis="tp")
+    ids4 = np.random.default_rng(1).integers(0, 100, (4, 16))
+    want4 = np.asarray(g.apply(g.params, ids4)[0])
+    out_b = run_b(params_b, ids4)[0]
+    assert out_b.sharding.spec == jax.sharding.PartitionSpec("tp")
+    # each device holds 1/4 of the output batch, not the full tensor
+    assert out_b.addressable_shards[0].data.shape[0] == 1
+    np.testing.assert_allclose(np.asarray(out_b), want4,
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="divide"):
+        run_b(params_b, np.random.default_rng(2).integers(0, 100, (3, 16)))
+
+    # a graph with a batchless (reduced) output gets a clear error, not
+    # an opaque GSPMD compile failure
+    from synapseml_tpu.onnx.builder import GraphBuilder
+    gb = GraphBuilder(opset=17)
+    xin = gb.add_input("x", np.float32, [4, 8])
+    red = gb.add_node("ReduceSum", [xin, gb.add_initializer(
+        "axes", np.array([0, 1], np.int64))], keepdims=0)
+    gb.add_output(red, np.float32, [])
+    g3 = import_model(gb.to_bytes())
+    params3, run3 = tp_jit(g3, mesh, batch_axis="tp")
+    with pytest.raises(ValueError, match="batchless|cannot shard"):
+        run3(params3, np.zeros((4, 8), np.float32))
+
     # the foreign torch-exported CNN fixture rides the same machinery
     import os
 
