@@ -1,0 +1,190 @@
+"""Executor partition-iterator protocol — the Spark/Arrow data-plane seam.
+
+The reference's training topology is ``df.rdd.barrier().mapPartitions``:
+each Spark executor task streams its partition's rows into the native
+dataset, then every task fits as one ring
+(ref: lightgbm/src/main/scala/com/microsoft/ml/spark/lightgbm/LightGBMBase.scala:482-486,
+DatasetAggregator.scala:69-180 for the per-task chunked ingest). This module
+is the TPU-native version of that seam: an executor task (a pyspark
+``mapPartitions`` closure co-located on a TPU host, a Ray actor, or a plain
+process) drives
+
+    agg = PartitionAggregator(feature_cols=[...], label_col="y")
+    for batch in partition_iter:          # pyarrow RecordBatch / Table,
+        agg.add(batch)                    # pandas DataFrame, dict, Table
+    booster = fit_partitions(params, [agg.batches...]) # or fit_aggregated
+
+Per-host aggregation builds ONE contiguous feature matrix (so the
+host->device transfer is a single placement, not a row loop); multi-host
+jobs join the mesh via :mod:`synapseml_tpu.parallel.distributed`
+(``rendezvous=...`` or ambient ``SYNAPSEML_*`` env), after which the
+dp-sharded fit psums histograms over ICI/DCN exactly like the single-host
+mesh path.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from synapseml_tpu.data.table import Table
+
+
+def _as_table(batch: Any) -> Table:
+    """Normalize one record batch to a Table (whose constructor validates
+    equal column lengths) — one normalization path, shared with the rest
+    of the data plane."""
+    if isinstance(batch, Table):
+        return batch
+    if isinstance(batch, dict):
+        return Table(batch)
+    if getattr(batch, "column_names", None) is not None:
+        return Table.from_arrow(batch)  # pyarrow RecordBatch / Table
+    if getattr(batch, "columns", None) is not None:
+        return Table.from_pandas(batch)  # pandas DataFrame
+    raise TypeError(
+        f"unsupported record-batch type {type(batch).__name__}: expected "
+        "pyarrow RecordBatch/Table, pandas DataFrame, Table, or dict")
+
+
+class PartitionAggregator:
+    """Streams an executor's record batches into contiguous columns.
+
+    The chunked-then-coalesced ingest the reference does natively
+    (DatasetAggregator's chunked arrays): ``add`` appends cheap references;
+    ``to_arrays`` concatenates ONCE into the (x, y, weight) the trainer
+    wants — no per-row marshalling.
+    """
+
+    def __init__(self, feature_cols: Sequence[str],
+                 label_col: str = "label",
+                 weight_col: Optional[str] = None):
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self.weight_col = weight_col
+        self._chunks: List[Dict[str, np.ndarray]] = []
+        self.num_rows = 0
+
+    def _needed(self) -> List[str]:
+        need = self.feature_cols + [self.label_col]
+        if self.weight_col is not None:
+            need.append(self.weight_col)
+        return need
+
+    def add(self, batch: Any) -> "PartitionAggregator":
+        t = _as_table(batch)  # Table validates equal column lengths
+        missing = [c for c in self._needed() if c not in t]
+        if missing:
+            raise KeyError(f"record batch lacks columns {missing} "
+                           f"(has: {sorted(t.columns)})")
+        # keep ONLY the columns the fit reads: a wide partition must not
+        # pin its unused columns in executor memory until to_arrays
+        self._chunks.append({c: t[c] for c in self._needed()})
+        self.num_rows += t.num_rows
+        return self
+
+    def to_arrays(self) -> Tuple[np.ndarray, np.ndarray,
+                                 Optional[np.ndarray]]:
+        """Concatenate once into (x, y, weight). An executor with no rows
+        (empty Spark partitions are routine) yields (0, F)-shaped arrays
+        so a multi-host job's other ranks aren't left hanging in the
+        gather collective."""
+        f = len(self.feature_cols)
+        if not self._chunks:
+            return (np.zeros((0, f)), np.zeros(0),
+                    np.zeros(0) if self.weight_col is not None else None)
+        x = np.concatenate([
+            np.column_stack([np.asarray(c[fc], np.float64)
+                             for fc in self.feature_cols])
+            for c in self._chunks]) if f else np.zeros((self.num_rows, 0))
+        y = np.concatenate([np.asarray(c[self.label_col], np.float64)
+                            for c in self._chunks])
+        w = None
+        if self.weight_col is not None:
+            w = np.concatenate([np.asarray(c[self.weight_col], np.float64)
+                                for c in self._chunks])
+        return x, y, w
+
+
+def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
+                   rendezvous: Optional[Dict[str, Any]] = None,
+                   **train_kw):
+    """Fit this host's aggregated rows, joining a multi-host mesh first.
+
+    ``rendezvous``: ``{"driver_host":..., "driver_port":..., "my_host":...,
+    "rank_hint":...}`` wires the host into the driver rendezvous and the
+    jax.distributed runtime (parallel/distributed.py) — the TPU-native
+    replacement of the reference's NetworkInit TCP ring. Without it, the
+    ambient ``SYNAPSEML_*`` env (if any) is used. Under a multi-process
+    runtime, every host's rows are gathered to form the global dataset
+    (rows ride DCN once), then the dp-sharded mesh fit psums histograms;
+    rows therefore currently replicate per host — the mesh shards the
+    *compute*.
+    """
+    import jax
+
+    from synapseml_tpu.gbdt.boosting import train
+    from synapseml_tpu.parallel import distributed
+
+    if rendezvous is not None:
+        distributed.rendezvous_and_initialize(
+            rendezvous["driver_host"], int(rendezvous["driver_port"]),
+            my_host=rendezvous.get("my_host"),
+            rank_hint=int(rendezvous.get("rank_hint", -1)),
+            coordinator_port=int(rendezvous.get(
+                "coordinator_port", 26570)))
+    else:
+        distributed.initialize()
+
+    x, y, w = agg.to_arrays()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # per-host row counts differ: pad to the global max, gather, trim
+        n_local = np.asarray([x.shape[0]])
+        n_all = np.asarray(multihost_utils.process_allgather(n_local)
+                           ).reshape(-1)
+        n_max = max(int(n_all.max()), 1)  # keep the collective well-shaped
+                                          # even when every host is empty
+
+        def gather_f64(a):
+            """Bit-exact float64 gather: jax would canonicalize f64 to
+            f32 with x64 disabled, and a rounding that crosses a bin
+            quantile would silently break the single-fit identity —
+            so the doubles ride as uint32 words."""
+            a = np.ascontiguousarray(
+                np.pad(a, [(0, n_max - a.shape[0])]
+                       + [(0, 0)] * (a.ndim - 1)))
+            words = a.view(np.uint32).reshape(n_max, -1)
+            out = np.asarray(multihost_utils.process_allgather(words))
+            out = out.reshape(len(n_all), n_max, -1)
+            return np.concatenate([
+                out[i, :n_all[i]].reshape(-1).view(np.float64).reshape(
+                    (n_all[i],) + a.shape[1:])
+                for i in range(len(n_all))])
+
+        x = gather_f64(np.asarray(x, np.float64))
+        y = gather_f64(np.asarray(y, np.float64))
+        if w is not None:
+            w = gather_f64(np.asarray(w, np.float64))
+        if mesh is None:
+            from jax.sharding import Mesh
+            mesh = Mesh(np.array(jax.devices()), ("dp",))
+    if x.shape[0] == 0:
+        raise ValueError("no rows to fit: every partition stream was empty")
+    return train(params, x, y, weight=w, mesh=mesh, **train_kw)
+
+
+def fit_partitions(params, partitions: Iterable[Any],
+                   feature_cols: Sequence[str], label_col: str = "label",
+                   weight_col: Optional[str] = None, mesh=None,
+                   rendezvous: Optional[Dict[str, Any]] = None,
+                   **train_kw):
+    """One-call form: stream ``partitions`` (an iterator of record
+    batches — THIS executor's partitions) through a
+    :class:`PartitionAggregator` and fit. See :func:`fit_aggregated`."""
+    agg = PartitionAggregator(feature_cols, label_col, weight_col)
+    for batch in partitions:
+        agg.add(batch)
+    return fit_aggregated(params, agg, mesh=mesh, rendezvous=rendezvous,
+                          **train_kw)
