@@ -24,8 +24,11 @@ shapes — and maps to the leading "N" dim; a CNTK static axis index k
 Supported op surface: the feedforward model-zoo diet (Times/Plus/
 activation chains, Convolution, Pooling, BatchNormalization, Reshape,
 Splice, Slice, TransposeAxes, ReduceElements, Clip, Dropout/NoOp
-passthrough, Combine). Recurrent ops (PastValue/OptimizedRNNStack)
-raise with the ONNX-export recipe, as before.
+passthrough, Combine) plus RECURRENT graphs: PastValue/FutureValue
+cycles lower to ONNX Scan -> ``lax.scan`` with everything outside the
+cycle vectorized over the sequence (see :func:`_recurrent_to_onnx`;
+bidirectional = two cycles = two Scans). OptimizedRNNStack (the fused
+cuDNN op) still raises with the ONNX-export recipe.
 """
 from __future__ import annotations
 
@@ -263,32 +266,35 @@ class _Var:
 VAR_INPUT, VAR_OUTPUT, VAR_PARAMETER, VAR_CONSTANT, VAR_PLACEHOLDER = range(5)
 
 
-def cntk_to_onnx(payload: bytes,
-                 parsed: Optional[Dict[str, Any]] = None) -> bytes:
-    """Parse ``.model`` bytes and re-emit the graph as ONNX bytes.
-    ``parsed`` skips the (pure-Python, weight-heavy) protobuf decode when
-    the caller already holds the Dictionary from the sniff."""
-    top = parsed if parsed is not None else load_model_dictionary(payload)
-    if top.get("type") != "CompositeFunction":
-        raise ValueError(
-            f"not a CNTK v2 CompositeFunction dictionary "
-            f"(type={top.get('type')!r})")
-    variables = {v["uid"]: _Var(v) for v in top.get("inputs", [])}
-    functions = top.get("primitive_functions", [])
-    root = top.get("root")
+class _Emitter:
+    """Lowers CNTK primitive functions into one GraphBuilder.
 
-    g = GraphBuilder(name=top.get("name") or "cntk_model", opset=17)
-    names: Dict[str, str] = {}   # cntk variable uid -> onnx tensor name
+    Reused by the recurrent path for Scan bodies: ``alias`` pre-maps
+    tensor uids onto existing onnx names (state inputs / per-timestep
+    scan slices), and ``seq_inputs`` marks model inputs that carry a
+    sequence axis (declared ``[N, T, ...]`` instead of ``[N, ...]``)."""
 
-    def resolve(uid: str, transpose_param: bool = False) -> str:
+    def __init__(self, g: GraphBuilder, variables: Dict[str, "_Var"],
+                 seq_inputs: frozenset = frozenset()):
+        self.g = g
+        self.variables = variables
+        self.seq_inputs = seq_inputs
+        self.names: Dict[Any, str] = {}
+        self.last_output: Optional[str] = None
+
+    def alias(self, tensor_uid: str, onnx_name: str):
+        self.names[(tensor_uid, False)] = onnx_name
+
+    def resolve(self, uid: str, transpose_param: bool = False) -> str:
         # a shared parameter may be consumed in BOTH orientations
         # (weight tying): the cache key carries the flip
         key = (uid, transpose_param)
-        if key in names:
-            return names[key]
-        var = variables.get(uid)
+        if key in self.names:
+            return self.names[key]
+        var = self.variables.get(uid)
         if var is None:
             raise KeyError(f"dangling variable uid {uid!r}")
+        g = self.g
         if var.kind in (VAR_PARAMETER, VAR_CONSTANT):
             arr = np.asarray(var.value)
             if transpose_param:
@@ -300,25 +306,29 @@ def cntk_to_onnx(payload: bytes,
                     "Times with a non-parameter weight operand needs a "
                     "runtime transpose; export to ONNX with the cntk "
                     "package for this graph")
+            dyn = ["N", "T"] if uid in self.seq_inputs else ["N"]
             nm = g.add_input(var.name or uid, np.float32,
-                             ["N"] + list(reversed(var.shape)))
+                             dyn + list(reversed(var.shape)))
         else:
             raise ValueError(f"unresolvable variable {uid!r} "
                              f"(kind={var.kind})")
-        names[key] = nm
+        self.names[key] = nm
         return nm
 
+    @staticmethod
     def np_axis(attr) -> int:
         k = attr.static_axis_idx if isinstance(attr, CntkAxisRef) \
             else int(attr)
         return -(k + 1)
 
-    def is_param(uid: str) -> bool:
-        v = variables.get(uid)
+    def is_param(self, uid: str) -> bool:
+        v = self.variables.get(uid)
         return v is not None and v.kind in (VAR_PARAMETER, VAR_CONSTANT)
 
-    last_output = None
-    for fd in functions:
+    def emit(self, fd: Dict[str, Any]) -> Optional[str]:
+        g, names = self.g, self.names
+        resolve, np_axis, is_param = self.resolve, self.np_axis, self.is_param
+        variables = self.variables
         op = int(fd["op"])
         uid = fd["uid"]
         ins: List[str] = list(fd.get("inputs", []))
@@ -437,27 +447,442 @@ def cntk_to_onnx(payload: bytes,
         elif op == OP_COMBINE:
             for j, i_uid in enumerate(ins):
                 names[(f"{uid}_Output_{j}", False)] = resolve(i_uid)
-            last_output = names[(f"{uid}_Output_0", False)]
-            continue
+            self.last_output = names[(f"{uid}_Output_0", False)]
+            return self.last_output
         elif op in (OP_PAST_VALUE, OP_FUTURE_VALUE):
-            raise NotImplementedError(
-                "recurrent CNTK graphs (PastValue/FutureValue) are not "
-                "supported by the direct reader; export the model to "
-                "ONNX with the cntk package and load that file")
+            raise AssertionError(
+                "recurrent state nodes must be handled by the Scan "
+                "lowering, never emitted directly")
         else:
             raise NotImplementedError(
                 f"CNTK primitive op code {op} ({fd.get('name') or uid}) "
                 f"is outside the supported feedforward surface; export "
                 f"to ONNX with the cntk package for full coverage")
         names[(out_name, False)] = y
-        last_output = y
+        self.last_output = y
+        return y
 
+
+def cntk_to_onnx(payload: bytes,
+                 parsed: Optional[Dict[str, Any]] = None) -> bytes:
+    """Parse ``.model`` bytes and re-emit the graph as ONNX bytes.
+    ``parsed`` skips the (pure-Python, weight-heavy) protobuf decode when
+    the caller already holds the Dictionary from the sniff. Recurrent
+    graphs (PastValue/FutureValue cycles) lower through ONNX Scan — see
+    :func:`_recurrent_to_onnx`."""
+    top = parsed if parsed is not None else load_model_dictionary(payload)
+    if top.get("type") != "CompositeFunction":
+        raise ValueError(
+            f"not a CNTK v2 CompositeFunction dictionary "
+            f"(type={top.get('type')!r})")
+    variables = {v["uid"]: _Var(v) for v in top.get("inputs", [])}
+    functions = top.get("primitive_functions", [])
+    root = top.get("root")
+
+    g = GraphBuilder(name=top.get("name") or "cntk_model", opset=17)
+    if any(int(fd["op"]) in (OP_PAST_VALUE, OP_FUTURE_VALUE)
+           for fd in functions):
+        return _recurrent_to_onnx(g, variables, functions, root)
+
+    em = _Emitter(g, variables)
+    for fd in functions:
+        em.emit(fd)
     out_uid = f"{root}_Output_0" if root else None
-    out_name = names.get((out_uid, False), last_output)
+    out_name = em.names.get((out_uid, False), em.last_output)
     if out_name is None:
         raise ValueError("model has no computable output")
     g.add_output(out_name, np.float32, None)
     return g.to_bytes(producer="synapseml_tpu.dl.cntk_format")
+
+
+def _recurrent_to_onnx(g: GraphBuilder, variables: Dict[str, _Var],
+                       functions: List[Dict[str, Any]],
+                       root: Optional[str]) -> bytes:
+    """Lower a CNTK v2 graph whose PastValue/FutureValue nodes form
+    recurrence cycles.
+
+    TPU-native design: each cycle becomes ONE ONNX ``Scan`` node, which
+    the importer lowers to ``lax.scan`` (one compiled body — no
+    per-timestep Python); everything OUTSIDE the cycles stays vectorized
+    over the whole ``[N, T, ...]`` sequence, so the input projection
+    ``x_t @ W`` for all t is a single MXU matmul instead of T small ones.
+    The reference executes these graphs natively via ``Function.load``
+    (deep-learning/.../cntk/SerializableFunction.scala:85-143 — the
+    BiLSTM zoo); here the sequence convention is: every model INPUT that
+    (transitively) feeds a recurrence carries CNTK's default
+    [batch, time] dynamic-axis pair, other inputs just [batch].
+
+    Supported: offset-1 Past/FutureValue, any number of state variables
+    per cycle (LSTM h+c merge into one body), stacked and backward
+    recurrences. A cycle mixing Past and Future (a true bidirectional
+    loop, not two separate cycles) cannot be a single scan and raises.
+    """
+    fns = {fd["uid"]: fd for fd in functions}
+    producer: Dict[str, str] = {}
+    for fd in functions:
+        n_out = len(fd.get("inputs", [])) \
+            if int(fd["op"]) == OP_COMBINE else 1
+        for j in range(n_out):
+            producer[f"{fd['uid']}_Output_{j}"] = fd["uid"]
+    consumers: Dict[str, List[str]] = {}
+    for fd in functions:
+        for i in fd.get("inputs", []):
+            p = producer.get(i)
+            if p is not None:
+                consumers.setdefault(p, []).append(fd["uid"])
+
+    def ancestors_of(tensor: str) -> set:
+        out: set = set()
+        stack = [producer[tensor]] if tensor in producer else []
+        while stack:
+            u = stack.pop()
+            if u in out:
+                continue
+            out.add(u)
+            for i in fns[u].get("inputs", []):
+                p = producer.get(i)
+                if p is not None and p not in out:
+                    stack.append(p)
+        return out
+
+    def descendants_of(uid: str) -> set:
+        out: set = set()
+        stack = [uid]
+        while stack:
+            for c in consumers.get(stack.pop(), []):
+                if c not in out:
+                    out.add(c)
+                    stack.append(c)
+        return out
+
+    pvs = [fd for fd in functions
+           if int(fd["op"]) in (OP_PAST_VALUE, OP_FUTURE_VALUE)]
+    for pv in pvs:
+        if int((pv.get("attributes") or {}).get("offset", 1)) != 1:
+            raise NotImplementedError(
+                "PastValue/FutureValue with offset != 1 is not supported")
+
+    # one group per recurrence cycle; overlapping cycles merge (LSTM's
+    # h and c share a body)
+    groups: List[Dict[str, Any]] = []
+    for pv in pvs:
+        cyc = descendants_of(pv["uid"]) & ancestors_of(pv["inputs"][0])
+        cyc.add(pv["uid"])
+        groups.append({"nodes": cyc, "pvs": [pv]})
+    merged = True
+    while merged:
+        merged = False
+        for i in range(len(groups)):
+            for j in range(i + 1, len(groups)):
+                if groups[i]["nodes"] & groups[j]["nodes"]:
+                    groups[i]["nodes"] |= groups[j]["nodes"]
+                    groups[i]["pvs"] += groups[j]["pvs"]
+                    del groups[j]
+                    merged = True
+                    break
+            if merged:
+                break
+    in_group: Dict[str, Dict[str, Any]] = {}
+    for grp in groups:
+        for u in grp["nodes"]:
+            in_group[u] = grp
+
+    # model inputs feeding any cycle carry the sequence axis
+    seq_inputs: set = set()
+    for grp in groups:
+        seen: set = set()
+        stack = list(grp["nodes"])
+        while stack:
+            u = stack.pop()
+            if u in seen:
+                continue
+            seen.add(u)
+            for i in fns[u].get("inputs", []):
+                p = producer.get(i)
+                if p is not None:
+                    stack.append(p)
+                else:
+                    v = variables.get(i)
+                    if v is not None and v.kind == VAR_INPUT:
+                        seq_inputs.add(i)
+
+    outer = _Emitter(g, variables, seq_inputs=frozenset(seq_inputs))
+
+    def infer_last_dim(tensor: str,
+                       _seen: Optional[set] = None) -> Optional[int]:
+        """Static trailing dim (state width) — needed when a scalar
+        initial_state must Expand to [N, H]. ``_seen`` breaks the
+        recurrence back-edge (the walk re-enters the cycle through the
+        state node and must answer from a sibling operand instead)."""
+        _seen = set() if _seen is None else _seen
+        if tensor in _seen:
+            return None
+        _seen.add(tensor)
+        v = variables.get(tensor)
+        if v is not None:
+            shape = tuple(reversed(v.shape))
+            return int(shape[-1]) if shape else None
+        u = producer.get(tensor)
+        if u is None:
+            return None
+        fd = fns[u]
+        op, ins = int(fd["op"]), list(fd.get("inputs", []))
+        if op in _UNARY or op in (OP_PAST_VALUE, OP_FUTURE_VALUE,
+                                  OP_DROPOUT, OP_NO_OP, OP_STOP_GRADIENT,
+                                  OP_SOFTMAX, OP_LOG_SOFTMAX):
+            return infer_last_dim(ins[0], _seen)
+        if op in _BINARY:
+            for i in ins:
+                d = infer_last_dim(i, _seen)
+                if d is not None and d != 1:
+                    return d
+            return None
+        if op in (OP_TIMES, OP_TRANSPOSE_TIMES):
+            p_right = (variables.get(ins[1]) is not None
+                       and variables[ins[1]].kind in (VAR_PARAMETER,
+                                                      VAR_CONSTANT)
+                       and not (variables.get(ins[0]) is not None
+                                and variables[ins[0]].kind in
+                                (VAR_PARAMETER, VAR_CONSTANT)))
+            w_uid = ins[1] if p_right else ins[0]
+            wv = variables.get(w_uid)
+            if wv is None or wv.value is None:
+                return None
+            w = np.asarray(wv.value)
+            flip = p_right != (op == OP_TRANSPOSE_TIMES)
+            w = w.T if flip else w
+            return int(w.shape[-1])
+        return None
+
+    def resolvable(tensor: str) -> bool:
+        return tensor in variables or (tensor, False) in outer.names
+
+    root_tensor = f"{root}_Output_0" if root else None
+    pending_fns = [fd for fd in functions if fd["uid"] not in in_group]
+    pending_groups = list(groups)
+    while pending_fns or pending_groups:
+        progress = False
+        for fd in list(pending_fns):
+            if all(resolvable(i) for i in fd.get("inputs", [])):
+                outer.emit(fd)
+                pending_fns.remove(fd)
+                progress = True
+        for grp in list(pending_groups):
+            if _group_ready(grp, fns, producer, variables, outer,
+                            in_group):
+                _emit_scan_group(g, outer, grp, fns, functions, producer,
+                                 consumers, variables, infer_last_dim,
+                                 root_tensor, in_group)
+                pending_groups.remove(grp)
+                progress = True
+        if not progress:
+            raise NotImplementedError(
+                "could not schedule the recurrent graph: a dependency "
+                "cycle crosses recurrence bodies in an unsupported way")
+
+    out_name = outer.names.get((root_tensor, False), outer.last_output)
+    if out_name is None:
+        raise ValueError("model has no computable output")
+    g.add_output(out_name, np.float32, None)
+    return g.to_bytes(producer="synapseml_tpu.dl.cntk_format")
+
+
+def _has_seq_ancestry(tensor: str, fns, producer, variables,
+                      in_group) -> bool:
+    """True when ``tensor`` transitively depends on a model INPUT or on
+    another recurrence's output — i.e. it carries the [N, T] axes. A
+    purely parameter-derived tensor (e.g. a bias combined outside the
+    cycle) does NOT, and scanning it would slice its feature axis as if
+    it were time."""
+    seen: set = set()
+    stack = [tensor]
+    while stack:
+        t = stack.pop()
+        if t in seen:
+            continue
+        seen.add(t)
+        v = variables.get(t)
+        if v is not None:
+            if v.kind == VAR_INPUT:
+                return True
+            continue  # parameter/constant
+        u = producer.get(t)
+        if u is None:
+            continue
+        if u in in_group:
+            return True  # another cycle's scan output: [N, T, ...]
+        stack.extend(fns[u].get("inputs", []))
+    return False
+
+
+def _group_crossing(grp, fns, producer, variables,
+                    in_group) -> Tuple[List[str], List[str]]:
+    """Split tensors consumed inside the cycle but produced outside it
+    into (per-timestep scan inputs, static outer-scope captures).
+    Parameters/constants resolve inside the body; state-node inputs are
+    handled separately. Static tensors (param-derived, no [N, T] axes)
+    ride as outer-scope name captures — ONNX subgraphs see enclosing
+    names, and the importer's body env carries them."""
+    crossing: List[str] = []
+    captured: List[str] = []
+    nodes = grp["nodes"]
+    pv_uids = {pv["uid"] for pv in grp["pvs"]}
+    for fd in (fns[u] for u in nodes):
+        if fd["uid"] in pv_uids:
+            continue
+        for i in fd.get("inputs", []):
+            p = producer.get(i)
+            if p is not None and p in nodes:
+                continue  # internal to the body
+            v = variables.get(i)
+            if v is not None and v.kind in (VAR_PARAMETER, VAR_CONSTANT):
+                continue  # body-local initializer
+            if i in crossing or i in captured:
+                continue
+            if _has_seq_ancestry(i, fns, producer, variables, in_group):
+                crossing.append(i)
+            else:
+                captured.append(i)
+    return crossing, captured
+
+
+def _group_ready(grp, fns, producer, variables, outer, in_group) -> bool:
+    crossing, captured = _group_crossing(grp, fns, producer, variables,
+                                         in_group)
+    return all(t in variables or (t, False) in outer.names
+               for t in crossing + captured)
+
+
+def _emit_scan_group(g, outer, grp, fns, functions, producer, consumers,
+                     variables, infer_last_dim, root_tensor, in_group):
+    """Emit one recurrence cycle as an ONNX Scan node."""
+    pvs = grp["pvs"]
+    nodes = grp["nodes"]
+    pv_ops = {int(pv["op"]) for pv in pvs}
+    if len(pv_ops) > 1:
+        raise NotImplementedError(
+            "a single recurrence cycle mixes PastValue and FutureValue "
+            "(a true bidirectional loop); split the graph or export via "
+            "ONNX")
+    backward = OP_FUTURE_VALUE in pv_ops
+    pv_uids = {pv["uid"] for pv in pvs}
+    body_fns = [fd for fd in functions
+                if fd["uid"] in nodes and fd["uid"] not in pv_uids]
+    crossing, captured = _group_crossing(grp, fns, producer, variables,
+                                         in_group)
+    if not crossing:
+        raise NotImplementedError(
+            "autonomous recurrence (no sequence input feeds the cycle) "
+            "has no scan length; not supported")
+
+    # -- body graph: inputs [states..., x_t slices...] -------------------
+    body_g = GraphBuilder(name=g.fresh("scan_body"), opset=17)
+    body_em = _Emitter(body_g, variables)
+    for k, pv in enumerate(pvs):
+        st = body_g.add_input(f"state_{k}")
+        body_em.alias(f"{pv['uid']}_Output_0", st)
+    for j, t in enumerate(crossing):
+        xt = body_g.add_input(f"xt_{j}")
+        body_em.alias(t, xt)
+    for t in captured:
+        # static (param-derived) outer tensor: reference the OUTER name
+        # from inside the body — ONNX outer-scope capture, which the
+        # importer's body env provides
+        body_em.alias(t, outer.resolve(t) if t in variables
+                      else outer.names[(t, False)])
+    remaining = list(body_fns)
+    while remaining:
+        progress = False
+        for fd in list(remaining):
+            if all((i, False) in body_em.names or i in variables
+                   for i in fd.get("inputs", [])):
+                body_em.emit(fd)
+                remaining.remove(fd)
+                progress = True
+        if not progress:
+            raise NotImplementedError(
+                "unschedulable recurrence body (unexpected internal "
+                "dependency shape)")
+
+    # outputs: next-state per pv, then the tensors consumed downstream
+    for pv in pvs:
+        nm = body_em.names.get((pv["inputs"][0], False))
+        if nm is None:
+            raise NotImplementedError(
+                f"recurrent input {pv['inputs'][0]!r} was not computed "
+                "inside the cycle body")
+        body_g.add_output(body_g.add_node("Identity", [nm]),
+                          np.float32, None)
+    scan_out_tensors: List[str] = []
+    for fd in body_fns + pvs:
+        n_out = len(fd.get("inputs", [])) \
+            if int(fd["op"]) == OP_COMBINE else 1
+        for j in range(n_out):
+            t = f"{fd['uid']}_Output_{j}"
+            used_outside = any(c not in nodes
+                               for c in consumers.get(fd["uid"], []))
+            if (used_outside or t == root_tensor) \
+                    and t not in scan_out_tensors:
+                scan_out_tensors.append(t)
+    for t in scan_out_tensors:
+        nm = body_em.names.get((t, False))
+        if nm is None:
+            raise NotImplementedError(
+                f"cycle tensor {t!r} consumed downstream was not emitted")
+        body_g.add_output(body_g.add_node("Identity", [nm]),
+                          np.float32, None)
+
+    # -- outer: initial states broadcast to [N, H] -----------------------
+    def outer_name(t: str) -> str:
+        return outer.resolve(t) if t in variables \
+            else outer.names[(t, False)]
+
+    first_seq = outer_name(crossing[0])
+    init_names = []
+    for pv in pvs:
+        init_uid = pv["inputs"][1] if len(pv["inputs"]) > 1 else None
+        iv = variables.get(init_uid) if init_uid else None
+        if iv is None or iv.value is None:
+            raise NotImplementedError(
+                "PastValue initial state must be a constant/parameter")
+        arr = np.asarray(iv.value, np.float32)
+        declared = tuple(reversed(iv.shape))  # the DECLARED cntk shape:
+        # scalar values decode as (1,) arrays, so arr.ndim can't tell
+        # a scalar init apart from a genuine width-1 state
+        if not declared:
+            h = infer_last_dim(pv["inputs"][0])
+            if h is None:
+                raise NotImplementedError(
+                    "cannot infer the state width for a scalar "
+                    "initial_state; save the model with a full-shape "
+                    "initial state")
+            feat = np.asarray([h], np.int64)
+            arr = arr.reshape(())  # Expand needs the scalar rank
+        else:
+            feat = np.asarray(list(declared), np.int64)
+        init_c = g.add_initializer(g.fresh("rec_init"), arr)
+        shp = g.add_node("Shape", [first_seq])
+        n0 = g.add_node("Gather", [shp, g.add_initializer(
+            g.fresh("idx0"), np.asarray([0], np.int64))], axis=0)
+        tgt = g.add_node("Concat", [n0, g.add_initializer(
+            g.fresh("rec_shape"), feat)], axis=0)
+        init_names.append(g.add_node("Expand", [init_c, tgt]))
+
+    scan_ins = [outer_name(t) for t in crossing]
+    m, k_out = len(crossing), len(scan_out_tensors)
+    node_outs = [g.fresh("rec_final") for _ in pvs] \
+        + [g.fresh("rec_seq") for _ in scan_out_tensors]
+    d = 1 if backward else 0
+    g.add_node("Scan", init_names + scan_ins, outputs=node_outs,
+               body=body_g.build().graph,
+               num_scan_inputs=m,
+               scan_input_axes=[1] * m,
+               scan_output_axes=[1] * k_out,
+               scan_input_directions=[d] * m,
+               scan_output_directions=[d] * k_out)
+    for t, nm in zip(scan_out_tensors, node_outs[len(pvs):]):
+        outer.alias(t, nm)
+        outer.last_output = nm
 
 
 def sniff_cntk_v2(payload: bytes) -> Optional[Dict[str, Any]]:
@@ -528,6 +953,18 @@ class CntkModelBuilder:
             "attributes": dict(attributes or {}), "name": name,
         })
         return f"{uid}_Output_0"
+
+    def set_input(self, func_output: str, idx: int, new_input: str):
+        """Patch a function's input after the fact — how a recurrence
+        cycle is closed (CNTK builds PastValue against a placeholder and
+        rewires it to the step output; the serialized file stores the
+        cyclic uid reference)."""
+        uid = func_output.rsplit("_Output_", 1)[0]
+        for f in self._funcs:
+            if f["uid"] == uid:
+                f["inputs"][idx] = new_input
+                return
+        raise KeyError(f"no function {uid!r}")
 
     def to_bytes(self, root_output: str) -> bytes:
         root = root_output.rsplit("_Output_", 1)[0]

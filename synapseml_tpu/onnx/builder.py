@@ -61,7 +61,14 @@ class GraphBuilder:
         self._counter += 1
         return f"{prefix}_{self._counter}"
 
-    def add_input(self, name: str, dtype, shape) -> str:
+    def add_input(self, name: str, dtype=None, shape=None) -> str:
+        """``dtype=None`` emits a bare ValueInfo (name only) — the form
+        subgraph bodies use, where types flow in from the outer scope."""
+        if dtype is None:
+            vi = Msg("ValueInfoProto")
+            vi.name = name
+            self._inputs.append(vi)
+            return name
         self._inputs.append(_value_info(name, dtype, shape))
         return name
 

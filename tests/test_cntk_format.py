@@ -21,11 +21,12 @@ from synapseml_tpu.data.table import Table
 from synapseml_tpu.dl.cntk_format import (CntkAxisRef, CntkModelBuilder,
                                           OP_BATCH_NORM, OP_CLIP,
                                           OP_COMBINE, OP_CONVOLUTION,
-                                          OP_DROPOUT, OP_PAST_VALUE,
+                                          OP_DROPOUT, OP_ELEMENT_TIMES,
+                                          OP_FUTURE_VALUE, OP_PAST_VALUE,
                                           OP_PLUS, OP_POOLING,
                                           OP_RELU, OP_RESHAPE, OP_SLICE,
-                                          OP_SOFTMAX, OP_SPLICE, OP_TIMES,
-                                          OP_TRANSPOSE_TIMES,
+                                          OP_SOFTMAX, OP_SPLICE, OP_TANH,
+                                          OP_TIMES, OP_TRANSPOSE_TIMES,
                                           cntk_to_onnx,
                                           load_model_dictionary,
                                           looks_like_cntk_v2, py_to_dict)
@@ -177,17 +178,241 @@ def test_reshape_splice_slice_clip_dropout_combine():
     np.testing.assert_allclose(got, want, atol=1e-6)
 
 
-def test_recurrent_and_unknown_ops_rejected_with_recipe():
-    b = CntkModelBuilder()
-    x = b.add_input((4,))
-    y = b.add_op(OP_PAST_VALUE, [x])
-    with pytest.raises(NotImplementedError, match="ONNX"):
-        cntk_to_onnx(b.to_bytes(y))
+def test_unknown_ops_rejected_with_recipe():
     b2 = CntkModelBuilder()
     x2 = b2.add_input((4,))
     y2 = b2.add_op(999, [x2])
     with pytest.raises(NotImplementedError, match="op code 999"):
         cntk_to_onnx(b2.to_bytes(y2))
+
+
+def _rnn_model(feat=6, hidden=5, seed=0, backward=False,
+               scalar_init=True):
+    """h_t = tanh(x_t @ W + h_{t-1} @ R + b) with a PastValue cycle,
+    exactly as CNTK serializes a Recurrence() layer (the pre-projection
+    W·x is OUTSIDE the cycle, so the lowering must vectorize it over the
+    sequence and scan only the state update). Returns (bytes, W, R, b)."""
+    rng = np.random.default_rng(seed)
+    W = (rng.normal(size=(feat, hidden)) * 0.4).astype(np.float32)
+    R = (rng.normal(size=(hidden, hidden)) * 0.4).astype(np.float32)
+    bias = rng.normal(size=(hidden,)).astype(np.float32) * 0.1
+
+    b = CntkModelBuilder("rnn")
+    x = b.add_input((feat,))
+    wx = b.add_op(OP_TIMES, [x, b.add_parameter(W.T)], {"outputRank": 1})
+    init = b.add_parameter(
+        np.zeros((), np.float32) if scalar_init
+        else np.zeros((hidden,), np.float32))
+    op_state = OP_FUTURE_VALUE if backward else OP_PAST_VALUE
+    pv = b.add_op(op_state, ["__patched__", init], {"offset": 1})
+    rh = b.add_op(OP_TIMES, [pv, b.add_parameter(R.T)], {"outputRank": 1})
+    s = b.add_op(OP_PLUS, [wx, rh])
+    s = b.add_op(OP_PLUS, [s, b.add_parameter(bias)])
+    h = b.add_op(OP_TANH, [s])
+    b.set_input(pv, 0, h)
+    return b.to_bytes(h), W, R, bias
+
+
+def _rnn_reference(x, W, R, bias, backward=False):
+    n, t, _ = x.shape
+    h = np.zeros((n, W.shape[1]), np.float32)
+    out = np.zeros((n, t, W.shape[1]), np.float32)
+    steps = range(t - 1, -1, -1) if backward else range(t)
+    for i in steps:
+        h = np.tanh(x[:, i] @ W + h @ R + bias)
+        out[:, i] = h
+    return out
+
+
+def test_past_value_recurrence_matches_numpy():
+    """The recurrent reader's core case: a PastValue cycle lowers to one
+    ONNX Scan (-> lax.scan) and matches the per-timestep numpy loop.
+    Scalar initial_state exercises the state-width inference."""
+    blob, W, R, bias = _rnn_model()
+    gi = import_model(cntk_to_onnx(blob))
+    x = np.random.default_rng(1).normal(size=(3, 7, 6)).astype(np.float32)
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    np.testing.assert_allclose(got, _rnn_reference(x, W, R, bias),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_future_value_runs_backward():
+    blob, W, R, bias = _rnn_model(seed=3, backward=True,
+                                  scalar_init=False)
+    gi = import_model(cntk_to_onnx(blob))
+    x = np.random.default_rng(2).normal(size=(2, 5, 6)).astype(np.float32)
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    np.testing.assert_allclose(
+        got, _rnn_reference(x, W, R, bias, backward=True),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_two_state_cycle_shares_one_scan_body():
+    """Two PastValues whose cycles are mutually dependent (the LSTM h/c
+    shape): both states must ride ONE Scan body.
+    c_t = 0.5*c_{t-1} + x_t@W + 0.3*h_{t-1}; h_t = tanh(c_t)."""
+    feat, hidden = 4, 4
+    rng = np.random.default_rng(5)
+    W = (rng.normal(size=(feat, hidden)) * 0.5).astype(np.float32)
+
+    b = CntkModelBuilder("two_state")
+    x = b.add_input((feat,))
+    wx = b.add_op(OP_TIMES, [x, b.add_parameter(W.T)], {"outputRank": 1})
+    half = b.add_parameter(np.float32(0.5).reshape(()))
+    point3 = b.add_parameter(np.float32(0.3).reshape(()))
+    zero = b.add_parameter(np.zeros((hidden,), np.float32))
+    pv_c = b.add_op(OP_PAST_VALUE, ["__c__", zero], {"offset": 1})
+    pv_h = b.add_op(OP_PAST_VALUE, ["__h__", zero], {"offset": 1})
+    c_decay = b.add_op(OP_ELEMENT_TIMES, [half, pv_c])
+    h_decay = b.add_op(OP_ELEMENT_TIMES, [point3, pv_h])
+    c = b.add_op(OP_PLUS, [c_decay, wx])
+    c = b.add_op(OP_PLUS, [c, h_decay])
+    h = b.add_op(OP_TANH, [c])
+    b.set_input(pv_c, 0, c)
+    b.set_input(pv_h, 0, h)
+    blob = b.to_bytes(h)
+
+    onnx_bytes = cntk_to_onnx(blob)
+    # exactly one Scan node: overlapping cycles merged into one body
+    model = proto.load_model(onnx_bytes)
+    scans = [n for n in model.graph.node if n.op_type == "Scan"]
+    assert len(scans) == 1
+
+    gi = import_model(onnx_bytes)
+    x_np = np.random.default_rng(6).normal(size=(2, 6, feat)) \
+        .astype(np.float32)
+    got = np.asarray(gi.apply(gi.params, x_np)[0])
+    cc = np.zeros((2, hidden), np.float32)
+    hh = np.zeros((2, hidden), np.float32)
+    want = np.zeros((2, 6, hidden), np.float32)
+    for i in range(6):
+        cc = 0.5 * cc + x_np[:, i] @ W + 0.3 * hh
+        hh = np.tanh(cc)
+        want[:, i] = hh
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_stacked_recurrences_emit_two_scans():
+    """Layer 2 consumes layer 1's scan-output sequence: two disjoint
+    cycles -> two Scan nodes wired in sequence."""
+    feat = 4
+    rng = np.random.default_rng(7)
+    W1 = (rng.normal(size=(feat, feat)) * 0.4).astype(np.float32)
+    W2 = (rng.normal(size=(feat, feat)) * 0.4).astype(np.float32)
+
+    b = CntkModelBuilder("stacked")
+    x = b.add_input((feat,))
+    zero = b.add_parameter(np.zeros((feat,), np.float32))
+
+    wx1 = b.add_op(OP_TIMES, [x, b.add_parameter(W1.T)],
+                   {"outputRank": 1})
+    pv1 = b.add_op(OP_PAST_VALUE, ["__1__", zero], {"offset": 1})
+    s1 = b.add_op(OP_PLUS, [wx1, pv1])
+    h1 = b.add_op(OP_TANH, [s1])
+    b.set_input(pv1, 0, h1)
+
+    wx2 = b.add_op(OP_TIMES, [h1, b.add_parameter(W2.T)],
+                   {"outputRank": 1})
+    pv2 = b.add_op(OP_PAST_VALUE, ["__2__", zero], {"offset": 1})
+    s2 = b.add_op(OP_PLUS, [wx2, pv2])
+    h2 = b.add_op(OP_TANH, [s2])
+    b.set_input(pv2, 0, h2)
+    blob = b.to_bytes(h2)
+
+    onnx_bytes = cntk_to_onnx(blob)
+    model = proto.load_model(onnx_bytes)
+    assert len([n for n in model.graph.node if n.op_type == "Scan"]) == 2
+
+    gi = import_model(onnx_bytes)
+    x_np = np.random.default_rng(8).normal(size=(2, 5, feat)) \
+        .astype(np.float32)
+    got = np.asarray(gi.apply(gi.params, x_np)[0])
+    h1v = np.zeros((2, feat), np.float32)
+    h2v = np.zeros((2, feat), np.float32)
+    want = np.zeros((2, 5, feat), np.float32)
+    for i in range(5):
+        h1v = np.tanh(x_np[:, i] @ W1 + h1v)
+        h2v = np.tanh(h1v @ W2 + h2v)
+        want[:, i] = h2v
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_param_derived_tensor_crossing_cycle_is_captured_not_scanned():
+    """A tensor computed OUTSIDE the cycle from parameters only (no
+    [N, T] axes) must ride into the body as an outer-scope capture —
+    scanning it would slice its feature axis as if it were time
+    (round-4 review repro: silent numeric corruption)."""
+    feat, hidden = 3, 4
+    rng = np.random.default_rng(9)
+    W = (rng.normal(size=(feat, hidden)) * 0.4).astype(np.float32)
+    b1 = rng.normal(size=(hidden,)).astype(np.float32) * 0.1
+    b2 = rng.normal(size=(hidden,)).astype(np.float32) * 0.1
+
+    b = CntkModelBuilder("captured_bias")
+    x = b.add_input((feat,))
+    wx = b.add_op(OP_TIMES, [x, b.add_parameter(W.T)], {"outputRank": 1})
+    # bias assembled OUTSIDE the cycle from two params: param-derived,
+    # not per-timestep
+    bias = b.add_op(OP_PLUS, [b.add_parameter(b1), b.add_parameter(b2)])
+    zero = b.add_parameter(np.zeros((hidden,), np.float32))
+    pv = b.add_op(OP_PAST_VALUE, ["__h__", zero], {"offset": 1})
+    s = b.add_op(OP_PLUS, [wx, pv])
+    s = b.add_op(OP_PLUS, [s, bias])
+    h = b.add_op(OP_TANH, [s])
+    b.set_input(pv, 0, h)
+    gi = import_model(cntk_to_onnx(b.to_bytes(h)))
+    x_np = np.random.default_rng(10).normal(size=(2, 5, feat)) \
+        .astype(np.float32)
+    hh = np.zeros((2, hidden), np.float32)
+    want = np.zeros((2, 5, hidden), np.float32)
+    for i in range(5):
+        hh = np.tanh(x_np[:, i] @ W + hh + (b1 + b2))
+        want[:, i] = hh
+    got = np.asarray(gi.apply(gi.params, x_np)[0])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_scalar_init_with_state_as_first_operand():
+    """Width inference for a scalar initial_state must survive the walk
+    re-entering the cycle (state as FIRST Plus operand previously
+    recursed forever — round-4 review repro)."""
+    feat, hidden = 3, 5
+    rng = np.random.default_rng(12)
+    W = (rng.normal(size=(feat, hidden)) * 0.4).astype(np.float32)
+
+    b = CntkModelBuilder("swapped")
+    x = b.add_input((feat,))
+    wx = b.add_op(OP_TIMES, [x, b.add_parameter(W.T)], {"outputRank": 1})
+    init = b.add_parameter(np.zeros((), np.float32))  # scalar
+    pv = b.add_op(OP_PAST_VALUE, ["__h__", init], {"offset": 1})
+    s = b.add_op(OP_PLUS, [pv, wx])  # state FIRST
+    h = b.add_op(OP_TANH, [s])
+    b.set_input(pv, 0, h)
+    gi = import_model(cntk_to_onnx(b.to_bytes(h)))
+    x_np = np.random.default_rng(13).normal(size=(2, 4, feat)) \
+        .astype(np.float32)
+    hh = np.zeros((2, hidden), np.float32)
+    want = np.zeros((2, 4, hidden), np.float32)
+    for i in range(4):
+        hh = np.tanh(hh + x_np[:, i] @ W)
+        want[:, i] = hh
+    got = np.asarray(gi.apply(gi.params, x_np)[0])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_committed_recurrent_fixture_loads_and_matches():
+    """The committed recurrent .model bytes (tools/make_cntk_recurrent_
+    fixture.py) load through the binary reader and match the frozen
+    expected outputs — the recurrent analogue of the torch ONNX
+    fixtures."""
+    import os
+
+    fx = os.path.join(os.path.dirname(__file__), "fixtures",
+                      "cntk_rnn.model")
+    io = np.load(fx.replace(".model", "_io.npz"))
+    gi = import_model(cntk_to_onnx(open(fx, "rb").read()))
+    got = np.asarray(gi.apply(gi.params, io["input"])[0])
+    np.testing.assert_allclose(got, io["expected"], rtol=2e-5, atol=2e-5)
 
 
 def test_cntk_model_transformer_consumes_raw_model_bytes():

@@ -1,0 +1,89 @@
+"""Generate the committed recurrent CNTK fixture (tests/fixtures/).
+
+A bidirectional RNN tagger-shape model: forward PastValue recurrence and
+backward FutureValue recurrence over the same projected input, spliced
+on the feature axis, with a linear head — the smallest graph exercising
+the whole recurrent-reader surface (two independent cycles, both
+directions, downstream consumption of scan outputs). The bytes are
+committed together with frozen expected outputs so the reader is tested
+against artifacts it did not just write in-process (the torch-ONNX
+fixture pattern; the reference executes such models natively via
+Function.load — deep-learning/.../cntk/SerializableFunction.scala:85-143).
+
+Run from the repo root:  python tools/make_cntk_recurrent_fixture.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from synapseml_tpu.dl.cntk_format import (  # noqa: E402
+    CntkModelBuilder, OP_FUTURE_VALUE, OP_PAST_VALUE, OP_PLUS, OP_SPLICE,
+    OP_TANH, OP_TIMES)
+
+FEAT, HIDDEN, OUT = 5, 4, 3
+
+
+def build(seed=11):
+    rng = np.random.default_rng(seed)
+    Wf = (rng.normal(size=(FEAT, HIDDEN)) * 0.4).astype(np.float32)
+    Wb = (rng.normal(size=(FEAT, HIDDEN)) * 0.4).astype(np.float32)
+    Wo = (rng.normal(size=(2 * HIDDEN, OUT)) * 0.4).astype(np.float32)
+    bias = (rng.normal(size=(OUT,)) * 0.1).astype(np.float32)
+
+    b = CntkModelBuilder("birnn")
+    x = b.add_input((FEAT,))
+    zero = b.add_parameter(np.zeros((), np.float32))
+
+    wxf = b.add_op(OP_TIMES, [x, b.add_parameter(Wf.T)], {"outputRank": 1})
+    pvf = b.add_op(OP_PAST_VALUE, ["__f__", zero], {"offset": 1})
+    hf = b.add_op(OP_TANH, [b.add_op(OP_PLUS, [wxf, pvf])])
+    b.set_input(pvf, 0, hf)
+
+    wxb = b.add_op(OP_TIMES, [x, b.add_parameter(Wb.T)], {"outputRank": 1})
+    fvb = b.add_op(OP_FUTURE_VALUE, ["__b__", zero], {"offset": 1})
+    hb = b.add_op(OP_TANH, [b.add_op(OP_PLUS, [wxb, fvb])])
+    b.set_input(fvb, 0, hb)
+
+    both = b.add_op(OP_SPLICE, [hf, hb], {"axis": 0})  # feature axis
+    y = b.add_op(OP_TIMES, [both, b.add_parameter(Wo.T)],
+                 {"outputRank": 1})
+    y = b.add_op(OP_PLUS, [y, b.add_parameter(bias)])
+    return b.to_bytes(y), (Wf, Wb, Wo, bias)
+
+
+def reference(x, Wf, Wb, Wo, bias):
+    n, t, _ = x.shape
+    hf = np.zeros((n, HIDDEN), np.float32)
+    hb = np.zeros((n, HIDDEN), np.float32)
+    outf = np.zeros((n, t, HIDDEN), np.float32)
+    outb = np.zeros((n, t, HIDDEN), np.float32)
+    for i in range(t):
+        hf = np.tanh(x[:, i] @ Wf + hf)
+        outf[:, i] = hf
+    for i in range(t - 1, -1, -1):
+        hb = np.tanh(x[:, i] @ Wb + hb)
+        outb[:, i] = hb
+    return np.concatenate([outf, outb], axis=-1) @ Wo + bias
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    fixtures = os.path.join(os.path.dirname(here), "tests", "fixtures")
+    os.makedirs(fixtures, exist_ok=True)
+    blob, (Wf, Wb, Wo, bias) = build()
+    x = np.random.default_rng(21).normal(size=(2, 6, FEAT)) \
+        .astype(np.float32)
+    expected = reference(x, Wf, Wb, Wo, bias).astype(np.float32)
+    with open(os.path.join(fixtures, "cntk_rnn.model"), "wb") as fh:
+        fh.write(blob)
+    np.savez(os.path.join(fixtures, "cntk_rnn_io.npz"),
+             input=x, expected=expected)
+    print(f"wrote cntk_rnn.model ({len(blob)} bytes) + io.npz "
+          f"expected shape {expected.shape}")
+
+
+if __name__ == "__main__":
+    main()
