@@ -563,12 +563,14 @@ def _recurrent_to_onnx(g: GraphBuilder, variables: Dict[str, _Var],
                 "PastValue/FutureValue with offset != 1 is not supported")
 
     # one group per recurrence cycle; overlapping cycles merge (LSTM's
-    # h and c share a body)
+    # h and c share a body). ``order`` fixes the serialization order so
+    # set iteration can never leak into the emitted bytes.
+    order = {fd["uid"]: i for i, fd in enumerate(functions)}
     groups: List[Dict[str, Any]] = []
     for pv in pvs:
         cyc = descendants_of(pv["uid"]) & ancestors_of(pv["inputs"][0])
         cyc.add(pv["uid"])
-        groups.append({"nodes": cyc, "pvs": [pv]})
+        groups.append({"nodes": cyc, "pvs": [pv], "order": order})
     merged = True
     while merged:
         merged = False
@@ -727,7 +729,10 @@ def _group_crossing(grp, fns, producer, variables,
     captured: List[str] = []
     nodes = grp["nodes"]
     pv_uids = {pv["uid"] for pv in grp["pvs"]}
-    for fd in (fns[u] for u in nodes):
+    # deterministic order (serialization order, not set order): scan-input
+    # ordering decides the emitted bytes and the Shape source tensor
+    ordered = sorted(nodes, key=grp["order"].__getitem__)
+    for fd in (fns[u] for u in ordered):
         if fd["uid"] in pv_uids:
             continue
         for i in fd.get("inputs", []):
@@ -776,7 +781,11 @@ def _emit_scan_group(g, outer, grp, fns, functions, producer, consumers,
             "has no scan length; not supported")
 
     # -- body graph: inputs [states..., x_t slices...] -------------------
-    body_g = GraphBuilder(name=g.fresh("scan_body"), opset=17)
+    body_name = g.fresh("scan_body")
+    # prefix namespaces body tensor names: a bare body-local name (e.g.
+    # 'add_3') could shadow an identically-named captured outer tensor
+    body_g = GraphBuilder(name=body_name, opset=17,
+                          name_prefix=f"{body_name}__")
     body_em = _Emitter(body_g, variables)
     for k, pv in enumerate(pvs):
         st = body_g.add_input(f"state_{k}")

@@ -47,9 +47,15 @@ def _value_info(name: str, dtype, shape: Sequence[Optional[Union[int, str]]]) ->
 class GraphBuilder:
     """Accumulates nodes/initializers and emits a ModelProto."""
 
-    def __init__(self, name: str = "graph", opset: int = 17):
+    def __init__(self, name: str = "graph", opset: int = 17,
+                 name_prefix: str = ""):
+        """``name_prefix`` namespaces every ``fresh`` tensor name —
+        REQUIRED for subgraph bodies, whose names would otherwise collide
+        with outer-scope tensors they capture (ONNX name resolution is
+        lexical: a body-local name shadows the outer one)."""
         self.name = name
         self.opset = opset
+        self.name_prefix = name_prefix
         self._nodes: List[Msg] = []
         self._initializers: List[Msg] = []
         self._inputs: List[Msg] = []
@@ -59,7 +65,7 @@ class GraphBuilder:
 
     def fresh(self, prefix: str = "t") -> str:
         self._counter += 1
-        return f"{prefix}_{self._counter}"
+        return f"{self.name_prefix}{prefix}_{self._counter}"
 
     def add_input(self, name: str, dtype=None, shape=None) -> str:
         """``dtype=None`` emits a bare ValueInfo (name only) — the form

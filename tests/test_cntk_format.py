@@ -372,6 +372,33 @@ def test_param_derived_tensor_crossing_cycle_is_captured_not_scanned():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+def test_body_names_cannot_shadow_captured_outer_tensors():
+    """The Scan body's generated tensor names are namespaced: with the
+    bias built FIRST (outer node name counters aligned with the body's),
+    an un-prefixed body 'add_N' would shadow the captured outer bias and
+    silently compute garbage (round-4 review repro: tanh(20)≈1 came back
+    0.0)."""
+    feat, hidden = 2, 3
+    b = CntkModelBuilder("shadow")
+    bias = b.add_op(OP_PLUS, [
+        b.add_parameter(np.full((hidden,), 10.0, np.float32)),
+        b.add_parameter(np.full((hidden,), 10.0, np.float32))])
+    x = b.add_input((feat,))
+    W = np.zeros((feat, hidden), np.float32)
+    wx = b.add_op(OP_TIMES, [x, b.add_parameter(W.T)], {"outputRank": 1})
+    zero = b.add_parameter(np.zeros((hidden,), np.float32))
+    pv = b.add_op(OP_PAST_VALUE, ["__h__", zero], {"offset": 1})
+    s = b.add_op(OP_PLUS, [wx, pv])
+    s = b.add_op(OP_PLUS, [s, bias])
+    h = b.add_op(OP_TANH, [s])
+    b.set_input(pv, 0, h)
+    gi = import_model(cntk_to_onnx(b.to_bytes(h)))
+    x_np = np.zeros((1, 2, feat), np.float32)
+    got = np.asarray(gi.apply(gi.params, x_np)[0])
+    # x=0, W=0: h_1 = tanh(0 + 0 + 20) ~= 1.0 everywhere
+    np.testing.assert_allclose(got[:, 0], np.tanh(20.0), rtol=1e-5)
+
+
 def test_scalar_init_with_state_as_first_operand():
     """Width inference for a scalar initial_state must survive the walk
     re-entering the cycle (state as FIRST Plus operand previously

@@ -1,0 +1,84 @@
+"""Generate real lib_lightgbm ground-truth fixtures (run OFFLINE).
+
+This image does not ship the ``lightgbm`` wheel, so the fixtures cannot
+be generated here — run this script in any environment with
+``pip install lightgbm`` and commit the outputs to ``tests/fixtures/``:
+
+    lightgbm_binary.txt / lightgbm_binary_pred.npz
+    lightgbm_multiclass.txt / lightgbm_multiclass_pred.npz
+    lightgbm_categorical.txt / lightgbm_categorical_pred.npz
+
+Each ``.txt`` is the model string lib_lightgbm itself wrote
+(``booster.model_to_string()``), each ``.npz`` holds the frozen input
+matrix and lib_lightgbm's own predictions on it.
+``tests/test_lightgbm_groundtruth.py`` then parity-tests
+``Booster.load_string`` predictions against LightGBM's — replacing the
+"sklearn agrees" cross-check with "LightGBM itself agrees" (the
+reference gates against real LightGBM outputs:
+lightgbm/src/test/resources/benchmarks/benchmarks_VerifyLightGBMClassifier.csv).
+
+Data is generated from fixed seeds so fixture regeneration is
+reproducible bit-for-bit given the same lightgbm version (record the
+version in the commit message).
+"""
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(os.path.dirname(HERE), "tests", "fixtures")
+
+
+def _data(seed, n=800, d=8, n_classes=2, categorical=False):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d))
+    if categorical:
+        x[:, 0] = rng.integers(0, 6, n)          # categorical slot
+        x[rng.random(n) < 0.1, 3] = np.nan       # NaN missing
+    logits = x[:, 1] + 0.5 * np.sin(2 * np.nan_to_num(x[:, 3])) * x[:, 2]
+    if categorical:
+        logits = logits + (np.nan_to_num(x[:, 0]) % 2) * 1.5
+    if n_classes == 2:
+        y = (logits + rng.normal(scale=0.3, size=n) > 0).astype(int)
+    else:
+        q = np.quantile(logits, np.linspace(0, 1, n_classes + 1)[1:-1])
+        y = np.digitize(logits, q)
+    return x, y
+
+
+def main():
+    import lightgbm as lgb
+
+    os.makedirs(FIXTURES, exist_ok=True)
+    cases = [
+        ("binary", dict(objective="binary"), 2, False),
+        ("multiclass", dict(objective="multiclass", num_class=3), 3, False),
+        ("categorical", dict(objective="binary"), 2, True),
+    ]
+    for name, params, k, cat in cases:
+        x, y = _data(seed=hash(name) % 2**31, n_classes=k,
+                     categorical=cat)
+        params = dict(params, num_leaves=15, learning_rate=0.1,
+                      deterministic=True, force_row_wise=True, seed=7,
+                      verbosity=-1)
+        ds = lgb.Dataset(
+            x, label=y,
+            categorical_feature=[0] if cat else "auto",
+            params={"verbosity": -1})
+        booster = lgb.train(params, ds, num_boost_round=25)
+        xq = _data(seed=12345, n=64, n_classes=k, categorical=cat)[0]
+        pred = booster.predict(xq)
+        raw = booster.predict(xq, raw_score=True)
+        with open(os.path.join(FIXTURES, f"lightgbm_{name}.txt"),
+                  "w") as fh:
+            fh.write(booster.model_to_string())
+        np.savez(os.path.join(FIXTURES, f"lightgbm_{name}_pred.npz"),
+                 input=xq, pred=pred, raw=raw,
+                 lgb_version=np.bytes_(lgb.__version__))
+        print(f"wrote lightgbm_{name}.txt + pred.npz "
+              f"(lightgbm {lgb.__version__})")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
