@@ -66,6 +66,25 @@ def main():
     out = np.asarray(g.apply(g.params, tokens.astype(np.int64))[0])
     assert out.shape == (b, 16, 4)
     print("BiLSTM ONNX graph scored:", out.shape)
+
+    # and the reference's native path: a recurrent CNTK v2 binary .model
+    # (bidirectional PastValue/FutureValue cycles -> ONNX Scan ->
+    # lax.scan) scored through CNTKModel, matching its frozen outputs
+    import os
+
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.dl.cntk import CNTKModel
+
+    fx = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "fixtures", "cntk_rnn.model")
+    io = np.load(fx.replace(".model", "_io.npz"))
+    cm = CNTKModel(model_path=fx)
+    md = cm.model_metadata()
+    cm.set(feed_dict={list(md["inputs"])[0]: "x"},
+           fetch_dict={"y": md["outputs"][0]})
+    got = np.asarray(cm.transform(Table({"x": io["input"]}))["y"])
+    np.testing.assert_allclose(got, io["expected"], rtol=2e-5, atol=2e-5)
+    print("recurrent CNTK .model scored:", got.shape)
     print("E2E bilstm_entity_extraction: PASS")
 
 

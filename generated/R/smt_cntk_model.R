@@ -7,12 +7,13 @@
 #' @param cut_layers trailing graph nodes dropped (headless featurization; persists across serde)
 #' @param feed_dict graph input name -> input column
 #' @param fetch_dict output column -> graph output name
+#' @param input_norm graph input name -> {'mean':..., 'scale':...} applied ON DEVICE after casting an integer feed to the compute dtype: the wire carries uint8 pixels (1 byte/px vs 2 for bf16) and the fused (x - mean) * scale runs where bandwidth is free
 #' @param mini_batch_size max rows per device batch
 #' @param model_payload raw .onnx protobuf bytes
 #' @param softmax_output_col column for softmax of first output
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_cntk_model <- function(argmax_output_col = NULL, compute_dtype = "float32", cut_layers = 0, feed_dict = NULL, fetch_dict = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
+smt_cntk_model <- function(argmax_output_col = NULL, compute_dtype = "float32", cut_layers = 0, feed_dict = NULL, fetch_dict = NULL, input_norm = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.dl.cntk")
   kwargs <- Filter(Negate(is.null), list(
     argmax_output_col = argmax_output_col,
@@ -20,6 +21,7 @@ smt_cntk_model <- function(argmax_output_col = NULL, compute_dtype = "float32", 
     cut_layers = cut_layers,
     feed_dict = feed_dict,
     fetch_dict = fetch_dict,
+    input_norm = input_norm,
     mini_batch_size = mini_batch_size,
     model_payload = model_payload,
     softmax_output_col = softmax_output_col

@@ -8,6 +8,7 @@
 #' @param feature_cols explicit list of scalar feature columns
 #' @param feature_fraction feature subsample per tree
 #' @param features_col features column (2-D) or None to use feature_cols
+#' @param hist_backend histogram formulation: auto (measured probe) / pallas / xla
 #' @param label_col label column
 #' @param lambda_l1 L1 regularization
 #' @param lambda_l2 L2 regularization
@@ -30,7 +31,7 @@
 #' @param weight_col sample weight column
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_light_gbm_regression_model <- function(bagging_fraction = 1.0, bagging_freq = 0, boosting_type = "gbdt", categorical_slot_indexes = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_iterations = 100, num_leaves = 31, other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
+smt_light_gbm_regression_model <- function(bagging_fraction = 1.0, bagging_freq = 0, boosting_type = "gbdt", categorical_slot_indexes = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", hist_backend = "auto", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_iterations = 100, num_leaves = 31, other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.gbdt.estimators")
   kwargs <- Filter(Negate(is.null), list(
     bagging_fraction = bagging_fraction,
@@ -41,6 +42,7 @@ smt_light_gbm_regression_model <- function(bagging_fraction = 1.0, bagging_freq 
     feature_cols = feature_cols,
     feature_fraction = feature_fraction,
     features_col = features_col,
+    hist_backend = hist_backend,
     label_col = label_col,
     lambda_l1 = lambda_l1,
     lambda_l2 = lambda_l2,

@@ -94,7 +94,7 @@ class _LightGBMParams:
     seed = Param("random seed", default=0)
     verbosity = Param("verbosity", default=-1)
     hist_backend = Param(
-        "histogram formulation: auto (measured probe) | pallas | xla",
+        "histogram formulation: auto (measured probe) / pallas / xla",
         default="auto",
         type_check=lambda v: v in ("auto", "pallas", "xla"))
 

@@ -127,7 +127,7 @@ def _route_cache_path():
 
 
 def resolve_hist_backend(n: int, f: int, n_bins: int,
-                         iters: int = 8) -> str:
+                         iters: Optional[int] = None) -> str:
     """Measure which histogram formulation wins *in context* for this
     shape and return "pallas" or "xla".
 
@@ -138,7 +138,13 @@ def resolve_hist_backend(n: int, f: int, n_bins: int,
     formulation competes for HBM bandwidth with the mask/gradient traffic
     around it. Results are cached per (device kind, n-bucket, f, n_bins)
     in-process and persisted to ``~/.cache/synapseml_tpu`` so one probe
-    cost (~seconds, paid at first fit) covers all later runs.
+    cost (~10 s, paid at the first fit ever) covers all later runs.
+
+    ``iters`` must put SECONDS of compute inside each timed call: on the
+    tunneled chip one dispatch round trip costs 100-200 ms with ~2x
+    jitter, so a short probe measures the tunnel, not the formulations
+    (round-4's bench caught exactly that: an 8-iter probe routed to the
+    formulation that loses the full training loop by 2x).
     """
     import json
     import os
@@ -151,13 +157,18 @@ def resolve_hist_backend(n: int, f: int, n_bins: int,
         return "xla"
     n_probe = int(min(max(n, 512), 65536))
     n_bucket = 1 << (n_probe - 1).bit_length()
+    if iters is None:
+        # ~25M row-visits per timed call: seconds of compute, so the
+        # winner comes from sustained HBM behavior, not dispatch jitter
+        iters = max(64, 25_000_000 // n_bucket)
     kind = jax.devices()[0].device_kind
     # versioned key: a jaxlib OR in-package kernel upgrade can flip the
     # winner, and a stale persisted verdict would be the "remembered
     # experiment" failure mode this router exists to eliminate
+    # (v2: v1 verdicts came from the RTT-dominated 8-iter probe)
     import synapseml_tpu as _pkg
     pkg_v = getattr(_pkg, "__version__", "0")
-    key = (f"v1|jax{jax.__version__}|pkg{pkg_v}|{kind}|"
+    key = (f"v2|jax{jax.__version__}|pkg{pkg_v}|{kind}|"
            f"{n_bucket}|{f}|{n_bins}")
     got = _HIST_ROUTE_CACHE.get(key)
     if got is not None:

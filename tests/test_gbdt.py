@@ -687,7 +687,7 @@ def test_hist_route_probe_and_disk_cache(tmp_path, monkeypatch):
 
     monkeypatch.setattr(grower, "histogram", fake_hist)
     grower._HIST_ROUTE_CACHE.clear()
-    got = grower.resolve_hist_backend(4096, 6, 64)
+    got = grower.resolve_hist_backend(4096, 6, 64, iters=8)
     assert got in ("pallas", "xla")
     assert "pallas" in calls and "xla" in calls  # both legs timed
     cache_file = tmp_path / "hist_routing.json"
@@ -697,7 +697,7 @@ def test_hist_route_probe_and_disk_cache(tmp_path, monkeypatch):
     # fresh "process": disk answers, no probe runs
     grower._HIST_ROUTE_CACHE.clear()
     calls.clear()
-    assert grower.resolve_hist_backend(4096, 6, 64) == got
+    assert grower.resolve_hist_backend(4096, 6, 64, iters=8) == got
     assert calls == []
 
     # probe failure: xla fallback, nothing new persisted
@@ -707,6 +707,6 @@ def test_hist_route_probe_and_disk_cache(tmp_path, monkeypatch):
     monkeypatch.setattr(grower, "histogram", boom)
     grower._HIST_ROUTE_CACHE.clear()
     cache_file.unlink()
-    assert grower.resolve_hist_backend(4096, 6, 64) == "xla"
+    assert grower.resolve_hist_backend(4096, 6, 64, iters=8) == "xla"
     assert not cache_file.exists()
     grower._HIST_ROUTE_CACHE.clear()

@@ -57,8 +57,9 @@ def main():
         ("categorical", dict(objective="binary"), 2, True),
     ]
     for name, params, k, cat in cases:
-        x, y = _data(seed=hash(name) % 2**31, n_classes=k,
-                     categorical=cat)
+        import zlib  # stable digest: hash() is salted per process
+        x, y = _data(seed=zlib.crc32(name.encode()) % 2**31,
+                     n_classes=k, categorical=cat)
         params = dict(params, num_leaves=15, learning_rate=0.1,
                       deterministic=True, force_row_wise=True, seed=7,
                       verbosity=-1)
