@@ -27,8 +27,9 @@ Splice, Slice, TransposeAxes, ReduceElements, Clip, Dropout/NoOp
 passthrough, Combine) plus RECURRENT graphs: PastValue/FutureValue
 cycles lower to ONNX Scan -> ``lax.scan`` with everything outside the
 cycle vectorized over the sequence (see :func:`_recurrent_to_onnx`;
-bidirectional = two cycles = two Scans). OptimizedRNNStack (the fused
-cuDNN op) still raises with the ONNX-export recipe.
+bidirectional = two cycles = two Scans), and OptimizedRNNStack (the
+fused cuDNN op GPU-trained models carry) unpacks its packed weight blob
+into standard ONNX LSTM/GRU/RNN nodes (:func:`_emit_optimized_rnn`).
 """
 from __future__ import annotations
 
@@ -241,6 +242,7 @@ OP_LESS_EQUAL, OP_GREATER, OP_GREATER_EQUAL = 26, 27, 28
 OP_TIMES, OP_TRANSPOSE_TIMES, OP_CONVOLUTION = 32, 33, 34
 OP_PAST_VALUE, OP_FUTURE_VALUE, OP_REDUCE_ELEMENTS = 38, 39, 40
 OP_BATCH_NORM, OP_CLIP, OP_SELECT, OP_SPLICE, OP_COMBINE = 41, 42, 43, 44, 45
+OP_OPTIMIZED_RNN = 50
 OP_LOG_SOFTMAX, OP_NO_OP, OP_STOP_GRADIENT, OP_ELU = 52, 56, 58, 59
 
 _UNARY = {
@@ -444,6 +446,8 @@ class _Emitter:
                                     resolve(ins[2])])
         elif op in (OP_DROPOUT, OP_NO_OP, OP_STOP_GRADIENT):
             y = g.add_node("Identity", [resolve(ins[0])])
+        elif op == OP_OPTIMIZED_RNN:
+            y = _emit_optimized_rnn(self, ins, attrs)
         elif op == OP_COMBINE:
             for j, i_uid in enumerate(ins):
                 names[(f"{uid}_Output_{j}", False)] = resolve(i_uid)
@@ -463,6 +467,115 @@ class _Emitter:
         return y
 
 
+def _emit_optimized_rnn(em: "_Emitter", ins: List[str],
+                        attrs: Dict[str, Any]) -> str:
+    """OptimizedRNNStack: the fused cuDNN RNN op GPU-trained CNTK models
+    carry (the zoo BiLSTM family). The single packed weight Parameter is
+    unpacked per the cuDNN canonical layout — all gate matrices for every
+    pseudo-layer (layer-major, direction-minor; W blocks then R blocks,
+    gate order i,f,c,o for LSTM / r,u,c for GRU), followed by all bias
+    vectors (bW then bR per pseudo-layer) — and re-emitted as standard
+    ONNX LSTM/GRU/RNN nodes per layer, which the importer lowers to
+    ``lax.scan`` (gate reorder to ONNX's i,o,f,c / z,r,h; cuDNN's
+    recurrent-side GRU reset placement maps to linear_before_reset=1).
+    The blob size must factor exactly as that layout demands — a
+    mismatch raises rather than mis-slicing weights.
+    """
+    g = em.g
+    if em.is_param(ins[0]) and not em.is_param(ins[1]):
+        w_uid, x_uid = ins[0], ins[1]
+    else:
+        x_uid, w_uid = ins[0], ins[1]
+    wv = em.variables.get(w_uid)
+    if wv is None or wv.value is None:
+        raise NotImplementedError(
+            "OptimizedRNNStack needs its weights as a stored Parameter")
+    blob = np.asarray(wv.value, np.float32).reshape(-1)
+    H = int(attrs.get("hiddenSize", 0))
+    L = int(attrs.get("numLayers", 1))
+    bidir = bool(attrs.get("bidirectional", False))
+    rec_op = str(attrs.get("recurrentOp", "lstm"))
+    dirs = 2 if bidir else 1
+    G = {"lstm": 4, "gru": 3, "rnnTanh": 1, "rnnReLU": 1}.get(rec_op)
+    if G is None:
+        raise NotImplementedError(
+            f"OptimizedRNNStack recurrentOp {rec_op!r}")
+    if H <= 0:
+        raise ValueError("OptimizedRNNStack without hiddenSize")
+    # solve the input width E from the blob size (layer 0 consumes E,
+    # deeper layers consume H*dirs)
+    rest = (L - 1) * dirs * G * H * (H * dirs + H + 2)
+    den = dirs * G * H
+    num = blob.size - rest
+    if num <= 0 or num % den or num // den - H - 2 <= 0:
+        raise ValueError(
+            f"OptimizedRNNStack weight blob of {blob.size} floats does "
+            f"not factor for hiddenSize={H} numLayers={L} dirs={dirs} "
+            f"op={rec_op!r} under the cuDNN canonical layout")
+    E = num // den - H - 2
+
+    # onnx gate order from cudnn order
+    reorder = {"lstm": [0, 3, 1, 2],   # i,f,c,o -> i,o,f,c
+               "gru": [1, 0, 2]}.get(rec_op, [0])  # r,u,c -> z,r,h
+    onnx_op = {"lstm": "LSTM", "gru": "GRU"}.get(rec_op, "RNN")
+
+    pos = 0
+
+    def take(n):
+        nonlocal pos
+        out = blob[pos:pos + n]
+        pos += n
+        return out
+
+    mats = []   # per pseudo-layer: (W [G,H,in], R [G,H,H])
+    for layer in range(L):
+        in_l = E if layer == 0 else H * dirs
+        for _ in range(dirs):
+            wg = np.stack([take(H * in_l).reshape(H, in_l)
+                           for _ in range(G)])
+            rg = np.stack([take(H * H).reshape(H, H) for _ in range(G)])
+            mats.append((wg, rg))
+    biases = []  # per pseudo-layer: (bW [G,H], bR [G,H])
+    for _ in range(L * dirs):
+        bw = np.stack([take(H) for _ in range(G)])
+        br = np.stack([take(H) for _ in range(G)])
+        biases.append((bw, br))
+    assert pos == blob.size
+
+    # [N, T, E] -> [T, N, E] once; stay [T, N, *] between layers
+    x = g.add_node("Transpose", [em.resolve(x_uid)], perm=[1, 0, 2])
+    for layer in range(L):
+        W = np.stack([mats[layer * dirs + d][0][reorder].reshape(
+            G * H, -1) for d in range(dirs)])
+        R = np.stack([mats[layer * dirs + d][1][reorder].reshape(
+            G * H, H) for d in range(dirs)])
+        B = np.stack([np.concatenate(
+            [biases[layer * dirs + d][0][reorder].reshape(-1),
+             biases[layer * dirs + d][1][reorder].reshape(-1)])
+            for d in range(dirs)])
+        kw: Dict[str, Any] = dict(
+            hidden_size=H,
+            direction="bidirectional" if bidir else "forward")
+        if rec_op == "gru":
+            kw["linear_before_reset"] = 1
+        if rec_op == "rnnReLU":
+            kw["activations"] = ["Relu"] * dirs
+        y = g.add_node(
+            onnx_op,
+            [x,
+             g.add_initializer(g.fresh("rnn_w"), W.astype(np.float32)),
+             g.add_initializer(g.fresh("rnn_r"), R.astype(np.float32)),
+             g.add_initializer(g.fresh("rnn_b"), B.astype(np.float32))],
+            **kw)
+        # Y [T, dirs, N, H] -> [T, N, dirs*H] for the next layer
+        y = g.add_node("Transpose", [y], perm=[0, 2, 1, 3])
+        shp = g.add_initializer(g.fresh("rnn_shape"),
+                                np.asarray([0, 0, dirs * H], np.int64))
+        x = g.add_node("Reshape", [y, shp])
+    # back to the [N, T, feat] convention
+    return g.add_node("Transpose", [x], perm=[1, 0, 2])
+
+
 def cntk_to_onnx(payload: bytes,
                  parsed: Optional[Dict[str, Any]] = None) -> bytes:
     """Parse ``.model`` bytes and re-emit the graph as ONNX bytes.
@@ -480,8 +593,10 @@ def cntk_to_onnx(payload: bytes,
     root = top.get("root")
 
     g = GraphBuilder(name=top.get("name") or "cntk_model", opset=17)
-    if any(int(fd["op"]) in (OP_PAST_VALUE, OP_FUTURE_VALUE)
+    if any(int(fd["op"]) in (OP_PAST_VALUE, OP_FUTURE_VALUE,
+                             OP_OPTIMIZED_RNN)
            for fd in functions):
+        # sequence-model path: inputs feeding recurrences carry [N, T]
         return _recurrent_to_onnx(g, variables, functions, root)
 
     em = _Emitter(g, variables)
@@ -589,9 +704,13 @@ def _recurrent_to_onnx(g: GraphBuilder, variables: Dict[str, _Var],
         for u in grp["nodes"]:
             in_group[u] = grp
 
-    # model inputs feeding any cycle carry the sequence axis
+    # model inputs feeding any cycle (or a fused cuDNN RNN stack) carry
+    # the sequence axis
     seq_inputs: set = set()
-    for grp in groups:
+    rnn_stacks = [fd["uid"] for fd in functions
+                  if int(fd["op"]) == OP_OPTIMIZED_RNN]
+    for grp in groups + ([{"nodes": set(rnn_stacks)}] if rnn_stacks
+                         else []):
         seen: set = set()
         stack = list(grp["nodes"])
         while stack:

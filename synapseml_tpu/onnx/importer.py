@@ -1674,6 +1674,17 @@ def _gru(ctx, x, w, r, b=None, seq_lens=None, init_h=None):
 def _rnn(ctx, x, w, r, b=None, seq_lens=None, init_h=None):
     hidden = ctx.attr("hidden_size")
     direction = ctx.attr("direction", "forward")
+    acts = [a.decode() if isinstance(a, bytes) else str(a)
+            for a in (ctx.attr("activations", None) or [])]
+    if len(set(acts)) > 1:
+        raise NotImplementedError(
+            f"RNN: per-direction activations {acts} are not supported")
+    _ACTS = {"Tanh": jnp.tanh, "Relu": jax.nn.relu,
+             "Sigmoid": jax.nn.sigmoid}
+    name = acts[0] if acts else "Tanh"
+    act = _ACTS.get(name)
+    if act is None:
+        raise NotImplementedError(f"RNN activation {name!r}")
     seq, batch, _ = x.shape
 
     def run_dir(d, reverse):
@@ -1687,7 +1698,7 @@ def _rnn(ctx, x, w, r, b=None, seq_lens=None, init_h=None):
         x_proj = jnp.einsum("sbi,gi->sbg", xs, wd) + wb
 
         def step(h, xp_t):
-            h_new = jnp.tanh(xp_t + h @ rd.T + rb)
+            h_new = act(xp_t + h @ rd.T + rb)
             return h_new, h_new
 
         h_f, ys = lax.scan(step, h0, x_proj)

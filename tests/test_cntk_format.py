@@ -22,7 +22,8 @@ from synapseml_tpu.dl.cntk_format import (CntkAxisRef, CntkModelBuilder,
                                           OP_BATCH_NORM, OP_CLIP,
                                           OP_COMBINE, OP_CONVOLUTION,
                                           OP_DROPOUT, OP_ELEMENT_TIMES,
-                                          OP_FUTURE_VALUE, OP_PAST_VALUE,
+                                          OP_FUTURE_VALUE,
+                                          OP_OPTIMIZED_RNN, OP_PAST_VALUE,
                                           OP_PLUS, OP_POOLING,
                                           OP_RELU, OP_RESHAPE, OP_SLICE,
                                           OP_SOFTMAX, OP_SPLICE, OP_TANH,
@@ -425,6 +426,152 @@ def test_scalar_init_with_state_as_first_operand():
         want[:, i] = hh
     got = np.asarray(gi.apply(gi.params, x_np)[0])
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def _pack_cudnn_blob(layers):
+    """Pack per the documented cuDNN canonical layout: all (W, R) gate
+    matrices per pseudo-layer first, then all (bW, bR) biases.
+    ``layers`` = list of pseudo-layers, each (W [G,H,in], R [G,H,H],
+    bW [G,H], bR [G,H]) in cuDNN gate order."""
+    chunks = []
+    for W, R, bW, bR in layers:
+        chunks.append(np.asarray(W, np.float32).reshape(-1))
+        chunks.append(np.asarray(R, np.float32).reshape(-1))
+    for W, R, bW, bR in layers:
+        chunks.append(np.asarray(bW, np.float32).reshape(-1))
+        chunks.append(np.asarray(bR, np.float32).reshape(-1))
+    return np.concatenate(chunks)
+
+
+def _cudnn_lstm_ref(x, W, R, bW, bR, reverse=False):
+    """cuDNN LSTM semantics, gate order i,f,c,o; two bias sets."""
+    n, t, _ = x.shape
+    H = W.shape[1]
+    h = np.zeros((n, H), np.float32)
+    c = np.zeros((n, H), np.float32)
+    out = np.zeros((n, t, H), np.float32)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    steps = range(t - 1, -1, -1) if reverse else range(t)
+    for s in steps:
+        gates = [x[:, s] @ W[gk].T + h @ R[gk].T + bW[gk] + bR[gk]
+                 for gk in range(4)]
+        i, f, cc, o = gates
+        c = sig(f) * c + sig(i) * np.tanh(cc)
+        h = sig(o) * np.tanh(c)
+        out[:, s] = h
+    return out
+
+
+def _cudnn_gru_ref(x, W, R, bW, bR, reverse=False):
+    """cuDNN GRU semantics (reset applied AFTER the recurrent matmul),
+    gate order r,u,c."""
+    n, t, _ = x.shape
+    H = W.shape[1]
+    h = np.zeros((n, H), np.float32)
+    out = np.zeros((n, t, H), np.float32)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    steps = range(t - 1, -1, -1) if reverse else range(t)
+    for s in steps:
+        r = sig(x[:, s] @ W[0].T + h @ R[0].T + bW[0] + bR[0])
+        u = sig(x[:, s] @ W[1].T + h @ R[1].T + bW[1] + bR[1])
+        cand = np.tanh(x[:, s] @ W[2].T + bW[2] + r * (h @ R[2].T + bR[2]))
+        h = (1 - u) * cand + u * h
+        out[:, s] = h
+    return out
+
+
+def _rnn_stack_model(blob, feat, attrs):
+    b = CntkModelBuilder("opt_rnn")
+    x = b.add_input((feat,))
+    w = b.add_parameter(blob)  # 1-D blob: layout unchanged by reversal
+    y = b.add_op(OP_OPTIMIZED_RNN, [x, w], attrs)
+    return b.to_bytes(y)
+
+
+def test_optimized_rnn_stack_lstm_matches_cudnn_reference():
+    """Unidirectional single-layer cuDNN LSTM blob -> ONNX LSTM ->
+    lax.scan, vs a numpy implementation of cuDNN's exact semantics."""
+    feat, H = 3, 4
+    rng = np.random.default_rng(30)
+    W = (rng.normal(size=(4, H, feat)) * 0.4).astype(np.float32)
+    R = (rng.normal(size=(4, H, H)) * 0.4).astype(np.float32)
+    bW = (rng.normal(size=(4, H)) * 0.1).astype(np.float32)
+    bR = (rng.normal(size=(4, H)) * 0.1).astype(np.float32)
+    blob = _pack_cudnn_blob([(W, R, bW, bR)])
+    gi = import_model(cntk_to_onnx(_rnn_stack_model(
+        blob, feat, {"hiddenSize": H, "numLayers": 1,
+                     "bidirectional": False, "recurrentOp": "lstm"})))
+    x = np.random.default_rng(31).normal(size=(2, 5, feat)) \
+        .astype(np.float32)
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    np.testing.assert_allclose(got, _cudnn_lstm_ref(x, W, R, bW, bR),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_optimized_rnn_stack_bidirectional_gru():
+    """Bidirectional GRU: forward + reverse pseudo-layers concat on the
+    feature axis; cuDNN's reset-after-matmul maps to ONNX
+    linear_before_reset=1."""
+    feat, H = 3, 3
+    rng = np.random.default_rng(32)
+
+    def mk():
+        return ((rng.normal(size=(3, H, feat)) * 0.4).astype(np.float32),
+                (rng.normal(size=(3, H, H)) * 0.4).astype(np.float32),
+                (rng.normal(size=(3, H)) * 0.1).astype(np.float32),
+                (rng.normal(size=(3, H)) * 0.1).astype(np.float32))
+
+    fwd, bwd = mk(), mk()
+    blob = _pack_cudnn_blob([fwd, bwd])
+    gi = import_model(cntk_to_onnx(_rnn_stack_model(
+        blob, feat, {"hiddenSize": H, "numLayers": 1,
+                     "bidirectional": True, "recurrentOp": "gru"})))
+    x = np.random.default_rng(33).normal(size=(2, 6, feat)) \
+        .astype(np.float32)
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    want = np.concatenate([_cudnn_gru_ref(x, *fwd),
+                           _cudnn_gru_ref(x, *bwd, reverse=True)], axis=-1)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_optimized_rnn_stack_two_layer_relu_and_blob_guard():
+    """Stacked rnnReLU layers chain [T,N,*] between ONNX RNN nodes; a
+    blob whose size does not factor for the declared geometry raises
+    instead of mis-slicing."""
+    feat, H = 4, 3
+    rng = np.random.default_rng(34)
+
+    def mk(in_w):
+        return ((rng.normal(size=(1, H, in_w)) * 0.4).astype(np.float32),
+                (rng.normal(size=(1, H, H)) * 0.4).astype(np.float32),
+                (rng.normal(size=(1, H)) * 0.1).astype(np.float32),
+                (rng.normal(size=(1, H)) * 0.1).astype(np.float32))
+
+    l0, l1 = mk(feat), mk(H)
+    blob = _pack_cudnn_blob([l0, l1])
+    gi = import_model(cntk_to_onnx(_rnn_stack_model(
+        blob, feat, {"hiddenSize": H, "numLayers": 2,
+                     "bidirectional": False, "recurrentOp": "rnnReLU"})))
+    x = np.random.default_rng(35).normal(size=(2, 4, feat)) \
+        .astype(np.float32)
+    h1 = np.zeros((2, H), np.float32)
+    h2 = np.zeros((2, H), np.float32)
+    want = np.zeros((2, 4, H), np.float32)
+    for s in range(4):
+        h1 = np.maximum(
+            x[:, s] @ l0[0][0].T + h1 @ l0[1][0].T + l0[2][0] + l0[3][0],
+            0.0)
+        h2 = np.maximum(
+            h1 @ l1[0][0].T + h2 @ l1[1][0].T + l1[2][0] + l1[3][0], 0.0)
+        want[:, s] = h2
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    with pytest.raises(ValueError, match="does not factor"):
+        cntk_to_onnx(_rnn_stack_model(
+            blob[:-1], feat, {"hiddenSize": H, "numLayers": 2,
+                              "bidirectional": False,
+                              "recurrentOp": "rnnReLU"}))
 
 
 def test_committed_recurrent_fixture_loads_and_matches():
