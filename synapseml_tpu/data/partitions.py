@@ -58,10 +58,15 @@ class PartitionAggregator:
 
     def __init__(self, feature_cols: Sequence[str],
                  label_col: str = "label",
-                 weight_col: Optional[str] = None):
+                 weight_col: Optional[str] = None,
+                 group_col: Optional[str] = None):
+        """``group_col``: ranking query-group ids (LightGBMRanker's
+        groupCol) — rows of one group must arrive in one executor's
+        stream, as in the reference's group-aligned partitioning."""
         self.feature_cols = list(feature_cols)
         self.label_col = label_col
         self.weight_col = weight_col
+        self.group_col = group_col
         self._chunks: List[Dict[str, np.ndarray]] = []
         self.num_rows = 0
 
@@ -69,7 +74,22 @@ class PartitionAggregator:
         need = self.feature_cols + [self.label_col]
         if self.weight_col is not None:
             need.append(self.weight_col)
+        if self.group_col is not None:
+            need.append(self.group_col)
         return need
+
+    def _concat_col(self, col: str, dtype) -> np.ndarray:
+        if not self._chunks:
+            return np.zeros(0, dtype)
+        return np.concatenate([np.asarray(c[col], dtype)
+                               for c in self._chunks])
+
+    def group_array(self) -> Optional[np.ndarray]:
+        """Query-group ids at their native integer width — a float64
+        round trip would merge distinct ids above 2**53."""
+        if self.group_col is None:
+            return None
+        return self._concat_col(self.group_col, np.int64)
 
     def add(self, batch: Any) -> "PartitionAggregator":
         t = _as_table(batch)  # Table validates equal column lengths
@@ -97,12 +117,10 @@ class PartitionAggregator:
             np.column_stack([np.asarray(c[fc], np.float64)
                              for fc in self.feature_cols])
             for c in self._chunks]) if f else np.zeros((self.num_rows, 0))
-        y = np.concatenate([np.asarray(c[self.label_col], np.float64)
-                            for c in self._chunks])
+        y = self._concat_col(self.label_col, np.float64)
         w = None
         if self.weight_col is not None:
-            w = np.concatenate([np.asarray(c[self.weight_col], np.float64)
-                                for c in self._chunks])
+            w = self._concat_col(self.weight_col, np.float64)
         return x, y, w
 
 
@@ -137,6 +155,7 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
         distributed.initialize()
 
     x, y, w = agg.to_arrays()
+    group = agg.group_array()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -147,11 +166,13 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
         n_max = max(int(n_all.max()), 1)  # keep the collective well-shaped
                                           # even when every host is empty
 
-        def gather_f64(a):
-            """Bit-exact float64 gather: jax would canonicalize f64 to
-            f32 with x64 disabled, and a rounding that crosses a bin
-            quantile would silently break the single-fit identity —
-            so the doubles ride as uint32 words."""
+        def gather_64(a):
+            """Bit-exact gather of any 8-byte dtype (float64/int64): jax
+            would canonicalize them to 32-bit with x64 disabled, and a
+            rounding that crosses a bin quantile (or merges two query
+            ids) would silently break the single-fit identity — so the
+            values ride as uint32 words and come back in their dtype."""
+            dt = a.dtype
             a = np.ascontiguousarray(
                 np.pad(a, [(0, n_max - a.shape[0])]
                        + [(0, 0)] * (a.ndim - 1)))
@@ -159,31 +180,44 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
             out = np.asarray(multihost_utils.process_allgather(words))
             out = out.reshape(len(n_all), n_max, -1)
             return np.concatenate([
-                out[i, :n_all[i]].reshape(-1).view(np.float64).reshape(
+                out[i, :n_all[i]].reshape(-1).view(dt).reshape(
                     (n_all[i],) + a.shape[1:])
                 for i in range(len(n_all))])
 
-        x = gather_f64(np.asarray(x, np.float64))
-        y = gather_f64(np.asarray(y, np.float64))
+        x = gather_64(np.asarray(x, np.float64))
+        y = gather_64(np.asarray(y, np.float64))
         if w is not None:
-            w = gather_f64(np.asarray(w, np.float64))
+            w = gather_64(np.asarray(w, np.float64))
+        if group is not None:
+            # hosts commonly number queries locally (0..N each), so raw
+            # ids would collide across hosts and lambdarank would pair
+            # rows of unrelated queries: relabel into disjoint per-host
+            # ranges first (groups must not SPAN hosts — same contract
+            # as the reference's group-aligned partitioning)
+            uniq, inv = np.unique(group, return_inverse=True)
+            counts = np.asarray(multihost_utils.process_allgather(
+                np.asarray([len(uniq)]))).reshape(-1)
+            offset = int(counts[:jax.process_index()].sum())
+            group = gather_64((inv + offset).astype(np.int64))
         if mesh is None:
             from jax.sharding import Mesh
             mesh = Mesh(np.array(jax.devices()), ("dp",))
     if x.shape[0] == 0:
         raise ValueError("no rows to fit: every partition stream was empty")
-    return train(params, x, y, weight=w, mesh=mesh, **train_kw)
+    return train(params, x, y, weight=w, group=group, mesh=mesh, **train_kw)
 
 
 def fit_partitions(params, partitions: Iterable[Any],
                    feature_cols: Sequence[str], label_col: str = "label",
-                   weight_col: Optional[str] = None, mesh=None,
+                   weight_col: Optional[str] = None,
+                   group_col: Optional[str] = None, mesh=None,
                    rendezvous: Optional[Dict[str, Any]] = None,
                    **train_kw):
     """One-call form: stream ``partitions`` (an iterator of record
     batches — THIS executor's partitions) through a
     :class:`PartitionAggregator` and fit. See :func:`fit_aggregated`."""
-    agg = PartitionAggregator(feature_cols, label_col, weight_col)
+    agg = PartitionAggregator(feature_cols, label_col, weight_col,
+                              group_col)
     for batch in partitions:
         agg.add(batch)
     return fit_aggregated(params, agg, mesh=mesh, rendezvous=rendezvous,

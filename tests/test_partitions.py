@@ -90,6 +90,38 @@ def test_aggregator_validation_and_weights():
                        PartitionAggregator(["a"], label_col="y"))
 
 
+def test_fit_partitions_ranker_groups():
+    """group_col streams query-group ids through the adapter: the
+    lambdarank fit from partition batches matches the single-call fit."""
+    rng = np.random.default_rng(4)
+    n_q, per_q = 30, 8
+    n = n_q * per_q
+    x = rng.normal(size=(n, 4))
+    rel = (x[:, 0] + 0.3 * rng.normal(size=n) > 0.4).astype(np.float64)
+    q = np.repeat(np.arange(n_q), per_q)
+    p = BoostParams(objective="lambdarank", num_iterations=8,
+                    num_leaves=7, min_data_in_leaf=2)
+    want = train(p, x, rel, group=q).predict(x)
+
+    cols = [f"f{i}" for i in range(4)]
+    # group-aligned partition boundaries (rows of a query stay together)
+    batches = []
+    for lo, hi in [(0, 80), (80, 160), (160, 240)]:
+        d = {c: x[lo:hi, j] for j, c in enumerate(cols)}
+        d["label"] = rel[lo:hi]
+        d["qid"] = q[lo:hi]
+        batches.append(d)
+    b = fit_partitions(p, batches, feature_cols=cols, group_col="qid")
+    np.testing.assert_allclose(b.predict(x), want, rtol=1e-12)
+
+    # hashed qids above 2^53 must stay distinct (no float64 round trip)
+    agg = PartitionAggregator(["a"], group_col="g")
+    agg.add({"a": [1.0, 2.0], "label": [0.0, 1.0],
+             "g": np.array([2**53, 2**53 + 1], np.int64)})
+    ga = agg.group_array()
+    assert ga.dtype == np.int64 and ga[0] != ga[1]
+
+
 def test_two_process_partition_fit_matches_single_fit():
     """The real N-executor proof: two OS processes each stream HALF the
     rows through the partition adapter, rendezvous via the driver socket,
