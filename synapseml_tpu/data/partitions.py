@@ -156,6 +156,14 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
 
     x, y, w = agg.to_arrays()
     group = agg.group_array()
+    if "group" in train_kw:
+        if group is not None:
+            raise TypeError(
+                "pass query groups either via group_col (streamed with "
+                "the batches) or via group=, not both")
+        # direct group= arrays are fine single-host; multi-host needs the
+        # per-host relabel below, which only the group_col path gets
+        group = np.asarray(train_kw.pop("group"))
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -183,3 +183,66 @@ print("PARTFIT", rank_hint, "ok", b.num_trees, flush=True)
     for rc, out, err in outs:
         assert rc == 0, err[-2000:]
         assert "ok" in out
+
+
+def test_two_process_ranker_groups_relabel_across_hosts():
+    """Two executors each number their queries LOCALLY (both send qid
+    0..19): the multi-host path must relabel into disjoint ranges before
+    the gather, reproducing the single-fit booster over globally-unique
+    ids — without relabeling, lambdarank would pair rows of unrelated
+    queries across hosts."""
+    from synapseml_tpu.io.serving import find_open_port
+
+    rdv_port = find_open_port(26900)
+    coord_port = find_open_port(27000)
+    worker_code = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+rank_hint = int(sys.argv[1])
+import numpy as np
+from synapseml_tpu.data.partitions import fit_partitions
+from synapseml_tpu.gbdt.boosting import BoostParams, train
+from synapseml_tpu.parallel.distributed import DriverRendezvous
+rng = np.random.default_rng(0)
+n_q, per_q = 40, 8
+n = n_q * per_q
+x = rng.normal(size=(n, 4))
+rel = (x[:, 0] + 0.3 * rng.normal(size=n) > 0.4).astype(np.float64)
+q_global = np.repeat(np.arange(n_q), per_q)
+cols = [f"f{i}" for i in range(4)]
+lo, hi = (0, 160) if rank_hint == 0 else (160, 320)
+q_local = q_global[lo:hi] - (0 if rank_hint == 0 else 20)  # both 0..19
+assert q_local.min() == 0
+batches = [{**{c: x[lo:hi, j] for j, c in enumerate(cols)},
+            "label": rel[lo:hi], "qid": q_local}]
+if rank_hint == 0:
+    DriverRendezvous(num_workers=2, host="127.0.0.1", port={rdv_port}).start()
+p = BoostParams(objective="lambdarank", num_iterations=6, num_leaves=7,
+                min_data_in_leaf=2)
+b = fit_partitions(p, batches, feature_cols=cols, group_col="qid",
+                   rendezvous={"driver_host": "127.0.0.1",
+                               "driver_port": {rdv_port},
+                               "my_host": "127.0.0.1",
+                               "rank_hint": rank_hint,
+                               "coordinator_port": {coord_port}})
+single = train(p, x, rel, group=q_global)
+np.testing.assert_allclose(b.predict(x), single.predict(x), rtol=1e-12)
+print("RANKFIT", rank_hint, "ok", flush=True)
+""".replace("{rdv_port}", str(rdv_port)).replace("{coord_port}",
+                                                 str(coord_port))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = "."
+    procs = [
+        subprocess.Popen([sys.executable, "-c", worker_code, str(i)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+        for i in range(2)
+    ]
+    for p_ in procs:
+        out, err = p_.communicate(timeout=180)
+        assert p_.returncode == 0, err[-2000:]
+        assert "ok" in out
