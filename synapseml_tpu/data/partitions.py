@@ -154,16 +154,19 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
     else:
         distributed.initialize()
 
+    # validate the group forms BEFORE the O(n) concat (and before peers
+    # start waiting on this host's gather)
+    direct_group = train_kw.pop("group", None)
+    if direct_group is not None and agg.group_col is not None:
+        raise TypeError(
+            "pass query groups either via group_col (streamed with "
+            "the batches) or via group=, not both")
     x, y, w = agg.to_arrays()
     group = agg.group_array()
-    if "group" in train_kw:
-        if group is not None:
-            raise TypeError(
-                "pass query groups either via group_col (streamed with "
-                "the batches) or via group=, not both")
-        # direct group= arrays are fine single-host; multi-host needs the
+    if direct_group is not None:
+        # direct group= arrays work single-host; multi-host needs the
         # per-host relabel below, which only the group_col path gets
-        group = np.asarray(train_kw.pop("group"))
+        group = np.asarray(direct_group)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -383,9 +383,10 @@ class DistributedServer:
             self.channels.add(item)
 
     def get_batch(self, channel: int, max_rows: int = 64,
-                  timeout: float = 0.1) -> List[CachedRequest]:
+                  timeout: float = 0.1,
+                  linger: float = 0.0) -> List[CachedRequest]:
         out = _drain_queue(self.channels.channel(channel), max_rows,
-                           timeout)
+                           timeout, linger)
         # same epoch/history bookkeeping as the direct path, so a shard
         # that dies mid-batch stays replayable through server.recover()
         self.server._record_epoch(out)
