@@ -640,8 +640,12 @@ def _lower_nodes(nodes, opset: int):
         # marks skipped optional outputs with "" placeholders
         arity = max((i + 1 for i, o in enumerate(node.output) if o),
                     default=0)
-        ctx = OpContext(node_attrs(node), opset, node.name, node.op_type,
-                        arity)
+        # exporters commonly leave node.name empty; the first output name
+        # is unique per graph (spec) and keeps per-node derivations (e.g.
+        # random-op fallback seeds) distinct
+        ctx = OpContext(node_attrs(node), opset,
+                        node.name or (node.output[0] if node.output else ""),
+                        node.op_type, arity)
         # control-flow subgraphs lower EAGERLY so an unsupported op inside
         # a branch is rejected at import time, not on live traffic
         if node.op_type == "If":
@@ -1325,6 +1329,154 @@ def _cast_like(ctx, x, like):
     if _is_host(x):
         return np.asarray(x).astype(dt)
     return x.astype(dt)
+
+
+def _node_key(ctx):
+    """PRNG key for a random op: the ONNX ``seed`` attribute when given,
+    else a per-node seed derived from the node name — two random nodes
+    in one graph draw differently, and a given graph is deterministic
+    across runs (the spec leaves the unseeded case implementation-
+    defined; XLA cannot express ambient nondeterminism)."""
+    import zlib
+    seed = ctx.attr("seed")
+    if seed is None:
+        seed = zlib.crc32(f"{ctx.name}|{ctx.op_type}".encode())
+    # the spec types seed as float; bit-cast keeps distinct floats distinct
+    return jax.random.PRNGKey(
+        int(np.float32(seed).view(np.uint32)) if not float(
+            seed).is_integer() else int(seed) & 0x7FFFFFFF)
+
+
+def _random_dtype(ctx, like=None, default=np.float32):
+    dt = ctx.attr("dtype")
+    if dt is not None:
+        return proto.TENSOR_DTYPES[int(dt)]
+    if like is not None:
+        return like.dtype
+    return default
+
+
+@op("RandomNormal")
+def _random_normal(ctx):
+    shape = tuple(int(s) for s in ctx.attr("shape"))
+    dt = _random_dtype(ctx)
+    return (jax.random.normal(_node_key(ctx), shape)
+            * ctx.attr("scale", 1.0) + ctx.attr("mean", 0.0)).astype(dt)
+
+
+@op("RandomNormalLike")
+def _random_normal_like(ctx, x):
+    dt = _random_dtype(ctx, like=jnp.asarray(x))
+    return (jax.random.normal(_node_key(ctx), jnp.shape(x))
+            * ctx.attr("scale", 1.0) + ctx.attr("mean", 0.0)).astype(dt)
+
+
+@op("RandomUniform")
+def _random_uniform(ctx):
+    shape = tuple(int(s) for s in ctx.attr("shape"))
+    dt = _random_dtype(ctx)
+    return jax.random.uniform(
+        _node_key(ctx), shape, minval=ctx.attr("low", 0.0),
+        maxval=ctx.attr("high", 1.0)).astype(dt)
+
+
+@op("RandomUniformLike")
+def _random_uniform_like(ctx, x):
+    dt = _random_dtype(ctx, like=jnp.asarray(x))
+    return jax.random.uniform(
+        _node_key(ctx), jnp.shape(x), minval=ctx.attr("low", 0.0),
+        maxval=ctx.attr("high", 1.0)).astype(dt)
+
+
+@op("Bernoulli")
+def _bernoulli(ctx, x):
+    x = jnp.asarray(x)
+    dt = _random_dtype(ctx, like=x)
+    draws = jax.random.uniform(_node_key(ctx), x.shape)
+    return (draws < x.astype(jnp.float32)).astype(dt)
+
+
+@op("Multinomial")
+def _multinomial(ctx, x):
+    """Multinomial: ``sample_size`` draws per batch row from unnormalized
+    LOG-probabilities (the spec's input is runtime-values of a softmax's
+    input)."""
+    n = int(ctx.attr("sample_size", 1))
+    dt = proto.TENSOR_DTYPES[int(ctx.attr("dtype", 6))]
+    x = jnp.asarray(x)
+    return jax.random.categorical(
+        _node_key(ctx), x[:, None, :], axis=-1,
+        shape=(x.shape[0], n)).astype(dt)
+
+
+@op("STFT")
+def _stft(ctx, signal, frame_step, window=None, frame_length=None):
+    """STFT (opset 17): framed DFT with static frame geometry — frames
+    are gathered as one [B, frames, flen] tensor and transformed with a
+    single batched (r)fft, not a per-frame loop. The speech front-end
+    op (pairs with cognitive/speech.py's WAV pull-stream)."""
+    (step,) = _static_int_list(frame_step, "STFT frame_step")
+    sig = jnp.asarray(signal)
+    if sig.ndim == 3:  # [B, length, 1]
+        if sig.shape[-1] != 1:
+            raise NotImplementedError(
+                "STFT: complex input signals are not supported")
+        sig = sig[..., 0]
+    if window is not None:
+        win = jnp.asarray(window)
+        flen = int(win.shape[0])
+        if frame_length is not None:
+            (fl2,) = _static_int_list(frame_length, "STFT frame_length")
+            if fl2 != flen:
+                raise ValueError(
+                    f"STFT: window length {flen} != frame_length {fl2}")
+    else:
+        if frame_length is None:
+            raise ValueError("STFT needs window and/or frame_length")
+        (flen,) = _static_int_list(frame_length, "STFT frame_length")
+        win = jnp.ones((flen,), sig.dtype)
+    length = sig.shape[-1]
+    frames = 1 + (length - flen) // step
+    idx = (jnp.arange(frames)[:, None] * step
+           + jnp.arange(flen)[None, :])                  # [frames, flen]
+    framed = sig[..., idx] * win.astype(sig.dtype)       # [B, frames, flen]
+    onesided = bool(ctx.attr("onesided", 1))
+    spec = jnp.fft.rfft(framed) if onesided else jnp.fft.fft(framed)
+    out = jnp.stack([jnp.real(spec), jnp.imag(spec)], axis=-1)
+    return out.astype(jnp.float32 if sig.dtype != jnp.float64
+                      else jnp.float64)
+
+
+@op("MelWeightMatrix")
+def _mel_weight_matrix(ctx, num_mel_bins, dft_length, sample_rate,
+                       lower_edge_hertz, upper_edge_hertz):
+    """MelWeightMatrix (opset 17): triangular HTK-mel filterbank,
+    [dft_length//2 + 1, num_mel_bins] — spec formula, fully vectorized."""
+    (n_mel,) = _static_int_list(num_mel_bins, "MelWeightMatrix bins")
+    (n_dft,) = _static_int_list(dft_length, "MelWeightMatrix dft_length")
+    sr = float(np.asarray(sample_rate).reshape(()))
+    lo = float(np.asarray(lower_edge_hertz).reshape(()))
+    hi = float(np.asarray(upper_edge_hertz).reshape(()))
+    n_bins = n_dft // 2 + 1
+
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+
+    edges_hz = mel_to_hz(
+        np.linspace(hz_to_mel(lo), hz_to_mel(hi), n_mel + 2))
+    bin_hz = np.arange(n_bins) * sr / n_dft
+    lower = edges_hz[:-2][None, :]       # [1, n_mel]
+    center = edges_hz[1:-1][None, :]
+    upper = edges_hz[2:][None, :]
+    f = bin_hz[:, None]                  # [n_bins, 1]
+    up = (f - lower) / np.maximum(center - lower, 1e-12)
+    down = (upper - f) / np.maximum(upper - center, 1e-12)
+    w = np.maximum(0.0, np.minimum(up, down))
+    dt = proto.TENSOR_DTYPES[int(ctx.attr("output_datatype", 1))]
+    return jnp.asarray(w.astype(dt))
 
 
 @op("Identity")

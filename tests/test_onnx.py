@@ -1014,6 +1014,126 @@ def test_uint8_feed_integer_graph_input_not_cast():
     np.testing.assert_array_equal(out, np.arange(6) + 1)
 
 
+def test_stft_matches_torch():
+    """ONNX STFT (opset 17) vs torch.stft with center=False — the audio
+    front-end op, certified against a foreign implementation."""
+    b, length, flen, step = 2, 400, 64, 32
+    rng = np.random.default_rng(0)
+    sig = rng.normal(size=(b, length)).astype(np.float32)
+    win = np.hanning(flen).astype(np.float32)
+
+    g = GraphBuilder(opset=17)
+    s_in = g.add_input("signal", np.float32, [b, length])
+    step_i = g.add_initializer("step", np.asarray(step, np.int64))
+    win_i = g.add_initializer("win", win)
+    y = g.add_node("STFT", [s_in, step_i, win_i], onesided=1)
+    g.add_output(y, np.float32, None)
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, sig)[0])
+
+    want_c = torch.stft(torch.from_numpy(sig), n_fft=flen,
+                        hop_length=step, win_length=flen,
+                        window=torch.from_numpy(win), center=False,
+                        onesided=True, return_complex=True).numpy()
+    # torch layout [B, bins, frames]; ONNX [B, frames, bins, 2]
+    want = np.stack([want_c.real, want_c.imag], axis=-1) \
+        .transpose(0, 2, 1, 3)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # no-window form: frame_length drives the geometry (rect window)
+    g2 = GraphBuilder(opset=17)
+    s2 = g2.add_input("signal", np.float32, [b, length])
+    y2 = g2.add_node("STFT", [
+        s2, g2.add_initializer("st", np.asarray(step, np.int64)),
+        "", g2.add_initializer("fl", np.asarray(flen, np.int64))],
+        onesided=1)
+    g2.add_output(y2, np.float32, None)
+    gi2 = import_model(g2.to_bytes())
+    got2 = np.asarray(gi2.apply(gi2.params, sig)[0])
+    want2_c = torch.stft(torch.from_numpy(sig), n_fft=flen,
+                         hop_length=step, win_length=flen,
+                         window=torch.ones(flen), center=False,
+                         onesided=True, return_complex=True).numpy()
+    want2 = np.stack([want2_c.real, want2_c.imag], axis=-1) \
+        .transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-4)
+
+
+def test_random_family_ops():
+    """RandomNormal/Uniform(/Like)/Bernoulli/Multinomial: deterministic
+    per node (XLA cannot express ambient nondeterminism — the spec
+    leaves unseeded behavior implementation-defined), statistically
+    sane, distinct across nodes."""
+    g = GraphBuilder(opset=17)
+    n1 = g.add_node("RandomNormal", [], shape=[2000], scale=2.0, mean=1.0)
+    n2 = g.add_node("RandomNormal", [], shape=[2000])
+    u = g.add_node("RandomUniform", [], shape=[2000], low=-1.0, high=3.0)
+    x_in = g.add_input("x", np.float32, [500])
+    nl = g.add_node("RandomNormalLike", [x_in])
+    bern = g.add_node("Bernoulli", [x_in])
+    for nm in (n1, n2, u, nl, bern):
+        g.add_output(nm, np.float32, None)
+    gi = import_model(g.to_bytes())
+    probs = np.full(500, 0.25, np.float32)
+    a1, a2, au, anl, ab = [np.asarray(o) for o in gi.apply(gi.params, probs)]
+    assert abs(a1.mean() - 1.0) < 0.2 and abs(a1.std() - 2.0) < 0.2
+    assert abs(a2.mean()) < 0.2 and not np.allclose(a1, a2 * 2 + 1)
+    # UNNAMED nodes (common in exporter output) must still draw
+    # distinctly: fallback seeds derive from output names, not node.name
+    stripped = proto.load_model(g.to_bytes())
+    for nd in stripped.graph.node:
+        nd.name = ""
+    gs = import_model(proto.encode(stripped))
+    s1, s2 = [np.asarray(o) for o in gs.apply(gs.params, probs)[:2]]
+    assert not np.allclose((s1 - 1.0) / 2.0, s2)
+    assert au.min() >= -1.0 and au.max() <= 3.0 and abs(au.mean() - 1.0) < 0.2
+    assert anl.shape == (500,)
+    assert set(np.unique(ab)) <= {0.0, 1.0}
+    assert abs(ab.mean() - 0.25) < 0.1
+    # deterministic across runs
+    b1 = np.asarray(gi.apply(gi.params, probs)[0])
+    np.testing.assert_array_equal(a1, b1)
+
+    # Multinomial: draws follow the (log-prob) weights
+    g2 = GraphBuilder(opset=17)
+    lp = g2.add_input("logp", np.float32, [1, 3])
+    m = g2.add_node("Multinomial", [lp], sample_size=2000, dtype=6)
+    g2.add_output(m, np.int32, None)
+    gi2 = import_model(g2.to_bytes())
+    draws = np.asarray(gi2.apply(
+        gi2.params, np.log(np.array([[0.7, 0.2, 0.1]], np.float32)))[0])
+    assert draws.shape == (1, 2000)
+    frac0 = (draws == 0).mean()
+    assert 0.6 < frac0 < 0.8
+
+
+def test_mel_weight_matrix_spec_properties():
+    """MelWeightMatrix: triangular HTK-mel filters — peaks at the mel
+    centers, zero outside [lower, upper], correct shape/dtype."""
+    g = GraphBuilder(opset=17)
+    y = g.add_node("MelWeightMatrix", [
+        g.add_initializer("nmel", np.asarray(8, np.int64)),
+        g.add_initializer("ndft", np.asarray(128, np.int64)),
+        g.add_initializer("sr", np.asarray(8000, np.int64)),
+        g.add_initializer("lo", np.asarray(100.0, np.float32)),
+        g.add_initializer("hi", np.asarray(3800.0, np.float32))])
+    g.add_output(y, np.float32, None)
+    gi = import_model(g.to_bytes())
+    w = np.asarray(gi.apply(gi.params)[0])
+    assert w.shape == (65, 8)  # [dft//2+1, n_mel]
+    assert (w >= 0).all() and w.max() <= 1.0 + 1e-6
+    bin_hz = np.arange(65) * 8000 / 128
+    # columns are triangles: each has one contiguous support inside
+    # (100, 3800) and every filter has some energy
+    assert (w.sum(axis=0) > 0).all()
+    assert (w[bin_hz < 100] == 0).all()
+    assert (w[bin_hz > 3800] == 0).all()
+    # mel centers increase monotonically
+    centers = w.argmax(axis=0)
+    assert (np.diff(centers) > 0).all()
+
+
 def test_external_data_save_load_roundtrip(tmp_path):
     """save_model(external_data_threshold=...) moves big initializers to
     a ``.data`` sidecar; import_model(path) resolves them transparently
