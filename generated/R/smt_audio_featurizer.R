@@ -1,0 +1,30 @@
+#' AudioFeaturizer
+#'
+#' Log-mel spectrogram features computed ON DEVICE.
+#'
+#' @param frame_length window size in samples
+#' @param frame_step hop in samples
+#' @param input_col waveform / wav-bytes column
+#' @param log_offset epsilon inside the log
+#' @param lower_hz mel filterbank lower edge
+#' @param num_mel_bins mel filter count
+#' @param output_col log-mel output column
+#' @param sample_rate sample rate when input is raw waveform
+#' @param upper_hz mel filterbank upper edge
+#' @return a synapseml_tpu transformer handle
+#' @export
+smt_audio_featurizer <- function(frame_length = 400, frame_step = 160, input_col = "audio", log_offset = 1e-06, lower_hz = 125.0, num_mel_bins = 64, output_col = "features", sample_rate = 16000, upper_hz = 7600.0) {
+  mod <- reticulate::import("synapseml_tpu.cognitive.speech")
+  kwargs <- Filter(Negate(is.null), list(
+    frame_length = frame_length,
+    frame_step = frame_step,
+    input_col = input_col,
+    log_offset = log_offset,
+    lower_hz = lower_hz,
+    num_mel_bins = num_mel_bins,
+    output_col = output_col,
+    sample_rate = sample_rate,
+    upper_hz = upper_hz
+  ))
+  do.call(mod$AudioFeaturizer, kwargs)
+}

@@ -29,6 +29,7 @@ import numpy as np
 from synapseml_tpu.cognitive.base import (CognitiveServicesBase, ServiceParam,
                                           with_url_params)
 from synapseml_tpu.core.param import Param
+from synapseml_tpu.core.pipeline import Transformer
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import (AsyncHTTPClient, HandlingUtils,
                                    HTTPRequestData, response_to_error)
@@ -263,3 +264,112 @@ class SpeechToTextSDK(CognitiveServicesBase):
                             if errors[j] is not None), None)
         return table.with_columns({self.output_col: out,
                                    self.error_col: errs})
+
+
+class AudioFeaturizer(Transformer):
+    """Log-mel spectrogram features computed ON DEVICE.
+
+    The reference ships audio to the Azure Speech SDK, which featurizes
+    server-side; here the spectral front end is local TPU compute: the
+    transformer composes an ONNX graph from the importer's own STFT /
+    MelWeightMatrix / ReduceSumSquare / MatMul / Log ops (dogfooding the
+    north-star path) and runs it through the BatchedExecutor — framing +
+    one batched rfft + the mel projection as a single MXU matmul.
+
+    Input column: 1-D float waveforms (object column of arrays, or a 2-D
+    equal-length column) or raw WAV bytes (parsed via WavStream's
+    format asserts). Clips in a batch pad to the longest; emitted
+    frame counts follow each clip's true length.
+    """
+
+    input_col = Param("waveform / wav-bytes column", default="audio")
+    output_col = Param("log-mel output column", default="features")
+    sample_rate = Param("sample rate when input is raw waveform",
+                        default=16000)
+    frame_length = Param("window size in samples", default=400)
+    frame_step = Param("hop in samples", default=160)
+    num_mel_bins = Param("mel filter count", default=64)
+    lower_hz = Param("mel filterbank lower edge", default=125.0)
+    upper_hz = Param("mel filterbank upper edge", default=7600.0)
+    log_offset = Param("epsilon inside the log", default=1e-6)
+
+    def _graph_bytes(self, sr: int) -> bytes:
+        from synapseml_tpu.onnx.builder import GraphBuilder
+
+        flen, step = int(self.frame_length), int(self.frame_step)
+        g = GraphBuilder(name="log_mel", opset=17)
+        sig = g.add_input("signal", np.float32, ["N", "L"])
+        win = g.add_initializer(
+            "win", np.hanning(flen).astype(np.float32))
+        stft = g.add_node(
+            "STFT", [sig, g.add_initializer(
+                "step", np.asarray(step, np.int64)), win], onesided=1)
+        power = g.add_node(
+            "ReduceSumSquare",
+            [stft, g.add_initializer("axes", np.asarray([-1], np.int64))],
+            keepdims=0)
+        mel = g.add_node("MelWeightMatrix", [
+            g.add_initializer("nmel", np.asarray(
+                int(self.num_mel_bins), np.int64)),
+            g.add_initializer("ndft", np.asarray(flen, np.int64)),
+            g.add_initializer("sr", np.asarray(sr, np.int64)),
+            g.add_initializer("lo", np.asarray(
+                float(self.lower_hz), np.float32)),
+            g.add_initializer("hi", np.asarray(
+                float(self.upper_hz), np.float32))])
+        melspec = g.add_node("MatMul", [power, mel])
+        logmel = g.add_node("Log", [g.add_node("Add", [
+            melspec, g.add_initializer("eps", np.asarray(
+                float(self.log_offset), np.float32))])])
+        g.add_output(logmel, np.float32, None)
+        return g.to_bytes()
+
+    def _waveform(self, v) -> Tuple[np.ndarray, int]:
+        if isinstance(v, (bytes, bytearray)):
+            ws = WavStream(bytes(v))
+            return ws.pcm.astype(np.float32) / 32768.0, ws.sample_rate
+        return np.asarray(v, np.float32), int(self.sample_rate)
+
+    def _transform(self, table: Table) -> Table:
+        from synapseml_tpu.onnx.importer import import_model
+        from synapseml_tpu.runtime.executor import BatchedExecutor
+
+        vals = table[self.input_col]
+        waves, srs = zip(*[self._waveform(v) for v in vals]) \
+            if table.num_rows else ((), ())
+        if len(set(srs)) > 1:
+            raise ValueError(
+                f"AudioFeaturizer: mixed sample rates {sorted(set(srs))} "
+                "in one batch")
+        sr = srs[0] if srs else int(self.sample_rate)
+        flen, step = int(self.frame_length), int(self.frame_step)
+        cache = self.__dict__.setdefault("_audio_cache", {})
+        key = (sr, flen, step, int(self.num_mel_bins),
+               float(self.lower_hz), float(self.upper_hz),
+               float(self.log_offset))
+        if key not in cache:
+            graph = import_model(self._graph_bytes(sr))
+            cache.clear()  # one device-resident config at a time
+            cache[key] = (graph, BatchedExecutor(
+                graph.apply, bound_args=(graph.params,)))
+        _, executor = cache[key]
+
+        # bucket the padded length to a power-of-two frame count: every
+        # distinct clip length would otherwise trace a fresh XLA program
+        # (the executor buckets only the batch axis); trailing padding is
+        # harmless because each row is trimmed to its true frame count
+        max_len = max(flen, *(len(w) for w in waves)) if waves else flen
+        frames = 1 + (max_len - flen) // step \
+            + (1 if (max_len - flen) % step else 0)
+        frames_b = 1 << max(frames - 1, 0).bit_length() if frames > 1 \
+            else 1
+        batch = np.zeros(
+            (table.num_rows, flen + (frames_b - 1) * step), np.float32)
+        for i, w in enumerate(waves):
+            batch[i, :len(w)] = w
+        (feats,) = executor(batch)
+        out = np.empty(table.num_rows, dtype=object)
+        for i, w in enumerate(waves):
+            n_frames = 1 + (len(w) - flen) // step if len(w) >= flen else 0
+            out[i] = np.asarray(feats[i][:n_frames], np.float32)
+        return table.with_column(self.output_col, out)

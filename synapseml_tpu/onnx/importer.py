@@ -1465,15 +1465,17 @@ def _mel_weight_matrix(ctx, num_mel_bins, dft_length, sample_rate,
     def mel_to_hz(m):
         return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
 
+    # the spec QUANTIZES edge frequencies to spectrogram-bin indices
+    # (floor((dft+1) * hz / sr)) and builds the triangles over bin
+    # indices — matching onnx's reference/ORT numerics exactly, peak
+    # 1.0 at each quantized center bin
     edges_hz = mel_to_hz(
         np.linspace(hz_to_mel(lo), hz_to_mel(hi), n_mel + 2))
-    bin_hz = np.arange(n_bins) * sr / n_dft
-    lower = edges_hz[:-2][None, :]       # [1, n_mel]
-    center = edges_hz[1:-1][None, :]
-    upper = edges_hz[2:][None, :]
-    f = bin_hz[:, None]                  # [n_bins, 1]
-    up = (f - lower) / np.maximum(center - lower, 1e-12)
-    down = (upper - f) / np.maximum(upper - center, 1e-12)
+    bins = np.floor((n_dft + 1) * edges_hz / sr).astype(np.int64)
+    left, center, right = bins[:-2], bins[1:-1], bins[2:]
+    f = np.arange(n_bins)[:, None].astype(np.float64)   # [n_bins, 1]
+    up = (f - left) / np.maximum(center - left, 1)
+    down = (right - f) / np.maximum(right - center, 1)
     w = np.maximum(0.0, np.minimum(up, down))
     dt = proto.TENSOR_DTYPES[int(ctx.attr("output_datatype", 1))]
     return jnp.asarray(w.astype(dt))
@@ -1903,6 +1905,11 @@ class ImportedGraph:
             "Squeeze": (1,), "Split": (1,), "Trilu": (1,),
             "ReduceSum": (1,), "ReduceMean": (1,), "ReduceMax": (1,),
             "ReduceMin": (1,), "ReduceProd": (1,), "CenterCropPad": (1,),
+            "ReduceSumSquare": (1,), "ReduceL1": (1,), "ReduceL2": (1,),
+            "ReduceLogSum": (1,), "ReduceLogSumExp": (1,),
+            # every MelWeightMatrix input is filterbank GEOMETRY (incl.
+            # the float hz edges); STFT's step/length are frame geometry
+            "MelWeightMatrix": (0, 1, 2, 3, 4), "STFT": (1, 3),
         }
         shape_fed = set()
         for node in graph.node:

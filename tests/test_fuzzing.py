@@ -638,6 +638,15 @@ def _test_objects():
             _svc(SpeechToTextSDK, audio_bytes="audio"),
             Table({"audio": np.array([_tiny_wav(), _tiny_wav()],
                                      dtype=object)})),
+        "AudioFeaturizer": lambda: (
+            __import__("synapseml_tpu.cognitive.speech",
+                       fromlist=["AudioFeaturizer"]).AudioFeaturizer(
+                frame_length=64, frame_step=32, num_mel_bins=8,
+                upper_hz=7000.0),
+            Table({"audio": np.array(
+                [np.sin(np.arange(400) / 5).astype(np.float32),
+                 np.cos(np.arange(300) / 7).astype(np.float32)],
+                dtype=object)})),
         "TagImage": lambda: (_svc(TagImage, image_url="url"), _url_table()),
         "DescribeImageExtended": lambda: (_svc(DescribeImageExtended,
                                                image_url="url"),

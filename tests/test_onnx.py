@@ -1123,6 +1123,8 @@ def test_mel_weight_matrix_spec_properties():
     w = np.asarray(gi.apply(gi.params)[0])
     assert w.shape == (65, 8)  # [dft//2+1, n_mel]
     assert (w >= 0).all() and w.max() <= 1.0 + 1e-6
+    # spec quantizes edges to bins: every filter peaks at EXACTLY 1.0
+    np.testing.assert_allclose(w.max(axis=0), 1.0)
     bin_hz = np.arange(65) * 8000 / 128
     # columns are triangles: each has one contiguous support inside
     # (100, 3800) and every filter has some energy
