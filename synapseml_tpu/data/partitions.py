@@ -17,9 +17,12 @@ process) drives
 Per-host aggregation builds ONE contiguous feature matrix (so the
 host->device transfer is a single placement, not a row loop); multi-host
 jobs join the mesh via :mod:`synapseml_tpu.parallel.distributed`
-(``rendezvous=...`` or ambient ``SYNAPSEML_*`` env), after which the
-dp-sharded fit psums histograms over ICI/DCN exactly like the single-host
-mesh path.
+(``rendezvous=...`` or ambient ``SYNAPSEML_*`` env). By default a
+multi-host fit is ROW-SHARDED: each host bins its own rows locally and
+only a capped bin-boundary sample plus per-iteration histograms cross
+DCN (the reference's ``tree_learner=data_parallel`` property — rows
+never leave their partition). ``row_sharded=False`` keeps the legacy
+gather fallback for small data.
 """
 from __future__ import annotations
 
@@ -126,6 +129,8 @@ class PartitionAggregator:
 
 def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
                    rendezvous: Optional[Dict[str, Any]] = None,
+                   row_sharded: Any = "auto",
+                   stats_out: Optional[Dict[str, Any]] = None,
                    **train_kw):
     """Fit this host's aggregated rows, joining a multi-host mesh first.
 
@@ -133,11 +138,17 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
     "rank_hint":...}`` wires the host into the driver rendezvous and the
     jax.distributed runtime (parallel/distributed.py) — the TPU-native
     replacement of the reference's NetworkInit TCP ring. Without it, the
-    ambient ``SYNAPSEML_*`` env (if any) is used. Under a multi-process
-    runtime, every host's rows are gathered to form the global dataset
-    (rows ride DCN once), then the dp-sharded mesh fit psums histograms;
-    rows therefore currently replicate per host — the mesh shards the
-    *compute*.
+    ambient ``SYNAPSEML_*`` env (if any) is used.
+
+    ``row_sharded``: ``"auto"`` (default) — multi-process jobs keep every
+    host's rows host-local and exchange only a capped bin sample plus
+    per-iteration histograms (:func:`~synapseml_tpu.gbdt.boosting.
+    train_row_sharded` — the reference's ``tree_learner=data_parallel``
+    scaling property, rows never leave their partition). ``False`` forces
+    the legacy gather fallback: every host's rows ride DCN once and
+    replicate on every host — O(total rows) per-host memory, only
+    sensible for small data. ``True`` forces row-sharded even
+    single-process (rows shard over local devices).
     """
     import jax
 
@@ -155,7 +166,7 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
         distributed.initialize()
 
     # validate the group forms BEFORE the O(n) concat (and before peers
-    # start waiting on this host's gather)
+    # start waiting on this host's collectives)
     direct_group = train_kw.pop("group", None)
     if direct_group is not None and agg.group_col is not None:
         raise TypeError(
@@ -164,52 +175,51 @@ def fit_aggregated(params, agg: PartitionAggregator, mesh=None,
     x, y, w = agg.to_arrays()
     group = agg.group_array()
     if direct_group is not None:
-        # direct group= arrays work single-host; multi-host needs the
-        # per-host relabel below, which only the group_col path gets
         group = np.asarray(direct_group)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+        if group.shape[0] != x.shape[0]:
+            # a short array would silently mis-pair tail rows after the
+            # multi-host padding round trip — fail loudly instead
+            raise ValueError(
+                f"group length {group.shape[0]} != row count {x.shape[0]}")
+    multi = jax.process_count() > 1
+    use_rs = row_sharded is True or (row_sharded == "auto" and multi)
+    if use_rs and row_sharded == "auto":
+        # a custom mesh the row-sharded layout can't honor (multi-axis,
+        # non-process-contiguous) keeps the gather path it always had;
+        # row_sharded=True lets train_row_sharded raise the precise error
+        from synapseml_tpu.gbdt.boosting import row_sharded_mesh_ok
+        use_rs = row_sharded_mesh_ok(mesh)
+    if use_rs:
+        from synapseml_tpu.gbdt.boosting import train_row_sharded
+        return train_row_sharded(params, x, y, weight=w, group=group,
+                                 mesh=mesh, stats_out=stats_out, **train_kw)
+    if stats_out is not None:
+        # every routing outcome reports where it went and what it held,
+        # so a caller asserting the accounting never reads an empty dict
+        stats_out.update(path="gather" if multi else "single_process",
+                         n_local=int(x.shape[0]))
+    if multi:
+        # gather fallback: every host materializes the global dataset
+        # (per-host memory O(total rows) — small data only)
+        from synapseml_tpu.parallel.distributed import host_allgather_rows
 
-        # per-host row counts differ: pad to the global max, gather, trim
-        n_local = np.asarray([x.shape[0]])
-        n_all = np.asarray(multihost_utils.process_allgather(n_local)
-                           ).reshape(-1)
-        n_max = max(int(n_all.max()), 1)  # keep the collective well-shaped
-                                          # even when every host is empty
-
-        def gather_64(a):
-            """Bit-exact gather of any 8-byte dtype (float64/int64): jax
-            would canonicalize them to 32-bit with x64 disabled, and a
-            rounding that crosses a bin quantile (or merges two query
-            ids) would silently break the single-fit identity — so the
-            values ride as uint32 words and come back in their dtype."""
-            dt = a.dtype
-            a = np.ascontiguousarray(
-                np.pad(a, [(0, n_max - a.shape[0])]
-                       + [(0, 0)] * (a.ndim - 1)))
-            words = a.view(np.uint32).reshape(n_max, -1)
-            out = np.asarray(multihost_utils.process_allgather(words))
-            out = out.reshape(len(n_all), n_max, -1)
-            return np.concatenate([
-                out[i, :n_all[i]].reshape(-1).view(dt).reshape(
-                    (n_all[i],) + a.shape[1:])
-                for i in range(len(n_all))])
-
-        x = gather_64(np.asarray(x, np.float64))
-        y = gather_64(np.asarray(y, np.float64))
+        x = host_allgather_rows(np.asarray(x, np.float64))
+        y = host_allgather_rows(np.asarray(y, np.float64))
         if w is not None:
-            w = gather_64(np.asarray(w, np.float64))
+            w = host_allgather_rows(np.asarray(w, np.float64))
         if group is not None:
             # hosts commonly number queries locally (0..N each), so raw
             # ids would collide across hosts and lambdarank would pair
             # rows of unrelated queries: relabel into disjoint per-host
             # ranges first (groups must not SPAN hosts — same contract
-            # as the reference's group-aligned partitioning)
+            # as the reference's group-aligned partitioning). Applies to
+            # both the group_col stream and a direct group= array.
+            from jax.experimental import multihost_utils
             uniq, inv = np.unique(group, return_inverse=True)
             counts = np.asarray(multihost_utils.process_allgather(
                 np.asarray([len(uniq)]))).reshape(-1)
             offset = int(counts[:jax.process_index()].sum())
-            group = gather_64((inv + offset).astype(np.int64))
+            group = host_allgather_rows((inv + offset).astype(np.int64))
         if mesh is None:
             from jax.sharding import Mesh
             mesh = Mesh(np.array(jax.devices()), ("dp",))
@@ -223,6 +233,8 @@ def fit_partitions(params, partitions: Iterable[Any],
                    weight_col: Optional[str] = None,
                    group_col: Optional[str] = None, mesh=None,
                    rendezvous: Optional[Dict[str, Any]] = None,
+                   row_sharded: Any = "auto",
+                   stats_out: Optional[Dict[str, Any]] = None,
                    **train_kw):
     """One-call form: stream ``partitions`` (an iterator of record
     batches — THIS executor's partitions) through a
@@ -232,4 +244,5 @@ def fit_partitions(params, partitions: Iterable[Any],
     for batch in partitions:
         agg.add(batch)
     return fit_aggregated(params, agg, mesh=mesh, rendezvous=rendezvous,
+                          row_sharded=row_sharded, stats_out=stats_out,
                           **train_kw)

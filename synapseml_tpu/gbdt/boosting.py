@@ -43,6 +43,10 @@ class BoostParams:
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
     max_bin: int = 255
+    # rows sampled to construct bin boundaries (LightGBM's
+    # bin_construct_sample_cnt); also the per-job gather budget of the
+    # row-sharded multi-host path (train_row_sharded)
+    bin_sample_count: int = 200_000
     feature_fraction: float = 1.0
     bagging_fraction: float = 1.0
     bagging_freq: int = 0
@@ -960,6 +964,7 @@ def train(
 
     mapper = BinMapper(max_bin=p.max_bin,
                        categorical_features=p.categorical_features,
+                       subsample=p.bin_sample_count,
                        seed=p.seed).fit(x)
     binned_np = mapper.transform(x)
     bdev = mapper.total_bins
@@ -968,13 +973,17 @@ def train(
         # route the hot op on a cached in-context measurement, not a
         # remembered experiment (see grower.resolve_hist_backend). On a
         # dp mesh each shard builds histograms over n/dp rows — probe the
-        # shape that actually executes.
+        # shape that actually executes. Fits too small to amortize the
+        # probe skip it (the fit_row_visits hint).
         from synapseml_tpu.gbdt.grower import resolve_hist_backend
         n_shard = n
         if mesh is not None and "dp" in mesh.axis_names:
             n_shard = max(1, n // int(mesh.shape["dp"]))
         gp = dataclasses.replace(
-            gp, hist_backend=resolve_hist_backend(n_shard, f, bdev))
+            gp, hist_backend=resolve_hist_backend(
+                n_shard, f, bdev,
+                fit_row_visits=n_shard * p.num_iterations * k
+                * p.num_leaves))
     thresholds = jnp.asarray(mapper.threshold_values(), jnp.float32)
 
     init = _init_score(p, y, weight)
@@ -989,42 +998,9 @@ def train(
     # Dispatch happens BEFORE any host->device transfer so the large [N,F]
     # matrix is only placed once, with its mesh sharding.
     # init_model validation + margins, shared by both dispatch paths
-    init_margins = None
-    if init_model is not None:
-        if p.boosting_type in ("dart", "rf"):
-            raise NotImplementedError(
-                f"init_model continuation is not defined for "
-                f"{p.boosting_type} (dart rescales past trees; rf averages)")
-        if init_model.num_class != k:
-            raise ValueError("init_model num_class mismatch")
-        # keep its init score so the combined booster's folded-init
-        # semantics stay consistent; num_iteration is passed explicitly:
-        # predict_raw would otherwise truncate at best_iteration while
-        # _prepend_init_trees prepends ALL trees
-        init = float(init_model.init_score)
-        n_init_iters = init_model.num_trees // max(k, 1)
-        init_margins = init_model.predict_raw(
-            x, num_iteration=n_init_iters).reshape(n, k)
-    if checkpoint_dir is not None and p.boosting_type == "dart":
-        raise NotImplementedError(
-            "step checkpointing is not defined for dart (past trees "
-            "are rescaled every round)")
-    if learning_rates is not None:
-        # schedule semantics are boosting-type properties, not device
-        # properties — identical guards on and off the mesh
-        if p.boosting_type == "dart":
-            raise NotImplementedError(
-                "per-iteration learning_rates are not defined for dart "
-                "(tree weights are renormalized every round)")
-        if p.boosting_type == "rf":
-            raise NotImplementedError(
-                "rf averages unshrunk trees; a learning-rate schedule "
-                "does not apply")
-        learning_rates = np.asarray(learning_rates, np.float32)
-        if learning_rates.shape != (p.num_iterations,):
-            raise ValueError(
-                f"learning_rates must have shape ({p.num_iterations},), "
-                f"got {learning_rates.shape}")
+    init, init_margins = _resume_state(p, init_model, k, x, init)
+    _validate_loop_extras(p, checkpoint_dir)
+    learning_rates = _validate_lr_schedule(p, learning_rates)
 
     if mesh is not None:
         return _train_distributed(
@@ -1113,7 +1089,7 @@ def train(
     # one compiled trainer instead of compiling 100
     key_p = dataclasses.replace(
         p, seed=0, num_iterations=1, early_stopping_round=0, verbosity=-1,
-        categorical_features=(), metric=None, max_bin=0,
+        categorical_features=(), metric=None, max_bin=0, bin_sample_count=0,
         deterministic=True,
         # with a schedule the static base LR is never read either
         learning_rate=0.0 if use_lr_schedule else p.learning_rate)
@@ -1144,6 +1120,300 @@ def train(
     if init_model is not None and booster.best_iteration >= 0:
         # best_iteration indexes the combined tree stack
         booster.best_iteration += init_model.num_trees // max(k, 1)
+    return booster
+
+
+def row_sharded_mesh_ok(mesh) -> bool:
+    """Whether :func:`train_row_sharded` can honor ``mesh``: a 1-axis dp
+    mesh whose devices are process-contiguous, in process order, with
+    equal per-process counts. ``fit_aggregated``'s auto routing falls
+    back to the gather path for meshes that fail this (rather than
+    breaking callers who relied on the gather path accepting any mesh)."""
+    if mesh is None:
+        return True
+    if ("dp" not in mesh.axis_names
+            or mesh.devices.size != int(mesh.shape["dp"])):
+        return False
+    by_proc: Dict[int, List[int]] = {}
+    for i, d in enumerate(mesh.devices.reshape(-1)):
+        by_proc.setdefault(d.process_index, []).append(i)
+    sizes = {len(v) for v in by_proc.values()}
+    if len(sizes) != 1:
+        return False
+    if not all(v == list(range(v[0], v[0] + len(v)))
+               for v in by_proc.values()):
+        return False
+    per = sizes.pop()
+    starts = [min(v) for _, v in sorted(by_proc.items())]
+    return starts == [i * per for i in range(len(by_proc))]
+
+
+def _init_score_sync(p: BoostParams, y, weight):
+    """boost_from_average over ALL hosts' rows, from host-local labels.
+
+    Mean-family objectives exchange two float64 sums per host; the
+    quantile family (quantile/l1/huber/mape) needs the full label
+    distribution, so the 1-D label vector rides DCN once (8 bytes/row —
+    the feature matrix never moves)."""
+    if not p.boost_from_average:
+        return 0.0
+    if p.objective in ("multiclass", "softmax", "multiclassova",
+                       "lambdarank", "rank_xendcg"):
+        return 0.0
+    from synapseml_tpu.parallel.distributed import host_allgather_rows
+
+    if p.objective in ("quantile", "regression_l1", "l1", "mae", "huber",
+                       "mape"):
+        # gather at the train loop's float32 width so the quantile math
+        # is bit-identical to the single-host _init_score
+        y_g = host_allgather_rows(np.asarray(y, np.float32))
+        if p.objective == "quantile":
+            return float(np.quantile(y_g, p.alpha))
+        return float(np.median(y_g))
+    y = np.asarray(y, np.float64)
+    w = weight if weight is not None else np.ones_like(y)
+    sums = host_allgather_rows(np.asarray(
+        [[float(np.sum(np.asarray(w, np.float64) * y)),
+          float(np.sum(np.asarray(w, np.float64)))]], np.float64))
+    mean = float(sums[:, 0].sum()) / max(float(sums[:, 1].sum()), 1e-300)
+    if p.objective in ("binary", "binary_logloss"):
+        pbar = float(np.clip(mean, 1e-12, 1 - 1e-12))
+        return float(np.log(pbar / (1 - pbar)) / p.sigmoid)
+    if p.objective in ("poisson", "tweedie"):
+        return float(np.log(max(mean, 1e-12)))
+    return mean
+
+
+def train_row_sharded(
+    p: BoostParams,
+    x: np.ndarray,
+    y: np.ndarray,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    valid_sets: Sequence[Tuple[np.ndarray, np.ndarray]] = (),
+    feature_names: Optional[List[str]] = None,
+    mesh=None,
+    init_model: Optional[Booster] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    learning_rates: Optional[np.ndarray] = None,
+    iteration_hook=None,
+    stats_out: Optional[Dict[str, Any]] = None,
+) -> Booster:
+    """Multi-host data-parallel training where ROWS NEVER LEAVE THEIR HOST.
+
+    The defining property of the reference's ``tree_learner=data_parallel``
+    (ref: lightgbm/.../LightGBMBase.scala:482-486 — each Spark task streams
+    only its own partition into a local native dataset;
+    TrainUtils.scala:279-295 — only fixed-size histograms cross the
+    network): ``x``/``y``/``weight``/``group`` here are THIS process's rows
+    only. What crosses DCN:
+
+    - a bin-boundary sample capped at ``p.bin_sample_count`` rows *total*
+      (LightGBM's ``bin_construct_sample_cnt`` — the native engine also
+      constructs distributed bin bounds from a synced sample);
+    - two float64 label sums for the init score (or the 1-D label vector,
+      for quantile-family objectives);
+    - per-iteration ``[F, B, 3]`` histogram psums + split decisions over
+      the dp axis — fixed-size, independent of total row count.
+
+    No process ever materializes the global ``[N, F]`` matrix: each host
+    bins its rows to uint8 locally and places them on its own devices
+    (``jax.make_array_from_single_device_arrays``), so peak per-host
+    memory is O(local rows + bin sample), where :func:`fit_aggregated`'s
+    gather fallback is O(total rows).
+
+    Identity: when the job's total rows fit the bin-sample budget and
+    partitions are in rank order, the gathered sample IS the dataset, bins
+    match a single-process fit exactly, and (histograms being placement-
+    invariant under psum) the booster is bit-identical to ``train``'s.
+    Larger jobs get sample-quantile bins — LightGBM's own distributed
+    semantics. ``valid_sets`` must be identical on every host (replicated,
+    like the reference's eval partition). Works single-process too (rows
+    shard over local devices).
+
+    ``stats_out`` (optional dict) receives layout/traffic accounting so
+    callers and tests can assert the no-replication property.
+    """
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.parallel.distributed import host_allgather_rows
+
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float32)
+    n_local, f = x.shape
+    k = (p.num_class
+         if p.objective in ("multiclass", "softmax", "multiclassova") else 1)
+    if weight is not None and len(weight) != n_local:
+        raise ValueError("weight length != row count")
+    nproc = jax.process_count()
+    pidx = jax.process_index()
+    if mesh is None:
+        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    if "dp" not in mesh.axis_names or mesh.devices.size != int(
+            mesh.shape["dp"]):
+        raise ValueError(
+            "train_row_sharded needs a 1-axis 'dp' mesh over the job's "
+            "devices")
+
+    flat = list(mesh.devices.reshape(-1))
+    n_dev = len(flat)
+    my_pos = sorted(i for i, d in enumerate(flat)
+                    if d.process_index == pidx)
+    if not my_pos:
+        raise ValueError("this process has no devices in the mesh")
+    if my_pos != list(range(my_pos[0], my_pos[0] + len(my_pos))):
+        raise ValueError(
+            "row-sharded training needs each process's devices contiguous "
+            "on the dp axis (the default Mesh over jax.devices() is)")
+    n_local_dev = len(my_pos)
+    dev_counts = host_allgather_rows(
+        np.asarray([n_local_dev], np.int64)).reshape(-1)
+    if len({int(c) for c in dev_counts}) != 1:
+        raise ValueError("unequal per-process device counts in the mesh")
+
+    # -- bin boundaries from a capped, synced sample ---------------------
+    n_all = host_allgather_rows(np.asarray([n_local], np.int64)).reshape(-1)
+    n_total = int(n_all.sum())
+    if n_total == 0:
+        raise ValueError("no rows to fit: every host's partition was empty")
+    budget = max(int(p.bin_sample_count), 1)
+    if n_total <= budget:
+        # the whole (possibly unbalanced) dataset fits the budget: every
+        # host contributes ALL its rows, preserving the bit-exact
+        # identity with a single-process fit regardless of skew
+        sample = x
+    else:
+        # proportional cap: each host's share of the budget matches its
+        # share of the rows (LightGBM's distributed sampling semantics)
+        per_host_budget = max(1, int(budget * n_local / n_total))
+        srng = np.random.default_rng(p.seed * 1000003 + pidx)
+        sample = x[np.sort(srng.choice(n_local,
+                                       min(per_host_budget, n_local),
+                                       replace=False))]
+    sample_g = host_allgather_rows(sample)
+    mapper = BinMapper(max_bin=p.max_bin,
+                       categorical_features=p.categorical_features,
+                       subsample=budget, seed=p.seed).fit(sample_g)
+    binned_local = mapper.transform(x)
+    bdev = mapper.total_bins
+    thresholds = jnp.asarray(mapper.threshold_values(), jnp.float32)
+
+    gp = dataclasses.replace(p.grower(), max_bin=bdev)
+    if gp.hist_backend == "auto":
+        from synapseml_tpu.gbdt.grower import resolve_hist_backend
+        n_shard = max(1, n_total // n_dev)
+        gp = dataclasses.replace(gp, hist_backend=resolve_hist_backend(
+            n_shard, f, bdev,
+            fit_row_visits=n_shard * p.num_iterations * k * p.num_leaves))
+
+    init = _init_score_sync(p, y, weight)
+    obj_fn = _objective_fn(p)
+    is_rank = p.objective in ("lambdarank", "rank_xendcg")
+    init, init_margins = _resume_state(p, init_model, k, x, init)
+    _validate_loop_extras(p, checkpoint_dir)
+    learning_rates = _validate_lr_schedule(p, learning_rates)
+
+    # -- host-local layout: this host's rows onto its own devices --------
+    if is_rank:
+        if group is None:
+            raise ValueError("ranking objectives need a group array")
+        group = np.asarray(group)
+        if group.shape[0] != n_local:
+            raise ValueError("group length != row count")
+        # disjoint per-host dense query ids (groups must not SPAN hosts —
+        # the reference's group-aligned partitioning contract)
+        uniq, inv = np.unique(group, return_inverse=True)
+        q_counts = host_allgather_rows(
+            np.asarray([len(uniq)], np.int64)).reshape(-1)
+        shard_idx, dense_gid, loads = _pack_queries(inv, n_local_dev)
+        dense_gid = dense_gid + int(q_counts[:pidx].sum())
+        per_local = int(loads.max()) if len(loads) else 0
+        per = max(1, int(host_allgather_rows(
+            np.asarray([[per_local]], np.int64)).max()))
+        per_host = per * n_local_dev
+        (binned_l, y_l, w_l, margins_l, padm_l,
+         gids_l) = _layout_shards(shard_idx, dense_gid, per, binned_local,
+                                  y, weight, init_margins, bdev,
+                                  neg_base=pidx * per_host)
+    else:
+        per_dev = -(-max(int(n_all.max()), 1) // n_local_dev)  # ceil
+        per_host = per_dev * n_local_dev
+        pad = per_host - n_local
+
+        def pad_rows(arr, fill=0):
+            if arr is None or pad == 0:
+                return arr
+            return np.concatenate(
+                [arr, np.full((pad,) + arr.shape[1:], fill, arr.dtype)])
+        binned_l = pad_rows(binned_local)
+        y_l, w_l = pad_rows(y), pad_rows(weight)
+        margins_l = pad_rows(init_margins)
+        padm_l = np.zeros(per_host, bool)
+        padm_l[:n_local] = True
+        gids_l = None
+
+    n_global = per_host * nproc
+    per_dev_g = n_global // n_dev
+    if my_pos[0] * per_dev_g != pidx * per_host:
+        raise ValueError(
+            "mesh device order does not match process order; use the "
+            "default Mesh over jax.devices()")
+
+    def make_global(local_np, spec):
+        """Assemble the global row-sharded array from THIS host's rows."""
+        shards = [
+            jax.device_put(local_np[j * per_dev_g:(j + 1) * per_dev_g],
+                           flat[i])
+            for j, i in enumerate(my_pos)]
+        return jax.make_array_from_single_device_arrays(
+            (n_global,) + local_np.shape[1:], NamedSharding(mesh, spec),
+            shards)
+
+    row_spec, mat_spec = P("dp"), P("dp", None)
+    if k > 1:
+        yoh_g = make_global(
+            np.eye(k, dtype=np.float32)[y_l.astype(np.int32)], mat_spec)
+        scores_l = (margins_l.astype(np.float32) if margins_l is not None
+                    else np.zeros((per_host, k), np.float32) + init)
+        scores_g = make_global(scores_l, mat_spec)
+    else:
+        yoh_g = None
+        scores_l = (margins_l[:, 0].astype(np.float32)
+                    if margins_l is not None
+                    else np.zeros(per_host, np.float32) + init)
+        scores_g = make_global(scores_l, row_spec)
+    placed = dict(
+        n=n_global, f=f,
+        binned=make_global(binned_l, mat_spec),
+        yd=make_global(y_l.astype(np.float32), row_spec),
+        wd=(make_global(w_l.astype(np.float32), row_spec)
+            if w_l is not None else None),
+        padm=make_global(padm_l, row_spec),
+        gids=(make_global(gids_l.astype(np.int32), row_spec)
+              if gids_l is not None else None),
+        yoh=yoh_g, scores=scores_g)
+
+    if stats_out is not None:
+        stats_out.update(
+            path="row_sharded",
+            n_local=int(n_local), n_total=n_total, n_global=n_global,
+            per_host_rows=int(per_host), n_features=int(f),
+            binned_local_shape=tuple(binned_l.shape),
+            sample_rows_sent=int(sample.shape[0]),
+            sample_rows_gathered=int(sample_g.shape[0]),
+            sample_gathered_bytes=int(sample_g.nbytes),
+            addressable_row_bytes=sum(
+                s.data.nbytes for s in placed["binned"].addressable_shards),
+            hist_backend=gp.hist_backend)
+
+    booster = _train_distributed(
+        p, mesh, None, None, None, k, init, obj_fn, gp, bdev, thresholds,
+        valid_sets, feature_names, group=None, init_model=init_model,
+        init_margins=None, checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every, iteration_hook=iteration_hook,
+        learning_rates=learning_rates, placed=placed)
     return booster
 
 
@@ -1196,14 +1466,163 @@ def _importances(b: Booster, num_features: int):
     return split, gain
 
 
+def _resume_state(p, init_model, k, x, default_init):
+    """Validate ``init_model`` and return (init score, margins over x's
+    rows). Keeps the resumed model's init score so the combined booster's
+    folded-init semantics stay consistent; num_iteration is passed
+    explicitly because predict_raw would otherwise truncate at
+    best_iteration while _prepend_init_trees prepends ALL trees."""
+    if init_model is None:
+        return default_init, None
+    if p.boosting_type in ("dart", "rf"):
+        raise NotImplementedError(
+            f"init_model continuation is not defined for "
+            f"{p.boosting_type} (dart rescales past trees; rf averages)")
+    if init_model.num_class != k:
+        raise ValueError("init_model num_class mismatch")
+    init = float(init_model.init_score)
+    n_init_iters = init_model.num_trees // max(k, 1)
+    margins = init_model.predict_raw(
+        x, num_iteration=n_init_iters).reshape(x.shape[0], k)
+    return init, margins
+
+
+def _validate_loop_extras(p, checkpoint_dir):
+    if checkpoint_dir is not None and p.boosting_type == "dart":
+        raise NotImplementedError(
+            "step checkpointing is not defined for dart (past trees "
+            "are rescaled every round)")
+
+
+def _validate_lr_schedule(p, learning_rates):
+    """Schedule semantics are boosting-type properties, not device
+    properties — identical guards on and off the mesh."""
+    if learning_rates is None:
+        return None
+    if p.boosting_type == "dart":
+        raise NotImplementedError(
+            "per-iteration learning_rates are not defined for dart "
+            "(tree weights are renormalized every round)")
+    if p.boosting_type == "rf":
+        raise NotImplementedError(
+            "rf averages unshrunk trees; a learning-rate schedule "
+            "does not apply")
+    learning_rates = np.asarray(learning_rates, np.float32)
+    if learning_rates.shape != (p.num_iterations,):
+        raise ValueError(
+            f"learning_rates must have shape ({p.num_iterations},), "
+            f"got {learning_rates.shape}")
+    return learning_rates
+
+
+def _pack_queries(group, n_shards):
+    """Greedily pack whole queries onto the least-loaded of ``n_shards``
+    shards. Returns (shard_idx row-index arrays, dense 0..nq-1 group ids,
+    per-shard loads). O(n log n): one stable argsort groups rows."""
+    group = np.asarray(group)
+    if group.size == 0:  # an empty host still participates in the mesh
+        return ([np.zeros(0, np.int64) for _ in range(n_shards)],
+                np.zeros(0, np.int64), np.zeros(n_shards, np.int64))
+    sort_idx = np.argsort(group, kind="stable")
+    sorted_g = group[sort_idx]
+    bounds = np.nonzero(sorted_g[1:] != sorted_g[:-1])[0] + 1
+    query_rows = np.split(sort_idx, bounds)
+    # keep first-appearance query order (matches the reference's
+    # repartitionByGroupingColumn stability)
+    query_rows.sort(key=lambda rows: int(rows.min()))
+    shard_rows: List[List[np.ndarray]] = [[] for _ in range(n_shards)]
+    loads = np.zeros(n_shards, np.int64)
+    for rows in query_rows:
+        tgt = int(np.argmin(loads))
+        shard_rows[tgt].append(rows)
+        loads[tgt] += len(rows)
+    shard_idx = [
+        np.concatenate(rs) if rs else np.zeros(0, np.int64)
+        for rs in shard_rows
+    ]
+    # device-side group ids are dense 0..nq-1 (user ids may themselves
+    # be negative; pad rows rely on negatives being free)
+    _, dense_gid = np.unique(group, return_inverse=True)
+    return shard_idx, dense_gid, loads
+
+
+def _layout_shards(shard_idx, dense_gid, per, binned_np, y, weight,
+                   init_margins, bdev, neg_base=0):
+    """Materialize a per-shard padded layout: each shard's rows followed
+    by pad rows up to ``per``. Pad rows get unique negative group ids
+    (no pairs -> zero gradients); ``neg_base`` offsets them so multiple
+    hosts' pads stay globally distinct."""
+    n_shards = len(shard_idx)
+    pad_mask_np = np.ones(per * n_shards, bool)
+    gids_np = np.full(per * n_shards, -1, np.int64)
+    for s, rows in enumerate(shard_idx):
+        base_off = s * per
+        gids_np[base_off:base_off + len(rows)] = dense_gid[rows]
+        pad_mask_np[base_off + len(rows):base_off + per] = False
+
+    def lay(arr, fill=0):
+        out = np.full((per * n_shards,) + arr.shape[1:], fill, arr.dtype)
+        for s, rows in enumerate(shard_idx):
+            out[s * per: s * per + len(rows)] = arr[rows]
+        return out
+    binned_np = lay(binned_np, fill=bdev - 1)
+    y = lay(y)
+    if weight is not None:
+        weight = lay(weight)
+    if init_margins is not None:
+        init_margins = lay(init_margins)
+    padidx = np.nonzero(~pad_mask_np)[0]
+    gids_np[padidx] = -(np.arange(len(padidx)) + 1 + neg_base)
+    return binned_np, y, weight, init_margins, pad_mask_np, gids_np
+
+
+def _layout_rows(is_rank, dpn, binned_np, y, weight, init_margins, group,
+                 bdev):
+    """Host-side row layout for the dp mesh: rank fits get group-aligned
+    shard packing, everything else pads to a multiple of dpn."""
+    n0, f = binned_np.shape
+    if is_rank:
+        shard_idx, dense_gid, loads = _pack_queries(group, dpn)
+        per = int(loads.max())
+        (binned_np, y, weight, init_margins, pad_mask_np,
+         gids_np) = _layout_shards(shard_idx, dense_gid, per, binned_np, y,
+                                   weight, init_margins, bdev)
+        n = per * dpn
+    else:
+        pad = (-n0) % dpn
+        pad_mask_np = np.ones(n0 + pad, bool)
+        if pad:
+            binned_np = np.vstack([binned_np,
+                                   np.zeros((pad, f), binned_np.dtype)])
+            y = np.concatenate([y, np.zeros(pad, y.dtype)])
+            if weight is not None:
+                weight = np.concatenate([weight, np.zeros(pad, weight.dtype)])
+            if init_margins is not None:
+                init_margins = np.vstack(
+                    [init_margins,
+                     np.zeros((pad, init_margins.shape[1]),
+                              init_margins.dtype)])
+            pad_mask_np[n0:] = False
+        n = n0 + pad
+        gids_np = None
+    return binned_np, y, weight, init_margins, pad_mask_np, gids_np, n
+
+
 def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                        bdev, thresholds, valid_sets, feature_names,
                        group=None, init_model=None, init_margins=None,
                        checkpoint_dir=None, checkpoint_every=0,
-                       iteration_hook=None, learning_rates=None):
+                       iteration_hook=None, learning_rates=None,
+                       placed=None):
     """dp-sharded training: shard_map over the mesh's 'dp' axis, with the
     boosting loop scanned on device (one host sync per chunk, as in the
     single-chip path).
+
+    ``placed`` — row-sharded entry (:func:`train_row_sharded`): a dict of
+    ALREADY-SHARDED global jax arrays (``binned, yd, wd, padm, gids, yoh,
+    scores``) plus ``n``/``f``; each host contributed only its local rows,
+    so the host-side layout below is skipped and no process ever holds the
+    global matrix.
 
     Every boosting mode runs on the mesh:
     - gbdt / rf: per-shard histograms psum'd over ICI (the TPU-native
@@ -1230,7 +1649,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     use_goss = p.boosting_type == "goss"
     is_rf = p.boosting_type == "rf"
     use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
-    if is_rank and group is None:
+    if is_rank and group is None and placed is None:
         raise ValueError("ranking objectives need a group array")
     renew_alpha = None
     if k == 1 and not is_dart:
@@ -1240,99 +1659,44 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             renew_alpha = p.alpha
 
     dpn = mesh.shape["dp"]
-    n0, f = binned_np.shape
-
-    # -- row layout ------------------------------------------------------
-    if is_rank:
-        # group-aligned sharding: greedily pack whole queries onto the
-        # least-loaded shard, then pad shards to a common length.
-        # O(n log n): one stable argsort groups rows; np.split slices them.
-        group = np.asarray(group)
-        sort_idx = np.argsort(group, kind="stable")
-        sorted_g = group[sort_idx]
-        bounds = np.nonzero(sorted_g[1:] != sorted_g[:-1])[0] + 1
-        query_rows = np.split(sort_idx, bounds)
-        # keep first-appearance query order (matches the reference's
-        # repartitionByGroupingColumn stability)
-        query_rows.sort(key=lambda rows: int(rows.min()))
-        shard_rows: List[List[np.ndarray]] = [[] for _ in range(dpn)]
-        loads = np.zeros(dpn, np.int64)
-        for rows in query_rows:
-            tgt = int(np.argmin(loads))
-            shard_rows[tgt].append(rows)
-            loads[tgt] += len(rows)
-        shard_idx = [
-            np.concatenate(rs) if rs else np.zeros(0, np.int64)
-            for rs in shard_rows
-        ]
-        per = int(loads.max())
-        # device-side group ids are dense 0..nq-1 (user ids may themselves
-        # be negative; the pad rows below rely on negatives being free)
-        _, dense_gid = np.unique(group, return_inverse=True)
-        pad_mask_np = np.ones(per * dpn, bool)
-        gids_np = np.full(per * dpn, -1, np.int64)
-        for s, rows in enumerate(shard_idx):
-            base_off = s * per
-            gids_np[base_off:base_off + len(rows)] = dense_gid[rows]
-            pad_mask_np[base_off + len(rows):base_off + per] = False
-
-        def lay(arr, fill=0):
-            out = np.full((per * dpn,) + arr.shape[1:], fill, arr.dtype)
-            for s, rows in enumerate(shard_idx):
-                out[s * per: s * per + len(rows)] = arr[rows]
-            return out
-        binned_np = lay(binned_np, fill=bdev - 1)
-        y = lay(y)
-        if weight is not None:
-            weight = lay(weight)
-        if init_margins is not None:
-            init_margins = lay(init_margins)
-        # padded rows get unique negative ids -> no pairs -> zero gradients
-        padidx = np.nonzero(~pad_mask_np)[0]
-        gids_np[padidx] = -(np.arange(len(padidx)) + 1)
-        n = per * dpn
-    else:
-        pad = (-n0) % dpn
-        pad_mask_np = np.ones(n0 + pad, bool)
-        if pad:
-            binned_np = np.vstack([binned_np,
-                                   np.zeros((pad, f), binned_np.dtype)])
-            y = np.concatenate([y, np.zeros(pad, y.dtype)])
-            if weight is not None:
-                weight = np.concatenate([weight, np.zeros(pad, weight.dtype)])
-            if init_margins is not None:
-                init_margins = np.vstack(
-                    [init_margins,
-                     np.zeros((pad, init_margins.shape[1]),
-                              init_margins.dtype)])
-            pad_mask_np[n0:] = False
-        n = n0 + pad
-        gids_np = None
 
     row_spec = P("dp")
     mat_spec = P("dp", None)
     rep = P()
+    y_onehot_spec = P("dp", None)
 
     def put(arr, spec):
         return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
 
-    binned = put(binned_np, mat_spec)
-    yd = put(y.astype(np.float32), row_spec)
-    wd = put(weight.astype(np.float32), row_spec) if weight is not None else None
-    padm = put(pad_mask_np, row_spec)
-    gids = put(gids_np, row_spec) if gids_np is not None else None
-    y_onehot_spec = P("dp", None)
-    if k > 1:
-        yoh = put(jax.nn.one_hot(jnp.asarray(y.astype(np.int32)), k), y_onehot_spec)
-        scores0 = (init_margins.astype(np.float32) if init_margins is not None
-                   else np.zeros((n, k), np.float32) + init)
-        scores = put(scores0, y_onehot_spec)
+    if placed is not None:
+        n, f = placed["n"], placed["f"]
+        binned, yd, wd = placed["binned"], placed["yd"], placed["wd"]
+        padm, gids, yoh = placed["padm"], placed["gids"], placed["yoh"]
+        scores = placed["scores"]
     else:
-        yoh = None
-        scores0 = (init_margins[:, 0].astype(np.float32)
-                   if init_margins is not None
-                   else np.zeros(n, np.float32) + init)
-        scores = put(scores0, row_spec)
+        f = binned_np.shape[1]
+        (binned_np, y, weight, init_margins, pad_mask_np, gids_np,
+         n) = _layout_rows(is_rank, dpn, binned_np, y, weight,
+                           init_margins, group, bdev)
+        binned = put(binned_np, mat_spec)
+        yd = put(y.astype(np.float32), row_spec)
+        wd = (put(weight.astype(np.float32), row_spec)
+              if weight is not None else None)
+        padm = put(pad_mask_np, row_spec)
+        gids = put(gids_np, row_spec) if gids_np is not None else None
+        if k > 1:
+            yoh = put(jax.nn.one_hot(jnp.asarray(y.astype(np.int32)), k),
+                      y_onehot_spec)
+            scores0 = (init_margins.astype(np.float32)
+                       if init_margins is not None
+                       else np.zeros((n, k), np.float32) + init)
+            scores = put(scores0, y_onehot_spec)
+        else:
+            yoh = None
+            scores0 = (init_margins[:, 0].astype(np.float32)
+                       if init_margins is not None
+                       else np.zeros(n, np.float32) + init)
+            scores = put(scores0, row_spec)
 
     total_steps = p.num_iterations * k
 

@@ -220,3 +220,37 @@ def global_mesh(want: Optional[Dict[str, int]] = None):
     from synapseml_tpu.parallel.mesh import build_mesh
 
     return build_mesh(jax.devices(), want=want)
+
+
+def host_allgather_rows(a):
+    """Bit-exact allgather of per-host row blocks (ragged first dim).
+
+    Hosts contribute different row counts: pad to the global max, gather,
+    trim. Any 8-byte dtype (float64/int64) rides as uint32 words — jax
+    would canonicalize 64-bit values to 32-bit with x64 disabled, and a
+    rounding that crosses a bin quantile (or merges two query ids) would
+    silently break fit identities. Returns the concatenation in process
+    order. Single-process: returns ``a`` unchanged (already contiguous).
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    a = np.ascontiguousarray(a)
+    if jax.process_count() == 1:
+        return a
+    n_all = np.asarray(multihost_utils.process_allgather(
+        np.asarray([a.shape[0]]))).reshape(-1)
+    # keep the collective well-shaped even when every host is empty
+    n_max = max(int(n_all.max()), 1)
+    dt = a.dtype
+    if dt.itemsize % 4:
+        raise TypeError(f"host_allgather_rows needs 4/8-byte dtypes, got {dt}")
+    a = np.ascontiguousarray(
+        np.pad(a, [(0, n_max - a.shape[0])] + [(0, 0)] * (a.ndim - 1)))
+    words = a.view(np.uint32).reshape(n_max, -1)
+    out = np.asarray(multihost_utils.process_allgather(words))
+    out = out.reshape(len(n_all), n_max, -1)
+    return np.concatenate([
+        out[i, :n_all[i]].reshape(-1).view(dt).reshape(
+            (n_all[i],) + a.shape[1:])
+        for i in range(len(n_all))])

@@ -710,3 +710,46 @@ def test_hist_route_probe_and_disk_cache(tmp_path, monkeypatch):
     assert grower.resolve_hist_backend(4096, 6, 64, iters=8) == "xla"
     assert not cache_file.exists()
     grower._HIST_ROUTE_CACHE.clear()
+
+
+def test_hist_probe_scaled_to_fit_size(monkeypatch):
+    """The probe is skipped entirely for fits too small to amortize it
+    (structural guarantee that a first small fit pays <1 s of routing
+    overhead, not the 10-17 s full probe), runs for big fits, and caps
+    its per-call budget at ~1/8 of a mid-size fit's estimated work —
+    never below the floor that keeps it measuring compute, not RTT."""
+    from synapseml_tpu.gbdt import grower
+
+    def forbid(*a, **k):
+        raise AssertionError("probe must not run for a small fit")
+
+    monkeypatch.setattr(grower, "_resolve_hist_backend_local", forbid)
+    small = grower._PROBE_MIN_FIT_ROW_VISITS - 1
+    assert grower.resolve_hist_backend(
+        4096, 10, 64, fit_row_visits=small) == "xla"
+
+    seen = {}
+
+    def record(n, f, n_bins, iters=None, fit_row_visits=None):
+        seen["fit_row_visits"] = fit_row_visits
+        return "pallas"
+
+    monkeypatch.setattr(grower, "_resolve_hist_backend_local", record)
+    assert grower.resolve_hist_backend(
+        100_000, 10, 64, fit_row_visits=10**9) == "pallas"
+    assert seen["fit_row_visits"] == 10**9
+
+    # budget arithmetic: full for big fits, capped for mid, floored
+    full, floor = grower._PROBE_FULL_BUDGET, grower._PROBE_FLOOR_BUDGET
+    cap = lambda v: min(full, max(floor, v // 8))  # noqa: E731
+    assert cap(10**10) == full
+    assert cap(100 * 10**6) == 100 * 10**6 // 8
+    assert cap(grower._PROBE_MIN_FIT_ROW_VISITS) == floor
+
+    # train() threads the hint: a tiny fit routes to xla without probing
+    monkeypatch.setattr(grower, "_resolve_hist_backend_local", forbid)
+    x = np.random.default_rng(0).normal(size=(200, 4))
+    y = (x[:, 0] > 0).astype(np.float64)
+    b = train(BoostParams(objective="binary", num_iterations=3,
+                          num_leaves=7), x, y)
+    assert b.num_trees == 3

@@ -89,6 +89,45 @@ def test_aggregator_validation_and_weights():
         fit_aggregated(BoostParams(objective="binary", num_iterations=2),
                        PartitionAggregator(["a"], label_col="y"))
 
+    # a direct group= array must cover every row — a short one would
+    # silently mis-pair tail rows after the multi-host padding round trip
+    agg2 = PartitionAggregator(["a"], label_col="y")
+    agg2.add({"a": [1.0, 2.0, 3.0], "y": [0.0, 1.0, 0.0]})
+    with pytest.raises(ValueError, match="group length"):
+        fit_aggregated(BoostParams(objective="lambdarank", num_iterations=2),
+                       agg2, group=np.asarray([0, 0]))
+
+
+def test_row_sharded_single_process_matches_mesh_fit():
+    """train_row_sharded degenerates to the dp-mesh fit when one process
+    owns all rows: bit-identical boosters across objectives + boosting
+    types (the histogram psum is placement-invariant)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from synapseml_tpu.gbdt.boosting import train_row_sharded
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(480, 6))
+    w = rng.random(480) + 0.5
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    cases = [
+        (dict(objective="binary", num_iterations=6, num_leaves=7),
+         (x[:, 0] + x[:, 1] > 0).astype(np.float64)),
+        (dict(objective="multiclass", num_class=3, num_iterations=4,
+              num_leaves=7),
+         np.digitize(x[:, 0] + x[:, 1], [-0.5, 0.5]).astype(np.float64)),
+        (dict(objective="quantile", alpha=0.7, num_iterations=4,
+              num_leaves=7), x[:, 0] * 2 + x[:, 1]),
+        (dict(objective="regression", boosting_type="goss",
+              num_iterations=4, num_leaves=7), x[:, 0] * 2 + x[:, 1]),
+    ]
+    for pkw, yy in cases:
+        p = BoostParams(**pkw)
+        want = train(p, x, yy, weight=w, mesh=mesh).predict(x)
+        got = train_row_sharded(p, x, yy, weight=w).predict(x)
+        np.testing.assert_array_equal(got, want, err_msg=str(pkw))
+
 
 def test_fit_partitions_ranker_groups():
     """group_col streams query-group ids through the adapter: the
@@ -122,16 +161,31 @@ def test_fit_partitions_ranker_groups():
     assert ga.dtype == np.int64 and ga[0] != ga[1]
 
 
-def test_two_process_partition_fit_matches_single_fit():
-    """The real N-executor proof: two OS processes each stream HALF the
-    rows through the partition adapter, rendezvous via the driver socket,
-    join jax.distributed, and the mesh fit yields the SAME booster as a
-    single-process fit over the full table."""
-    from synapseml_tpu.io.serving import find_open_port
+def _run_two_workers(worker_code, ports, timeout=240):
+    """Spawn two rank processes running ``worker_code`` (with {rdv_port}/
+    {coord_port} substituted); assert both exit 0 and print 'ok'."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = "."
+    code = (worker_code
+            .replace("{rdv_port}", str(ports[0]))
+            .replace("{coord_port}", str(ports[1])))
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code, str(i)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+        for i in range(2)
+    ]
+    outs = [(p_.returncode, *p_.communicate(timeout=timeout))
+            for p_ in procs]
+    for p_, (rc, out, err) in zip(procs, outs):
+        assert p_.returncode == 0, err[-2000:]
+        assert "ok" in out, (out, err[-1000:])
 
-    rdv_port = find_open_port(26700)
-    coord_port = find_open_port(26800)
-    worker_code = """
+
+_WORKER_PRELUDE = """
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -140,6 +194,116 @@ import numpy as np
 from synapseml_tpu.data.partitions import fit_partitions
 from synapseml_tpu.gbdt.boosting import BoostParams, train
 from synapseml_tpu.parallel.distributed import DriverRendezvous
+RDV = {"driver_host": "127.0.0.1", "driver_port": {rdv_port},
+       "my_host": "127.0.0.1", "rank_hint": rank_hint,
+       "coordinator_port": {coord_port}}
+if rank_hint == 0:
+    DriverRendezvous(num_workers=2, host="127.0.0.1",
+                     port={rdv_port}).start()
+"""
+
+
+def test_two_process_row_sharded_never_materializes_global_matrix():
+    """THE scale property (reference tree_learner=data_parallel,
+    LightGBMBase.scala:482-486): rows stay host-local. Every cross-host
+    gather is spied on — none may carry the global feature matrix; the
+    only row-bearing gather is the bin sample, capped by
+    bin_sample_count. Each host's device-placed rows cover only ITS
+    partition (+pad), asserted from the actual addressable shards."""
+    from synapseml_tpu.io.serving import find_open_port
+
+    worker_code = _WORKER_PRELUDE + """
+from jax.experimental import multihost_utils
+gathered_bytes = []
+_orig = multihost_utils.process_allgather
+def spy(a, *args, **kw):
+    gathered_bytes.append(np.asarray(a).nbytes)
+    return _orig(a, *args, **kw)
+multihost_utils.process_allgather = spy
+
+n, d = 400, 4
+rng = np.random.default_rng(0)
+x = rng.normal(size=(n, d))
+y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+cols = [f"f{j}" for j in range(d)]
+lo, hi = (0, 200) if rank_hint == 0 else (200, 400)
+batches = [{**{c: x[lo:hi, j] for j, c in enumerate(cols)},
+            "label": y[lo:hi]}]
+# bin sample budget 120 rows TOTAL: bins come from a 60-row-per-host
+# sample, so the full 400x4 matrix can never be reconstructed anywhere
+p = BoostParams(objective="binary", num_iterations=8, num_leaves=7,
+                bin_sample_count=120)
+stats = {}
+b = fit_partitions(p, batches, feature_cols=cols, rendezvous=RDV,
+                   stats_out=stats)
+full_matrix_bytes = n * d * 8
+assert max(gathered_bytes) < full_matrix_bytes, gathered_bytes
+# the one row-bearing gather is the bin sample: 60 rows x 4 f64 columns
+# as uint32 words = 1920 B per host block
+assert stats["sample_rows_gathered"] <= 120, stats
+assert stats["sample_rows_sent"] <= 60, stats
+# this host's device-resident rows = its own 200 (+pad), not 400
+assert stats["binned_local_shape"][0] == 200, stats
+assert stats["addressable_row_bytes"] == 200 * d, stats  # uint8 bins
+assert stats["n_global"] == 400, stats
+# sample-quantile bins (LightGBM distributed semantics): same model
+# family, predictions track the exact-bin single fit closely
+single = train(BoostParams(objective="binary", num_iterations=8,
+                           num_leaves=7), x, y)
+pb, ps = b.predict(x), single.predict(x)
+assert b.num_trees == single.num_trees
+assert np.corrcoef(pb, ps)[0, 1] > 0.98, np.corrcoef(pb, ps)[0, 1]
+print("NOREP", rank_hint, "ok", flush=True)
+"""
+    _run_two_workers(worker_code, (find_open_port(27100),
+                                   find_open_port(27200)))
+
+
+def test_two_process_empty_host_and_weight_col():
+    """An executor with ZERO rows (empty Spark partitions are routine,
+    ref LightGBMBase.scala:348-356) must still join every collective and
+    produce the same booster the other host's rows imply — with
+    weight_col streaming through the adapter."""
+    from synapseml_tpu.io.serving import find_open_port
+
+    worker_code = _WORKER_PRELUDE + """
+n, d = 300, 4
+rng = np.random.default_rng(3)
+x = rng.normal(size=(n, d))
+y = (x[:, 0] - 0.5 * x[:, 2] > 0).astype(np.float64)
+w = rng.random(n) + 0.5
+cols = [f"f{j}" for j in range(d)]
+if rank_hint == 0:
+    batches = [{**{c: x[:, j] for j, c in enumerate(cols)},
+                "label": y, "wt": w}]
+else:
+    batches = []  # empty executor
+p = BoostParams(objective="binary", num_iterations=8, num_leaves=7)
+stats = {}
+b = fit_partitions(p, batches, feature_cols=cols, weight_col="wt",
+                   rendezvous=RDV, stats_out=stats)
+assert stats["n_local"] == (300 if rank_hint == 0 else 0), stats
+assert stats["n_total"] == 300, stats
+single = train(p, x, y, weight=w)
+assert b.num_trees == single.num_trees
+np.testing.assert_allclose(b.predict(x), single.predict(x), rtol=1e-12)
+print("EMPTYHOST", rank_hint, "ok", flush=True)
+"""
+    _run_two_workers(worker_code, (find_open_port(27300),
+                                   find_open_port(27400)))
+
+
+def test_two_process_partition_fit_matches_single_fit():
+    """The real N-executor proof: two OS processes each stream HALF the
+    rows through the partition adapter, rendezvous via the driver socket,
+    join jax.distributed, and the (row-sharded) fit yields the SAME
+    booster as a single-process fit over the full table — the dataset is
+    under the bin-sample budget, so the sample gather IS the dataset and
+    the identity is bit-exact. The gather fallback (row_sharded=False)
+    must produce the identical booster too."""
+    from synapseml_tpu.io.serving import find_open_port
+
+    worker_code = _WORKER_PRELUDE + """
 rng = np.random.default_rng(0)
 x = rng.normal(size=(400, 4))
 y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
@@ -147,63 +311,36 @@ cols = [f"f{i}" for i in range(4)]
 lo, hi = (0, 200) if rank_hint == 0 else (200, 400)
 batches = [{**{c: x[a:b, j] for j, c in enumerate(cols)}, "label": y[a:b]}
            for a, b in [(lo, (lo+hi)//2), ((lo+hi)//2, hi)]]
-if rank_hint == 0:
-    DriverRendezvous(num_workers=2, host="127.0.0.1", port={rdv_port}).start()
 p = BoostParams(objective="binary", num_iterations=8, num_leaves=7)
-b = fit_partitions(p, batches, feature_cols=cols,
-                   rendezvous={"driver_host": "127.0.0.1",
-                               "driver_port": {rdv_port},
-                               "my_host": "127.0.0.1",
-                               "rank_hint": rank_hint,
-                               "coordinator_port": {coord_port}})
+stats = {}
+b = fit_partitions(p, batches, feature_cols=cols, rendezvous=RDV,
+                   stats_out=stats)
+assert stats["path"] == "row_sharded", stats
 single = train(p, x, y)
-pred_b = b.predict(x)
-pred_s = single.predict(x)
 assert b.num_trees == single.num_trees, (b.num_trees, single.num_trees)
-# the f64 rows ride the gather bit-exactly, so the boosters are identical
-np.testing.assert_allclose(pred_b, pred_s, rtol=1e-12)
+# rows <= bin_sample_count: the sample IS the dataset -> identical bins
+np.testing.assert_allclose(b.predict(x), single.predict(x), rtol=1e-12)
+# legacy gather fallback: same booster, different data plane
+stats_g = {}
+bg = fit_partitions(p, batches, feature_cols=cols, row_sharded=False,
+                    stats_out=stats_g)
+assert stats_g["path"] == "gather", stats_g
+np.testing.assert_allclose(bg.predict(x), single.predict(x), rtol=1e-12)
 print("PARTFIT", rank_hint, "ok", b.num_trees, flush=True)
-""".replace("{rdv_port}", str(rdv_port)).replace("{coord_port}",
-                                                 str(coord_port))
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = "."
-    procs = [
-        subprocess.Popen([sys.executable, "-c", worker_code, str(i)],
-                         env=env, stdout=subprocess.PIPE,
-                         stderr=subprocess.PIPE, text=True,
-                         cwd=os.path.dirname(os.path.dirname(
-                             os.path.abspath(__file__))))
-        for i in range(2)
-    ]
-    outs = []
-    for p_ in procs:
-        out, err = p_.communicate(timeout=180)
-        outs.append((p_.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, err[-2000:]
-        assert "ok" in out
+"""
+    _run_two_workers(worker_code, (find_open_port(26700),
+                                   find_open_port(26800)))
 
 
 def test_two_process_ranker_groups_relabel_across_hosts():
     """Two executors each number their queries LOCALLY (both send qid
-    0..19): the multi-host path must relabel into disjoint ranges before
-    the gather, reproducing the single-fit booster over globally-unique
-    ids — without relabeling, lambdarank would pair rows of unrelated
-    queries across hosts."""
+    0..19): the multi-host path must relabel into disjoint ranges,
+    reproducing the single-fit booster over globally-unique ids —
+    without relabeling, lambdarank would pair rows of unrelated queries
+    across hosts."""
     from synapseml_tpu.io.serving import find_open_port
 
-    rdv_port = find_open_port(26900)
-    coord_port = find_open_port(27000)
-    worker_code = """
-import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-rank_hint = int(sys.argv[1])
-import numpy as np
-from synapseml_tpu.data.partitions import fit_partitions
-from synapseml_tpu.gbdt.boosting import BoostParams, train
-from synapseml_tpu.parallel.distributed import DriverRendezvous
+    worker_code = _WORKER_PRELUDE + """
 rng = np.random.default_rng(0)
 n_q, per_q = 40, 8
 n = n_q * per_q
@@ -216,33 +353,13 @@ q_local = q_global[lo:hi] - (0 if rank_hint == 0 else 20)  # both 0..19
 assert q_local.min() == 0
 batches = [{**{c: x[lo:hi, j] for j, c in enumerate(cols)},
             "label": rel[lo:hi], "qid": q_local}]
-if rank_hint == 0:
-    DriverRendezvous(num_workers=2, host="127.0.0.1", port={rdv_port}).start()
 p = BoostParams(objective="lambdarank", num_iterations=6, num_leaves=7,
                 min_data_in_leaf=2)
 b = fit_partitions(p, batches, feature_cols=cols, group_col="qid",
-                   rendezvous={"driver_host": "127.0.0.1",
-                               "driver_port": {rdv_port},
-                               "my_host": "127.0.0.1",
-                               "rank_hint": rank_hint,
-                               "coordinator_port": {coord_port}})
+                   rendezvous=RDV)
 single = train(p, x, rel, group=q_global)
 np.testing.assert_allclose(b.predict(x), single.predict(x), rtol=1e-12)
 print("RANKFIT", rank_hint, "ok", flush=True)
-""".replace("{rdv_port}", str(rdv_port)).replace("{coord_port}",
-                                                 str(coord_port))
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = "."
-    procs = [
-        subprocess.Popen([sys.executable, "-c", worker_code, str(i)],
-                         env=env, stdout=subprocess.PIPE,
-                         stderr=subprocess.PIPE, text=True,
-                         cwd=os.path.dirname(os.path.dirname(
-                             os.path.abspath(__file__))))
-        for i in range(2)
-    ]
-    for p_ in procs:
-        out, err = p_.communicate(timeout=180)
-        assert p_.returncode == 0, err[-2000:]
-        assert "ok" in out
+"""
+    _run_two_workers(worker_code, (find_open_port(26900),
+                                   find_open_port(27000)))
