@@ -261,6 +261,100 @@ def _matmul_integer(ctx, a, b, a_zp=None, b_zp=None):
         else jnp.matmul(a32, b32)
 
 
+@op("DynamicQuantizeLinear")
+def _dynamic_quantize_linear(ctx, x):
+    """x -> (uint8 y, scale, zero_point), ONNX spec formula: the range is
+    extended to include 0 so zero stays exactly representable (the
+    dynamic-quantization idiom onnxruntime emits for int8 inference)."""
+    x = jnp.asarray(x, jnp.float32)
+    mn = jnp.minimum(x.min(), 0.0)
+    mx = jnp.maximum(x.max(), 0.0)
+    scale = (mx - mn) / 255.0
+    scale = jnp.where(scale <= 0, jnp.float32(1.0), scale)  # constant input
+    zp = jnp.clip(jnp.round(-mn / scale), 0, 255)
+    y = jnp.clip(jnp.round(x / scale) + zp, 0, 255).astype(jnp.uint8)
+    return y, scale.astype(jnp.float32), zp.astype(jnp.uint8)
+
+
+def _int_conv_core(ctx, x, w, x_zp=None, w_zp=None):
+    """Zero-point-shifted integer conv accumulating in int32 — the shared
+    engine of ConvInteger and QLinearConv. On TPU the MXU consumes the
+    int operands directly (preferred_element_type=int32)."""
+    x32 = jnp.asarray(x).astype(jnp.int32)
+    w32 = jnp.asarray(w).astype(jnp.int32)
+    if x_zp is not None:
+        x32 = x32 - jnp.asarray(x_zp).astype(jnp.int32)  # scalar per spec
+    if w_zp is not None:
+        zp = jnp.asarray(w_zp).astype(jnp.int32)
+        if zp.ndim == 1:  # per-output-channel
+            zp = zp.reshape((-1,) + (1,) * (w32.ndim - 1))
+        w32 = w32 - zp
+    rank = x32.ndim - 2
+    strides = ctx.attr("strides", [1] * rank)
+    dilations = ctx.attr("dilations", [1] * rank)
+    group = ctx.attr("group", 1)
+    kernel = ctx.attr("kernel_shape", list(w32.shape[2:]))
+    pads = _resolve_pads(ctx, x32.shape[2:], kernel, strides, dilations)
+    return lax.conv_general_dilated(
+        x32, w32, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, feature_group_count=group,
+        dimension_numbers=_conv_dims(rank),
+        preferred_element_type=jnp.int32)
+
+
+@op("ConvInteger")
+def _conv_integer(ctx, x, w, x_zp=None, w_zp=None):
+    """int8/uint8 conv -> raw int32 accumulator (the integer half of a
+    dynamically-quantized conv; requantization happens in the graph)."""
+    return _int_conv_core(ctx, x, w, x_zp, w_zp)
+
+
+def _requantize(acc32, combined_scale, y_zp):
+    """int32 accumulator -> affine-quantized output: scale in float32,
+    round-half-to-even, shift by the output zero point, saturate to the
+    zero point's dtype (onnxruntime's requantization semantics)."""
+    out_dt = np.dtype(np.asarray(y_zp).dtype)
+    info = np.iinfo(out_dt)
+    q = (jnp.round(acc32.astype(jnp.float32) * combined_scale)
+         + jnp.asarray(y_zp).astype(jnp.float32))
+    return jnp.clip(q, info.min, info.max).astype(out_dt)
+
+
+@op("QLinearConv")
+def _qlinear_conv(ctx, x, x_scale, x_zp, w, w_scale, w_zp, y_scale, y_zp,
+                  b=None):
+    """Statically-quantized conv (onnxruntime static-QDQ exports,
+    ref ONNXModel.scala:173-193 — the reference scores whatever ORT
+    runs): int32 accumulation, then requantization. Bias is int32 at
+    scale x_scale*w_scale per spec; w_scale may be per-output-channel."""
+    acc = _int_conv_core(ctx, x, w, x_zp, w_zp)
+    rank = acc.ndim - 2
+    if b is not None:
+        acc = acc + jnp.asarray(b).astype(jnp.int32).reshape(
+            (1, -1) + (1,) * rank)
+    w_s = jnp.asarray(w_scale, jnp.float32)
+    if w_s.ndim == 1:
+        w_s = w_s.reshape((1, -1) + (1,) * rank)
+    combined = (jnp.asarray(x_scale, jnp.float32) * w_s
+                / jnp.asarray(y_scale, jnp.float32))
+    return _requantize(acc, combined, y_zp)
+
+
+@op("QLinearMatMul")
+def _qlinear_matmul(ctx, a, a_scale, a_zp, b, b_scale, b_zp, y_scale,
+                    y_zp):
+    """Statically-quantized matmul: MatMulInteger accumulation + the
+    shared requantization. 1-D a_scale is per-row, 1-D b_scale is
+    per-column (ONNX spec broadcast)."""
+    acc = _matmul_integer(ctx, a, b, a_zp, b_zp)
+    a_s = jnp.asarray(a_scale, jnp.float32)
+    if a_s.ndim == 1:
+        a_s = a_s[:, None]
+    combined = (a_s * jnp.asarray(b_scale, jnp.float32)
+                / jnp.asarray(y_scale, jnp.float32))
+    return _requantize(acc, combined, y_zp)
+
+
 @op("Clip")
 def _clip(ctx, x, lo=None, hi=None):
     if ctx.opset < 11:
@@ -1871,6 +1965,190 @@ def _rnn(ctx, x, w, r, b=None, seq_lens=None, init_h=None):
 
 
 # ---------------------------------------------------------------------------
+# Detection ops (SSD / YOLO / Faster-RCNN export families)
+# ---------------------------------------------------------------------------
+
+def _nms_iou_corners(boxes, center_point_box):
+    """[N, 4] -> (y1, x1, y2, x2) normalized corners + areas, per ONNX
+    NMS conventions (corner coords may arrive in either diagonal order;
+    center format is [x_c, y_c, w, h])."""
+    xp = jnp if not _is_host(boxes) else np
+    if center_point_box:
+        xc, yc, w, h = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+        y1, y2 = yc - h / 2, yc + h / 2
+        x1, x2 = xc - w / 2, xc + w / 2
+    else:
+        y1 = xp.minimum(boxes[:, 0], boxes[:, 2])
+        y2 = xp.maximum(boxes[:, 0], boxes[:, 2])
+        x1 = xp.minimum(boxes[:, 1], boxes[:, 3])
+        x2 = xp.maximum(boxes[:, 1], boxes[:, 3])
+    area = (y2 - y1) * (x2 - x1)
+    return y1, x1, y2, x2, area
+
+
+def _nms_host(boxes, scores, max_out, iou_th, score_th, center):
+    """Exact ONNX semantics on host data: [num_selected, 3] int64 rows of
+    (batch, class, box), per-class score-descending selection order."""
+    nb, nc, n = scores.shape
+    rows = []
+    for bi in range(nb):
+        y1, x1, y2, x2, area = _nms_iou_corners(boxes[bi], center)
+        for ci in range(nc):
+            s = scores[bi, ci]
+            cand = np.argsort(-s, kind="stable")
+            if score_th is not None:
+                cand = cand[s[cand] > score_th]
+            chosen: List[int] = []
+            for i in cand:
+                if len(chosen) >= max_out:
+                    break
+                ok = True
+                for j in chosen:
+                    yy1 = max(y1[i], y1[j]); xx1 = max(x1[i], x1[j])
+                    yy2 = min(y2[i], y2[j]); xx2 = min(x2[i], x2[j])
+                    inter = max(0.0, yy2 - yy1) * max(0.0, xx2 - xx1)
+                    union = area[i] + area[j] - inter
+                    if union > 0 and inter / union > iou_th:
+                        ok = False
+                        break
+                if ok:
+                    chosen.append(int(i))
+            rows.extend([bi, ci, i] for i in chosen)
+    return (np.asarray(rows, np.int64).reshape(-1, 3) if rows
+            else np.zeros((0, 3), np.int64))
+
+
+@op("NonMaxSuppression")
+def _non_max_suppression(ctx, boxes, scores, max_out=None, iou_th=None,
+                         score_th=None):
+    """ONNX NMS (ref ONNXModel.scala:173-193 — the reference scores every
+    ORT-runnable detection export). Host inputs get the exact
+    data-dependent [num_selected, 3] result. Traced inputs get the
+    TPU-native fixed-capacity formulation: XLA cannot emit data-dependent
+    shapes, so the result is [num_batches*num_classes*max_out, 3] in the
+    same (batch, class, score-descending) order with unused slots as
+    [-1, -1, -1] rows — consumers mask/compact on the first column.
+    The selection itself is a lax.scan of argmax+IoU-suppression steps
+    vmapped over (batch, class): O(max_out * N) vector work, no
+    per-box host loop."""
+    center = ctx.attr("center_point_box", 0)
+    n_max = 0 if max_out is None else int(np.asarray(max_out).reshape(()))
+    iou = 0.0 if iou_th is None else float(np.asarray(iou_th).reshape(()))
+    sth = (None if score_th is None
+           else float(np.asarray(score_th).reshape(())))
+    if n_max <= 0:
+        return np.zeros((0, 3), np.int64)
+    if _all_host((boxes, scores)):
+        return _nms_host(np.asarray(boxes, np.float32),
+                         np.asarray(scores, np.float32),
+                         n_max, iou, sth, center)
+
+    boxes = jnp.asarray(boxes, jnp.float32)
+    scores = jnp.asarray(scores, jnp.float32)
+    nb, nc, n = scores.shape
+
+    def one_class(box_b, s):
+        y1, x1, y2, x2, area = _nms_iou_corners(box_b, center)
+        alive0 = (s > sth) if sth is not None else jnp.ones(n, bool)
+
+        def step(alive, _):
+            cand = jnp.where(alive, s, -jnp.inf)
+            i = jnp.argmax(cand)
+            valid = cand[i] > -jnp.inf
+            yy1 = jnp.maximum(y1, y1[i]); xx1 = jnp.maximum(x1, x1[i])
+            yy2 = jnp.minimum(y2, y2[i]); xx2 = jnp.minimum(x2, x2[i])
+            inter = (jnp.maximum(yy2 - yy1, 0.0)
+                     * jnp.maximum(xx2 - xx1, 0.0))
+            union = area + area[i] - inter
+            sup = (inter > iou * union) & (union > 0)
+            alive = alive & ~sup & (jnp.arange(n) != i)
+            return jnp.where(valid, alive, jnp.zeros_like(alive)), \
+                jnp.where(valid, i, -1).astype(jnp.int64)
+
+        _, sel = lax.scan(step, alive0, None, length=n_max)
+        return sel                                        # [n_max]
+
+    sel = jax.vmap(lambda bb, sb: jax.vmap(
+        lambda sc: one_class(bb, sc))(sb))(boxes, scores)  # [B, C, n_max]
+    bi = jnp.broadcast_to(jnp.arange(nb, dtype=jnp.int64)[:, None, None],
+                          sel.shape)
+    ci = jnp.broadcast_to(jnp.arange(nc, dtype=jnp.int64)[None, :, None],
+                          sel.shape)
+    out = jnp.stack([bi, ci, sel], axis=-1).reshape(-1, 3)
+    invalid = out[:, 2] < 0
+    return jnp.where(invalid[:, None], jnp.int64(-1), out)
+
+
+@op("RoiAlign")
+def _roi_align(ctx, x, rois, batch_indices):
+    """ONNX RoiAlign: bilinear-sampled pooling of roi bins over a
+    [N, C, H, W] feature map -> [num_rois, C, oh, ow] (the Faster-RCNN
+    head op). Gather-based bilinear sampling vmapped over rois — every
+    shape static, so XLA tiles the [C, samples] contractions.
+
+    ``sampling_ratio=0`` (adaptive per-roi grid) is data-dependent under
+    jit and rejected with a recipe; real detectron/torchvision exports
+    set it explicitly (usually 2)."""
+    mode = ctx.attr("mode", "avg")
+    oh, ow = ctx.attr("output_height", 1), ctx.attr("output_width", 1)
+    sr = int(ctx.attr("sampling_ratio", 0))
+    scale = ctx.attr("spatial_scale", 1.0)
+    ctm = ctx.attr("coordinate_transformation_mode",
+                   "half_pixel" if ctx.opset >= 16 else "output_half_pixel")
+    if sr <= 0:
+        raise NotImplementedError(
+            "RoiAlign with sampling_ratio=0 sizes its sampling grid from "
+            "roi extents (data-dependent shapes); re-export with an "
+            "explicit sampling_ratio (torchvision/detectron2 use 2)")
+    x = jnp.asarray(x, jnp.float32)
+    rois = jnp.asarray(rois, jnp.float32)
+    bidx = jnp.asarray(batch_indices).astype(jnp.int32)
+    H, W = x.shape[2], x.shape[3]
+    off = 0.5 if ctm == "half_pixel" else 0.0
+
+    def one_roi(roi, bi):
+        x1 = roi[0] * scale - off
+        y1 = roi[1] * scale - off
+        x2 = roi[2] * scale - off
+        y2 = roi[3] * scale - off
+        rw, rh = x2 - x1, y2 - y1
+        if ctm != "half_pixel":  # legacy mode clamps tiny rois to 1px
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w, bin_h = rw / ow, rh / oh
+        # sample grid: sr x sr points per bin, evenly inset
+        gy = (y1 + (jnp.arange(oh)[:, None] + (jnp.arange(sr) + 0.5)
+                    / sr) * bin_h).reshape(-1)              # [oh*sr]
+        gx = (x1 + (jnp.arange(ow)[:, None] + (jnp.arange(sr) + 0.5)
+                    / sr) * bin_w).reshape(-1)              # [ow*sr]
+
+        def axis_weights(g, size):
+            outside = (g < -1.0) | (g > size)
+            gc = jnp.clip(g, 0.0, size - 1)
+            lo = jnp.floor(gc).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, size - 1)
+            frac = gc - lo
+            return lo, hi, frac, outside
+
+        ylo, yhi, fy, oy = axis_weights(gy, H)
+        xlo, xhi, fx, ox = axis_weights(gx, W)
+        fmap = x[bi]                                        # [C, H, W]
+        # bilinear = lerp along y of lerps along x, via 4 gathers
+        def g2(yy, xx):
+            return fmap[:, yy][:, :, xx]                    # [C, oh*sr, ow*sr]
+        top = g2(ylo, xlo) * (1 - fx) + g2(ylo, xhi) * fx
+        bot = g2(yhi, xlo) * (1 - fx) + g2(yhi, xhi) * fx
+        val = top * (1 - fy)[None, :, None] + bot * fy[None, :, None]
+        val = jnp.where(oy[None, :, None] | ox[None, None, :], 0.0, val)
+        val = val.reshape(-1, oh, sr, ow, sr)
+        if mode == "max":
+            return val.max(axis=(2, 4))
+        return val.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois, bidx)                    # [R, C, oh, ow]
+
+
+# ---------------------------------------------------------------------------
 # Graph import
 # ---------------------------------------------------------------------------
 
@@ -1910,6 +2188,9 @@ class ImportedGraph:
             # every MelWeightMatrix input is filterbank GEOMETRY (incl.
             # the float hz edges); STFT's step/length are frame geometry
             "MelWeightMatrix": (0, 1, 2, 3, 4), "STFT": (1, 3),
+            # NMS capacity + thresholds select the compiled program's
+            # shape/constants (incl. the float iou/score thresholds)
+            "NonMaxSuppression": (2, 3, 4),
         }
         shape_fed = set()
         for node in graph.node:

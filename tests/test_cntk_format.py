@@ -574,6 +574,76 @@ def test_optimized_rnn_stack_two_layer_relu_and_blob_guard():
                               "recurrentOp": "rnnReLU"}))
 
 
+def _torch_cudnn_blob(mod, gates):
+    """Pack a torch.nn.{LSTM,GRU,RNN} module's parameters into the cuDNN
+    canonical blob. torch's parameter layout IS cuDNN's per-matrix layout
+    (same gate orders: LSTM i,f,c,o; GRU r,z/u,n/c), so the packing
+    exercises only the repo's blob-offset arithmetic."""
+    layers = []
+    dirs = 2 if mod.bidirectional else 1
+    H = mod.hidden_size
+    for layer in range(mod.num_layers):
+        for d in range(dirs):
+            sfx = f"_l{layer}" + ("_reverse" if d else "")
+            w_ih = getattr(mod, "weight_ih" + sfx).detach().numpy()
+            w_hh = getattr(mod, "weight_hh" + sfx).detach().numpy()
+            b_ih = getattr(mod, "bias_ih" + sfx).detach().numpy()
+            b_hh = getattr(mod, "bias_hh" + sfx).detach().numpy()
+            layers.append((w_ih.reshape(gates, H, -1),
+                           w_hh.reshape(gates, H, H),
+                           b_ih.reshape(gates, H),
+                           b_hh.reshape(gates, H)))
+    return _pack_cudnn_blob(layers)
+
+
+@pytest.mark.parametrize("kind,bidi,layers", [
+    ("lstm", False, 1), ("lstm", True, 1), ("lstm", False, 2),
+    ("lstm", True, 2),
+    ("gru", False, 1), ("gru", True, 1), ("gru", False, 2),
+    ("rnnTanh", False, 1), ("rnnTanh", True, 1), ("rnnReLU", False, 2),
+])
+def test_optimized_rnn_stack_matches_torch(kind, bidi, layers):
+    """FOREIGN ground truth for the cuDNN canonical blob layout (round-4
+    verdict: the numpy refs above are self-authored): torch.nn.LSTM/GRU/
+    RNN implement the same cuDNN cell semantics torch inherited from
+    cuDNN's API. Packing a torch module's weights into the blob and
+    running the reader's OptimizedRNNStack -> ONNX -> lax.scan lowering
+    must reproduce torch's own forward for every cell/direction/stack
+    shape the reader supports (ref SerializableFunction.scala:85-143 —
+    the reference executes these graphs through real CNTK)."""
+    import zlib
+
+    import torch
+
+    feat, H, n, t = 3, 5, 2, 7
+    # deterministic per-case seed (hash() is salted per process)
+    torch.manual_seed(zlib.crc32(f"{kind}|{bidi}|{layers}".encode()))
+    if kind == "lstm":
+        mod = torch.nn.LSTM(feat, H, num_layers=layers,
+                            bidirectional=bidi, batch_first=True)
+        gates = 4
+    elif kind == "gru":
+        mod = torch.nn.GRU(feat, H, num_layers=layers,
+                           bidirectional=bidi, batch_first=True)
+        gates = 3
+    else:
+        mod = torch.nn.RNN(feat, H, num_layers=layers,
+                           bidirectional=bidi, batch_first=True,
+                           nonlinearity="tanh" if kind == "rnnTanh"
+                           else "relu")
+        gates = 1
+    blob = _torch_cudnn_blob(mod, gates)
+    gi = import_model(cntk_to_onnx(_rnn_stack_model(
+        blob, feat, {"hiddenSize": H, "numLayers": layers,
+                     "bidirectional": bidi, "recurrentOp": kind})))
+    x = np.random.default_rng(40).normal(size=(n, t, feat)) \
+        .astype(np.float32)
+    with torch.no_grad():
+        want = mod(torch.from_numpy(x))[0].numpy()
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
 def test_committed_recurrent_fixture_loads_and_matches():
     """The committed recurrent .model bytes (tools/make_cntk_recurrent_
     fixture.py) load through the binary reader and match the frozen

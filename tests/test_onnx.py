@@ -8,6 +8,7 @@ importer must not depend on them):
   onnxruntime-vs-Spark comparisons
   (ref: deep-learning/src/test/scala/com/microsoft/ml/spark/onnx/ONNXModelSuite).
 """
+import jax.numpy as jnp
 import numpy as np
 import pytest
 import torch
@@ -1463,3 +1464,361 @@ def test_eyelike_reverse_sequence_nonzero():
     m = np.array([[1, 0], [0, 2]], np.float32)
     np.testing.assert_array_equal(_non_zero(_Ctx(), m),
                                   np.stack(np.nonzero(m)))
+
+
+# ---------------------------------------------------------------------------
+# Detection-era + statically-quantized export families
+# (ref ONNXModel.scala:173-193 — the reference scores whatever ORT runs)
+# ---------------------------------------------------------------------------
+
+def test_dynamic_quantize_linear_spec_formula():
+    """DynamicQuantizeLinear follows the ONNX spec exactly: range extended
+    to include zero, scale over 255 steps, saturating uint8."""
+    rng = np.random.default_rng(0)
+    for x in [rng.normal(size=(3, 17)).astype(np.float32) * 4,
+              np.abs(rng.normal(size=(5,)).astype(np.float32)),  # min>0
+              -np.abs(rng.normal(size=(5,)).astype(np.float32)),  # max<0
+              np.zeros((4,), np.float32)]:                        # degenerate
+        g = GraphBuilder(opset=21)
+        xn = g.add_input("x", np.float32, list(x.shape))
+        y, ys, yzp = g.add_node("DynamicQuantizeLinear", [xn],
+                                outputs=["y", "ys", "yzp"])
+        g.add_output(y, np.uint8, list(x.shape))
+        g.add_output(ys, np.float32, [])
+        g.add_output(yzp, np.uint8, [])
+        gi = import_model(g.to_bytes())
+        qy, qs, qzp = [np.asarray(o) for o in gi.apply(gi.params, x)]
+        mn, mx = min(x.min(), 0.0), max(x.max(), 0.0)
+        scale = (mx - mn) / 255.0 or 1.0
+        zp = np.clip(np.rint(-mn / scale), 0, 255)
+        np.testing.assert_allclose(qs, scale, rtol=1e-6)
+        assert qzp == zp and qzp.dtype == np.uint8
+        want = np.clip(np.rint(x / scale) + zp, 0, 255).astype(np.uint8)
+        np.testing.assert_array_equal(qy, want)
+
+
+def _qlinear_conv_graph(x_shape, w, xs, xzp, ws, wzp, ys, yzp, b=None,
+                        **conv_attrs):
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.uint8, list(x_shape))
+    ins = [xn,
+           g.add_initializer("xs", np.float32(xs)),
+           g.add_initializer("xzp", np.uint8(xzp)),
+           g.add_initializer("w", w),
+           g.add_initializer("ws", np.asarray(ws, np.float32)),
+           g.add_initializer("wzp", np.asarray(wzp, np.int8)),
+           g.add_initializer("ys", np.float32(ys)),
+           g.add_initializer("yzp", np.uint8(yzp))]
+    if b is not None:
+        ins.append(g.add_initializer("b", np.asarray(b, np.int32)))
+    y = g.add_node("QLinearConv", ins, **conv_attrs)
+    g.add_output(y, np.uint8, None)
+    return import_model(g.to_bytes())
+
+
+def test_qlinear_conv_matches_torch_quantized():
+    """Foreign ground truth: torch.ao.nn.quantized Conv2d (fbgemm) on the
+    same scales/zero-points. Requantization rounding may differ by one
+    ulp on ties, so the gate is <=1 LSB everywhere and overwhelmingly
+    exact."""
+    import torch.ao.nn.quantized as nnq
+    if "fbgemm" not in torch.backends.quantized.supported_engines:
+        pytest.skip("torch built without the fbgemm quantized engine")
+    prev_engine = torch.backends.quantized.engine
+    torch.backends.quantized.engine = "fbgemm"
+    try:
+        _check_qlinear_conv_against_torch(nnq)
+    finally:
+        torch.backends.quantized.engine = prev_engine
+
+
+def _check_qlinear_conv_against_torch(nnq):
+    rng = np.random.default_rng(1)
+    cin, cout, k = 3, 8, 3
+    xs, xzp, ys, yzp = 0.05, 128, 0.12, 100
+    xq = rng.integers(0, 255, (2, cin, 10, 10)).astype(np.uint8)
+    x_f = (xq.astype(np.float32) - xzp) * xs
+
+    for per_channel in (False, True):
+        wq = rng.integers(-100, 100, (cout, cin, k, k)).astype(np.int8)
+        if per_channel:
+            ws = (rng.random(cout) * 0.03 + 0.01).astype(np.float32)
+            w_f = wq.astype(np.float32) * ws[:, None, None, None]
+            qw = torch.quantize_per_channel(
+                torch.from_numpy(w_f), torch.from_numpy(ws),
+                torch.zeros(cout, dtype=torch.long), 0, torch.qint8)
+            wzp = np.zeros(cout, np.int8)
+        else:
+            ws = np.float32(0.02)
+            w_f = wq.astype(np.float32) * float(ws)
+            qw = torch.quantize_per_tensor(torch.from_numpy(w_f),
+                                           float(ws), 0, torch.qint8)
+            wzp = np.int8(0)
+        b_f = rng.normal(size=cout).astype(np.float32)
+        # ONNX bias: int32 at scale xs*ws
+        b_i32 = np.rint(b_f / (xs * np.asarray(ws))).astype(np.int32)
+        b_used = b_i32.astype(np.float32) * (xs * np.asarray(ws))
+
+        conv = nnq.Conv2d(cin, cout, k, stride=1, padding=1)
+        conv.set_weight_bias(qw, torch.from_numpy(b_used))
+        conv.scale, conv.zero_point = ys, yzp
+        qx = torch.quantize_per_tensor(torch.from_numpy(x_f), xs, xzp,
+                                       torch.quint8)
+        want = conv(qx).int_repr().numpy()
+
+        gi = _qlinear_conv_graph(xq.shape, wq, xs, xzp, ws, wzp, ys, yzp,
+                                 b=b_i32, strides=[1, 1],
+                                 pads=[1, 1, 1, 1])
+        got = np.asarray(gi.apply(gi.params, xq)[0])
+        assert got.dtype == np.uint8
+        diff = np.abs(got.astype(np.int32) - want.astype(np.int32))
+        assert diff.max() <= 1, (per_channel, diff.max())
+        assert (diff == 0).mean() > 0.98, (per_channel, (diff == 0).mean())
+
+
+def test_qlinear_matmul_and_conv_integer_exact_int_semantics():
+    """QLinearMatMul against exact integer arithmetic + spec
+    requantization; ConvInteger against a float64 conv over the
+    zero-point-shifted operands (exact for int8 ranges)."""
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, 255, (4, 6)).astype(np.uint8)
+    b = rng.integers(-127, 127, (6, 5)).astype(np.int8)
+    a_s, a_zp, b_s, b_zp, y_s, y_zp = 0.03, 120, 0.05, 3, 0.2, 64
+    g = GraphBuilder(opset=21)
+    an = g.add_input("a", np.uint8, [4, 6])
+    ins = [an, g.add_initializer("as_", np.float32(a_s)),
+           g.add_initializer("azp", np.uint8(a_zp)),
+           g.add_initializer("b", b),
+           g.add_initializer("bs", np.float32(b_s)),
+           g.add_initializer("bzp", np.int8(b_zp)),
+           g.add_initializer("ys", np.float32(y_s)),
+           g.add_initializer("yzp", np.uint8(y_zp))]
+    y = g.add_node("QLinearMatMul", ins)
+    g.add_output(y, np.uint8, [4, 5])
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, a)[0])
+    acc = (a.astype(np.int64) - a_zp) @ (b.astype(np.int64) - b_zp)
+    want = np.clip(
+        np.rint(acc.astype(np.float32) * np.float32(a_s * b_s / y_s))
+        + y_zp, 0, 255).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+    # ConvInteger: raw int32 accumulator
+    x = rng.integers(0, 255, (1, 2, 7, 7)).astype(np.uint8)
+    w = rng.integers(-127, 127, (4, 2, 3, 3)).astype(np.int8)
+    g2 = GraphBuilder(opset=21)
+    xn = g2.add_input("x", np.uint8, [1, 2, 7, 7])
+    ins2 = [xn, g2.add_initializer("w", w),
+            g2.add_initializer("xzp", np.uint8(99))]
+    y2 = g2.add_node("ConvInteger", ins2, pads=[1, 1, 1, 1])
+    g2.add_output(y2, np.int32, None)
+    gi2 = import_model(g2.to_bytes())
+    got2 = np.asarray(gi2.apply(gi2.params, x)[0])
+    want2 = torch.nn.functional.conv2d(
+        torch.from_numpy(x.astype(np.float64) - 99.0),
+        torch.from_numpy(w.astype(np.float64)), padding=1).numpy()
+    np.testing.assert_array_equal(got2, want2.astype(np.int32))
+
+
+def _nms_graph(n, max_out, iou, score_th=None, center=0, nb=1, nc=1):
+    g = GraphBuilder(opset=21)
+    bn = g.add_input("boxes", np.float32, [nb, n, 4])
+    sn = g.add_input("scores", np.float32, [nb, nc, n])
+    ins = [bn, sn, g.add_initializer("mo", np.int64(max_out)),
+           g.add_initializer("iou", np.float32(iou))]
+    if score_th is not None:
+        ins.append(g.add_initializer("st", np.float32(score_th)))
+    y = g.add_node("NonMaxSuppression", ins, center_point_box=center)
+    g.add_output(y, np.int64, None)
+    return import_model(g.to_bytes())
+
+
+def test_nonmax_suppression_onnx_spec_case():
+    """The canonical ONNX NMS example (suppress-by-IOU): host path gives
+    the exact [num_selected, 3]; the traced (jit) path gives the same
+    rows in fixed-capacity form with -1 padding."""
+    boxes = np.array([[[0.0, 0.0, 1.0, 1.0], [0.0, 0.1, 1.0, 1.1],
+                       [0.0, -0.1, 1.0, 0.9], [0.0, 10.0, 1.0, 11.0],
+                       [0.0, 10.1, 1.0, 11.1], [0.0, 100.0, 1.0, 101.0]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.75, 0.6, 0.95, 0.5, 0.3]]], np.float32)
+    want = np.array([[0, 0, 3], [0, 0, 0], [0, 0, 5]], np.int64)
+
+    gi = _nms_graph(6, max_out=3, iou=0.5)
+    host = np.asarray(gi.apply(gi.params, boxes, scores)[0])
+    np.testing.assert_array_equal(host, want)
+
+    import jax
+    traced = np.asarray(jax.jit(gi.apply)(
+        gi.params, jnp.asarray(boxes), jnp.asarray(scores))[0])
+    assert traced.shape == (3, 3)  # 1 batch x 1 class x max_out
+    np.testing.assert_array_equal(traced[traced[:, 2] >= 0], want)
+
+    # score threshold + flipped-corner boxes + multi-class, traced vs host
+    rng = np.random.default_rng(5)
+    nb, nc, n = 2, 3, 40
+    centers = rng.random((nb, n, 2)).astype(np.float32) * 4
+    sizes = rng.random((nb, n, 2)).astype(np.float32) + 0.3
+    b2 = np.concatenate([centers - sizes / 2, centers + sizes / 2],
+                        axis=-1)[..., [1, 0, 3, 2]]  # y1 x1 y2 x2
+    # randomly swap the diagonal (spec: order-free corners)
+    swap = rng.random((nb, n)) < 0.5
+    b2[swap] = b2[swap][:, [2, 3, 0, 1]]
+    s2 = rng.random((nb, nc, n)).astype(np.float32)
+    gi2 = _nms_graph(n, max_out=5, iou=0.45, score_th=0.2, nb=nb, nc=nc)
+    host2 = np.asarray(gi2.apply(gi2.params, b2.astype(np.float32), s2)[0])
+    traced2 = np.asarray(jax.jit(gi2.apply)(
+        gi2.params, jnp.asarray(b2, jnp.float32), jnp.asarray(s2))[0])
+    np.testing.assert_array_equal(traced2[traced2[:, 2] >= 0], host2)
+
+    # center_point_box format agrees with the corner formulation
+    bc = np.concatenate([centers, sizes], axis=-1).astype(np.float32)
+    gi3 = _nms_graph(n, max_out=5, iou=0.45, score_th=0.2, nb=nb, nc=nc,
+                     center=1)
+    host3 = np.asarray(gi3.apply(gi3.params, bc, s2)[0])
+    np.testing.assert_array_equal(host3, host2)
+
+
+def _roi_align_ref(x, rois, bidx, oh, ow, sr, scale, mode, ctm):
+    """Independent loop-based numpy implementation straight from the
+    ONNX spec text (bilinear sampling with the -1/size outside rule)."""
+    R = rois.shape[0]
+    C, H, W = x.shape[1:]
+    out = np.zeros((R, C, oh, ow), np.float32)
+    off = 0.5 if ctm == "half_pixel" else 0.0
+    for r in range(R):
+        x1, y1, x2, y2 = rois[r] * scale - off
+        rw, rh = x2 - x1, y2 - y1
+        if ctm != "half_pixel":
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bw, bh = rw / ow, rh / oh
+        fm = x[bidx[r]]
+        for ph in range(oh):
+            for pw in range(ow):
+                vals = []
+                for iy in range(sr):
+                    for ix in range(sr):
+                        yy = y1 + (ph + (iy + 0.5) / sr) * bh
+                        xx = x1 + (pw + (ix + 0.5) / sr) * bw
+                        if yy < -1.0 or yy > H or xx < -1.0 or xx > W:
+                            vals.append(np.zeros(C, np.float32))
+                            continue
+                        yy, xx = min(max(yy, 0.0), H - 1), min(max(xx, 0.0), W - 1)
+                        ylo, xlo = int(np.floor(yy)), int(np.floor(xx))
+                        yhi, xhi = min(ylo + 1, H - 1), min(xlo + 1, W - 1)
+                        fy, fx = yy - ylo, xx - xlo
+                        v = (fm[:, ylo, xlo] * (1 - fy) * (1 - fx)
+                             + fm[:, ylo, xhi] * (1 - fy) * fx
+                             + fm[:, yhi, xlo] * fy * (1 - fx)
+                             + fm[:, yhi, xhi] * fy * fx)
+                        vals.append(v)
+                stack = np.stack(vals)
+                out[r, :, ph, pw] = (stack.max(0) if mode == "max"
+                                     else stack.mean(0))
+    return out
+
+
+def test_roi_align_modes_and_transforms():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(2, 3, 12, 12)).astype(np.float32)
+    rois = np.array([[0.0, 0.0, 9.0, 9.0], [1.5, 2.0, 9.5, 11.0],
+                     [4.0, 4.0, 6.0, 6.0], [-1.0, -1.0, 3.0, 3.0]],
+                    np.float32)
+    bidx = np.array([0, 1, 0, 1], np.int64)
+    for mode in ("avg", "max"):
+        for ctm, opset in (("output_half_pixel", 10), ("half_pixel", 16)):
+            g = GraphBuilder(opset=max(opset, 16))
+            xn = g.add_input("x", np.float32, [2, 3, 12, 12])
+            rn = g.add_initializer("rois", rois)
+            bn = g.add_initializer("bidx", bidx)
+            y = g.add_node("RoiAlign", [xn, rn, bn], mode=mode,
+                           output_height=4, output_width=3,
+                           sampling_ratio=2, spatial_scale=0.5,
+                           coordinate_transformation_mode=ctm)
+            g.add_output(y, np.float32, [4, 3, 4, 3])
+            gi = import_model(g.to_bytes())
+            import jax
+            got = np.asarray(jax.jit(gi.apply)(
+                gi.params, jnp.asarray(x))[0])
+            want = _roi_align_ref(x, rois, bidx, 4, 3, 2, 0.5, mode, ctm)
+            np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5,
+                                       err_msg=f"{mode}/{ctm}")
+
+    # sampling_ratio=0 is data-dependent under jit: explicit recipe error
+    g = GraphBuilder(opset=16)
+    xn = g.add_input("x", np.float32, [2, 3, 12, 12])
+    rn = g.add_initializer("rois", rois)
+    bn = g.add_initializer("bidx", bidx)
+    y = g.add_node("RoiAlign", [xn, rn, bn], output_height=2,
+                   output_width=2, sampling_ratio=0)
+    g.add_output(y, np.float32, [4, 3, 2, 2])
+    with pytest.raises(NotImplementedError, match="sampling_ratio"):
+        gi = import_model(g.to_bytes())
+        gi.apply(gi.params, x)
+
+
+def test_detection_head_end_to_end():
+    """Builder-composed detection head: conv backbone -> box-delta +
+    score heads -> anchor decode (Mul/Add) -> NonMaxSuppression, traced
+    through one jit. The selected indices must equal the host NMS run on
+    the intermediate boxes/scores computed by a twin graph."""
+    import jax
+
+    rng = np.random.default_rng(8)
+    n_anchors, img = 16, 8
+    anchors = np.zeros((1, n_anchors, 4), np.float32)
+    cy, cx = np.meshgrid(np.arange(4), np.arange(4), indexing="ij")
+    anchors[0, :, 0] = cy.ravel() * 2
+    anchors[0, :, 1] = cx.ravel() * 2
+    anchors[0, :, 2] = cy.ravel() * 2 + 2.5
+    anchors[0, :, 3] = cx.ravel() * 2 + 2.5
+
+    def build(with_nms):
+        g = GraphBuilder(opset=21)
+        xn = g.add_input("x", np.float32, [1, 3, img, img])
+        w1 = rng.normal(size=(8, 3, 3, 3)).astype(np.float32) * 0.4
+        c1 = g.conv(xn, w1, pads=(1, 1, 1, 1))
+        r1 = g.relu(c1)
+        wb = rng.normal(size=(4, 8, 2, 2)).astype(np.float32) * 0.05
+        box_map = g.conv(r1, wb, strides=(2, 2))          # [1,4,4,4]
+        ws = rng.normal(size=(2, 8, 2, 2)).astype(np.float32) * 0.4
+        sc_map = g.conv(r1, ws, strides=(2, 2))           # [1,2,4,4]
+        # deltas [1,4,16] -> [1,16,4]; decode: anchors + 0.5*tanh(deltas)
+        shp = g.add_initializer("shp", np.array([1, 4, 16], np.int64))
+        box_r = g.add_node("Reshape", [box_map, shp])
+        box_t = g.add_node("Transpose", [box_r], perm=[0, 2, 1])
+        half = g.add_initializer("half", np.float32(0.5))
+        delt = g.add_node("Mul", [g.add_node("Tanh", [box_t]), half])
+        boxes = g.add_node("Add", [g.add_initializer("anchors", anchors),
+                                   delt])
+        shp2 = g.add_initializer("shp2", np.array([1, 2, 16], np.int64))
+        sc_r = g.add_node("Reshape", [sc_map, shp2])
+        scores = g.add_node("Sigmoid", [sc_r])            # [1,2,16]
+        if not with_nms:
+            g.add_output(boxes, np.float32, [1, n_anchors, 4])
+            g.add_output(scores, np.float32, [1, 2, n_anchors])
+            return g
+        sel = g.add_node("NonMaxSuppression",
+                         [boxes, scores,
+                          g.add_initializer("mo", np.int64(4)),
+                          g.add_initializer("iou", np.float32(0.5)),
+                          g.add_initializer("st", np.float32(0.3))])
+        g.add_output(sel, np.int64, None)
+        return g
+
+    rng_state = rng.bit_generator.state
+    g_full = build(True)
+    rng.bit_generator.state = rng_state     # identical weights
+    g_mid = build(False)
+
+    x = np.random.default_rng(9).normal(size=(1, 3, img, img)).astype(
+        np.float32)
+    gi = import_model(g_full.to_bytes())
+    sel = np.asarray(jax.jit(gi.apply)(gi.params, jnp.asarray(x))[0])
+    assert sel.shape == (1 * 2 * 4, 3)
+
+    gm = import_model(g_mid.to_bytes())
+    boxes_v, scores_v = [np.asarray(o) for o in gm.apply(gm.params, x)]
+    from synapseml_tpu.onnx.importer import _nms_host
+    want = _nms_host(boxes_v, scores_v, 4, 0.5, 0.3, 0)
+    np.testing.assert_array_equal(sel[sel[:, 2] >= 0], want)

@@ -5,10 +5,17 @@ backward FutureValue recurrence over the same projected input, spliced
 on the feature axis, with a linear head — the smallest graph exercising
 the whole recurrent-reader surface (two independent cycles, both
 directions, downstream consumption of scan outputs). The bytes are
-committed together with frozen expected outputs so the reader is tested
-against artifacts it did not just write in-process (the torch-ONNX
-fixture pattern; the reference executes such models natively via
-Function.load — deep-learning/.../cntk/SerializableFunction.scala:85-143).
+committed together with frozen expected outputs so later reader changes
+are tested against a frozen artifact. Caveat: the artifact is written by
+this repo's own CntkModelBuilder, so it guards against regression, not
+against a misreading of the CNTK wire format itself — format parity
+rests on the protoc cross-check (tests/test_cntk_format.py wire tests)
+and, for the cuDNN blob layout, on the torch.nn.{LSTM,GRU,RNN} oracle
+(test_optimized_rnn_stack_matches_torch). If an environment with the
+real `cntk` package ever becomes available, regenerate this fixture
+with a genuine CNTK export (as tools/make_lightgbm_fixtures.py does for
+LightGBM); the reference executes such models natively via
+Function.load — deep-learning/.../cntk/SerializableFunction.scala:85-143.
 
 Run from the repo root:  python tools/make_cntk_recurrent_fixture.py
 """
