@@ -85,6 +85,36 @@ def main():
     got = np.asarray(cm.transform(Table({"x": io["input"]}))["y"])
     np.testing.assert_allclose(got, io["expected"], rtol=2e-5, atol=2e-5)
     print("recurrent CNTK .model scored:", got.shape)
+
+    # --- the speech scenario as ONE streaming pipeline (ref:
+    # SpeechToTextSDK.scala + AudioStreams.scala:94): committed WAV ->
+    # endpointer -> ON-DEVICE log-mel (AudioFeaturizer's ONNX STFT/Mel
+    # graph) -> recurrent CNTK OptimizedRNNStack model over the mel
+    # frames -> per-utterance rows
+    from synapseml_tpu.cognitive import (utterance_feature_batch,
+                                         wav_to_utterance_rows)
+    from synapseml_tpu.dl.cntk_format import build_optimized_rnn_model
+
+    wav_path = os.path.join(os.path.dirname(fx), "utterances.wav")
+    with open(wav_path, "rb") as fh:
+        rows = wav_to_utterance_rows(fh.read())
+    print(f"utterances: {rows.num_rows}")
+    assert rows.num_rows == 3
+
+    mel, hidden = 64, 16
+    am = CNTKModel(model_bytes=build_optimized_rnn_model(
+        mel, hidden, bidirectional=True, cell="lstm", seed=11))
+    md = am.model_metadata()
+    am.set(feed_dict={list(md["inputs"])[0]: "mel"},
+           fetch_dict={"state": md["outputs"][0]})
+    batch, n_frames = utterance_feature_batch(rows)
+    states = np.asarray(am.transform(Table({"mel": batch}))["state"])
+    assert states.shape == (rows.num_rows, batch.shape[1], 2 * hidden)
+    for i in range(rows.num_rows):
+        vec = states[i, :n_frames[i]].mean(axis=0)
+        print(f"  utterance {i}: {rows['t_start'][i]:.2f}-"
+              f"{rows['t_end'][i]:.2f}s {n_frames[i]} frames "
+              f"state|mean|={np.abs(vec).mean():.4f}")
     print("E2E bilstm_entity_extraction: PASS")
 
 

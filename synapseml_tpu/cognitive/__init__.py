@@ -27,10 +27,13 @@ from synapseml_tpu.cognitive.form import (  # noqa: F401
     flatten_read_results,
 )
 from synapseml_tpu.cognitive.speech import (  # noqa: F401
+    AudioFeaturizer,
     SpeechToTextSDK,
     WavStream,
     pcm_to_wav,
     segment_utterances,
+    utterance_feature_batch,
+    wav_to_utterance_rows,
 )
 from synapseml_tpu.cognitive.services import (  # noqa: F401
     AnalyzeImage,

@@ -373,3 +373,59 @@ class AudioFeaturizer(Transformer):
             n_frames = 1 + (len(w) - flen) // step if len(w) >= flen else 0
             out[i] = np.asarray(feats[i][:n_frames], np.float32)
         return table.with_column(self.output_col, out)
+
+
+def wav_to_utterance_rows(wav_bytes: bytes,
+                          featurizer: Optional["AudioFeaturizer"] = None,
+                          **endpointer_kw) -> Table:
+    """One call from WAV bytes to per-utterance feature rows — the front
+    half of the reference's speech scenario (SpeechToTextSDK.scala:431 +
+    AudioStreams.scala:94: stream -> segment -> per-utterance requests),
+    with featurization as local TPU compute instead of a service call.
+
+    Parses the WAV (canonical-format asserts), segments utterances with
+    the energy endpointer, and runs the on-device log-mel
+    :class:`AudioFeaturizer` over ONE batch of all utterances. Returns a
+    Table with per-utterance rows: ``utterance`` (index), ``t_start`` /
+    ``t_end`` (seconds), ``audio`` (float waveform) and the featurizer's
+    output column (log-mel ``[frames, num_mel_bins]``). Feed the feature
+    column to any sequence model (the recurrent CNTK path, the ONNX
+    BiLSTM tagger, ...) for the back half.
+    """
+    ws = WavStream(bytes(wav_bytes))
+    segs = segment_utterances(ws.pcm, ws.sample_rate, **endpointer_kw)
+    feat = featurizer or AudioFeaturizer()
+    audio = np.empty(len(segs), dtype=object)
+    for i, (s, e) in enumerate(segs):
+        audio[i] = ws.pcm[s:e].astype(np.float32) / 32768.0
+    table = Table({
+        "utterance": np.arange(len(segs), dtype=np.int64),
+        "t_start": np.asarray([s / ws.sample_rate for s, _ in segs],
+                              np.float64),
+        "t_end": np.asarray([e / ws.sample_rate for _, e in segs],
+                            np.float64),
+        str(feat.input_col): audio,
+    })
+    if not segs:
+        table = table.with_column(str(feat.output_col),
+                                  np.empty(0, dtype=object))
+        return table
+    # copy() scopes the rate override to this call — mutating a shared
+    # featurizer would silently re-rate the caller's other pipelines
+    return feat.copy(sample_rate=ws.sample_rate).transform(table)
+
+
+def utterance_feature_batch(rows: Table, feature_col: str = "features"):
+    """Pad per-utterance ``[frames, D]`` features into one ``[U, T, D]``
+    batch for a sequence model (one device placement, static shapes);
+    returns ``(batch, frame_counts)`` — trim each row's output back to
+    its true frame count with ``frame_counts``."""
+    feats = [np.asarray(f, np.float32) for f in rows[feature_col]]
+    n_frames = np.asarray([len(f) for f in feats], np.int64)
+    if not len(feats):
+        return np.zeros((0, 0, 0), np.float32), n_frames
+    batch = np.zeros((len(feats), int(n_frames.max()), feats[0].shape[1]),
+                     np.float32)
+    for i, f in enumerate(feats):
+        batch[i, :len(f)] = f
+    return batch, n_frames

@@ -1106,3 +1106,44 @@ class CntkModelBuilder:
             "primitive_functions": self._funcs,
         }
         return proto.encode(py_to_dict(top))
+
+
+def build_optimized_rnn_model(input_dim: int, hidden: int,
+                              num_layers: int = 1,
+                              bidirectional: bool = True,
+                              cell: str = "lstm", seed: int = 0,
+                              scale: float = 0.2,
+                              bias_scale: float = 0.05) -> bytes:
+    """Random-initialized OptimizedRNNStack ``.model`` bytes.
+
+    Packs seeded weights in the cuDNN canonical blob layout (all (W, R)
+    gate matrices per pseudo-layer first, then all (bW, bR) biases —
+    the layout torch-oracle-verified in tests/test_cntk_format.py) and
+    wraps them in a one-op CNTK v2 graph. The demo/e2e helper behind the
+    speech scenario's recurrent stage; for real models, load the bytes
+    CNTK wrote.
+    """
+    gates = {"lstm": 4, "gru": 3, "rnnTanh": 1, "rnnReLU": 1}[cell]
+    rng = np.random.default_rng(seed)
+    dirs = 2 if bidirectional else 1
+    mats: List[np.ndarray] = []
+    biases: List[np.ndarray] = []
+    in_w = input_dim
+    for _layer in range(num_layers):
+        for _d in range(dirs):
+            mats.append((rng.normal(size=(gates * hidden, in_w))
+                         * scale).astype(np.float32).ravel())
+            mats.append((rng.normal(size=(gates * hidden, hidden))
+                         * scale).astype(np.float32).ravel())
+            biases.append((rng.normal(size=gates * hidden)
+                           * bias_scale).astype(np.float32))
+            biases.append((rng.normal(size=gates * hidden)
+                           * bias_scale).astype(np.float32))
+        in_w = hidden * dirs
+    b = CntkModelBuilder("optimized_rnn")
+    x = b.add_input((input_dim,))
+    y = b.add_op(OP_OPTIMIZED_RNN,
+                 [x, b.add_parameter(np.concatenate(mats + biases))],
+                 {"hiddenSize": hidden, "numLayers": num_layers,
+                  "bidirectional": bidirectional, "recurrentOp": cell})
+    return b.to_bytes(y)

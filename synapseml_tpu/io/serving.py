@@ -215,9 +215,17 @@ class WorkerServer:
                     cr.epoch = epoch
                 self.history[epoch] = list(out)
 
-    def commit(self, epoch: int):
-        """Prune replay history through ``epoch`` (ref: commit :555-567)."""
+    def commit(self, epoch: int, exact: bool = False):
+        """Prune replay history through ``epoch`` (ref: commit :555-567).
+
+        ``exact=True`` prunes ONLY that epoch — required when epochs
+        complete out of order (concurrent scoring workers): a cumulative
+        commit of a later epoch would silently drop an earlier,
+        still-in-flight epoch's replay history."""
         with self._lock:
+            if exact:
+                self.history.pop(epoch, None)
+                return
             for e in [e for e in self.history if e <= epoch]:
                 del self.history[e]
 
@@ -480,11 +488,30 @@ class ContinuousServer:
                  host: str = "127.0.0.1", port: Optional[int] = None,
                  max_batch: int = 64, parse_json: bool = True,
                  reply_col: str = "reply", reply_timeout: float = 60.0,
-                 batch_linger: float = 0.0):
+                 batch_linger: float = 0.0, pipelined: bool = True,
+                 scoring_workers: int = 1):
         """``batch_linger``: seconds to keep collecting after the first
         request of a batch arrives. A few ms turns concurrent clients'
         requests into ONE scored micro-batch (one device round trip
-        amortized over the batch) instead of serial singletons."""
+        amortized over the batch) instead of serial singletons.
+
+        ``pipelined``: run collection and scoring as a two-stage pipeline
+        (a collector thread drains + lingers on batch k+1 WHILE the device
+        scores batch k, and keeps coalescing for as long as every scorer is
+        busy — adaptive linger). ``False`` restores the strictly serial
+        drain->score loop.
+
+        ``scoring_workers``: concurrent scorer threads (pipelined mode).
+        Default 1: ``pipeline_fn`` is never called concurrently unless
+        the caller opts in (>1 requires a thread-safe pipeline — jitted
+        jax fns are; ad-hoc host state may not be).
+        On a remote/tunneled device the per-batch wall time is dominated
+        by dispatch ROUND-TRIP latency, not device compute — N workers
+        keep N micro-batches in flight, so throughput scales toward
+        N/RTT while per-request latency stays one RTT (replies are
+        per-request ids; epochs commit independently, so ordering is
+        preserved per epoch, as in the reference's partition-parallel
+        HTTPSourceV2 writers)."""
         self.server = HTTPSourceStateHolder.get_or_create_server(
             name, host, port, reply_timeout=reply_timeout)
         self.name = name
@@ -493,13 +520,42 @@ class ContinuousServer:
         self.batch_linger = batch_linger
         self.parse_json = parse_json
         self.reply_col = reply_col
+        self.pipelined = pipelined
+        self.scoring_workers = max(1, int(scoring_workers))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._collector: Optional[threading.Thread] = None
+        self._extra_scorers: List[threading.Thread] = []
+        self._handoff: Optional["queue.Queue"] = None
         self.errors: List[str] = []
 
     @property
     def url(self) -> str:
         return self.server.url
+
+    def _score_batch(self, batch: List[CachedRequest]):
+        """Score one micro-batch and commit its epoch(s) — a pipelined
+        batch may merge several drain epochs (each already recorded for
+        replay), so every distinct epoch is committed."""
+        epochs = sorted({cr.epoch for cr in batch})
+        try:
+            table = requests_to_table(batch)
+            if self.parse_json:
+                table = parse_request(table)
+            out = self.pipeline_fn(table)
+            send_replies(self.server, out, self.reply_col)
+        except Exception as e:  # noqa: BLE001 - serving loop must survive
+            self.errors.append(repr(e))
+            for cr in batch:
+                self.server.reply_to(cr.rid, HTTPResponseData(
+                    status_code=500, reason="pipeline error",
+                    entity=repr(e).encode()))
+        finally:
+            # exact commits: concurrent workers finish epochs out of
+            # order, and a cumulative commit of a later epoch would
+            # erase an earlier in-flight epoch's replay history
+            for ep in epochs:
+                self.server.commit(ep, exact=True)
 
     def _loop(self):
         while not self._stop.is_set():
@@ -507,25 +563,55 @@ class ContinuousServer:
                                           linger=self.batch_linger)
             if not batch:
                 continue
-            epoch = batch[0].epoch
+            self._score_batch(batch)
+
+    def _collect_loop(self, handoff: "queue.Queue"):
+        """Stage 1: drain + linger concurrently with device scoring.
+        While the scorer holds the handoff slot, the wait itself becomes
+        extra coalescing time — the linger adapts to the service rate
+        instead of being a fixed prepaid delay."""
+        while not self._stop.is_set():
+            batch = self.server.get_batch(self.max_batch, timeout=0.05,
+                                          linger=self.batch_linger)
+            if not batch:
+                continue
+            while not self._stop.is_set():
+                try:
+                    handoff.put(batch, timeout=0.05)
+                    break
+                except queue.Full:
+                    if len(batch) < self.max_batch:
+                        batch.extend(self.server.get_batch(
+                            self.max_batch - len(batch), timeout=0.001))
+
+    def _score_loop(self, handoff: "queue.Queue"):
+        while not self._stop.is_set():
             try:
-                table = requests_to_table(batch)
-                if self.parse_json:
-                    table = parse_request(table)
-                out = self.pipeline_fn(table)
-                send_replies(self.server, out, self.reply_col)
-                self.server.commit(epoch)
-            except Exception as e:  # noqa: BLE001 - serving loop must survive
-                self.errors.append(repr(e))
-                for cr in batch:
-                    self.server.reply_to(cr.rid, HTTPResponseData(
-                        status_code=500, reason="pipeline error",
-                        entity=repr(e).encode()))
-                self.server.commit(epoch)
+                batch = handoff.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self._score_batch(batch)
+
+    def _pipelined_loop(self):
+        handoff: "queue.Queue[List[CachedRequest]]" = queue.Queue(
+            maxsize=self.scoring_workers)
+        self._handoff = handoff
+        self._collector = threading.Thread(
+            target=self._collect_loop, args=(handoff,),
+            name=f"serving-collect-{self.name}", daemon=True)
+        self._collector.start()
+        for i in range(self.scoring_workers - 1):
+            t = threading.Thread(target=self._score_loop, args=(handoff,),
+                                 name=f"serving-score-{self.name}-{i + 1}",
+                                 daemon=True)
+            t.start()
+            self._extra_scorers.append(t)
+        self._score_loop(handoff)
 
     def start(self) -> "ContinuousServer":
         self._thread = threading.Thread(
-            target=self._loop, name=f"serving-query-{self.name}", daemon=True)
+            target=self._pipelined_loop if self.pipelined else self._loop,
+            name=f"serving-query-{self.name}", daemon=True)
         self._thread.start()
         return self
 
@@ -533,6 +619,24 @@ class ContinuousServer:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._collector is not None:
+            self._collector.join(timeout=5)
+        for t in self._extra_scorers:
+            t.join(timeout=5)
+        # batches parked in the handoff when the scorers exited would
+        # leave their clients blocked until reply_timeout: fail them
+        # fast with 503 (the old serial loop always finished its batch)
+        if self._handoff is not None:
+            while True:
+                try:
+                    batch = self._handoff.get_nowait()
+                except queue.Empty:
+                    break
+                for cr in batch:
+                    self.server.reply_to(cr.rid, HTTPResponseData(
+                        status_code=503, reason="server stopping"))
+                for ep in sorted({cr.epoch for cr in batch}):
+                    self.server.commit(ep, exact=True)
         HTTPSourceStateHolder.remove(self.name)
 
 

@@ -50,3 +50,61 @@ def test_native_string_raw_scores_match_lightgbm(name):
     want = io["raw"]
     np.testing.assert_allclose(
         np.asarray(got).reshape(want.shape), want, rtol=1e-5, atol=1e-7)
+
+
+def test_fixture_generator_schema(tmp_path, monkeypatch):
+    """The CI lightgbm-groundtruth job (tools/ci/pipeline.yaml) runs
+    tools/make_lightgbm_fixtures.py with the real wheel. This in-image
+    test drives the SAME generator against a faked lightgbm module so
+    schema drift (renamed npz keys, changed file names, dropped cases)
+    is caught here, where the wheel cannot be installed — the npz keys
+    below are exactly what _fixture()/the gate tests consume."""
+    import sys
+    import types
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import make_lightgbm_fixtures as gen
+    finally:
+        sys.path.pop(0)
+
+    class _FakeBooster:
+        def model_to_string(self):
+            return "tree\nversion=v4\nobjective=binary\n"
+
+        def predict(self, x, raw_score=False):
+            return np.zeros(len(x) if np.ndim(x) else 1)
+
+    fake = types.ModuleType("lightgbm")
+    fake.__version__ = "0.0-fake"
+    fake.Dataset = lambda *a, **k: None
+    fake.train = lambda *a, **k: _FakeBooster()
+    monkeypatch.setitem(sys.modules, "lightgbm", fake)
+    monkeypatch.setattr(gen, "FIXTURES", str(tmp_path))
+    gen.main()
+
+    for name in CASES:
+        txt = tmp_path / f"lightgbm_{name}.txt"
+        npz = tmp_path / f"lightgbm_{name}_pred.npz"
+        assert txt.exists() and npz.exists(), name
+        data = np.load(npz)
+        # the exact keys the gate tests read — drift fails HERE
+        assert {"input", "pred", "raw", "lgb_version"} <= set(data.files)
+        assert data["input"].ndim == 2 and len(data["input"]) == 64
+
+    # the generator's data is deterministic: fixture regeneration with
+    # the same lightgbm version must be reproducible
+    x1, y1 = gen._data(seed=7, n_classes=3)
+    x2, y2 = gen._data(seed=7, n_classes=3)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+
+    # and the CI pipeline actually carries the job
+    ci = os.path.join(os.path.dirname(__file__), "..", "tools", "ci",
+                      "pipeline.yaml")
+    with open(ci) as fh:
+        text = fh.read()
+    assert "lightgbm-groundtruth" in text
+    assert "make_lightgbm_fixtures.py" in text
+    assert "test_lightgbm_groundtruth.py" in text

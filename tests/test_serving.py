@@ -323,3 +323,90 @@ def test_distributed_server_replay_and_ownership():
         assert results["r"] == (200, {"done": True})
     finally:
         ds.stop()
+
+
+def test_pipelined_scoring_overlaps_device_time():
+    """The two-stage pipeline + N scoring workers must overlap batch
+    collection AND scoring: with a 40 ms 'device' and max_batch=4, eight
+    open-loop requests take ~2 overlapped rounds pipelined vs ~2x that
+    strictly serial. Also asserts the adaptive path commits every merged
+    epoch (no request is left replayable after its reply)."""
+    calls = []
+
+    def slow_pipeline(table: Table) -> Table:
+        calls.append(table.num_rows)
+        time.sleep(0.04)
+        replies = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            replies[i] = make_reply({"ok": True})
+        return table.with_column("reply", replies)
+
+    def run(pipelined):
+        name = f"t_overlap_{pipelined}"
+        cs = ContinuousServer(name, slow_pipeline, max_batch=4,
+                              batch_linger=0.005, pipelined=pipelined,
+                              scoring_workers=2).start()
+        try:
+            _post(cs.url, {"warm": 1})
+            results = [None] * 8
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: results.__setitem__(
+                        i, _post(cs.url, {"i": i})))
+                for i in range(8)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            wall = time.perf_counter() - t0
+            assert all(r is not None and r[0] == 200 for r in results)
+            # every drained epoch was committed -> nothing replayable
+            assert cs.server.recover() == 0
+            return wall
+        finally:
+            cs.stop()
+
+    wall_serial = run(False)
+    wall_pipe = run(True)
+    # serial: >=2 rounds of (linger + 40ms) strictly one at a time;
+    # pipelined: two 40ms rounds in flight concurrently. Generous margin
+    # so scheduler jitter can't flake the assertion.
+    assert wall_pipe < wall_serial * 0.8, (wall_pipe, wall_serial)
+
+
+def test_exact_commit_preserves_earlier_inflight_epochs():
+    """Concurrent scorers finish epochs out of order: committing epoch 4
+    must NOT prune epoch 3's replay history (the cumulative prune is the
+    serial loop's semantics only) — recover() still replays epoch 3."""
+    from synapseml_tpu.io.serving import WorkerServer
+
+    ws = WorkerServer("t_exact_commit")
+    try:
+        results = {}
+
+        def client(i):
+            results[i] = _post(ws.url, {"i": i}, timeout=30)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        b3 = ws.get_batch(max_rows=1, timeout=5.0)   # epoch N
+        b4 = ws.get_batch(max_rows=1, timeout=5.0)   # epoch N+1
+        assert len(b3) == 1 and len(b4) == 1
+        # worker scoring b4 finishes FIRST and commits exactly
+        ws.reply_to(b4[0].rid, make_reply({"ok": 4}))
+        ws.commit(b4[0].epoch, exact=True)
+        # b3's scorer dies before replying: its epoch must still replay
+        assert ws.recover() == 1
+        b3r = ws.get_batch(max_rows=1, timeout=5.0)
+        assert b3r and b3r[0].rid == b3[0].rid
+        ws.reply_to(b3r[0].rid, make_reply({"ok": 3}))
+        ws.commit(b3r[0].epoch, exact=True)
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(r[0] for r in results.values()) == [200, 200]
+    finally:
+        ws.stop()

@@ -1,0 +1,57 @@
+"""Generate the committed speech fixture (tests/fixtures/utterances.wav).
+
+A canonical 16 kHz mono 16-bit WAV with three tone-burst "utterances"
+separated by silence — the smallest input exercising the whole speech
+scenario chain: WavStream format asserts -> energy endpointer (3
+segments) -> on-device log-mel AudioFeaturizer -> recurrent model ->
+per-utterance rows (ref: SpeechToTextSDK.scala + AudioStreams.scala:94;
+the reference streams such audio to the Azure SDK).
+
+Deterministic (fixed freqs/amplitudes, no RNG): regeneration is
+bit-for-bit reproducible.
+
+Run from the repo root:  python tools/make_audio_fixture.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from synapseml_tpu.cognitive.speech import pcm_to_wav  # noqa: E402
+
+SR = 16000
+FIXTURES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "fixtures")
+
+
+def build_pcm() -> np.ndarray:
+    def tone(freq, ms, amp):
+        t = np.arange(int(SR * ms / 1000)) / SR
+        # short fade-in/out so segment boundaries are clean
+        env = np.minimum(1.0, np.minimum(t, t[::-1]) / 0.01)
+        return amp * env * np.sin(2 * np.pi * freq * t)
+
+    def silence(ms):
+        return np.zeros(int(SR * ms / 1000))
+
+    x = np.concatenate([
+        silence(200), tone(440.0, 300, 0.30),
+        silence(450), tone(880.0, 420, 0.22),
+        silence(500), tone(330.0, 350, 0.35),
+        silence(250)])
+    return (x * 32767).astype("<i2")
+
+
+def main():
+    os.makedirs(FIXTURES, exist_ok=True)
+    wav = pcm_to_wav(build_pcm(), SR)
+    path = os.path.join(FIXTURES, "utterances.wav")
+    with open(path, "wb") as fh:
+        fh.write(wav)
+    print(f"wrote {path} ({len(wav)} bytes)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
