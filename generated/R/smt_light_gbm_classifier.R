@@ -4,6 +4,7 @@
 #'
 #' @param bagging_fraction row subsample
 #' @param bagging_freq bagging frequency
+#' @param bin_sample_count rows sampled to construct bin boundaries (reference binSampleCount, TrainParams.scala:17); also caps the cross-host gather of the row-sharded multi-host fit
 #' @param boosting_type gbdt|rf|dart|goss
 #' @param categorical_slot_indexes categorical feature slots
 #' @param delegate optional LightGBMDelegate with batch/iteration/LR hooks
@@ -38,11 +39,12 @@
 #' @param weight_col sample weight column
 #' @return a synapseml_tpu estimator handle
 #' @export
-smt_light_gbm_classifier <- function(bagging_fraction = 1.0, bagging_freq = 0, boosting_type = "gbdt", categorical_slot_indexes = NULL, delegate = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", hist_backend = "auto", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_batches = 0, num_iterations = 100, num_leaves = 31, objective = "binary", other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", probability_col = "probability", raw_prediction_col = "rawPrediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
+smt_light_gbm_classifier <- function(bagging_fraction = 1.0, bagging_freq = 0, bin_sample_count = 200000, boosting_type = "gbdt", categorical_slot_indexes = NULL, delegate = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", hist_backend = "auto", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_batches = 0, num_iterations = 100, num_leaves = 31, objective = "binary", other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", probability_col = "probability", raw_prediction_col = "rawPrediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.gbdt.estimators")
   kwargs <- Filter(Negate(is.null), list(
     bagging_fraction = bagging_fraction,
     bagging_freq = bagging_freq,
+    bin_sample_count = bin_sample_count,
     boosting_type = boosting_type,
     categorical_slot_indexes = categorical_slot_indexes,
     delegate = delegate,

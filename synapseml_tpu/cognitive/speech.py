@@ -410,9 +410,13 @@ def wav_to_utterance_rows(wav_bytes: bytes,
         table = table.with_column(str(feat.output_col),
                                   np.empty(0, dtype=object))
         return table
-    # copy() scopes the rate override to this call — mutating a shared
-    # featurizer would silently re-rate the caller's other pipelines
-    return feat.copy(sample_rate=ws.sample_rate).transform(table)
+    if int(feat.sample_rate) != ws.sample_rate:
+        # copy() scopes the rate override to this call — mutating a
+        # shared featurizer would silently re-rate the caller's other
+        # pipelines. Matching-rate calls (the streaming common case)
+        # keep the caller's instance and its warm compiled-graph cache.
+        feat = feat.copy(sample_rate=ws.sample_rate)
+    return feat.transform(table)
 
 
 def utterance_feature_batch(rows: Table, feature_col: str = "features"):

@@ -78,6 +78,10 @@ class _LightGBMParams:
     min_sum_hessian_in_leaf = Param("min hessian per leaf", default=1e-3)
     min_gain_to_split = Param("min split gain", default=0.0)
     max_bin = Param("histogram bins", default=255)
+    bin_sample_count = Param(
+        "rows sampled to construct bin boundaries (reference "
+        "binSampleCount, TrainParams.scala:17); also caps the cross-host "
+        "gather of the row-sharded multi-host fit", default=200_000)
     feature_fraction = Param("feature subsample per tree", default=1.0)
     bagging_fraction = Param("row subsample", default=1.0)
     bagging_freq = Param("bagging frequency", default=0)
@@ -122,6 +126,7 @@ class _LightGBMParams:
             min_sum_hessian_in_leaf=float(self.min_sum_hessian_in_leaf),
             min_gain_to_split=float(self.min_gain_to_split),
             max_bin=int(self.max_bin),
+            bin_sample_count=int(self.bin_sample_count),
             feature_fraction=float(self.feature_fraction),
             bagging_fraction=float(self.bagging_fraction),
             bagging_freq=int(self.bagging_freq),

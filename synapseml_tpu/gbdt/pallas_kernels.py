@@ -21,12 +21,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_TN = 512  # rows per grid step
+_TN = 512  # rows per grid step (wide-feature default)
+
+
+def _rows_per_step(n_feat: int) -> int:
+    """Rows per grid step, chosen by feature width. Each step issues
+    ``n_feat`` small MXU dots over the chunk; with few features a step
+    does too little work to cover grid overhead, so narrow matrices take
+    bigger chunks. Measured on v5e (r05): f=14 @ 1024 is 1.8x f=14 @ 512
+    isolated (8.8 -> 15.8M rows/s); f=28 @ 512 stays best (26.7M)."""
+    return 1024 if n_feat <= 16 else _TN
 
 
 def _hist_kernel(binned_ref, data_ref, out_ref, *, n_feat: int,
-                 n_bins_padded: int):
-    """binned_ref [TN, F] int32; data_ref [3, TN] f32 (pad rows are zero);
+                 n_bins_padded: int, tn: int):
+    """binned_ref [tn, F] int32; data_ref [3, tn] f32 (pad rows are zero);
     out_ref [F, 3, Bp] f32 accumulated across the sequential grid."""
     from jax.experimental import pallas as pl
 
@@ -36,7 +45,7 @@ def _hist_kernel(binned_ref, data_ref, out_ref, *, n_feat: int,
 
     chunk = binned_ref[...]
     dat = data_ref[...]
-    bins = jax.lax.broadcasted_iota(jnp.int32, (_TN, n_bins_padded), 1)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (tn, n_bins_padded), 1)
     # hi/lo split: the one-hot operand is exact in bf16, so two default-
     # precision MXU passes (hi + residual) recover ~f32 accuracy at 2/3 the
     # cost of Precision.HIGHEST's three passes
@@ -62,21 +71,22 @@ def histogram_tpu(binned: jnp.ndarray, data: jnp.ndarray,
     from jax.experimental.pallas import tpu as pltpu
 
     n, f = binned.shape
+    tn = _rows_per_step(f)
     bp = max(128, -(-n_bins // 128) * 128)
-    pad = (-n) % _TN
+    pad = (-n) % tn
     if pad:
         binned = jnp.pad(binned, ((0, pad), (0, 0)))
         data = jnp.pad(data, ((0, pad), (0, 0)))
-    grid = (binned.shape[0] // _TN,)
+    grid = (binned.shape[0] // tn,)
 
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_feat=f, n_bins_padded=bp),
+        functools.partial(_hist_kernel, n_feat=f, n_bins_padded=bp, tn=tn),
         out_shape=jax.ShapeDtypeStruct((f, 3, bp), jnp.float32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_TN, f), lambda i: (i, 0),
+            pl.BlockSpec((tn, f), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, _TN), lambda i: (0, i),
+            pl.BlockSpec((3, tn), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((f, 3, bp), lambda i: (0, 0, 0),

@@ -565,6 +565,16 @@ class ContinuousServer:
                 continue
             self._score_batch(batch)
 
+    def _fail_batch(self, batch: List[CachedRequest], status: int = 503,
+                    reason: str = "server stopping"):
+        """Fast-fail a drained-but-unscored batch (shutdown path): the
+        clients would otherwise block until reply_timeout."""
+        for cr in batch:
+            self.server.reply_to(cr.rid, HTTPResponseData(
+                status_code=status, reason=reason))
+        for ep in sorted({cr.epoch for cr in batch}):
+            self.server.commit(ep, exact=True)
+
     def _collect_loop(self, handoff: "queue.Queue"):
         """Stage 1: drain + linger concurrently with device scoring.
         While the scorer holds the handoff slot, the wait itself becomes
@@ -575,14 +585,20 @@ class ContinuousServer:
                                           linger=self.batch_linger)
             if not batch:
                 continue
+            placed = False
             while not self._stop.is_set():
                 try:
                     handoff.put(batch, timeout=0.05)
+                    placed = True
                     break
                 except queue.Full:
                     if len(batch) < self.max_batch:
                         batch.extend(self.server.get_batch(
                             self.max_batch - len(batch), timeout=0.001))
+            if not placed:
+                # stop() raced us while the batch was in hand: it can't
+                # see this batch in the handoff, so fail it here
+                self._fail_batch(batch)
 
     def _score_loop(self, handoff: "queue.Queue"):
         while not self._stop.is_set():
@@ -632,11 +648,7 @@ class ContinuousServer:
                     batch = self._handoff.get_nowait()
                 except queue.Empty:
                     break
-                for cr in batch:
-                    self.server.reply_to(cr.rid, HTTPResponseData(
-                        status_code=503, reason="server stopping"))
-                for ep in sorted({cr.epoch for cr in batch}):
-                    self.server.commit(ep, exact=True)
+                self._fail_batch(batch)
         HTTPSourceStateHolder.remove(self.name)
 
 
