@@ -355,6 +355,105 @@ def _qlinear_matmul(ctx, a, a_scale, a_zp, b, b_scale, b_zp, y_scale,
     return _requantize(acc, combined, y_zp)
 
 
+def _dq(x, scale, zp):
+    """Affine dequantize to f32 (per-tensor, the com.microsoft contrib
+    ops' convention)."""
+    return ((jnp.asarray(x).astype(jnp.float32)
+             - jnp.asarray(zp).astype(jnp.float32))
+            * jnp.asarray(scale, jnp.float32))
+
+
+def _q(val, y_scale, y_zp):
+    """Affine quantize f32 -> the zero point's dtype, saturating."""
+    out_dt = np.dtype(np.asarray(y_zp).dtype)
+    info = np.iinfo(out_dt)
+    q = (jnp.round(jnp.asarray(val) / jnp.asarray(y_scale, jnp.float32))
+         + jnp.asarray(y_zp).astype(jnp.float32))
+    return jnp.clip(q, info.min, info.max).astype(out_dt)
+
+
+# com.microsoft QOperator contrib family — what onnxruntime's static
+# quantizer (quant_format=QOperator) emits between the QLinearConv/
+# QLinearMatMul nodes. Dispatch is by op_type (domains carry no
+# semantics here); compute is dequant -> f32 op -> requant, which
+# matches ORT's lookup-table kernels to <=1 LSB.
+def _qlinear_binary(fn):
+    def impl(ctx, a, a_scale, a_zp, b, b_scale, b_zp, c_scale, c_zp):
+        return _q(fn(_dq(a, a_scale, a_zp), _dq(b, b_scale, b_zp)),
+                  c_scale, c_zp)
+    return impl
+
+
+_REGISTRY["QLinearAdd"] = _qlinear_binary(jnp.add)
+_REGISTRY["QLinearMul"] = _qlinear_binary(jnp.multiply)
+
+
+@op("QLinearSigmoid")
+def _qlinear_sigmoid(ctx, x, x_scale, x_zp, y_scale, y_zp):
+    return _q(jax.nn.sigmoid(_dq(x, x_scale, x_zp)), y_scale, y_zp)
+
+
+@op("QLinearLeakyRelu")
+def _qlinear_leaky_relu(ctx, x, x_scale, x_zp, y_scale, y_zp):
+    alpha = ctx.attr("alpha", 0.01)
+    v = _dq(x, x_scale, x_zp)
+    return _q(jnp.where(v >= 0, v, alpha * v), y_scale, y_zp)
+
+
+@op("QLinearGlobalAveragePool")
+def _qlinear_global_avg_pool(ctx, x, x_scale, x_zp, y_scale, y_zp):
+    axes = (tuple(range(1, jnp.ndim(x) - 1))
+            if ctx.attr("channels_last", 0)
+            else tuple(range(2, jnp.ndim(x))))
+    # mean over the int values first (exact in f32 for int8 sums of
+    # typical spatial extents), then one affine rescale
+    m = jnp.mean(jnp.asarray(x).astype(jnp.float32), axis=axes,
+                 keepdims=True)
+    return _q((m - jnp.asarray(x_zp, jnp.float32))
+              * jnp.asarray(x_scale, jnp.float32), y_scale, y_zp)
+
+
+@op("QLinearConcat")
+def _qlinear_concat(ctx, y_scale, y_zp, *parts):
+    axis = ctx.attr("axis")
+    if axis is None:
+        raise ValueError("QLinearConcat needs an axis attribute")
+    if len(parts) % 3:
+        raise ValueError("QLinearConcat inputs must be (X, scale, zp) "
+                         "triplets after (Y_scale, Y_zp)")
+    deq = [_dq(parts[i], parts[i + 1], parts[i + 2])
+           for i in range(0, len(parts), 3)]
+    return _q(jnp.concatenate(deq, axis=int(axis)), y_scale, y_zp)
+
+
+@op("QGemm")
+def _qgemm(ctx, a, a_scale, a_zp, b, b_scale, b_zp, c=None, y_scale=None,
+           y_zp=None):
+    """com.microsoft QGemm: integer gemm with optional int32 bias;
+    float output when y_scale is absent, requantized otherwise."""
+    alpha = ctx.attr("alpha", 1.0)
+    a32 = jnp.asarray(a).astype(jnp.int32)
+    b32 = jnp.asarray(b).astype(jnp.int32)
+    if ctx.attr("transA", 0):
+        a32 = a32.T
+    if ctx.attr("transB", 0):
+        b32 = b32.T
+    a32 = a32 - jnp.asarray(a_zp).astype(jnp.int32)
+    bz = jnp.asarray(b_zp).astype(jnp.int32)
+    b32 = b32 - (bz if bz.ndim == 0 else bz[None, :])
+    acc = jax.lax.dot_general(
+        a32, b32, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    if c is not None:
+        acc = acc + jnp.asarray(c).astype(jnp.int32)
+    combined = (alpha * jnp.asarray(a_scale, jnp.float32)
+                * jnp.asarray(b_scale, jnp.float32))
+    if y_scale is None:
+        return acc.astype(jnp.float32) * combined
+    return _requantize(acc, combined / jnp.asarray(y_scale, jnp.float32),
+                       y_zp)
+
+
 @op("Clip")
 def _clip(ctx, x, lo=None, hi=None):
     if ctx.opset < 11:

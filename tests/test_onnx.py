@@ -1822,3 +1822,157 @@ def test_detection_head_end_to_end():
     from synapseml_tpu.onnx.importer import _nms_host
     want = _nms_host(boxes_v, scores_v, 4, 0.5, 0.3, 0)
     np.testing.assert_array_equal(sel[sel[:, 2] >= 0], want)
+
+
+def test_qoperator_contrib_family():
+    """The com.microsoft QOperator ops onnxruntime's static quantizer
+    emits between QLinearConv/MatMul nodes: each against the exact
+    dequant -> f32 op -> requant formula, plus a composed QOperator
+    chain traced through one jit."""
+    import jax
+
+    rng = np.random.default_rng(3)
+
+    def q(v, s, zp):
+        return np.clip(np.rint(v / s) + zp, 0, 255).astype(np.uint8)
+
+    def dq(x, s, zp):
+        return (x.astype(np.float32) - zp) * s
+
+    a = rng.integers(0, 255, (2, 3, 4, 4)).astype(np.uint8)
+    b = rng.integers(0, 255, (2, 3, 4, 4)).astype(np.uint8)
+    sa, za, sb, zb, sc, zc = 0.04, 120, 0.03, 110, 0.06, 128
+
+    for op_name, fn in [("QLinearAdd", np.add),
+                        ("QLinearMul", np.multiply)]:
+        g = GraphBuilder(opset=21)
+        an = g.add_input("a", np.uint8, list(a.shape))
+        ins = [an, g.add_initializer("sa", np.float32(sa)),
+               g.add_initializer("za", np.uint8(za)),
+               g.add_initializer("b", b),
+               g.add_initializer("sb", np.float32(sb)),
+               g.add_initializer("zb", np.uint8(zb)),
+               g.add_initializer("sc", np.float32(sc)),
+               g.add_initializer("zc", np.uint8(zc))]
+        y = g.add_node(op_name, ins, domain="com.microsoft")
+        g.add_output(y, np.uint8, None)
+        gi = import_model(g.to_bytes())
+        got = np.asarray(jax.jit(gi.apply)(gi.params, jnp.asarray(a))[0])
+        want = q(fn(dq(a, sa, za), dq(b, sb, zb)), sc, zc)
+        np.testing.assert_array_equal(got, want, err_msg=op_name)
+
+    # QLinearSigmoid + QLinearLeakyRelu + QLinearGlobalAveragePool
+    x = rng.integers(0, 255, (2, 5, 6, 6)).astype(np.uint8)
+    sx, zx, sy, zy = 0.02, 128, 1.0 / 256, 0
+    for op_name, ref, attrs in [
+        ("QLinearSigmoid",
+         lambda v: 1 / (1 + np.exp(-v)), {}),
+        ("QLinearLeakyRelu",
+         lambda v: np.where(v >= 0, v, 0.1 * v), {"alpha": 0.1}),
+    ]:
+        g = GraphBuilder(opset=21)
+        xn = g.add_input("x", np.uint8, list(x.shape))
+        ins = [xn, g.add_initializer("sx", np.float32(sx)),
+               g.add_initializer("zx", np.uint8(zx)),
+               g.add_initializer("sy", np.float32(sy)),
+               g.add_initializer("zy", np.uint8(zy))]
+        y = g.add_node(op_name, ins, domain="com.microsoft", **attrs)
+        g.add_output(y, np.uint8, None)
+        gi = import_model(g.to_bytes())
+        got = np.asarray(gi.apply(gi.params, x)[0])
+        want = q(ref(dq(x, sx, zx)), sy, zy)
+        diff = np.abs(got.astype(int) - want.astype(int))
+        assert diff.max() <= 1 and (diff == 0).mean() > 0.99, op_name
+
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.uint8, list(x.shape))
+    ins = [xn, g.add_initializer("sx", np.float32(sx)),
+           g.add_initializer("zx", np.uint8(zx)),
+           g.add_initializer("sy", np.float32(0.015)),
+           g.add_initializer("zy", np.uint8(100))]
+    y = g.add_node("QLinearGlobalAveragePool", ins,
+                   domain="com.microsoft")
+    g.add_output(y, np.uint8, None)
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    want = q(dq(x, sx, zx).mean(axis=(2, 3), keepdims=True), 0.015, 100)
+    diff = np.abs(got.astype(int) - want.astype(int))
+    assert diff.max() <= 1, diff.max()
+
+    # QLinearConcat: triplets after (Y_scale, Y_zp)
+    g = GraphBuilder(opset=21)
+    an = g.add_input("a", np.uint8, [2, 3])
+    a2 = rng.integers(0, 255, (2, 3)).astype(np.uint8)
+    b2 = rng.integers(0, 255, (2, 2)).astype(np.uint8)
+    ins = [g.add_initializer("sy", np.float32(0.05)),
+           g.add_initializer("zy", np.uint8(128)),
+           an, g.add_initializer("s1", np.float32(0.04)),
+           g.add_initializer("z1", np.uint8(100)),
+           g.add_initializer("b2", b2),
+           g.add_initializer("s2", np.float32(0.02)),
+           g.add_initializer("z2", np.uint8(50))]
+    y = g.add_node("QLinearConcat", ins, domain="com.microsoft", axis=1)
+    g.add_output(y, np.uint8, [2, 5])
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, a2)[0])
+    want = q(np.concatenate([dq(a2, 0.04, 100), dq(b2, 0.02, 50)],
+                            axis=1), 0.05, 128)
+    np.testing.assert_array_equal(got, want)
+
+    # QGemm: int accumulation, int32 bias, requantized AND float outputs
+    A = rng.integers(0, 255, (3, 4)).astype(np.uint8)
+    B = rng.integers(-127, 127, (4, 5)).astype(np.int8)
+    bias = rng.integers(-500, 500, 5).astype(np.int32)
+    for with_y in (True, False):
+        g = GraphBuilder(opset=21)
+        an = g.add_input("a", np.uint8, [3, 4])
+        ins = [an, g.add_initializer("sa", np.float32(0.1)),
+               g.add_initializer("za", np.uint8(10)),
+               g.add_initializer("B", B),
+               g.add_initializer("sb", np.float32(0.2)),
+               g.add_initializer("zb", np.int8(3)),
+               g.add_initializer("bias", bias)]
+        if with_y:
+            ins += [g.add_initializer("sy", np.float32(0.4)),
+                    g.add_initializer("zy", np.uint8(64))]
+        y = g.add_node("QGemm", ins, domain="com.microsoft", alpha=1.0)
+        g.add_output(y, np.uint8 if with_y else np.float32, None)
+        gi = import_model(g.to_bytes())
+        got = np.asarray(gi.apply(gi.params, A)[0])
+        acc = (A.astype(np.int64) - 10) @ (B.astype(np.int64) - 3) + bias
+        if with_y:
+            want = np.clip(np.rint(acc * np.float32(0.1 * 0.2 / 0.4))
+                           + 64, 0, 255).astype(np.uint8)
+            np.testing.assert_array_equal(got, want)
+        else:
+            np.testing.assert_allclose(
+                got, acc * np.float32(0.1 * 0.2), rtol=1e-6)
+
+    # composed QOperator chain through one jit: QLinearConv ->
+    # QLinearSigmoid -> QLinearGlobalAveragePool
+    w = rng.integers(-100, 100, (4, 5, 3, 3)).astype(np.int8)
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.uint8, list(x.shape))
+    conv = g.add_node("QLinearConv", [
+        xn, g.add_initializer("cxs", np.float32(sx)),
+        g.add_initializer("cxz", np.uint8(zx)),
+        g.add_initializer("w", w),
+        g.add_initializer("cws", np.float32(0.01)),
+        g.add_initializer("cwz", np.int8(0)),
+        g.add_initializer("cys", np.float32(0.05)),
+        g.add_initializer("cyz", np.uint8(128))], pads=[1, 1, 1, 1])
+    sig = g.add_node("QLinearSigmoid", [
+        conv, g.add_initializer("ssx", np.float32(0.05)),
+        g.add_initializer("ssz", np.uint8(128)),
+        g.add_initializer("ssy", np.float32(1.0 / 256)),
+        g.add_initializer("sszy", np.uint8(0))], domain="com.microsoft")
+    pool = g.add_node("QLinearGlobalAveragePool", [
+        sig, g.add_initializer("psx", np.float32(1.0 / 256)),
+        g.add_initializer("psz", np.uint8(0)),
+        g.add_initializer("psy", np.float32(1.0 / 256)),
+        g.add_initializer("pszy", np.uint8(0))], domain="com.microsoft")
+    g.add_output(pool, np.uint8, None)
+    gi = import_model(g.to_bytes())
+    out = np.asarray(jax.jit(gi.apply)(gi.params, jnp.asarray(x))[0])
+    assert out.shape == (2, 4, 1, 1) and out.dtype == np.uint8
+    assert out.min() >= 0 and int(out.max()) <= 255
