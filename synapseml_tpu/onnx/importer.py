@@ -2063,6 +2063,300 @@ def _rnn(ctx, x, w, r, b=None, seq_lens=None, init_h=None):
     return (y, y_h)[: max(ctx.n_outputs, 1)] if ctx.n_outputs > 1 else y
 
 
+for _name, _np_fn, _jnp_fn in [
+    ("BitwiseAnd", np.bitwise_and, jnp.bitwise_and),
+    ("BitwiseOr", np.bitwise_or, jnp.bitwise_or),
+    ("BitwiseXor", np.bitwise_xor, jnp.bitwise_xor),
+]:
+    _REGISTRY[_name] = _ew(_np_fn, _jnp_fn)
+_REGISTRY["BitwiseNot"] = _ew(np.invert, jnp.invert)
+
+
+@op("DFT")
+def _dft(ctx, x, dft_length=None, axis=None):
+    """Discrete Fourier transform (opset 17 axis-attr / 20 axis-input).
+    Real input [..., n, 1] or complex [..., n, 2]; output [..., m, 2]."""
+    x = jnp.asarray(x)
+    if axis is not None:
+        (ax,) = _static_int_list(axis, "DFT axis")
+    else:
+        # opset 20 moved axis to an input with default -2; opset 17's
+        # attribute default is 1. Axes count over the FULL rank
+        # (including the trailing re/im dim) per the ONNX spec.
+        ax = ctx.attr("axis", -2 if ctx.opset >= 20 else 1)
+    ax = ax % x.ndim
+    if ax == x.ndim - 1:
+        raise ValueError("DFT cannot transform the trailing re/im dim")
+    n_fft = None
+    if dft_length is not None:
+        (n_fft,) = _static_int_list(dft_length, "DFT dft_length")
+    inverse = bool(ctx.attr("inverse", 0))
+    onesided = bool(ctx.attr("onesided", 0))
+    if x.shape[-1] == 2:
+        sig = jax.lax.complex(x[..., 0], x[..., 1])
+    elif x.shape[-1] == 1:
+        sig = x[..., 0].astype(jnp.complex64)
+    else:
+        raise ValueError("DFT input must end in a [1|2] re/im dimension")
+    if inverse:
+        if onesided:
+            raise NotImplementedError("DFT: inverse+onesided")
+        spec = jnp.fft.ifft(sig, n=n_fft, axis=ax)
+    elif onesided:
+        spec = jnp.fft.rfft(jnp.real(sig), n=n_fft, axis=ax)
+    else:
+        spec = jnp.fft.fft(sig, n=n_fft, axis=ax)
+    out = jnp.stack([jnp.real(spec), jnp.imag(spec)], axis=-1)
+    # same-T output constraint: preserve the input's float dtype
+    return out.astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.float32)
+
+
+@op("CenterCropPad")
+def _center_crop_pad(ctx, x, shape):
+    """Center-crop or zero-pad each listed axis to the target extent."""
+    x = jnp.asarray(x) if not _is_host(x) else np.asarray(x)
+    target = _static_int_list(shape, "CenterCropPad shape")
+    axes = ctx.attr("axes")
+    if axes is None:
+        axes = list(range(len(target)))
+    xp = np if _is_host(x) else jnp
+    for ax, want in zip(axes, target):
+        ax = ax % x.ndim
+        have = x.shape[ax]
+        if want < have:  # crop: extra-at-start goes to the low side
+            lo = (have - want) // 2
+            x = jax.lax.slice_in_dim(x, lo, lo + want, axis=ax) \
+                if xp is jnp else np.take(x, range(lo, lo + want), axis=ax)
+        elif want > have:  # pad: extra-at-end goes to the high side
+            lo = (want - have) // 2
+            pads = [(0, 0)] * x.ndim
+            pads[ax] = (lo, want - have - lo)
+            x = xp.pad(x, pads)
+    return x
+
+
+@op("Col2Im")
+def _col2im(ctx, x, image_shape, block_shape):
+    """Inverse of the exporters' im2col unfolding (opset 18): scatter-add
+    column blocks back into the image. One vectorized index build + one
+    .at[].add — XLA lowers it as a single scatter."""
+    img = _static_int_list(image_shape, "Col2Im image_shape")
+    blk = _static_int_list(block_shape, "Col2Im block_shape")
+    rank = len(img)
+    strides = ctx.attr("strides", [1] * rank)
+    dil = ctx.attr("dilations", [1] * rank)
+    pads = ctx.attr("pads", [0] * (2 * rank))
+    x = jnp.asarray(x)
+    n, ckk, L = x.shape
+    kprod = int(np.prod(blk))
+    c = ckk // kprod
+    # per-dim output positions of each (block offset, column) pair
+    outs = [1 + (img[d] + pads[d] + pads[d + rank]
+                 - dil[d] * (blk[d] - 1) - 1) // strides[d]
+            for d in range(rank)]
+    if int(np.prod(outs)) != L:
+        raise ValueError(
+            f"Col2Im: {L} columns do not factor into positions {outs}")
+    k_idx = np.stack(np.unravel_index(np.arange(kprod), blk), 0)  # [r,K]
+    l_idx = np.stack(np.unravel_index(np.arange(L), outs), 0)     # [r,L]
+    coords = []
+    valid = np.ones((kprod, L), bool)
+    for d in range(rank):
+        pos = (k_idx[d][:, None] * dil[d]
+               + l_idx[d][None, :] * strides[d] - pads[d])  # [K, L]
+        valid &= (pos >= 0) & (pos < img[d])
+        coords.append(np.clip(pos, 0, img[d] - 1))
+    flat = np.zeros((kprod, L), np.int64)
+    for d in range(rank):
+        flat = flat * img[d] + coords[d]
+    vals = x.reshape(n, c, kprod, L) * jnp.asarray(valid, x.dtype)
+    out = jnp.zeros((n, c, int(np.prod(img))), x.dtype)
+    out = out.at[:, :, jnp.asarray(flat.reshape(-1))].add(
+        vals.reshape(n, c, -1))
+    return out.reshape((n, c) + tuple(img))
+
+
+@op("AffineGrid")
+def _affine_grid(ctx, theta, size):
+    """Sampling-grid generator (opset 20) — pairs with GridSample, the
+    torch.nn.functional.affine_grid export."""
+    dims = _static_int_list(size, "AffineGrid size")
+    align = bool(ctx.attr("align_corners", 0))
+    theta = jnp.asarray(theta, jnp.float32)
+    spatial = dims[2:]
+    rank = len(spatial)
+    if rank not in (2, 3):
+        raise NotImplementedError("AffineGrid supports 4-D/5-D sizes")
+
+    def axis_coords(n):
+        if align:
+            return (jnp.linspace(-1.0, 1.0, n) if n > 1
+                    else jnp.zeros((1,)))
+        step = 2.0 / n
+        return -1.0 + step / 2 + step * jnp.arange(n, dtype=jnp.float32)
+
+    axes = [axis_coords(s) for s in spatial]
+    mesh = jnp.meshgrid(*axes, indexing="ij")          # rank x spatial
+    # homogeneous coords ordered (x, y[, z]) = reversed spatial order
+    ones = jnp.ones_like(mesh[0])
+    pts = jnp.stack(list(reversed(mesh)) + [ones], -1)  # [*sp, rank+1]
+    grid = jnp.einsum("...k,njk->n...j", pts, theta)
+    return grid.astype(jnp.float32)
+
+
+@op("Unique")
+def _unique(ctx, x):
+    """Data-dependent output shape: host-side only (same contract as the
+    reference's ORT CPU kernel; a traced input cannot produce a
+    dynamic-shape XLA result)."""
+    if not _is_host(x):
+        raise NotImplementedError(
+            "Unique produces data-dependent shapes; feed it host-side "
+            "data (constant-folded subgraph) or move it out of the "
+            "jitted region")
+    x = np.asarray(x)
+    axis = ctx.attr("axis")
+    is_sorted = bool(ctx.attr("sorted", 1))
+    y, first_idx, inverse, counts = np.unique(
+        x, return_index=True, return_inverse=True, return_counts=True,
+        axis=axis)
+    if not is_sorted:
+        order = np.argsort(first_idx, kind="stable")
+        rank_of = np.empty_like(order)
+        rank_of[order] = np.arange(len(order))
+        y = np.take(y, order, axis=axis if axis is not None else 0)
+        first_idx = first_idx[order]
+        counts = counts[order]
+        inverse = rank_of[inverse]
+    outs = (y, first_idx.astype(np.int64),
+            inverse.reshape(-1).astype(np.int64),
+            counts.astype(np.int64))
+    return outs[: max(ctx.n_outputs, 1)] if ctx.n_outputs > 1 else y
+
+
+@op("Compress")
+def _compress(ctx, x, condition):
+    """Boolean-mask selection — output length is data-dependent, so the
+    condition must be host-side (initializer / folded)."""
+    if not (_is_host(condition) and _is_host(x)):
+        raise NotImplementedError(
+            "Compress produces data-dependent shapes; condition and data "
+            "must be host-side (constant-folded)")
+    return np.compress(np.asarray(condition, bool).reshape(-1),
+                       np.asarray(x), axis=ctx.attr("axis"))
+
+
+def _nll_core(logp, target, weight, reduction, ignore_index):
+    n, c = logp.shape[0], logp.shape[1]
+    t = jnp.asarray(target).astype(jnp.int32)
+    gather = jnp.take_along_axis(
+        logp, t[:, None] if logp.ndim == 2
+        else t[:, None, ...], axis=1).squeeze(1)
+    w = (jnp.asarray(weight, jnp.float32)[t.clip(0, c - 1)]
+         if weight is not None else jnp.ones_like(gather))
+    if ignore_index is not None:
+        w = jnp.where(t == ignore_index, 0.0, w)
+    loss = -gather * w
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    return loss.sum() / jnp.maximum(w.sum(), 1e-12)
+
+
+@op("NegativeLogLikelihoodLoss")
+def _nll_loss(ctx, x, target, weight=None):
+    return _nll_core(jnp.asarray(x, jnp.float32), target, weight,
+                     ctx.attr("reduction", "mean"),
+                     ctx.attr("ignore_index"))
+
+
+@op("SoftmaxCrossEntropyLoss")
+def _softmax_ce_loss(ctx, scores, target, weight=None):
+    logp = jax.nn.log_softmax(jnp.asarray(scores, jnp.float32), axis=1)
+    loss = _nll_core(logp, target, weight, ctx.attr("reduction", "mean"),
+                     ctx.attr("ignore_index"))
+    return (loss, logp) if ctx.n_outputs > 1 else loss
+
+
+@op("MatMulNBits")
+def _matmul_nbits(ctx, a, b_packed, scales, zero_points=None):
+    """com.microsoft blockwise 4-bit quantized matmul — the quantized-LLM
+    weight format. B is [N, K/block, block/2] packed nibbles (low nibble
+    = even element); dequantize blockwise to [K, N] once (XLA keeps it
+    fused into the dot's operand) and run one MXU matmul."""
+    bits = int(ctx.attr("bits", 4))
+    if bits != 4:
+        raise NotImplementedError("MatMulNBits: only bits=4 is supported")
+    K = int(ctx.attr("K"))
+    N = int(ctx.attr("N"))
+    block = int(ctx.attr("block_size"))
+    bp = jnp.asarray(b_packed)
+    n_blocks = bp.shape[1]
+    lo = (bp & 0xF).astype(jnp.int32)
+    hi = (bp >> 4).astype(jnp.int32)
+    nibbles = jnp.stack([lo, hi], -1).reshape(N, n_blocks, -1)  # [N,nb,blk]
+    sc = jnp.asarray(scales, jnp.float32).reshape(N, n_blocks)
+    if zero_points is None:
+        zp = jnp.full((N, n_blocks), 8.0, jnp.float32)
+    else:
+        zpa = jnp.asarray(zero_points)
+        if zpa.dtype == jnp.uint8 and zpa.ndim == 1:
+            # packed 4-bit zero points, one nibble per block
+            zl = (zpa & 0xF).astype(jnp.float32)
+            zh = (zpa >> 4).astype(jnp.float32)
+            zp = jnp.stack([zl, zh], -1).reshape(N, -1)[:, :n_blocks]
+        else:
+            zp = zpa.astype(jnp.float32).reshape(N, n_blocks)
+    deq = (nibbles.astype(jnp.float32) - zp[..., None]) * sc[..., None]
+    w = deq.reshape(N, n_blocks * block)[:, :K]               # [N, K]
+    a = jnp.asarray(a)
+    return jnp.matmul(a, w.T.astype(a.dtype))
+
+
+@op("RotaryEmbedding")
+def _rotary_embedding(ctx, x, position_ids, cos_cache, sin_cache):
+    """com.microsoft rotary position embedding (the LLM export op).
+    3-D [B, S, H] (num_heads attr) or 4-D [B, NH, S, Hd] input;
+    interleaved and half-split layouts."""
+    interleaved = bool(ctx.attr("interleaved", 0))
+    x = jnp.asarray(x)
+    squeeze_back = x.ndim == 3
+    if squeeze_back:
+        nh = int(ctx.attr("num_heads", 0))
+        b, s, h = x.shape
+        if nh <= 0:
+            raise ValueError("RotaryEmbedding: 3-D input needs num_heads")
+        x = x.reshape(b, s, nh, h // nh).transpose(0, 2, 1, 3)
+    b, nh, s, hd = x.shape
+    rot = int(ctx.attr("rotary_embedding_dim", 0)) or hd
+    pos = jnp.asarray(position_ids).astype(jnp.int32)
+    if pos.size == 1:
+        # ORT's start-offset form: one scalar position id means
+        # positions start there and increment per token
+        pos = pos.reshape(1, 1) + jnp.arange(s, dtype=jnp.int32)[None, :]
+    elif pos.ndim == 1:
+        pos = pos[None, :]
+    cos = jnp.asarray(cos_cache, jnp.float32)[pos][:, None]  # [B,1,S,rot/2]
+    sin = jnp.asarray(sin_cache, jnp.float32)[pos][:, None]
+    xr, xpass = x[..., :rot], x[..., rot:]
+    if interleaved:
+        x1, x2 = xr[..., 0::2], xr[..., 1::2]
+    else:
+        x1, x2 = xr[..., : rot // 2], xr[..., rot // 2:]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    if interleaved:
+        out = jnp.stack([o1, o2], -1).reshape(xr.shape)
+    else:
+        out = jnp.concatenate([o1, o2], -1)
+    out = jnp.concatenate([out.astype(x.dtype), xpass], -1)
+    if squeeze_back:
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Detection ops (SSD / YOLO / Faster-RCNN export families)
 # ---------------------------------------------------------------------------
@@ -2290,6 +2584,10 @@ class ImportedGraph:
             # NMS capacity + thresholds select the compiled program's
             # shape/constants (incl. the float iou/score thresholds)
             "NonMaxSuppression": (2, 3, 4),
+            "DFT": (1, 2), "Col2Im": (1, 2), "AffineGrid": (1,),
+            # host-only data-dependent ops: their float data must not
+            # ride the jit params pytree as tracers
+            "Unique": (0,), "Compress": (0, 1),
         }
         shape_fed = set()
         for node in graph.node:

@@ -1976,3 +1976,288 @@ def test_qoperator_contrib_family():
     out = np.asarray(jax.jit(gi.apply)(gi.params, jnp.asarray(x))[0])
     assert out.shape == (2, 4, 1, 1) and out.dtype == np.uint8
     assert out.min() >= 0 and int(out.max()) <= 255
+
+
+def _one_op_graph(op_name, inputs, input_specs, out_dtype=np.float32,
+                  opset=21, domain="", n_outputs=1, **attrs):
+    """Single-node graph builder: ``inputs`` is an ordered list of
+    (name, array_or_None) pairs — None marks a runtime input whose
+    (dtype, shape) comes from ``input_specs``; arrays become
+    initializers."""
+    g = GraphBuilder(opset=opset)
+    names = []
+    for name, arr in inputs:
+        if arr is None:
+            dt, shp = input_specs[name]
+            names.append(g.add_input(name, dt, shp))
+        else:
+            names.append(g.add_initializer(name, arr))
+    outs = [f"out{i}" for i in range(n_outputs)]
+    g.add_node(op_name, names, outputs=outs, domain=domain, **attrs)
+    for o in outs:
+        g.add_output(o, out_dtype, None)
+    return import_model(g.to_bytes())
+
+
+def test_bitwise_dft_centercroppad():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 255, (3, 4)).astype(np.uint8)
+    b = rng.integers(0, 255, (3, 4)).astype(np.uint8)
+    for op_name, fn in [("BitwiseAnd", np.bitwise_and),
+                        ("BitwiseOr", np.bitwise_or),
+                        ("BitwiseXor", np.bitwise_xor)]:
+        gi = _one_op_graph(op_name, [("a", None), ("b", b)],
+                           {"a": (np.uint8, [3, 4])}, out_dtype=np.uint8)
+        np.testing.assert_array_equal(
+            np.asarray(gi.apply(gi.params, a)[0]), fn(a, b))
+    gi = _one_op_graph("BitwiseNot", [("a", None)],
+                       {"a": (np.uint8, [3, 4])}, out_dtype=np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(gi.apply(gi.params, a)[0]), np.invert(a))
+
+    # DFT: real forward (onesided + full), complex inverse, negative axis
+    sig = rng.normal(size=(2, 16, 1)).astype(np.float32)
+    gi = _one_op_graph("DFT", [("x", None)],
+                       {"x": (np.float32, [2, 16, 1])}, onesided=1)
+    got = np.asarray(gi.apply(gi.params, sig)[0])
+    spec = np.fft.rfft(sig[..., 0], axis=1)
+    np.testing.assert_allclose(got[..., 0], spec.real, atol=2e-4)
+    np.testing.assert_allclose(got[..., 1], spec.imag, atol=2e-4)
+
+    # axis counts over the FULL rank incl. the trailing re/im dim, so
+    # -2 (the opset-20 default, also valid explicitly) is the signal
+    # axis of [2, 16, 1] — NOT the batch axis (round-5 review repro)
+    gi = _one_op_graph("DFT", [("x", None),
+                               ("dl", np.asarray(16, np.int64)),
+                               ("ax", np.asarray(-2, np.int64))],
+                       {"x": (np.float32, [2, 16, 1])}, onesided=1,
+                       opset=21)
+    got_neg = np.asarray(gi.apply(gi.params, sig)[0])
+    np.testing.assert_allclose(got_neg, got, atol=1e-5)
+
+    comp = rng.normal(size=(2, 8, 2)).astype(np.float32)
+    gi = _one_op_graph("DFT", [("x", None)],
+                       {"x": (np.float32, [2, 8, 2])}, inverse=1, axis=1)
+    got = np.asarray(gi.apply(gi.params, comp)[0])
+    want = np.fft.ifft(comp[..., 0] + 1j * comp[..., 1], axis=1)
+    np.testing.assert_allclose(got[..., 0], want.real, atol=2e-5)
+    np.testing.assert_allclose(got[..., 1], want.imag, atol=2e-5)
+
+    # float16 in -> float16 out (same-T output constraint)
+    gi = _one_op_graph("DFT", [("x", None)],
+                       {"x": (np.float16, [2, 16, 1])}, onesided=1)
+    assert np.asarray(
+        gi.apply(gi.params, sig.astype(np.float16))[0]).dtype == np.float16
+
+    # CenterCropPad: crop one axis, pad the other (ONNX center rules)
+    x = np.arange(5 * 7, dtype=np.float32).reshape(5, 7)
+    gi = _one_op_graph("CenterCropPad",
+                       [("x", None),
+                        ("shape", np.asarray([7, 3], np.int64))],
+                       {"x": (np.float32, [5, 7])}, opset=21)
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    assert got.shape == (7, 3)
+    np.testing.assert_array_equal(got[1:6], x[:, 2:5])
+    assert (got[0] == 0).all() and (got[6] == 0).all()
+
+
+def test_col2im_and_affine_grid_match_torch():
+    rng = np.random.default_rng(1)
+    # Col2Im == torch.nn.functional.fold
+    n, c, kh, kw = 2, 3, 2, 3
+    oh, ow = 4, 5
+    L = ((oh + 2 - 2 * (kh - 1) - 1) // 1 + 1) * \
+        ((ow + 2 - 1 * (kw - 1) - 1) // 2 + 1)
+    cols = rng.normal(size=(n, c * kh * kw, L)).astype(np.float32)
+    gi = _one_op_graph(
+        "Col2Im",
+        [("x", None), ("img", np.asarray([oh, ow], np.int64)),
+         ("blk", np.asarray([kh, kw], np.int64))],
+        {"x": (np.float32, list(cols.shape))},
+        dilations=[2, 1], pads=[1, 1, 1, 1], strides=[1, 2])
+    got = np.asarray(gi.apply(gi.params, cols)[0])
+    want = torch.nn.functional.fold(
+        torch.from_numpy(cols), (oh, ow), (kh, kw), dilation=(2, 1),
+        padding=(1, 1), stride=(1, 2)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # AffineGrid == torch.nn.functional.affine_grid
+    theta = rng.normal(size=(2, 2, 3)).astype(np.float32)
+    for align in (0, 1):
+        gi = _one_op_graph(
+            "AffineGrid",
+            [("theta", None),
+             ("size", np.asarray([2, 3, 4, 5], np.int64))],
+            {"theta": (np.float32, [2, 2, 3])}, align_corners=align)
+        got = np.asarray(gi.apply(gi.params, theta)[0])
+        want = torch.nn.functional.affine_grid(
+            torch.from_numpy(theta), (2, 3, 4, 5),
+            align_corners=bool(align)).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5,
+                                   err_msg=f"align={align}")
+
+
+def test_unique_compress_and_loss_ops():
+    import jax
+
+    # Unique: host path, sorted and first-appearance order
+    x = np.asarray([2.0, 1.0, 1.0, 3.0, 4.0, 3.0], np.float32)
+    for sorted_attr in (1, 0):
+        gi = _one_op_graph("Unique", [("x", x)], {}, n_outputs=4,
+                           sorted=sorted_attr)
+        y, idx, inv, counts = [np.asarray(o) for o in gi.apply(gi.params)]
+        if sorted_attr:
+            np.testing.assert_array_equal(y, [1, 2, 3, 4])
+        else:
+            np.testing.assert_array_equal(y, [2, 1, 3, 4])
+        np.testing.assert_array_equal(y[inv], x)
+        np.testing.assert_array_equal(x[idx], y)
+        assert counts.sum() == len(x)
+        # host-only data rides static_params, so the same graph works
+        # INSIDE jit too (round-5 review: Unique/Compress must not land
+        # in the traced params pytree)
+        y2 = np.asarray(jax.jit(gi.apply)(gi.params)[0])
+        np.testing.assert_array_equal(y2, y)
+
+    # a traced RUNTIME input -> explicit recipe error
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, [6])
+    o = g.add_node("Unique", [xn])
+    g.add_output(o, np.float32, None)
+    gi2 = import_model(g.to_bytes())
+    with pytest.raises(NotImplementedError, match="data-dependent"):
+        jax.jit(gi2.apply)(gi2.params, jnp.asarray(x))
+
+    gi = _one_op_graph(
+        "Compress",
+        [("x", np.arange(12, dtype=np.float32).reshape(3, 4)),
+         ("cond", np.asarray([True, False, True]))], {}, axis=0)
+    np.testing.assert_array_equal(
+        np.asarray(gi.apply(gi.params)[0]),
+        np.arange(12, dtype=np.float32).reshape(3, 4)[[0, 2]])
+
+    # NLL / SoftmaxCrossEntropy vs torch (weights + ignore_index + all
+    # reductions)
+    rng = np.random.default_rng(2)
+    scores = rng.normal(size=(6, 5)).astype(np.float32)
+    target = rng.integers(0, 5, 6).astype(np.int64)
+    target[2] = 3
+    weight = (rng.random(5) + 0.5).astype(np.float32)
+    for reduction in ("mean", "sum", "none"):
+        for ignore in (None, 3):
+            kw = dict(reduction=reduction)
+            if ignore is not None:
+                kw["ignore_index"] = ignore
+            gi = _one_op_graph(
+                "SoftmaxCrossEntropyLoss",
+                [("s", None), ("t", target), ("w", weight)],
+                {"s": (np.float32, [6, 5])}, **kw)
+            got = np.asarray(gi.apply(gi.params, scores)[0])
+            want = torch.nn.functional.cross_entropy(
+                torch.from_numpy(scores), torch.from_numpy(target),
+                weight=torch.from_numpy(weight), reduction=reduction,
+                ignore_index=ignore if ignore is not None else -100
+            ).numpy()
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                                       err_msg=f"{reduction}/{ignore}")
+
+    logp = np.log(np.abs(scores) + 0.1).astype(np.float32)
+    gi = _one_op_graph("NegativeLogLikelihoodLoss",
+                       [("l", None), ("t", target)],
+                       {"l": (np.float32, [6, 5])}, reduction="sum")
+    got = np.asarray(gi.apply(gi.params, logp)[0])
+    want = torch.nn.functional.nll_loss(
+        torch.from_numpy(logp), torch.from_numpy(target),
+        reduction="sum").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_matmul_nbits_and_rotary_embedding():
+    rng = np.random.default_rng(3)
+    # MatMulNBits: pack a known int4 matrix blockwise, compare against
+    # the float dequant reference
+    N, K, block = 6, 32, 16
+    n_blocks = K // block
+    q = rng.integers(0, 16, (N, K)).astype(np.uint8)          # int4 vals
+    scales = (rng.random((N, n_blocks)) * 0.2 + 0.05).astype(np.float32)
+    packed = (q[:, 0::2] | (q[:, 1::2] << 4)).reshape(
+        N, n_blocks, block // 2)
+    a = rng.normal(size=(2, K)).astype(np.float32)
+    w = ((q.astype(np.float32)
+          - 8.0).reshape(N, n_blocks, block)
+         * scales[..., None]).reshape(N, K)
+    gi = _one_op_graph(
+        "MatMulNBits",
+        [("a", None), ("b", packed), ("sc", scales.reshape(-1))],
+        {"a": (np.float32, [2, K])}, domain="com.microsoft",
+        K=K, N=N, bits=4, block_size=block)
+    got = np.asarray(gi.apply(gi.params, a)[0])
+    np.testing.assert_allclose(got, a @ w.T, rtol=2e-5, atol=2e-5)
+
+    # explicit packed 4-bit zero points
+    zp_vals = rng.integers(0, 16, (N, n_blocks)).astype(np.uint8)
+    zp_packed = (zp_vals[:, 0::2] | ((zp_vals[:, 1::2] << 4)
+                 if n_blocks > 1 else 0)).astype(np.uint8)
+    w2 = ((q.astype(np.float32) - zp_vals.repeat(block, 1))
+          .reshape(N, n_blocks, block) * scales[..., None]).reshape(N, K)
+    gi = _one_op_graph(
+        "MatMulNBits",
+        [("a", None), ("b", packed), ("sc", scales.reshape(-1)),
+         ("zp", zp_packed.reshape(-1))],
+        {"a": (np.float32, [2, K])}, domain="com.microsoft",
+        K=K, N=N, bits=4, block_size=block)
+    got = np.asarray(gi.apply(gi.params, a)[0])
+    np.testing.assert_allclose(got, a @ w2.T, rtol=2e-5, atol=2e-5)
+
+    # RotaryEmbedding: numpy reference, 4-D and 3-D, both layouts
+    b, nh, s, hd = 2, 3, 5, 8
+    cos = np.cos(rng.normal(size=(16, hd // 2))).astype(np.float32)
+    sin = np.sin(rng.normal(size=(16, hd // 2))).astype(np.float32)
+    pos = rng.integers(0, 16, (b, s)).astype(np.int64)
+    x4 = rng.normal(size=(b, nh, s, hd)).astype(np.float32)
+
+    def rot_ref(x, interleaved, pos_arr):
+        cc = cos[pos_arr][:, None]
+        ss = sin[pos_arr][:, None]
+        if interleaved:
+            x1, x2 = x[..., 0::2], x[..., 1::2]
+        else:
+            x1, x2 = x[..., :hd // 2], x[..., hd // 2:]
+        o1 = x1 * cc - x2 * ss
+        o2 = x2 * cc + x1 * ss
+        if interleaved:
+            return np.stack([o1, o2], -1).reshape(x.shape)
+        return np.concatenate([o1, o2], -1)
+
+    for inter in (0, 1):
+        gi = _one_op_graph(
+            "RotaryEmbedding",
+            [("x", None), ("pos", pos), ("cos", cos), ("sin", sin)],
+            {"x": (np.float32, list(x4.shape))}, domain="com.microsoft",
+            interleaved=inter)
+        got = np.asarray(gi.apply(gi.params, x4)[0])
+        np.testing.assert_allclose(got, rot_ref(x4, inter, pos),
+                                   atol=1e-5,
+                                   err_msg=f"interleaved={inter}")
+
+    # scalar position_ids = ORT's start-offset form: positions are
+    # offset..offset+S-1, NOT one broadcast position (round-5 review)
+    gi = _one_op_graph(
+        "RotaryEmbedding",
+        [("x", None), ("pos", np.asarray([4], np.int64)),
+         ("cos", cos), ("sin", sin)],
+        {"x": (np.float32, list(x4.shape))}, domain="com.microsoft")
+    got = np.asarray(gi.apply(gi.params, x4)[0])
+    pos_off = np.broadcast_to(np.arange(4, 4 + s), (b, s))
+    np.testing.assert_allclose(got, rot_ref(x4, 0, pos_off), atol=1e-5)
+
+    # 3-D input with num_heads splits/merges heads around the rotation
+    x3 = x4.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    gi = _one_op_graph(
+        "RotaryEmbedding",
+        [("x", None), ("pos", pos), ("cos", cos), ("sin", sin)],
+        {"x": (np.float32, [b, s, nh * hd])}, domain="com.microsoft",
+        num_heads=nh)
+    got = np.asarray(gi.apply(gi.params, x3)[0])
+    want = rot_ref(x4, 0, pos).transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    np.testing.assert_allclose(got, want, atol=1e-5)
