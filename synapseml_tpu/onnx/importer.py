@@ -2103,6 +2103,10 @@ def _dft(ctx, x, dft_length=None, axis=None):
             raise NotImplementedError("DFT: inverse+onesided")
         spec = jnp.fft.ifft(sig, n=n_fft, axis=ax)
     elif onesided:
+        if x.shape[-1] == 2:
+            raise ValueError(
+                "DFT: onesided=1 requires a real signal ([..., 1]); a "
+                "complex input's spectrum is not conjugate-symmetric")
         spec = jnp.fft.rfft(jnp.real(sig), n=n_fft, axis=ax)
     else:
         spec = jnp.fft.fft(sig, n=n_fft, axis=ax)
@@ -2589,17 +2593,27 @@ class ImportedGraph:
             # ride the jit params pytree as tracers
             "Unique": (0,), "Compress": (0, 1),
         }
+        # ...while packed-integer WEIGHT slots must stay in the donated
+        # params pytree even though they are non-float: a quantized LLM's
+        # MatMulNBits B matrices are the model's dominant bytes, and
+        # baking them in as XLA constants would bloat the program and
+        # defeat device-resident weights/donation for exactly that case
+        weight_consumers = {"MatMulNBits": (1, 3)}
         shape_fed = set()
+        weight_fed = set()
         for node in graph.node:
-            slots = shape_consumers.get(node.op_type)
-            if not slots:
-                continue
-            for i in slots:
-                if i < len(node.input) and node.input[i]:
-                    shape_fed.add(node.input[i])
+            for target, slots in ((shape_fed,
+                                   shape_consumers.get(node.op_type)),
+                                  (weight_fed,
+                                   weight_consumers.get(node.op_type))):
+                for i in slots or ():
+                    if i < len(node.input) and node.input[i]:
+                        target.add(node.input[i])
+        weight_fed -= shape_fed  # shape use wins: it needs host values
         self.static_params: Dict[str, np.ndarray] = {
             k: v for k, v in all_inits.items()
-            if not np.issubdtype(v.dtype, np.floating) or k in shape_fed
+            if (not np.issubdtype(v.dtype, np.floating) or k in shape_fed)
+            and k not in weight_fed
         }
         self.params: Dict[str, np.ndarray] = {
             k: v for k, v in all_inits.items() if k not in self.static_params

@@ -2191,6 +2191,9 @@ def test_matmul_nbits_and_rotary_embedding():
         [("a", None), ("b", packed), ("sc", scales.reshape(-1))],
         {"a": (np.float32, [2, K])}, domain="com.microsoft",
         K=K, N=N, bits=4, block_size=block)
+    # the packed weights are the model's dominant bytes: they must ride
+    # the donated params pytree, not bake in as XLA constants
+    assert "b" in gi.params and "b" not in gi.static_params
     got = np.asarray(gi.apply(gi.params, a)[0])
     np.testing.assert_allclose(got, a @ w.T, rtol=2e-5, atol=2e-5)
 
