@@ -483,7 +483,13 @@ def main():
                 serving_baseline_ms / max(scored_conc_p50_ms, 1e-9), 3),
             "detail": {"clients": 32,
                        "p99_ms": round(scored_conc_p99_ms, 3),
-                       "requests_per_sec": round(scored_conc_rps, 1)},
+                       "requests_per_sec": round(scored_conc_rps, 1),
+                       # the architecture's number: amortized device+
+                       # serving cost per request under load (p50 is
+                       # dominated by the tunnel RTT a request waits
+                       # for its batch's round trip)
+                       "amortized_ms_per_request": round(
+                           1e3 / max(scored_conc_rps, 1e-9), 2)},
         }, {
             # GBDT hot-op shootout: which histogram formulation ships
             # (pallas VMEM kernel vs XLA one-hot einsum), measured on
