@@ -103,3 +103,23 @@ def test_torch_transformer_fixture_parity():
     got2 = np.asarray(g.apply(g.params, x2)[0])
     np.testing.assert_allclose(got2[:3], io["expected"], atol=1e-5,
                                rtol=1e-5)
+
+
+def test_torch_quantized_cnn_fixture_parity():
+    """Committed statically-quantized torch export (QDQ idiom, fbgemm
+    calibration): the importer's integer/QDQ lowering must reproduce
+    torch's own quantized forward within 2 output quantization steps —
+    the headroom between fbgemm's int kernels and float-simulated QDQ
+    (ref ONNXModel.scala:173-193: the reference scores whatever ORT
+    runs, statically-quantized exports included)."""
+    gi, io = _load("torch_quant_cnn")
+    got = np.asarray(gi.apply(gi.params, io["input"])[0])
+    want = io["expected"]
+    assert got.shape == want.shape
+    tol = 2.0 * float(io["out_scale"])
+    assert np.abs(got - want).max() <= tol + 1e-7, (
+        np.abs(got - want).max(), tol)
+    # overwhelmingly exact at the quantization grid: >95% of outputs
+    # within one step
+    assert (np.abs(got - want) <= float(io["out_scale"]) + 1e-7).mean() \
+        > 0.95

@@ -75,6 +75,55 @@ class GruSeq(nn.Module):
         return self.head(y[:, y.shape[1] - 1, :])
 
 
+class QuantCNN(nn.Module):
+    """Statically-quantized conv net (torch.ao eager static quant,
+    fbgemm): the exporter emits the QDQ idiom (QuantizeLinear/
+    DequantizeLinear fencing int-weight convs) that onnxruntime's
+    quantization tooling also produces — the importer must score it
+    within integer-kernel rounding of torch's own quantized forward."""
+
+    def __init__(self):
+        super().__init__()
+        self.quant = torch.ao.quantization.QuantStub()
+        self.conv = nn.Conv2d(3, 8, 3, padding=1)
+        self.relu = nn.ReLU()
+        self.conv2 = nn.Conv2d(8, 4, 3, stride=2, padding=1)
+        self.dequant = torch.ao.quantization.DeQuantStub()
+
+    def forward(self, x):
+        x = self.quant(x)
+        x = self.relu(self.conv(x))
+        x = self.conv2(x)
+        return self.dequant(x)
+
+
+def make_quantized(name="torch_quant_cnn"):
+    torch.backends.quantized.engine = "fbgemm"
+    torch.manual_seed(7)
+    m = QuantCNN().eval()
+    m.qconfig = torch.ao.quantization.get_default_qconfig("fbgemm")
+    torch.ao.quantization.fuse_modules(m, [["conv", "relu"]],
+                                       inplace=True)
+    torch.ao.quantization.prepare(m, inplace=True)
+    for _ in range(8):  # calibration passes (seeded)
+        m(torch.randn(2, 3, 16, 16))
+    torch.ao.quantization.convert(m, inplace=True)
+    x = torch.randn(2, 3, 16, 16)
+    with torch.no_grad():
+        expected = m(x).numpy()
+    path = os.path.join(OUT, f"{name}.onnx")
+    torch.onnx.export(m, (x,), path, opset_version=17, dynamo=False,
+                      input_names=["input"], output_names=["output"])
+    # record the model's OUTPUT dequant scale so the parity test can
+    # gate in units of output quantization steps
+    out_scale = float(m.conv2.scale) * 1.0
+    np.savez(os.path.join(OUT, f"{name}_io.npz"),
+             input=x.numpy(), expected=expected,
+             out_scale=np.float32(out_scale))
+    print(f"{name}: {os.path.getsize(path)} bytes, out {expected.shape}, "
+          f"out_scale {out_scale:.5f}")
+
+
 def export(model, args, name, dynamic_axes):
     model.eval()
     path = os.path.join(OUT, f"{name}.onnx")
@@ -119,6 +168,14 @@ def main():
     export(txf, (xt,), "torch_transformer",
            {"input": {0: "batch"}, "output": {0: "batch"}})
 
+    make_quantized()
+
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "quantized":
+        os.makedirs(OUT, exist_ok=True)
+        make_quantized()  # additive: leaves the committed fixtures as-is
+    else:
+        main()
