@@ -79,7 +79,8 @@ def test_fixture_bytes_are_foreign():
     producer tag, not this repo's builder."""
     from synapseml_tpu.onnx import proto
 
-    for name in ("torch_cnn", "torch_gru", "torch_transformer"):
+    for name in ("torch_cnn", "torch_gru", "torch_transformer",
+                 "torch_quant_cnn"):
         with open(os.path.join(FIXTURES, f"{name}.onnx"), "rb") as fh:
             m = proto.decode("ModelProto", fh.read())
         assert m.producer_name == "pytorch", m.producer_name
