@@ -145,7 +145,11 @@ def bench_gbdt_train():
     bdev = BinMapper(max_bin=bp.max_bin,
                      categorical_features=bp.categorical_features,
                      seed=bp.seed).fit(x.astype(np.float64)).total_bins
-    ab["auto_routed_to"] = resolve_hist_backend(n, d, bdev)
+    # same fit_row_visits hint as train() passes, so this hits the SAME
+    # cache entry (probe budgets are part of the key) and reports what
+    # the auto leg actually ran
+    ab["auto_routed_to"] = resolve_hist_backend(
+        n, d, bdev, fit_row_visits=n * 100 * bp.num_leaves)
     return auto_rows_s, ab
 
 

@@ -25,7 +25,7 @@ Layer map (SURVEY.md §1 → TPU-native):
   codegen/   reflection-driven R wrappers + API reference
   utils/     fault tolerance, hashing, profiling utilities
 """
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # r05: adaptive hist-kernel chunking — probe verdicts re-measure
 
 from synapseml_tpu.core.param import Param, ComplexParam, Params
 from synapseml_tpu.core.pipeline import (
