@@ -2319,6 +2319,22 @@ def _matmul_nbits(ctx, a, b_packed, scales, zero_points=None):
     return jnp.matmul(a, w.T.astype(a.dtype))
 
 
+def _apply_rope(t, cc, ss, interleaved, rot):
+    """Rotate the leading ``rot`` features of ``t`` by (cos, sin) —
+    the core shared by RotaryEmbedding and GroupQueryAttention's
+    internal rope. ``cc``/``ss`` broadcast against t[..., :rot//2]."""
+    tr, tp = t[..., :rot], t[..., rot:]
+    if interleaved:
+        t1, t2 = tr[..., 0::2], tr[..., 1::2]
+    else:
+        t1, t2 = tr[..., : rot // 2], tr[..., rot // 2:]
+    o1 = t1 * cc - t2 * ss
+    o2 = t2 * cc + t1 * ss
+    out = (jnp.stack([o1, o2], -1).reshape(tr.shape) if interleaved
+           else jnp.concatenate([o1, o2], -1))
+    return jnp.concatenate([out.astype(t.dtype), tp], -1)
+
+
 @op("RotaryEmbedding")
 def _rotary_embedding(ctx, x, position_ids, cos_cache, sin_cache):
     """com.microsoft rotary position embedding (the LLM export op).
@@ -2344,20 +2360,102 @@ def _rotary_embedding(ctx, x, position_ids, cos_cache, sin_cache):
         pos = pos[None, :]
     cos = jnp.asarray(cos_cache, jnp.float32)[pos][:, None]  # [B,1,S,rot/2]
     sin = jnp.asarray(sin_cache, jnp.float32)[pos][:, None]
-    xr, xpass = x[..., :rot], x[..., rot:]
-    if interleaved:
-        x1, x2 = xr[..., 0::2], xr[..., 1::2]
-    else:
-        x1, x2 = xr[..., : rot // 2], xr[..., rot // 2:]
-    o1 = x1 * cos - x2 * sin
-    o2 = x2 * cos + x1 * sin
-    if interleaved:
-        out = jnp.stack([o1, o2], -1).reshape(xr.shape)
-    else:
-        out = jnp.concatenate([o1, o2], -1)
-    out = jnp.concatenate([out.astype(x.dtype), xpass], -1)
+    out = _apply_rope(x, cos, sin, interleaved, rot)
     if squeeze_back:
         out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    return out
+
+
+@op("GroupQueryAttention")
+def _group_query_attention(ctx, query, key=None, value=None,
+                           past_key=None, past_value=None, seqlens_k=None,
+                           total_sequence_length=None, cos_cache=None,
+                           sin_cache=None):
+    """com.microsoft GroupQueryAttention — the decoder-attention op of
+    ORT GenAI exports (completes the quantized-LLM triad with
+    MatMulNBits + RotaryEmbedding). Causal grouped-head attention with
+    an optional KV cache: ``past_key/past_value`` concatenate ahead of
+    this call's keys, ``present_*`` outputs return the extended cache.
+
+    Supported surface (documented limits, loud errors otherwise):
+    separate or packed QKV; prefill and left-aligned decode (the cache
+    is assumed densely packed — per-batch ``seqlens_k`` bounds the
+    attended keys); internal rotary via ``do_rotary`` with
+    batch-uniform position offset = past length. Everything lowers to
+    one einsum-softmax-einsum chain per call; XLA fuses the mask."""
+    num_heads = int(ctx.attr("num_heads", 0))
+    kv_heads = int(ctx.attr("kv_num_heads", 0))
+    if num_heads <= 0 or kv_heads <= 0:
+        raise ValueError("GroupQueryAttention needs num_heads and "
+                         "kv_num_heads attributes")
+    q = jnp.asarray(query)
+    b, s, dq = q.shape
+    if key is None or (hasattr(key, "size") and np.size(key) == 0):
+        # packed QKV: [B, S, (Hq + 2*Hkv) * D]
+        head = dq // (num_heads + 2 * kv_heads)
+        q, k, v = jnp.split(
+            q, [num_heads * head, (num_heads + kv_heads) * head], axis=-1)
+    else:
+        head = dq // num_heads
+        k, v = jnp.asarray(key), jnp.asarray(value)
+    dt = q.dtype
+
+    def heads(t, h):
+        return t.reshape(b, s, h, head).transpose(0, 2, 1, 3)
+
+    q = heads(q, num_heads)                        # [B, Hq, S, D]
+    k = heads(k, kv_heads)                         # [B, Hkv, S, D]
+    v = heads(v, kv_heads)
+    past_len = 0
+    if past_key is not None:
+        past_len = jnp.asarray(past_key).shape[2]
+
+    if bool(ctx.attr("do_rotary", 0)):
+        if cos_cache is None or sin_cache is None:
+            raise ValueError("do_rotary=1 needs cos_cache/sin_cache")
+        cos = jnp.asarray(cos_cache, jnp.float32)
+        sin = jnp.asarray(sin_cache, jnp.float32)
+        rot = 2 * cos.shape[-1]
+        if past_len + s > cos.shape[0]:
+            # a clamped gather would silently freeze the rotary angle
+            raise ValueError(
+                f"GroupQueryAttention: positions {past_len}+{s} exceed "
+                f"the exported rope cache ({cos.shape[0]} rows); "
+                "re-export with a longer max position")
+        inter = bool(ctx.attr("rotary_interleaved", 0))
+        pos = past_len + jnp.arange(s, dtype=jnp.int32)
+        cc, ss = cos[pos][None, None], sin[pos][None, None]
+        q = _apply_rope(q, cc, ss, inter, rot)
+        k = _apply_rope(k, cc, ss, inter, rot)
+
+    if past_key is not None:
+        k = jnp.concatenate([jnp.asarray(past_key, dt), k], axis=2)
+        v = jnp.concatenate([jnp.asarray(past_value, dt), v], axis=2)
+    present_k, present_v = k, v
+    t_kv = k.shape[2]
+
+    group = num_heads // kv_heads
+    # grouped einsum — K/V stay [B, Hkv, T, D]: a materialized
+    # group-repeat would copy the whole KV cache group x per call
+    qg = q.reshape(b, kv_heads, group, s, head).astype(jnp.float32)
+    scale = ctx.attr("scale", 0.0) or 1.0 / math.sqrt(head)
+    logits = jnp.einsum("bkgsd,bktd->bkgst", qg,
+                        k.astype(jnp.float32)) * scale
+    q_pos = past_len + jnp.arange(s)[:, None]      # global query positions
+    k_pos = jnp.arange(t_kv)[None, :]
+    mask = (k_pos <= q_pos)[None, None, None]      # causal   [S, T]
+    if seqlens_k is not None:
+        # ORT convention: seqlens_k = total valid keys per batch - 1
+        lim = (jnp.asarray(seqlens_k).astype(jnp.int32).reshape(b) + 1)
+        mask = mask & (k_pos < lim[:, None])[:, None, None, None, :]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgst,bktd->bkgsd", probs, v.astype(jnp.float32))
+    out = out.reshape(b, num_heads, s, head)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, num_heads * head)
+    out = out.astype(dt)
+    if ctx.n_outputs > 1:
+        return out, present_k, present_v
     return out
 
 

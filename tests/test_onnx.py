@@ -2264,3 +2264,192 @@ def test_matmul_nbits_and_rotary_embedding():
     got = np.asarray(gi.apply(gi.params, x3)[0])
     want = rot_ref(x4, 0, pos).transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_group_query_attention():
+    """GQA vs a torch grouped causal-attention reference (prefill),
+    packed-QKV parity with separate QKV, per-batch seqlens_k masking,
+    and the KV-cache contract: two-step incremental decode must equal
+    full-sequence attention on the concatenation."""
+    import jax
+
+    rng = np.random.default_rng(4)
+    b, s, hq, hkv, d = 2, 6, 4, 2, 8
+
+    def gqa_graph(with_past=False, past_t=0, n_outputs=1, packed=False,
+                  seqlens=None, do_rotary=0, cos=None, sin=None):
+        g = GraphBuilder(opset=21)
+        if packed:
+            qn = g.add_input("q", np.float32, [b, s, (hq + 2 * hkv) * d])
+            ins = [qn, "", ""]
+        else:
+            qn = g.add_input("q", np.float32, [b, s, hq * d])
+            kn = g.add_input("k", np.float32, [b, s, hkv * d])
+            vn = g.add_input("v", np.float32, [b, s, hkv * d])
+            ins = [qn, kn, vn]
+        if with_past:
+            ins += [g.add_input("pk", np.float32, [b, hkv, past_t, d]),
+                    g.add_input("pv", np.float32, [b, hkv, past_t, d])]
+        else:
+            ins += ["", ""]
+        if seqlens is not None:
+            ins.append(g.add_initializer("sl", seqlens))
+        elif do_rotary:
+            ins.append("")
+        if do_rotary:
+            ins += ["", g.add_initializer("cos", cos),
+                    g.add_initializer("sin", sin)]
+        outs = ["y", "prk", "prv"][:n_outputs]
+        g.add_node("GroupQueryAttention", ins, outputs=outs,
+                   domain="com.microsoft", num_heads=hq,
+                   kv_num_heads=hkv, do_rotary=do_rotary)
+        for o in outs:
+            g.add_output(o, np.float32, None)
+        return import_model(g.to_bytes())
+
+    def torch_ref(q, k, v, past_k=None, past_v=None, lims=None):
+        tq = torch.from_numpy(q).reshape(b, -1, hq, d).transpose(1, 2)
+        tk = torch.from_numpy(k).reshape(b, -1, hkv, d).transpose(1, 2)
+        tv = torch.from_numpy(v).reshape(b, -1, hkv, d).transpose(1, 2)
+        if past_k is not None:
+            tk = torch.cat([torch.from_numpy(past_k), tk], dim=2)
+            tv = torch.cat([torch.from_numpy(past_v), tv], dim=2)
+        past_t = tk.shape[2] - tq.shape[2]
+        tk = tk.repeat_interleave(hq // hkv, dim=1)
+        tv = tv.repeat_interleave(hq // hkv, dim=1)
+        sq, tt = tq.shape[2], tk.shape[2]
+        mask = (torch.arange(tt)[None, :]
+                <= past_t + torch.arange(sq)[:, None])
+        mask = mask[None, None].expand(b, 1, sq, tt).clone()
+        if lims is not None:
+            mask &= (torch.arange(tt)[None, None, None, :]
+                     < torch.as_tensor(lims)[:, None, None, None])
+        att = (tq @ tk.transpose(-1, -2)) / np.sqrt(d)
+        att = att.masked_fill(~mask, float("-inf")).softmax(-1)
+        out = att @ tv
+        return out.transpose(1, 2).reshape(b, sq, hq * d).numpy()
+
+    q = rng.normal(size=(b, s, hq * d)).astype(np.float32)
+    k = rng.normal(size=(b, s, hkv * d)).astype(np.float32)
+    v = rng.normal(size=(b, s, hkv * d)).astype(np.float32)
+
+    gi = gqa_graph()
+    got = np.asarray(jax.jit(gi.apply)(
+        gi.params, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))[0])
+    np.testing.assert_allclose(got, torch_ref(q, k, v), atol=2e-5,
+                               rtol=2e-5)
+
+    # packed QKV == separate QKV
+    gi_p = gqa_graph(packed=True)
+    packed = np.concatenate([q, k, v], axis=-1)
+    got_p = np.asarray(gi_p.apply(gi_p.params, packed)[0])
+    np.testing.assert_allclose(got_p, got, atol=1e-6)
+
+    # per-batch seqlens_k (ORT: valid keys - 1) bounds attention
+    lims = np.asarray([4, 6], np.int32)
+    gi_s = gqa_graph(seqlens=(lims - 1).astype(np.int32))
+    got_s = np.asarray(gi_s.apply(gi_s.params, q, k, v)[0])
+    np.testing.assert_allclose(got_s, torch_ref(q, k, v, lims=lims),
+                               atol=2e-5, rtol=2e-5)
+
+    # KV cache: prefill s tokens, then decode 2 more one-by-one ==
+    # full attention over s+2 (causal => prefix outputs identical)
+    s2 = 2
+    q2 = rng.normal(size=(b, s2, hq * d)).astype(np.float32)
+    k2 = rng.normal(size=(b, s2, hkv * d)).astype(np.float32)
+    v2 = rng.normal(size=(b, s2, hkv * d)).astype(np.float32)
+    gi_c = gqa_graph(n_outputs=3)
+    _, pk, pv = gi_c.apply(gi_c.params, q, k, v)
+    g_step = gqa_graph(with_past=True, past_t=s, n_outputs=3)
+    out_step, pk2, pv2 = g_step.apply(
+        g_step.params, q2, k2, v2, np.asarray(pk), np.asarray(pv))
+    full = torch_ref(np.concatenate([q, q2], 1),
+                     np.concatenate([k, k2], 1),
+                     np.concatenate([v, v2], 1))
+    np.testing.assert_allclose(np.asarray(out_step), full[:, s:],
+                               atol=2e-5, rtol=2e-5)
+    assert np.asarray(pk2).shape == (b, hkv, s + s2, d)
+
+    # do_rotary: internal rope with position offset = past length must
+    # equal applying RotaryEmbedding externally then GQA without it
+    cos = np.cos(rng.normal(size=(32, d // 2))).astype(np.float32)
+    sin = np.sin(rng.normal(size=(32, d // 2))).astype(np.float32)
+    gi_r = gqa_graph(do_rotary=1, cos=cos, sin=sin)
+    got_r = np.asarray(gi_r.apply(gi_r.params, q, k, v)[0])
+
+    def rope_np(t, h):
+        tt = t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        pos = np.arange(s)
+        cc, ss = cos[pos][None, None], sin[pos][None, None]
+        t1, t2 = tt[..., :d // 2], tt[..., d // 2:]
+        out = np.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], -1)
+        return out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    want_r = torch_ref(rope_np(q, hq).astype(np.float32),
+                       rope_np(k, hkv).astype(np.float32), v)
+    np.testing.assert_allclose(got_r, want_r, atol=2e-5, rtol=2e-5)
+
+
+def test_quantized_llm_decoder_block_end_to_end():
+    """The ORT-GenAI decoder idiom composed from the triad: MatMulNBits
+    int4 projections -> GroupQueryAttention (internal rotary, KV cache
+    outputs) -> MatMulNBits out-projection + residual, traced through
+    one jit. The packed weights must ride the donated params pytree."""
+    import jax
+
+    rng = np.random.default_rng(5)
+    b, s, hq, hkv, d = 2, 4, 4, 2, 8
+    H = hq * d
+
+    def nbits_init(g, name, n_out, n_in, block=16):
+        qw = rng.integers(0, 16, (n_out, n_in)).astype(np.uint8)
+        nb = n_in // block
+        sc = (rng.random((n_out, nb)) * 0.05 + 0.01).astype(np.float32)
+        packed = (qw[:, 0::2] | (qw[:, 1::2] << 4)).reshape(
+            n_out, nb, block // 2)
+        g.add_initializer(f"{name}_w", packed)
+        g.add_initializer(f"{name}_s", sc.reshape(-1))
+        return [f"{name}_w", f"{name}_s"]
+
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, [b, s, H])
+    cos = np.cos(rng.normal(size=(32, d // 2))).astype(np.float32)
+    sin = np.sin(rng.normal(size=(32, d // 2))).astype(np.float32)
+
+    def proj(name, n_out):
+        return g.add_node(
+            "MatMulNBits", [xn] + nbits_init(g, name, n_out, H),
+            domain="com.microsoft", K=H, N=n_out, bits=4, block_size=16)
+
+    qp, kp, vp = proj("q", hq * d), proj("k", hkv * d), proj("v", hkv * d)
+    att = g.add_node(
+        "GroupQueryAttention",
+        [qp, kp, vp, "", "", "", "",
+         g.add_initializer("cos", cos), g.add_initializer("sin", sin)],
+        outputs=["att", "prk", "prv"], domain="com.microsoft",
+        num_heads=hq, kv_num_heads=hkv, do_rotary=1)
+    op_w = nbits_init(g, "o", H, H)
+    out = g.add_node("MatMulNBits", [att[0]] + op_w,
+                     domain="com.microsoft", K=H, N=H, bits=4,
+                     block_size=16)
+    y = g.add_node("Add", [xn, out])
+    g.add_output(y, np.float32, [b, s, H])
+    g.add_output("prk", np.float32, None)
+    g.add_output("prv", np.float32, None)
+    gi = import_model(g.to_bytes())
+
+    # the int4 projection weights are in the donated pytree, not baked
+    assert {"q_w", "k_w", "v_w", "o_w"} <= set(gi.params)
+    x = rng.normal(size=(b, s, H)).astype(np.float32)
+    yv, pk, pv = jax.jit(gi.apply)(gi.params, jnp.asarray(x))
+    assert np.isfinite(np.asarray(yv)).all()
+    assert np.asarray(yv).shape == (b, s, H)
+    assert np.asarray(pk).shape == (b, hkv, s, d)
+    # causality: recomputing with the LAST token's hidden state changed
+    # must leave every earlier position's output untouched
+    x2 = x.copy()
+    x2[:, -1] += 1.0
+    yv2 = np.asarray(jax.jit(gi.apply)(gi.params, jnp.asarray(x2))[0])
+    np.testing.assert_allclose(np.asarray(yv)[:, :-1], yv2[:, :-1],
+                               atol=1e-6)
+    assert np.abs(np.asarray(yv)[:, -1] - yv2[:, -1]).max() > 1e-3
