@@ -121,12 +121,27 @@ def test_row_sharded_single_process_matches_mesh_fit():
               num_leaves=7), x[:, 0] * 2 + x[:, 1]),
         (dict(objective="regression", boosting_type="goss",
               num_iterations=4, num_leaves=7), x[:, 0] * 2 + x[:, 1]),
+        (dict(objective="binary", boosting_type="dart", num_iterations=4,
+              num_leaves=7, drop_rate=0.5, skip_drop=0.0),
+         (x[:, 0] + x[:, 1] > 0).astype(np.float64)),
     ]
     for pkw, yy in cases:
         p = BoostParams(**pkw)
         want = train(p, x, yy, weight=w, mesh=mesh).predict(x)
         got = train_row_sharded(p, x, yy, weight=w).predict(x)
         np.testing.assert_array_equal(got, want, err_msg=str(pkw))
+
+    # lambdarank: per-host query packing is placement-invariant too
+    n_q, per_q = 30, 8
+    n = n_q * per_q
+    xr = rng.normal(size=(n, 4))
+    rel = (xr[:, 0] + 0.3 * rng.normal(size=n) > 0.4).astype(np.float64)
+    q = np.repeat(np.arange(n_q), per_q)
+    pr = BoostParams(objective="lambdarank", num_iterations=5,
+                     num_leaves=7, min_data_in_leaf=2)
+    want = train(pr, xr, rel, group=q, mesh=mesh).predict(xr)
+    got = train_row_sharded(pr, xr, rel, group=q).predict(xr)
+    np.testing.assert_array_equal(got, want)
 
 
 def test_fit_partitions_ranker_groups():
