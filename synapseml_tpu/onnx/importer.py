@@ -2366,6 +2366,92 @@ def _rotary_embedding(ctx, x, position_ids, cos_cache, sin_cache):
     return out
 
 
+# -- Sequence ops (torch unbind/split/list exports) -----------------------
+# A sequence is a Python list of tensors: the LENGTH and every position
+# index must be static (they shape the program), while the elements may
+# be traced — under jit a list of tracers is just a pytree, so sequence
+# graphs compile like any other.
+
+_REGISTRY["SequenceEmpty"] = lambda ctx: []
+_REGISTRY["SequenceConstruct"] = lambda ctx, *xs: list(xs)
+_REGISTRY["SequenceLength"] = lambda ctx, seq: np.int64(len(seq))
+
+
+def _seq_pos(pos, n, what):
+    (p,) = _static_int_list(pos, what)
+    if not -n <= p <= n - 1:
+        raise ValueError(f"{what}: position {p} out of range for a "
+                         f"{n}-element sequence")
+    return p  # python list indexing handles the negative form
+
+
+@op("SequenceAt")
+def _sequence_at(ctx, seq, pos):
+    return seq[_seq_pos(pos, len(seq), "SequenceAt position")]
+
+
+@op("SequenceInsert")
+def _sequence_insert(ctx, seq, tensor, pos=None):
+    out = list(seq)
+    if pos is None:
+        out.append(tensor)
+    else:
+        (p,) = _static_int_list(pos, "SequenceInsert position")
+        if not -len(seq) <= p <= len(seq):
+            raise ValueError(
+                f"SequenceInsert: position {p} out of range for a "
+                f"{len(seq)}-element sequence")
+        # python insert matches the ONNX reference for negatives too:
+        # insert(-1) places BEFORE the last element
+        out.insert(p, tensor)
+    return out
+
+
+@op("SequenceErase")
+def _sequence_erase(ctx, seq, pos=None):
+    out = list(seq)
+    del out[-1 if pos is None
+            else _seq_pos(pos, len(seq), "SequenceErase position")]
+    return out
+
+
+@op("ConcatFromSequence")
+def _concat_from_sequence(ctx, seq):
+    axis = ctx.attr("axis")
+    if axis is None:
+        raise ValueError("ConcatFromSequence needs an axis attribute")
+    # preserve host-ness: an all-constant sequence must stay foldable
+    # for static-shape consumers downstream (the _concat convention)
+    xp = np if _all_host(seq) else jnp
+    if ctx.attr("new_axis", 0):
+        return xp.stack(list(seq), axis=int(axis))
+    return xp.concatenate(list(seq), axis=int(axis))
+
+
+@op("SplitToSequence")
+def _split_to_sequence(ctx, x, split=None):
+    keepdims = ctx.attr("keepdims", 1)
+    x = jnp.asarray(x) if not _is_host(x) else np.asarray(x)
+    axis = int(ctx.attr("axis", 0)) % x.ndim  # spec allows [-r, r-1]
+    n = x.shape[axis]
+    if split is None:
+        parts = [jax.lax.index_in_dim(x, i, axis=axis, keepdims=True)
+                 if not _is_host(x) else np.take(x, [i], axis=axis)
+                 for i in range(n)]
+        if not keepdims:
+            xp = np if _is_host(x) else jnp
+            parts = [xp.squeeze(p, axis=axis) for p in parts]
+        return parts
+    sizes = _static_int_list(split, "SplitToSequence split")
+    if len(sizes) == 1 and np.ndim(split) == 0:
+        size = sizes[0]
+        sizes = [size] * (n // size) + ([n % size] if n % size else [])
+    bounds = np.cumsum(sizes)[:-1].tolist()
+    if _is_host(x):
+        return list(np.split(x, bounds, axis=axis))
+    return jnp.split(x, bounds, axis=axis)
+
+
 @op("GroupQueryAttention")
 def _group_query_attention(ctx, query, key=None, value=None,
                            past_key=None, past_value=None, seqlens_k=None,
