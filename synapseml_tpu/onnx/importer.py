@@ -2366,6 +2366,20 @@ def _rotary_embedding(ctx, x, position_ids, cos_cache, sin_cache):
     return out
 
 
+# Optional wrappers: the env's natural None/value distinction IS the
+# optional type (absent optional inputs already flow as None)
+_REGISTRY["Optional"] = lambda ctx, x=None: x
+_REGISTRY["OptionalHasElement"] = lambda ctx, x=None: np.bool_(
+    x is not None)
+
+
+@op("OptionalGetElement")
+def _optional_get_element(ctx, x=None):
+    if x is None:
+        raise ValueError("OptionalGetElement on an empty optional")
+    return x
+
+
 # -- Sequence ops (torch unbind/split/list exports) -----------------------
 # A sequence is a Python list of tensors: the LENGTH and every position
 # index must be static (they shape the program), while the elements may

@@ -2576,3 +2576,31 @@ def test_sequence_ops():
     gi = import_model(g.to_bytes())
     with pytest.raises(ValueError, match="out of range"):
         gi.apply(gi.params, a)
+
+
+def test_optional_ops():
+    """Optional wrappers ride the env's None/value distinction."""
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, [3])
+    o = g.add_node("Optional", [xn])
+    has = g.add_node("OptionalHasElement", [o])
+    val = g.add_node("OptionalGetElement", [o])
+    empty = g.add_node("Optional", [])
+    has_not = g.add_node("OptionalHasElement", [empty])
+    g.add_output(has, np.bool_, [])
+    g.add_output(val, np.float32, [3])
+    g.add_output(has_not, np.bool_, [])
+    gi = import_model(g.to_bytes())
+    x = np.asarray([1.0, 2.0, 3.0], np.float32)
+    h, v, hn = gi.apply(gi.params, x)
+    assert bool(h) is True and bool(hn) is False
+    np.testing.assert_array_equal(np.asarray(v), x)
+
+    g2 = GraphBuilder(opset=21)
+    g2.add_input("x", np.float32, [3])
+    e = g2.add_node("Optional", [])
+    bad = g2.add_node("OptionalGetElement", [e])
+    g2.add_output(bad, np.float32, [3])
+    gi2 = import_model(g2.to_bytes())
+    with pytest.raises(ValueError, match="empty optional"):
+        gi2.apply(gi2.params, x)
