@@ -1434,15 +1434,20 @@ def _gather_elements(ctx, x, idx):
 
 @op("GatherND")
 def _gather_nd(ctx, x, idx):
-    batch_dims = ctx.attr("batch_dims", 0)
-    if batch_dims:
-        raise NotImplementedError("GatherND batch_dims > 0")
+    batch_dims = int(ctx.attr("batch_dims", 0))
     x = jnp.asarray(x)
     idx = jnp.asarray(idx)
-    k = idx.shape[-1]
-    flat_idx = idx.reshape(-1, k)
-    out = x[tuple(flat_idx[:, i] for i in range(k))]
-    return out.reshape(idx.shape[:-1] + x.shape[k:])
+
+    def core(xx, ii):
+        k = ii.shape[-1]
+        flat = ii.reshape(-1, k)
+        out = xx[tuple(flat[:, i] for i in range(k))]
+        return out.reshape(ii.shape[:-1] + xx.shape[k:])
+
+    fn = core
+    for _ in range(batch_dims):  # leading dims batch (detection heads'
+        fn = jax.vmap(fn)        # post-NMS gathers use batch_dims=1)
+    return fn(x, idx)
 
 
 @op("ScatterElements")

@@ -2604,3 +2604,37 @@ def test_optional_ops():
     gi2 = import_model(g2.to_bytes())
     with pytest.raises(ValueError, match="empty optional"):
         gi2.apply(gi2.params, x)
+
+
+def test_gather_nd_batch_dims():
+    """GatherND with batch_dims (the detection heads' post-NMS gather
+    idiom) vs a loop reference, batch_dims 1 and 2."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(2, 3, 4, 5)).astype(np.float32)
+
+    def graph(idx, batch_dims):
+        g = GraphBuilder(opset=21)
+        xn = g.add_input("x", np.float32, list(x.shape))
+        y = g.add_node("GatherND", [xn, g.add_initializer("i", idx)],
+                       batch_dims=batch_dims)
+        g.add_output(y, np.float32, None)
+        return import_model(g.to_bytes())
+
+    # batch_dims=1: per-batch [3,2] index tuples into [3,4,5]
+    idx1 = np.stack([rng.integers(0, [3, 4], (6, 2)),
+                     rng.integers(0, [3, 4], (6, 2))]).astype(np.int64)
+    gi = graph(idx1, 1)
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    want = np.stack([
+        np.stack([x[b][tuple(idx1[b, j])] for j in range(6)])
+        for b in range(2)])
+    np.testing.assert_array_equal(got, want)
+
+    # batch_dims=2: indices [2,3,2,1] into the length-4 axis
+    idx2 = rng.integers(0, 4, (2, 3, 2, 1)).astype(np.int64)
+    gi = graph(idx2, 2)
+    got = np.asarray(gi.apply(gi.params, x)[0])
+    want = np.stack([
+        np.stack([x[b, c][idx2[b, c, :, 0]] for c in range(3)])
+        for b in range(2)])
+    np.testing.assert_array_equal(got, want)
