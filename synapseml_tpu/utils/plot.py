@@ -1,0 +1,102 @@
+"""Notebook plotting helpers — the ``mmlspark.plot`` analogue
+(ref: core/src/main/python/mmlspark/plot/plot.py:17-60 —
+``confusionMatrix`` and ``roc`` over a DataFrame/pandas pair of
+label/prediction columns, rendered with matplotlib).
+
+TPU-native differences: the inputs are :class:`~synapseml_tpu.data.
+table.Table` (or anything column-indexable), the confusion matrix and
+ROC points are computed HERE in vectorized numpy (no sklearn), and each
+function returns its data so headless pipelines can assert on it —
+matplotlib is only touched when an ``ax``/rendering is actually wanted.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _cols(df, *names):
+    return [np.asarray(df[n]) for n in names]
+
+
+def confusion_matrix(df, y_col: str, y_hat_col: str,
+                     labels: Optional[Sequence] = None,
+                     normalize: bool = False, ax=None, render: bool = True
+                     ) -> np.ndarray:
+    """Confusion matrix of ``y_hat_col`` vs ``y_col``; returns the
+    [n_labels, n_labels] count matrix (row = true class). ``render=True``
+    draws the reference's annotated heatmap (accuracy in the title
+    position, per-cell counts) onto ``ax``/the current axes."""
+    y, y_hat = _cols(df, y_col, y_hat_col)
+    if labels is None:
+        labels = np.unique(np.concatenate([y, y_hat]))
+    labels = list(labels)
+    n = len(labels)
+    # vectorized accumulation; rows outside an explicit labels list are
+    # ignored (sklearn's confusion_matrix semantics)
+    srt = np.argsort(labels, kind="stable")
+    slabels = np.asarray(labels)[srt]
+    ti = srt[np.clip(np.searchsorted(slabels, y), 0, n - 1)]
+    pi = srt[np.clip(np.searchsorted(slabels, y_hat), 0, n - 1)]
+    ok = (np.asarray(labels)[ti] == y) & (np.asarray(labels)[pi] == y_hat)
+    cm = np.zeros((n, n), np.int64)
+    np.add.at(cm, (ti[ok], pi[ok]), 1)
+    if render:
+        import matplotlib.pyplot as plt
+
+        if ax is None:
+            ax = plt.gca()
+        cmn = cm.astype(np.float64) / np.maximum(
+            cm.sum(axis=1, keepdims=True), 1)
+        acc = float(np.mean(y == y_hat))
+        ax.imshow(cmn, interpolation="nearest", cmap="Blues", vmin=0,
+                  vmax=1)
+        ax.set_title(f"Accuracy = {acc * 100:.1f}%")
+        ax.set_xticks(range(n), labels)
+        ax.set_yticks(range(n), labels)
+        for i in range(n):
+            for j in range(n):
+                ax.text(j, i, int(cm[i, j]), ha="center",
+                        color="white" if cmn[i, j] > 0.5 else "black")
+        ax.set_xlabel("predicted")
+        ax.set_ylabel("true")
+    if normalize:
+        return cm.astype(np.float64) / np.maximum(
+            cm.sum(axis=1, keepdims=True), 1)
+    return cm  # counts (row = true class)
+
+
+def roc(df, y_col: str, y_hat_col: str, thresh: float = 0.5, ax=None,
+        render: bool = True) -> Tuple[np.ndarray, np.ndarray, float]:
+    """ROC curve points + AUC for score column ``y_hat_col`` against
+    labels binarized at ``thresh`` (the reference's convention). Returns
+    ``(fpr, tpr, auc)``; sorted-scores sweep, no sklearn."""
+    y, s = _cols(df, y_col, y_hat_col)
+    y = (np.asarray(y, np.float64) > thresh).astype(np.int64)
+    s = np.asarray(s, np.float64)
+    p, nneg = int(y.sum()), int((1 - y).sum())
+    if p == 0 or nneg == 0:
+        raise ValueError(
+            f"ROC is undefined with {p} positives / {nneg} negatives "
+            f"after binarizing {y_col!r} at {thresh}")
+    order = np.argsort(-s, kind="stable")
+    y_sorted, s_sorted = y[order], s[order]
+    tp = np.concatenate([[0], np.cumsum(y_sorted)])
+    fp = np.concatenate([[0], np.cumsum(1 - y_sorted)])
+    # keep only threshold boundaries (distinct score steps) + endpoints
+    distinct = np.concatenate(
+        [[True], s_sorted[1:] != s_sorted[:-1], [True]])
+    tpr = tp[distinct] / p
+    fpr = fp[distinct] / nneg
+    auc = float(np.trapezoid(tpr, fpr))
+    if render:
+        import matplotlib.pyplot as plt
+
+        if ax is None:
+            ax = plt.gca()
+        ax.plot(fpr, tpr)
+        ax.set_xlabel("False Positive Rate")
+        ax.set_ylabel("True Positive Rate")
+        ax.set_title(f"AUC = {auc:.3f}")
+    return fpr, tpr, auc

@@ -1,0 +1,69 @@
+"""mmlspark.plot analogue: confusion matrix + ROC data computed
+in-repo (no sklearn), matplotlib rendering exercised headless
+(ref core/src/main/python/mmlspark/plot/plot.py:17-60)."""
+import matplotlib
+
+matplotlib.use("Agg")  # headless backend before pyplot import
+
+import numpy as np
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.utils.plot import confusion_matrix, roc
+
+
+def test_confusion_matrix_counts_and_render(tmp_path):
+    t = Table({"y": np.asarray([0, 0, 1, 1, 2, 2, 2]),
+               "pred": np.asarray([0, 1, 1, 1, 2, 0, 2])})
+    cm = confusion_matrix(t, "y", "pred", labels=[0, 1, 2], render=False)
+    np.testing.assert_array_equal(
+        cm, [[1, 1, 0], [0, 2, 0], [1, 0, 2]])
+    cmn = confusion_matrix(t, "y", "pred", normalize=True, render=False)
+    np.testing.assert_allclose(cmn.sum(axis=1), 1.0)
+
+    import matplotlib.pyplot as plt
+    fig, ax = plt.subplots()
+    confusion_matrix(t, "y", "pred", ax=ax)
+    fig.savefig(tmp_path / "cm.png")  # rendering path actually draws
+    plt.close(fig)
+    assert (tmp_path / "cm.png").stat().st_size > 0
+
+
+def test_roc_matches_sklearn_semantics():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 200)
+    s = np.clip(y * 0.4 + rng.normal(0.3, 0.25, 200), 0, 1)
+    t = Table({"y": y.astype(np.float64), "score": s})
+    fpr, tpr, auc = roc(t, "y", "score", render=False)
+    assert fpr[0] == 0 and tpr[-1] == 1 and fpr[-1] == 1
+    assert np.all(np.diff(fpr) >= 0) and np.all(np.diff(tpr) >= 0)
+    # cross-check AUC against the rank-statistic formulation
+    pos, neg = s[y == 1], s[y == 0]
+    wins = (pos[:, None] > neg[None, :]).sum() \
+        + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    np.testing.assert_allclose(auc, wins / (len(pos) * len(neg)),
+                               atol=1e-9)
+
+    # perfect separation -> AUC 1; reversed -> 0
+    t2 = Table({"y": np.asarray([0, 0, 1, 1], np.float64),
+                "score": np.asarray([0.1, 0.2, 0.8, 0.9])})
+    assert roc(t2, "y", "score", render=False)[2] == 1.0
+    t3 = Table({"y": np.asarray([1, 1, 0, 0], np.float64),
+                "score": np.asarray([0.1, 0.2, 0.8, 0.9])})
+    assert roc(t3, "y", "score", render=False)[2] == 0.0
+
+
+def test_plot_edge_cases():
+    import pytest
+
+    # explicit labels omit a present class: those rows are IGNORED
+    # (sklearn semantics), not a KeyError
+    t = Table({"y": np.asarray([0, 0, 1, 2]),
+               "pred": np.asarray([0, 2, 1, 2])})
+    cm = confusion_matrix(t, "y", "pred", labels=[0, 1], render=False)
+    np.testing.assert_array_equal(cm, [[1, 0], [0, 1]])
+
+    # single-class labels: ROC is undefined -> loud error, not 0.0
+    t2 = Table({"y": np.ones(5, np.float64),
+                "score": np.linspace(0, 1, 5)})
+    with pytest.raises(ValueError, match="undefined"):
+        roc(t2, "y", "score", render=False)
