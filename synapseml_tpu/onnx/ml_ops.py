@@ -468,3 +468,138 @@ def _ml_one_hot(ctx, x):
     if not int(ctx.attr("zeros", 1)):
         pass  # zeros=0 would demand an error on unknown; keep permissive
     return hot
+
+
+# -- SVM family (sklearn-converted exports) --------------------------------
+
+def _svm_kernel(x, sv, kind: str, gamma: float, coef0: float,
+                degree: float):
+    """Batched kernel matrix [N, S] — one MXU gram matmul per call."""
+    x = jnp.asarray(x, jnp.float32)
+    sv = jnp.asarray(sv, jnp.float32)
+    dot = x @ sv.T
+    if kind == "LINEAR":
+        return dot
+    if kind == "POLY":
+        return (gamma * dot + coef0) ** degree
+    if kind == "RBF":
+        d2 = ((x * x).sum(-1)[:, None] - 2.0 * dot
+              + (sv * sv).sum(-1)[None, :])
+        return jnp.exp(-gamma * d2)
+    if kind == "SIGMOID":
+        return jnp.tanh(gamma * dot + coef0)
+    raise NotImplementedError(f"SVM kernel_type {kind!r}")
+
+
+def _svm_params(ctx):
+    kp = [float(v) for v in (ctx.attr("kernel_params") or [])]
+    gamma = kp[0] if len(kp) > 0 else 1.0
+    coef0 = kp[1] if len(kp) > 1 else 0.0
+    degree = kp[2] if len(kp) > 2 else 3.0
+    return str(ctx.attr("kernel_type", "LINEAR")), gamma, coef0, degree
+
+
+@op("SVMClassifier")
+def _svm_classifier(ctx, x):
+    """One-vs-one SVC (support-vector mode) or linear-weight mode.
+    Outputs (label, scores): scores are the k*(k-1)/2 ovo decision
+    values in (0,1),(0,2),..,(1,2).. order, with the libsvm/onnxruntime
+    sign convention — positive votes the FIRST class of the pair.
+    (sklearn's BINARY decision_function is the negation of libsvm's
+    (0,1) value; skl2onnx compensates by negating binary dual coefs +
+    rho at export, and the parity tests mirror that.)"""
+    if ctx.attr("prob_a"):
+        raise NotImplementedError(
+            "SVMClassifier Platt-scaled probabilities (prob_a/prob_b) "
+            "are not supported; re-export without probability=True")
+    labels_i = ctx.attr("classlabels_int64s")
+    if ctx.attr("classlabels_strings"):
+        raise NotImplementedError(
+            "string class labels need host-side mapping; use int64 labels")
+    kind, gamma, coef0, degree = _svm_params(ctx)
+    sv = np.asarray(ctx.attr("support_vectors") or [], np.float32)
+    coefs = np.asarray(ctx.attr("coefficients"), np.float32)
+    rho = np.asarray(ctx.attr("rho"), np.float32)
+    x = jnp.asarray(x, jnp.float32)
+
+    if sv.size == 0:
+        # linear-weight mode: coefficients are [k, F] class weights
+        labels = np.asarray(labels_i if labels_i else [0, 1], np.int64)
+        w = coefs.reshape(len(labels), -1)
+        scores = x @ jnp.asarray(w.T) + jnp.asarray(rho)
+        label = jnp.asarray(labels)[jnp.argmax(scores, axis=-1)]
+        return label, _post_transform(
+            scores, str(ctx.attr("post_transform", "NONE")))
+
+    vpc = np.asarray(ctx.attr("vectors_per_class"), np.int64)
+    k = len(vpc)
+    labels = np.asarray(labels_i if labels_i else list(range(k)), np.int64)
+    n_sv = int(vpc.sum())
+    sv = sv.reshape(n_sv, -1)
+    dual = coefs.reshape(k - 1, n_sv)          # [k-1, n_sv] dual coefs
+    starts = np.concatenate([[0], np.cumsum(vpc)])
+    K = _svm_kernel(x, sv, kind, gamma, coef0, degree)   # [N, n_sv]
+
+    decisions = []
+    votes = jnp.zeros((x.shape[0], k), jnp.int32)
+    p = 0
+    for i in range(k):
+        for j in range(i + 1, k):
+            si, ei = int(starts[i]), int(starts[i + 1])
+            sj, ej = int(starts[j]), int(starts[j + 1])
+            dec = (K[:, si:ei] @ jnp.asarray(dual[j - 1, si:ei])
+                   + K[:, sj:ej] @ jnp.asarray(dual[i, sj:ej])
+                   + float(rho[p]))
+            decisions.append(dec)
+            win = (dec > 0)
+            votes = votes.at[:, i].add(win.astype(jnp.int32))
+            votes = votes.at[:, j].add((~win).astype(jnp.int32))
+            p += 1
+    scores = jnp.stack(decisions, axis=-1)       # [N, k*(k-1)/2]
+    label = jnp.asarray(labels)[jnp.argmax(votes, axis=-1)]
+    return label, _post_transform(
+        scores, str(ctx.attr("post_transform", "NONE")))
+
+
+@op("SVMRegressor")
+def _svm_regressor(ctx, x):
+    kind, gamma, coef0, degree = _svm_params(ctx)
+    coefs = np.asarray(ctx.attr("coefficients"), np.float32)
+    rho = float(np.asarray(ctx.attr("rho"), np.float32).reshape(-1)[0])
+    n_sup = int(ctx.attr("n_supports", 0))
+    x = jnp.asarray(x, jnp.float32)
+    if n_sup == 0:
+        y = x @ jnp.asarray(coefs.reshape(-1)) + rho
+    else:
+        sv = np.asarray(ctx.attr("support_vectors"),
+                        np.float32).reshape(n_sup, -1)
+        K = _svm_kernel(x, sv, kind, gamma, coef0, degree)
+        y = K @ jnp.asarray(coefs.reshape(-1)) + rho
+    if int(ctx.attr("one_class", 0)):
+        # OneClassSVM exports: onnxruntime maps the score to +/-1
+        y = jnp.where(y > 0, 1.0, -1.0)
+    y = _post_transform(y, str(ctx.attr("post_transform", "NONE")))
+    return y[:, None]
+
+
+@op("DictVectorizer")
+def _dict_vectorizer(ctx, x):
+    """map<key, value> rows -> dense columns per the vocabulary order.
+    Maps only exist host-side (object arrays of dicts)."""
+    vocab = (ctx.attr("string_vocabulary")
+             or ctx.attr("int64_vocabulary"))
+    if vocab is None:
+        raise ValueError("DictVectorizer needs a vocabulary attribute")
+    if not _is_host(x):
+        raise NotImplementedError(
+            "DictVectorizer consumes map values, which only exist "
+            "host-side; feed object rows of dicts")
+    rows = np.asarray(x, dtype=object).reshape(-1)
+    out = np.zeros((len(rows), len(vocab)), np.float32)
+    index = {k: i for i, k in enumerate(vocab)}
+    for r, d in enumerate(rows):
+        for key, val in dict(d).items():
+            i = index.get(key)
+            if i is not None:
+                out[r, i] = val
+    return out

@@ -2638,3 +2638,164 @@ def test_gather_nd_batch_dims():
         np.stack([x[b, c][idx2[b, c, :, 0]] for c in range(3)])
         for b in range(2)])
     np.testing.assert_array_equal(got, want)
+
+
+def test_svm_family_matches_sklearn():
+    """SVMClassifier/SVMRegressor against sklearn itself (the foreign
+    oracle skl2onnx converts FROM): ovo decision values, vote labels,
+    rbf/poly/sigmoid kernels, SVR, and the linear-weight modes."""
+    from sklearn.svm import SVC, SVR, LinearSVC, LinearSVR
+
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(120, 5))
+    y3 = np.digitize(x[:, 0] + 0.7 * x[:, 1], [-0.4, 0.4])
+    xq = rng.normal(size=(40, 5)).astype(np.float32)
+
+    for kernel, kind, params in [
+        ("rbf", "RBF", dict(gamma=0.3)),
+        ("poly", "POLY", dict(gamma=0.25, coef0=1.0, degree=3)),
+        ("sigmoid", "SIGMOID", dict(gamma=0.05, coef0=0.2)),
+        ("linear", "LINEAR", {}),
+    ]:
+        m = SVC(kernel=kernel, decision_function_shape="ovo",
+                **params).fit(x, y3)
+        sv = m.support_vectors_.astype(np.float32)
+        g = GraphBuilder(opset=21)
+        xn = g.add_input("x", np.float32, ["N", 5])
+        lab, sc = g.add_node(
+            "SVMClassifier", [xn], outputs=["lab", "sc"],
+            domain="ai.onnx.ml",
+            kernel_type=kind,
+            kernel_params=[float(m._gamma),
+                           float(params.get("coef0", 0.0)),
+                           float(params.get("degree", 3))],
+            support_vectors=sv.reshape(-1).tolist(),
+            vectors_per_class=m.n_support_.tolist(),
+            coefficients=m.dual_coef_.astype(
+                np.float32).reshape(-1).tolist(),
+            rho=m.intercept_.astype(np.float32).tolist(),
+            classlabels_int64s=[int(c) for c in m.classes_])
+        g.add_output(lab, np.int64, ["N"])
+        g.add_output(sc, np.float32, None)
+        gi = import_model(g.to_bytes())
+        got_lab, got_sc = [np.asarray(o) for o in
+                           gi.apply(gi.params, xq)]
+        want_dec = m.decision_function(xq.astype(np.float64))
+        np.testing.assert_allclose(got_sc, want_dec, rtol=2e-4,
+                                   atol=2e-4, err_msg=kernel)
+        want_lab = m.predict(xq.astype(np.float64))
+        agree = (got_lab == want_lab).mean()
+        assert agree > 0.97, (kernel, agree)  # vote ties may differ
+
+    # SVR: kernel + rho
+    mr = SVR(kernel="rbf", gamma=0.2, C=2.0).fit(x, x[:, 0] * 2 + x[:, 1])
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", 5])
+    yr = g.add_node(
+        "SVMRegressor", [xn], domain="ai.onnx.ml", kernel_type="RBF",
+        kernel_params=[float(mr._gamma), 0.0, 3.0],
+        support_vectors=mr.support_vectors_.astype(
+            np.float32).reshape(-1).tolist(),
+        n_supports=int(len(mr.support_vectors_)),
+        coefficients=mr.dual_coef_.astype(np.float32).reshape(-1).tolist(),
+        rho=mr.intercept_.astype(np.float32).tolist())
+    g.add_output(yr, np.float32, ["N", 1])
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, xq)[0])[:, 0]
+    np.testing.assert_allclose(got, mr.predict(xq.astype(np.float64)),
+                               rtol=2e-4, atol=2e-4)
+
+    # BINARY SVC: libsvm/ORT sign convention is the NEGATION of
+    # sklearn's binary decision_function; skl2onnx negates the dual
+    # coefs + rho at export — mirror that and labels must match exactly
+    yb = (y3 > 0).astype(int)
+    mb = SVC(kernel="rbf", gamma=0.3).fit(x, yb)
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", 5])
+    lab, sc = g.add_node(
+        "SVMClassifier", [xn], outputs=["lab", "sc"],
+        domain="ai.onnx.ml", kernel_type="RBF",
+        kernel_params=[float(mb._gamma), 0.0, 3.0],
+        support_vectors=mb.support_vectors_.astype(
+            np.float32).reshape(-1).tolist(),
+        vectors_per_class=mb.n_support_.tolist(),
+        coefficients=(-mb.dual_coef_).astype(
+            np.float32).reshape(-1).tolist(),
+        rho=(-mb.intercept_).astype(np.float32).tolist(),
+        classlabels_int64s=[int(c) for c in mb.classes_])
+    g.add_output(lab, np.int64, ["N"])
+    g.add_output(sc, np.float32, None)
+    gi = import_model(g.to_bytes())
+    got_lab, got_sc = [np.asarray(o) for o in gi.apply(gi.params, xq)]
+    np.testing.assert_allclose(
+        got_sc[:, 0], -mb.decision_function(xq.astype(np.float64)),
+        rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(got_lab,
+                                  mb.predict(xq.astype(np.float64)))
+
+    # OneClassSVM via SVMRegressor one_class=1: +/-1 == sklearn.predict
+    from sklearn.svm import OneClassSVM
+    mo = OneClassSVM(kernel="rbf", gamma=0.2, nu=0.3).fit(x)
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", 5])
+    yo = g.add_node(
+        "SVMRegressor", [xn], domain="ai.onnx.ml", kernel_type="RBF",
+        kernel_params=[float(mo._gamma), 0.0, 3.0], one_class=1,
+        support_vectors=mo.support_vectors_.astype(
+            np.float32).reshape(-1).tolist(),
+        n_supports=int(len(mo.support_vectors_)),
+        coefficients=mo.dual_coef_.astype(np.float32).reshape(-1).tolist(),
+        rho=mo.intercept_.astype(np.float32).tolist())
+    g.add_output(yo, np.float32, ["N", 1])
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, xq)[0])[:, 0]
+    np.testing.assert_array_equal(
+        got, mo.predict(xq.astype(np.float64)).astype(np.float32))
+
+    # linear-weight modes (LinearSVC/LinearSVR exports: no SVs)
+    ml = LinearSVC().fit(x, y3)
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", 5])
+    lab, sc = g.add_node(
+        "SVMClassifier", [xn], outputs=["lab", "sc"],
+        domain="ai.onnx.ml", kernel_type="LINEAR",
+        coefficients=ml.coef_.astype(np.float32).reshape(-1).tolist(),
+        rho=ml.intercept_.astype(np.float32).tolist(),
+        classlabels_int64s=[int(c) for c in ml.classes_])
+    g.add_output(lab, np.int64, ["N"])
+    g.add_output(sc, np.float32, None)
+    gi = import_model(g.to_bytes())
+    got_lab, got_sc = [np.asarray(o) for o in gi.apply(gi.params, xq)]
+    np.testing.assert_allclose(got_sc,
+                               ml.decision_function(xq.astype(np.float64)),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_array_equal(got_lab,
+                                  ml.predict(xq.astype(np.float64)))
+
+    mlr = LinearSVR().fit(x, x[:, 0])
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N", 5])
+    yr = g.add_node(
+        "SVMRegressor", [xn], domain="ai.onnx.ml", kernel_type="LINEAR",
+        n_supports=0,
+        coefficients=mlr.coef_.astype(np.float32).reshape(-1).tolist(),
+        rho=[float(mlr.intercept_[0])])
+    g.add_output(yr, np.float32, ["N", 1])
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, xq)[0])[:, 0]
+    np.testing.assert_allclose(got, mlr.predict(xq.astype(np.float64)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_dict_vectorizer():
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("x", np.float32, ["N"])  # dtype nominal: host objects
+    y = g.add_node("DictVectorizer", [xn], domain="ai.onnx.ml",
+                   string_vocabulary=["a", "b", "c"])
+    g.add_output(y, np.float32, ["N", 3])
+    gi = import_model(g.to_bytes())
+    rows = np.empty(2, dtype=object)
+    rows[0] = {"a": 1.0, "c": 2.0, "zzz": 9.0}  # unknown keys ignored
+    rows[1] = {"b": -1.0}
+    got = np.asarray(gi.apply(gi.params, rows)[0])
+    np.testing.assert_array_equal(got, [[1, 0, 2], [0, -1, 0]])
