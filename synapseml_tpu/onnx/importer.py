@@ -2371,6 +2371,101 @@ def _rotary_embedding(ctx, x, position_ids, cos_cache, sin_cache):
     return out
 
 
+@op("TfIdfVectorizer")
+def _tfidf_vectorizer(ctx, x):
+    """N-gram counting over integer token rows (the sklearn
+    CountVectorizer/TfidfVectorizer export op). Skip-grams follow the
+    onnxruntime interpretation: for each skip value s in
+    [0, max_skip_count], n-gram items are taken at EQUAL stride s+1.
+    Matching is one vectorized windows==pool comparison per
+    (n, skip) pair — [N, W, P] elementwise on device, no per-row loops.
+    """
+    mode = str(ctx.attr("mode", "TF"))
+    min_n = int(ctx.attr("min_gram_length", 1))
+    max_n = int(ctx.attr("max_gram_length", 1))
+    max_skip = int(ctx.attr("max_skip_count", 0))
+    if ctx.attr("pool_int64s") is None or \
+            ctx.attr("pool_strings") is not None:
+        raise NotImplementedError(
+            "TfIdfVectorizer: only pool_int64s token pools are supported")
+    pool = np.asarray(ctx.attr("pool_int64s"), np.int64)
+    counts_attr = [int(v) for v in ctx.attr("ngram_counts")]
+    indexes = np.asarray(ctx.attr("ngram_indexes"), np.int64)
+    weights = ctx.attr("weights")
+    n_out = int(indexes.max()) + 1 if indexes.size else 0
+    x = jnp.asarray(x)
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[None, :]
+    rows, seq = x.shape
+
+    # pool layout: ngram_counts[i] = flat offset of the (i+1)-gram block
+    bounds = counts_attr + [len(pool)]
+    out = jnp.zeros((rows, n_out), jnp.float32)
+    idx_cursor = 0
+    for level in range(len(counts_attr)):
+        n = level + 1
+        lo, hi = bounds[level], bounds[level + 1]
+        n_grams = (hi - lo) // max(n, 1)
+        if n_grams == 0:
+            continue
+        cols = indexes[idx_cursor: idx_cursor + n_grams]
+        idx_cursor += n_grams
+        if not (min_n <= n <= max_n):
+            continue  # pool level present but not counted
+        grams = jnp.asarray(pool[lo:hi].reshape(n_grams, n))
+        skips = range(max_skip + 1) if n > 1 else (0,)
+        level_counts = jnp.zeros((rows, n_grams), jnp.float32)
+        for s in skips:
+            stride = s + 1
+            span = (n - 1) * stride + 1
+            if span > seq:
+                continue
+            w = seq - span + 1
+            win_idx = (np.arange(w)[:, None]
+                       + np.arange(n)[None, :] * stride)    # [W, n]
+            windows = x[:, win_idx]                         # [N, W, n]
+
+            def count_chunk(gchunk):
+                # per-position AND accumulation: the peak intermediate
+                # is [N, W, chunk], never [N, W, chunk, n]
+                m = jnp.ones((rows, w, gchunk.shape[0]), bool)
+                for kk in range(n):
+                    m = m & (windows[:, :, kk, None]
+                             == gchunk[None, None, :, kk])
+                return m.sum(1).astype(jnp.float32)         # [N, chunk]
+
+            # chunk the pool so rows*W*chunk stays bounded (a real text
+            # export carries tens of thousands of n-grams)
+            chunk = max(1, min(n_grams, (1 << 24) // max(rows * w, 1)))
+            if chunk >= n_grams:
+                level_counts = level_counts + count_chunk(grams)
+            else:
+                n_chunks = -(-n_grams // chunk)
+                pad = n_chunks * chunk - n_grams
+                gp = jnp.pad(grams, ((0, pad), (0, 0)),
+                             constant_values=-1)  # -1 never matches
+                _, per = lax.scan(
+                    lambda c, g: (c, count_chunk(g)), None,
+                    gp.reshape(n_chunks, chunk, n))
+                level_counts = level_counts + jnp.moveaxis(
+                    per, 0, 1).reshape(rows, -1)[:, :n_grams]
+        out = out.at[:, cols].add(level_counts)
+    if mode in ("IDF", "TFIDF"):
+        # weights align with the POOL order; scatter to output columns
+        wv_np = np.ones(n_out, np.float32)
+        if weights is not None:
+            wv_np[np.asarray(indexes)] = np.asarray(weights, np.float32)
+        wv = jnp.asarray(wv_np)
+        if mode == "IDF":
+            out = jnp.where(out > 0, wv[None, :], 0.0)
+        else:
+            out = out * wv[None, :]
+    elif mode != "TF":
+        raise ValueError(f"TfIdfVectorizer mode {mode!r}")
+    return out[0] if squeeze else out
+
+
 # Optional wrappers: the env's natural None/value distinction IS the
 # optional type (absent optional inputs already flow as None)
 _REGISTRY["Optional"] = lambda ctx, x=None: x

@@ -523,10 +523,17 @@ def _svm_classifier(ctx, x):
     x = jnp.asarray(x, jnp.float32)
 
     if sv.size == 0:
-        # linear-weight mode: coefficients are [k, F] class weights
+        # linear-weight mode: row count comes from the coefficient size;
+        # a binary export carries ONE weight row whose RAW decision
+        # thresholds at 0 — expand to (-s, s) so argmax is that
+        # threshold (the 0.5-probability expansion would misclassify
+        # raw-margin scores)
+        k_rows = max(1, coefs.size // int(x.shape[-1]))
         labels = np.asarray(labels_i if labels_i else [0, 1], np.int64)
-        w = coefs.reshape(len(labels), -1)
+        w = coefs.reshape(k_rows, -1)
         scores = x @ jnp.asarray(w.T) + jnp.asarray(rho)
+        if k_rows == 1 and len(labels) == 2:
+            scores = jnp.concatenate([-scores, scores], axis=-1)
         label = jnp.asarray(labels)[jnp.argmax(scores, axis=-1)]
         return label, _post_transform(
             scores, str(ctx.attr("post_transform", "NONE")))
