@@ -2950,3 +2950,62 @@ def test_tfidf_vectorizer():
         for i in range(Xb.shape[1] - 1):
             want_b[r] += (grams_b == Xb[r, i:i + 2]).all(1)
     np.testing.assert_array_equal(got_b, want_b)
+
+
+def test_sklearn_text_pipeline_composed():
+    """The sklearn text-classification export shape, composed in one
+    graph: TfIdfVectorizer (bigram counts) -> SVMClassifier (linear),
+    scored through ONNXModel.transform — predictions equal the sklearn
+    Pipeline(CountVectorizer, LinearSVC) it mirrors."""
+    from sklearn.feature_extraction.text import CountVectorizer
+    from sklearn.svm import LinearSVC
+
+    docs = ["good great fine", "bad awful bad", "great good good",
+            "awful poor bad", "fine good fine", "poor awful poor",
+            "good fine great", "bad poor awful"] * 4
+    y = np.asarray([1, 0] * 16)
+    cv = CountVectorizer(ngram_range=(1, 2),
+                         token_pattern=r"(?u)\b\w+\b").fit(docs)
+    Xc = cv.transform(docs).toarray().astype(np.float64)
+    clf = LinearSVC().fit(Xc, y)
+
+    tok2id = {t: i for i, t in enumerate(
+        sorted({w for d in docs for w in d.split()}))}
+    X = np.asarray([[tok2id[t] for t in d.split()] for d in docs],
+                   np.int64)
+    vocab = sorted(cv.vocabulary_, key=cv.vocabulary_.get)
+    pool, cols = [], []
+    uni = [v for v in vocab if " " not in v]
+    for v in uni:
+        pool.append(tok2id[v])
+        cols.append(cv.vocabulary_[v])
+    counts_attr = [0, len(pool)]
+    for v in vocab:
+        if " " in v:
+            a, b = v.split()
+            pool += [tok2id[a], tok2id[b]]
+            cols.append(cv.vocabulary_[v])
+
+    g = GraphBuilder(opset=21)
+    xn = g.add_input("tokens", np.int64, ["N", 3])
+    feats = g.add_node("TfIdfVectorizer", [xn], mode="TF",
+                       min_gram_length=1, max_gram_length=2,
+                       max_skip_count=0, ngram_counts=counts_attr,
+                       ngram_indexes=cols, pool_int64s=pool)
+    lab, sc = g.add_node(
+        "SVMClassifier", [feats], outputs=["lab", "sc"],
+        domain="ai.onnx.ml", kernel_type="LINEAR",
+        coefficients=clf.coef_.astype(np.float32).reshape(-1).tolist(),
+        rho=clf.intercept_.astype(np.float32).tolist(),
+        classlabels_int64s=[0, 1])
+    g.add_output(lab, np.int64, ["N"])
+    g.add_output(sc, np.float32, None)
+
+    from synapseml_tpu.onnx import ONNXModel
+    model = ONNXModel(model_bytes=g.to_bytes(),
+                      feed_dict={"tokens": "tokens"},
+                      fetch_dict={"pred": "lab"})
+    out = model.transform(Table({"tokens": X}))
+    got = np.asarray(out["pred"], np.int64)
+    np.testing.assert_array_equal(got, clf.predict(Xc))
+    assert (got == y).all()  # the pipeline actually learned the task
