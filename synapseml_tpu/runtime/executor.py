@@ -248,14 +248,39 @@ class BatchedExecutor:
             padded.append(
                 jax.device_put(a, self._device) if self._device else a)
         out = self._jit_for(len(padded))(*self._bound, *padded)
-        return out, n
+        return out, n, bucket
 
-    def _fetch(self, out, n: int):
+    def _fetch(self, out, n: int, bucket: int):
         """Block on one batch's device->host copy. One batched fetch —
         per-leaf np.asarray pays a transfer round trip per output on
-        remote chips."""
+        remote chips. Padding is sliced off per leaf; a leaf whose
+        leading dim is NOT the batch axis cannot be row-sliced, and
+        doing it silently would mis-assign rows (the round-5 NMS-through-
+        ONNXModel repro) — fail with a recipe instead."""
         leaves = jax.device_get(jax.tree_util.tree_leaves(out))
-        return tuple(l[:n] for l in leaves)
+        trimmed = []
+        for l in leaves:
+            if np.ndim(l) == 0:
+                raise ValueError(
+                    "executor outputs must carry a batch axis: a scalar "
+                    "output aggregates over the PADDING rows of the "
+                    f"bucket ({bucket} padded vs {n} real) — keep a "
+                    "leading batch dim and reduce outside the executor")
+            if len(l) == bucket:
+                trimmed.append(l[:n])
+            elif len(l) <= n:
+                # smaller-than-batch outputs were never sliced before;
+                # keep the pass-through (no row mis-assignment occurs)
+                trimmed.append(l)
+            else:
+                raise ValueError(
+                    f"executor output with leading dim {len(l)} is not "
+                    f"batch-aligned (batch bucket {bucket}, {n} real "
+                    "rows): per-row slicing would silently mis-assign "
+                    "rows. Batch-align it in-graph — e.g. Reshape "
+                    "NonMaxSuppression's [B*C*max_out, 3] output to "
+                    "[B, C*max_out, 3] before the graph output.")
+        return tuple(trimmed)
 
 
 class JitCache:

@@ -3009,3 +3009,57 @@ def test_sklearn_text_pipeline_composed():
     got = np.asarray(out["pred"], np.int64)
     np.testing.assert_array_equal(got, clf.predict(Xc))
     assert (got == y).all()  # the pipeline actually learned the task
+
+
+def test_nms_through_onnx_model_requires_batch_alignment():
+    """The fixed-capacity NMS output ([B*C*max_out, 3]) is not
+    batch-aligned: scoring it through ONNXModel must fail LOUDLY with
+    the reshape recipe (previously the executor silently sliced the
+    first B rows — batch 0's 2nd pick landed on table row 1), and the
+    recipe itself — an in-graph Reshape to [B, C*max_out, 3] — must
+    yield correct per-row selections."""
+    from synapseml_tpu.onnx import ONNXModel
+
+    def build(aligned):
+        g = GraphBuilder(opset=21)
+        bn = g.add_input("boxes", np.float32, ["N", 6, 4])
+        sn = g.add_input("scores", np.float32, ["N", 1, 6])
+        ins = [bn, sn, g.add_initializer("mo", np.int64(3)),
+               g.add_initializer("iou", np.float32(0.5))]
+        y = g.add_node("NonMaxSuppression", ins)
+        if aligned:
+            shp = g.add_node("Shape", [bn])
+            b0 = g.add_node("Gather", [shp, g.add_initializer(
+                "z", np.asarray(0, np.int64))])
+            tgt = g.add_node("Concat", [
+                g.add_node("Unsqueeze", [b0, g.add_initializer(
+                    "ax0", np.asarray([0], np.int64))]),
+                g.add_initializer("rest", np.asarray([-1, 3], np.int64))],
+                axis=0)
+            y = g.add_node("Reshape", [y, tgt], outputs=["sel"])
+        g.add_output(y, np.int64, None)
+        return g.to_bytes(), y
+
+    boxes = np.array([[[0, 0, 1, 1], [0, 0.1, 1, 1.1], [0, -0.1, 1, 0.9],
+                       [0, 10, 1, 11], [0, 10.1, 1, 11.1],
+                       [0, 100, 1, 101]]] * 2, np.float32)
+    scores = np.array([[[0.9, 0.75, 0.6, 0.95, 0.5, 0.3]]] * 2,
+                      np.float32)
+
+    blob, out_name = build(False)
+    m = ONNXModel(model_bytes=blob, feed_dict={"boxes": "b",
+                                               "scores": "s"},
+                  fetch_dict={"sel": out_name})
+    with pytest.raises(ValueError, match="batch-aligned"):
+        m.transform(Table({"b": boxes, "s": scores}))
+
+    blob, out_name = build(True)
+    m2 = ONNXModel(model_bytes=blob, feed_dict={"boxes": "b",
+                                                "scores": "s"},
+                   fetch_dict={"sel": out_name})
+    out = m2.transform(Table({"b": boxes, "s": scores}))
+    r0 = np.asarray(out["sel"][0])
+    r1 = np.asarray(out["sel"][1])
+    np.testing.assert_array_equal(r0, [[0, 0, 3], [0, 0, 0], [0, 0, 5]])
+    np.testing.assert_array_equal(r1[:, 2], r0[:, 2])  # same picks
+    assert (r1[:, 0] == 1).all()                       # its own batch

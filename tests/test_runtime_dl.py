@@ -131,9 +131,9 @@ def test_executor_pipelines_dispatch_before_fetch():
                    for l in jax.tree_util.tree_leaves(out[0]))
         return out
 
-    def fetch(out, n):
+    def fetch(out, n, bucket):
         events.append("f")
-        return orig_fetch(out, n)
+        return orig_fetch(out, n, bucket)
 
     ex._dispatch, ex._fetch = dispatch, fetch
     x = np.arange(16, dtype=np.float32)
@@ -223,3 +223,31 @@ def test_executor_superchunk_ragged_tail(monkeypatch):
     x = np.arange(22, dtype=np.float32)  # 5 buckets + ragged last
     (y,) = ex(x)
     np.testing.assert_allclose(y, x * 3.0)
+
+
+def test_executor_rejects_non_batch_aligned_outputs():
+    """An output whose leading dim is neither the batch bucket nor the
+    real row count cannot be row-sliced: the executor must fail loudly
+    with the batch-align recipe (round-5 repro: NonMaxSuppression's
+    [B*C*max_out, 3] through ONNXModel silently mis-assigned rows)."""
+    import pytest
+
+    from synapseml_tpu.runtime.executor import BatchedExecutor
+
+    ex = BatchedExecutor(lambda x: (x.reshape(-1, 1),), min_bucket=4,
+                         max_bucket=4)
+    x = np.ones((3, 2), np.float32)  # padded to bucket 4 -> output [8,1]
+    with pytest.raises(ValueError, match="batch-aligned"):
+        ex(x)
+
+    # scalar outputs aggregate over the padding -> loud error too
+    ex_s = BatchedExecutor(lambda x: (x.mean(),), min_bucket=4)
+    with pytest.raises(ValueError, match="batch axis"):
+        ex_s(x)
+
+    # batch-aligned outputs still slice the padding off; small fixed
+    # outputs (leading dim <= n) keep the historical pass-through
+    ex2 = BatchedExecutor(lambda x: (x * 2.0, x.sum(0, keepdims=True)),
+                          min_bucket=4)
+    out, agg = ex2(np.ones((3, 2), np.float32))
+    assert out.shape == (3, 2) and agg.shape == (1, 2)
