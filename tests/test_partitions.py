@@ -176,22 +176,24 @@ def test_fit_partitions_ranker_groups():
     assert ga.dtype == np.int64 and ga[0] != ga[1]
 
 
-def _run_two_workers(worker_code, ports, timeout=240):
-    """Spawn two rank processes running ``worker_code`` (with {rdv_port}/
-    {coord_port} substituted); assert both exit 0 and print 'ok'."""
+def _run_two_workers(worker_code, ports, timeout=240, n_workers=2):
+    """Spawn ``n_workers`` rank processes running ``worker_code`` (with
+    {rdv_port}/{coord_port}/{n_workers} substituted); assert every one
+    exits 0 and prints 'ok'."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = "."
     code = (worker_code
             .replace("{rdv_port}", str(ports[0]))
-            .replace("{coord_port}", str(ports[1])))
+            .replace("{coord_port}", str(ports[1]))
+            .replace("{n_workers}", str(n_workers)))
     procs = [
         subprocess.Popen([sys.executable, "-c", code, str(i)],
                          env=env, stdout=subprocess.PIPE,
                          stderr=subprocess.PIPE, text=True,
                          cwd=os.path.dirname(os.path.dirname(
                              os.path.abspath(__file__))))
-        for i in range(2)
+        for i in range(n_workers)
     ]
     outs = [(p_.returncode, *p_.communicate(timeout=timeout))
             for p_ in procs]
@@ -212,8 +214,9 @@ from synapseml_tpu.parallel.distributed import DriverRendezvous
 RDV = {"driver_host": "127.0.0.1", "driver_port": {rdv_port},
        "my_host": "127.0.0.1", "rank_hint": rank_hint,
        "coordinator_port": {coord_port}}
+N_WORKERS = {n_workers}
 if rank_hint == 0:
-    DriverRendezvous(num_workers=2, host="127.0.0.1",
+    DriverRendezvous(num_workers=N_WORKERS, host="127.0.0.1",
                      port={rdv_port}).start()
 """
 
@@ -452,3 +455,35 @@ print("CKPT", rank_hint, "ok", flush=True)
 """
     _run_two_workers(worker_code, (find_open_port(27500),
                                    find_open_port(27600)))
+
+
+def test_three_process_row_sharded_uneven_shards():
+    """Three ranks with UNEVEN partition sizes (150/90/60 rows): the
+    row-sharded collectives must agree across an odd process count with
+    ragged per-host padding, and the booster must equal the single fit
+    (data under the bin budget, rank-ordered partitions)."""
+    from synapseml_tpu.io.serving import find_open_port
+
+    worker_code = _WORKER_PRELUDE + """
+n, d = 300, 4
+rng = np.random.default_rng(5)
+x = rng.normal(size=(n, d))
+y = (x[:, 0] - 0.3 * x[:, 1] > 0).astype(np.float64)
+bounds = [(0, 150), (150, 240), (240, 300)]
+lo, hi = bounds[rank_hint]
+cols = [f"f{j}" for j in range(d)]
+batches = [{**{c: x[lo:hi, j] for j, c in enumerate(cols)},
+            "label": y[lo:hi]}]
+p = BoostParams(objective="binary", num_iterations=6, num_leaves=7)
+stats = {}
+b = fit_partitions(p, batches, feature_cols=cols, rendezvous=RDV,
+                   stats_out=stats)
+assert stats["path"] == "row_sharded", stats
+assert stats["n_local"] == hi - lo, stats
+assert stats["n_total"] == 300, stats
+single = train(p, x, y)
+np.testing.assert_allclose(b.predict(x), single.predict(x), rtol=1e-12)
+print("THREEWAY", rank_hint, "ok", flush=True)
+"""
+    _run_two_workers(worker_code, (find_open_port(27700),
+                                   find_open_port(27800)), n_workers=3)
