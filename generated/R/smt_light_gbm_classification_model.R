@@ -25,18 +25,19 @@
 #' @param num_iterations boosting rounds
 #' @param num_leaves max leaves per tree
 #' @param other_rate GOSS other rate
-#' @param parallelism distributed tree learner; data_parallel (dp-mesh psum histograms) is the implemented strategy
+#' @param parallelism distributed tree learner (ref LightGBMParams.scala:16-18): data_parallel (full-histogram dp psum) or voting_parallel (PV-tree top_k feature election; merges only elected features' histograms per split)
 #' @param prediction_col prediction column
 #' @param probability_col probability column
 #' @param raw_prediction_col raw margin column
 #' @param seed random seed
+#' @param top_k voting_parallel features elected per split (LightGBM top_k)
 #' @param top_rate GOSS top rate
 #' @param validation_indicator_col bool column marking validation rows
 #' @param verbosity verbosity
 #' @param weight_col sample weight column
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_light_gbm_classification_model <- function(bagging_fraction = 1.0, bagging_freq = 0, bin_sample_count = 200000, boosting_type = "gbdt", categorical_slot_indexes = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", hist_backend = "auto", label_col = "label", label_values = NULL, lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_classes = 2, num_iterations = 100, num_leaves = 31, other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", probability_col = "probability", raw_prediction_col = "rawPrediction", seed = 0, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
+smt_light_gbm_classification_model <- function(bagging_fraction = 1.0, bagging_freq = 0, bin_sample_count = 200000, boosting_type = "gbdt", categorical_slot_indexes = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", hist_backend = "auto", label_col = "label", label_values = NULL, lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_classes = 2, num_iterations = 100, num_leaves = 31, other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", probability_col = "probability", raw_prediction_col = "rawPrediction", seed = 0, top_k = 20, top_rate = 0.2, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.gbdt.estimators")
   kwargs <- Filter(Negate(is.null), list(
     bagging_fraction = bagging_fraction,
@@ -69,6 +70,7 @@ smt_light_gbm_classification_model <- function(bagging_fraction = 1.0, bagging_f
     probability_col = probability_col,
     raw_prediction_col = raw_prediction_col,
     seed = seed,
+    top_k = top_k,
     top_rate = top_rate,
     validation_indicator_col = validation_indicator_col,
     verbosity = verbosity,

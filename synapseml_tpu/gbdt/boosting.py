@@ -76,8 +76,19 @@ class BoostParams:
     # "auto" = measure at fit time (grower.resolve_hist_backend);
     # "pallas"/"xla" force a histogram formulation
     hist_backend: str = "auto"
+    # distributed tree learner (the reference's parallelism param,
+    # LightGBMParams.scala:16-18: "data_parallel or voting_parallel");
+    # voting elects voting_top_k features per split (LightGBM top_k)
+    # and merges only their histograms — see GrowerParams.voting_top_k
+    tree_learner: str = "data_parallel"
+    voting_top_k: int = 20
 
     def grower(self) -> GrowerParams:
+        if self.tree_learner not in ("data_parallel", "voting_parallel"):
+            raise ValueError(
+                f"tree_learner {self.tree_learner!r}: the reference's "
+                "parallelism param offers data_parallel or "
+                "voting_parallel (LightGBMParams.scala:16-18)")
         return GrowerParams(
             num_leaves=self.num_leaves,
             max_bin=0,  # filled at fit time (device width)
@@ -88,6 +99,9 @@ class BoostParams:
             min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
             min_gain_to_split=self.min_gain_to_split,
             hist_backend=self.hist_backend,
+            voting_top_k=(max(1, int(self.voting_top_k))
+                          if self.tree_learner == "voting_parallel"
+                          else 0),
         )
 
 

@@ -90,10 +90,14 @@ class _LightGBMParams:
     early_stopping_round = Param("early stopping patience", default=0)
     categorical_slot_indexes = Param("categorical feature slots", default=None)
     parallelism = Param(
-        "distributed tree learner; data_parallel (dp-mesh psum histograms) "
-        "is the implemented strategy",
+        "distributed tree learner (ref LightGBMParams.scala:16-18): "
+        "data_parallel (full-histogram dp psum) or voting_parallel "
+        "(PV-tree top_k feature election; merges only elected "
+        "features' histograms per split)",
         default="data_parallel",
-        type_check=lambda v: v == "data_parallel")
+        type_check=lambda v: v in ("data_parallel", "voting_parallel"))
+    top_k = Param("voting_parallel features elected per split "
+                  "(LightGBM top_k)", default=20)
     metric = Param("eval metric override", default=None)
     seed = Param("random seed", default=0)
     verbosity = Param("verbosity", default=-1)
@@ -138,6 +142,8 @@ class _LightGBMParams:
             seed=int(self.seed),
             categorical_features=tuple(self.categorical_slot_indexes or ()),
             hist_backend=self.hist_backend,
+            tree_learner=str(self.parallelism),
+            voting_top_k=int(self.top_k),
         )
 
 

@@ -44,6 +44,13 @@ class GrowerParams:
     # "pallas", or "xla". boosting.train resolves "auto" to a MEASURED
     # winner via resolve_hist_backend before tracing the boosting loop.
     hist_backend: str = "auto"
+    # PV-tree voting (the reference's parallelism="voting_parallel",
+    # LightGBM top_k): >0 elects that many features per split by a
+    # psum'd local-gain vote and merges ONLY their histograms across the
+    # mesh — per-split exchange drops from [F, B, 3] to [top_k, B, 3],
+    # the lever when the dp axis rides DCN instead of ICI. 0 = exact
+    # data_parallel (full-histogram psum).
+    voting_top_k: int = 0
 
 
 @jax.tree_util.register_dataclass
@@ -300,25 +307,73 @@ def _leaf_objective(g, h, p: GrowerParams):
     return gl1 * gl1 / (h + p.lambda_l2 + 1e-12)
 
 
+def _split_gains(hist, totals, p: GrowerParams, depth_ok,
+                 constrained: bool = True):
+    """Per-(feature, bin) split gains [F, B]. ``constrained=False``
+    skips the min-data/min-hessian validity mask (used only as a
+    voting fallback — never for an actual split decision)."""
+    cum = jnp.cumsum(hist, axis=1)                     # [F, B, 3]
+    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
+    gt, ht, ct = totals[0], totals[1], totals[2]
+    gr, hr, cr = gt - gl, ht - hl, ct - cl
+    gain = (_leaf_objective(gl, hl, p) + _leaf_objective(gr, hr, p)
+            - _leaf_objective(gt, ht, p))
+    if not constrained:
+        return jnp.where(depth_ok & (cr > 0), gain, -jnp.inf)
+    valid = ((cl >= p.min_data_in_leaf) & (cr >= p.min_data_in_leaf)
+             & (hl >= p.min_sum_hessian_in_leaf)
+             & (hr >= p.min_sum_hessian_in_leaf))
+    return jnp.where(valid & depth_ok, gain, -jnp.inf)
+
+
 def best_split(hist, totals, p: GrowerParams, depth_ok):
     """Best (gain, feature, bin) for one leaf.
 
     hist: [F, B, 3]; totals: [3] (G, H, C). Split semantics: bin <= b left.
     """
-    cum = jnp.cumsum(hist, axis=1)                     # [F, B, 3]
-    gl, hl, cl = cum[..., 0], cum[..., 1], cum[..., 2]
-    gt, ht, ct = totals[0], totals[1], totals[2]
-    gr, hr, cr = gt - gl, ht - hl, ct - cl
-    valid = ((cl >= p.min_data_in_leaf) & (cr >= p.min_data_in_leaf)
-             & (hl >= p.min_sum_hessian_in_leaf)
-             & (hr >= p.min_sum_hessian_in_leaf))
-    gain = (_leaf_objective(gl, hl, p) + _leaf_objective(gr, hr, p)
-            - _leaf_objective(gt, ht, p))
-    gain = jnp.where(valid & depth_ok, gain, -jnp.inf)
+    gain = _split_gains(hist, totals, p, depth_ok)
     flat = jnp.argmax(gain)
     f_best = (flat // gain.shape[1]).astype(jnp.int32)
     b_best = (flat % gain.shape[1]).astype(jnp.int32)
     return gain.reshape(-1)[flat], f_best, b_best
+
+
+def best_split_voting(hist_local, totals, p: GrowerParams, depth_ok,
+                      axis_name: str):
+    """PV-tree elected best split (ref: LightGBM voting_parallel /
+    Meng et al. parallel voting tree). Each shard ranks features by its
+    LOCAL gains, a psum'd vote elects the global top-k, and only the
+    elected features' histograms are merged (the [top_k, B, 3] psum
+    replaces the full [F, B, 3] one). ``totals`` must be GLOBAL; returns
+    (gain, global feature id, bin), identical on every shard."""
+    f = hist_local.shape[0]
+    k = int(min(p.voting_top_k, f))
+    # local per-feature best gains vote from LOCAL statistics. A shard
+    # whose every (feature, bin) fails the LOCAL min-data/min-hessian
+    # constraints (deep leaves on wide meshes: global counts pass,
+    # per-shard counts don't) would otherwise vote the arbitrary first
+    # k indices — fall back to UNconstrained local gains for its
+    # ranking (the actual split still applies the GLOBAL constraints).
+    local_tot = hist_local[0].sum(axis=0)
+    masked_f = _split_gains(hist_local, local_tot, p,
+                            depth_ok).max(axis=1)            # [F]
+    raw_f = _split_gains(hist_local, local_tot, p, depth_ok,
+                         constrained=False).max(axis=1)
+    local_gain_f = jnp.where(jnp.isfinite(masked_f.max()),
+                             masked_f, raw_f)
+    _, top_local = lax.top_k(local_gain_f, k)
+    votes = lax.psum(
+        jax.nn.one_hot(top_local, f, dtype=jnp.float32).sum(0), axis_name)
+    # deterministic tie-break by feature index (same on every shard);
+    # elected ids are SORTED so best_split's argmax resolves gain ties
+    # in global feature order — with k == F this makes the election
+    # bit-identical to data_parallel
+    order_score = votes * f + jnp.arange(f, 0, -1, dtype=jnp.float32) / f
+    _, elected = lax.top_k(order_score, k)                   # [k]
+    elected = jnp.sort(elected)
+    hist_elected = lax.psum(hist_local[elected], axis_name)  # [k, B, 3]
+    gain, f_local, b_best = best_split(hist_elected, totals, p, depth_ok)
+    return gain, elected[f_local].astype(jnp.int32), b_best
 
 
 def build_tree(
@@ -336,12 +391,23 @@ def build_tree(
     M = 2 * L - 1
     B = p.max_bin
 
-    hist0 = histogram(binned, grad, hess, row_mask, B, axis_name,
+    voting = p.voting_top_k > 0 and axis_name is not None
+    # voting mode keeps per-shard histograms LOCAL (the parent-child
+    # subtraction stays shard-local too) and merges only elected
+    # features per split; totals are always global
+    hist_axis = None if voting else axis_name
+    hist0 = histogram(binned, grad, hess, row_mask, B, hist_axis,
                       backend=p.hist_backend)
     tot0 = hist0[0].sum(axis=0)                       # (G, H, C) of the root
+    if voting:
+        tot0 = lax.psum(tot0, axis_name)
 
     depth_ok0 = True if p.max_depth <= 0 else (0 < p.max_depth)
-    g0, f0, b0 = best_split(hist0, tot0, p, depth_ok0)
+    if voting:
+        g0, f0, b0 = best_split_voting(hist0, tot0, p, depth_ok0,
+                                       axis_name)
+    else:
+        g0, f0, b0 = best_split(hist0, tot0, p, depth_ok0)
 
     state = dict(
         row_slot=jnp.zeros(n, jnp.int32),
@@ -407,8 +473,10 @@ def build_tree(
         mask_right = (st["row_slot"] == s) & row_mask
         hist_r = histogram(binned, grad, hess,
                            jnp.where(do, mask_right, jnp.zeros_like(mask_right)),
-                           B, axis_name, backend=p.hist_backend)
+                           B, hist_axis, backend=p.hist_backend)
         tot_r = hist_r[0].sum(axis=0)
+        if voting:
+            tot_r = lax.psum(tot_r, axis_name)
         hist_l = st["hist"][leaf] - hist_r
         tot_l = st["totals"][leaf] - tot_r
 
@@ -434,8 +502,14 @@ def build_tree(
         st["node_cover"] = _putM(st["node_cover"], rnode, tot_r[2])
 
         depth_ok = True if p.max_depth <= 0 else (new_depth < p.max_depth)
-        gl, fl, bl = best_split(hist_l, tot_l, p, depth_ok)
-        gr, fr, br = best_split(hist_r, tot_r, p, depth_ok)
+        if voting:
+            gl, fl, bl = best_split_voting(hist_l, tot_l, p, depth_ok,
+                                           axis_name)
+            gr, fr, br = best_split_voting(hist_r, tot_r, p, depth_ok,
+                                           axis_name)
+        else:
+            gl, fl, bl = best_split(hist_l, tot_l, p, depth_ok)
+            gr, fr, br = best_split(hist_r, tot_r, p, depth_ok)
         neg = jnp.float32(-jnp.inf)
         st["best_gain"] = _putL(st["best_gain"], lslot, jnp.where(do, gl, neg))
         st["best_gain"] = _putL(st["best_gain"], rslot, jnp.where(do, gr, neg))

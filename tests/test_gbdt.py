@@ -753,3 +753,72 @@ def test_hist_probe_scaled_to_fit_size(monkeypatch):
     b = train(BoostParams(objective="binary", num_iterations=3,
                           num_leaves=7), x, y)
     assert b.num_trees == 3
+
+
+def test_voting_parallel_tree_learner():
+    """parallelism=voting_parallel (PV-tree, the reference's second
+    tree_learner): with top_k >= F the election is exhaustive and the
+    booster is BIT-IDENTICAL to data_parallel; with a small top_k the
+    restricted search still learns (accuracy within a few points) and
+    is deterministic; invalid learners fail loudly."""
+    import dataclasses
+
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(600, 8))
+    y = (x[:, 0] + 0.8 * x[:, 3] - 0.5 * x[:, 6] > 0).astype(np.float64)
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+
+    base = BoostParams(objective="binary", num_iterations=8, num_leaves=7)
+    want = train(base, x, y, mesh=mesh)
+
+    exhaustive = dataclasses.replace(base, tree_learner="voting_parallel",
+                                     voting_top_k=8)
+    got = train(exhaustive, x, y, mesh=mesh)
+    # identical trees; leaf values differ only by the psum association
+    # order of the totals (psum-of-sum vs sum-of-psum, last-ulp)
+    np.testing.assert_array_equal(got.trees_feature, want.trees_feature)
+    np.testing.assert_array_equal(got.trees_left, want.trees_left)
+    np.testing.assert_allclose(got.predict(x), want.predict(x),
+                               rtol=1e-5, atol=1e-6)
+
+    small = dataclasses.replace(base, tree_learner="voting_parallel",
+                                voting_top_k=2)
+    b1 = train(small, x, y, mesh=mesh)
+    b2 = train(small, x, y, mesh=mesh)
+    np.testing.assert_array_equal(b1.predict(x), b2.predict(x))
+    acc_full = ((want.predict(x) > 0.5) == y).mean()
+    acc_vote = ((b1.predict(x) > 0.5) == y).mean()
+    assert acc_vote > acc_full - 0.05, (acc_vote, acc_full)
+    if int(mesh.shape["dp"]) > 1:
+        # the restricted election actually bit (on a 1-device mesh the
+        # local vote IS the global argmax, so trees coincide)
+        assert not np.array_equal(b1.trees_feature, want.trees_feature)
+
+    # deep-leaf regime: per-shard leaf rows drop below min_data_in_leaf
+    # while global counts pass — the unconstrained-vote fallback must
+    # keep the election informative (review repro: all--inf local gains
+    # voted features 0..k-1)
+    deep = dataclasses.replace(base, tree_learner="voting_parallel",
+                               voting_top_k=2, num_leaves=31,
+                               min_data_in_leaf=20, num_iterations=4)
+    bd = train(deep, x, y, mesh=mesh)
+    deep_full = dataclasses.replace(base, num_leaves=31,
+                                    min_data_in_leaf=20, num_iterations=4)
+    bf = train(deep_full, x, y, mesh=mesh)
+    acc_d = ((bd.predict(x) > 0.5) == y).mean()
+    acc_f = ((bf.predict(x) > 0.5) == y).mean()
+    assert acc_d > acc_f - 0.05, (acc_d, acc_f)
+
+    with pytest.raises(ValueError, match="tree_learner"):
+        train(dataclasses.replace(base, tree_learner="feature_parallel"),
+              x, y, mesh=mesh)
+
+    # estimator surface: param accepted + threaded
+    est = LightGBMClassifier(num_iterations=3,
+                             parallelism="voting_parallel", top_k=4)
+    assert est._boost_params("binary").voting_top_k == 4
+    with pytest.raises(TypeError):
+        LightGBMClassifier(parallelism="feature_parallel")
