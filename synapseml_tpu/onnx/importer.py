@@ -128,6 +128,8 @@ for _name, _fn in [
     ("Sin", jnp.sin), ("Cos", jnp.cos), ("Tan", jnp.tan),
     ("Asin", jnp.arcsin), ("Acos", jnp.arccos), ("Atan", jnp.arctan),
     ("Sinh", jnp.sinh), ("Cosh", jnp.cosh),
+    ("Asinh", jnp.arcsinh), ("Acosh", jnp.arccosh), ("Atanh", jnp.arctanh),
+    ("Det", jnp.linalg.det),
     ("IsNaN", jnp.isnan), ("IsInf", jnp.isinf),
     ("Softsign", lambda x: x / (1 + jnp.abs(x))),
     ("Round", jnp.round),
@@ -186,6 +188,12 @@ def _mish(ctx, x):
 def _celu(ctx, x):
     a = ctx.attr("alpha", 1.0)
     return jnp.maximum(x, 0) + jnp.minimum(0.0, a * (jnp.exp(x / a) - 1))
+
+
+@op("Affine")
+def _affine(ctx, x):
+    # legacy experimental op (pre-opset-10 exporters): alpha * x + beta
+    return ctx.attr("alpha", 1.0) * x + ctx.attr("beta", 0.0)
 
 
 @op("ThresholdedRelu")
@@ -670,12 +678,87 @@ def _max_pool(ctx, x):
     pads = _resolve_pads(ctx, x.shape[2:], kernel, strides, dilations,
                          ctx.attr("ceil_mode", 0))
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    if ctx.n_outputs > 1:
+        if int(ctx.attr("storage_order", 0)):
+            raise NotImplementedError(
+                "MaxPool storage_order=1 (column-major Indices) is not "
+                "supported; re-export with row-major indices")
+        return _max_pool_with_indices(x, kernel, strides, dilations,
+                                      pads, init)
     return lax.reduce_window(
         x, init, lax.max,
         window_dimensions=(1, 1) + tuple(kernel),
         window_strides=(1, 1) + tuple(strides),
         window_dilation=(1, 1) + tuple(dilations),
         padding=((0, 0), (0, 0)) + tuple(pads))
+
+
+def _max_pool_with_indices(x, kernel, strides, dilations, pads, init):
+    """MaxPool's optional Indices output (the SegNet/DeconvNet pattern,
+    paired with MaxUnpool): windows are gathered as one patch tensor with
+    compile-time index grids, argmax picks the row-major-first winner
+    (onnxruntime's tie-break), and indices are flattened over the WHOLE
+    [N, C, spatial] input per spec."""
+    rank = len(kernel)
+    sp = x.shape[2:]
+    n, c = x.shape[0], x.shape[1]
+    padded = jnp.pad(jnp.asarray(x), ((0, 0), (0, 0)) + tuple(pads),
+                     constant_values=init)
+    out_sp = [(sp[d] + pads[d][0] + pads[d][1]
+               - (kernel[d] - 1) * dilations[d] - 1) // strides[d] + 1
+              for d in range(rank)]
+    grids = []
+    for d in range(rank):  # G[o, k] = o*stride + k*dilation, into padded
+        g = (np.arange(out_sp[d])[:, None] * strides[d]
+             + np.arange(kernel[d])[None, :] * dilations[d])
+        shape = [1] * (2 * rank)
+        shape[d], shape[rank + d] = out_sp[d], kernel[d]
+        grids.append(g.reshape(shape))
+    patches = padded[(slice(None), slice(None)) + tuple(grids)]
+    flat = patches.reshape(patches.shape[:2 + rank] + (-1,))
+    vals = jnp.max(flat, axis=-1)
+    amax = jnp.argmax(flat, axis=-1)
+    coords = []  # unravel the window argmax into original-tensor coords
+    rem = amax
+    for d in reversed(range(rank)):
+        kd = rem % kernel[d]
+        rem = rem // kernel[d]
+        shape = [1] * (2 + rank)
+        shape[2 + d] = out_sp[d]
+        o_d = jnp.asarray(np.arange(out_sp[d]).reshape(shape))
+        coords.insert(0, o_d * strides[d] + kd * dilations[d] - pads[d][0])
+    flat_sp = coords[0]
+    for d in range(1, rank):
+        flat_sp = flat_sp * sp[d] + coords[d]
+    n_idx = jnp.arange(n).reshape((n,) + (1,) * (1 + rank))
+    c_idx = jnp.arange(c).reshape((1, c) + (1,) * rank)
+    gidx = (n_idx * c + c_idx) * int(np.prod(sp)) + flat_sp
+    return vals, gidx.astype(jnp.int64)
+
+
+@op("MaxUnpool")
+def _max_unpool(ctx, x, idx, output_shape=None):
+    """MaxUnpool: scatter pooled values back to the positions recorded by
+    MaxPool's Indices output (global row-major flat indices per spec), the
+    SegNet decoder op. Output geometry from the explicit output_shape
+    input when present, else inverted from kernel/stride/pads."""
+    kernel = ctx.attr("kernel_shape")
+    rank = len(kernel)
+    strides = ctx.attr("strides", [1] * rank)
+    pads = [int(p) for p in ctx.attr("pads", [0] * (2 * rank))]
+    if output_shape is not None:
+        out_shape = tuple(_static_int_list(
+            output_shape, "MaxUnpool output_shape"))
+    else:
+        sp = x.shape[2:]
+        out_shape = tuple(x.shape[:2]) + tuple(
+            (sp[d] - 1) * strides[d] + kernel[d] - pads[d] - pads[rank + d]
+            for d in range(rank))
+    total = int(np.prod(out_shape))
+    out = jnp.zeros(total, jnp.asarray(x).dtype)
+    out = out.at[jnp.asarray(idx).reshape(-1)].set(
+        jnp.asarray(x).reshape(-1))
+    return out.reshape(out_shape)
 
 
 @op("AveragePool")
@@ -1465,6 +1548,10 @@ def _scatter_elements(ctx, x, idx, updates):
     return at.set(updates)
 
 
+# deprecated opset-9 name for the same op (no reduction attr back then)
+_REGISTRY["Scatter"] = _scatter_elements
+
+
 @op("Expand")
 def _expand(ctx, x, shape):
     # bidirectional numpy broadcast: align ranks from the right, then each
@@ -1645,6 +1732,30 @@ def _stft(ctx, signal, frame_step, window=None, frame_length=None):
                       else jnp.float64)
 
 
+def _cosine_window(name: str, coeffs):
+    """Opset-17 generalized-cosine window family. ``size`` is geometry
+    (static); ``periodic=1`` (default) divides by N, symmetric by N-1 —
+    the spec's formulas, emitted eagerly as a host constant so a window
+    feeding STFT stays a weight, not a traced value."""
+    def impl(ctx, size):
+        (n,) = _static_int_list(size, f"{name} size")
+        dt = proto.TENSOR_DTYPES[int(ctx.attr("output_datatype", 1))]
+        denom = n if int(ctx.attr("periodic", 1)) else n - 1
+        k = 2.0 * np.pi * np.arange(n) / max(denom, 1)
+        w = np.zeros(n, np.float64)
+        for j, a in enumerate(coeffs):
+            w += a * np.cos(j * k) * (-1.0 if j % 2 else 1.0)
+        return np.asarray(w, dt)
+    return impl
+
+
+_REGISTRY["HannWindow"] = _cosine_window("HannWindow", (0.5, 0.5))
+_REGISTRY["HammingWindow"] = _cosine_window(
+    "HammingWindow", (25.0 / 46.0, 21.0 / 46.0))
+_REGISTRY["BlackmanWindow"] = _cosine_window(
+    "BlackmanWindow", (0.42, 0.5, 0.08))
+
+
 @op("MelWeightMatrix")
 def _mel_weight_matrix(ctx, num_mel_bins, dft_length, sample_rate,
                        lower_edge_hertz, upper_edge_hertz):
@@ -1783,6 +1894,7 @@ _REGISTRY["ReduceProd"] = _reduce(jnp.prod)
 _REGISTRY["ReduceL1"] = _reduce(lambda x, axis, keepdims: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims))
 _REGISTRY["ReduceL2"] = _reduce(lambda x, axis, keepdims: jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims)))
 _REGISTRY["ReduceLogSumExp"] = _reduce(lambda x, axis, keepdims: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims))
+_REGISTRY["ReduceLogSum"] = _reduce(lambda x, axis, keepdims: jnp.log(jnp.sum(x, axis=axis, keepdims=keepdims)))
 _REGISTRY["ReduceSumSquare"] = _reduce(lambda x, axis, keepdims: jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
 
 
@@ -2843,6 +2955,47 @@ def _roi_align(ctx, x, rois, batch_indices):
     return jax.vmap(one_roi)(rois, bidx)                    # [R, C, oh, ow]
 
 
+@op("MaxRoiPool")
+def _max_roi_pool(ctx, x, rois):
+    """MaxRoiPool (the Caffe/Fast-RCNN ROIPooling): hard-quantized roi
+    bins, max-pooled. Rectangular bins make the 2-D max separable, so
+    the lowering is two masked per-axis maxes (no [R,C,ph,pw,H,W]
+    blow-up); empty bins emit 0 as the Caffe semantics require."""
+    ph, pw = [int(v) for v in ctx.attr("pooled_shape")]
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    x = jnp.asarray(x, jnp.float32)
+    rois = jnp.asarray(rois, jnp.float32)
+    H, W = x.shape[2], x.shape[3]
+    bidx = jnp.round(rois[:, 0]).astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1] * scale)
+    y1 = jnp.round(rois[:, 2] * scale)
+    x2 = jnp.round(rois[:, 3] * scale)
+    y2 = jnp.round(rois[:, 4] * scale)
+    roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+
+    def axis_masks(lo, extent, n_bins, size):
+        # [R, n_bins, size] membership of each pixel in each quantized bin
+        b = jnp.arange(n_bins, dtype=jnp.float32)
+        bin_sz = (extent / n_bins)[:, None]
+        start = jnp.clip(jnp.floor(b[None, :] * bin_sz) + lo[:, None],
+                         0, size)
+        end = jnp.clip(jnp.ceil((b[None, :] + 1) * bin_sz) + lo[:, None],
+                       0, size)
+        pix = jnp.arange(size, dtype=jnp.float32)
+        return ((pix[None, None, :] >= start[..., None])
+                & (pix[None, None, :] < end[..., None]))
+
+    mh = axis_masks(y1, roi_h, ph, H)                       # [R, ph, H]
+    mw = axis_masks(x1, roi_w, pw, W)                       # [R, pw, W]
+    fmap = x[bidx]                                          # [R, C, H, W]
+    t = jnp.where(mh[:, None, :, :, None], fmap[:, :, None, :, :],
+                  -jnp.inf).max(axis=3)                     # [R, C, ph, W]
+    out = jnp.where(mw[:, None, None, :, :], t[:, :, :, None, :],
+                    -jnp.inf).max(axis=4)                   # [R, C, ph, pw]
+    return jnp.where(jnp.isneginf(out), 0.0, out)
+
+
 # ---------------------------------------------------------------------------
 # Graph import
 # ---------------------------------------------------------------------------
@@ -2883,6 +3036,8 @@ class ImportedGraph:
             # every MelWeightMatrix input is filterbank GEOMETRY (incl.
             # the float hz edges); STFT's step/length are frame geometry
             "MelWeightMatrix": (0, 1, 2, 3, 4), "STFT": (1, 3),
+            "HannWindow": (0,), "HammingWindow": (0,),
+            "BlackmanWindow": (0,), "MaxUnpool": (2,),
             # NMS capacity + thresholds select the compiled program's
             # shape/constants (incl. the float iou/score thresholds)
             "NonMaxSuppression": (2, 3, 4),

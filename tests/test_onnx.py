@@ -3063,3 +3063,198 @@ def test_nms_through_onnx_model_requires_batch_alignment():
     np.testing.assert_array_equal(r0, [[0, 0, 3], [0, 0, 0], [0, 0, 5]])
     np.testing.assert_array_equal(r1[:, 2], r0[:, 2])  # same picks
     assert (r1[:, 0] == 1).all()                       # its own batch
+
+
+# ---------------------------------------------------------------------------
+# Opset-completion batch 1: windows, MaxPool indices/MaxUnpool, MaxRoiPool,
+# deprecated aliases, leftovers of the elementwise/reduce families
+# ---------------------------------------------------------------------------
+
+def _spec_cosine_window(name, n, periodic):
+    big_n = n if periodic else n - 1
+    k = 2 * np.pi * np.arange(n) / max(big_n, 1)
+    if name == "HannWindow":
+        return 0.5 - 0.5 * np.cos(k)
+    if name == "HammingWindow":  # ONNX uses 25/46, NOT torch's 0.54
+        return 25.0 / 46.0 - 21.0 / 46.0 * np.cos(k)
+    return 0.42 - 0.5 * np.cos(k) + 0.08 * np.cos(2 * k)
+
+
+@pytest.mark.parametrize("name", ["HannWindow", "HammingWindow",
+                                  "BlackmanWindow"])
+@pytest.mark.parametrize("periodic", [0, 1])
+def test_cosine_windows_match_spec(name, periodic):
+    g = GraphBuilder(opset=17)
+    s = g.add_initializer("size", np.asarray(16, np.int64))
+    out = g.add_node(name, [s], periodic=periodic)
+    g.add_output(out, np.float32, [16])
+    m = import_model(g.to_bytes())
+    got = np.asarray(m.apply(m.params)[0]).reshape(-1)
+    np.testing.assert_allclose(
+        got, _spec_cosine_window(name, 16, periodic), atol=1e-6)
+    # Hann cross-check against torch (whose hamming coefficients differ
+    # from the ONNX spec, so only hann/blackman have a torch oracle)
+    if name == "HannWindow":
+        np.testing.assert_allclose(
+            got, torch.hann_window(16, periodic=bool(periodic)).numpy(),
+            atol=1e-6)
+
+
+def test_hann_window_feeds_stft():
+    """Window op composed into STFT — the exported torch.stft pattern
+    (window built in-graph, not shipped as an initializer)."""
+    sig = np.random.default_rng(5).normal(
+        size=(1, 256)).astype(np.float32)
+    g = GraphBuilder(opset=17)
+    s_in = g.add_input("signal", np.float32, [1, 256])
+    size_i = g.add_initializer("wsize", np.asarray(64, np.int64))
+    win = g.add_node("HannWindow", [size_i])
+    step_i = g.add_initializer("step", np.asarray(32, np.int64))
+    y = g.add_node("STFT", [s_in, step_i, win], onesided=1)
+    g.add_output(y, np.float32, None)
+    m = import_model(g.to_bytes())
+    got = np.asarray(m.apply(m.params, sig)[0])
+    want_c = torch.stft(
+        torch.from_numpy(sig), n_fft=64, hop_length=32, win_length=64,
+        window=torch.hann_window(64), center=False, onesided=True,
+        return_complex=True).numpy()
+    want = np.stack([want_c.real, want_c.imag], -1).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_maxpool_indices_and_maxunpool_match_torch():
+    """MaxPool's Indices output + MaxUnpool (the SegNet encoder/decoder
+    pair) against torch's max_pool2d(return_indices)/max_unpool2d."""
+    xs = np.random.default_rng(0).normal(
+        size=(2, 3, 8, 10)).astype(np.float32)
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, list(xs.shape))
+    y, i = g.add_node("MaxPool", [x], outputs=["y", "i"],
+                      kernel_shape=[2, 3], strides=[2, 2],
+                      pads=[0, 1, 0, 1])
+    oshape = g.add_initializer("oshape", np.array([2, 3, 8, 10], np.int64))
+    u = g.add_node("MaxUnpool", [y, i, oshape], kernel_shape=[2, 3],
+                   strides=[2, 2], pads=[0, 1, 0, 1])
+    for nm in (y, i, u):
+        g.add_output(nm, np.float32, None)
+    m = import_model(g.to_bytes())
+    gy, gi, gu = [np.asarray(v) for v in m.apply(m.params, xs)]
+    ty, ti = torch.nn.functional.max_pool2d(
+        torch.from_numpy(xs), (2, 3), (2, 2), (0, 1),
+        return_indices=True)
+    tu = torch.nn.functional.max_unpool2d(
+        ty, ti, (2, 3), (2, 2), (0, 1), output_size=(8, 10))
+    np.testing.assert_allclose(gy, ty.numpy())
+    # torch flattens per-(N,C) plane; ONNX over the whole tensor
+    nc_off = np.arange(2 * 3).reshape(2, 3, 1, 1) * (8 * 10)
+    np.testing.assert_array_equal(gi, ti.numpy() + nc_off)
+    np.testing.assert_allclose(gu, tu.numpy())
+
+
+def test_maxunpool_inferred_shape_and_1d():
+    xs = np.random.default_rng(3).normal(
+        size=(2, 3, 8, 8)).astype(np.float32)
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, [2, 3, 8, 8])
+    y, i = g.add_node("MaxPool", [x], outputs=["y", "i"],
+                      kernel_shape=[2, 2], strides=[2, 2])
+    u = g.add_node("MaxUnpool", [y, i], kernel_shape=[2, 2],
+                   strides=[2, 2])
+    g.add_output(u, np.float32, None)
+    m = import_model(g.to_bytes())
+    gu = np.asarray(m.apply(m.params, xs)[0])
+    ty, ti = torch.nn.functional.max_pool2d(
+        torch.from_numpy(xs), 2, 2, return_indices=True)
+    tu = torch.nn.functional.max_unpool2d(ty, ti, 2, 2)
+    np.testing.assert_allclose(gu, tu.numpy())
+
+    # 1-D: rank-generic path
+    xs1 = np.random.default_rng(4).normal(size=(1, 2, 9)).astype(np.float32)
+    g1 = GraphBuilder(opset=17)
+    x1 = g1.add_input("x", np.float32, [1, 2, 9])
+    y1, i1 = g1.add_node("MaxPool", [x1], outputs=["y1", "i1"],
+                         kernel_shape=[3], strides=[3])
+    u1 = g1.add_node("MaxUnpool", [y1, i1], kernel_shape=[3], strides=[3])
+    g1.add_output(u1, np.float32, None)
+    m1 = import_model(g1.to_bytes())
+    gu1 = np.asarray(m1.apply(m1.params, xs1)[0])
+    ty1, ti1 = torch.nn.functional.max_pool1d(
+        torch.from_numpy(xs1), 3, 3, return_indices=True)
+    tu1 = torch.nn.functional.max_unpool1d(ty1, ti1, 3, 3)
+    np.testing.assert_allclose(gu1, tu1.numpy())
+
+
+def test_max_roi_pool_matches_quantized_reference():
+    """MaxRoiPool (Caffe ROIPooling quantization) against a literal
+    per-bin numpy evaluation of the spec."""
+    xs = np.random.default_rng(1).normal(
+        size=(2, 3, 12, 14)).astype(np.float32)
+    rois = np.array([[0, 0, 0, 11, 11], [1, 2, 2, 7, 9],
+                     [0, 4, 1, 13, 10]], np.float32)
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, list(xs.shape))
+    r = g.add_input("r", np.float32, list(rois.shape))
+    o = g.add_node("MaxRoiPool", [x, r], pooled_shape=[3, 4],
+                   spatial_scale=0.5)
+    g.add_output(o, np.float32, None)
+    m = import_model(g.to_bytes())
+    got = np.asarray(m.apply(m.params, xs, rois)[0])
+
+    ph, pw, scale = 3, 4, 0.5
+    want = np.zeros((len(rois), xs.shape[1], ph, pw), np.float32)
+    height, width = xs.shape[2:]
+    for ri, roi in enumerate(rois):
+        b = int(round(roi[0]))
+        x1, y1, x2, y2 = [int(round(v * scale)) for v in roi[1:]]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        for p in range(ph):
+            hs = min(max(int(np.floor(p * rh / ph)) + y1, 0), height)
+            he = min(max(int(np.ceil((p + 1) * rh / ph)) + y1, 0), height)
+            for q in range(pw):
+                ws = min(max(int(np.floor(q * rw / pw)) + x1, 0), width)
+                we = min(max(int(np.ceil((q + 1) * rw / pw)) + x1, 0),
+                         width)
+                if he > hs and we > ws:
+                    want[ri, :, p, q] = xs[b, :, hs:he, ws:we].max((1, 2))
+    np.testing.assert_allclose(got, want)
+
+
+def test_opset_leftovers_elementwise_and_aliases():
+    """Asinh/Acosh/Atanh/Det/ReduceLogSum/Affine + the deprecated
+    Scatter alias — the long tail that completes the default-domain
+    opset table."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, [2, 3, 3])
+    outs = [g.add_node("Asinh", [x]), g.add_node("Acosh", [x]),
+            g.add_node("Atanh", [x]),
+            g.add_node("Det", [x]),
+            g.add_node("ReduceLogSum", [x], axes=[1], keepdims=0),
+            g.add_node("Affine", [x], alpha=2.0, beta=0.5)]
+    for nm in outs:
+        g.add_output(nm, np.float32, None)
+    m = import_model(g.to_bytes())
+    xv = (np.random.default_rng(2).random((2, 3, 3)) * 0.2
+          + 1.2).astype(np.float32)  # >1 so acosh is defined
+    asinh_v, acosh_v, atanh_v, det_v, rls_v, aff_v = [
+        np.asarray(v) for v in m.apply(m.params, xv)]
+    np.testing.assert_allclose(asinh_v, np.arcsinh(xv), atol=1e-5)
+    np.testing.assert_allclose(acosh_v, np.arccosh(xv), atol=1e-5)
+    # atanh needs |x|<1
+    np.testing.assert_allclose(
+        np.asarray(m.apply(m.params, xv - 1.0)[2]),
+        np.arctanh(xv - 1.0), atol=1e-5)
+    np.testing.assert_allclose(det_v, np.linalg.det(xv), atol=1e-4)
+    np.testing.assert_allclose(rls_v, np.log(xv.sum(1)), atol=1e-5)
+    np.testing.assert_allclose(aff_v, 2 * xv + 0.5, atol=1e-6)
+
+    g2 = GraphBuilder(opset=9)
+    x2 = g2.add_input("x", np.float32, [3, 3])
+    ii = g2.add_initializer("ii", np.array([[0, 1, 2]], np.int64))
+    uu = g2.add_initializer("uu", np.array([[9., 8., 7.]], np.float32))
+    s = g2.add_node("Scatter", [x2, ii, uu], axis=0)
+    g2.add_output(s, np.float32, None)
+    m2 = import_model(g2.to_bytes())
+    got = np.asarray(m2.apply(m2.params, np.zeros((3, 3), np.float32))[0])
+    want = np.zeros((3, 3), np.float32)
+    want[0, 0], want[1, 1], want[2, 2] = 9, 8, 7
+    np.testing.assert_allclose(got, want)
