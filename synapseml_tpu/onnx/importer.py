@@ -669,6 +669,102 @@ def _conv_transpose(ctx, x, w, b=None):
     return y
 
 
+@op("DeformConv")
+def _deform_conv(ctx, x, w, offset, b=None, mask=None):
+    """DeformConv (opset 19, torchvision deform_conv2d semantics):
+    per-output-pixel learned sampling offsets, bilinear interpolation
+    with zero padding, optional modulation mask (v2). Lowered as one
+    batched 4-corner gather over [N, C, kH*kW, oH*oW] plus a grouped
+    einsum — all static shapes, MXU-contractable."""
+    if x.ndim != 4:
+        raise NotImplementedError("DeformConv supports 2-D (NCHW) only")
+    x = jnp.asarray(x, jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
+    offset = jnp.asarray(offset, jnp.float32)
+    n, c, h, wd = x.shape
+    oc, cg_w, kh, kw = w.shape
+    strides = [int(v) for v in ctx.attr("strides", [1, 1])]
+    dil = [int(v) for v in ctx.attr("dilations", [1, 1])]
+    pads = [int(v) for v in ctx.attr("pads", [0, 0, 0, 0])]
+    group = int(ctx.attr("group", 1))
+    og = int(ctx.attr("offset_group", 1))
+    oh, ow = offset.shape[2], offset.shape[3]
+    k = kh * kw
+    p = oh * ow
+    cg = c // og
+
+    # base sampling grid [k, p] then + offsets -> [N, og, k, p]
+    ker_y = (np.arange(kh)[:, None] * dil[0]).repeat(kw, 1).reshape(-1)
+    ker_x = np.tile(np.arange(kw) * dil[1], kh)
+    byx = np.stack([  # [2, k, p]
+        ker_y[:, None] + (np.arange(oh) * strides[0]
+                          - pads[0]).repeat(ow)[None, :],
+        ker_x[:, None] + np.tile(np.arange(ow) * strides[1]
+                                 - pads[1], oh)[None, :]])
+    off = offset.reshape(n, og, k, 2, p)  # [..., (dy, dx), ...]
+    py = byx[0][None, None] + off[:, :, :, 0]          # [N, og, k, p]
+    px = byx[1][None, None] + off[:, :, :, 1]
+
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    fy, fx = py - y0, px - x0
+    x_r = x.reshape(n, og, cg, h * wd)
+
+    def corner(yy, xx):
+        valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0)
+                 & (xx <= wd - 1))
+        idx = (jnp.clip(yy, 0, h - 1).astype(jnp.int32) * wd
+               + jnp.clip(xx, 0, wd - 1).astype(jnp.int32))
+        g = jnp.take_along_axis(
+            x_r, idx.reshape(n, og, 1, k * p), axis=3
+        ).reshape(n, og, cg, k, p)
+        return g * valid[:, :, None].astype(x.dtype).reshape(
+            n, og, 1, k, p)
+
+    samp = (corner(y0, x0) * ((1 - fy) * (1 - fx))[:, :, None]
+            + corner(y0, x0 + 1) * ((1 - fy) * fx)[:, :, None]
+            + corner(y0 + 1, x0) * (fy * (1 - fx))[:, :, None]
+            + corner(y0 + 1, x0 + 1) * (fy * fx)[:, :, None])
+    if mask is not None:
+        samp = samp * jnp.asarray(mask, jnp.float32).reshape(
+            n, og, 1, k, p)
+    # grouped contraction: [N, g, C/g, k, p] x [g, oC/g, C/g, k]
+    samp = samp.reshape(n, group, c // group, k, p)
+    w_g = w.reshape(group, oc // group, cg_w, k)
+    out = jnp.einsum("ngckp,gock->ngop", samp, w_g,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(n, oc, oh, ow)
+    if b is not None:
+        out = out + jnp.asarray(b, jnp.float32)[None, :, None, None]
+    return out
+
+
+@op("ImageDecoder")
+def _image_decoder(ctx, encoded):
+    """ImageDecoder (opset 20): host-side decode of an encoded image
+    byte stream to [H, W, C] uint8 via PIL (shared with
+    synapseml_tpu.image.reader). Decoding is inherently host work —
+    a traced byte tensor is rejected loudly."""
+    if not _is_host(encoded):
+        raise NotImplementedError(
+            "ImageDecoder needs host bytes: image decoding cannot run "
+            "under jit — decode ahead of the graph or feed host values")
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover - PIL baked into image
+        raise NotImplementedError(
+            "ImageDecoder requires PIL for this codec") from e
+    data = np.asarray(encoded, np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(data))
+    fmt = str(ctx.attr("pixel_format", "RGB"))
+    if fmt == "Grayscale":
+        return np.asarray(img.convert("L"), np.uint8)[:, :, None]
+    rgb = np.asarray(img.convert("RGB"), np.uint8)
+    return rgb[:, :, ::-1] if fmt == "BGR" else rgb
+
+
 @op("MaxPool")
 def _max_pool(ctx, x):
     rank = x.ndim - 2
@@ -928,7 +1024,7 @@ def _lower_nodes(nodes, opset: int):
             ctx.attrs["__lowered__"] = (
                 _Subgraph(ctx.attr("then_branch"), opset),
                 _Subgraph(ctx.attr("else_branch"), opset))
-        elif node.op_type in ("Loop", "Scan"):
+        elif node.op_type in ("Loop", "Scan", "SequenceMap"):
             ctx.attrs["__lowered_body__"] = _Subgraph(ctx.attr("body"),
                                                       opset)
         lowered.append((impl, ctx, list(node.input), list(node.output)))
@@ -2676,6 +2772,119 @@ def _split_to_sequence(ctx, x, split=None):
     if _is_host(x):
         return list(np.split(x, bounds, axis=axis))
     return jnp.split(x, bounds, axis=axis)
+
+
+@op("SequenceMap")
+def _sequence_map(ctx, seq, *extra, env=None):
+    """SequenceMap: run the body subgraph once per sequence element.
+    Sequences are static-length python lists here (see the sequence-op
+    section header), so the map is a host loop whose per-element bodies
+    trace into one jax program — additional tensor inputs broadcast,
+    additional sequence inputs zip elementwise, per spec."""
+    body = ctx.attrs["__lowered_body__"]  # lowered at import time
+    n_out = len(body.output_names)
+    outs: List[List[Any]] = [[] for _ in range(n_out)]
+    for i in range(len(seq)):
+        sub_env = dict(env or {})
+        vals = [seq[i]] + [e[i] if isinstance(e, list) else e
+                           for e in extra]
+        for nm, v in zip(body.input_names, vals):
+            sub_env[nm] = v
+        for acc, r in zip(outs, body.run(sub_env)):
+            acc.append(r)
+    return tuple(outs) if n_out > 1 else outs[0]
+
+
+_sequence_map._needs_env = True
+
+
+# -- String ops (host-side: object-dtype arrays, the TfIdf/tokenizer
+#    preprocessing family sklearn/ORT text pipelines emit) ----------------
+
+def _host_strings(x, opname: str) -> np.ndarray:
+    if not _is_host(x):
+        raise NotImplementedError(
+            f"{opname} operates on host string tensors; string data "
+            "cannot be device-traced — feed it as a host input")
+    return np.asarray(x, dtype=object)
+
+
+@op("StringConcat")
+def _string_concat(ctx, a, b):
+    a = _host_strings(a, "StringConcat")
+    b = _host_strings(b, "StringConcat")
+    return np.frompyfunc(
+        lambda s, t: str(s) + str(t), 2, 1)(a, b).astype(object)
+
+
+@op("StringSplit")
+def _string_split(ctx, x):
+    """StringSplit (opset 20): ragged splits padded with "" to the max
+    token count (the spec's dense output), plus per-element counts."""
+    x = _host_strings(x, "StringSplit")
+    delim = ctx.attr("delimiter", None)
+    maxsplit = ctx.attr("maxsplit", None)
+    ms = -1 if maxsplit is None else int(maxsplit)
+    toks = []
+    for s in x.reshape(-1):
+        s = str(s)
+        if delim:  # explicit delimiter: empty strings between separators kept
+            toks.append(s.split(delim, ms) if ms >= 0 else s.split(delim))
+        else:      # whitespace mode: runs collapse, no empty tokens
+            toks.append(s.split(None, ms) if ms >= 0 else s.split())
+    width = max((len(t) for t in toks), default=0)
+    out = np.full((len(toks), width), "", dtype=object)
+    for i, t in enumerate(toks):
+        out[i, :len(t)] = t
+    counts = np.asarray([len(t) for t in toks], np.int64).reshape(x.shape)
+    return out.reshape(x.shape + (width,)), counts
+
+
+@op("StringNormalizer")
+def _string_normalizer(ctx, x):
+    """StringNormalizer (opset 10): stopword filtering + case folding on
+    a [C] or [1, C] string tensor; an all-filtered input yields the
+    spec's single empty string."""
+    x = _host_strings(x, "StringNormalizer")
+    two_d = x.ndim == 2
+    if two_d and x.shape[0] != 1:
+        raise ValueError(
+            f"StringNormalizer input must be [C] or [1, C], got {x.shape}")
+    flat = [str(s) for s in x.reshape(-1)]
+    action = str(ctx.attr("case_change_action", "NONE")).upper()
+    stop = ctx.attr("stopwords") or []
+    if stop:
+        if int(ctx.attr("is_case_sensitive", 0)):
+            stops = set(stop)
+            keep = [s for s in flat if s not in stops]
+        else:
+            lowered = {w.lower() for w in stop}
+            keep = [s for s in flat if s.lower() not in lowered]
+    else:
+        keep = flat
+    if action == "LOWER":
+        keep = [s.lower() for s in keep]
+    elif action == "UPPER":
+        keep = [s.upper() for s in keep]
+    if not keep:
+        keep = [""]
+    out = np.asarray(keep, dtype=object)
+    return out.reshape(1, -1) if two_d else out
+
+
+@op("RegexFullMatch")
+def _regex_full_match(ctx, x):
+    import re as _re
+
+    x = _host_strings(x, "RegexFullMatch")
+    pattern = ctx.attr("pattern")
+    if pattern is None:
+        raise ValueError("RegexFullMatch needs a pattern attribute")
+    # the spec prescribes RE2 syntax; python `re` accepts the shared
+    # common subset (RE2 extras like \p{...} raise a loud re.error)
+    rx = _re.compile(pattern)
+    return np.frompyfunc(
+        lambda s: rx.fullmatch(str(s)) is not None, 1, 1)(x).astype(bool)
 
 
 @op("GroupQueryAttention")

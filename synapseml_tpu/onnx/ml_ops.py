@@ -297,12 +297,148 @@ def _tree_regressor(ctx, x):
     return _post_transform(scores, str(ctx.attr("post_transform", "NONE")))
 
 
+# new-style TreeEnsemble (ai.onnx.ml opset 5) integer codes
+_V5_MODES = {0: "BRANCH_LEQ", 1: "BRANCH_LT", 2: "BRANCH_GTE",
+             3: "BRANCH_GT", 4: "BRANCH_EQ", 5: "BRANCH_NEQ"}
+_V5_POST = {0: "NONE", 1: "SOFTMAX", 2: "LOGISTIC", 3: "SOFTMAX_ZERO"}
+
+
+@op("TreeEnsemble")
+def _tree_ensemble_v5(ctx, x):
+    """ai.onnx.ml opset-5 TreeEnsemble (the regressor/classifier merger
+    that new converters emit). The compact encoding — internal nodes and
+    leaves in separate arrays, child pointers tagged by
+    nodes_trueleafs/falseleafs flags — is unrolled into the flat
+    (treeid, nodeid) form and reuses the GEMM-ified _TreeTables path, so
+    the lowering stays all-MXU."""
+    def build():
+        import types
+
+        a = ctx.attrs
+        roots = [int(r) for r in a["tree_roots"]]
+        modes = np.asarray(a["nodes_modes"]).reshape(-1)
+        splits = np.asarray(a["nodes_splits"], np.float64).reshape(-1)
+        feats = [int(v) for v in a["nodes_featureids"]]
+        tru = [int(v) for v in a["nodes_truenodeids"]]
+        fal = [int(v) for v in a["nodes_falsenodeids"]]
+        tru_leaf = [int(v) for v in a["nodes_trueleafs"]]
+        fal_leaf = [int(v) for v in a["nodes_falseleafs"]]
+        miss = a.get("nodes_missing_value_tracks_true") or []
+        leaf_tid = [int(v) for v in a["leaf_targetids"]]
+        leaf_w = np.asarray(a["leaf_weights"], np.float64).reshape(-1)
+        if any(int(m) == 6 for m in modes):
+            raise NotImplementedError(
+                "TreeEnsemble BRANCH_MEMBER (set membership via "
+                "membership_values) is not supported; re-export with "
+                "equality splits")
+        old: Dict[str, list] = {k: [] for k in (
+            "nodes_treeids", "nodes_nodeids", "nodes_modes",
+            "nodes_featureids", "nodes_values", "nodes_truenodeids",
+            "nodes_falsenodeids", "nodes_missing_value_tracks_true",
+            "target_treeids", "target_nodeids", "target_ids",
+            "target_weights")}
+
+        for t, root in enumerate(roots):
+            # explicit-stack unroll (deep unpruned trees must not hit
+            # Python's recursion limit at import); children patch their
+            # parent's child-pointer slot once their own id is assigned
+            nid = 0
+            stack = [(root, False, None, None)]
+            while stack:
+                idx, is_leaf, patch_pos, child_slot = stack.pop()
+                if patch_pos is not None:
+                    old[child_slot][patch_pos] = nid
+                old["nodes_treeids"].append(t)
+                old["nodes_nodeids"].append(nid)
+                if is_leaf:
+                    old["nodes_modes"].append("LEAF")
+                    old["nodes_featureids"].append(0)
+                    old["nodes_values"].append(0.0)
+                    old["nodes_missing_value_tracks_true"].append(0)
+                    old["nodes_truenodeids"].append(0)
+                    old["nodes_falsenodeids"].append(0)
+                    old["target_treeids"].append(t)
+                    old["target_nodeids"].append(nid)
+                    old["target_ids"].append(leaf_tid[idx])
+                    old["target_weights"].append(float(leaf_w[idx]))
+                else:
+                    old["nodes_modes"].append(_V5_MODES[int(modes[idx])])
+                    old["nodes_featureids"].append(feats[idx])
+                    old["nodes_values"].append(float(splits[idx]))
+                    old["nodes_missing_value_tracks_true"].append(
+                        int(miss[idx]) if idx < len(miss) else 0)
+                    pos = len(old["nodes_truenodeids"])
+                    old["nodes_truenodeids"].append(-1)  # patched above
+                    old["nodes_falsenodeids"].append(-1)
+                    stack.append((fal[idx], bool(fal_leaf[idx]), pos,
+                                  "nodes_falsenodeids"))
+                    stack.append((tru[idx], bool(tru_leaf[idx]), pos,
+                                  "nodes_truenodeids"))
+                nid += 1
+        n_out = int(ctx.attr("n_targets", 0)) or (max(leaf_tid) + 1)
+        return _TreeTables(
+            types.SimpleNamespace(attrs=old), "target", n_out)
+
+    tables = _cached(ctx, "__tables__", build)
+    agg = int(ctx.attr("aggregate_function", 1))
+    if agg == 0:
+        scores = tables.scores(x) / max(tables.n_trees, 1)
+    elif agg == 1:
+        scores = tables.scores(x)
+    else:
+        raise NotImplementedError(
+            f"TreeEnsemble aggregate_function={agg} (MIN/MAX) is not "
+            "supported; converters emit SUM/AVERAGE")
+    pt = int(ctx.attr("post_transform", 0))
+    if pt not in _V5_POST:
+        raise NotImplementedError(f"TreeEnsemble post_transform={pt}")
+    return _post_transform(scores, _V5_POST[pt])
+
+
 @op("ZipMap")
 def _zipmap(ctx, probs):
     # seq<map<label, score>> lowered to the dense tensor: the reference
     # flattens the maps back into a vector column immediately
     # (ONNXModel.scala:156-171,255-263), so downstream semantics match.
     return probs
+
+
+@op("CastMap")
+def _cast_map(ctx, x):
+    """CastMap: map<int64, T> -> tensor. Two runtime forms arrive here:
+    a python dict (a genuine map value, e.g. from DictVectorizer-style
+    feeds) gets densified per map_form/max_map; the ZipMap lowering's
+    dense vector (see _zipmap) just casts — the reference's scala side
+    does the same flatten-then-cast (ONNXModel.scala:156-171)."""
+    cast_to = str(ctx.attr("cast_to", "TO_FLOAT"))
+    if isinstance(x, dict):
+        keys = sorted(int(k) for k in x)
+        if str(ctx.attr("map_form", "DENSE")) == "DENSE":
+            arr = np.asarray([x[k] for k in keys])
+        else:
+            max_map = int(ctx.attr("max_map", 0))
+            arr = np.zeros(max_map)
+            for k in keys:
+                if 0 <= k < max_map:
+                    arr[k] = x[k]
+        arr = arr.reshape(1, -1)  # spec output is [1, C] per map
+    else:
+        arr = np.asarray(x) if _is_host(x) else x
+    if cast_to == "TO_FLOAT":
+        return (np.asarray(arr, np.float32) if _is_host(arr)
+                else arr.astype(jnp.float32))
+    if cast_to == "TO_INT64":
+        return (np.asarray(arr, np.int64) if _is_host(arr)
+                else arr.astype(jnp.int64))
+    if cast_to == "TO_STRING":
+        if not _is_host(arr):
+            raise NotImplementedError(
+                "CastMap TO_STRING needs host values (strings cannot be "
+                "device-traced)")
+        return np.asarray([str(v) for v in
+                           np.asarray(arr).reshape(-1)],
+                          dtype=object).reshape(np.shape(arr))
+    raise ValueError(f"CastMap cast_to {cast_to!r}")
 
 
 @op("Scaler")

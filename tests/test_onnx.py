@@ -3258,3 +3258,171 @@ def test_opset_leftovers_elementwise_and_aliases():
     want = np.zeros((3, 3), np.float32)
     want[0, 0], want[1, 1], want[2, 2] = 9, 8, 7
     np.testing.assert_allclose(got, want)
+
+
+# ---------------------------------------------------------------------------
+# Opset-completion batch 2: string ops, SequenceMap, DeformConv, ImageDecoder
+# ---------------------------------------------------------------------------
+
+def test_string_ops_concat_split_normalize_regex():
+    g = GraphBuilder(opset=20)
+    a = g.add_initializer(
+        "a", np.asarray(["foo", "bar baz qux", ""], object))
+    b = g.add_initializer("b", np.asarray(["_x", "_y", "_z"], object))
+    c = g.add_node("StringConcat", [a, b])
+    s, n = g.add_node("StringSplit", [a], outputs=["s", "n"])
+    norm = g.add_node("StringNormalizer", [a],
+                      case_change_action="UPPER", stopwords=["foo"],
+                      is_case_sensitive=1)
+    rx = g.add_node("RegexFullMatch", [a], pattern=r"\w+")
+    for nm in (c, s, n, norm, rx):
+        g.add_output(nm, np.float32, None)
+    m = import_model(g.to_bytes())
+    cv, sv, nv, normv, rxv = m.apply(m.params)
+    assert list(cv) == ["foo_x", "bar baz qux_y", "_z"]
+    assert sv.shape == (3, 3)
+    assert list(sv[1]) == ["bar", "baz", "qux"]
+    assert list(sv[0]) == ["foo", "", ""]       # "" padding
+    assert list(nv) == [1, 3, 0]                # whitespace-mode counts
+    # "foo" is a stopword (elementwise match), remainder uppercased
+    assert list(normv) == ["BAR BAZ QUX", ""]
+    assert list(rxv) == [True, False, False]    # fullmatch, not search
+
+    # delimiter + maxsplit form
+    g2 = GraphBuilder(opset=20)
+    a2 = g2.add_initializer("a", np.asarray(["a,b,c,d", "x,,y"], object))
+    s2, n2 = g2.add_node("StringSplit", [a2], outputs=["s2", "n2"],
+                         delimiter=",", maxsplit=2)
+    g2.add_output(s2, np.float32, None)
+    g2.add_output(n2, np.int64, None)
+    m2 = import_model(g2.to_bytes())
+    sv2, nv2 = m2.apply(m2.params)
+    assert list(sv2[0]) == ["a", "b", "c,d"]
+    assert list(sv2[1]) == ["x", "", "y"]       # empties kept with delim
+    assert list(nv2) == [3, 3]
+
+    # all-stopword input collapses to the spec's single empty string
+    g3 = GraphBuilder(opset=20)
+    a3 = g3.add_initializer("a", np.asarray([["Stop", "STOP"]], object))
+    n3 = g3.add_node("StringNormalizer", [a3], stopwords=["stop"],
+                     is_case_sensitive=0, case_change_action="LOWER")
+    g3.add_output(n3, np.float32, None)
+    m3 = import_model(g3.to_bytes())
+    out3 = m3.apply(m3.params)[0]
+    assert out3.shape == (1, 1) and out3[0, 0] == ""
+
+
+def test_sequence_map_body_over_sequence():
+    """SequenceMap: body runs per element; tensor extras broadcast,
+    sequence extras zip."""
+    body = GraphBuilder(name="body", opset=17, name_prefix="b_")
+    e = body.add_input("e", None)
+    t = body.add_input("t", None)
+    o = body.add_node("Add", [e, t], outputs=["b_out"])
+    body.add_output(o, np.float32, None)
+    g = GraphBuilder(opset=17)
+    x1 = g.add_initializer("x1", np.asarray([1., 2.], np.float32))
+    x2 = g.add_initializer("x2", np.asarray([10., 20., 30.], np.float32))
+    extra = g.add_initializer("extra", np.asarray([100.], np.float32))
+    seq = g.add_node("SequenceConstruct", [x1, x2])
+    mapped = g.add_node("SequenceMap", [seq, extra],
+                        body=body.build().graph)
+    cc = g.add_node("ConcatFromSequence", [mapped], axis=0)
+    g.add_output(cc, np.float32, None)
+    m = import_model(g.to_bytes())
+    out = np.asarray(m.apply(m.params)[0])
+    np.testing.assert_allclose(out, [101, 102, 110, 120, 130])
+
+
+def test_deform_conv_matches_literal_reference():
+    """DeformConv (opset 19) vs a literal per-pixel numpy evaluation of
+    the torchvision-semantics spec: offsets, modulation mask, groups,
+    offset_groups, strides/pads/dilations all exercised."""
+    rng = np.random.default_rng(0)
+    n, c, h, wd, oc, kh, kw = 2, 4, 7, 8, 6, 3, 2
+    strides, pads, dil, group, og = [2, 1], [1, 0, 1, 0], [1, 1], 2, 2
+    oh = (h + pads[0] + pads[2] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (wd + pads[1] + pads[3] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+    x = rng.normal(size=(n, c, h, wd)).astype(np.float32)
+    w = rng.normal(size=(oc, c // group, kh, kw)).astype(np.float32)
+    off = (rng.normal(size=(n, og * 2 * kh * kw, oh, ow)) * 1.5
+           ).astype(np.float32)
+    msk = rng.random(size=(n, og * kh * kw, oh, ow)).astype(np.float32)
+    bias = rng.normal(size=(oc,)).astype(np.float32)
+
+    g = GraphBuilder(opset=19)
+    xi = g.add_input("x", np.float32, list(x.shape))
+    wi = g.add_initializer("w", w)
+    oi = g.add_input("off", np.float32, list(off.shape))
+    bi = g.add_initializer("b", bias)
+    mi = g.add_input("m", np.float32, list(msk.shape))
+    y = g.add_node("DeformConv", [xi, wi, oi, bi, mi], strides=strides,
+                   pads=pads, dilations=dil, group=group, offset_group=og,
+                   kernel_shape=[kh, kw])
+    g.add_output(y, np.float32, None)
+    m = import_model(g.to_bytes())
+    got = np.asarray(m.apply(m.params, x, off, msk)[0])
+
+    def bilinear(xc, py, px):
+        y0, x0 = int(np.floor(py)), int(np.floor(px))
+        fy, fx = py - y0, px - x0
+        v = 0.0
+        for yy, xx, wt in [(y0, x0, (1 - fy) * (1 - fx)),
+                           (y0, x0 + 1, (1 - fy) * fx),
+                           (y0 + 1, x0, fy * (1 - fx)),
+                           (y0 + 1, x0 + 1, fy * fx)]:
+            if 0 <= yy < h and 0 <= xx < wd:
+                v += wt * xc[yy, xx]
+        return v
+
+    want = np.zeros((n, oc, oh, ow))
+    cg = c // og
+    for ni in range(n):
+        for o in range(oc):
+            gi_ = o // (oc // group)
+            for ohh in range(oh):
+                for oww in range(ow):
+                    acc = 0.0
+                    for ci in range(c // group):
+                        cin = gi_ * (c // group) + ci
+                        gg = cin // cg
+                        for i in range(kh):
+                            for j in range(kw):
+                                kidx = i * kw + j
+                                dy = off[ni, (gg * kh * kw + kidx) * 2,
+                                         ohh, oww]
+                                dx = off[ni, (gg * kh * kw + kidx) * 2 + 1,
+                                         ohh, oww]
+                                py = (ohh * strides[0] - pads[0]
+                                      + i * dil[0] + dy)
+                                px = (oww * strides[1] - pads[1]
+                                      + j * dil[1] + dx)
+                                v = bilinear(x[ni, cin], py, px)
+                                v *= msk[ni, gg * kh * kw + kidx, ohh, oww]
+                                acc += w[o, ci, i, j] * v
+                    want[ni, o, ohh, oww] = acc + bias[o]
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_image_decoder_png_and_formats():
+    from PIL import Image
+    import io as _io
+
+    rng = np.random.default_rng(7)
+    arr = rng.integers(0, 255, size=(9, 11, 3)).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")
+    data = np.frombuffer(buf.getvalue(), np.uint8)
+    for fmt, want in [("RGB", arr), ("BGR", arr[:, :, ::-1]),
+                      ("Grayscale", None)]:
+        g = GraphBuilder(opset=20)
+        e = g.add_initializer("enc", data)
+        d = g.add_node("ImageDecoder", [e], pixel_format=fmt)
+        g.add_output(d, np.uint8, None)
+        m = import_model(g.to_bytes())
+        got = np.asarray(m.apply(m.params)[0])
+        if fmt == "Grayscale":
+            assert got.shape == (9, 11, 1)
+            want = np.asarray(
+                Image.fromarray(arr).convert("L"), np.uint8)[:, :, None]
+        np.testing.assert_array_equal(got, want)

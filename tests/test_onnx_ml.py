@@ -302,3 +302,106 @@ def test_tree_path_tensor_size_guard(monkeypatch):
     with pytest.raises(MemoryError, match="path tensor would allocate"):
         g = import_model(blob)
         g.apply(g.params, x[:8])
+
+
+def test_tree_ensemble_v5_matches_old_style():
+    """ai.onnx.ml opset-5 TreeEnsemble (compact leaf-array encoding)
+    against the sklearn-verified old-style TreeEnsembleRegressor on the
+    same two-tree ensemble, plus a hand-evaluated route check."""
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, [None, 2])
+    y = g.add_node(
+        "TreeEnsemble", [x], domain="ai.onnx.ml",
+        tree_roots=[0, 2],
+        # tree0: n0(f0<=0.5) -> leaf0 | n1(f1>2) -> leaf1|leaf2
+        # tree1: n2(f1<1.5) -> leaf3 | leaf4
+        nodes_modes=np.asarray([0, 3, 1], np.uint8),
+        nodes_featureids=[0, 1, 1],
+        nodes_splits=np.asarray([0.5, 2.0, 1.5], np.float32),
+        nodes_truenodeids=[0, 1, 3], nodes_trueleafs=[1, 1, 1],
+        nodes_falsenodeids=[1, 2, 4], nodes_falseleafs=[0, 1, 1],
+        leaf_targetids=[0, 0, 0, 0, 0],
+        leaf_weights=np.asarray([1.5, -1.0, 3.0, 0.25, -0.25],
+                                np.float32),
+        n_targets=1, aggregate_function=1, post_transform=0)
+    g.add_output(y, np.float32, None)
+    m = import_model(g.to_bytes())
+    xv = np.array([[0.3, 5.0], [0.9, 5.0], [0.9, 1.0]], np.float32)
+    got = np.asarray(m.apply(m.params, xv)).reshape(-1)
+    # routes: r0 leaf0+leaf4, r1 leaf1+leaf4, r2 leaf2+leaf3
+    np.testing.assert_allclose(got, [1.25, -1.25, 3.25], atol=1e-6)
+
+    g2 = GraphBuilder(opset=17)
+    x2 = g2.add_input("x", np.float32, [None, 2])
+    y2 = g2.add_node(
+        "TreeEnsembleRegressor", [x2], domain="ai.onnx.ml",
+        nodes_treeids=[0, 0, 0, 0, 0, 1, 1, 1],
+        nodes_nodeids=[0, 1, 2, 3, 4, 0, 1, 2],
+        nodes_modes=["BRANCH_LEQ", "LEAF", "BRANCH_GT", "LEAF", "LEAF",
+                     "BRANCH_LT", "LEAF", "LEAF"],
+        nodes_featureids=[0, 0, 1, 0, 0, 1, 0, 0],
+        nodes_values=[0.5, 0., 2.0, 0., 0., 1.5, 0., 0.],
+        nodes_truenodeids=[1, 0, 3, 0, 0, 1, 0, 0],
+        nodes_falsenodeids=[2, 0, 4, 0, 0, 2, 0, 0],
+        target_treeids=[0, 0, 0, 1, 1],
+        target_nodeids=[1, 3, 4, 1, 2],
+        target_ids=[0, 0, 0, 0, 0],
+        target_weights=[1.5, -1.0, 3.0, 0.25, -0.25], n_targets=1)
+    g2.add_output(y2, np.float32, None)
+    m2 = import_model(g2.to_bytes())
+    got2 = np.asarray(m2.apply(m2.params, xv)).reshape(-1)
+    np.testing.assert_allclose(got, got2, atol=1e-6)
+
+    # AVERAGE + LOGISTIC codes
+    g3 = GraphBuilder(opset=17)
+    x3 = g3.add_input("x", np.float32, [None, 2])
+    y3 = g3.add_node(
+        "TreeEnsemble", [x3], domain="ai.onnx.ml",
+        tree_roots=[0, 2],
+        nodes_modes=np.asarray([0, 3, 1], np.uint8),
+        nodes_featureids=[0, 1, 1],
+        nodes_splits=np.asarray([0.5, 2.0, 1.5], np.float32),
+        nodes_truenodeids=[0, 1, 3], nodes_trueleafs=[1, 1, 1],
+        nodes_falsenodeids=[1, 2, 4], nodes_falseleafs=[0, 1, 1],
+        leaf_targetids=[0, 0, 0, 0, 0],
+        leaf_weights=np.asarray([1.5, -1.0, 3.0, 0.25, -0.25],
+                                np.float32),
+        n_targets=1, aggregate_function=0, post_transform=2)
+    g3.add_output(y3, np.float32, None)
+    m3 = import_model(g3.to_bytes())
+    got3 = np.asarray(m3.apply(m3.params, xv)).reshape(-1)
+    np.testing.assert_allclose(
+        got3, 1.0 / (1.0 + np.exp(-got / 2.0)), atol=1e-6)
+
+
+def test_cast_map_dense_and_dict_forms():
+    """CastMap behind ZipMap (the sklearn-converter tail) plus the
+    genuine-map form with SPARSE densification."""
+    g = GraphBuilder(opset=17)
+    p = g.add_input("p", np.float32, [None, 3])
+    z = g.add_node("ZipMap", [p], domain="ai.onnx.ml",
+                   classlabels_int64s=[0, 1, 2])
+    cm = g.add_node("CastMap", [z], domain="ai.onnx.ml",
+                    cast_to="TO_FLOAT")
+    g.add_output(cm, np.float32, None)
+    m = import_model(g.to_bytes())
+    pv = np.array([[0.1, 0.7, 0.2]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(m.apply(m.params, pv)[0]), pv)
+
+    from synapseml_tpu.onnx.ml_ops import _cast_map
+
+    class _Ctx:
+        def __init__(self, **attrs):
+            self.attrs = attrs
+
+        def attr(self, k, d=None):
+            return self.attrs.get(k, d)
+
+    sparse = _cast_map(_Ctx(map_form="SPARSE", max_map=5,
+                            cast_to="TO_FLOAT"), {1: 2.0, 3: 4.0, 9: 9.0})
+    np.testing.assert_allclose(
+        np.asarray(sparse), [[0.0, 2.0, 0.0, 4.0, 0.0]])
+    dense = _cast_map(_Ctx(cast_to="TO_INT64"), {0: 7.0, 1: 8.0})
+    assert np.asarray(dense).dtype == np.int64
+    np.testing.assert_array_equal(np.asarray(dense), [[7, 8]])
