@@ -742,9 +742,11 @@ def _deform_conv(ctx, x, w, offset, b=None, mask=None):
 @op("ImageDecoder")
 def _image_decoder(ctx, encoded):
     """ImageDecoder (opset 20): host-side decode of an encoded image
-    byte stream to [H, W, C] uint8 via PIL (shared with
-    synapseml_tpu.image.reader). Decoding is inherently host work —
-    a traced byte tensor is rejected loudly."""
+    byte stream to [H, W, C] uint8 via PIL. Decoding is inherently host
+    work — a traced byte tensor is rejected loudly. (The column-level
+    image path lives in synapseml_tpu.image.reader; this op covers
+    in-graph decode nodes, whose pixel_format/channel contract differs
+    from the reader's BGR column layout.)"""
     if not _is_host(encoded):
         raise NotImplementedError(
             "ImageDecoder needs host bytes: image decoding cannot run "

@@ -3426,3 +3426,48 @@ def test_image_decoder_png_and_formats():
             want = np.asarray(
                 Image.fromarray(arr).convert("L"), np.uint8)[:, :, None]
         np.testing.assert_array_equal(got, want)
+
+
+def test_maxpool_indices_ceil_dilation_tiebreak():
+    """Indices path corners: ceil_mode, dilations, and the all-equal
+    tie-break (row-major first occurrence, onnxruntime's rule)."""
+    xs = np.random.default_rng(9).normal(
+        size=(1, 2, 9, 9)).astype(np.float32)
+
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, [1, 2, 9, 9])
+    y, i = g.add_node("MaxPool", [x], outputs=["y", "i"],
+                      kernel_shape=[2, 2], strides=[2, 2], ceil_mode=1)
+    g.add_output(y, np.float32, None)
+    g.add_output(i, np.int64, None)
+    m = import_model(g.to_bytes())
+    gy, gi = [np.asarray(v) for v in m.apply(m.params, xs)]
+    ty, ti = torch.nn.functional.max_pool2d(
+        torch.from_numpy(xs), 2, 2, ceil_mode=True, return_indices=True)
+    np.testing.assert_allclose(gy, ty.numpy())
+    nc_off = np.arange(2).reshape(1, 2, 1, 1) * 81
+    np.testing.assert_array_equal(gi, ti.numpy() + nc_off)
+
+    g2 = GraphBuilder(opset=17)
+    x2 = g2.add_input("x", np.float32, [1, 2, 9, 9])
+    y2, i2 = g2.add_node("MaxPool", [x2], outputs=["y2", "i2"],
+                         kernel_shape=[2, 2], dilations=[2, 2])
+    g2.add_output(y2, np.float32, None)
+    g2.add_output(i2, np.int64, None)
+    m2 = import_model(g2.to_bytes())
+    gy2, gi2 = [np.asarray(v) for v in m2.apply(m2.params, xs)]
+    ty2, ti2 = torch.nn.functional.max_pool2d(
+        torch.from_numpy(xs), 2, 1, dilation=2, return_indices=True)
+    np.testing.assert_allclose(gy2, ty2.numpy())
+    np.testing.assert_array_equal(gi2, ti2.numpy() + nc_off)
+
+    # all-equal window: the FIRST (row-major) position must win
+    ones = np.ones((1, 1, 4, 4), np.float32)
+    g3 = GraphBuilder(opset=17)
+    x3 = g3.add_input("x", np.float32, [1, 1, 4, 4])
+    y3, i3 = g3.add_node("MaxPool", [x3], outputs=["y3", "i3"],
+                         kernel_shape=[2, 2], strides=[2, 2])
+    g3.add_output(i3, np.int64, None)
+    m3 = import_model(g3.to_bytes())
+    gi3 = np.asarray(m3.apply(m3.params, ones)[0])
+    np.testing.assert_array_equal(gi3[0, 0], [[0, 2], [8, 10]])
