@@ -2983,6 +2983,230 @@ def _group_query_attention(ctx, query, key=None, value=None,
 
 
 # ---------------------------------------------------------------------------
+# com.microsoft transformer-fusion family — what onnxruntime's
+# transformer optimizer (fusion passes) rewrites BERT/GPT graphs into.
+# The reference scores such optimized exports through ORT unchanged
+# (ONNXModel.scala:173-193); here each fused node lowers to the same
+# jax it would have lowered to unfused, so XLA re-fuses on its own
+# terms (the fusion is a no-op semantically, load-bearing for ORT only).
+# ---------------------------------------------------------------------------
+
+@op("FusedMatMul")
+def _fused_matmul(ctx, a, b):
+    if int(ctx.attr("transBatchA", 0)) or int(ctx.attr("transBatchB", 0)):
+        raise NotImplementedError(
+            "FusedMatMul transBatchA/transBatchB (batch-axis folding) is "
+            "not supported; re-export without batch transpose")
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if int(ctx.attr("transA", 0)):
+        a = jnp.swapaxes(a, -1, -2)
+    if int(ctx.attr("transB", 0)):
+        b = jnp.swapaxes(b, -1, -2)
+    return float(ctx.attr("alpha", 1.0)) * jnp.matmul(a, b)
+
+
+@op("BiasGelu")
+def _bias_gelu(ctx, x, bias):
+    return jax.nn.gelu(jnp.asarray(x) + jnp.asarray(bias),
+                       approximate=False)
+
+
+@op("FastGelu")
+def _fast_gelu(ctx, x, bias=None):
+    x = jnp.asarray(x)
+    if bias is not None:
+        x = x + jnp.asarray(bias)
+    return jax.nn.gelu(x, approximate=True)
+
+
+@op("QuickGelu")
+def _quick_gelu(ctx, x):
+    x = jnp.asarray(x)
+    return x * jax.nn.sigmoid(float(ctx.attr("alpha", 1.702)) * x)
+
+
+def _rms_norm(x, scale, eps, axis):
+    x32 = jnp.asarray(x, jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=axis, keepdims=True)
+    inv = lax.rsqrt(ms + eps)
+    return (x32 * inv * jnp.asarray(scale, jnp.float32)).astype(
+        jnp.asarray(x).dtype), inv
+
+
+@op("SimplifiedLayerNormalization")
+def _simplified_layer_norm(ctx, x, scale):
+    """RMSNorm — ORT's name for it (LLaMA-family exports)."""
+    axis = int(ctx.attr("axis", -1)) % np.ndim(x)
+    y, inv = _rms_norm(x, scale, ctx.attr("epsilon", 1e-5),
+                       tuple(range(axis, np.ndim(x))))
+    return (y, inv)[: max(ctx.n_outputs, 1)] if ctx.n_outputs > 1 else y
+
+
+def _ln_affine(h, gamma, beta, eps):
+    """Shared f32-upcast layernorm core for the fusion family (the
+    contrib ops normalize in f32 regardless of input dtype, per ORT).
+    Returns (y, mean, inv_std) with y cast back to h's dtype."""
+    h32 = jnp.asarray(h, jnp.float32)
+    mean = jnp.mean(h32, axis=-1, keepdims=True)
+    var = jnp.var(h32, axis=-1, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    y = (h32 - mean) * inv * jnp.asarray(gamma, jnp.float32)
+    if beta is not None:
+        y = y + jnp.asarray(beta, jnp.float32)
+    return y.astype(jnp.asarray(h).dtype), mean, inv
+
+
+@op("SkipSimplifiedLayerNormalization")
+def _skip_simplified_layer_norm(ctx, x, skip, gamma, bias=None):
+    h = jnp.asarray(x) + jnp.asarray(skip)
+    if bias is not None:
+        h = h + jnp.asarray(bias)
+    y, inv = _rms_norm(h, gamma, ctx.attr("epsilon", 1e-5), -1)
+    if ctx.n_outputs > 1:
+        # slot 2 ("mean") is defined on the summed input even though the
+        # RMS normalization itself is mean-free — fill it so a graph
+        # naming it never sees a poisoned None
+        mean = jnp.mean(jnp.asarray(h, jnp.float32), -1, keepdims=True)
+        return (y, mean, inv, h)[: ctx.n_outputs]
+    return y
+
+
+@op("SkipLayerNormalization")
+def _skip_layer_norm(ctx, x, skip, gamma, beta=None, bias=None):
+    h = jnp.asarray(x) + jnp.asarray(skip)
+    if bias is not None:
+        h = h + jnp.asarray(bias)
+    y, mean, inv = _ln_affine(h, gamma, beta, ctx.attr("epsilon", 1e-5))
+    if ctx.n_outputs > 1:
+        return (y, mean, inv, h)[: ctx.n_outputs]
+    return y
+
+
+@op("EmbedLayerNormalization")
+def _embed_layer_norm(ctx, input_ids, segment_ids=None, word_emb=None,
+                      pos_emb=None, seg_emb=None, gamma=None, beta=None,
+                      mask=None, position_ids=None):
+    """com.microsoft EmbedLayerNormalization: the BERT front-end fusion
+    (word + position + segment gather, layernorm, mask length)."""
+    ids = jnp.asarray(input_ids).astype(jnp.int32)
+    b, s = ids.shape
+    emb = jnp.asarray(word_emb)[ids]
+    if position_ids is not None:
+        pos = jnp.asarray(position_ids).astype(jnp.int32)
+        pos = jnp.broadcast_to(pos.reshape(-1, s), (b, s))
+    else:
+        pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    emb = emb + jnp.asarray(pos_emb)[pos]
+    if seg_emb is not None:
+        if segment_ids is None:
+            raise ValueError(
+                "EmbedLayerNormalization has a segment embedding but no "
+                "segment_ids input")
+        emb = emb + jnp.asarray(seg_emb)[
+            jnp.asarray(segment_ids).astype(jnp.int32)]
+    y, _, _ = _ln_affine(emb, gamma, beta, ctx.attr("epsilon", 1e-12))
+    if mask is not None:
+        mask_index = jnp.sum(
+            jnp.asarray(mask).astype(jnp.int32), axis=1)
+    else:
+        mask_index = jnp.zeros((b,), jnp.int32)
+    if ctx.n_outputs > 2:
+        return y, mask_index, emb
+    return y, mask_index
+
+
+@op("Attention")
+def _contrib_attention(ctx, x, weights, bias=None, mask_index=None,
+                       past=None, attention_bias=None,
+                       past_sequence_length=None):
+    """com.microsoft Attention: the fused BERT self-attention block
+    (input projection + multi-head SDPA). Supported surface: equal
+    Q/K/V hidden sizes, raw [B] lengths or [B, T] / broadcastable 0/1
+    key masks, additive attention_bias, unidirectional (causal) mode,
+    and the stacked [2, B, N, P, D] past/present KV cache. Asymmetric
+    qkv_hidden_sizes and packed-KV pasts are rejected loudly."""
+    if weights is None or np.ndim(weights) != 2:
+        # the standard ai.onnx opset-23 Attention (separate Q/K/V
+        # tensors) shares this op_type but not this signature — keep
+        # the unsupported-op failure loud instead of a shape error
+        raise NotImplementedError(
+            "only the com.microsoft fused Attention (input + [H, 3H] "
+            "projection weights) is supported; the standard ai.onnx "
+            "opset-23 Attention op is not — re-export the attention "
+            "block as composed MatMul/Softmax ops or the contrib form")
+    num_heads = int(ctx.attr("num_heads", 0))
+    if num_heads <= 0:
+        raise ValueError("Attention needs the num_heads attribute")
+    sizes = ctx.attr("qkv_hidden_sizes")
+    if sizes and len(set(int(v) for v in sizes)) != 1:
+        raise NotImplementedError(
+            "Attention with asymmetric qkv_hidden_sizes is not "
+            "supported; re-export with equal Q/K/V widths")
+    if int(ctx.attr("past_present_share_buffer", 0)) \
+            or past_sequence_length is not None:
+        raise NotImplementedError(
+            "Attention with past_present_share_buffer (max-length cache "
+            "buffer + past_sequence_length) is not supported: the cached "
+            "length would be read from the buffer dimension and attend "
+            "uninitialized rows; re-export with a dense (unshared) past")
+    x = jnp.asarray(x)
+    b, s, _ = x.shape
+    w = jnp.asarray(weights)
+    hidden = w.shape[1] // 3
+    head = hidden // num_heads
+    qkv = jnp.matmul(x, w)
+    if bias is not None:
+        qkv = qkv + jnp.asarray(bias)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, num_heads, head).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)          # [B, N, S, D]
+    past_len = 0
+    if past is not None:
+        p = jnp.asarray(past)                       # [2, B, N, P, D]
+        past_len = p.shape[3]
+        k = jnp.concatenate([p[0].astype(k.dtype), k], axis=2)
+        v = jnp.concatenate([p[1].astype(v.dtype), v], axis=2)
+    t_kv = k.shape[2]
+    scale = ctx.attr("scale", 0.0) or 1.0 / math.sqrt(head)
+    logits = jnp.einsum("bnsd,bntd->bnst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if attention_bias is not None:
+        logits = logits + jnp.asarray(attention_bias, jnp.float32)
+    neg = jnp.float32(-1e9)  # ORT masks with a large negative, not -inf
+    if mask_index is not None:
+        m = jnp.asarray(mask_index)
+        if m.ndim == 1 and m.shape[0] != b:
+            raise NotImplementedError(
+                f"Attention 1-D mask_index has {m.shape[0]} entries for "
+                f"batch {b}: the (2*batch,) end/start left-padding format "
+                "is not supported; re-export with a [batch] lengths "
+                "vector or a [batch, seq] key mask")
+        if m.ndim == 1:                             # [B] valid-key lengths
+            key_ok = jnp.arange(t_kv)[None, :] < m.astype(
+                jnp.int32)[:, None]
+            logits = jnp.where(key_ok[:, None, None, :], logits, neg)
+        else:                                       # 0/1 key mask
+            # right-align onto [B, N, S, T]: [B,T] -> [B,1,1,T],
+            # [B,S,T] -> [B,1,S,T], 4-D passes through
+            m2 = m.reshape((b,) + (1,) * (4 - m.ndim) + m.shape[1:])
+            logits = jnp.where(
+                jnp.broadcast_to(m2, logits.shape) != 0, logits, neg)
+    if bool(ctx.attr("unidirectional", 0)):
+        q_pos = past_len + jnp.arange(s)[:, None]
+        causal = jnp.arange(t_kv)[None, :] <= q_pos
+        logits = jnp.where(causal[None, None], logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnst,bntd->bnsd", probs, v.astype(jnp.float32))
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, hidden).astype(x.dtype)
+    if ctx.n_outputs > 1:
+        return out, jnp.stack([k, v], axis=0)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Detection ops (SSD / YOLO / Faster-RCNN export families)
 # ---------------------------------------------------------------------------
 

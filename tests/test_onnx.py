@@ -3471,3 +3471,196 @@ def test_maxpool_indices_ceil_dilation_tiebreak():
     m3 = import_model(g3.to_bytes())
     gi3 = np.asarray(m3.apply(m3.params, ones)[0])
     np.testing.assert_array_equal(gi3[0, 0], [[0, 2], [8, 10]])
+
+
+# ---------------------------------------------------------------------------
+# com.microsoft transformer-fusion family (ORT transformer-optimizer output)
+# ---------------------------------------------------------------------------
+
+def _mk_attention_ref(x, w, bias, num_heads, lens=None, causal=False,
+                      past=None):
+    """Literal torch multi-head attention matching the contrib op."""
+    import math as _math
+
+    b, s, _ = x.shape
+    hidden = w.shape[1] // 3
+    d = hidden // num_heads
+    qkv = torch.tensor(x) @ torch.tensor(w) + torch.tensor(bias)
+    q, k, v = qkv.split(hidden, dim=-1)
+
+    def hd(t):
+        return t.reshape(b, s, num_heads, d).permute(0, 2, 1, 3)
+
+    q, k, v = hd(q), hd(k), hd(v)
+    past_len = 0
+    if past is not None:
+        pk, pv = torch.tensor(past[0]), torch.tensor(past[1])
+        past_len = pk.shape[2]
+        k = torch.cat([pk, k], dim=2)
+        v = torch.cat([pv, v], dim=2)
+    t_kv = k.shape[2]
+    logits = (q @ k.transpose(-1, -2)) / _math.sqrt(d)
+    if lens is not None:
+        ok = torch.arange(t_kv)[None, :] < torch.tensor(lens)[:, None]
+        logits = logits.masked_fill(~ok[:, None, None, :], -1e9)
+    if causal:
+        qp = past_len + torch.arange(s)[:, None]
+        cm = torch.arange(t_kv)[None, :] <= qp
+        logits = logits.masked_fill(~cm[None, None], -1e9)
+    out = torch.softmax(logits, -1) @ v
+    return out.permute(0, 2, 1, 3).reshape(b, s, hidden).numpy(), \
+        torch.stack([k, v]).numpy()
+
+
+def test_contrib_attention_masks_causal_and_past():
+    rng = np.random.default_rng(0)
+    b, s, h, n = 2, 5, 24, 3
+    x = rng.normal(size=(b, s, h)).astype(np.float32)
+    w = (rng.normal(size=(h, 3 * h)) * 0.3).astype(np.float32)
+    bias = rng.normal(size=(3 * h,)).astype(np.float32)
+    lens = np.array([5, 3], np.int32)
+
+    # [B] length mask
+    g = GraphBuilder(opset=17)
+    xi = g.add_input("x", np.float32, [b, s, h])
+    wi = g.add_initializer("w", w)
+    bi = g.add_initializer("b", bias)
+    mi = g.add_input("m", np.int32, [b])
+    att = g.add_node("Attention", [xi, wi, bi, mi],
+                     domain="com.microsoft", num_heads=n)
+    g.add_output(att, np.float32, None)
+    m = import_model(g.to_bytes())
+    got = np.asarray(m.apply(m.params, x, lens)[0])
+    want, _ = _mk_attention_ref(x, w, bias, n, lens=lens)
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+    # unidirectional + past KV cache, present output
+    p = 3
+    past = rng.normal(size=(2, b, n, p, h // n)).astype(np.float32)
+    g2 = GraphBuilder(opset=17)
+    xi2 = g2.add_input("x", np.float32, [b, s, h])
+    wi2 = g2.add_initializer("w", w)
+    bi2 = g2.add_initializer("b", bias)
+    pi2 = g2.add_input("past", np.float32, list(past.shape))
+    att2, pres = g2.add_node(
+        "Attention", [xi2, wi2, bi2, "", pi2], outputs=["att2", "pres"],
+        domain="com.microsoft", num_heads=n, unidirectional=1)
+    g2.add_output(att2, np.float32, None)
+    g2.add_output(pres, np.float32, None)
+    m2 = import_model(g2.to_bytes())
+    got2, pres2 = [np.asarray(v) for v in m2.apply(m2.params, x, past)]
+    want2, want_pres = _mk_attention_ref(x, w, bias, n, causal=True,
+                                         past=past)
+    np.testing.assert_allclose(got2, want2, atol=1e-4)
+    np.testing.assert_allclose(pres2, want_pres, atol=1e-5)
+
+    # [B, T] 0/1 key mask == the length mask it encodes
+    key_mask = (np.arange(s)[None] < lens[:, None]).astype(np.int32)
+    g3 = GraphBuilder(opset=17)
+    xi3 = g3.add_input("x", np.float32, [b, s, h])
+    mi3 = g3.add_input("m", np.int32, [b, s])
+    att3 = g3.add_node(
+        "Attention",
+        [xi3, g3.add_initializer("w", w), g3.add_initializer("b", bias),
+         mi3], domain="com.microsoft", num_heads=n)
+    g3.add_output(att3, np.float32, None)
+    m3 = import_model(g3.to_bytes())
+    got3 = np.asarray(m3.apply(m3.params, x, key_mask)[0])
+    np.testing.assert_allclose(got3, want, atol=1e-4)
+
+
+def test_fusion_family_matches_unfused_and_torch():
+    """Each ORT fusion op == its unfused composition (and torch where a
+    direct oracle exists), so optimizer-processed exports score
+    identically to raw ones."""
+    rng = np.random.default_rng(1)
+    b, s, h = 2, 4, 16
+    x = rng.normal(size=(b, s, h)).astype(np.float32)
+    skip = rng.normal(size=(b, s, h)).astype(np.float32)
+    gamma = rng.normal(size=(h,)).astype(np.float32)
+    beta = rng.normal(size=(h,)).astype(np.float32)
+    bias = rng.normal(size=(h,)).astype(np.float32)
+    w = rng.normal(size=(h, h)).astype(np.float32)
+
+    g = GraphBuilder(opset=17)
+    xi = g.add_input("x", np.float32, [b, s, h])
+    si = g.add_input("s", np.float32, [b, s, h])
+    names = {k: g.add_initializer(k, v) for k, v in
+             [("ga", gamma), ("be", beta), ("bi", bias), ("w", w)]}
+    outs = [
+        g.add_node("SkipLayerNormalization", [xi, si, names["ga"],
+                   names["be"], names["bi"]], domain="com.microsoft"),
+        g.add_node("SkipSimplifiedLayerNormalization",
+                   [xi, si, names["ga"]], domain="com.microsoft"),
+        g.add_node("BiasGelu", [xi, names["bi"]], domain="com.microsoft"),
+        g.add_node("FastGelu", [xi, names["bi"]], domain="com.microsoft"),
+        g.add_node("QuickGelu", [xi], domain="com.microsoft"),
+        g.add_node("FusedMatMul", [xi, names["w"]],
+                   domain="com.microsoft", alpha=0.5, transB=1),
+        g.add_node("SimplifiedLayerNormalization", [xi, names["ga"]],
+                   epsilon=1e-6),
+    ]
+    for nm in outs:
+        g.add_output(nm, np.float32, None)
+    m = import_model(g.to_bytes())
+    (sln, ssln, bg, fg, qg, fmm, rms) = [
+        np.asarray(v) for v in m.apply(m.params, x, skip)]
+
+    hsum = x + skip + bias
+    mu = hsum.mean(-1, keepdims=True)
+    va = hsum.var(-1, keepdims=True)
+    np.testing.assert_allclose(
+        sln, (hsum - mu) / np.sqrt(va + 1e-5) * gamma + beta, atol=1e-4)
+    h2 = x + skip
+    np.testing.assert_allclose(
+        ssln, h2 / np.sqrt((h2 ** 2).mean(-1, keepdims=True) + 1e-5)
+        * gamma, atol=1e-4)
+    np.testing.assert_allclose(
+        bg, torch.nn.functional.gelu(torch.tensor(x + bias)).numpy(),
+        atol=1e-4)
+    np.testing.assert_allclose(
+        fg, torch.nn.functional.gelu(torch.tensor(x + bias),
+                                     approximate="tanh").numpy(),
+        atol=1e-4)
+    np.testing.assert_allclose(
+        qg, (torch.tensor(x)
+             * torch.sigmoid(1.702 * torch.tensor(x))).numpy(), atol=1e-4)
+    np.testing.assert_allclose(fmm, 0.5 * (x @ w.T), atol=1e-4)
+    np.testing.assert_allclose(
+        rms, x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * gamma,
+        atol=1e-5)
+
+
+def test_embed_layer_normalization_bert_frontend():
+    rng = np.random.default_rng(2)
+    b, s, h, v, p = 2, 6, 16, 40, 12
+    word = rng.normal(size=(v, h)).astype(np.float32)
+    pos = rng.normal(size=(p, h)).astype(np.float32)
+    seg = rng.normal(size=(2, h)).astype(np.float32)
+    gamma = rng.normal(size=(h,)).astype(np.float32)
+    beta = rng.normal(size=(h,)).astype(np.float32)
+    ids = rng.integers(0, v, (b, s)).astype(np.int32)
+    sids = rng.integers(0, 2, (b, s)).astype(np.int32)
+    lens = np.array([6, 4], np.int32)
+    msk = (np.arange(s)[None] < lens[:, None]).astype(np.int32)
+
+    g = GraphBuilder(opset=17)
+    ii = g.add_input("ids", np.int32, [b, s])
+    si = g.add_input("sids", np.int32, [b, s])
+    mi = g.add_input("mask", np.int32, [b, s])
+    names = [g.add_initializer(n_, a_) for n_, a_ in
+             [("we", word), ("pe", pos), ("se", seg), ("ga", gamma),
+              ("bt", beta)]]
+    el, mx = g.add_node("EmbedLayerNormalization", [ii, si] + names + [mi],
+                        outputs=["el", "mx"], domain="com.microsoft",
+                        epsilon=1e-12)
+    g.add_output(el, np.float32, None)
+    g.add_output(mx, np.int32, None)
+    m = import_model(g.to_bytes())
+    gy, gm = [np.asarray(o) for o in m.apply(m.params, ids, sids, msk)]
+    emb = word[ids] + pos[np.arange(s)][None] + seg[sids]
+    mu = emb.mean(-1, keepdims=True)
+    va = emb.var(-1, keepdims=True)
+    np.testing.assert_allclose(
+        gy, (emb - mu) / np.sqrt(va + 1e-12) * gamma + beta, atol=1e-4)
+    np.testing.assert_array_equal(gm, lens)
