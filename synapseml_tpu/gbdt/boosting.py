@@ -59,6 +59,22 @@ class BoostParams:
     drop_rate: float = 0.1
     max_drop: int = 50
     skip_drop: float = 0.5
+    # uniform_drop=False drops trees with probability proportional to
+    # their current |weight| (lib_lightgbm dart.hpp DroppingTrees);
+    # True is plain Bernoulli(drop_rate) per tree
+    uniform_drop: bool = False
+    # xgboost_dart_mode normalizes a round that dropped kd trees with
+    # lr/(kd+lr) (xgboost's dart) instead of lr/(kd+1)
+    xgboost_dart_mode: bool = False
+    # None derives the bagging stream from `seed` (stream-stable with
+    # earlier versions); an int gives bagging its own stream, the
+    # reference's baggingSeed (LightGBMParams.scala)
+    bagging_seed: Optional[int] = None
+    # early stopping counts an iteration as improved only if the metric
+    # moved by more than this (reference improvementTolerance,
+    # TrainUtils.scala:129-141: larger-better improved iff m-best>tol,
+    # smaller-better iff m-best<tol)
+    improvement_tolerance: float = 0.0
     # multiclass
     num_class: int = 1
     sigmoid: float = 1.0
@@ -270,8 +286,9 @@ class _ValidTracker:
     def record(self, m: float, it: int) -> bool:
         """Record a precomputed metric value; True = stop early."""
         self.history[self.metric_name].append(m)
-        improved = (m > self.best_score if self.larger_better
-                    else m < self.best_score)
+        tol = self.p.improvement_tolerance
+        improved = (m - self.best_score > tol if self.larger_better
+                    else m - self.best_score < tol)
         if improved:
             self.best_score, self.best_iter = m, it
             return False
@@ -280,6 +297,36 @@ class _ValidTracker:
 
     def final_best_iter(self) -> int:
         return self.best_iter if self.p.early_stopping_round > 0 else -1
+
+
+def _dart_select(rng, t: int, cur_weights, p: BoostParams) -> np.ndarray:
+    """One DART iteration's drop set (host RNG, lib_lightgbm dart.hpp
+    DroppingTrees): skip_drop gates the whole round; uniform mode is
+    Bernoulli(drop_rate) per tree, weighted mode drops proportionally to
+    each tree's current |weight| (normalized by the mean weight)."""
+    if t == 0 or rng.random() < p.skip_drop:
+        return np.empty(0, np.int64)
+    w = np.abs(np.asarray(cur_weights[:t], np.float64))
+    if p.uniform_drop or w.sum() <= 0:
+        sel = rng.random(t) < p.drop_rate
+    else:
+        probs = np.minimum(1.0, p.drop_rate * t * w / w.sum())
+        sel = rng.random(t) < probs
+    dropped = np.nonzero(sel)[0]
+    if p.max_drop > 0:  # LightGBM: max_drop <= 0 = no limit
+        dropped = dropped[: p.max_drop]
+    return dropped
+
+
+def _dart_normalize(p: BoostParams, kd: int):
+    """(new_tree_weight, dropped_scale) after a round that dropped
+    ``kd`` trees: lr/(kd+1) classic, lr/(kd+lr) in xgboost_dart_mode."""
+    if kd == 0:
+        return p.learning_rate, 1.0
+    if p.xgboost_dart_mode:
+        return (p.learning_rate / (kd + p.learning_rate),
+                kd / (kd + p.learning_rate))
+    return p.learning_rate / (kd + 1.0), kd / (kd + 1.0)
 
 
 def _init_score(p: BoostParams, y: np.ndarray, weight: Optional[np.ndarray]):
@@ -779,7 +826,10 @@ def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
     is_rank = p.objective in ("lambdarank", "rank_xendcg")
     use_goss = p.boosting_type == "goss"
     is_rf = p.boosting_type == "rf"
-    use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
+    strat_bagging = (p.pos_bagging_fraction < 1.0
+                     or p.neg_bagging_fraction < 1.0)
+    use_bagging = (p.bagging_freq > 0
+                   and (p.bagging_fraction < 1.0 or strat_bagging)) or is_rf
     feature_frac = p.feature_fraction
     renew_alpha = None
     if k == 1 and p.objective in ("regression_l1", "l1", "mae"):
@@ -833,9 +883,19 @@ def _make_scan_fn(p: BoostParams, gp: GrowerParams, k: int, track: bool,
                 h = jnp.where(small, hess * amp, hess)
                 return mask, g, h
             if use_bagging:
+                bkey = (key if p.bagging_seed is None
+                        else jax.random.fold_in(key, p.bagging_seed))
+                u = jax.random.uniform(bkey, (n,))
+                if strat_bagging and not is_rf:
+                    # per-class subsampling (LightGBM pos/neg bagging:
+                    # binary labels, no gradient amplification)
+                    mask = jnp.where(yd > 0,
+                                     u < p.pos_bagging_fraction,
+                                     u < p.neg_bagging_fraction)
+                    return mask, grad, hess
                 frac = p.bagging_fraction if not is_rf else (
                     p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632)
-                mask = jax.random.uniform(key, (n,)) < frac
+                mask = u < frac
                 return mask, grad, hess
             return jnp.ones(n, jnp.bool_), grad, hess
 
@@ -975,6 +1035,13 @@ def train(
     y = np.asarray(y, dtype=np.float32)
     n, f = x.shape
     k = p.num_class if p.objective in ("multiclass", "softmax", "multiclassova") else 1
+    if ((p.pos_bagging_fraction < 1.0 or p.neg_bagging_fraction < 1.0)
+            and p.objective not in ("binary", "binary_logloss")):
+        # stratified bagging splits rows by label sign — only meaningful
+        # (and only defined by LightGBM) for binary objectives
+        raise ValueError(
+            "pos_bagging_fraction/neg_bagging_fraction require a binary "
+            f"objective, got {p.objective!r}")
 
     mapper = BinMapper(max_bin=p.max_bin,
                        categorical_features=p.categorical_features,
@@ -1662,7 +1729,10 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     is_dart = p.boosting_type == "dart"
     use_goss = p.boosting_type == "goss"
     is_rf = p.boosting_type == "rf"
-    use_bagging = (p.bagging_freq > 0 and p.bagging_fraction < 1.0) or is_rf
+    strat_bagging = (p.pos_bagging_fraction < 1.0
+                     or p.neg_bagging_fraction < 1.0)
+    use_bagging = (p.bagging_freq > 0
+                   and (p.bagging_fraction < 1.0 or strat_bagging)) or is_rf
     if is_rank and group is None and placed is None:
         raise ValueError("ranking objectives need a group array")
     renew_alpha = None
@@ -1728,20 +1798,11 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         dart_drops: List[np.ndarray] = []
         cur = np.zeros(n_units, np.float32)
         for t in range(n_units):
-            if t == 0 or drng.random() < p.skip_drop:
-                dropped = np.empty(0, np.int64)
-            else:
-                sel = drng.random(t) < p.drop_rate
-                dropped = np.nonzero(sel)[0]
-                if p.max_drop > 0:  # LightGBM: max_drop <= 0 = no limit
-                    dropped = dropped[: p.max_drop]
+            dropped = _dart_select(drng, t, cur, p)
             dart_drops.append(dropped)
-            kd = len(dropped)
-            if kd:
-                cur[dropped] *= kd / (kd + 1.0)
-                cur[t] = p.learning_rate / (kd + 1.0)
-            else:
-                cur[t] = p.learning_rate
+            new_w, scale = _dart_normalize(p, len(dropped))
+            cur[dropped] *= scale
+            cur[t] = new_w
         dart_w_final = np.repeat(cur, k) if k > 1 else cur
 
         _dart_run = np.zeros(n_units, np.float32)
@@ -1765,12 +1826,9 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
                     _dart_row[0] = np.repeat(w, k) if k > 1 else w
                 out[j] = _dart_row[0]
                 if c == k - 1:  # iteration complete
-                    kd = len(dart_drops[u])
-                    if kd:
-                        _dart_run[dart_drops[u]] *= kd / (kd + 1.0)
-                        _dart_run[u] = p.learning_rate / (kd + 1.0)
-                    else:
-                        _dart_run[u] = p.learning_rate
+                    new_w, scale = _dart_normalize(p, len(dart_drops[u]))
+                    _dart_run[dart_drops[u]] *= scale
+                    _dart_run[u] = new_w
                 _dart_next[0] = t + 1
             if start_step + n_steps > total_steps:
                 _dart_next[0] = start_step + n_steps
@@ -1892,9 +1950,18 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             if use_goss:
                 mask, g, h = goss_select(g, h, key)
             elif use_bagging and not is_dart:
-                frac = p.bagging_fraction if p.bagging_fraction < 1.0 else 0.632
                 bkey = jax.random.fold_in(key, lax.axis_index("dp"))
-                mask = padm_l & (jax.random.uniform(bkey, (n_l,)) < frac)
+                if p.bagging_seed is not None:
+                    bkey = jax.random.fold_in(bkey, p.bagging_seed)
+                u = jax.random.uniform(bkey, (n_l,))
+                if strat_bagging and not is_rf:
+                    mask = padm_l & jnp.where(
+                        yd_l > 0, u < p.pos_bagging_fraction,
+                        u < p.neg_bagging_fraction)
+                else:
+                    frac = (p.bagging_fraction
+                            if p.bagging_fraction < 1.0 else 0.632)
+                    mask = padm_l & (u < frac)
             else:
                 mask = padm_l
 
@@ -2095,13 +2162,7 @@ def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
 
     for it in range(p.num_iterations):
         t = len(iter_preds)
-        if t == 0 or rng.random() < p.skip_drop:
-            dropped = np.empty(0, np.int64)
-        else:
-            sel = rng.random(t) < p.drop_rate
-            dropped = np.nonzero(sel)[0]
-            if p.max_drop > 0:  # LightGBM: max_drop <= 0 = no limit
-                dropped = dropped[: p.max_drop]
+        dropped = _dart_select(rng, t, np.asarray(weights, np.float64), p)
         w = np.asarray(weights, np.float32)
         if len(dropped):
             w_used = w.copy()
@@ -2131,14 +2192,9 @@ def _train_dart(p, binned, yd, wd, obj_fn, gp, thresholds, init, n, f,
         # one batched device->host round trip for the iteration's k trees
         trees.extend(jax.device_get(iter_trees))
         iter_preds.append(jnp.stack(class_preds))
-        kd = len(dropped)
-        if kd:
-            new_w = p.learning_rate / (kd + 1.0)
-            factor = kd / (kd + 1.0)
-            for d in dropped:
-                weights[d] *= factor
-        else:
-            new_w = p.learning_rate
+        new_w, scale = _dart_normalize(p, len(dropped))
+        for d in dropped:
+            weights[d] *= scale
         weights.append(float(new_w))
 
     # expand iteration weights to the class-interleaved tree stack

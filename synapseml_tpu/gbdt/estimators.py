@@ -85,9 +85,36 @@ class _LightGBMParams:
     feature_fraction = Param("feature subsample per tree", default=1.0)
     bagging_fraction = Param("row subsample", default=1.0)
     bagging_freq = Param("bagging frequency", default=0)
+    bagging_seed = Param(
+        "independent seed for the bagging stream (reference baggingSeed); "
+        "None derives it from seed", default=None)
+    pos_bagging_fraction = Param(
+        "per-iteration subsample of positive rows (binary only)",
+        default=1.0)
+    neg_bagging_fraction = Param(
+        "per-iteration subsample of negative rows (binary only)",
+        default=1.0)
     top_rate = Param("GOSS top rate", default=0.2)
     other_rate = Param("GOSS other rate", default=0.1)
+    drop_rate = Param("DART per-tree drop probability", default=0.1)
+    max_drop = Param("DART max trees dropped per iteration (<=0 = no "
+                     "limit)", default=50)
+    skip_drop = Param("DART probability of skipping dropout entirely",
+                      default=0.5)
+    uniform_drop = Param(
+        "DART: True = uniform Bernoulli tree selection; False (LightGBM "
+        "default) drops proportionally to current tree weight",
+        default=False)
+    xgboost_dart_mode = Param(
+        "DART: normalize dropped rounds with lr/(k+lr) (xgboost's rule) "
+        "instead of lr/(k+1)", default=False)
+    boost_from_average = Param(
+        "initialize scores from the label average (LightGBM "
+        "boost_from_average)", default=True)
     early_stopping_round = Param("early stopping patience", default=0)
+    improvement_tolerance = Param(
+        "metric delta below which an iteration does not count as "
+        "improved (reference improvementTolerance)", default=0.0)
     categorical_slot_indexes = Param("categorical feature slots", default=None)
     parallelism = Param(
         "distributed tree learner (ref LightGBMParams.scala:16-18): "
@@ -134,9 +161,20 @@ class _LightGBMParams:
             feature_fraction=float(self.feature_fraction),
             bagging_fraction=float(self.bagging_fraction),
             bagging_freq=int(self.bagging_freq),
+            bagging_seed=(None if self.get("bagging_seed") is None
+                          else int(self.bagging_seed)),
+            pos_bagging_fraction=float(self.pos_bagging_fraction),
+            neg_bagging_fraction=float(self.neg_bagging_fraction),
             top_rate=float(self.top_rate),
             other_rate=float(self.other_rate),
+            drop_rate=float(self.drop_rate),
+            max_drop=int(self.max_drop),
+            skip_drop=float(self.skip_drop),
+            uniform_drop=bool(self.uniform_drop),
+            xgboost_dart_mode=bool(self.xgboost_dart_mode),
+            boost_from_average=bool(self.boost_from_average),
             early_stopping_round=int(self.early_stopping_round),
+            improvement_tolerance=float(self.improvement_tolerance),
             num_class=num_class,
             metric=self.get("metric"),
             seed=int(self.seed),

@@ -3175,7 +3175,9 @@ def _contrib_attention(ctx, x, weights, bias=None, mask_index=None,
                         k.astype(jnp.float32)) * scale
     if attention_bias is not None:
         logits = logits + jnp.asarray(attention_bias, jnp.float32)
-    neg = jnp.float32(-1e9)  # ORT masks with a large negative, not -inf
+    # ORT masks with a finite additive floor, not -inf — and exporters
+    # may tune it (soft masking), so honor the attribute (default -1e4)
+    neg = jnp.float32(ctx.attr("mask_filter_value", -10000.0))
     if mask_index is not None:
         m = jnp.asarray(mask_index)
         if m.ndim == 1 and m.shape[0] != b:

@@ -822,3 +822,136 @@ def test_voting_parallel_tree_learner():
     assert est._boost_params("binary").voting_top_k == 4
     with pytest.raises(TypeError):
         LightGBMClassifier(parallelism="feature_parallel")
+
+
+# ---------------------------------------------------------------------------
+# Round-5 param-surface completion: DART modes, stratified bagging,
+# bagging seed, improvement tolerance
+# ---------------------------------------------------------------------------
+
+def test_dart_select_and_normalize_semantics():
+    """Unit semantics of the shared DART helpers (lib_lightgbm dart.hpp
+    rules): weighted vs uniform selection, max_drop cap, xgboost vs
+    classic normalization."""
+    from synapseml_tpu.gbdt.boosting import _dart_normalize, _dart_select
+
+    p = BoostParams(boosting_type="dart", learning_rate=0.5,
+                    drop_rate=1.0, skip_drop=0.0, max_drop=2)
+    rng = np.random.default_rng(0)
+    # drop_rate=1 drops every tree, capped by max_drop
+    dropped = _dart_select(rng, 5, np.ones(5), p)
+    assert len(dropped) == 2
+
+    # weighted mode: a zero-weight tree is never dropped when others
+    # carry all the weight (probability proportional to |w|)
+    pw = BoostParams(boosting_type="dart", drop_rate=0.5, skip_drop=0.0,
+                     max_drop=0, uniform_drop=False)
+    w = np.array([0.0, 1.0, 1.0, 1.0, 1.0, 1.0])
+    hits = set()
+    for s in range(50):
+        hits.update(_dart_select(np.random.default_rng(s), 6, w, pw)
+                    .tolist())
+    assert 0 not in hits and len(hits) > 0
+
+    # classic vs xgboost normalization
+    p0 = BoostParams(learning_rate=0.3)
+    assert _dart_normalize(p0, 0) == (0.3, 1.0)
+    nw, sc = _dart_normalize(p0, 2)
+    assert abs(nw - 0.1) < 1e-12 and abs(sc - 2 / 3) < 1e-12
+    px = BoostParams(learning_rate=0.3, xgboost_dart_mode=True)
+    nw, sc = _dart_normalize(px, 2)
+    assert abs(nw - 0.3 / 2.3) < 1e-12 and abs(sc - 2 / 2.3) < 1e-12
+
+
+def test_dart_mode_params_change_the_ensemble():
+    """uniform_drop / xgboost_dart_mode must actually reach the trainer:
+    toggling them changes predictions; same settings reproduce."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(400, 6))
+    y = (x[:, 0] + 0.5 * x[:, 1] + rng.normal(0, 0.2, 400) > 0) \
+        .astype(np.float64)
+    t = Table({"features": x, "label": y})
+
+    def fit(**kw):
+        m = LightGBMClassifier(boosting_type="dart", num_iterations=30,
+                               drop_rate=0.4, skip_drop=0.0, seed=7,
+                               **kw).fit(t)
+        return np.asarray(m.transform(t)["probability"])
+
+    base = fit()
+    again = fit()
+    np.testing.assert_allclose(base, again)     # deterministic
+    assert not np.allclose(base, fit(uniform_drop=True))
+    assert not np.allclose(base, fit(xgboost_dart_mode=True))
+
+
+def test_stratified_bagging_binary_only_and_effective():
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(600, 5))
+    y = (x[:, 0] > 0.8).astype(np.float64)      # imbalanced positives
+    t = Table({"features": x, "label": y})
+
+    def fit(**kw):
+        m = LightGBMClassifier(num_iterations=25, bagging_freq=1, seed=3,
+                               **kw).fit(t)
+        return np.asarray(m.transform(t)["probability"])
+
+    base = fit()
+    strat = fit(neg_bagging_fraction=0.3)       # downsample negatives
+    assert not np.allclose(base, strat)
+    np.testing.assert_allclose(strat, fit(neg_bagging_fraction=0.3))
+
+    with pytest.raises(ValueError, match="binary"):
+        train(BoostParams(objective="regression",
+                          pos_bagging_fraction=0.5, bagging_freq=1),
+              x, y)
+
+
+def test_bagging_seed_independent_stream():
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(500, 5))
+    y = (x[:, 0] + rng.normal(0, 0.3, 500) > 0).astype(np.float64)
+    t = Table({"features": x, "label": y})
+
+    def fit(**kw):
+        m = LightGBMClassifier(num_iterations=20, bagging_freq=1,
+                               bagging_fraction=0.5, seed=3, **kw).fit(t)
+        return np.asarray(m.transform(t)["probability"])
+
+    base = fit()                    # bagging_seed=None: derived stream
+    np.testing.assert_allclose(base, fit())
+    seeded = fit(bagging_seed=42)
+    assert not np.allclose(base, seeded)
+    np.testing.assert_allclose(seeded, fit(bagging_seed=42))
+
+
+def test_improvement_tolerance_early_stopping():
+    """Reference TrainUtils.scala:129-141 semantics: an improvement
+    below tolerance does not reset patience (larger-better)."""
+    from synapseml_tpu.gbdt.boosting import BoostParams as BP
+
+    class _Tracker:
+        # minimal record() host: mirror the ValidTracker fields it reads
+        def __init__(self, p):
+            self.p = p
+            self.history = {"auc": []}
+            self.metric_name = "auc"
+            self.larger_better = True
+            self.best_score = -np.inf
+            self.best_iter = -1
+        from synapseml_tpu.gbdt.boosting import _ValidTracker
+        record = _ValidTracker.record
+
+    p = BP(early_stopping_round=2, improvement_tolerance=0.05)
+    tr = _Tracker(p)
+    assert tr.record(0.70, 0) is False          # first: improved
+    assert tr.record(0.72, 1) is False          # +0.02 < tol: no reset
+    assert tr.record(0.73, 2) is True           # patience exhausted
+    assert tr.best_iter == 0
+
+    p0 = BP(early_stopping_round=2, improvement_tolerance=0.0)
+    tr0 = _Tracker(p0)
+    assert tr0.record(0.70, 0) is False
+    assert tr0.record(0.72, 1) is False         # resets with tol=0
+    assert tr0.record(0.73, 2) is False
+    assert tr0.best_iter == 2

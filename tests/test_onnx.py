@@ -3664,3 +3664,71 @@ def test_embed_layer_normalization_bert_frontend():
     np.testing.assert_allclose(
         gy, (emb - mu) / np.sqrt(va + 1e-12) * gamma + beta, atol=1e-4)
     np.testing.assert_array_equal(gm, lens)
+
+
+def test_fused_encoder_layer_equals_unfused_composition():
+    """A full ORT-optimizer-shaped encoder layer (Attention +
+    SkipLayerNormalization + BiasGelu + FusedMatMul) against the same
+    layer written as raw MatMul/Add/LayerNormalization/Gelu nodes with
+    identical weights — the end-to-end form of the per-op fusion
+    equalities, proving optimized and raw exports score identically."""
+    rng = np.random.default_rng(3)
+    b, s, h, n = 2, 6, 32, 4
+    x = rng.normal(size=(b, s, h)).astype(np.float32)
+    aw = (rng.normal(size=(h, 3 * h)) * 0.2).astype(np.float32)
+    ab = rng.normal(size=(3 * h,)).astype(np.float32)
+    g1 = rng.normal(size=(h,)).astype(np.float32)
+    b1 = rng.normal(size=(h,)).astype(np.float32)
+    fw = (rng.normal(size=(h, 4 * h)) * 0.2).astype(np.float32)
+    fb = rng.normal(size=(4 * h,)).astype(np.float32)
+    fw2 = (rng.normal(size=(4 * h, h)) * 0.2).astype(np.float32)
+    g2 = rng.normal(size=(h,)).astype(np.float32)
+    b2 = rng.normal(size=(h,)).astype(np.float32)
+    lens = np.array([6, 4], np.int32)
+
+    gf = GraphBuilder(opset=17)
+    xi = gf.add_input("x", np.float32, [b, s, h])
+    mi = gf.add_input("m", np.int32, [b])
+    att = gf.add_node(
+        "Attention", [xi, gf.add_initializer("aw", aw),
+                      gf.add_initializer("ab", ab), mi],
+        domain="com.microsoft", num_heads=n)
+    s1 = gf.add_node(
+        "SkipLayerNormalization", [att, xi, gf.add_initializer("g1", g1),
+                                   gf.add_initializer("b1", b1)],
+        domain="com.microsoft")
+    ff = gf.add_node("FusedMatMul", [s1, gf.add_initializer("fw", fw)],
+                     domain="com.microsoft")
+    gl = gf.add_node("BiasGelu", [ff, gf.add_initializer("fb", fb)],
+                     domain="com.microsoft")
+    fo = gf.add_node("FusedMatMul", [gl, gf.add_initializer("fw2", fw2)],
+                     domain="com.microsoft")
+    s2 = gf.add_node(
+        "SkipLayerNormalization", [fo, s1, gf.add_initializer("g2", g2),
+                                   gf.add_initializer("b2", b2)],
+        domain="com.microsoft")
+    gf.add_output(s2, np.float32, None)
+    mf = import_model(gf.to_bytes())
+    fused = np.asarray(mf.apply(mf.params, x, lens)[0])
+
+    # raw composition with the same weights
+    want_att, _ = _mk_attention_ref(x, aw, ab, n, lens=lens)
+    gr = GraphBuilder(opset=17)
+    ai = gr.add_input("att", np.float32, [b, s, h])
+    xi2 = gr.add_input("x", np.float32, [b, s, h])
+    ad1 = gr.add_node("Add", [ai, xi2])
+    ln1 = gr.add_node(
+        "LayerNormalization", [ad1, gr.add_initializer("g1", g1),
+                               gr.add_initializer("b1", b1)])
+    mm1 = gr.add_node("MatMul", [ln1, gr.add_initializer("fw", fw)])
+    ad2 = gr.add_node("Add", [mm1, gr.add_initializer("fb", fb)])
+    ge = gr.add_node("Gelu", [ad2])
+    mm2 = gr.add_node("MatMul", [ge, gr.add_initializer("fw2", fw2)])
+    ad3 = gr.add_node("Add", [mm2, ln1])
+    ln2 = gr.add_node(
+        "LayerNormalization", [ad3, gr.add_initializer("g2", g2),
+                               gr.add_initializer("b2", b2)])
+    gr.add_output(ln2, np.float32, None)
+    mr = import_model(gr.to_bytes())
+    raw = np.asarray(mr.apply(mr.params, want_att, x)[0])
+    np.testing.assert_allclose(fused, raw, atol=2e-4)
