@@ -214,6 +214,10 @@ class VowpalWabbitContextualBandit(Estimator, _VWBaseParams):
     cost_col = Param("cost column (lower is better)", default="cost")
     probability_col = Param("logging-policy probability column",
                             default="probability")
+    epsilon = Param(
+        "epsilon-greedy exploration at prediction: greedy action gets "
+        "1-eps+eps/K, others eps/K (reference epsilon / VW "
+        "--cb_explore_adf)", default=0.05)
 
     def _fit(self, table: Table) -> "VowpalWabbitContextualBanditModel":
         p = self._vw_params("squared")
@@ -244,13 +248,16 @@ class VowpalWabbitContextualBandit(Estimator, _VWBaseParams):
                                     "final_loss": losses[-1] if losses else None},
             shared_col=self.shared_col,
             action_features_col=self.action_features_col,
-            prediction_col=self.prediction_col)
+            prediction_col=self.prediction_col,
+            epsilon=self.epsilon)
 
 
 class VowpalWabbitContextualBanditModel(_VWModelBase, HasPredictionCol):
     shared_col = Param("hashed shared-context column prefix", default="shared")
     action_features_col = Param("per-action hashed features column",
                                 default="action_features")
+    epsilon = Param("epsilon-greedy exploration pmf parameter",
+                    default=0.05)
 
     def _transform(self, table: Table) -> Table:
         st: VWState = self.state
@@ -260,7 +267,9 @@ class VowpalWabbitContextualBanditModel(_VWModelBase, HasPredictionCol):
         sh_val = table[f"{self.shared_col}_val"]
         actions = table[self.action_features_col]
         scores_out = np.empty(table.num_rows, dtype=object)
+        pmf_out = np.empty(table.num_rows, dtype=object)
         best = np.zeros(table.num_rows, np.float64)
+        eps = float(self.epsilon)
         for i in range(table.num_rows):
             shared_score = float(np.sum(w[np.asarray(sh_idx[i], np.int64)]
                                         * np.asarray(sh_val[i])))
@@ -271,7 +280,14 @@ class VowpalWabbitContextualBanditModel(_VWModelBase, HasPredictionCol):
                            * np.asarray(a_val, np.float32)))
                 scores.append(s)
             scores_out[i] = scores
-            best[i] = int(np.argmin(scores)) + 1  # 1-based, min cost
+            greedy = int(np.argmin(scores))
+            best[i] = greedy + 1                  # 1-based, min cost
+            # epsilon-greedy exploration pmf (VW --cb_explore_adf):
+            # greedy action 1-eps+eps/K, every action eps/K
+            pmf = np.full(len(scores), eps / len(scores))
+            pmf[greedy] += 1.0 - eps
+            pmf_out[i] = pmf
         return (table
                 .with_column(self.prediction_col, best)
-                .with_column("scores", scores_out))
+                .with_column("scores", scores_out)
+                .with_column("probabilities", pmf_out))

@@ -38,9 +38,21 @@ class VowpalWabbitFeaturizer(Transformer, HasOutputCol):
     """
 
     input_cols = Param("columns to featurize", default=None)
+    string_split_input_cols = Param(
+        "string columns split on whitespace — one feature per token "
+        "(reference stringSplitInputCols)", default=None)
     num_bits = Param("hash space = 2^num_bits", default=18)
     seed = Param("murmur seed (namespace analogue)", default=0)
     sum_collisions = Param("sum colliding values (vs overwrite)", default=True)
+    prefix_strings_with_column_name = Param(
+        "hash string features as 'col=value' (reference default); False "
+        "hashes the bare value, letting equal values in different "
+        "columns share weights", default=True)
+
+    def _str_name(self, c: str, tok) -> str:
+        if self.prefix_strings_with_column_name:
+            return f"{c}={tok}"
+        return str(tok)
 
     def _row_features(self, table: Table, i: int) -> List[Tuple[int, float]]:
         bits, seed = int(self.num_bits), int(self.seed)
@@ -48,19 +60,28 @@ class VowpalWabbitFeaturizer(Transformer, HasOutputCol):
         for c in self.input_cols or []:
             col = table[c]
             v = col[i]
-            if col.ndim == 2:
+            if col.ndim == 2 and col.dtype != object:
                 for j, x in enumerate(np.asarray(v, np.float64)):
                     if x != 0:
                         feats.append((_hash_feature(f"{c}_{j}", bits, seed), float(x)))
             elif isinstance(v, (list, tuple, np.ndarray)):
                 for tok in v:
-                    feats.append((_hash_feature(f"{c}={tok}", bits, seed), 1.0))
+                    feats.append((_hash_feature(
+                        self._str_name(c, tok), bits, seed), 1.0))
             elif isinstance(v, str):
-                feats.append((_hash_feature(f"{c}={v}", bits, seed), 1.0))
+                feats.append((_hash_feature(
+                    self._str_name(c, v), bits, seed), 1.0))
             elif v is not None:
                 x = float(v)
                 if x != 0:
                     feats.append((_hash_feature(c, bits, seed), x))
+        for c in self.string_split_input_cols or []:
+            v = table[c][i]
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                continue  # nulls emit nothing, as in the input_cols path
+            for tok in str(v).split():
+                feats.append((_hash_feature(
+                    self._str_name(c, tok), bits, seed), 1.0))
         return feats
 
     def _transform(self, table: Table) -> Table:

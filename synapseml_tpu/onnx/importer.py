@@ -3175,8 +3175,9 @@ def _contrib_attention(ctx, x, weights, bias=None, mask_index=None,
                         k.astype(jnp.float32)) * scale
     if attention_bias is not None:
         logits = logits + jnp.asarray(attention_bias, jnp.float32)
-    # ORT masks with a finite additive floor, not -inf — and exporters
-    # may tune it (soft masking), so honor the attribute (default -1e4)
+    # ORT masking is ADDITIVE: masked logits get logit + filter value
+    # (default -1e4), which preserves relative order — load-bearing for
+    # exporters that tune a small mask_filter_value (soft masking)
     neg = jnp.float32(ctx.attr("mask_filter_value", -10000.0))
     if mask_index is not None:
         m = jnp.asarray(mask_index)
@@ -3189,17 +3190,17 @@ def _contrib_attention(ctx, x, weights, bias=None, mask_index=None,
         if m.ndim == 1:                             # [B] valid-key lengths
             key_ok = jnp.arange(t_kv)[None, :] < m.astype(
                 jnp.int32)[:, None]
-            logits = jnp.where(key_ok[:, None, None, :], logits, neg)
+            logits = logits + jnp.where(
+                key_ok[:, None, None, :], 0.0, neg)
         else:                                       # 0/1 key mask
             # right-align onto [B, N, S, T]: [B,T] -> [B,1,1,T],
             # [B,S,T] -> [B,1,S,T], 4-D passes through
             m2 = m.reshape((b,) + (1,) * (4 - m.ndim) + m.shape[1:])
-            logits = jnp.where(
-                jnp.broadcast_to(m2, logits.shape) != 0, logits, neg)
+            logits = logits + jnp.where(m2 != 0, 0.0, neg)
     if bool(ctx.attr("unidirectional", 0)):
         q_pos = past_len + jnp.arange(s)[:, None]
         causal = jnp.arange(t_kv)[None, :] <= q_pos
-        logits = jnp.where(causal[None, None], logits, neg)
+        logits = logits + jnp.where(causal[None, None], 0.0, neg)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bnst,bntd->bnsd", probs, v.astype(jnp.float32))
     out = out.transpose(0, 2, 1, 3).reshape(b, s, hidden).astype(x.dtype)

@@ -179,3 +179,69 @@ def test_iforest_max_features():
     assert all(len(s) <= 3 for s in per_tree)
     # different trees should sample different subsets (overwhelmingly likely)
     assert len(set(frozenset(s) for s in per_tree if s)) > 1
+
+
+def test_featurizer_string_split_and_prefix_modes():
+    """stringSplitInputCols + prefixStringsWithColumnName parity
+    (ref: vw/.../VowpalWabbitFeaturizer.scala param surface)."""
+    from synapseml_tpu.linear.featurizer import VowpalWabbitFeaturizer
+
+    t = Table({"txt": np.asarray(["red blue", "blue"], object),
+               "tok": np.asarray([["blue"], ["red"]], object)})
+    f = VowpalWabbitFeaturizer(string_split_input_cols=["txt"],
+                               output_col="features")
+    out = f.transform(t)
+    # row 0 splits into two tokens, row 1 into one (padded)
+    assert (out["features_val"][0] != 0).sum() == 2
+    assert (out["features_val"][1] != 0).sum() == 1
+
+    # prefix=False hashes the bare token: 'txt' token "blue" collides
+    # (shares a weight slot) with 'tok' token "blue"
+    f2 = VowpalWabbitFeaturizer(string_split_input_cols=["txt"],
+                                input_cols=["tok"],
+                                prefix_strings_with_column_name=False,
+                                output_col="features")
+    o2 = f2.transform(t)
+    r0 = set(np.asarray(o2["features_idx"][0])[
+        np.asarray(o2["features_val"][0]) != 0])
+    assert len(r0) == 2  # {blue(tok), red, blue(txt)} -> blue collides
+
+    f3 = VowpalWabbitFeaturizer(string_split_input_cols=["txt"],
+                                input_cols=["tok"],
+                                output_col="features")
+    o3 = f3.transform(t)
+    r0p = set(np.asarray(o3["features_idx"][0])[
+        np.asarray(o3["features_val"][0]) != 0])
+    assert len(r0p) == 3  # prefixed: txt=blue != tok=blue
+
+
+def test_contextual_bandit_exploration_pmf():
+    from synapseml_tpu.linear.estimators import VowpalWabbitContextualBandit
+
+    rng = np.random.default_rng(0)
+    n, k, d = 60, 3, 8
+    bits = 10
+    sh_idx = rng.integers(0, 2 ** bits, (n, d)).astype(np.int32)
+    sh_val = rng.normal(size=(n, d)).astype(np.float32)
+    actions = np.empty(n, object)
+    for i in range(n):
+        actions[i] = [(rng.integers(0, 2 ** bits, d).astype(np.int32),
+                       rng.normal(size=d).astype(np.float32))
+                      for _ in range(k)]
+    t = Table({"shared_idx": sh_idx, "shared_val": sh_val,
+               "action_features": actions,
+               "chosenAction": rng.integers(1, k + 1, n).astype(np.int64),
+               "cost": rng.random(n).astype(np.float32),
+               "probability": np.full(n, 0.5, np.float32)})
+    m = VowpalWabbitContextualBandit(
+        num_bits=bits, num_passes=2, epsilon=0.3).fit(t)
+    out = m.transform(t)
+    for i in range(5):
+        pmf = np.asarray(out["probabilities"][i])
+        assert pmf.shape == (k,)
+        np.testing.assert_allclose(pmf.sum(), 1.0, atol=1e-6)
+        best = int(out["prediction"][i]) - 1
+        np.testing.assert_allclose(pmf[best], 1 - 0.3 + 0.3 / k,
+                                   atol=1e-6)
+        others = [pmf[j] for j in range(k) if j != best]
+        np.testing.assert_allclose(others, 0.3 / k, atol=1e-6)
