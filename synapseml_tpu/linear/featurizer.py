@@ -73,7 +73,7 @@ class VowpalWabbitFeaturizer(Transformer, HasOutputCol):
                     self._str_name(c, v), bits, seed), 1.0))
             elif v is not None:
                 x = float(v)
-                if x != 0:
+                if x != 0 and not np.isnan(x):  # null/NaN emits nothing
                     feats.append((_hash_feature(c, bits, seed), x))
         for c in self.string_split_input_cols or []:
             v = table[c][i]

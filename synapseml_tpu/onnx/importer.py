@@ -3033,9 +3033,11 @@ def _rms_norm(x, scale, eps, axis):
         jnp.asarray(x).dtype), inv
 
 
-@op("SimplifiedLayerNormalization")
+@op("SimplifiedLayerNormalization", "RMSNormalization")
 def _simplified_layer_norm(ctx, x, scale):
-    """RMSNorm — ORT's name for it (LLaMA-family exports)."""
+    """RMSNorm — ORT's contrib name for it (LLaMA-family exports); the
+    standard ai.onnx domain added the same op as RMSNormalization in
+    opset 23 (identical signature/attrs)."""
     axis = int(ctx.attr("axis", -1)) % np.ndim(x)
     y, inv = _rms_norm(x, scale, ctx.attr("epsilon", 1e-5),
                        tuple(range(axis, np.ndim(x))))
@@ -3115,6 +3117,87 @@ def _embed_layer_norm(ctx, input_ids, segment_ids=None, word_emb=None,
     return y, mask_index
 
 
+def _standard_attention(ctx, q, k, v, attn_mask=None, past_key=None,
+                        past_value=None):
+    """Standard ai.onnx Attention (opset 23): scaled dot-product
+    attention over separate Q/K/V, 3-D ([B, S, N*D] + q/kv_num_heads
+    attrs — torch's opset-23 exporter shape) or 4-D ([B, N, S, D]).
+    Grouped-query head counts, is_causal (top-left alignment, the
+    spec's tril), additive or boolean masks, scale and softcap are
+    lowered; KV cache inputs/outputs and the qk_matmul_output modes
+    are rejected loudly."""
+    if k is None or v is None:
+        raise NotImplementedError(
+            "standard Attention needs Q, K and V inputs")
+    if past_key is not None or past_value is not None:
+        raise NotImplementedError(
+            "standard Attention with past_key/past_value (KV cache) is "
+            "not supported; re-export the decode step with explicit "
+            "Concat of the cache, or use the com.microsoft "
+            "GroupQueryAttention form")
+    if ctx.n_outputs > 1:
+        raise NotImplementedError(
+            "standard Attention present_key/present_value (or "
+            "qk_matmul_output) outputs are not supported")
+    if int(ctx.attr("qk_matmul_output_mode", 0)) != 0:
+        raise NotImplementedError(
+            "Attention qk_matmul_output_mode != 0 (exposing the raw "
+            "QK product) is not supported")
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    three_d = q.ndim == 3
+    if three_d:
+        nq = int(ctx.attr("q_num_heads", 0))
+        nk = int(ctx.attr("kv_num_heads", 0))
+        if nq <= 0 or nk <= 0:
+            raise ValueError(
+                "3-D standard Attention needs q_num_heads/kv_num_heads")
+        b, s, dq = q.shape
+        q = q.reshape(b, s, nq, dq // nq).transpose(0, 2, 1, 3)
+        k = k.reshape(k.shape[0], k.shape[1], nk,
+                      k.shape[2] // nk).transpose(0, 2, 1, 3)
+        v = v.reshape(v.shape[0], v.shape[1], nk,
+                      v.shape[2] // nk).transpose(0, 2, 1, 3)
+    b, nq, s, head = q.shape
+    nk, t_kv = k.shape[1], k.shape[2]
+    if nq % nk:
+        raise ValueError(
+            f"Attention q heads {nq} not a multiple of kv heads {nk}")
+    group = nq // nk
+    dt = q.dtype
+    qg = q.reshape(b, nk, group, s, head).astype(jnp.float32)
+    scale = ctx.attr("scale", 0.0) or 1.0 / math.sqrt(head)
+    logits = jnp.einsum("bkgsd,bktd->bkgst", qg,
+                        k.astype(jnp.float32)) * scale
+    softcap = float(ctx.attr("softcap", 0.0))
+    if softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
+    if attn_mask is not None:
+        m = jnp.asarray(attn_mask)
+        # right-align onto [B, N, S, T] then add the group axis
+        m4 = m.reshape((1,) * (4 - m.ndim) + m.shape)
+        if m4.shape[1] == 1:        # broadcast over heads
+            m5 = m4[:, :, None]
+        else:                       # per-q-head mask: split (nk, group)
+            m5 = m4.reshape(m4.shape[0], nk, group,
+                            m4.shape[2], m4.shape[3])
+        if m.dtype == jnp.bool_ or m.dtype == np.bool_:
+            logits = jnp.where(m5, logits, -jnp.inf)
+        else:  # additive float mask, the exporter's other convention
+            logits = logits + m5.astype(jnp.float32)
+    if bool(ctx.attr("is_causal", 0)):
+        # top-left alignment: query i attends keys j <= i (the spec's
+        # tril(ones(S, T)) and torch SDPA's is_causal)
+        causal = jnp.arange(t_kv)[None, :] <= jnp.arange(s)[:, None]
+        logits = jnp.where(causal[None, None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
+    out = jnp.einsum("bkgst,bktd->bkgsd", probs, v.astype(jnp.float32))
+    out = out.reshape(b, nq, s, head).astype(dt)
+    if three_d:
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, nq * head)
+    return out
+
+
 @op("Attention")
 def _contrib_attention(ctx, x, weights, bias=None, mask_index=None,
                        past=None, attention_bias=None,
@@ -3126,14 +3209,11 @@ def _contrib_attention(ctx, x, weights, bias=None, mask_index=None,
     and the stacked [2, B, N, P, D] past/present KV cache. Asymmetric
     qkv_hidden_sizes and packed-KV pasts are rejected loudly."""
     if weights is None or np.ndim(weights) != 2:
-        # the standard ai.onnx opset-23 Attention (separate Q/K/V
-        # tensors) shares this op_type but not this signature — keep
-        # the unsupported-op failure loud instead of a shape error
-        raise NotImplementedError(
-            "only the com.microsoft fused Attention (input + [H, 3H] "
-            "projection weights) is supported; the standard ai.onnx "
-            "opset-23 Attention op is not — re-export the attention "
-            "block as composed MatMul/Softmax ops or the contrib form")
+        # the standard ai.onnx opset-23 Attention shares this op_type
+        # but not this signature: its first three inputs are separate
+        # Q/K/V tensors (3-D or 4-D), not (input, [H,3H] weights)
+        return _standard_attention(ctx, x, weights, bias, mask_index,
+                                   past, attention_bias)
     num_heads = int(ctx.attr("num_heads", 0))
     if num_heads <= 0:
         raise ValueError("Attention needs the num_heads attribute")

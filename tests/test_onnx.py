@@ -3732,3 +3732,96 @@ def test_fused_encoder_layer_equals_unfused_composition():
     mr = import_model(gr.to_bytes())
     raw = np.asarray(mr.apply(mr.params, want_att, x)[0])
     np.testing.assert_allclose(fused, raw, atol=2e-4)
+
+
+def test_standard_attention_opset23_matches_torch_sdpa():
+    """Standard ai.onnx Attention (opset 23, what torch's newest
+    exporter emits): 4-D GQA causal, 3-D with boolean mask, and
+    scale+softcap+additive mask — all against torch SDPA / a literal
+    reference."""
+    rng = np.random.default_rng(0)
+    b, nq, nk, s, t, d = 2, 4, 2, 5, 5, 8
+    q = rng.normal(size=(b, nq, s, d)).astype(np.float32)
+    k = rng.normal(size=(b, nk, t, d)).astype(np.float32)
+    v = rng.normal(size=(b, nk, t, d)).astype(np.float32)
+
+    g = GraphBuilder(opset=23)
+    qi = g.add_input("q", np.float32, list(q.shape))
+    ki = g.add_input("k", np.float32, list(k.shape))
+    vi = g.add_input("v", np.float32, list(v.shape))
+    g.add_output(g.add_node("Attention", [qi, ki, vi], is_causal=1),
+                 np.float32, None)
+    m = import_model(g.to_bytes())
+    got = np.asarray(m.apply(m.params, q, k, v)[0])
+    want = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v),
+        is_causal=True, enable_gqa=True).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # cross-length causal: top-left (tril) alignment per spec — s != t
+    # is where top-left and bottom-right diverge
+    qs = q[:, :, :3]
+    got_s = np.asarray(m.apply(m.params, qs, k, v)[0])
+    want_s = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(qs), torch.tensor(k), torch.tensor(v),
+        is_causal=True, enable_gqa=True).numpy()
+    np.testing.assert_allclose(got_s, want_s, atol=1e-5)
+
+    # 3-D layout + boolean mask (broadcast over heads)
+    q3 = rng.normal(size=(b, s, nq * d)).astype(np.float32)
+    k3 = rng.normal(size=(b, t, nk * d)).astype(np.float32)
+    v3 = rng.normal(size=(b, t, nk * d)).astype(np.float32)
+    mask = rng.random((b, 1, s, t)) > 0.3
+    g2 = GraphBuilder(opset=23)
+    qi2 = g2.add_input("q", np.float32, list(q3.shape))
+    ki2 = g2.add_input("k", np.float32, list(k3.shape))
+    vi2 = g2.add_input("v", np.float32, list(v3.shape))
+    mi2 = g2.add_input("m", np.bool_, list(mask.shape))
+    g2.add_output(
+        g2.add_node("Attention", [qi2, ki2, vi2, mi2], q_num_heads=nq,
+                    kv_num_heads=nk), np.float32, None)
+    m2 = import_model(g2.to_bytes())
+    got2 = np.asarray(m2.apply(m2.params, q3, k3, v3, mask)[0])
+
+    def hd(x_, n):
+        return torch.tensor(x_).reshape(b, -1, n, d).permute(0, 2, 1, 3)
+
+    want2 = torch.nn.functional.scaled_dot_product_attention(
+        hd(q3, nq), hd(k3, nk), hd(v3, nk),
+        attn_mask=torch.tensor(mask), enable_gqa=True) \
+        .permute(0, 2, 1, 3).reshape(b, s, nq * d).numpy()
+    np.testing.assert_allclose(got2, np.nan_to_num(want2), atol=1e-5)
+
+    # explicit scale + softcap (Gemma-style) + additive float mask
+    addm = (rng.normal(size=(s, t)) * 2).astype(np.float32)
+    g3 = GraphBuilder(opset=23)
+    qi3 = g3.add_input("q", np.float32, list(q.shape))
+    ki3 = g3.add_input("k", np.float32, list(k.shape))
+    vi3 = g3.add_input("v", np.float32, list(v.shape))
+    mi3 = g3.add_initializer("m", addm)
+    g3.add_output(
+        g3.add_node("Attention", [qi3, ki3, vi3, mi3], scale=0.25,
+                    softcap=5.0), np.float32, None)
+    m3 = import_model(g3.to_bytes())
+    got3 = np.asarray(m3.apply(m3.params, q, k, v)[0])
+    kr, vr = np.repeat(k, 2, 1), np.repeat(v, 2, 1)
+    logits = torch.einsum("bnsd,bntd->bnst", torch.tensor(q),
+                          torch.tensor(kr)) * 0.25
+    logits = 5.0 * torch.tanh(logits / 5.0) + torch.tensor(addm)
+    want3 = torch.einsum("bnst,bntd->bnsd", torch.softmax(logits, -1),
+                         torch.tensor(vr)).numpy()
+    np.testing.assert_allclose(got3, want3, atol=1e-5)
+
+    # RMSNormalization (the opset-23 standard name) aliases the
+    # spec-identical SimplifiedLayerNormalization lowering
+    gamma = rng.normal(size=(nq * d,)).astype(np.float32)
+    g4 = GraphBuilder(opset=23)
+    xi4 = g4.add_input("x", np.float32, [b, s, nq * d])
+    g4.add_output(
+        g4.add_node("RMSNormalization",
+                    [xi4, g4.add_initializer("sc", gamma)],
+                    epsilon=1e-6), np.float32, None)
+    m4 = import_model(g4.to_bytes())
+    got4 = np.asarray(m4.apply(m4.params, q3)[0])
+    want4 = q3 / np.sqrt((q3 ** 2).mean(-1, keepdims=True) + 1e-6) * gamma
+    np.testing.assert_allclose(got4, want4, atol=1e-5)
