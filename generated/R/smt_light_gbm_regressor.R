@@ -5,80 +5,100 @@
 #' @param alpha huber/quantile alpha
 #' @param bagging_fraction row subsample
 #' @param bagging_freq bagging frequency
+#' @param bagging_seed independent seed for the bagging stream (reference baggingSeed); None derives it from seed
 #' @param bin_sample_count rows sampled to construct bin boundaries (reference binSampleCount, TrainParams.scala:17); also caps the cross-host gather of the row-sharded multi-host fit
+#' @param boost_from_average initialize scores from the label average (LightGBM boost_from_average)
 #' @param boosting_type gbdt|rf|dart|goss
 #' @param categorical_slot_indexes categorical feature slots
 #' @param delegate optional LightGBMDelegate with batch/iteration/LR hooks
+#' @param drop_rate DART per-tree drop probability
 #' @param early_stopping_round early stopping patience
 #' @param feature_cols explicit list of scalar feature columns
 #' @param feature_fraction feature subsample per tree
 #' @param features_col features column (2-D) or None to use feature_cols
 #' @param hist_backend histogram formulation: auto (measured probe) / pallas / xla
+#' @param improvement_tolerance metric delta below which an iteration does not count as improved (reference improvementTolerance)
 #' @param label_col label column
 #' @param lambda_l1 L1 regularization
 #' @param lambda_l2 L2 regularization
 #' @param learning_rate shrinkage
 #' @param max_bin histogram bins
 #' @param max_depth max depth, 0=unlimited
+#' @param max_drop DART max trees dropped per iteration (<=0 = no limit)
 #' @param metric eval metric override
 #' @param min_data_in_leaf min rows per leaf
 #' @param min_gain_to_split min split gain
 #' @param min_sum_hessian_in_leaf min hessian per leaf
+#' @param neg_bagging_fraction per-iteration subsample of negative rows (binary only)
 #' @param num_batches split training into N sequential batches, threading the booster from each into the next (ref: LightGBMBase.scala train:46-61)
 #' @param num_iterations boosting rounds
 #' @param num_leaves max leaves per tree
 #' @param objective regression|regression_l1|huber|fair|poisson|quantile|mape|tweedie
 #' @param other_rate GOSS other rate
 #' @param parallelism distributed tree learner (ref LightGBMParams.scala:16-18): data_parallel (full-histogram dp psum) or voting_parallel (PV-tree top_k feature election; merges only elected features' histograms per split)
+#' @param pos_bagging_fraction per-iteration subsample of positive rows (binary only)
 #' @param prediction_col prediction column
 #' @param seed random seed
+#' @param skip_drop DART probability of skipping dropout entirely
 #' @param top_k voting_parallel features elected per split (LightGBM top_k)
 #' @param top_rate GOSS top rate
 #' @param tweedie_variance_power tweedie power
+#' @param uniform_drop DART: True = uniform Bernoulli tree selection; False (LightGBM default) drops proportionally to current tree weight
 #' @param validation_indicator_col bool column marking validation rows
 #' @param verbosity verbosity
 #' @param weight_col sample weight column
+#' @param xgboost_dart_mode DART: normalize dropped rounds with lr/(k+lr) (xgboost's rule) instead of lr/(k+1)
 #' @return a synapseml_tpu estimator handle
 #' @export
-smt_light_gbm_regressor <- function(alpha = 0.9, bagging_fraction = 1.0, bagging_freq = 0, bin_sample_count = 200000, boosting_type = "gbdt", categorical_slot_indexes = NULL, delegate = NULL, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", hist_backend = "auto", label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, num_batches = 0, num_iterations = 100, num_leaves = 31, objective = "regression", other_rate = 0.1, parallelism = "data_parallel", prediction_col = "prediction", seed = 0, top_k = 20, top_rate = 0.2, tweedie_variance_power = 1.5, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL) {
+smt_light_gbm_regressor <- function(alpha = 0.9, bagging_fraction = 1.0, bagging_freq = 0, bagging_seed = NULL, bin_sample_count = 200000, boost_from_average = TRUE, boosting_type = "gbdt", categorical_slot_indexes = NULL, delegate = NULL, drop_rate = 0.1, early_stopping_round = 0, feature_cols = NULL, feature_fraction = 1.0, features_col = "features", hist_backend = "auto", improvement_tolerance = 0.0, label_col = "label", lambda_l1 = 0.0, lambda_l2 = 0.0, learning_rate = 0.1, max_bin = 255, max_depth = -1, max_drop = 50, metric = NULL, min_data_in_leaf = 20, min_gain_to_split = 0.0, min_sum_hessian_in_leaf = 0.001, neg_bagging_fraction = 1.0, num_batches = 0, num_iterations = 100, num_leaves = 31, objective = "regression", other_rate = 0.1, parallelism = "data_parallel", pos_bagging_fraction = 1.0, prediction_col = "prediction", seed = 0, skip_drop = 0.5, top_k = 20, top_rate = 0.2, tweedie_variance_power = 1.5, uniform_drop = FALSE, validation_indicator_col = NULL, verbosity = -1, weight_col = NULL, xgboost_dart_mode = FALSE) {
   mod <- reticulate::import("synapseml_tpu.gbdt.estimators")
   kwargs <- Filter(Negate(is.null), list(
     alpha = alpha,
     bagging_fraction = bagging_fraction,
     bagging_freq = bagging_freq,
+    bagging_seed = bagging_seed,
     bin_sample_count = bin_sample_count,
+    boost_from_average = boost_from_average,
     boosting_type = boosting_type,
     categorical_slot_indexes = categorical_slot_indexes,
     delegate = delegate,
+    drop_rate = drop_rate,
     early_stopping_round = early_stopping_round,
     feature_cols = feature_cols,
     feature_fraction = feature_fraction,
     features_col = features_col,
     hist_backend = hist_backend,
+    improvement_tolerance = improvement_tolerance,
     label_col = label_col,
     lambda_l1 = lambda_l1,
     lambda_l2 = lambda_l2,
     learning_rate = learning_rate,
     max_bin = max_bin,
     max_depth = max_depth,
+    max_drop = max_drop,
     metric = metric,
     min_data_in_leaf = min_data_in_leaf,
     min_gain_to_split = min_gain_to_split,
     min_sum_hessian_in_leaf = min_sum_hessian_in_leaf,
+    neg_bagging_fraction = neg_bagging_fraction,
     num_batches = num_batches,
     num_iterations = num_iterations,
     num_leaves = num_leaves,
     objective = objective,
     other_rate = other_rate,
     parallelism = parallelism,
+    pos_bagging_fraction = pos_bagging_fraction,
     prediction_col = prediction_col,
     seed = seed,
+    skip_drop = skip_drop,
     top_k = top_k,
     top_rate = top_rate,
     tweedie_variance_power = tweedie_variance_power,
+    uniform_drop = uniform_drop,
     validation_indicator_col = validation_indicator_col,
     verbosity = verbosity,
-    weight_col = weight_col
+    weight_col = weight_col,
+    xgboost_dart_mode = xgboost_dart_mode
   ))
   do.call(mod$LightGBMRegressor, kwargs)
 }

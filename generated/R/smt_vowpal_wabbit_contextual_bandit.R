@@ -6,6 +6,7 @@
 #' @param batch_size minibatch size
 #' @param chosen_action_col 1-based chosen action index column
 #' @param cost_col cost column (lower is better)
+#' @param epsilon epsilon-greedy exploration at prediction: greedy action gets 1-eps+eps/K, others eps/K (reference epsilon / VW --cb_explore_adf)
 #' @param features_col hashed features column prefix (expects _idx/_val)
 #' @param initial_model warm-start state (ref: initialModel bytes)
 #' @param initial_t lr schedule offset
@@ -25,13 +26,14 @@
 #' @param weight_col name of the sample-weight column
 #' @return a synapseml_tpu estimator handle
 #' @export
-smt_vowpal_wabbit_contextual_bandit <- function(action_features_col = "action_features", batch_size = 256, chosen_action_col = "chosenAction", cost_col = "cost", features_col = "features", initial_model = NULL, initial_t = 0.0, l1 = 0.0, l2 = 0.0, label_col = "label", learning_rate = 0.5, num_bits = 18, num_passes = 1, optimizer = "adagrad", power_t = 0.5, prediction_col = "prediction", probability_col = "probability", seed = 0, shared_col = "shared", use_mesh = FALSE, weight_col = NULL) {
+smt_vowpal_wabbit_contextual_bandit <- function(action_features_col = "action_features", batch_size = 256, chosen_action_col = "chosenAction", cost_col = "cost", epsilon = 0.05, features_col = "features", initial_model = NULL, initial_t = 0.0, l1 = 0.0, l2 = 0.0, label_col = "label", learning_rate = 0.5, num_bits = 18, num_passes = 1, optimizer = "adagrad", power_t = 0.5, prediction_col = "prediction", probability_col = "probability", seed = 0, shared_col = "shared", use_mesh = FALSE, weight_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.linear.estimators")
   kwargs <- Filter(Negate(is.null), list(
     action_features_col = action_features_col,
     batch_size = batch_size,
     chosen_action_col = chosen_action_col,
     cost_col = cost_col,
+    epsilon = epsilon,
     features_col = features_col,
     initial_model = initial_model,
     initial_t = initial_t,

@@ -1,6 +1,7 @@
 #' VowpalWabbitContextualBanditModel
 #'
 #' @param action_features_col per-action hashed features column
+#' @param epsilon epsilon-greedy exploration pmf parameter
 #' @param features_col hashed features column prefix
 #' @param performance_statistics training perf stats
 #' @param prediction_col name of the prediction column
@@ -9,10 +10,11 @@
 #' @param train_params VWParams used at fit time
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_vowpal_wabbit_contextual_bandit_model <- function(action_features_col = "action_features", features_col = "features", performance_statistics = NULL, prediction_col = "prediction", shared_col = "shared", state = NULL, train_params = NULL) {
+smt_vowpal_wabbit_contextual_bandit_model <- function(action_features_col = "action_features", epsilon = 0.05, features_col = "features", performance_statistics = NULL, prediction_col = "prediction", shared_col = "shared", state = NULL, train_params = NULL) {
   mod <- reticulate::import("synapseml_tpu.linear.estimators")
   kwargs <- Filter(Negate(is.null), list(
     action_features_col = action_features_col,
+    epsilon = epsilon,
     features_col = features_col,
     performance_statistics = performance_statistics,
     prediction_col = prediction_col,

@@ -5,17 +5,21 @@
 #' @param input_cols columns to featurize
 #' @param num_bits hash space = 2^num_bits
 #' @param output_col name of the output column
+#' @param prefix_strings_with_column_name hash string features as 'col=value' (reference default); False hashes the bare value, letting equal values in different columns share weights
 #' @param seed murmur seed (namespace analogue)
+#' @param string_split_input_cols string columns split on whitespace — one feature per token (reference stringSplitInputCols)
 #' @param sum_collisions sum colliding values (vs overwrite)
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_vowpal_wabbit_featurizer <- function(input_cols = NULL, num_bits = 18, output_col = "output", seed = 0, sum_collisions = TRUE) {
+smt_vowpal_wabbit_featurizer <- function(input_cols = NULL, num_bits = 18, output_col = "output", prefix_strings_with_column_name = TRUE, seed = 0, string_split_input_cols = NULL, sum_collisions = TRUE) {
   mod <- reticulate::import("synapseml_tpu.linear.featurizer")
   kwargs <- Filter(Negate(is.null), list(
     input_cols = input_cols,
     num_bits = num_bits,
     output_col = output_col,
+    prefix_strings_with_column_name = prefix_strings_with_column_name,
     seed = seed,
+    string_split_input_cols = string_split_input_cols,
     sum_collisions = sum_collisions
   ))
   do.call(mod$VowpalWabbitFeaturizer, kwargs)

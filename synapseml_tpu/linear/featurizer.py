@@ -62,7 +62,7 @@ class VowpalWabbitFeaturizer(Transformer, HasOutputCol):
             v = col[i]
             if col.ndim == 2 and col.dtype != object:
                 for j, x in enumerate(np.asarray(v, np.float64)):
-                    if x != 0:
+                    if x != 0 and not np.isnan(x):  # null slots emit nothing
                         feats.append((_hash_feature(f"{c}_{j}", bits, seed), float(x)))
             elif isinstance(v, (list, tuple, np.ndarray)):
                 for tok in v:

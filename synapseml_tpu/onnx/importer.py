@@ -3159,6 +3159,7 @@ def _standard_attention(ctx, q, k, v, attn_mask=None, past_key=None,
                       v.shape[2] // nk).transpose(0, 2, 1, 3)
     b, nq, s, head = q.shape
     nk, t_kv = k.shape[1], k.shape[2]
+    dv = v.shape[-1]  # the spec allows V's head size to differ from QK's
     if nq % nk:
         raise ValueError(
             f"Attention q heads {nq} not a multiple of kv heads {nk}")
@@ -3168,9 +3169,7 @@ def _standard_attention(ctx, q, k, v, attn_mask=None, past_key=None,
     scale = ctx.attr("scale", 0.0) or 1.0 / math.sqrt(head)
     logits = jnp.einsum("bkgsd,bktd->bkgst", qg,
                         k.astype(jnp.float32)) * scale
-    softcap = float(ctx.attr("softcap", 0.0))
-    if softcap > 0.0:
-        logits = softcap * jnp.tanh(logits / softcap)
+    bool_mask = None
     if attn_mask is not None:
         m = jnp.asarray(attn_mask)
         # right-align onto [B, N, S, T] then add the group axis
@@ -3181,9 +3180,18 @@ def _standard_attention(ctx, q, k, v, attn_mask=None, past_key=None,
             m5 = m4.reshape(m4.shape[0], nk, group,
                             m4.shape[2], m4.shape[3])
         if m.dtype == jnp.bool_ or m.dtype == np.bool_:
-            logits = jnp.where(m5, logits, -jnp.inf)
-        else:  # additive float mask, the exporter's other convention
+            bool_mask = m5
+        else:
+            # additive float mask ADDS BEFORE softcap (the spec's
+            # Add -> softcap -> Softmax node order)
             logits = logits + m5.astype(jnp.float32)
+    softcap = float(ctx.attr("softcap", 0.0))
+    if softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
+    # hard masking applies AFTER softcap: folding a -inf into the tanh
+    # would collapse it to -softcap and silently unmask the position
+    if bool_mask is not None:
+        logits = jnp.where(bool_mask, logits, -jnp.inf)
     if bool(ctx.attr("is_causal", 0)):
         # top-left alignment: query i attends keys j <= i (the spec's
         # tril(ones(S, T)) and torch SDPA's is_causal)
@@ -3192,9 +3200,9 @@ def _standard_attention(ctx, q, k, v, attn_mask=None, past_key=None,
     probs = jax.nn.softmax(logits, axis=-1)
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked rows
     out = jnp.einsum("bkgst,bktd->bkgsd", probs, v.astype(jnp.float32))
-    out = out.reshape(b, nq, s, head).astype(dt)
+    out = out.reshape(b, nq, s, dv).astype(dt)
     if three_d:
-        out = out.transpose(0, 2, 1, 3).reshape(b, s, nq * head)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, nq * dv)
     return out
 
 

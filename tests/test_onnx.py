@@ -3807,10 +3807,28 @@ def test_standard_attention_opset23_matches_torch_sdpa():
     kr, vr = np.repeat(k, 2, 1), np.repeat(v, 2, 1)
     logits = torch.einsum("bnsd,bntd->bnst", torch.tensor(q),
                           torch.tensor(kr)) * 0.25
-    logits = 5.0 * torch.tanh(logits / 5.0) + torch.tensor(addm)
+    # spec node order: Add(mask) BEFORE softcap
+    logits = 5.0 * torch.tanh((logits + torch.tensor(addm)) / 5.0)
     want3 = torch.einsum("bnst,bntd->bnsd", torch.softmax(logits, -1),
                          torch.tensor(vr)).numpy()
     np.testing.assert_allclose(got3, want3, atol=1e-5)
+
+    # V head size differing from QK head size (spec-legal)
+    dv = 4
+    v5 = rng.normal(size=(b, nk, t, dv)).astype(np.float32)
+    g5 = GraphBuilder(opset=23)
+    qi5 = g5.add_input("q", np.float32, list(q.shape))
+    ki5 = g5.add_input("k", np.float32, list(k.shape))
+    vi5 = g5.add_input("v", np.float32, list(v5.shape))
+    g5.add_output(g5.add_node("Attention", [qi5, ki5, vi5]),
+                  np.float32, None)
+    m5_ = import_model(g5.to_bytes())
+    got5 = np.asarray(m5_.apply(m5_.params, q, k, v5)[0])
+    want5 = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v5),
+        enable_gqa=True).numpy()
+    assert got5.shape == (b, nq, s, dv)
+    np.testing.assert_allclose(got5, want5, atol=1e-5)
 
     # RMSNormalization (the opset-23 standard name) aliases the
     # spec-identical SimplifiedLayerNormalization lowering
