@@ -381,8 +381,14 @@ class Booster:
     def num_trees(self) -> int:
         return self.trees_feature.shape[0]
 
-    def _raw_scores(self, x: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        """[N] or [N, K] raw margin scores, computed with a device scan."""
+    def _raw_scores(self, x: np.ndarray, num_iteration: int = -1,
+                    start_iteration: int = 0) -> np.ndarray:
+        """[N] or [N, K] raw margin scores, computed with a device scan.
+
+        ``start_iteration``/``num_iteration`` select an iteration RANGE
+        (lib_lightgbm's predict window, the reference's startIteration /
+        numIterations model params) — the init score attaches only when
+        the window starts at 0, matching LightGBM."""
         x = np.asarray(x, dtype=np.float32)
         if self.num_features > 0 and x.shape[1] != self.num_features:
             raise ValueError(
@@ -390,40 +396,50 @@ class Booster:
                 f"{self.num_features} features, got {x.shape[1]}")
         k = self.num_class
         t = self.num_trees
+        t0 = max(0, int(start_iteration)) * k
         if num_iteration and num_iteration > 0:
-            t = min(t, num_iteration * k)
-        elif self.best_iteration >= 0:
-            # after early stopping, default to the best iteration (LightGBM)
+            t = min(t, t0 + num_iteration * k)
+        elif self.best_iteration >= 0 and t0 == 0:
+            # after early stopping, default to the best iteration — but
+            # only for whole-model predicts: an explicit start window
+            # with unset num_iteration means "all remaining trees"
+            # (lib_lightgbm sets num_iteration=-1 when start > 0)
             t = min(t, (self.best_iteration + 1) * k)
+        t = max(t, t0)
         stack = (
-            jnp.asarray(self.trees_feature[:t]),
-            jnp.asarray(self.trees_threshold[:t]),
-            jnp.asarray(self.trees_left[:t]),
-            jnp.asarray(self.trees_right[:t]),
-            jnp.asarray(self.trees_value[:t]),
+            jnp.asarray(self.trees_feature[t0:t]),
+            jnp.asarray(self.trees_threshold[t0:t]),
+            jnp.asarray(self.trees_left[t0:t]),
+            jnp.asarray(self.trees_right[t0:t]),
+            jnp.asarray(self.trees_value[t0:t]),
         )
-        weights = jnp.asarray(self.tree_weights[:t], jnp.float32)
-        if self.params.boosting_type == "rf" and t > 0:
+        weights = jnp.asarray(self.tree_weights[t0:t], jnp.float32)
+        n_used = t - t0
+        if self.params.boosting_type == "rf" and n_used > 0:
             # rf margins are averages over the trees actually used, so a
             # truncated predict (early stopping / num_iteration) must
             # renormalize from 1/T_total to 1/T_kept
-            weights = jnp.full((t,), 1.0 / max(t // k, 1), jnp.float32)
+            weights = jnp.full((n_used,), 1.0 / max(n_used // k, 1),
+                               jnp.float32)
         if self.trees_cat is not None:
             out = _predict_stack_cat(
-                stack + (jnp.asarray(self.trees_cat[:t]),),
+                stack + (jnp.asarray(self.trees_cat[t0:t]),),
                 weights, jnp.asarray(x),
                 jnp.asarray(self.cat_bitsets, jnp.uint32),
-                jnp.asarray(self.cat_boundaries, jnp.int32), k, t)
+                jnp.asarray(self.cat_boundaries, jnp.int32), k, n_used)
         else:
-            out = _predict_stack(stack, weights, jnp.asarray(x), k, t)
-        out = np.asarray(out) + self.init_score
+            out = _predict_stack(stack, weights, jnp.asarray(x), k, n_used)
+        out = np.asarray(out)
+        if t0 == 0:
+            out = out + self.init_score
         return out if k > 1 else out[:, 0]
 
-    def predict_raw(self, x, num_iteration: int = -1):
-        return self._raw_scores(x, num_iteration)
+    def predict_raw(self, x, num_iteration: int = -1,
+                    start_iteration: int = 0):
+        return self._raw_scores(x, num_iteration, start_iteration)
 
-    def predict(self, x, num_iteration: int = -1):
-        raw = self._raw_scores(x, num_iteration)
+    def predict(self, x, num_iteration: int = -1, start_iteration: int = 0):
+        raw = self._raw_scores(x, num_iteration, start_iteration)
         o = self.params.objective
         if o in ("binary", "binary_logloss"):
             return 1.0 / (1.0 + np.exp(-self.params.sigmoid * raw))

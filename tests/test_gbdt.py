@@ -955,3 +955,50 @@ def test_improvement_tolerance_early_stopping():
     assert tr0.record(0.72, 1) is False         # resets with tol=0
     assert tr0.record(0.73, 2) is False
     assert tr0.best_iter == 2
+
+
+def test_predict_start_iteration_window():
+    """start_iteration/num_iteration select an iteration range, and the
+    windows compose additively (lib_lightgbm's predict window; the
+    reference's startIteration model param)."""
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(300, 5))
+    y = x[:, 0] * 2 + x[:, 1] + rng.normal(0, 0.1, 300)
+    b = train(BoostParams(objective="regression", num_iterations=20,
+                          boost_from_average=True), x, y)
+    full = b.predict_raw(x)
+    head = b.predict_raw(x, num_iteration=8)
+    tail = b.predict_raw(x, start_iteration=8)
+    # init score attaches once (to the window starting at 0), so the
+    # two windows sum exactly to the full prediction
+    np.testing.assert_allclose(head + tail, full, rtol=1e-5, atol=1e-5)
+    mid = b.predict_raw(x, num_iteration=4, start_iteration=8)
+    win = b.predict_raw(x, num_iteration=12) - head
+    np.testing.assert_allclose(mid, win, rtol=1e-4, atol=1e-5)
+
+    # early-stopped model: whole-model predict truncates at best_iter,
+    # but an explicit start window means "all remaining trees"
+    # (lib_lightgbm sets num_iteration=-1 whenever start_iteration > 0)
+    b2 = train(BoostParams(objective="regression", num_iterations=20),
+               x, y)
+    b2 = dataclasses_replace_booster(b2, best_iteration=4)
+    np.testing.assert_allclose(
+        b2.predict_raw(x, start_iteration=2),
+        b2.predict_raw(x, num_iteration=18, start_iteration=2),
+        rtol=1e-6)
+    assert not np.allclose(b2.predict_raw(x),
+                           b2.predict_raw(x, start_iteration=0,
+                                          num_iteration=20))
+
+
+def dataclasses_replace_booster(b, **kw):
+    import dataclasses as _dc
+    return _dc.replace(b, **kw) if _dc.is_dataclass(b) else _replace(b, kw)
+
+
+def _replace(b, kw):
+    import copy
+    b2 = copy.copy(b)
+    for k_, v_ in kw.items():
+        setattr(b2, k_, v_)
+    return b2
