@@ -381,6 +381,12 @@ class Booster:
     def num_trees(self) -> int:
         return self.trees_feature.shape[0]
 
+    @property
+    def num_iterations(self) -> int:
+        """Boosting iterations = trees / classes (multiclass stacks K
+        class trees per iteration)."""
+        return self.num_trees // max(self.num_class, 1)
+
     def _raw_scores(self, x: np.ndarray, num_iteration: int = -1,
                     start_iteration: int = 0) -> np.ndarray:
         """[N] or [N, K] raw margin scores, computed with a device scan.

@@ -221,6 +221,38 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         from synapseml_tpu.gbdt.shap import tree_shap
         return tree_shap(self.booster, self._features(table))
 
+    def get_feature_shaps(self, features) -> List[float]:
+        """Per-feature SHAP values (+ expected value last) for ONE row,
+        flattened to K*(F+1) floats for multiclass — the reference's
+        flat-array contract
+        (ref: LightGBMModelMethods.scala getFeatureShaps:27)."""
+        from synapseml_tpu.gbdt.shap import tree_shap
+        row = np.asarray(features, np.float64).reshape(1, -1)
+        nf = self.booster.num_features
+        if nf > 0 and row.shape[1] != nf:
+            raise ValueError(
+                f"feature width mismatch: model trained on {nf} "
+                f"features, got {row.shape[1]}")
+        return list(np.asarray(tree_shap(self.booster, row)[0],
+                               float).ravel())
+
+    # booster introspection getters
+    # (ref: LightGBMModelMethods.scala:55-96)
+    def get_booster_best_iteration(self) -> int:
+        return int(self.booster.best_iteration)
+
+    def get_booster_num_total_iterations(self) -> int:
+        return int(self.booster.num_iterations)
+
+    def get_booster_num_total_model(self) -> int:
+        return int(self.booster.num_trees)
+
+    def get_booster_num_features(self) -> int:
+        return int(self.booster.num_features)
+
+    def get_booster_num_classes(self) -> int:
+        return int(self.booster.num_class)
+
     def save_native_model(self, path: str):
         """Write the booster in LightGBM's native text format
         (ref: LightGBMBooster.scala:454 saveNativeModel)."""

@@ -981,7 +981,8 @@ def test_predict_start_iteration_window():
     # (lib_lightgbm sets num_iteration=-1 whenever start_iteration > 0)
     b2 = train(BoostParams(objective="regression", num_iterations=20),
                x, y)
-    b2 = dataclasses_replace_booster(b2, best_iteration=4)
+    import dataclasses
+    b2 = dataclasses.replace(b2, best_iteration=4)
     np.testing.assert_allclose(
         b2.predict_raw(x, start_iteration=2),
         b2.predict_raw(x, num_iteration=18, start_iteration=2),
@@ -991,14 +992,37 @@ def test_predict_start_iteration_window():
                                           num_iteration=20))
 
 
-def dataclasses_replace_booster(b, **kw):
-    import dataclasses as _dc
-    return _dc.replace(b, **kw) if _dc.is_dataclass(b) else _replace(b, kw)
+
+def test_model_introspection_getters():
+    """Reference model-methods surface
+    (LightGBMModelMethods.scala:27-96): single-row SHAP and the booster
+    introspection getters."""
+    rng = np.random.default_rng(19)
+    x = rng.normal(size=(200, 6))
+    y = (x[:, 0] > 0).astype(np.float64)
+    m = LightGBMClassifier(num_iterations=8).fit(
+        Table({"features": x, "label": y}))
+    assert m.get_booster_num_features() == 6
+    assert m.get_booster_num_classes() == 1      # binary: one score
+    assert m.get_booster_num_total_iterations() == 8
+    assert m.get_booster_num_total_model() == 8
+    assert m.get_booster_best_iteration() == -1  # no early stopping ran
+    shaps = m.get_feature_shaps(x[0])
+    assert len(shaps) == 7                       # 6 features + expected
+    np.testing.assert_allclose(
+        sum(shaps), m.booster.predict_raw(x[:1])[0], atol=1e-4)
 
 
-def _replace(b, kw):
-    import copy
-    b2 = copy.copy(b)
-    for k_, v_ in kw.items():
-        setattr(b2, k_, v_)
-    return b2
+def test_feature_shaps_multiclass_flat_contract():
+    """Multiclass get_feature_shaps flattens to K*(F+1) floats (the
+    reference's flat-array contract); wrong row width raises clearly."""
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(150, 4))
+    y = rng.integers(0, 3, 150).astype(np.float64)
+    m = LightGBMClassifier(objective="multiclass", num_iterations=4).fit(
+        Table({"features": x, "label": y}))  # 3 classes inferred
+    shaps = m.get_feature_shaps(x[0])
+    assert len(shaps) == 3 * (4 + 1)
+    assert all(isinstance(v, float) for v in shaps)
+    with pytest.raises(ValueError, match="feature width"):
+        m.get_feature_shaps(x[0][:2])
