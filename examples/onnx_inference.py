@@ -10,6 +10,7 @@ import os
 import threading
 import urllib.request
 
+import jax
 import numpy as np
 
 from synapseml_tpu.data.table import Table
@@ -36,7 +37,10 @@ def main():
     g = import_model(fx)
     io = np.load(fx.replace(".onnx", "_io.npz"))
     got = np.asarray(g.apply(g.params, io["input"])[0])
-    np.testing.assert_allclose(got, io["expected"], atol=1e-5, rtol=1e-5)
+    # TPU MXU matmuls round f32 operands through bf16 at default
+    # precision (~1e-3 relative); CPU reproduces torch to 1e-5
+    tol = 1e-5 if jax.default_backend() == "cpu" else 3e-3
+    np.testing.assert_allclose(got, io["expected"], atol=tol, rtol=tol)
     print("foreign torch-exported .onnx parity: ok")
 
     # 3. serve the ONNX scorer over HTTP

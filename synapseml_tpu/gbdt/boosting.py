@@ -724,7 +724,7 @@ def _chunk_callbacks(checkpoint_dir, init_model, p, k, init, f,
                 feature_names, tracker, compute_importances=False,
                 init_model=init_model)
             if init_model is not None and booster.best_iteration >= 0:
-                booster.best_iteration += init_model.num_trees // max(k, 1)
+                booster.best_iteration += init_model.num_iterations
             save_checkpoint(checkpoint_dir, booster, iters_done,
                             p.num_iterations)
     if ckpt is None and iteration_hook is None:
@@ -1153,7 +1153,7 @@ def train(
             # (full stack, not best_iteration-truncated — see above)
             vraw = init_model.predict_raw(
                 np.asarray(tracker.sets[0][0]),
-                num_iteration=init_model.num_trees // max(k, 1))
+                num_iteration=init_model.num_iterations)
             vsum0 = jnp.asarray(
                 vraw.reshape(-1, k) - init, jnp.float32)
     else:
@@ -1222,7 +1222,7 @@ def train(
         feature_names, tracker, init_model=init_model)
     if init_model is not None and booster.best_iteration >= 0:
         # best_iteration indexes the combined tree stack
-        booster.best_iteration += init_model.num_trees // max(k, 1)
+        booster.best_iteration += init_model.num_iterations
     return booster
 
 
@@ -1584,7 +1584,7 @@ def _resume_state(p, init_model, k, x, default_init):
     if init_model.num_class != k:
         raise ValueError("init_model num_class mismatch")
     init = float(init_model.init_score)
-    n_init_iters = init_model.num_trees // max(k, 1)
+    n_init_iters = init_model.num_iterations
     margins = init_model.predict_raw(
         x, num_iteration=n_init_iters).reshape(x.shape[0], k)
     return init, margins
@@ -1879,7 +1879,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
             # valid margins must include the resumed model's contribution
             vraw = init_model.predict_raw(
                 np.asarray(tracker.sets[0][0]),
-                num_iteration=init_model.num_trees // max(k, 1))
+                num_iteration=init_model.num_iterations)
             vsum0 = put(np.asarray(vraw).reshape(-1, k).astype(np.float32)
                         - init, rep)
         else:
@@ -2145,7 +2145,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
         dart_w_final=dart_w_final if is_dart else None,
         init_model=init_model)
     if init_model is not None and booster.best_iteration >= 0:
-        booster.best_iteration += init_model.num_trees // max(k, 1)
+        booster.best_iteration += init_model.num_iterations
     return booster
 
 

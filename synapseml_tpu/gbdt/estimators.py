@@ -228,11 +228,6 @@ class _LightGBMModelBase(Model, _LightGBMParams):
         (ref: LightGBMModelMethods.scala getFeatureShaps:27)."""
         from synapseml_tpu.gbdt.shap import tree_shap
         row = np.asarray(features, np.float64).reshape(1, -1)
-        nf = self.booster.num_features
-        if nf > 0 and row.shape[1] != nf:
-            raise ValueError(
-                f"feature width mismatch: model trained on {nf} "
-                f"features, got {row.shape[1]}")
         return list(np.asarray(tree_shap(self.booster, row)[0],
                                float).ravel())
 

@@ -124,6 +124,14 @@ def tree_shap(booster, x: np.ndarray) -> np.ndarray:
             "splits (loaded native LightGBM model)")
     x = np.asarray(x, np.float64)
     n, f = x.shape
+    nf = int(getattr(booster, "num_features", -1))
+    if nf > 0 and f != nf:
+        # same loud contract as Booster._raw_scores — a narrow row would
+        # otherwise IndexError deep in the recursion, a wide one would
+        # silently drop columns
+        raise ValueError(
+            f"feature width mismatch: model trained on {nf} features, "
+            f"got {f}")
     k = booster.num_class
     out = np.zeros((n, f + 1) if k == 1 else (n, k, f + 1), np.float64)
 
