@@ -3206,6 +3206,90 @@ def _standard_attention(ctx, q, k, v, attn_mask=None, past_key=None,
     return out
 
 
+@op("MultiHeadAttention")
+def _multi_head_attention(ctx, query, key=None, value=None, bias=None,
+                          key_padding_mask=None, attention_bias=None,
+                          past_key=None, past_value=None):
+    """com.microsoft MultiHeadAttention — the post-projection attention
+    fusion newer ORT transformer-optimizer versions emit (cross- and
+    self-attention with already-projected Q/K/V). Supported surface:
+    3-D [B, S, N*D] Q/K/V (+ num_heads attr), combined QKV bias (split
+    at the actual q/k/v widths — v_hidden_size may differ), [B] or
+    [B, T_kv] key padding masks, additive attention_bias, past/present
+    KV cache. The 5-D packed-QKV and 4-D past-format K/V layouts are
+    rejected loudly. The projection-fused form is `Attention`; the
+    standard-domain form is `_standard_attention` — three ops, one
+    einsum chain each."""
+    num_heads = int(ctx.attr("num_heads", 0))
+    if num_heads <= 0:
+        raise ValueError("MultiHeadAttention needs num_heads")
+    q = jnp.asarray(query)
+    if q.ndim != 3:
+        raise NotImplementedError(
+            "MultiHeadAttention supports 3-D [B, S, N*D] inputs; the "
+            "5-D packed-QKV form is not supported — re-export unpacked")
+    b, s, _ = q.shape
+    if key is None or (hasattr(key, "size") and np.size(key) == 0):
+        raise NotImplementedError(
+            "MultiHeadAttention needs separate 3-D key/value inputs "
+            "(the packed-QKV layout is 5-D and unsupported — re-export "
+            "unpacked)")
+    k, v = jnp.asarray(key), jnp.asarray(value)
+    if k.ndim != 3:
+        raise NotImplementedError(
+            "MultiHeadAttention past-format (4-D) K/V inputs are "
+            "not supported; re-export with 3-D K/V + past_key/"
+            "past_value cache inputs")
+    if bias is not None:
+        # ORT layout: (q_hidden | k_hidden | v_hidden) — v may differ
+        bias = jnp.asarray(bias)
+        bq, bk, bv = jnp.split(
+            bias, [q.shape[-1], q.shape[-1] + k.shape[-1]])
+        q, k, v = q + bq, k + bk, v + bv
+    head = q.shape[-1] // num_heads
+
+    def heads(t):
+        return t.reshape(t.shape[0], t.shape[1], num_heads,
+                         -1).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)          # [B, N, S|T, D]
+    if past_key is not None:
+        k = jnp.concatenate([jnp.asarray(past_key, k.dtype), k], axis=2)
+        v = jnp.concatenate([jnp.asarray(past_value, v.dtype), v],
+                            axis=2)
+    present_k, present_v = k, v
+    t_kv = k.shape[2]
+    scale = ctx.attr("scale", 0.0) or 1.0 / math.sqrt(head)
+    logits = jnp.einsum("bnsd,bntd->bnst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if attention_bias is not None:
+        ab = jnp.asarray(attention_bias, jnp.float32)
+        logits = logits + ab.reshape((1,) * (4 - ab.ndim) + ab.shape)
+    neg = jnp.float32(ctx.attr("mask_filter_value", -10000.0))
+    if key_padding_mask is not None:
+        m = jnp.asarray(key_padding_mask)
+        if m.ndim == 1:                             # [B] valid lengths
+            ok = jnp.arange(t_kv)[None, :] < m.astype(jnp.int32)[:, None]
+        elif m.ndim == 2:                           # [B, T_kv] 0/1
+            ok = m != 0
+        else:
+            raise NotImplementedError(
+                "MultiHeadAttention key_padding_mask must be [B] "
+                "lengths or a [B, T_kv] 0/1 mask")
+        logits = logits + jnp.where(ok[:, None, None, :], 0.0, neg)
+    if bool(ctx.attr("unidirectional", 0)):
+        q_pos = (t_kv - s) + jnp.arange(s)[:, None]
+        causal = jnp.arange(t_kv)[None, :] <= q_pos
+        logits = logits + jnp.where(causal[None, None], 0.0, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnst,bntd->bnsd", probs, v.astype(jnp.float32))
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, -1).astype(
+        jnp.asarray(query).dtype)
+    if ctx.n_outputs > 1:
+        return out, present_k, present_v
+    return out
+
+
 @op("Attention")
 def _contrib_attention(ctx, x, weights, bias=None, mask_index=None,
                        past=None, attention_bias=None,

@@ -3843,3 +3843,68 @@ def test_standard_attention_opset23_matches_torch_sdpa():
     got4 = np.asarray(m4.apply(m4.params, q3)[0])
     want4 = q3 / np.sqrt((q3 ** 2).mean(-1, keepdims=True) + 1e-6) * gamma
     np.testing.assert_allclose(got4, want4, atol=1e-5)
+
+
+def test_multi_head_attention_matches_torch():
+    """com.microsoft MultiHeadAttention (post-projection fusion):
+    cross-attention with a combined QKV bias and [B] key lengths, plus
+    the causal self-attention + KV-cache decode step — against torch
+    SDPA references."""
+    rng = np.random.default_rng(4)
+    b, n, s, t, d = 2, 3, 4, 6, 8
+    h = n * d
+    q = rng.normal(size=(b, s, h)).astype(np.float32)
+    k = rng.normal(size=(b, t, h)).astype(np.float32)
+    v = rng.normal(size=(b, t, h)).astype(np.float32)
+    bias = rng.normal(size=(3 * h,)).astype(np.float32)
+    lens = np.array([6, 3], np.int32)
+
+    g = GraphBuilder(opset=17)
+    qi = g.add_input("q", np.float32, [b, s, h])
+    ki = g.add_input("k", np.float32, [b, t, h])
+    vi = g.add_input("v", np.float32, [b, t, h])
+    bi = g.add_initializer("b", bias)
+    mi = g.add_input("m", np.int32, [b])
+    att = g.add_node("MultiHeadAttention", [qi, ki, vi, bi, mi],
+                     domain="com.microsoft", num_heads=n)
+    g.add_output(att, np.float32, None)
+    m = import_model(g.to_bytes())
+    got = np.asarray(m.apply(m.params, q, k, v, lens)[0])
+
+    def hd(x_, sl):
+        return torch.tensor(x_).reshape(b, -1, n, d).permute(0, 2, 1, 3)
+
+    bq, bk, bv = np.split(bias, 3)
+    ok = torch.arange(t)[None, :] < torch.tensor(lens)[:, None]
+    # ORT adds a finite mask floor (-1e4), not -inf
+    addm = torch.where(ok, 0.0, -10000.0)[:, None, None, :]
+    want = torch.nn.functional.scaled_dot_product_attention(
+        hd(q + bq, s), hd(k + bk, t), hd(v + bv, t), attn_mask=addm) \
+        .permute(0, 2, 1, 3).reshape(b, s, h).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+    # causal decode step with a KV cache + present outputs
+    p = 5
+    q1 = rng.normal(size=(b, 1, h)).astype(np.float32)
+    pk = rng.normal(size=(b, n, p, d)).astype(np.float32)
+    pv = rng.normal(size=(b, n, p, d)).astype(np.float32)
+    g2 = GraphBuilder(opset=17)
+    qi2 = g2.add_input("q", np.float32, [b, 1, h])
+    pki = g2.add_input("pk", np.float32, list(pk.shape))
+    pvi = g2.add_input("pv", np.float32, list(pv.shape))
+    o2, prk, prv = g2.add_node(
+        "MultiHeadAttention",
+        [qi2, qi2, qi2, "", "", "", pki, pvi],
+        outputs=["o2", "prk", "prv"], domain="com.microsoft",
+        num_heads=n, unidirectional=1)
+    for nm in (o2, prk, prv):
+        g2.add_output(nm, np.float32, None)
+    m2 = import_model(g2.to_bytes())
+    got2, gk, gv = [np.asarray(o) for o in m2.apply(m2.params, q1, pk, pv)]
+    kc = torch.cat([torch.tensor(pk), hd(q1, 1)], dim=2)
+    vc = torch.cat([torch.tensor(pv), hd(q1, 1)], dim=2)
+    want2 = torch.nn.functional.scaled_dot_product_attention(
+        hd(q1, 1), kc, vc).permute(0, 2, 1, 3).reshape(b, 1, h).numpy()
+    np.testing.assert_allclose(got2, want2, atol=1e-4)
+    assert gk.shape == (b, n, p + 1, d)
+    np.testing.assert_allclose(gk[:, :, :p], pk, atol=1e-6)
