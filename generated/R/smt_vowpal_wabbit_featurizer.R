@@ -7,7 +7,7 @@
 #' @param output_col name of the output column
 #' @param prefix_strings_with_column_name hash string features as 'col=value' (reference default); False hashes the bare value, letting equal values in different columns share weights
 #' @param seed murmur seed (namespace analogue)
-#' @param string_split_input_cols string columns split on whitespace — one feature per token (reference stringSplitInputCols)
+#' @param string_split_input_cols string columns split into unicode word tokens (punctuation stripped) — one feature per BARE token, never column-prefixed (reference stringSplitInputCols / StringSplitFeaturizer.scala)
 #' @param sum_collisions sum colliding values (vs overwrite)
 #' @return a synapseml_tpu transformer handle
 #' @export

@@ -142,14 +142,36 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         return arr
 
     def _transform(self, table: Table) -> Table:
-        imgs = [self._prepare(v) for v in table[self.input_col]]
-        valid = [i for i, v in enumerate(imgs) if v is not None]
+        executor = self._pieces()
+        col = table[self.input_col]
+        mbs = max(1, int(self.mini_batch_size))
+        valid: list = []
+
+        def chunks():
+            # lazy prepare: executor.stream pulls this generator with
+            # pipeline_depth chunks in flight, so decode/resize of chunk
+            # k+1 runs on the host WHILE chunk k computes on device —
+            # the submit/drain overlap a single stacked call can't get
+            buf: list = []
+            for i, v in enumerate(col):
+                arr = self._prepare(v)
+                if arr is None:
+                    continue
+                valid.append(i)
+                buf.append(arr)
+                if len(buf) >= mbs:
+                    yield (np.stack(buf).transpose(0, 3, 1, 2),)
+                    buf = []
+            if buf:
+                yield (np.stack(buf).transpose(0, 3, 1, 2),)
+
+        feat_chunks = [out for (out,) in executor.stream(chunks())]
         if not valid:
             return table.with_column(
                 self.output_col, np.empty(table.num_rows, dtype=object))
-        batch = np.stack([imgs[i] for i in valid]).transpose(0, 3, 1, 2)
-        (feats,) = self._pieces()(batch)
-        feats = np.asarray(feats, np.float32)
+        feats = np.asarray(
+            feat_chunks[0] if len(feat_chunks) == 1
+            else np.concatenate(feat_chunks), np.float32)
         if len(valid) == table.num_rows:
             return table.with_column(self.output_col, feats)
         out = np.empty(table.num_rows, dtype=object)

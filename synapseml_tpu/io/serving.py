@@ -495,7 +495,7 @@ class ContinuousServer:
         requests into ONE scored micro-batch (one device round trip
         amortized over the batch) instead of serial singletons.
 
-        ``pipelined``: run collection and scoring as a two-stage pipeline
+        ``pipelined``: run collection and scoring as a staged pipeline
         (a collector thread drains + lingers on batch k+1 WHILE the device
         scores batch k, and keeps coalescing for as long as every scorer is
         busy — adaptive linger). ``False`` restores the strictly serial
@@ -511,7 +511,15 @@ class ContinuousServer:
         N/RTT while per-request latency stays one RTT (replies are
         per-request ids; epochs commit independently, so ordering is
         preserved per epoch, as in the reference's partition-parallel
-        HTTPSourceV2 writers)."""
+        HTTPSourceV2 writers).
+
+        Pipelined mode is a THREE-stage pipeline: collect -> score ->
+        reply. Reply serialization + socket writes + epoch commits for
+        batch k run on a dedicated reply thread while the scorer already
+        scores batch k+1 — and since the scorer itself feeds the
+        executor's async submit/drain pipeline (runtime/executor.py),
+        host staging, H2D, device compute, and D2H fetch of consecutive
+        micro-batches all overlap instead of alternating."""
         self.server = HTTPSourceStateHolder.get_or_create_server(
             name, host, port, reply_timeout=reply_timeout)
         self.name = name
@@ -527,35 +535,53 @@ class ContinuousServer:
         self._collector: Optional[threading.Thread] = None
         self._extra_scorers: List[threading.Thread] = []
         self._handoff: Optional["queue.Queue"] = None
+        self._reply_q: Optional["queue.Queue"] = None
+        self._reply_thread: Optional[threading.Thread] = None
         self.errors: List[str] = []
 
     @property
     def url(self) -> str:
         return self.server.url
 
-    def _score_batch(self, batch: List[CachedRequest]):
-        """Score one micro-batch and commit its epoch(s) — a pipelined
-        batch may merge several drain epochs (each already recorded for
-        replay), so every distinct epoch is committed."""
-        epochs = sorted({cr.epoch for cr in batch})
+    def _score_only(self, batch: List[CachedRequest]):
+        """Stage 2 of the pipeline: score one micro-batch WITHOUT sending
+        replies. Returns ``(out_table, error)`` — exactly one is None."""
         try:
             table = requests_to_table(batch)
             if self.parse_json:
                 table = parse_request(table)
-            out = self.pipeline_fn(table)
-            send_replies(self.server, out, self.reply_col)
+            return self.pipeline_fn(table), None
         except Exception as e:  # noqa: BLE001 - serving loop must survive
             self.errors.append(repr(e))
+            return None, e
+
+    def _reply_scored(self, batch: List[CachedRequest], out, err):
+        """Stage 3: reply-send + exact epoch commits for one scored batch.
+        A pipelined batch may merge several drain epochs (each already
+        recorded for replay), so every distinct epoch is committed —
+        exact commits, because concurrent workers finish epochs out of
+        order and a cumulative commit of a later epoch would erase an
+        earlier in-flight epoch's replay history."""
+        try:
+            if err is None:
+                try:
+                    send_replies(self.server, out, self.reply_col)
+                    return
+                except Exception as e:  # noqa: BLE001 - bad reply col etc.
+                    self.errors.append(repr(e))
+                    err = e
             for cr in batch:
                 self.server.reply_to(cr.rid, HTTPResponseData(
                     status_code=500, reason="pipeline error",
-                    entity=repr(e).encode()))
+                    entity=repr(err).encode()))
         finally:
-            # exact commits: concurrent workers finish epochs out of
-            # order, and a cumulative commit of a later epoch would
-            # erase an earlier in-flight epoch's replay history
-            for ep in epochs:
+            for ep in sorted({cr.epoch for cr in batch}):
                 self.server.commit(ep, exact=True)
+
+    def _score_batch(self, batch: List[CachedRequest]):
+        """Score + reply inline (the strictly serial path)."""
+        out, err = self._score_only(batch)
+        self._reply_scored(batch, out, err)
 
     def _loop(self):
         while not self._stop.is_set():
@@ -601,17 +627,60 @@ class ContinuousServer:
                 self._fail_batch(batch)
 
     def _score_loop(self, handoff: "queue.Queue"):
+        """Stage 2: score, then hand the scored batch to the reply
+        thread — the scorer starts on batch k+1 while batch k's replies
+        serialize and its epochs commit on the reply thread."""
         while not self._stop.is_set():
             try:
                 batch = handoff.get(timeout=0.05)
             except queue.Empty:
                 continue
-            self._score_batch(batch)
+            out, err = self._score_only(batch)
+            rq = self._reply_q
+            if rq is None or self._stop.is_set():
+                # reply stage not running — or stop() raced a long score
+                # and the reply thread may already have exited: reply
+                # inline so the batch's clients never hang
+                self._reply_scored(batch, out, err)
+                continue
+            rq.put((batch, out, err))
+            if self._stop.is_set():
+                # stop() landed between the check and the put — the
+                # reply thread may have seen an empty queue and exited
+                # (or be about to). Wait out its exit, then drain any
+                # leftovers here (get_nowait races with stop()'s own
+                # drain safely — each item is taken once)
+                self._reply_thread.join(timeout=10)
+                while True:
+                    try:
+                        item = rq.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._reply_scored(*item)
+
+    def _reply_loop(self):
+        """Stage 3: reply-send + commits off the scoring threads. Exits
+        only once stopped AND drained, so scored batches always reply."""
+        while True:
+            try:
+                item = self._reply_q.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            self._reply_scored(*item)
 
     def _pipelined_loop(self):
         handoff: "queue.Queue[List[CachedRequest]]" = queue.Queue(
             maxsize=self.scoring_workers)
         self._handoff = handoff
+        # bounded: a stalled reply sink backpressures scoring instead of
+        # queueing scored-but-unreplied batches without limit
+        self._reply_q = queue.Queue(maxsize=max(2, 2 * self.scoring_workers))
+        self._reply_thread = threading.Thread(
+            target=self._reply_loop, name=f"serving-reply-{self.name}",
+            daemon=True)
+        self._reply_thread.start()
         self._collector = threading.Thread(
             target=self._collect_loop, args=(handoff,),
             name=f"serving-collect-{self.name}", daemon=True)
@@ -639,6 +708,17 @@ class ContinuousServer:
             self._collector.join(timeout=5)
         for t in self._extra_scorers:
             t.join(timeout=5)
+        # scorers are down: the reply thread drains what they queued and
+        # exits (scored batches get their real replies, not 503s)
+        if self._reply_thread is not None:
+            self._reply_thread.join(timeout=5)
+        if self._reply_q is not None:
+            while True:
+                try:
+                    item = self._reply_q.get_nowait()
+                except queue.Empty:
+                    break
+                self._reply_scored(*item)
         # batches parked in the handoff when the scorers exited would
         # leave their clients blocked until reply_timeout: fail them
         # fast with 503 (the old serial loop always finished its batch)

@@ -13,6 +13,7 @@ device as two contiguous blocks and the learner consumes them with gathers
 """
 from __future__ import annotations
 
+import re
 from typing import Any, List, Sequence, Tuple
 
 import numpy as np
@@ -27,6 +28,11 @@ def _hash_feature(name: str, num_bits: int, seed: int) -> int:
     return hash_token(name, seed) & ((1 << num_bits) - 1)
 
 
+# the reference StringSplitFeaturizer tokenizes with the unicode word
+# regex (?U)\w+ — punctuation is stripped, not kept attached to tokens
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+
+
 class VowpalWabbitFeaturizer(Transformer, HasOutputCol):
     """Hash scalar/string/token columns into (idx, val) pairs.
 
@@ -39,8 +45,10 @@ class VowpalWabbitFeaturizer(Transformer, HasOutputCol):
 
     input_cols = Param("columns to featurize", default=None)
     string_split_input_cols = Param(
-        "string columns split on whitespace — one feature per token "
-        "(reference stringSplitInputCols)", default=None)
+        "string columns split into unicode word tokens (punctuation "
+        "stripped) — one feature per BARE token, never column-prefixed "
+        "(reference stringSplitInputCols / StringSplitFeaturizer.scala)",
+        default=None)
     num_bits = Param("hash space = 2^num_bits", default=18)
     seed = Param("murmur seed (namespace analogue)", default=0)
     sum_collisions = Param("sum colliding values (vs overwrite)", default=True)
@@ -79,9 +87,12 @@ class VowpalWabbitFeaturizer(Transformer, HasOutputCol):
             v = table[c][i]
             if v is None or (isinstance(v, float) and np.isnan(v)):
                 continue  # nulls emit nothing, as in the input_cols path
-            for tok in str(v).split():
-                feats.append((_hash_feature(
-                    self._str_name(c, tok), bits, seed), 1.0))
+            # reference parity (StringSplitFeaturizer.scala): unicode-word
+            # tokenization and the BARE token hashed — the column-name
+            # prefix never applies on the string-split path, so equal
+            # tokens share a weight slot across columns
+            for tok in _WORD_RE.findall(str(v)):
+                feats.append((_hash_feature(tok, bits, seed), 1.0))
         return feats
 
     def _transform(self, table: Table) -> Table:

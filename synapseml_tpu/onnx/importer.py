@@ -817,6 +817,7 @@ def _max_pool_with_indices(x, kernel, strides, dilations, pads, init):
     vals = jnp.max(flat, axis=-1)
     amax = jnp.argmax(flat, axis=-1)
     coords = []  # unravel the window argmax into original-tensor coords
+    in_bounds = None
     rem = amax
     for d in reversed(range(rank)):
         kd = rem % kernel[d]
@@ -824,14 +825,31 @@ def _max_pool_with_indices(x, kernel, strides, dilations, pads, init):
         shape = [1] * (2 + rank)
         shape[2 + d] = out_sp[d]
         o_d = jnp.asarray(np.arange(out_sp[d]).reshape(shape))
-        coords.insert(0, o_d * strides[d] + kd * dilations[d] - pads[d][0])
+        raw = o_d * strides[d] + kd * dilations[d] - pads[d][0]
+        # a window that falls ENTIRELY inside the padding has its argmax
+        # on a padded cell, whose recovered coordinate lands outside
+        # [0, sp[d]-1]: unguarded, the negative flat index WRAPS under
+        # MaxUnpool's scatter and corrupts the tensor tail. Track
+        # in-bounds-ness and clamp the coordinate so the flat index
+        # stays well-formed either way
+        ok_d = (raw >= 0) & (raw < sp[d])
+        in_bounds = ok_d if in_bounds is None else (in_bounds & ok_d)
+        coords.insert(0, jnp.clip(raw, 0, sp[d] - 1))
     flat_sp = coords[0]
     for d in range(1, rank):
         flat_sp = flat_sp * sp[d] + coords[d]
     n_idx = jnp.arange(n).reshape((n,) + (1,) * (1 + rank))
     c_idx = jnp.arange(c).reshape((1, c) + (1,) * rank)
     gidx = (n_idx * c + c_idx) * int(np.prod(sp)) + flat_sp
-    return vals, gidx.astype(jnp.int64)
+    # degenerate (all-padding) windows take the dtype-max sentinel:
+    # non-negative (no wraparound) and out of range for ANY unpool
+    # output — including a spec-sanctioned output_shape LARGER than the
+    # pool input, which an input-sized sentinel would land inside — so
+    # MaxUnpool's .at[].set() drops the update instead of colliding
+    # with a real cell
+    gidx = gidx.astype(jnp.int64)
+    gidx = jnp.where(in_bounds, gidx, jnp.iinfo(gidx.dtype).max)
+    return vals, gidx
 
 
 @op("MaxUnpool")

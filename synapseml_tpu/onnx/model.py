@@ -13,7 +13,7 @@ compute. Softmax/argmax post-processing columns mirror the reference
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, Iterator, List, Optional
 
 import jax
 import numpy as np
@@ -214,9 +214,39 @@ class ONNXModel(Transformer):
         return cache[key]
 
     def _transform(self, table: Table) -> Table:
-        g = self.graph
+        # ride the executor's shared submit/drain pipeline: concurrent
+        # _transform callers (serving scoring workers) overlap their host
+        # staging, H2D, compute, and D2H instead of each serializing a
+        # private dispatch->fetch loop
         feeds = self._resolve_feeds(table)
-        outs = self._executor()(*feeds)
+        # keep a strong ref across result(): a concurrent config change
+        # may evict this executor from the cache, and the pipeline holds
+        # it only weakly — dropping it mid-flight would fail the future
+        ex = self._executor()
+        outs = ex.submit(*feeds).result()
+        return self._attach_outputs(table, outs)
+
+    def transform_stream(self, tables: Iterable[Table]) -> Iterator[Table]:
+        """Score an iterable of tables with ``pipeline_depth`` mini-batches
+        in flight, yielding transformed tables in order — batch k+1's host
+        staging and H2D copy overlap batch k's compute and D2H fetch
+        (the cross-call counterpart of the reference's IOBinding overlap,
+        ref: ONNXModel.scala:357-402)."""
+        from collections import deque
+
+        ex = self._executor()
+        pending: "deque" = deque()
+        for table in tables:
+            pending.append((table, ex.submit(*self._resolve_feeds(table))))
+            while len(pending) > ex.pipeline_depth:
+                t, fut = pending.popleft()
+                yield self._attach_outputs(t, fut.result())
+        while pending:
+            t, fut = pending.popleft()
+            yield self._attach_outputs(t, fut.result())
+
+    def _attach_outputs(self, table: Table, outs) -> Table:
+        g = self.graph
         fetch = self.fetch_dict or {n: n for n in g.output_names}
         by_name = dict(zip(g.output_names, outs))
         new_cols: Dict[str, np.ndarray] = {}

@@ -10,18 +10,28 @@ with a jit-cache-aware executor:
   compilations, then runs hot.
 - **dtype coercion**: host columns are coerced once (e.g. f64→f32→bf16) before
   a single contiguous ``device_put`` — no per-row marshalling hot loop.
-- **Pipelined feed**: jax dispatch is asynchronous, so the executor keeps
-  ``pipeline_depth`` batches in flight — batch N+1's host→device copy and
-  compute are dispatched *before* blocking on batch N's device→host fetch,
-  hiding transfer latency behind compute (the role ORT's IOBinding plays
-  for the reference). Inputs are donated to XLA on non-CPU backends so
-  same-bucket batches reuse device buffers instead of allocating.
+- **Async submit/drain pipeline**: every call rides a per-executor pipeline of
+  (a) a bounded host-staging worker pool (coerce + pad off the dispatch
+  thread), (b) an ordered dispatch thread that starts the async H2D copy and
+  compute, and (c) a dedicated drain thread whose blocking ``device_get``
+  never stalls the next batch's staging or dispatch. :meth:`submit` returns a
+  future; :meth:`stream` pipelines an iterable with ``pipeline_depth`` batches
+  in flight; ``__call__`` is submit+drain — so overlap now happens *across*
+  calls and callers, the role ORT's IOBinding plays for the reference, not
+  just within one multi-batch call. Inputs are donated to XLA on non-CPU
+  backends so same-bucket batches reuse device buffers instead of allocating.
 """
 from __future__ import annotations
 
+import atexit
 import math
+import queue as _queue
+import threading
+import weakref
 from collections import deque
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+from concurrent.futures import Future
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple, Union)
 
 import jax
 import jax.numpy as jnp
@@ -51,13 +61,226 @@ def coerce_host_array(arr: np.ndarray, compute_dtype: Optional[Any] = None) -> n
     return arr
 
 
+_SHUTDOWN = object()
+
+
+class ExecutorFuture:
+    """Future-like handle for one :meth:`BatchedExecutor.submit`.
+
+    Resolves to the exact tuple ``__call__`` returns. Assembly (gathering
+    per-bucket chunks, slicing padding, concatenating) happens in the
+    *waiter's* thread, so the pipeline's drain thread never blocks on
+    host-side concatenation of someone else's result.
+    """
+
+    __slots__ = ("_chunks",)
+
+    def __init__(self, chunk_futs: Sequence[Future]):
+        self._chunks = list(chunk_futs)
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until every chunk lands; ``timeout`` applies per chunk."""
+        outs = [f.result(timeout) for f in self._chunks]
+        if len(outs) == 1:
+            return outs[0]
+        return tuple(
+            np.concatenate([o[i] for o in outs]) for i in range(len(outs[0]))
+        )
+
+    def done(self) -> bool:
+        return all(f.done() for f in self._chunks)
+
+    def exception(self, timeout: Optional[float] = None):
+        for f in self._chunks:
+            exc = f.exception(timeout)
+            if exc is not None:
+                return exc
+        return None
+
+    def add_done_callback(self, fn: Callable[["ExecutorFuture"], None]):
+        """Invoke ``fn(self)`` once the LAST chunk completes."""
+        remaining = [len(self._chunks)]
+        lock = threading.Lock()
+
+        def _one(_f):
+            with lock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            fn(self)
+
+        for f in self._chunks:
+            f.add_done_callback(_one)
+
+
+class _Unit:
+    """One staging unit: a callable producing 1+ dispatch-ready chunks.
+
+    A plain chunk stages one bucket; a super-chunk (``transfer_batches``)
+    stages one grouped H2D copy that fans out into several bucket
+    dispatches on device-side slices.
+    """
+
+    __slots__ = ("stage", "futs", "staged", "error", "ready", "ex")
+
+    def __init__(self, n_chunks: int):
+        self.stage: Callable[[], List[tuple]] = None  # set by _plan
+        self.futs = [Future() for _ in range(n_chunks)]
+        self.staged: Optional[List[tuple]] = None
+        self.error: Optional[BaseException] = None
+        self.ready = threading.Event()
+        # strong ref while work is pending: 'fut = ex.submit(x); del ex;
+        # fut.result()' must complete, not die to a mid-flight GC. The
+        # ref is dropped as each stage finishes, so an IDLE executor is
+        # still collectable (and its threads reaped via the finalizer).
+        self.ex: Optional["BatchedExecutor"] = None
+
+
+class _PipelineState:
+    """Shared queues/threads of one executor's pipeline.
+
+    Lives OUTSIDE the executor so worker threads can hold it strongly
+    while holding the executor itself only weakly — a dropped executor is
+    then garbage-collected and its threads reaped via ``weakref.finalize``
+    instead of leaking a parked thread set per evicted jit cache entry.
+    """
+
+    __slots__ = ("stage_q", "dispatch_q", "inflight_q", "depth_sem",
+                 "stage_slots", "lock", "closed", "threads", "__weakref__")
+
+    def __init__(self, depth: int, stage_workers: int):
+        self.stage_q: "_queue.Queue" = _queue.Queue()
+        self.dispatch_q: "_queue.Queue" = _queue.Queue()
+        # unbounded queue + explicit semaphore: "in flight" counts
+        # dispatched-but-unfetched batches exactly (a bounded queue would
+        # let one extra batch hide inside a blocked put)
+        self.inflight_q: "_queue.Queue" = _queue.Queue()
+        self.depth_sem = threading.Semaphore(depth)
+        # backpressure on submit: at most depth + workers staging units
+        # may be pending host-side, so a fast producer cannot pin
+        # unbounded host memory behind a slow device
+        self.stage_slots = threading.Semaphore(depth + stage_workers)
+        self.lock = threading.Lock()
+        self.closed = False
+        self.threads: List[threading.Thread] = []
+
+
+def _stage_worker(state: _PipelineState):
+    while True:
+        unit = state.stage_q.get()
+        if unit is _SHUTDOWN:
+            state.stage_q.put(_SHUTDOWN)  # propagate to sibling workers
+            return
+        try:
+            unit.staged = unit.stage()
+        except BaseException as e:  # noqa: BLE001 - delivered via futures
+            unit.error = e
+        finally:
+            unit.stage = None  # drop array refs promptly
+            unit.ready.set()
+
+
+def _dispatch_loop(state: _PipelineState):
+    while True:
+        unit = state.dispatch_q.get()
+        if unit is _SHUTDOWN:
+            state.inflight_q.put(_SHUTDOWN)
+            return
+        unit.ready.wait()
+        try:
+            if unit.error is not None:
+                for f in unit.futs:
+                    f.set_exception(unit.error)
+                continue
+            ex = unit.ex
+            for (arrays, n, bucket, internal), fut in zip(
+                    unit.staged, unit.futs):
+                state.depth_sem.acquire()
+                try:
+                    # instance-attribute lookup: tests (and tracing
+                    # wrappers) may patch ex._dispatch per instance
+                    out, n, bucket = (
+                        ex._dispatch(arrays, n, bucket, internal=True)
+                        if internal else
+                        ex._dispatch(arrays, n, bucket))
+                except BaseException as e:  # noqa: BLE001
+                    state.depth_sem.release()
+                    fut.set_exception(e)
+                    continue
+                # the record carries the strong executor ref until the
+                # fetch resolves its future
+                state.inflight_q.put((out, n, bucket, fut, ex))
+            del ex
+        finally:
+            unit.staged = None
+            unit.ex = None
+            state.stage_slots.release()
+            del unit
+
+
+def _drain_loop(state: _PipelineState):
+    while True:
+        rec = state.inflight_q.get()
+        if rec is _SHUTDOWN:
+            return
+        out, n, bucket, fut, ex = rec
+        del rec
+        try:
+            try:
+                res = ex._fetch(out, n, bucket)
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+            else:
+                fut.set_result(res)
+        finally:
+            state.depth_sem.release()
+            del ex, out, fut
+
+
+def _shutdown_pipeline(state: _PipelineState):
+    """Idempotent: wake every pipeline thread with sentinels. Pending
+    units already queued ahead of the sentinels still complete."""
+    with state.lock:
+        if state.closed:
+            return
+        state.closed = True
+    state.stage_q.put(_SHUTDOWN)
+    state.dispatch_q.put(_SHUTDOWN)
+
+
+# Pipeline threads still parked inside the XLA runtime at interpreter
+# shutdown abort the process ("terminate called without an active
+# exception" from the PJRT client destructor racing frozen daemon
+# threads). Drain every live pipeline while threading still works.
+_LIVE_PIPELINES: "weakref.WeakSet[_PipelineState]" = weakref.WeakSet()
+
+
+@atexit.register
+def _shutdown_all_pipelines():
+    states = list(_LIVE_PIPELINES)
+    for state in states:
+        _shutdown_pipeline(state)
+    for state in states:
+        for t in state.threads:
+            t.join(timeout=10)
+
+
 class BatchedExecutor:
     """Runs ``fn(*arrays) -> arrays`` over row batches with a bucketed jit cache.
 
     ``fn`` must treat axis 0 of every argument as the batch axis. The executor
     pads the batch to a bucket size, runs the compiled program, and slices the
-    padding off the outputs. Multi-batch calls are pipelined: up to
-    ``pipeline_depth`` batches are in flight at once.
+    padding off the outputs.
+
+    Execution rides an async submit/drain pipeline (host staging pool →
+    ordered dispatch thread → drain thread) shared by all callers of this
+    executor, with up to ``pipeline_depth`` batches in flight at once:
+
+    - :meth:`submit` — non-blocking-ish; returns an :class:`ExecutorFuture`.
+    - :meth:`stream` — generator over an iterable of inputs, yielding
+      results in order with ``pipeline_depth`` batches in flight.
+    - ``__call__`` — submit + drain: identical outputs and donation/
+      bucketing semantics to the historical synchronous path.
     """
 
     def __init__(
@@ -72,6 +295,7 @@ class BatchedExecutor:
         pipeline_depth: int = 2,
         donate: Optional[bool] = None,
         transfer_batches: Union[int, str, None] = None,
+        stage_workers: int = 2,
     ):
         """``bound_args`` are prepended to every call unpadded — use for a
         weights pytree so it is device-resident and *shared* across all shape
@@ -88,13 +312,18 @@ class BatchedExecutor:
         explicit grouped device_put for BOTH large image batches
         (100 vs 77 img/s) and small tabular rows (34k vs 26k rows/s);
         the option exists for co-located topologies where explicit DMA
-        grouping can win (docs/perf.md records the A/Bs)."""
+        grouping can win (docs/perf.md records the A/Bs).
+
+        ``stage_workers`` bounds the host-staging pool: that many batches'
+        coerce+pad host work can proceed concurrently with dispatch and
+        fetch of earlier batches."""
         self._device = device
         self._compute_dtype = compute_dtype
         self._min_bucket = min_bucket
         self._max_bucket = max_bucket
         self._static_batch = static_batch
         self._depth = max(1, int(pipeline_depth))
+        self._stage_workers = max(1, int(stage_workers))
         self._bound = tuple(
             jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, device) if device else jnp.asarray(a),
@@ -113,6 +342,13 @@ class BatchedExecutor:
         # donation indices depend on the call arity, which is only known at
         # call time — one jitted callable per arity
         self._jits: Dict[int, Callable] = {}
+        self._pipeline: Optional[_PipelineState] = None
+        self._pipeline_init_lock = threading.Lock()
+        self._finalizer = None
+
+    @property
+    def pipeline_depth(self) -> int:
+        return self._depth
 
     def _jit_for(self, n_args: int) -> Callable:
         got = self._jits.get(n_args)
@@ -155,80 +391,210 @@ class BatchedExecutor:
             b = min(b, self._max_bucket)
         return b
 
-    def __call__(self, *host_arrays: np.ndarray) -> Tuple[np.ndarray, ...]:
-        n = len(host_arrays[0])
-        bucket = self._bucket(max(n, 1))
+    # -- pipeline plumbing ----------------------------------------------
+    def _ensure_pipeline(self) -> _PipelineState:
+        state = self._pipeline
+        if state is not None:
+            return state
+        with self._pipeline_init_lock:
+            state = self._pipeline
+            if state is None:
+                state = _PipelineState(self._depth, self._stage_workers)
+                threads = [threading.Thread(
+                    target=_stage_worker, args=(state,),
+                    name=f"executor-stage-{i}", daemon=True)
+                    for i in range(self._stage_workers)]
+                threads.append(threading.Thread(
+                    target=_dispatch_loop, args=(state,),
+                    name="executor-dispatch", daemon=True))
+                threads.append(threading.Thread(
+                    target=_drain_loop, args=(state,),
+                    name="executor-drain", daemon=True))
+                state.threads = threads
+                _LIVE_PIPELINES.add(state)
+                for t in threads:
+                    t.start()
+                self._pipeline = state
+                # reap the threads when the executor is dropped (e.g. jit
+                # cache eviction) without requiring an explicit close()
+                self._finalizer = weakref.finalize(
+                    self, _shutdown_pipeline, state)
+        return state
+
+    def close(self, wait: bool = True):
+        """Shut the pipeline down. Batches already submitted complete
+        (their futures resolve); later :meth:`submit` calls raise.
+        Idempotent; ``wait=True`` joins the pipeline threads."""
+        state = self._pipeline
+        if state is None:
+            with self._pipeline_init_lock:
+                # never-started pipeline: mark closed so submit refuses
+                if self._pipeline is None:
+                    self._pipeline = state = _PipelineState(
+                        self._depth, self._stage_workers)
+                    state.closed = True
+                    return
+                state = self._pipeline
+        _shutdown_pipeline(state)
+        if wait:
+            for t in state.threads:
+                t.join(timeout=60)
+
+    def _resolve_transfer_batches(self, host_arrays, bucket: int):
+        tb = self._transfer_batches
+        if tb != "auto":
+            return tb
+        # group buckets up to ~32MB per explicit copy (shape/dtype
+        # only — np.asarray on a device array would force a D2H copy)
+        row_bytes = 0
+        for a in host_arrays:
+            a0 = a if hasattr(a, "shape") and hasattr(a, "dtype") \
+                else np.asarray(a)
+            itemsize = 2 if (self._compute_dtype is not None
+                             and jnp.issubdtype(a0.dtype, jnp.floating)) \
+                else min(a0.dtype.itemsize, 4)
+            row_bytes += int(np.prod(a0.shape[1:], dtype=np.int64)) \
+                * itemsize
+        return max(1, (32 << 20) // max(1, bucket * row_bytes))
+
+    def _stage_host_chunk(self, arrays, n: int, bucket: int):
+        """Host-side staging (the work the pool does off the dispatch
+        thread): coerce + bucket-pad numpy inputs. Device-resident inputs
+        pass through untouched so ``_dispatch`` applies its external-array
+        rules (on-device pad/coerce, defensive copy before donation)."""
+        staged = []
+        for a in arrays:
+            if isinstance(a, jax.Array):
+                staged.append(a)
+                continue
+            a = coerce_host_array(np.asarray(a), self._compute_dtype)
+            if n < bucket and len(a) < bucket:  # never re-pad a padded tail
+                pad = [(0, bucket - n)] + [(0, 0)] * (a.ndim - 1)
+                a = np.pad(a, pad)
+            staged.append(a)
+        return staged
+
+    def _stage_superchunk(self, host_arrays, sc_start: int, sc_stop: int,
+                          bucket: int):
+        """super-chunk: ONE coerce+pad+copy for transfer_batches buckets,
+        then per-bucket compute on device-side slices. device_put is
+        unconditional here — with device=None it targets the default
+        device; leaving host numpy would quietly re-copy per bucket
+        and void the whole point of grouping."""
+        sc_n = sc_stop - sc_start
+        rows = -(-sc_n // bucket) * bucket
+        devs = []
+        for a in host_arrays:
+            sl = a[sc_start:sc_stop]
+            if isinstance(sl, jax.Array):
+                # already device-resident: pad/coerce on device, no
+                # host round trip
+                devs.append(self._stage_device_array(sl, rows)[0])
+                continue
+            sl = coerce_host_array(np.asarray(sl), self._compute_dtype)
+            if rows > sc_n:
+                sl = np.pad(sl,
+                            [(0, rows - sc_n)] + [(0, 0)] * (sl.ndim - 1))
+            devs.append(jax.device_put(sl, self._device))
+        return [([d[b:b + bucket] for d in devs],
+                 min(bucket, sc_n - b), bucket, True)
+                for b in range(0, sc_n, bucket)]
+
+    def _plan(self, host_arrays, n: int, bucket: int) -> List[_Unit]:
+        """Split one logical call into ordered staging units."""
         if n == 0:
             # run one padded batch to learn output structure; slice to empty
-            return self._fetch(*self._dispatch(list(host_arrays), 0, bucket))
-        outs = []
-        pending: deque = deque()
-
-        def push(item):
-            pending.append(item)
-            if len(pending) >= self._depth:
-                outs.append(self._fetch(*pending.popleft()))
-
-        tb = self._transfer_batches
-        if tb == "auto":
-            # group buckets up to ~32MB per explicit copy (shape/dtype
-            # only — np.asarray on a device array would force a D2H copy)
-            row_bytes = 0
-            for a in host_arrays:
-                a0 = a if hasattr(a, "shape") and hasattr(a, "dtype") \
-                    else np.asarray(a)
-                itemsize = 2 if (self._compute_dtype is not None
-                                 and jnp.issubdtype(a0.dtype, jnp.floating)) \
-                    else min(a0.dtype.itemsize, 4)
-                row_bytes += int(np.prod(a0.shape[1:], dtype=np.int64)) \
-                    * itemsize
-            tb = max(1, (32 << 20) // max(1, bucket * row_bytes))
+            unit = _Unit(1)
+            unit.ex = self
+            arrays = list(host_arrays)
+            unit.stage = lambda: [(self._stage_host_chunk(arrays, 0, bucket),
+                                   0, bucket, False)]
+            return [unit]
+        units: List[_Unit] = []
+        tb = self._resolve_transfer_batches(host_arrays, bucket)
         super_rows = bucket * tb
         for sc_start in range(0, n, super_rows):
             sc_stop = min(sc_start + super_rows, n)
             sc_n = sc_stop - sc_start
             if tb == 1 or sc_n <= bucket:
-                # dispatch is async: this batch's H2D copy and compute are
-                # in flight before an earlier batch's fetch blocks below
-                push(self._dispatch(
-                    [a[sc_start:sc_stop] for a in host_arrays], sc_n, bucket))
-                continue
-            # super-chunk: ONE coerce+pad+copy for transfer_batches buckets,
-            # then per-bucket compute on device-side slices. device_put is
-            # unconditional here — with device=None it targets the default
-            # device; leaving host numpy would quietly re-copy per bucket
-            # and void the whole point of grouping
-            rows = -(-sc_n // bucket) * bucket
-            devs = []
-            for a in host_arrays:
-                sl = a[sc_start:sc_stop]
-                if isinstance(sl, jax.Array):
-                    # already device-resident: pad/coerce on device, no
-                    # host round trip
-                    devs.append(self._stage_device_array(sl, rows)[0])
-                    continue
-                sl = coerce_host_array(np.asarray(sl), self._compute_dtype)
-                if rows > sc_n:
-                    sl = np.pad(sl,
-                                [(0, rows - sc_n)] + [(0, 0)] * (sl.ndim - 1))
-                devs.append(jax.device_put(sl, self._device))
-            for b in range(0, sc_n, bucket):
-                push(self._dispatch(
-                    [d[b:b + bucket] for d in devs],
-                    min(bucket, sc_n - b), bucket, internal=True))
-        while pending:
-            outs.append(self._fetch(*pending.popleft()))
-        if len(outs) == 1:
-            return outs[0]
-        return tuple(
-            np.concatenate([o[i] for o in outs]) for i in range(len(outs[0]))
-        )
+                unit = _Unit(1)
+                unit.stage = (
+                    lambda s=sc_start, e=sc_stop, m=sc_n:
+                    [(self._stage_host_chunk(
+                        [a[s:e] for a in host_arrays], m, bucket),
+                      m, bucket, False)])
+            else:
+                unit = _Unit(-(-sc_n // bucket))
+                unit.stage = (
+                    lambda s=sc_start, e=sc_stop:
+                    self._stage_superchunk(host_arrays, s, e, bucket))
+            unit.ex = self
+            units.append(unit)
+        return units
 
+    # -- public API -----------------------------------------------------
+    def submit(self, *host_arrays: np.ndarray) -> ExecutorFuture:
+        """Enqueue one logical batch; returns a future resolving to the
+        same tuple ``__call__`` returns. Safe to call from any number of
+        threads concurrently — staging, device dispatch, and D2H fetch of
+        different submissions overlap through the shared pipeline. Blocks
+        only when the staging window (``pipeline_depth + stage_workers``
+        units) is full — backpressure, not serialization.
+
+        Staging reads the input arrays asynchronously: do not mutate
+        them until the returned future resolves."""
+        state = self._ensure_pipeline()
+        n = len(host_arrays[0])
+        bucket = self._bucket(max(n, 1))
+        units = self._plan(host_arrays, n, bucket)
+        futs: List[Future] = []
+        for unit in units:
+            # slot acquisition happens OUTSIDE the lock: a large
+            # multi-unit submission waiting for the pipeline to drain
+            # must not convoy other callers' submits behind it.
+            # Concurrent submitters may interleave units — harmless,
+            # since every unit's chunks resolve through its own futures;
+            # only the stage_q/dispatch_q pair must agree on order,
+            # which the per-unit lock below guarantees
+            state.stage_slots.acquire()
+            with state.lock:
+                if state.closed:
+                    state.stage_slots.release()
+                    raise RuntimeError("executor pipeline is closed")
+                state.stage_q.put(unit)
+                state.dispatch_q.put(unit)
+            futs.extend(unit.futs)
+        return ExecutorFuture(futs)
+
+    def stream(self, items: Iterable) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Pipeline an iterable of inputs; yield result tuples in order.
+
+        Each item is a tuple/list of host arrays (or a single array).
+        ``pipeline_depth`` items stay in flight: item k+1's host staging
+        and H2D copy overlap item k's compute and D2H fetch, and the
+        iterable itself is advanced lazily so a generator's per-item host
+        work (decode, resize) overlaps device time too."""
+        pending: deque = deque()
+        for item in items:
+            arrays = tuple(item) if isinstance(item, (tuple, list)) \
+                else (item,)
+            pending.append(self.submit(*arrays))
+            while len(pending) > self._depth:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+
+    def __call__(self, *host_arrays: np.ndarray) -> Tuple[np.ndarray, ...]:
+        return self.submit(*host_arrays).result()
+
+    # -- pipeline stages (overridable/patchable per instance) ------------
     def _dispatch(self, arrays, n: int, bucket: int, internal: bool = False):
         """Coerce+pad on host (device-resident slices pass through), start
         the H2D copy and the compute; returns device futures without
         blocking. ``internal`` marks super-chunk slices the executor
-        staged itself (safe to donate)."""
+        staged itself (safe to donate). Idempotent over pre-staged host
+        chunks: the staging pool already coerced+padded them, so the
+        re-coerce here is a no-op passthrough."""
         padded = []
         for a in arrays:
             if isinstance(a, jax.Array):

@@ -1,0 +1,267 @@
+"""Async submit/drain executor pipeline tests: thread-safe concurrent
+submission, in-order streaming under mixed bucket sizes, exception
+propagation through futures, and clean shutdown with batches in flight.
+
+These are the structural guarantees the serving + ONNXModel hot paths
+lean on (runtime/executor.py submit/stream/close); a deadlock here would
+hang tier-1, so CI runs this file under a hard timeout
+(tools/ci/smoke_pipeline.sh).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from synapseml_tpu.runtime.executor import BatchedExecutor, ExecutorFuture
+
+
+def test_submit_returns_future_with_call_identical_result():
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4)
+    x = np.arange(11, dtype=np.float32)
+    fut = ex.submit(x)
+    assert isinstance(fut, ExecutorFuture)
+    (y,) = fut.result()
+    (y_call,) = ex(x)
+    np.testing.assert_array_equal(y, y_call)
+    assert fut.done() and fut.exception() is None
+
+
+def test_submit_multi_chunk_concatenates_in_order():
+    # 40 rows at max_bucket 8 -> 5 chunks; the future must assemble them
+    # in submission order exactly like the historical __call__
+    ex = BatchedExecutor(lambda x: (x + 1.0,), min_bucket=8, max_bucket=8)
+    x = np.arange(40, dtype=np.float32)
+    (y,) = ex.submit(x).result()
+    np.testing.assert_allclose(y, x + 1.0)
+
+
+def test_concurrent_submit_from_many_threads():
+    """Thread-safety: N threads submitting distinct data concurrently
+    each get exactly their own answer back."""
+    ex = BatchedExecutor(lambda x: (x * 3.0,), min_bucket=4, max_bucket=8)
+    n_threads, per_thread = 8, 6
+    results = {}
+    lock = threading.Lock()
+
+    def worker(t):
+        mine = []
+        for k in range(per_thread):
+            x = (np.arange(3 + (t + k) % 9, dtype=np.float32)
+                 + 100.0 * t + 10.0 * k)
+            (y,) = ex.submit(x).result()
+            mine.append((x, y))
+        with lock:
+            results[t] = mine
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(results) == n_threads
+    for mine in results.values():
+        for x, y in mine:
+            np.testing.assert_allclose(y, x * 3.0)
+
+
+def test_stream_in_order_mixed_bucket_sizes():
+    """stream() yields per-item results in submission order even when
+    items land in different shape buckets (different compile cache
+    entries, different device times)."""
+    ex = BatchedExecutor(lambda x: (x - 1.0,), min_bucket=4, max_bucket=32)
+    sizes = [3, 17, 1, 32, 9, 4, 27, 2]
+    items = [np.full(s, float(i), np.float32) for i, s in enumerate(sizes)]
+    outs = list(ex.stream((a,) for a in items))
+    assert len(outs) == len(items)
+    for i, (got,) in enumerate(outs):
+        assert got.shape == (sizes[i],)
+        np.testing.assert_allclose(got, items[i] - 1.0)
+
+
+def test_stream_accepts_bare_arrays_and_overlaps_producer():
+    """A generator item's host work runs while earlier items compute:
+    the stream holds pipeline_depth items in flight, so the producer is
+    pulled ahead of the consumer."""
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4,
+                         pipeline_depth=2)
+    produced = []
+
+    def gen():
+        for i in range(5):
+            produced.append(i)
+            yield np.full(4, float(i), np.float32)
+
+    seen = 0
+    for i, (y,) in enumerate(ex.stream(gen())):
+        np.testing.assert_allclose(y, 2.0 * i)
+        seen += 1
+        # depth-2 window: by the time item k is yielded, the producer
+        # has been advanced past it (unless exhausted)
+        assert len(produced) >= min(5, i + 2)
+    assert seen == 5 and produced == list(range(5))
+
+
+def test_exception_from_jitted_fn_propagates_through_future():
+    def bad_fn(x):
+        raise RuntimeError("scorer exploded")
+
+    ex = BatchedExecutor(bad_fn, min_bucket=4)
+    fut = ex.submit(np.ones(3, np.float32))
+    with pytest.raises(RuntimeError, match="scorer exploded"):
+        fut.result()
+    assert isinstance(fut.exception(), RuntimeError)
+    # __call__ surfaces the same error synchronously
+    with pytest.raises(RuntimeError, match="scorer exploded"):
+        ex(np.ones(3, np.float32))
+
+
+def test_exception_does_not_wedge_pipeline():
+    """A failing batch must not deadlock or poison the pipeline: the
+    depth slot it held is released and later submits still complete."""
+    state = {"fail": True}
+
+    def fn(x):
+        if state["fail"]:
+            raise ValueError("transient")
+        return (x + 5.0,)
+
+    ex = BatchedExecutor(fn, min_bucket=4, pipeline_depth=2)
+    futs = [ex.submit(np.ones(4, np.float32)) for _ in range(4)]
+    for f in futs:
+        with pytest.raises(ValueError, match="transient"):
+            f.result()
+    state["fail"] = False
+    ex._jits.clear()  # drop the traced-and-failed cache entry
+    for _ in range(4):  # more than pipeline_depth: slots were released
+        (y,) = ex(np.ones(4, np.float32))
+        np.testing.assert_allclose(y, 6.0)
+
+
+def test_fetch_error_propagates_and_pipeline_survives():
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4)
+    orig_fetch = ex._fetch
+    boom = [True]
+
+    def fetch(out, n, bucket):
+        if boom[0]:
+            boom[0] = False
+            raise OSError("D2H transport dropped")
+        return orig_fetch(out, n, bucket)
+
+    ex._fetch = fetch
+    with pytest.raises(OSError, match="transport dropped"):
+        ex.submit(np.ones(4, np.float32)).result()
+    (y,) = ex(np.ones(4, np.float32))
+    np.testing.assert_allclose(y, 2.0)
+
+
+def test_close_drains_inflight_batches():
+    """Clean shutdown: close() lets already-submitted batches complete
+    (their futures resolve with real results), then refuses new work."""
+    ex = BatchedExecutor(lambda x: (x + 2.0,), min_bucket=4, max_bucket=4,
+                         pipeline_depth=2)
+    gate = threading.Event()
+    orig_fetch = ex._fetch
+
+    def slow_fetch(out, n, bucket):
+        gate.wait(10)  # hold batches in flight until close() is underway
+        return orig_fetch(out, n, bucket)
+
+    ex._fetch = slow_fetch
+    futs = [ex.submit(np.full(4, float(i), np.float32)) for i in range(3)]
+    closer = threading.Thread(target=lambda: ex.close(wait=True))
+    closer.start()
+    time.sleep(0.05)
+    gate.set()
+    closer.join(timeout=30)
+    assert not closer.is_alive(), "close(wait=True) did not finish"
+    for i, f in enumerate(futs):
+        (y,) = f.result(timeout=10)
+        np.testing.assert_allclose(y, i + 2.0)
+    with pytest.raises(RuntimeError, match="closed"):
+        ex.submit(np.ones(4, np.float32))
+    ex.close()  # idempotent
+
+
+def test_close_before_first_submit():
+    ex = BatchedExecutor(lambda x: (x,), min_bucket=4)
+    ex.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        ex.submit(np.ones(4, np.float32))
+
+
+def test_dropped_executor_reaps_pipeline_threads():
+    """An executor evicted from a jit cache must not leak its parked
+    pipeline threads: the weakref finalizer shuts them down."""
+    import gc
+
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4)
+    ex(np.ones(4, np.float32))  # start the pipeline
+    threads = list(ex._pipeline.threads)
+    assert all(t.is_alive() for t in threads)
+    del ex
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and any(t.is_alive() for t in threads):
+        time.sleep(0.02)
+    assert not any(t.is_alive() for t in threads), \
+        "pipeline threads leaked after executor GC"
+
+
+def test_future_add_done_callback_fires_once_after_last_chunk():
+    ex = BatchedExecutor(lambda x: (x,), min_bucket=4, max_bucket=4)
+    fired = []
+    fut = ex.submit(np.arange(12, dtype=np.float32))  # 3 chunks
+    fut.add_done_callback(lambda f: fired.append(f.done()))
+    fut.result()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not fired:
+        time.sleep(0.01)
+    assert fired == [True]
+
+
+def test_submit_empty_batch_learns_structure():
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4)
+    (y,) = ex.submit(np.zeros((0, 3), np.float32)).result()
+    assert y.shape == (0, 3)
+
+
+def test_cross_caller_overlap_one_fetch_does_not_stall_dispatch():
+    """The dedicated drain thread: while caller A's fetch blocks, caller
+    B's batch must still be dispatched (the cross-caller overlap the
+    serving scorers rely on)."""
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4, max_bucket=4,
+                         pipeline_depth=2)
+    first_fetch_started = threading.Event()
+    release_first_fetch = threading.Event()
+    dispatched = []
+    orig_fetch, orig_dispatch = ex._fetch, ex._dispatch
+
+    def fetch(out, n, bucket):
+        if not first_fetch_started.is_set():
+            first_fetch_started.set()
+            assert release_first_fetch.wait(30)
+        return orig_fetch(out, n, bucket)
+
+    def dispatch(arrays, n, bucket, **kw):
+        dispatched.append(n)
+        return orig_dispatch(arrays, n, bucket, **kw)
+
+    ex._fetch, ex._fetch_orig = fetch, orig_fetch
+    ex._dispatch = dispatch
+    fut_a = ex.submit(np.ones(4, np.float32))
+    assert first_fetch_started.wait(10)
+    fut_b = ex.submit(np.full(4, 7.0, np.float32))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(dispatched) < 2:
+        time.sleep(0.01)
+    # B dispatched while A's fetch is still blocked inside device_get
+    assert len(dispatched) == 2, dispatched
+    release_first_fetch.set()
+    np.testing.assert_allclose(fut_a.result()[0], 2.0)
+    np.testing.assert_allclose(fut_b.result()[0], 14.0)

@@ -183,7 +183,10 @@ def test_iforest_max_features():
 
 def test_featurizer_string_split_and_prefix_modes():
     """stringSplitInputCols + prefixStringsWithColumnName parity
-    (ref: vw/.../VowpalWabbitFeaturizer.scala param surface)."""
+    (ref: vw/.../VowpalWabbitFeaturizer.scala param surface). The
+    string-split path ALWAYS hashes the bare token (reference
+    StringSplitFeaturizer semantics); the prefix flag only governs the
+    input_cols string/token paths."""
     from synapseml_tpu.linear.featurizer import VowpalWabbitFeaturizer
 
     t = Table({"txt": np.asarray(["red blue", "blue"], object),
@@ -195,8 +198,8 @@ def test_featurizer_string_split_and_prefix_modes():
     assert (out["features_val"][0] != 0).sum() == 2
     assert (out["features_val"][1] != 0).sum() == 1
 
-    # prefix=False hashes the bare token: 'txt' token "blue" collides
-    # (shares a weight slot) with 'tok' token "blue"
+    # prefix=False hashes tok's entries bare too: 'txt' split token
+    # "blue" collides (shares a weight slot) with 'tok' token "blue"
     f2 = VowpalWabbitFeaturizer(string_split_input_cols=["txt"],
                                 input_cols=["tok"],
                                 prefix_strings_with_column_name=False,
@@ -212,7 +215,38 @@ def test_featurizer_string_split_and_prefix_modes():
     o3 = f3.transform(t)
     r0p = set(np.asarray(o3["features_idx"][0])[
         np.asarray(o3["features_val"][0]) != 0])
-    assert len(r0p) == 3  # prefixed: txt=blue != tok=blue
+    assert len(r0p) == 3  # bare split 'blue' != prefixed 'tok=blue'
+
+
+def test_featurizer_string_split_matches_reference_tokenizer():
+    """Reference parity for the string-split path
+    (ref: vw/.../featurizer/StringSplitFeaturizer.scala): tokens come
+    from the unicode word regex (?U)\\w+ — punctuation stripped — and
+    the BARE token is hashed regardless of
+    prefix_strings_with_column_name."""
+    from synapseml_tpu.linear.featurizer import VowpalWabbitFeaturizer
+
+    t = Table({"txt": np.asarray(["foo, foo! bar", "naïve café"],
+                                 object)})
+    f = VowpalWabbitFeaturizer(string_split_input_cols=["txt"],
+                               output_col="features")
+    out = f.transform(t)
+    # 'foo,' and 'foo!' both tokenize to 'foo' -> ONE slot summed to 2.0
+    # (whitespace splitting would emit three distinct hashes)
+    row0 = np.asarray(out["features_val"][0])
+    assert sorted(row0[row0 != 0].tolist()) == [1.0, 2.0]
+    # unicode \\w keeps accented words as single tokens
+    assert (np.asarray(out["features_val"][1]) != 0).sum() == 2
+
+    # the prefix flag does not perturb string-split slots
+    f_bare = VowpalWabbitFeaturizer(string_split_input_cols=["txt"],
+                                    prefix_strings_with_column_name=False,
+                                    output_col="features")
+    o_bare = f_bare.transform(t)
+    np.testing.assert_array_equal(np.asarray(out["features_idx"]),
+                                  np.asarray(o_bare["features_idx"]))
+    np.testing.assert_array_equal(np.asarray(out["features_val"]),
+                                  np.asarray(o_bare["features_val"]))
 
 
 def test_contextual_bandit_exploration_pmf():

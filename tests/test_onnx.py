@@ -3151,6 +3151,49 @@ def test_maxpool_indices_and_maxunpool_match_torch():
     np.testing.assert_allclose(gu, tu.numpy())
 
 
+def test_maxpool_indices_degenerate_padding_clamped():
+    """A pooling window that falls ENTIRELY inside the padding used to
+    recover its argmax coordinate inside the pad region (e.g. -pads[0]),
+    emitting a NEGATIVE flat index that MaxUnpool's scatter would wrap
+    around to the tensor TAIL, corrupting a real cell. Degenerate
+    windows now emit the dtype-max drop sentinel — non-negative and out
+    of range for ANY unpool output shape (the spec allows output_shape
+    LARGER than the pool input), so MaxUnpool's scatter drops them
+    instead of colliding with a real window's cell."""
+    xs = np.array([[[1.0, 2.0, 3.0, 4.0]]], np.float32)
+    g = GraphBuilder(opset=17)
+    x = g.add_input("x", np.float32, [1, 1, 4])
+    y, i = g.add_node("MaxPool", [x], outputs=["y", "i"],
+                      kernel_shape=[2], strides=[2], pads=[2, 2])
+    u = g.add_node("MaxUnpool", [y, i], kernel_shape=[2], strides=[2],
+                   pads=[2, 2])
+    # spec-sanctioned ENLARGED output_shape: an input-sized sentinel
+    # (4) would land INSIDE this 6-cell output and corrupt cell 4
+    oshape = g.add_initializer("oshape", np.array([1, 1, 6], np.int64))
+    u2 = g.add_node("MaxUnpool", [y, i, oshape], kernel_shape=[2],
+                    strides=[2], pads=[2, 2])
+    for nm in (y, i, u, u2):
+        g.add_output(nm, np.float32, None)
+    m = import_model(g.to_bytes())
+    gy, gi, gu, gu2 = [np.asarray(v) for v in m.apply(m.params, xs)]
+    # windows over the padded extent [-inf,-inf, 1,2,3,4, -inf,-inf]:
+    # [-inf,-inf], [1,2], [3,4], [-inf,-inf] — first and last are
+    # entirely padding (their pooled value is the -inf init)
+    np.testing.assert_array_equal(gy[0, 0, 1:3], [2.0, 4.0])
+    assert gy[0, 0, 0] == -np.inf and gy[0, 0, 3] == -np.inf
+    # the regression: window 0 used to emit flat index -2 (wrapping to
+    # cell 2 under MaxUnpool); real windows keep exact indices, the two
+    # degenerate windows take the dtype-max drop sentinel
+    assert (gi >= 0).all(), gi
+    sentinel = np.iinfo(gi.dtype).max
+    np.testing.assert_array_equal(gi[0, 0], [sentinel, 1, 3, sentinel])
+    # MaxUnpool round trips: real maxima land on their cells, degenerate
+    # windows' -inf is DROPPED — no wraparound, no collision, even when
+    # the explicit output_shape is larger than the pool's input
+    np.testing.assert_array_equal(gu[0, 0], [0.0, 2.0, 0.0, 4.0])
+    np.testing.assert_array_equal(gu2[0, 0], [0.0, 2.0, 0.0, 4.0, 0.0, 0.0])
+
+
 def test_maxunpool_inferred_shape_and_1d():
     xs = np.random.default_rng(3).normal(
         size=(2, 3, 8, 8)).astype(np.float32)

@@ -113,50 +113,72 @@ def test_resnet_headless_features():
 
 def test_executor_pipelines_dispatch_before_fetch():
     """Copy/compute overlap: with pipeline_depth=2 the executor must
-    dispatch batch N+1 (async H2D + compute) before blocking on batch
-    N's fetch — the IOBinding-style overlap the reference gets from ORT
-    (ONNXModel.scala:357-402)."""
+    dispatch batch N+1 (async H2D + compute) WHILE batch N's blocking
+    fetch is in progress — the IOBinding-style overlap the reference
+    gets from ORT (ONNXModel.scala:357-402). The fetch below only
+    completes once a second dispatch has happened; a serial
+    dispatch->fetch loop would time out here."""
+    import threading
+
     from synapseml_tpu.runtime.executor import BatchedExecutor
 
     ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4, max_bucket=4,
                          pipeline_depth=2)
-    events = []
+    two_dispatched = threading.Event()
+    n_dispatch = [0]
     orig_dispatch, orig_fetch = ex._dispatch, ex._fetch
 
     def dispatch(arrays, n, bucket):
-        events.append("d")
         out = orig_dispatch(arrays, n, bucket)
         # dispatch must return device futures, not host arrays
         assert all(isinstance(l, jax.Array)
                    for l in jax.tree_util.tree_leaves(out[0]))
+        n_dispatch[0] += 1
+        if n_dispatch[0] >= 2:
+            two_dispatched.set()
         return out
 
     def fetch(out, n, bucket):
-        events.append("f")
+        assert two_dispatched.wait(30), \
+            "no overlap: a fetch blocked all further dispatches"
         return orig_fetch(out, n, bucket)
 
     ex._dispatch, ex._fetch = dispatch, fetch
     x = np.arange(16, dtype=np.float32)
     (y,) = ex(x)
     np.testing.assert_allclose(y, x * 2.0)
-    # 4 chunks of 4: the second dispatch precedes the first fetch, and
-    # exactly one batch stays in flight afterwards
-    assert events == ["d", "d", "f", "d", "f", "d", "f", "f"], events
+    assert n_dispatch[0] == 4  # 4 chunks of 4
 
 
 def test_executor_deep_pipeline_and_donation_flag():
+    import threading
+
     from synapseml_tpu.runtime.executor import BatchedExecutor
 
-    # depth 3 keeps two batches in flight
+    # depth 3 keeps three batches in flight: every fetch below waits for
+    # three dispatches to have happened, which only a pipeline at least
+    # that deep can satisfy while a fetch is blocking
     ex = BatchedExecutor(lambda x: (x + 1.0,), min_bucket=2, max_bucket=2,
                          pipeline_depth=3)
-    events = []
+    three_dispatched = threading.Event()
+    n_dispatch = [0]
     orig_dispatch, orig_fetch = ex._dispatch, ex._fetch
-    ex._dispatch = lambda *a: (events.append("d"), orig_dispatch(*a))[1]
-    ex._fetch = lambda *a: (events.append("f"), orig_fetch(*a))[1]
+
+    def dispatch(*a):
+        out = orig_dispatch(*a)
+        n_dispatch[0] += 1
+        if n_dispatch[0] >= 3:
+            three_dispatched.set()
+        return out
+
+    def fetch(*a):
+        assert three_dispatched.wait(30), "pipeline shallower than depth 3"
+        return orig_fetch(*a)
+
+    ex._dispatch, ex._fetch = dispatch, fetch
     (y,) = ex(np.zeros(8, np.float32))
     np.testing.assert_allclose(y, 1.0)
-    assert events[:3] == ["d", "d", "d"]
+    assert n_dispatch[0] == 4  # 4 chunks of 2
     # donation is off on CPU (XLA ignores it there and would warn)
     assert ex._donate is False
 

@@ -335,26 +335,36 @@ def test_pipelined_scoring_overlaps_device_time():
 
     def slow_pipeline(table: Table) -> Table:
         calls.append(table.num_rows)
-        time.sleep(0.04)
+        # 100ms "device": large vs the tens-of-ms scheduler jitter an
+        # oversubscribed 2-core CI box injects, so the ratio assert
+        # below measures architecture, not the OS run queue
+        time.sleep(0.1)
         replies = np.empty(table.num_rows, dtype=object)
         for i in range(table.num_rows):
             replies[i] = make_reply({"ok": True})
         return table.with_column("reply", replies)
 
-    def run(pipelined):
-        name = f"t_overlap_{pipelined}"
+    def run(pipelined, rep):
+        name = f"t_overlap_{pipelined}_{rep}"
+        # linger 20ms + a client barrier: the 8 posts land near-
+        # simultaneously and coalesce into exactly two micro-batches
+        # even when thread startup is staggered by a loaded CI box —
+        # ragged arrival would split them into 3-4 batches and charge
+        # the pipelined leg an extra device round
         cs = ContinuousServer(name, slow_pipeline, max_batch=4,
-                              batch_linger=0.005, pipelined=pipelined,
+                              batch_linger=0.02, pipelined=pipelined,
                               scoring_workers=2).start()
         try:
             _post(cs.url, {"warm": 1})
             results = [None] * 8
-            threads = [
-                threading.Thread(
-                    target=lambda i=i: results.__setitem__(
-                        i, _post(cs.url, {"i": i})))
-                for i in range(8)
-            ]
+            barrier = threading.Barrier(8)
+
+            def client(i):
+                barrier.wait(timeout=30)
+                results[i] = _post(cs.url, {"i": i})
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(8)]
             t0 = time.perf_counter()
             for t in threads:
                 t.start()
@@ -368,12 +378,84 @@ def test_pipelined_scoring_overlaps_device_time():
         finally:
             cs.stop()
 
-    wall_serial = run(False)
-    wall_pipe = run(True)
-    # serial: >=2 rounds of (linger + 40ms) strictly one at a time;
-    # pipelined: two 40ms rounds in flight concurrently. Generous margin
-    # so scheduler jitter can't flake the assertion.
+    # best-of-2 per leg: a single background-load spike on a shared CI
+    # box cannot decide the comparison
+    wall_serial = min(run(False, r) for r in range(2))
+    wall_pipe = min(run(True, r) for r in range(2))
+    # serial: >=2 rounds of (linger + 100ms) strictly one at a time;
+    # pipelined: two 100ms rounds in flight concurrently. Generous
+    # margin so scheduler jitter can't flake the assertion.
     assert wall_pipe < wall_serial * 0.8, (wall_pipe, wall_serial)
+
+
+def test_reply_send_runs_off_the_scoring_thread():
+    """Pipelined mode is a 3-stage pipeline: reply serialization +
+    epoch commits for batch k run on the dedicated reply thread while
+    the scorer moves on to batch k+1 — so pipeline_fn and send_replies
+    must execute on DIFFERENT threads (the serial path keeps them on
+    one)."""
+    import synapseml_tpu.io.serving as serving_mod
+
+    score_threads, reply_threads = set(), set()
+    orig_send = serving_mod.send_replies
+
+    def recording_send(server, table, reply_col="reply", id_col="id"):
+        reply_threads.add(threading.get_ident())
+        return orig_send(server, table, reply_col, id_col)
+
+    def pipeline(table: Table) -> Table:
+        score_threads.add(threading.get_ident())
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply({"ok": v["n"]})
+        return table.with_column("reply", replies)
+
+    serving_mod.send_replies = recording_send
+    cs = ContinuousServer("t_reply_thread", pipeline, max_batch=4).start()
+    try:
+        for i in range(6):
+            st, body = _post(cs.url, {"n": i})
+            assert st == 200 and body["ok"] == i
+        assert cs.errors == []
+        assert score_threads and reply_threads
+        assert score_threads.isdisjoint(reply_threads), (
+            score_threads, reply_threads)
+        # commits flowed through the reply stage: nothing replayable
+        assert cs.server.recover() == 0
+    finally:
+        cs.stop()
+        serving_mod.send_replies = orig_send
+
+
+def test_scored_batches_flush_real_replies_on_stop():
+    """stop() must deliver REAL replies for batches that were already
+    scored but still parked in the reply queue — only unscored handoff
+    batches fast-fail with 503."""
+    gate = threading.Event()
+
+    def pipeline(table: Table) -> Table:
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply({"ok": v["n"]})
+        out = table.with_column("reply", replies)
+        gate.set()  # scored: from here the reply stage owns the batch
+        return out
+
+    cs = ContinuousServer("t_flush_stop", pipeline, max_batch=4).start()
+    try:
+        results = {}
+
+        def client():
+            results["r"] = _post(cs.url, {"n": 42}, timeout=30)
+
+        th = threading.Thread(target=client)
+        th.start()
+        assert gate.wait(10)
+        cs.stop()  # reply thread drains the scored batch before exiting
+        th.join(timeout=10)
+        assert results["r"] == (200, {"ok": 42})
+    finally:
+        HTTPSourceStateHolder.remove("t_flush_stop")
 
 
 def test_exact_commit_preserves_earlier_inflight_epochs():

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Fast deadlock guard for the async executor pipeline + serving layer.
+#
+# The submit/drain executor (runtime/executor.py) and the 3-stage serving
+# query (io/serving.py) are thread pipelines: a wedged drain or reply
+# thread would HANG the full tier-1 suite rather than fail it. This
+# target runs just those suites under a hard wall-clock timeout so a
+# deadlock surfaces as a fast red X (exit 124) instead of a stuck job.
+#
+# Usage: tools/ci/smoke_pipeline.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+exec timeout -k 10 "${SMOKE_TIMEOUT:-300}" env JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_executor_pipeline.py tests/test_serving.py \
+  -q -p no:cacheprovider
