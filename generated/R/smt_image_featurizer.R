@@ -5,6 +5,7 @@
 #' @param channels backbone input channels (3, or 1 for grayscale nets like the bundled digits-cnn)
 #' @param compute_dtype float32|bfloat16
 #' @param cut_output_layers trailing graph nodes to drop
+#' @param devices data-parallel device spec: None, 'all', int N, or a device sequence — buckets are dp-sharded by the executor
 #' @param image_size square input side fed to the net
 #' @param input_col name of the input column
 #' @param mean per-channel normalization mean (0-1 scale)
@@ -14,12 +15,13 @@
 #' @param std per-channel normalization std
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_image_featurizer <- function(channels = 3, compute_dtype = "float32", cut_output_layers = 1, image_size = 224, input_col = "input", mean = c(0.485, 0.456, 0.406), mini_batch_size = 64, model_payload = NULL, output_col = "output", std = c(0.229, 0.224, 0.225)) {
+smt_image_featurizer <- function(channels = 3, compute_dtype = "float32", cut_output_layers = 1, devices = NULL, image_size = 224, input_col = "input", mean = c(0.485, 0.456, 0.406), mini_batch_size = 64, model_payload = NULL, output_col = "output", std = c(0.229, 0.224, 0.225)) {
   mod <- reticulate::import("synapseml_tpu.image.featurizer")
   kwargs <- Filter(Negate(is.null), list(
     channels = channels,
     compute_dtype = compute_dtype,
     cut_output_layers = cut_output_layers,
+    devices = devices,
     image_size = image_size,
     input_col = input_col,
     mean = mean,

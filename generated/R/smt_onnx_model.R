@@ -4,6 +4,7 @@
 #'
 #' @param argmax_output_col column for argmax of first output
 #' @param compute_dtype device compute dtype: float32|bfloat16|float16
+#' @param devices data-parallel device spec: None (single default device), 'all', an int N (first N local devices), or a device sequence — each mini-batch bucket is dp-sharded across them by the executor (runtime/executor.py), bit-identical to single-device
 #' @param feed_dict graph input name -> input column
 #' @param fetch_dict output column -> graph output name
 #' @param input_norm graph input name -> {'mean':..., 'scale':...} applied ON DEVICE after casting an integer feed to the compute dtype: the wire carries uint8 pixels (1 byte/px vs 2 for bf16) and the fused (x - mean) * scale runs where bandwidth is free
@@ -12,11 +13,12 @@
 #' @param softmax_output_col column for softmax of first output
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_onnx_model <- function(argmax_output_col = NULL, compute_dtype = "float32", feed_dict = NULL, fetch_dict = NULL, input_norm = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
+smt_onnx_model <- function(argmax_output_col = NULL, compute_dtype = "float32", devices = NULL, feed_dict = NULL, fetch_dict = NULL, input_norm = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.onnx.model")
   kwargs <- Filter(Negate(is.null), list(
     argmax_output_col = argmax_output_col,
     compute_dtype = compute_dtype,
+    devices = devices,
     feed_dict = feed_dict,
     fetch_dict = fetch_dict,
     input_norm = input_norm,

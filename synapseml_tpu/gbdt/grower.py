@@ -91,9 +91,29 @@ def histogram(binned, grad, hess, mask, n_bins: int,
     if jax.default_backend() == "tpu":
         from synapseml_tpu.gbdt import pallas_kernels
 
+        requested = backend
+        if backend == "auto" and jax.process_count() == 1:
+            # per-(rows, F, B)-shape MEASURED verdict when one is cached
+            # (resolve_hist_backend probes and persists them) — the
+            # static availability heuristic only decides for shapes no
+            # probe ever timed. Shapes are static at trace time, so this
+            # host-side lookup is trace-safe. Single-process ONLY: ranks
+            # of a multi-host fit can hold different cached verdicts
+            # (only rank 0 probes+persists), and divergent backends trace
+            # non-identical SPMD programs for one collective fit —
+            # undefined under XLA multi-host. Multi-process callers get
+            # the rank-deterministic heuristic unless they pre-resolve
+            # via resolve_hist_backend (which broadcasts rank 0's
+            # verdict), as boosting.train does.
+            routed = cached_hist_route(n, f, n_bins)
+            if routed is not None:
+                backend = routed
         use_pallas = (backend != "xla" and pallas_kernels.available()
                       and _pallas_shape_ok(n, f, n_bins))
-        if backend == "pallas" and not use_pallas:
+        # only an EXPLICIT pallas request warns: a cached auto verdict can
+        # legitimately overrule itself at a shape the kernel rejects
+        # (row-bucketed keys), and that silent XLA fallback is correct
+        if requested == "pallas" and not use_pallas:
             import warnings
             warnings.warn(
                 f"hist_backend='pallas' requested but unusable for shape "
@@ -131,6 +151,68 @@ def _route_cache_path():
     d = os.environ.get("SYNAPSEML_TPU_CACHE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "synapseml_tpu")
     return os.path.join(d, "hist_routing.json")
+
+
+def _route_key_base(n: int, f: int, n_bins: int) -> str:
+    """Canonical per-shape routing key (without the reduced-tier suffix).
+
+    Versioned: a jaxlib OR in-package kernel upgrade can flip the winner,
+    and a stale persisted verdict would be the "remembered experiment"
+    failure mode this router exists to eliminate (v2: v1 verdicts came
+    from the RTT-dominated 8-iter probe). Rows are bucketed to the next
+    power of two (and clamped to the probe range) so nearby sizes share
+    one verdict."""
+    n_probe = int(min(max(n, 512), 65536))
+    n_bucket = 1 << (n_probe - 1).bit_length()
+    kind = jax.devices()[0].device_kind
+    import synapseml_tpu as _pkg
+    pkg_v = getattr(_pkg, "__version__", "0")
+    return (f"v2|jax{jax.__version__}|pkg{pkg_v}|{kind}|"
+            f"{n_bucket}|{f}|{n_bins}")
+
+
+def _load_disk_routes() -> dict:
+    import json
+    try:
+        with open(_route_cache_path()) as fh:
+            return json.load(fh)
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        return {}
+
+
+# negative-lookup memo for cached_hist_route: shapes with NO measured
+# verdict would otherwise re-open + re-parse the disk cache on every
+# histogram trace. Cleared whenever a probe lands a new verdict.
+_ROUTE_NEG: set = set()
+
+
+def cached_hist_route(n: int, f: int, n_bins: int) -> Optional[str]:
+    """Cache-only lookup of a measured routing verdict for this shape —
+    NO probe is run (safe to call at trace time, where running device
+    code would be impossible). Prefers the full-integrity verdict;
+    falls back to any reduced-budget tier for the same shape. Returns
+    "pallas" / "xla" / None (nothing measured yet)."""
+    try:
+        base = _route_key_base(n, f, n_bins)
+    except Exception:  # noqa: BLE001 - no devices yet etc.
+        return None
+    if base in _ROUTE_NEG:
+        return None
+    got = _HIST_ROUTE_CACHE.get(base)
+    if got is None:
+        disk = _load_disk_routes()
+        _HIST_ROUTE_CACHE.update(
+            {k: v for k, v in disk.items() if k not in _HIST_ROUTE_CACHE})
+        got = _HIST_ROUTE_CACHE.get(base)
+    if got is None:
+        reduced = base + "|b"
+        for k, v in _HIST_ROUTE_CACHE.items():
+            if k.startswith(reduced):
+                got = v
+                break
+    if got is None:
+        _ROUTE_NEG.add(base)
+    return got
 
 
 # Below this many estimated fit row-visits (n * boosting steps * leaves)
@@ -228,27 +310,15 @@ def _resolve_hist_backend_local(n: int, f: int, n_bins: int,
             # (power-of-2 bucketed) so a later big fit still gets its
             # full-integrity probe instead of inheriting this one
             reduced_tier = f"|b{1 << (int(budget) - 1).bit_length()}"
-    kind = jax.devices()[0].device_kind
-    # versioned key: a jaxlib OR in-package kernel upgrade can flip the
-    # winner, and a stale persisted verdict would be the "remembered
-    # experiment" failure mode this router exists to eliminate
-    # (v2: v1 verdicts came from the RTT-dominated 8-iter probe)
-    import synapseml_tpu as _pkg
-    pkg_v = getattr(_pkg, "__version__", "0")
-    key = (f"v2|jax{jax.__version__}|pkg{pkg_v}|{kind}|"
-           f"{n_bucket}|{f}|{n_bins}{reduced_tier}")
+    key = _route_key_base(n, f, n_bins) + reduced_tier
     got = _HIST_ROUTE_CACHE.get(key)
     if got is not None:
         return got
     path = _route_cache_path()
-    try:
-        with open(path) as fh:
-            disk = json.load(fh)
-        if key in disk:
-            _HIST_ROUTE_CACHE[key] = disk[key]
-            return disk[key]
-    except Exception:  # noqa: BLE001 - cache is best-effort
-        disk = {}
+    disk = _load_disk_routes()
+    if key in disk:
+        _HIST_ROUTE_CACHE[key] = disk[key]
+        return disk[key]
 
     import numpy as np
     rng = np.random.default_rng(0)
@@ -286,8 +356,10 @@ def _resolve_hist_backend_local(n: int, f: int, n_bins: int,
         # the failure may BE the pallas leg: fall back to the formulation
         # that cannot crash, and do not persist a verdict we never timed
         _HIST_ROUTE_CACHE[key] = "xla"
+        _ROUTE_NEG.clear()  # new verdict: retire stale negative lookups
         return "xla"
     _HIST_ROUTE_CACHE[key] = winner
+    _ROUTE_NEG.clear()  # new verdict: retire stale negative lookups
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         disk[key] = winner

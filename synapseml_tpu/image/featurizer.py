@@ -48,6 +48,9 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
                      "nets like the bundled digits-cnn)", default=3)
     compute_dtype = Param("float32|bfloat16", default="float32")
     mini_batch_size = Param("max rows per device batch", default=64)
+    devices = Param(
+        "data-parallel device spec: None, 'all', int N, or a device "
+        "sequence — buckets are dp-sharded by the executor", default=None)
 
     def __init__(self, model_path: Optional[str] = None,
                  model_bytes: Optional[bytes] = None, **kw):
@@ -66,10 +69,13 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         self.__dict__.pop("_feat_cache", None)
 
     def _pieces(self):
+        from synapseml_tpu.runtime.executor import resolve_devices
         cache = self.__dict__.get("_feat_cache")
+        devs = resolve_devices(self.devices)
+        dev_key = None if devs is None else tuple(d.id for d in devs)
         key = (self.cut_output_layers, self.compute_dtype,
                self.mini_batch_size, tuple(self.mean), tuple(self.std),
-               self.channels, hash(self.model_payload))
+               self.channels, hash(self.model_payload), dev_key)
         if cache is not None and cache[0] == key:
             return cache[1]
         graph: ImportedGraph = import_model(self.model_payload)
@@ -110,7 +116,7 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             return out.reshape(out.shape[0], -1).astype(jnp.float32)
 
         executor = BatchedExecutor(fn, max_bucket=self.mini_batch_size,
-                                   bound_args=(params,))
+                                   bound_args=(params,), devices=devs)
         self.__dict__["_feat_cache"] = (key, executor)
         return executor
 

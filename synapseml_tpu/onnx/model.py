@@ -49,6 +49,12 @@ class ONNXModel(Transformer):
         "after casting an integer feed to the compute dtype: the wire "
         "carries uint8 pixels (1 byte/px vs 2 for bf16) and the fused "
         "(x - mean) * scale runs where bandwidth is free", default=None)
+    devices = Param(
+        "data-parallel device spec: None (single default device), 'all', "
+        "an int N (first N local devices), or a device sequence — each "
+        "mini-batch bucket is dp-sharded across them by the executor "
+        "(runtime/executor.py), bit-identical to single-device",
+        default=None)
 
     def __init__(self, model_path: Optional[str] = None,
                  model_bytes: Optional[bytes] = None, **kw):
@@ -157,7 +163,11 @@ class ONNXModel(Transformer):
                 (k, np.asarray(v).tobytes(), np.asarray(v).shape)
                 for k, v in spec.items())))
             for name, spec in sorted(norm.items()))
-        key = (id(g), self.mini_batch_size, self.compute_dtype, norm_key)
+        from synapseml_tpu.runtime.executor import resolve_devices
+        devs = resolve_devices(self.devices)
+        dev_key = None if devs is None else tuple(d.id for d in devs)
+        key = (id(g), self.mini_batch_size, self.compute_dtype, norm_key,
+               dev_key)
         if key not in cache:
             dtype = _DTYPES[self.compute_dtype]
             params = g.params
@@ -210,7 +220,8 @@ class ONNXModel(Transformer):
                 cache.pop(next(iter(cache)))
             cache[key] = BatchedExecutor(
                 apply_fn, compute_dtype=compute,
-                max_bucket=self.mini_batch_size, bound_args=(params,))
+                max_bucket=self.mini_batch_size, bound_args=(params,),
+                devices=devs)
         return cache[key]
 
     def _transform(self, table: Table) -> Table:

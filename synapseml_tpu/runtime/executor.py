@@ -19,7 +19,21 @@ with a jit-cache-aware executor:
   in flight; ``__call__`` is submit+drain — so overlap now happens *across*
   calls and callers, the role ORT's IOBinding plays for the reference, not
   just within one multi-batch call. Inputs are donated to XLA on non-CPU
-  backends so same-bucket batches reuse device buffers instead of allocating.
+  backends so same-bucket batches reuse device buffers instead of allocating
+  — but only the inputs whose shape/dtype an output can actually alias (see
+  :meth:`BatchedExecutor._donate_mask_for`).
+- **Multi-device data parallelism**: ``devices=`` fans each padded bucket out
+  over a 1-axis ``dp`` mesh via ``NamedSharding`` — ONE jitted program whose
+  batch dimension XLA splits across the chips, no collectives for
+  per-row programs — the embarrassingly-parallel scoring fan-out the
+  reference gets from Spark partitions (ref: ONNXModel.scala:497-508, one
+  session per executor). Buckets a topology cannot split evenly (non-pow2
+  device counts) fall back to round-robin per-device dispatch: successive
+  buckets land whole on successive chips, so the submit/drain pipeline still
+  keeps every chip busy. Both layouts sit UNDER the async pipeline — staging,
+  H2D, compute, and D2H keep overlapping while compute fans out — and both
+  produce bit-identical outputs, in submission order, versus the
+  single-device path.
 """
 from __future__ import annotations
 
@@ -59,6 +73,39 @@ def coerce_host_array(arr: np.ndarray, compute_dtype: Optional[Any] = None) -> n
     if compute_dtype is not None and np.issubdtype(arr.dtype, np.floating):
         arr = arr.astype(compute_dtype)
     return arr
+
+
+def resolve_devices(spec) -> Optional[Tuple[jax.Device, ...]]:
+    """Normalize a user-facing device spec to a tuple of devices.
+
+    ``None`` -> None (single default device); ``"all"`` -> every local
+    device; an int ``n`` -> the first n local devices; a sequence of
+    devices passes through. Raises on anything else so a typo'd spec
+    fails at construction, not as a silent single-device run.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        if spec != "all":
+            raise ValueError(
+                f"devices spec {spec!r} not understood (use None, 'all', "
+                "an int, or a sequence of jax devices)")
+        return tuple(jax.local_devices())
+    if isinstance(spec, int) and not isinstance(spec, bool):
+        local = jax.local_devices()
+        if not 0 < spec <= len(local):
+            raise ValueError(
+                f"devices={spec} but {len(local)} local devices exist")
+        return tuple(local[:spec])
+    if isinstance(spec, bool):
+        # devices=True would satisfy the int branch and silently resolve
+        # to ONE device — the opposite of what the caller meant
+        raise ValueError("devices=True/False is ambiguous — use 'all', "
+                         "an int, or a device sequence")
+    devs = tuple(spec)
+    if not devs:
+        raise ValueError("devices sequence is empty")
+    return devs
 
 
 _SHUTDOWN = object()
@@ -292,17 +339,20 @@ class BatchedExecutor:
         max_bucket: Optional[int] = None,
         static_batch: Optional[int] = None,
         bound_args: Tuple[Any, ...] = (),
-        pipeline_depth: int = 2,
+        pipeline_depth: Optional[int] = None,
         donate: Optional[bool] = None,
         transfer_batches: Union[int, str, None] = None,
         stage_workers: int = 2,
+        devices: Union[None, str, int, Sequence[jax.Device]] = None,
     ):
         """``bound_args`` are prepended to every call unpadded — use for a
         weights pytree so it is device-resident and *shared* across all shape
         buckets instead of baked into each compiled program as constants.
 
         ``donate=None`` donates batch inputs to XLA whenever the target
-        backend is not CPU (CPU ignores donation and would warn).
+        backend is not CPU (CPU ignores donation and would warn). Only
+        inputs whose shape/dtype some output can alias are annotated —
+        see :meth:`_donate_mask_for`.
 
         ``transfer_batches`` groups that many compute buckets into ONE
         explicit host->device copy (compute then runs per bucket on
@@ -316,19 +366,60 @@ class BatchedExecutor:
 
         ``stage_workers`` bounds the host-staging pool: that many batches'
         coerce+pad host work can proceed concurrently with dispatch and
-        fetch of earlier batches."""
+        fetch of earlier batches.
+
+        ``devices`` turns on multi-device data parallelism: ``"all"``,
+        an int, or an explicit device sequence (:func:`resolve_devices`).
+        Buckets divisible by the device count are sharded over a 1-axis
+        ``dp`` mesh (one jit, batch dim split); indivisible buckets
+        dispatch round-robin, one whole bucket per device. A one-element
+        ``devices`` degenerates to the pinned single-device path."""
+        devices = resolve_devices(devices)
+        if devices is not None and device is not None:
+            raise ValueError("pass either device= or devices=, not both")
+        if devices is not None and len(devices) == 1:
+            device, devices = devices[0], None
         self._device = device
+        self._devices = devices
+        if devices is not None:
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec)  # local: cheap import
+            self._mesh = Mesh(np.asarray(devices), ("dp",))
+            self._shard_data = NamedSharding(self._mesh, PartitionSpec("dp"))
+            self._shard_repl = NamedSharding(self._mesh, PartitionSpec())
+        else:
+            self._mesh = self._shard_data = self._shard_repl = None
         self._compute_dtype = compute_dtype
         self._min_bucket = min_bucket
         self._max_bucket = max_bucket
         self._static_batch = static_batch
+        if pipeline_depth is None:
+            # multi-device default: the round-robin layout parallelizes
+            # ACROSS in-flight buckets, so the depth must cover the
+            # topology (+1 so drain of the oldest overlaps dispatch of
+            # the newest) or at most `depth` chips ever compute at once;
+            # single-device keeps the measured default of 2
+            pipeline_depth = 2 if devices is None else len(devices) + 1
         self._depth = max(1, int(pipeline_depth))
         self._stage_workers = max(1, int(stage_workers))
-        self._bound = tuple(
-            jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, device) if device else jnp.asarray(a),
-                b) for b in bound_args)
+        if devices is not None:
+            # weights replicated once across the mesh: every shard of a
+            # dp-split batch (and the sharded jit) reads its local copy
+            self._bound = tuple(
+                jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, self._shard_repl), b)
+                for b in bound_args)
+        else:
+            self._bound = tuple(
+                jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, device) if device
+                    else jnp.asarray(a), b) for b in bound_args)
+        # round-robin fallback state: per-device bound-arg replicas (lazy)
+        # and the next-device cursor (dispatch-thread-serial)
+        self._bound_rr: Dict[int, tuple] = {}
+        self._rr_next = 0
         plat = (device.platform if device is not None
+                else devices[0].platform if devices is not None
                 else jax.default_backend())
         if donate is None:
             donate = plat not in ("cpu",)
@@ -339,9 +430,13 @@ class BatchedExecutor:
             transfer_batches = max(1, int(transfer_batches))
         self._transfer_batches = transfer_batches  # "auto" = ~32MB groups
         self._fn = fn
-        # donation indices depend on the call arity, which is only known at
-        # call time — one jitted callable per arity
-        self._jits: Dict[int, Callable] = {}
+        # donation indices depend on the call arity AND on which inputs an
+        # output can alias (shape/dtype match) — one jitted callable per
+        # (arity, donate-mask); jax itself caches executables per input
+        # sharding/placement under each callable, which keeps per-bucket
+        # compiles separate per layout (single / dp-sharded / per-device)
+        self._jits: Dict[Tuple[int, Tuple[bool, ...]], Callable] = {}
+        self._donate_masks: Dict[tuple, Tuple[bool, ...]] = {}
         self._pipeline: Optional[_PipelineState] = None
         self._pipeline_init_lock = threading.Lock()
         self._finalizer = None
@@ -350,19 +445,63 @@ class BatchedExecutor:
     def pipeline_depth(self) -> int:
         return self._depth
 
-    def _jit_for(self, n_args: int) -> Callable:
-        got = self._jits.get(n_args)
+    @property
+    def devices(self) -> Optional[Tuple[jax.Device, ...]]:
+        return self._devices
+
+    @property
+    def n_devices(self) -> int:
+        return len(self._devices) if self._devices is not None else 1
+
+    def _jit_for(self, n_args: int,
+                 mask: Tuple[bool, ...] = ()) -> Callable:
+        got = self._jits.get((n_args, mask))
         if got is None:
-            donate = tuple(range(len(self._bound), len(self._bound) + n_args)) \
-                if self._donate else ()
+            donate = tuple(len(self._bound) + i
+                           for i, m in enumerate(mask) if m)
             got = jax.jit(self._fn, donate_argnums=donate)
-            self._jits[n_args] = got
+            self._jits[(n_args, mask)] = got
         return got
 
-    def _stage_device_array(self, a: jax.Array, target_rows: int):
+    def _donate_mask_for(self, padded: Sequence[Any]) -> Tuple[bool, ...]:
+        """Which batch inputs to donate: only those whose (shape, dtype)
+        some output leaf can actually alias. Donating a buffer no output
+        matches makes XLA warn "Some donated buffers were not usable" per
+        compile and donates nothing — the annotation must match the real
+        buffer layouts. Greedy multiset matching on abstract shapes via
+        ``eval_shape`` (no compile, no execution), cached per input
+        signature."""
+        if not self._donate or not padded:
+            return (False,) * len(padded)
+        sig = tuple((tuple(np.shape(a)), jnp.dtype(a.dtype).name)
+                    for a in padded)
+        got = self._donate_masks.get(sig)
+        if got is None:
+            try:
+                out = jax.eval_shape(self._fn, *self._bound, *padded)
+                avail: Dict[tuple, int] = {}
+                for l in jax.tree_util.tree_leaves(out):
+                    k = (tuple(l.shape), jnp.dtype(l.dtype).name)
+                    avail[k] = avail.get(k, 0) + 1
+                mask = []
+                for k in sig:
+                    if avail.get(k, 0) > 0:
+                        avail[k] -= 1
+                        mask.append(True)
+                    else:
+                        mask.append(False)
+                got = tuple(mask)
+            except Exception:  # noqa: BLE001 - eval_shape is best-effort
+                got = (True,) * len(padded)  # old behavior: donate all
+            self._donate_masks[sig] = got
+        return got
+
+    def _stage_device_array(self, a: jax.Array, target_rows: int,
+                            placement: Any = None):
         """Pad/coerce/place an already-device-resident array entirely on
-        device. Returns ``(array, fresh)`` — ``fresh`` is True when a new
-        buffer was definitely created (safe to donate)."""
+        device. ``placement`` is a device, a sharding, or None (leave
+        where it is). Returns ``(array, fresh)`` — ``fresh`` is True when
+        a new buffer was definitely created (safe to donate)."""
         fresh = False
         if len(a) != target_rows:
             pad = [(0, target_rows - len(a))] + [(0, 0)] * (a.ndim - 1)
@@ -373,15 +512,43 @@ class BatchedExecutor:
                 and a.dtype != jnp.dtype(self._compute_dtype)):
             a = a.astype(self._compute_dtype)
             fresh = True
-        if self._device is not None:
+        if placement is not None:
             try:
-                misplaced = a.device != self._device
+                if isinstance(placement, jax.Device):
+                    misplaced = a.device != placement
+                else:  # a NamedSharding: reshard unless already identical
+                    misplaced = a.sharding != placement
             except Exception:  # multi-device/sharded array
                 misplaced = True
             if misplaced:
-                a = jax.device_put(a, self._device)
+                a = jax.device_put(a, placement)
                 fresh = True
         return a, fresh
+
+    # -- multi-device layout --------------------------------------------
+    def _layout(self, bucket: int) -> str:
+        """Sharding layout for one bucket: ``"shard"`` when the batch
+        dimension splits evenly over the dp mesh (single jit, no
+        collectives for per-row programs), ``"rr"`` (round-robin whole
+        buckets onto successive devices) when it cannot — non-pow2
+        topologies, or buckets smaller than the device count — and
+        ``"single"`` without ``devices``."""
+        if self._devices is None:
+            return "single"
+        return "shard" if bucket % len(self._devices) == 0 else "rr"
+
+    def _bound_for_device(self, dev: jax.Device) -> tuple:
+        """Per-device bound-arg replicas for the round-robin path. Lazily
+        extracted from the mesh-replicated copies (each chip already holds
+        a shard-local replica; device_put pins a committed single-device
+        view for the per-device jit)."""
+        got = self._bound_rr.get(dev.id)
+        if got is None:
+            got = tuple(
+                jax.tree_util.tree_map(lambda a: jax.device_put(a, dev), b)
+                for b in self._bound)
+            self._bound_rr[dev.id] = got
+        return got
 
     def _bucket(self, n: int) -> int:
         if self._static_batch is not None:
@@ -442,6 +609,11 @@ class BatchedExecutor:
 
     def _resolve_transfer_batches(self, host_arrays, bucket: int):
         tb = self._transfer_batches
+        if self._devices is not None:
+            # multi-device: per-bucket staging only — a grouped device_put
+            # would pin the super-chunk to one chip and every bucket slice
+            # would reshard off it, serializing the fan-out
+            return 1
         if tb != "auto":
             return tb
         # group buckets up to ~32MB per explicit copy (shape/dtype
@@ -489,7 +661,8 @@ class BatchedExecutor:
             if isinstance(sl, jax.Array):
                 # already device-resident: pad/coerce on device, no
                 # host round trip
-                devs.append(self._stage_device_array(sl, rows)[0])
+                devs.append(
+                    self._stage_device_array(sl, rows, self._device)[0])
                 continue
             sl = coerce_host_array(np.asarray(sl), self._compute_dtype)
             if rows > sc_n:
@@ -594,17 +767,36 @@ class BatchedExecutor:
         blocking. ``internal`` marks super-chunk slices the executor
         staged itself (safe to donate). Idempotent over pre-staged host
         chunks: the staging pool already coerced+padded them, so the
-        re-coerce here is a no-op passthrough."""
+        re-coerce here is a no-op passthrough.
+
+        With ``devices=``, the bucket either rides ONE sharded jit call
+        (batch dim dp-split across the mesh) or — when the bucket does
+        not divide over the topology — lands whole on the next device in
+        round-robin order. Either way this method stays ordered and
+        non-blocking, so the surrounding pipeline semantics (submission
+        order, depth backpressure) are untouched."""
+        layout = self._layout(bucket)
+        if layout == "shard":
+            placement: Any = self._shard_data
+            bound = self._bound
+        elif layout == "rr":
+            dev = self._devices[self._rr_next % len(self._devices)]
+            self._rr_next += 1
+            placement = dev
+            bound = self._bound_for_device(dev)
+        else:
+            placement = self._device
+            bound = self._bound
         padded = []
-        for a in arrays:
+        guard: List[int] = []  # external device arrays we did not copy
+        for i, a in enumerate(arrays):
             if isinstance(a, jax.Array):
                 # super-chunk slices pass through; an *external* device
                 # array is padded/coerced on device so it lines up with
                 # bucket-padded host args
-                a, fresh = self._stage_device_array(a, bucket)
+                a, fresh = self._stage_device_array(a, bucket, placement)
                 if self._donate and not (fresh or internal):
-                    # donation would delete the caller's own buffer
-                    a = jnp.copy(a)
+                    guard.append(i)
                 padded.append(a)
                 continue
             a = coerce_host_array(np.asarray(a), self._compute_dtype)
@@ -612,8 +804,13 @@ class BatchedExecutor:
                 pad = [(0, bucket - n)] + [(0, 0)] * (a.ndim - 1)
                 a = np.pad(a, pad)
             padded.append(
-                jax.device_put(a, self._device) if self._device else a)
-        out = self._jit_for(len(padded))(*self._bound, *padded)
+                jax.device_put(a, placement) if placement is not None else a)
+        mask = self._donate_mask_for(padded)
+        for i in guard:
+            if mask[i]:
+                # donation would delete the caller's own buffer
+                padded[i] = jnp.copy(padded[i])
+        out = self._jit_for(len(padded), mask)(*bound, *padded)
         return out, n, bucket
 
     def _fetch(self, out, n: int, bucket: int):

@@ -492,3 +492,114 @@ def test_exact_commit_preserves_earlier_inflight_epochs():
         assert sorted(r[0] for r in results.values()) == [200, 200]
     finally:
         ws.stop()
+
+
+def test_drain_queue_coalesce_window_batches_staggered_arrivals():
+    """Deadline-based coalescing: requests arriving WITHIN the window of
+    the first request's arrival ride the same batch — the knob that lets
+    concurrent low-QPS clients share one device round trip."""
+    import queue as _q
+
+    from synapseml_tpu.io.http import HTTPRequestData
+    from synapseml_tpu.io.serving import CachedRequest, _drain_queue
+
+    q: "_q.Queue" = _q.Queue()
+    q.put(CachedRequest("a", HTTPRequestData(url="/", method="POST", headers={}, entity=b"1")))
+
+    def late():
+        time.sleep(0.05)
+        q.put(CachedRequest("b", HTTPRequestData(url="/", method="POST", headers={}, entity=b"2")))
+
+    t = threading.Thread(target=late)
+    t.start()
+    out = _drain_queue(q, max_rows=8, timeout=0.5, coalesce=0.3)
+    t.join()
+    assert [cr.rid for cr in out] == ["a", "b"]
+    # without a window the drain takes what's there: the late request
+    # would have ridden the NEXT batch
+    q2: "_q.Queue" = _q.Queue()
+    q2.put(CachedRequest("a", HTTPRequestData(url="/", method="POST", headers={}, entity=b"1")))
+    out2 = _drain_queue(q2, max_rows=8, timeout=0.5)
+    assert [cr.rid for cr in out2] == ["a"]
+
+
+def test_drain_queue_coalesce_deadline_is_arrival_anchored():
+    """A request that already sat in the queue longer than the window
+    (busy scorer) must pay ZERO extra delay — the deadline anchors at
+    arrival, unlike linger which restarts at observation."""
+    import queue as _q
+
+    from synapseml_tpu.io.http import HTTPRequestData
+    from synapseml_tpu.io.serving import CachedRequest, _drain_queue
+
+    q: "_q.Queue" = _q.Queue()
+    q.put(CachedRequest("old", HTTPRequestData(url="/", method="POST", headers={}, entity=b"1")))
+    time.sleep(0.25)  # request ages past the window
+    t0 = time.monotonic()
+    out = _drain_queue(q, max_rows=8, timeout=0.5, coalesce=0.2)
+    elapsed = time.monotonic() - t0
+    assert [cr.rid for cr in out] == ["old"]
+    assert elapsed < 0.15, f"aged request paid {elapsed:.3f}s extra wait"
+
+
+def test_continuous_server_batch_coalesce_amortizes_concurrent_clients():
+    """End-to-end: with batch_coalesce on, N near-simultaneous clients
+    score as FEWER pipeline_fn invocations than requests (micro-batch
+    amortization), and every client still gets its own reply."""
+    calls = []
+
+    def pipeline(table):
+        calls.append(table.num_rows)
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply({"echo": v})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("t_coalesce", pipeline, max_batch=16,
+                          batch_coalesce=0.15, pipelined=False).start()
+    try:
+        assert cs.batch_coalesce == 0.15
+        n_clients = 6
+        results = {}
+        barrier = threading.Barrier(n_clients)
+
+        def client(i):
+            barrier.wait()
+            results[i] = _post(cs.url, {"i": i})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == n_clients
+        assert all(r[0] == 200 and r[1]["echo"]["i"] == i
+                   for i, r in results.items())
+        assert sum(calls) == n_clients
+        assert len(calls) < n_clients, (
+            f"coalescing never batched: {calls}")
+    finally:
+        cs.stop()
+
+
+def test_drain_queue_coalesce_backlog_still_sweeps():
+    """An EXPIRED window must not degrade batching: with a backlog whose
+    head already aged past the coalesce window, the drain still sweeps
+    everything instantly available (like coalesce=0), instead of
+    returning a singleton per device round trip."""
+    import queue as _q
+
+    from synapseml_tpu.io.http import HTTPRequestData
+    from synapseml_tpu.io.serving import CachedRequest, _drain_queue
+
+    q: "_q.Queue" = _q.Queue()
+    for i in range(10):
+        q.put(CachedRequest(str(i), HTTPRequestData(
+            url="/", method="POST", headers={}, entity=b"x")))
+    time.sleep(0.25)  # head ages past the window
+    t0 = time.monotonic()
+    out = _drain_queue(q, max_rows=64, timeout=0.5, coalesce=0.2)
+    elapsed = time.monotonic() - t0
+    assert [cr.rid for cr in out] == [str(i) for i in range(10)]
+    assert elapsed < 0.15, f"expired-window sweep waited {elapsed:.3f}s"

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Hard-timeout smoke for the multi-device data-parallel executor.
+#
+# Forces an 8-device virtual CPU platform (the TPU-slice stand-in) and
+# runs the multi-device suite alone: sharded-vs-single bit-identity,
+# stream() ordering, the n=1 degenerate path, ragged final buckets, and
+# the round-robin fallback for odd topologies. Like smoke_pipeline.sh,
+# a wedged dispatch across devices would HANG rather than fail — the
+# timeout turns that into a fast exit-124.
+#
+# Usage: tools/ci/smoke_multidevice.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+exec timeout -k 10 "${SMOKE_TIMEOUT:-300}" env JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m pytest tests/test_executor_multidevice.py -q -p no:cacheprovider
