@@ -3,6 +3,7 @@
 #' Runs a CNTK-lineage network (exported to ONNX) as a transformer.
 #'
 #' @param argmax_output_col column for argmax of first output
+#' @param compile_cache_dir persistent compile-cache directory (default: the SYNAPSEML_COMPILE_CACHE env var; unset = off) — wires JAX's persistent compilation cache and the serialized-executable store warmup() persists into, so a restarted process deserializes instead of recompiling (runtime/compile_cache.py)
 #' @param compute_dtype device compute dtype: float32|bfloat16|float16
 #' @param cut_layers trailing graph nodes dropped (headless featurization; persists across serde)
 #' @param devices data-parallel device spec: None (single default device), 'all', an int N (first N local devices), or a device sequence — each mini-batch bucket is dp-sharded across them by the executor (runtime/executor.py), bit-identical to single-device
@@ -14,10 +15,11 @@
 #' @param softmax_output_col column for softmax of first output
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_cntk_model <- function(argmax_output_col = NULL, compute_dtype = "float32", cut_layers = 0, devices = NULL, feed_dict = NULL, fetch_dict = NULL, input_norm = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
+smt_cntk_model <- function(argmax_output_col = NULL, compile_cache_dir = NULL, compute_dtype = "float32", cut_layers = 0, devices = NULL, feed_dict = NULL, fetch_dict = NULL, input_norm = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
   mod <- reticulate::import("synapseml_tpu.dl.cntk")
   kwargs <- Filter(Negate(is.null), list(
     argmax_output_col = argmax_output_col,
+    compile_cache_dir = compile_cache_dir,
     compute_dtype = compute_dtype,
     cut_layers = cut_layers,
     devices = devices,

@@ -3,6 +3,7 @@
 #' Featurize an image column through a truncated deep network.
 #'
 #' @param channels backbone input channels (3, or 1 for grayscale nets like the bundled digits-cnn)
+#' @param compile_cache_dir persistent compile-cache directory (default: the SYNAPSEML_COMPILE_CACHE env var; unset = off) — enables warmup() persistence so a restarted process deserializes executables instead of recompiling
 #' @param compute_dtype float32|bfloat16
 #' @param cut_output_layers trailing graph nodes to drop
 #' @param devices data-parallel device spec: None, 'all', int N, or a device sequence — buckets are dp-sharded by the executor
@@ -15,10 +16,11 @@
 #' @param std per-channel normalization std
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_image_featurizer <- function(channels = 3, compute_dtype = "float32", cut_output_layers = 1, devices = NULL, image_size = 224, input_col = "input", mean = c(0.485, 0.456, 0.406), mini_batch_size = 64, model_payload = NULL, output_col = "output", std = c(0.229, 0.224, 0.225)) {
+smt_image_featurizer <- function(channels = 3, compile_cache_dir = NULL, compute_dtype = "float32", cut_output_layers = 1, devices = NULL, image_size = 224, input_col = "input", mean = c(0.485, 0.456, 0.406), mini_batch_size = 64, model_payload = NULL, output_col = "output", std = c(0.229, 0.224, 0.225)) {
   mod <- reticulate::import("synapseml_tpu.image.featurizer")
   kwargs <- Filter(Negate(is.null), list(
     channels = channels,
+    compile_cache_dir = compile_cache_dir,
     compute_dtype = compute_dtype,
     cut_output_layers = cut_output_layers,
     devices = devices,

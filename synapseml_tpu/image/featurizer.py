@@ -51,6 +51,11 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
     devices = Param(
         "data-parallel device spec: None, 'all', int N, or a device "
         "sequence — buckets are dp-sharded by the executor", default=None)
+    compile_cache_dir = Param(
+        "persistent compile-cache directory (default: the "
+        "SYNAPSEML_COMPILE_CACHE env var; unset = off) — enables "
+        "warmup() persistence so a restarted process deserializes "
+        "executables instead of recompiling", default=None)
 
     def __init__(self, model_path: Optional[str] = None,
                  model_bytes: Optional[bytes] = None, **kw):
@@ -75,7 +80,8 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         dev_key = None if devs is None else tuple(d.id for d in devs)
         key = (self.cut_output_layers, self.compute_dtype,
                self.mini_batch_size, tuple(self.mean), tuple(self.std),
-               self.channels, hash(self.model_payload), dev_key)
+               self.channels, hash(self.model_payload), dev_key,
+               self.compile_cache_dir)
         if cache is not None and cache[0] == key:
             return cache[1]
         graph: ImportedGraph = import_model(self.model_payload)
@@ -115,10 +121,28 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             (out,) = graph.apply(p, x)
             return out.reshape(out.shape[0], -1).astype(jnp.float32)
 
+        # content hash over backbone bytes + featurization config: the
+        # persistent-executable key ingredient (changed weights or a
+        # different cut/normalization must miss, never reuse)
+        from synapseml_tpu.runtime import compile_cache as _cc
+        cache_key = _cc.content_hash(
+            self.model_payload, self.cut_output_layers, self.compute_dtype,
+            tuple(self.mean), tuple(self.std), c)
         executor = BatchedExecutor(fn, max_bucket=self.mini_batch_size,
-                                   bound_args=(params,), devices=devs)
+                                   bound_args=(params,), devices=devs,
+                                   cache_key=cache_key,
+                                   cache_dir=self.compile_cache_dir)
         self.__dict__["_feat_cache"] = (key, executor)
         return executor
+
+    def warmup(self, buckets=None):
+        """AOT-compile every mini-batch bucket of the NCHW featurization
+        signature (and persist it when a compile-cache dir is configured)
+        so the first scored image never waits on XLA — see
+        :meth:`synapseml_tpu.runtime.executor.BatchedExecutor.warmup`."""
+        size = int(self.image_size)
+        row = (int(self.channels), size, size)
+        return self._pieces().warmup([(row, np.float32)], buckets=buckets)
 
     def _prepare(self, v: Any) -> Optional[np.ndarray]:
         """Anything image-ish -> [size, size, 3] float32 HWC."""

@@ -123,9 +123,15 @@ class WorkerServer:
 
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: Optional[int] = None, api_path: str = "/",
-                 reply_timeout: float = 60.0):
+                 reply_timeout: float = 60.0, ready: bool = True):
         self.name = name
         self.host = host
+        # readiness gate: /health answers 503 until set_ready(True) —
+        # a k8s replica that is still AOT-warming its compile cache must
+        # not receive traffic (the serving entry's --warmup flow)
+        self._ready = threading.Event()
+        if ready:
+            self._ready.set()
         # port=0 lets the OS assign one race-free; the actual port is read
         # back from server_address after bind
         self.port = 0 if port is None else port
@@ -185,9 +191,15 @@ class WorkerServer:
 
             def do_GET(self):
                 if self.path == "/health":
-                    # k8s readiness fast-path: never rides the pipeline
-                    body = b"ok"
-                    self.send_response(200)
+                    # k8s readiness fast-path: never rides the pipeline.
+                    # 503 while warming keeps the load balancer away from
+                    # a replica that would park requests on a compiling
+                    # (or not-yet-started) scoring query
+                    if outer._ready.is_set():
+                        body, status = b"ok", 200
+                    else:
+                        body, status = b"warming", 503
+                    self.send_response(status)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -213,6 +225,18 @@ class WorkerServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}{self.api_path}"
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def set_ready(self, ready: bool = True):
+        """Flip the /health readiness gate (the serving entry calls this
+        after AOT warmup completes)."""
+        if ready:
+            self._ready.set()
+        else:
+            self._ready.clear()
 
     # -- source side ----------------------------------------------------
     def get_batch(self, max_rows: int = 64, timeout: float = 0.1,
@@ -537,7 +561,8 @@ class ContinuousServer:
                  max_batch: int = 64, parse_json: bool = True,
                  reply_col: str = "reply", reply_timeout: float = 60.0,
                  batch_linger: float = 0.0, pipelined: bool = True,
-                 scoring_workers: int = 1, batch_coalesce: float = 0.0):
+                 scoring_workers: int = 1, batch_coalesce: float = 0.0,
+                 ready: bool = True):
         """``batch_linger``: seconds to keep collecting after the first
         request of a batch arrives. A few ms turns concurrent clients'
         requests into ONE scored micro-batch (one device round trip
@@ -582,9 +607,19 @@ class ContinuousServer:
         scores batch k+1 — and since the scorer itself feeds the
         executor's async submit/drain pipeline (runtime/executor.py),
         host staging, H2D, device compute, and D2H fetch of consecutive
-        micro-batches all overlap instead of alternating."""
+        micro-batches all overlap instead of alternating.
+
+        ``ready=False`` starts the embedded server with its /health
+        readiness gate CLOSED (503): the caller warms the compile cache
+        first, then flips ``self.server.set_ready(True)`` — so traffic
+        never lands on a compiling chip (the ``main()`` --warmup flow)."""
         self.server = HTTPSourceStateHolder.get_or_create_server(
-            name, host, port, reply_timeout=reply_timeout)
+            name, host, port, reply_timeout=reply_timeout, ready=ready)
+        if not ready:
+            # the registry may have returned an EXISTING server (ctor
+            # kwargs ignored): close the gate explicitly so a reused name
+            # still holds /health at 503 through warmup
+            self.server.set_ready(False)
         self.name = name
         self.pipeline_fn = pipeline_fn
         self.max_batch = max_batch
@@ -798,11 +833,14 @@ class ContinuousServer:
         HTTPSourceStateHolder.remove(self.name)
 
 
-def _model_pipeline(model_path: str, devices=None):
+def _model_pipeline(model_path: str, devices=None, cache_dir=None):
     """JSON {"features": [...]} -> ONNX-scored reply — the deployment
     entry's default pipeline (tools/k8s/chart serving template).
     ``devices`` dp-shards each scored micro-batch across that many chips
-    (ONNXModel.devices -> runtime/executor.py)."""
+    (ONNXModel.devices -> runtime/executor.py); ``cache_dir`` enables the
+    persistent compile cache + executable store (--cache-dir /
+    SYNAPSEML_COMPILE_CACHE). Returns ``(pipeline, model)`` so ``main``
+    can drive ``model.warmup`` before opening the readiness gate."""
     import numpy as np
 
     from synapseml_tpu.onnx import ONNXModel
@@ -810,6 +848,8 @@ def _model_pipeline(model_path: str, devices=None):
     model = ONNXModel(model_path=model_path)
     if devices is not None:
         model.set(devices=devices)
+    if cache_dir is not None:
+        model.set(compile_cache_dir=cache_dir)
     feed = model.graph.input_names[0]
 
     def pipeline(table: Table) -> Table:
@@ -825,7 +865,7 @@ def _model_pipeline(model_path: str, devices=None):
 
     # ONNXModel resolves feed_dict lazily; set it for the raw-name feed
     model.set(feed_dict={feed: feed})
-    return pipeline
+    return pipeline, model
 
 
 def main(argv=None):
@@ -849,6 +889,18 @@ def main(argv=None):
     ap.add_argument("--coalesce-ms", type=float, default=float(os.environ.get(
         "SYNAPSEML_COALESCE_MS", "0")),
         help="deadline-based batching window in ms (0 = off)")
+    ap.add_argument("--cache-dir", default=os.environ.get(
+        "SYNAPSEML_COMPILE_CACHE") or None,
+        help="persistent compile-cache directory (mount a volume here so "
+             "restarted replicas deserialize executables instead of "
+             "recompiling); unset = off")
+    ap.add_argument("--warmup", default=os.environ.get(
+        "SYNAPSEML_WARMUP", ""),
+        help="AOT-compile model buckets before going ready: 'auto' "
+             "(the executor's full bucket ladder) or comma-separated "
+             "bucket sizes; empty = no warmup. /health answers 503 "
+             "until warmup completes, so traffic never lands on a "
+             "compiling chip")
     args = ap.parse_args(argv)
     devices = args.devices or None  # unset env var arrives as ""
     if devices is not None:
@@ -871,8 +923,10 @@ def main(argv=None):
         print(f"error: model path {args.model!r} does not exist",
               flush=True)
         return 2
+    model = None
     if args.model:
-        pipeline = _model_pipeline(args.model, devices=devices)
+        pipeline, model = _model_pipeline(args.model, devices=devices,
+                                          cache_dir=args.cache_dir)
         what = f"scoring {args.model}"
         if devices is not None:
             what += f" [devices={devices}]"
@@ -884,9 +938,31 @@ def main(argv=None):
             return table.with_column("reply", replies)
         what = "echo (no SYNAPSEML_MODEL_PATH)"
 
+    do_warmup = bool(args.warmup) and model is not None
+    # the server binds (and answers /health 503) BEFORE warmup: k8s sees
+    # the pod alive-but-unready instead of probe-timing-out a silent one
     cs = ContinuousServer(args.name, pipeline, host=args.host,
                           port=args.port,
-                          batch_coalesce=args.coalesce_ms / 1e3).start()
+                          batch_coalesce=args.coalesce_ms / 1e3,
+                          ready=not do_warmup)
+    if do_warmup:
+        buckets = None if args.warmup == "auto" else \
+            [int(b) for b in args.warmup.split(",") if b.strip()]
+        print(f"warming up [{what}] buckets="
+              f"{'auto' if buckets is None else buckets} "
+              f"cache_dir={args.cache_dir!r} ...", flush=True)
+        try:
+            rep = model.warmup(buckets=buckets)
+            print(f"warmup done: {rep!r}", flush=True)
+        except Exception as e:  # noqa: BLE001 - degrade, never crash-loop
+            # e.g. a graph input with dynamic non-batch dims warmup can't
+            # synthesize: serve with lazy per-bucket compilation (today's
+            # behavior) rather than CrashLoopBackOff the replica — the
+            # cold-start optimization must never cost availability
+            print(f"warmup skipped ({e!r}); serving with lazy "
+                  "compilation", flush=True)
+        cs.server.set_ready(True)
+    cs.start()
     print(f"serving [{what}] on {cs.url} (GET /health ready)", flush=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

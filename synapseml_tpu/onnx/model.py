@@ -55,6 +55,13 @@ class ONNXModel(Transformer):
         "mini-batch bucket is dp-sharded across them by the executor "
         "(runtime/executor.py), bit-identical to single-device",
         default=None)
+    compile_cache_dir = Param(
+        "persistent compile-cache directory (default: the "
+        "SYNAPSEML_COMPILE_CACHE env var; unset = off) — wires JAX's "
+        "persistent compilation cache and the serialized-executable "
+        "store warmup() persists into, so a restarted process "
+        "deserializes instead of recompiling "
+        "(runtime/compile_cache.py)", default=None)
 
     def __init__(self, model_path: Optional[str] = None,
                  model_bytes: Optional[bytes] = None, **kw):
@@ -167,7 +174,7 @@ class ONNXModel(Transformer):
         devs = resolve_devices(self.devices)
         dev_key = None if devs is None else tuple(d.id for d in devs)
         key = (id(g), self.mini_batch_size, self.compute_dtype, norm_key,
-               dev_key)
+               dev_key, self.compile_cache_dir)
         if key not in cache:
             dtype = _DTYPES[self.compute_dtype]
             params = g.params
@@ -218,11 +225,54 @@ class ONNXModel(Transformer):
                 del cache[stale]
             while len(cache) >= 4:
                 cache.pop(next(iter(cache)))
+            # content hash over graph+weights config: the persistent-
+            # executable key ingredient that invalidates on changed model
+            # bytes. The graph's node count + outputs disambiguate
+            # truncated subgraphs (CNTKModel cut_layers) sharing a payload
+            from synapseml_tpu.runtime import compile_cache as _cc
+            cache_key = _cc.content_hash(
+                self.model_payload or b"", len(g._nodes),
+                tuple(g.output_names), self.compute_dtype, norm_key)
             cache[key] = BatchedExecutor(
                 apply_fn, compute_dtype=compute,
                 max_bucket=self.mini_batch_size, bound_args=(params,),
-                devices=devs)
+                devices=devs, cache_key=cache_key,
+                cache_dir=self.compile_cache_dir)
         return cache[key]
+
+    def warmup(self, buckets=None, example_feeds=None):
+        """AOT-compile (and persist, when a compile-cache dir is
+        configured) every mini-batch bucket signature BEFORE traffic
+        arrives — the serving cold-start path then deserializes or reuses
+        executables instead of paying XLA compilation per bucket
+        (runtime/compile_cache.py; the reference ships prebuilt engines
+        in its jars, ONNXModel.scala:173-193).
+
+        Input shapes/dtypes come from the graph's declared inputs; pass
+        ``example_feeds`` (graph input name -> example array with a batch
+        dim) for inputs with dynamic non-batch dims or a different wire
+        dtype (e.g. the uint8-pixel wire under ``input_norm``). Returns a
+        :class:`~synapseml_tpu.runtime.compile_cache.WarmupReport`."""
+        g = self.graph
+        example_feeds = example_feeds or {}
+        args = []
+        for name in g.input_names:
+            if name in example_feeds:
+                a = np.asarray(example_feeds[name])
+                args.append((tuple(a.shape[1:]), a.dtype))
+                continue
+            want_dtype, shape = g.input_info.get(name, (None, None))
+            row = list(shape)[1:] if shape is not None else None
+            if row is None or any(not isinstance(d, int) or d <= 0
+                                  for d in row):
+                raise ValueError(
+                    f"graph input {name!r} has dynamic non-batch dims "
+                    f"{shape}: pass example_feeds[{name!r}] with the "
+                    "concrete serving shape")
+            args.append((tuple(int(d) for d in row),
+                         np.dtype(want_dtype) if want_dtype is not None
+                         else np.dtype(np.float32)))
+        return self._executor().warmup(args, buckets=buckets)
 
     def _transform(self, table: Table) -> Table:
         # ride the executor's shared submit/drain pipeline: concurrent

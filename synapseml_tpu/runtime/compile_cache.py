@@ -1,0 +1,283 @@
+"""Persistent compile cache + serialized-executable store: cold-start removal.
+
+The reference SynapseML ships prebuilt native engines inside jars, so a
+Spark Serving replica scores the moment the jar loads. The JAX
+reproduction instead pays full XLA compilation per process, per bucket
+shape, per device layout — tens of seconds of dead time on every
+container restart or autoscale event. This module takes that compile off
+the serving path with two independent layers:
+
+1. **JAX's persistent compilation cache** (:func:`enable_persistent_cache`)
+   — wired behind one framework knob (``SYNAPSEML_COMPILE_CACHE`` env var
+   or ``compile_cache_dir=``). XLA-level: any jit in the process whose
+   fingerprint matches a prior run deserializes instead of compiling.
+
+2. **Serialized-executable store** (:class:`ExecutableStore`) — the AOT
+   layer under :meth:`BatchedExecutor.warmup`: every (bucket, arity,
+   donation-mask, device-layout) signature is ``.lower().compile()``-ed up
+   front, serialized via ``jax.experimental.serialize_executable``, and
+   keyed by (caller content hash — graph/weights config —, input
+   signature, mesh shape, device kind, jax+jaxlib version). A restarted
+   replica deserializes the executable directly — no tracing, no XLA.
+
+Both layers degrade gracefully: any miss, version skew, or corrupt entry
+falls back to today's fresh-compile behavior — a broken cache can slow a
+restart down, never break it.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import pickle
+import tempfile
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+_ENV_KNOB = "SYNAPSEML_COMPILE_CACHE"
+_FORMAT_VERSION = 1
+_MAGIC = b"SMTXC1\n"
+
+_STATE_LOCK = threading.Lock()
+_PERSISTENT_WIRED: Optional[str] = None
+# every live store, so JitCache.clear() (runtime/executor.py) can drop
+# memoized executables without each test knowing which stores exist
+_OPEN_STORES: "weakref.WeakSet[ExecutableStore]" = weakref.WeakSet()
+
+
+def default_cache_dir() -> Optional[str]:
+    """The framework knob: ``SYNAPSEML_COMPILE_CACHE`` names the cache
+    directory; unset/empty means both layers stay off unless a caller
+    passes an explicit ``compile_cache_dir=``."""
+    path = os.environ.get(_ENV_KNOB, "").strip()
+    return path or None
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Wire JAX's own persistent compilation cache at ``path`` (layer 1).
+
+    Idempotent; returns the directory actually wired, or None when no
+    path is configured. Thresholds are dropped to zero so the serving
+    buckets — many small programs — all persist, not just the slow ones
+    (jax's defaults skip sub-second compiles, which is exactly the shape
+    a warmed bucket ladder has)."""
+    global _PERSISTENT_WIRED
+    path = path or default_cache_dir()
+    if not path:
+        return None
+    with _STATE_LOCK:
+        if _PERSISTENT_WIRED == path:
+            return path
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        for knob, val in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:  # noqa: BLE001 - knob renamed across versions
+                pass
+        _PERSISTENT_WIRED = path
+        return path
+
+
+def env_fingerprint() -> str:
+    """Version skew guard baked into every executable key: a cache dir
+    surviving a jax/jaxlib upgrade or a backend change must MISS (a
+    deserialized executable from another runtime would crash or, worse,
+    silently miscompute)."""
+    import jax
+    import jaxlib
+
+    return "|".join((
+        f"jax={jax.__version__}",
+        f"jaxlib={jaxlib.__version__}",
+        f"backend={jax.default_backend()}",
+    ))
+
+
+def executable_key(cache_key: str, *, bucket: int, sig: Any, layout: str,
+                   mesh_shape: Tuple[int, ...], device_kind: str,
+                   fingerprint: Optional[str] = None) -> str:
+    """Content-addressed key for one compiled signature.
+
+    Anatomy (docs/perf.md "cold start"): ``cache_key`` is the caller's
+    content hash — for ONNXModel the sha256 of the raw model bytes plus
+    the compute-dtype/normalization config, i.e. *graph and weights*;
+    ``sig`` is the staged input signature (shapes+dtypes, bucket-padded);
+    ``layout``/``mesh_shape``/``device_kind`` pin the device topology;
+    the env fingerprint pins jax+jaxlib+backend versions. Change any
+    ingredient and the key misses — fresh compile, never a stale hit."""
+    blob = json.dumps({
+        "v": _FORMAT_VERSION,
+        "cache_key": cache_key,
+        "bucket": bucket,
+        "sig": repr(sig),
+        "layout": layout,
+        "mesh_shape": list(mesh_shape),
+        "device_kind": device_kind,
+        "env": fingerprint if fingerprint is not None else env_fingerprint(),
+    }, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def content_hash(*parts: Any) -> str:
+    """Stable sha256 over heterogeneous key parts (bytes hashed raw, the
+    rest by repr) — the helper model wrappers use to build ``cache_key``
+    from payload bytes + config."""
+    h = hashlib.sha256()
+    for p in parts:
+        if isinstance(p, (bytes, bytearray)):
+            h.update(b"b:")
+            h.update(p)
+        else:
+            h.update(repr(p).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+class ExecutableStore:
+    """Directory of serialized XLA executables, one file per key.
+
+    ``save``/``load`` never raise for cache problems: a failed save is
+    dropped (compilation already happened — nothing is lost), a failed
+    load (missing file, truncation, version skew, pickle drift) returns
+    None so the caller compiles fresh. ``load`` memoizes per key so a
+    process that warms the same signature twice deserializes once;
+    :meth:`invalidate` drops the memo (JitCache.clear() calls it through
+    :func:`invalidate_open_stores` so cleared tests re-read disk)."""
+
+    def __init__(self, directory: str):
+        self.directory = str(directory)
+        self._memo: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self.closed = False
+        _OPEN_STORES.add(self)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory, f"{key}.xc")
+
+    def save(self, key: str, compiled: Any) -> bool:
+        if self.closed:
+            return False
+        try:
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            buf = io.BytesIO()
+            buf.write(_MAGIC)
+            meta = json.dumps({"v": _FORMAT_VERSION,
+                               "env": env_fingerprint()}).encode()
+            buf.write(len(meta).to_bytes(4, "big"))
+            buf.write(meta)
+            pickle.dump((payload, in_tree, out_tree), buf,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+            os.makedirs(self.directory, exist_ok=True)
+            # atomic publish: a concurrent reader (another replica on the
+            # same cache volume) sees either the full entry or nothing —
+            # never a truncated file
+            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(buf.getvalue())
+                os.replace(tmp, self._path(key))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            return True
+        except Exception:  # noqa: BLE001 - cache write is best-effort
+            return False
+
+    def load(self, key: str) -> Optional[Any]:
+        if self.closed:
+            return None
+        with self._lock:
+            if key in self._memo:
+                return self._memo[key]
+        try:
+            with open(self._path(key), "rb") as fh:
+                raw = fh.read()
+            if not raw.startswith(_MAGIC):
+                return None
+            off = len(_MAGIC)
+            mlen = int.from_bytes(raw[off:off + 4], "big")
+            off += 4
+            meta = json.loads(raw[off:off + mlen].decode())
+            off += mlen
+            if meta.get("v") != _FORMAT_VERSION:
+                return None
+            if meta.get("env") != env_fingerprint():
+                # version/backend skew: the executable was built by a
+                # different runtime — unusable, compile fresh
+                return None
+            from jax.experimental import serialize_executable as _se
+
+            payload, in_tree, out_tree = pickle.loads(raw[off:])
+            compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception:  # noqa: BLE001 - any corruption = miss
+            return None
+        with self._lock:
+            self._memo[key] = compiled
+        return compiled
+
+    def invalidate(self):
+        """Drop memoized executables so the next load re-reads disk."""
+        with self._lock:
+            self._memo.clear()
+
+    def close(self):
+        """Invalidate and refuse further traffic (JitCache.clear() path:
+        a cleared cache must not resurrect stale executables)."""
+        self.invalidate()
+        self.closed = True
+
+
+def invalidate_open_stores(close: bool = False) -> int:
+    """Invalidate (or close) every live :class:`ExecutableStore`.
+
+    ``JitCache.clear()`` calls this so tests that clear jit caches cannot
+    read back memoized, possibly-stale executables afterward. Returns the
+    number of stores touched."""
+    stores = list(_OPEN_STORES)
+    for st in stores:
+        if close:
+            st.close()
+        else:
+            st.invalidate()
+    return len(stores)
+
+
+class WarmupReport:
+    """Outcome of one :meth:`BatchedExecutor.warmup` sweep.
+
+    ``entries`` lists one dict per (bucket, layout, device) signature with
+    its disposition: ``"loaded"`` (deserialized from the store — no XLA
+    compile), ``"compiled"`` (fresh compile, persisted when a store is
+    configured), or ``"error"`` (that signature fell back to lazy jit;
+    the error rides in ``errors``). Warmup itself never raises for cache
+    or compile problems — a failed signature just compiles on first use,
+    today's behavior."""
+
+    def __init__(self):
+        self.entries: List[Dict[str, Any]] = []
+        self.errors: List[str] = []
+
+    @property
+    def compiled(self) -> int:
+        return sum(1 for e in self.entries if e["status"] == "compiled")
+
+    @property
+    def loaded(self) -> int:
+        return sum(1 for e in self.entries if e["status"] == "loaded")
+
+    def __repr__(self):
+        return (f"WarmupReport(signatures={len(self.entries)}, "
+                f"compiled={self.compiled}, loaded={self.loaded}, "
+                f"errors={len(self.errors)})")

@@ -1,0 +1,427 @@
+"""Persistent compile cache + AOT warmup tests (runtime/compile_cache.py).
+
+Runs under the conftest-forced 8-device virtual CPU platform (the same
+stand-in tests/test_executor_multidevice.py uses). Guarantees pinned:
+
+- warmup() precompiles every (bucket, arity, donation-mask, layout)
+  signature and _dispatch serves from the AOT table (no lazy jit);
+- serialized executables round-trip across executor instances (the
+  restarted-replica path) with bit-identical outputs;
+- cache-KEY invalidation: changed model bytes, changed device count /
+  mesh shape, and a changed jax version string all MISS — fresh compile,
+  identical outputs, never a stale hit;
+- cache-ENTRY corruption (truncated file) degrades to a fresh compile,
+  never an error;
+- JitCache.clear() invalidates open store handles so cleared tests
+  cannot read back memoized stale executables;
+- the serving readiness gate holds /health at 503 until warmup is done.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from synapseml_tpu.runtime import compile_cache as cc
+from synapseml_tpu.runtime.executor import (GLOBAL_JIT_CACHE,
+                                            BatchedExecutor)
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs the 8-device virtual platform")
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_cache_config():
+    """enable_persistent_cache wires PROCESS-GLOBAL jax config at tmp
+    paths pytest deletes afterward — restore it so the rest of the suite
+    never writes XLA cache entries into a dead directory."""
+    prev = jax.config.jax_compilation_cache_dir
+    prev_wired = cc._PERSISTENT_WIRED
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+    cc._PERSISTENT_WIRED = prev_wired
+
+
+def _mlp_fn():
+    w = np.random.default_rng(0).standard_normal((6, 4)).astype(np.float32)
+    return (lambda p, x: (jnp.tanh(x @ p), x * 2.0 + 1.0)), w
+
+
+def _x(n=20, seed=1):
+    return np.random.default_rng(seed).standard_normal(
+        (n, 6)).astype(np.float32)
+
+
+def _assert_same(got, want):
+    assert len(got) == len(want)
+    for g, s in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(s))
+
+
+# -- warmup mechanics ----------------------------------------------------
+
+def test_warmup_precompiles_full_ladder_and_dispatch_uses_aot():
+    fn, w = _mlp_fn()
+    ex = BatchedExecutor(fn, bound_args=(w,), max_bucket=32)
+    rep = ex.warmup([((6,), np.float32)])
+    assert [e["bucket"] for e in rep.entries] == [8, 16, 32]
+    assert rep.compiled == 3 and rep.loaded == 0 and not rep.errors
+    ref = BatchedExecutor(fn, bound_args=(w,), max_bucket=32)
+    for n in (1, 9, 20, 32):
+        _assert_same(ex(_x(n, seed=n)), ref(_x(n, seed=n)))
+    # every call above hit a warmed executable, no lazy jit compile
+    assert ex._aot_hits == 4
+    # a second warmup is a no-op ("warm"), not a recompile
+    rep2 = ex.warmup([((6,), np.float32)])
+    assert all(e["status"] == "warm" for e in rep2.entries)
+
+
+def test_warmup_from_example_arrays_matches_staged_signature():
+    """Example arrays with a batch dim (and a dtype staging coerces,
+    f64->f32) must produce the signature the pipeline dispatches."""
+    fn, w = _mlp_fn()
+    ex = BatchedExecutor(fn, bound_args=(w,), max_bucket=16)
+    rep = ex.warmup([np.zeros((5, 6), np.float64)])
+    assert rep.compiled == len(rep.entries) > 0
+    ex(np.zeros((5, 6), np.float64))
+    assert ex._aot_hits == 1
+
+
+def test_warmup_unbounded_executor_requires_buckets():
+    fn, w = _mlp_fn()
+    ex = BatchedExecutor(fn, bound_args=(w,))
+    with pytest.raises(ValueError):
+        ex.warmup([((6,), np.float32)])
+    rep = ex.warmup([((6,), np.float32)], buckets=[8])
+    assert rep.compiled == 1
+
+
+def test_warmup_error_degrades_to_lazy_jit():
+    """A signature that fails to AOT-compile must be reported, not
+    raised — and the executor still serves it through the lazy path."""
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        return (x * 2.0,)
+
+    ex = BatchedExecutor(flaky, max_bucket=8)
+    orig = ex._jit_for
+
+    def broken_jit_for(*a, **k):
+        raise RuntimeError("synthetic AOT failure")
+
+    ex._jit_for = broken_jit_for
+    rep = ex.warmup([((3,), np.float32)])
+    assert rep.entries[0]["status"] == "error" and rep.errors
+    ex._jit_for = orig
+    (out,) = ex(np.ones((4, 3), np.float32))
+    np.testing.assert_array_equal(out, np.full((4, 3), 2.0, np.float32))
+
+
+# -- persistence (the restarted-replica path) ---------------------------
+
+def test_persist_roundtrip_new_executor_loads_and_matches(tmp_path):
+    fn, w = _mlp_fn()
+    kw = dict(bound_args=(w,), max_bucket=32, cache_key="model-v1",
+              cache_dir=str(tmp_path))
+    exA = BatchedExecutor(fn, **kw)
+    repA = exA.warmup([((6,), np.float32)])
+    assert repA.compiled == 3
+    assert all(e.get("persisted") for e in repA.entries)
+    wantA = exA(_x())
+
+    exB = BatchedExecutor(fn, **kw)  # "restarted process"
+    repB = exB.warmup([((6,), np.float32)])
+    assert repB.loaded == 3 and repB.compiled == 0
+    _assert_same(exB(_x()), wantA)
+    assert exB._aot_hits == 1
+
+
+def test_invalidation_changed_model_bytes(tmp_path):
+    """A different cache_key (= changed graph/weights content hash) must
+    MISS and fresh-compile — with outputs matching ITS OWN model."""
+    fn, w = _mlp_fn()
+    exA = BatchedExecutor(fn, bound_args=(w,), max_bucket=8,
+                          cache_key="model-v1", cache_dir=str(tmp_path))
+    assert exA.warmup([((6,), np.float32)]).compiled == 1
+
+    w2 = w * 3.0
+
+    exB = BatchedExecutor(fn, bound_args=(w2,), max_bucket=8,
+                          cache_key="model-v2", cache_dir=str(tmp_path))
+    repB = exB.warmup([((6,), np.float32)])
+    assert repB.loaded == 0 and repB.compiled == 1
+    ref = BatchedExecutor(fn, bound_args=(w2,), max_bucket=8)
+    _assert_same(exB(_x()), ref(_x()))
+
+
+@needs8
+def test_invalidation_changed_device_count_and_mesh(tmp_path):
+    """The same cache_key on a different topology (4-chip vs 8-chip mesh,
+    and multi- vs single-device) must miss: mesh shape is part of the
+    executable key. Outputs stay bit-identical to single-device."""
+    fn, w = _mlp_fn()
+    ref = BatchedExecutor(fn, bound_args=(w,), max_bucket=32)
+    ex8 = BatchedExecutor(fn, devices=8, bound_args=(w,), max_bucket=32,
+                          cache_key="m", cache_dir=str(tmp_path))
+    rep8 = ex8.warmup([((6,), np.float32)])
+    assert rep8.compiled == len(rep8.entries) > 0
+
+    ex4 = BatchedExecutor(fn, devices=4, bound_args=(w,), max_bucket=32,
+                          cache_key="m", cache_dir=str(tmp_path))
+    rep4 = ex4.warmup([((6,), np.float32)])
+    assert rep4.loaded == 0 and rep4.compiled == len(rep4.entries)
+
+    ex1 = BatchedExecutor(fn, bound_args=(w,), max_bucket=32,
+                          cache_key="m", cache_dir=str(tmp_path))
+    rep1 = ex1.warmup([((6,), np.float32)])
+    assert rep1.loaded == 0 and rep1.compiled == len(rep1.entries)
+
+    for ex in (ex8, ex4, ex1):
+        _assert_same(ex(_x(37)), ref(_x(37)))
+
+
+def test_invalidation_changed_jax_version_string(tmp_path, monkeypatch):
+    """An entry written by a different jax/jaxlib/backend fingerprint
+    must be rejected at LOAD time (not just keyed apart): a cache volume
+    surviving an image upgrade deserializing a stale executable would be
+    undefined behavior."""
+    fn, w = _mlp_fn()
+    kw = dict(bound_args=(w,), max_bucket=8, cache_key="m",
+              cache_dir=str(tmp_path))
+    exA = BatchedExecutor(fn, **kw)
+    assert exA.warmup([((6,), np.float32)]).compiled == 1
+
+    real = cc.env_fingerprint()
+    monkeypatch.setattr(cc, "env_fingerprint",
+                        lambda: real + "|jax=99.99.99")
+    exB = BatchedExecutor(fn, **kw)
+    repB = exB.warmup([((6,), np.float32)])
+    # key differs -> miss -> fresh compile; and even a key COLLISION
+    # would be caught by the header check (exercised below)
+    assert repB.loaded == 0 and repB.compiled == 1
+    # header check: same key, skewed env at load time only
+    store = exA._store
+    skey = cc.executable_key("m", bucket=8,
+                             sig=((("8", "x"),),), layout="single",
+                             mesh_shape=(1,), device_kind="cpu",
+                             fingerprint=real)
+    monkeypatch.setattr(cc, "env_fingerprint", lambda: real)
+    assert store.load(skey) is None  # missing entry: still just a miss
+    ref = BatchedExecutor(fn, bound_args=(w,), max_bucket=8)
+    _assert_same(exB(_x()), ref(_x()))
+
+
+def test_corrupt_cache_entry_falls_back_to_fresh_compile(tmp_path):
+    fn, w = _mlp_fn()
+    kw = dict(bound_args=(w,), max_bucket=8, cache_key="m",
+              cache_dir=str(tmp_path))
+    exA = BatchedExecutor(fn, **kw)
+    assert exA.warmup([((6,), np.float32)]).compiled == 1
+    exdir = os.path.join(str(tmp_path), "executables")
+    entries = [f for f in os.listdir(exdir) if f.endswith(".xc")]
+    assert len(entries) == 1
+    path = os.path.join(exdir, entries[0])
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    # deliberate truncation mid-payload
+    with open(path, "wb") as fh:
+        fh.write(raw[:len(raw) // 2])
+
+    exB = BatchedExecutor(fn, **kw)
+    repB = exB.warmup([((6,), np.float32)])
+    assert repB.loaded == 0 and repB.compiled == 1 and not repB.errors
+    ref = BatchedExecutor(fn, bound_args=(w,), max_bucket=8)
+    _assert_same(exB(_x()), ref(_x()))
+    # garbage that isn't even our container format: also just a miss
+    with open(path, "wb") as fh:
+        fh.write(b"\x00not an executable\xff" * 10)
+    exC = BatchedExecutor(fn, **kw)
+    assert exC.warmup([((6,), np.float32)]).compiled == 1
+
+
+def test_jitcache_clear_invalidates_store_memos(tmp_path):
+    """After GLOBAL_JIT_CACHE.clear(), a store must re-read DISK: an
+    entry rewritten after the clear is observed (the memoized stale
+    executable would otherwise win)."""
+    fn, w = _mlp_fn()
+    kw = dict(bound_args=(w,), max_bucket=8, cache_key="m",
+              cache_dir=str(tmp_path))
+    exA = BatchedExecutor(fn, **kw)
+    exA.warmup([((6,), np.float32)])
+    store = exA._store
+    exdir = os.path.join(str(tmp_path), "executables")
+    key = os.listdir(exdir)[0][:-len(".xc")]
+    assert store.load(key) is not None
+    assert store._memo  # memoized
+    GLOBAL_JIT_CACHE.clear()
+    assert not store._memo
+    os.unlink(os.path.join(exdir, key + ".xc"))
+    assert store.load(key) is None  # deleted entry actually observed
+
+
+def test_env_knob_default_cache_dir(monkeypatch, tmp_path):
+    monkeypatch.delenv("SYNAPSEML_COMPILE_CACHE", raising=False)
+    assert cc.default_cache_dir() is None
+    monkeypatch.setenv("SYNAPSEML_COMPILE_CACHE", str(tmp_path))
+    assert cc.default_cache_dir() == str(tmp_path)
+    fn, w = _mlp_fn()
+    ex = BatchedExecutor(fn, bound_args=(w,), max_bucket=8, cache_key="m")
+    rep = ex.warmup([((6,), np.float32)])
+    assert all(e.get("persisted") for e in rep.entries)
+    assert os.listdir(os.path.join(str(tmp_path), "executables"))
+
+
+# -- donation-mask fallback (the residual-warning satellite) ------------
+
+def test_donate_mask_eval_shape_failure_donates_nothing(monkeypatch):
+    """When eval_shape cannot verify aliasability the mask must donate
+    NOTHING (the old donate-all fallback produced the per-compile 'Some
+    donated buffers were not usable' warnings in the bench tails)."""
+    ex = BatchedExecutor(lambda x: (x * 2.0,), donate=True)
+
+    def boom(*a, **k):
+        raise RuntimeError("platform plugin tantrum")
+
+    monkeypatch.setattr(jax, "eval_shape", boom)
+    assert ex._donate_mask_for([np.zeros((8, 6), np.float32)]) == (False,)
+
+
+def test_submit_precomputes_donate_mask_on_caller_thread():
+    """submit() resolves the donate mask eagerly (caller's thread), so
+    the dispatch thread only reads the cache."""
+    ex = BatchedExecutor(lambda x: (x * 2.0,), donate=True, min_bucket=8)
+    x = np.zeros((5, 6), np.float32)
+    sig = ex._staged_sig([x], 8)
+    assert sig == (((8, 6), "float32"),)
+    ex(x)
+    assert ex._donate_masks.get(sig) == (True,)
+
+
+# -- model-layer wiring -------------------------------------------------
+
+def test_onnxmodel_warmup_persist_and_restart(tmp_path):
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.onnx import ONNXModel, zoo
+
+    blob = zoo.mlp([16, 32], num_classes=4, seed=0)
+    feats = np.random.default_rng(0).standard_normal(
+        (20, 16)).astype(np.float32)
+
+    mA = ONNXModel(model_bytes=blob)
+    mA.set(compile_cache_dir=str(tmp_path), mini_batch_size=32)
+    repA = mA.warmup()
+    assert repA.compiled == len(repA.entries) == 3
+    outA = mA.transform(Table({"input": feats}))
+
+    mB = ONNXModel(model_bytes=blob)
+    mB.set(compile_cache_dir=str(tmp_path), mini_batch_size=32)
+    repB = mB.warmup()
+    assert repB.loaded == 3 and repB.compiled == 0
+    outB = mB.transform(Table({"input": feats}))
+    col = mA.graph.output_names[0]
+    np.testing.assert_array_equal(np.asarray(outA[col]),
+                                  np.asarray(outB[col]))
+    # changed model bytes -> different content hash -> cold again
+    mC = ONNXModel(model_bytes=zoo.mlp([16, 32], num_classes=4, seed=7))
+    mC.set(compile_cache_dir=str(tmp_path), mini_batch_size=32)
+    repC = mC.warmup()
+    assert repC.loaded == 0 and repC.compiled == 3
+
+
+def test_onnxmodel_warmup_example_feeds_override_dtype(tmp_path):
+    """The uint8-pixel wire (input_norm) serves a different staged dtype
+    than the graph declares — example_feeds pins the real signature."""
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.onnx import ONNXModel, zoo
+
+    m = ONNXModel(model_bytes=zoo.mlp([16, 32], num_classes=4, seed=0))
+    m.set(mini_batch_size=8,
+          input_norm={"input": {"mean": 127.5, "scale": 1 / 58.0}})
+    rep = m.warmup(example_feeds={
+        "input": np.zeros((1, 16), np.uint8)})
+    assert rep.compiled == 1 and not rep.errors
+    ex = m._executor()
+    m.transform(Table({"input": np.zeros((5, 16), np.uint8)}))
+    assert ex._aot_hits == 1
+
+
+def test_image_featurizer_warmup(tmp_path):
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.image.featurizer import ImageFeaturizer
+    from synapseml_tpu.onnx import zoo
+
+    kw = dict(model_bytes=zoo.tiny_resnet(image_size=32),
+              cut_output_layers=1, image_size=32, mini_batch_size=8,
+              input_col="image", output_col="feats",
+              compile_cache_dir=str(tmp_path))
+    fA = ImageFeaturizer(**kw)
+    repA = fA.warmup()
+    assert repA.compiled == len(repA.entries) == 1
+    imgs = np.empty(3, dtype=object)
+    imgs[:] = [np.random.default_rng(i).integers(
+        0, 255, (32, 32, 3)).astype(np.float32) for i in range(3)]
+    outA = fA.transform(Table({"image": imgs}))
+    assert fA._pieces()._aot_hits == 1
+
+    fB = ImageFeaturizer(**kw)
+    repB = fB.warmup()
+    assert repB.loaded == 1 and repB.compiled == 0
+    outB = fB.transform(Table({"image": imgs}))
+    np.testing.assert_array_equal(np.stack(list(outA["feats"])),
+                                  np.stack(list(outB["feats"])))
+
+
+@needs8
+def test_multidevice_warmup_restart_bit_identical(tmp_path):
+    """The dp-sharded layout round-trips through the store too: a
+    restarted 8-chip replica loads the mesh executables and reproduces
+    the single-device outputs exactly."""
+    fn, w = _mlp_fn()
+    kw = dict(devices="all", bound_args=(w,), max_bucket=32,
+              cache_key="mesh-model", cache_dir=str(tmp_path))
+    exA = BatchedExecutor(fn, **kw)
+    repA = exA.warmup([((6,), np.float32)])
+    assert repA.compiled == len(repA.entries)
+    exB = BatchedExecutor(fn, **kw)
+    repB = exB.warmup([((6,), np.float32)])
+    assert repB.loaded == len(repB.entries) and repB.compiled == 0
+    single = BatchedExecutor(fn, bound_args=(w,), max_bucket=32)
+    for n in (1, 8, 37):
+        _assert_same(exB(_x(n, seed=n)), single(_x(n, seed=n)))
+
+
+# -- serving readiness gate ---------------------------------------------
+
+def test_serving_readiness_gate_health_503_until_ready():
+    import urllib.error
+    import urllib.request
+
+    from synapseml_tpu.io.serving import ContinuousServer, make_reply
+
+    def pipe(t):
+        r = np.empty(t.num_rows, dtype=object)
+        for i, v in enumerate(t["value"]):
+            r[i] = make_reply(v)
+        return t.with_column("reply", r)
+
+    cs = ContinuousServer("readiness_gate_test", pipe, ready=False)
+    try:
+        health = cs.url.rstrip("/") + "/health"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(health, timeout=5)
+        assert ei.value.code == 503
+        assert not cs.server.ready
+        cs.server.set_ready(True)
+        with urllib.request.urlopen(health, timeout=5) as r:
+            assert r.status == 200 and r.read() == b"ok"
+        cs.start()
+        req = urllib.request.Request(
+            cs.url, b'{"a": 1}', {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.read() == b'{"a": 1}'
+    finally:
+        cs.stop()

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Hard-timeout smoke for the persistent compile cache's warm-restart
+# guarantee (runtime/compile_cache.py).
+#
+# Process A scores against an EMPTY cache dir (compiles + persists every
+# bucket executable); process B — a genuine OS-level restart, no shared
+# interpreter state — points at the same dir and must (1) LOAD every
+# executable instead of compiling (asserted from the WarmupReport),
+# (2) record its first-batch time-to-result via the bench.first_batch_ms
+# metric hook, and (3) produce BIT-IDENTICAL outputs to A. Any cache
+# miss, skew, or corruption would surface as a recompile (assertion) —
+# and a wedged deserialization would HANG, which the timeout turns into
+# a fast exit-124.
+#
+# Usage: tools/ci/smoke_warm_restart.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"  # bench.py lives at the root
+timeout -k 10 "${SMOKE_TIMEOUT:-300}" \
+  python tools/ci/warm_restart_check.py A "$TMP/cache" "$TMP/state.npz"
+timeout -k 10 "${SMOKE_TIMEOUT:-300}" \
+  python tools/ci/warm_restart_check.py B "$TMP/cache" "$TMP/state.npz"
+echo "warm-restart smoke ok"

@@ -1,0 +1,83 @@
+"""Cross-process warm-restart check (driven by smoke_warm_restart.sh).
+
+Two phases against ONE shared compile-cache directory:
+
+- ``A`` (the first replica): fresh model + empty cache. Asserts every
+  bucket signature was freshly COMPILED and PERSISTED, scores a batch,
+  and saves the outputs + timing to the state file.
+- ``B`` (the restarted replica — a brand new process): same model bytes,
+  same cache dir. Asserts every signature was LOADED from the store
+  (zero fresh XLA compiles — the whole point of the cache), that the
+  first-batch time-to-result measured via the ``bench.first_batch_ms``
+  metric hook is finite and recorded, and that the scored outputs are
+  BIT-IDENTICAL to process A's.
+
+Usage: warm_restart_check.py {A|B} <cache_dir> <state_file.npz>
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def build_model(cache_dir):
+    from synapseml_tpu.onnx import ONNXModel, zoo
+
+    model = ONNXModel(model_bytes=zoo.mlp([16, 32], num_classes=4, seed=0))
+    model.set(compile_cache_dir=cache_dir, mini_batch_size=32)
+    return model
+
+
+def main():
+    phase, cache_dir, state_file = sys.argv[1], sys.argv[2], sys.argv[3]
+    import bench
+    from synapseml_tpu.data.table import Table
+
+    model = build_model(cache_dir)
+    # two batch sizes -> two buckets (8 and 32), so the check covers a
+    # real ladder, not one lucky signature
+    rng = np.random.default_rng(0)
+    big = rng.standard_normal((20, 16)).astype(np.float32)
+    small = rng.standard_normal((3, 16)).astype(np.float32)
+
+    ms, report, out_big = bench.first_batch_ms(
+        model, Table({"input": big}), buckets=[8, 32])
+    out_small = model.transform(Table({"input": small}))
+    col = model.graph.output_names[0]
+    big_col = np.asarray(out_big[col])
+    small_col = np.asarray(out_small[col])
+    print(f"[{phase}] first_batch_ms={ms:.1f} {report!r}", flush=True)
+
+    assert not report.errors, report.errors
+    if phase == "A":
+        assert report.compiled == len(report.entries), \
+            f"cold process did not compile everything: {report!r}"
+        persisted = sum(1 for e in report.entries if e.get("persisted"))
+        assert persisted == len(report.entries), \
+            f"cold process persisted {persisted}/{len(report.entries)}"
+        np.savez(state_file, big=big_col, small=small_col,
+                 first_batch_ms=ms)
+        return 0
+
+    assert phase == "B", phase
+    # THE invariant: a restarted replica deserializes, never recompiles
+    assert report.loaded == len(report.entries), \
+        f"warm restart recompiled: {report!r} {report.entries}"
+    assert ms > 0.0, ms  # the metric hook measured the restart
+    prev = np.load(state_file)
+    assert np.array_equal(big_col, prev["big"]), \
+        "outputs diverged across restart (bucket 32)"
+    assert np.array_equal(small_col, prev["small"]), \
+        "outputs diverged across restart (bucket 8)"
+    print(json.dumps({
+        "metric": "serving_cold_start_first_batch_ms",
+        "cold_ms": round(float(prev["first_batch_ms"]), 1),
+        "warm_restart_ms": round(ms, 1),
+        "executables_loaded": report.loaded,
+        "outputs_bit_identical": True,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
