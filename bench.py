@@ -15,6 +15,7 @@ Runs on whatever jax.devices() provides (the real TPU chip under the driver).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -567,6 +568,31 @@ def bench_serving_cold_start():
     return warm_ms, cold_ms, warm_rep.loaded, persisted, identical
 
 
+def bench_synlint():
+    """Static-analysis hygiene canary: run synlint (tools/analysis,
+    docs/analysis.md) over the package and record (total findings,
+    analyzer wall time). The committed JSON makes hygiene drift — a new
+    host-sync on the dispatch path, an unguarded shared write — a
+    diffable number per round, same as the donation-warning count.
+    Never sinks the benchmark run: any analyzer failure reports -1."""
+    import time as _time
+
+    try:
+        from tools.analysis.engine import analyze_paths
+
+        # anchor targets to the repo root, not the process cwd — run
+        # from elsewhere, bare names would resolve to nothing and the
+        # metric would read as a spotless 0
+        root = os.path.dirname(os.path.abspath(__file__))
+        t0 = _time.monotonic()
+        findings = analyze_paths(
+            [os.path.join(root, p)
+             for p in ("synapseml_tpu", "tools", "bench.py")], root=root)
+        return len(findings), _time.monotonic() - t0
+    except Exception:  # noqa: BLE001 - the bench must survive lint bugs
+        return -1, -1.0
+
+
 def _with_retries(fn, attempts=3):
     """The tunneled device occasionally drops remote_compile connections;
     a transient failure must not zero out the recorded benchmark."""
@@ -607,6 +633,7 @@ def main():
     donation_warnings = sum(
         1 for w in _rec
         if "donated buffers were not usable" in str(w.message).lower())
+    synlint_total, synlint_s = bench_synlint()
     gpu_img_baseline = 1000.0
     gpu_rows_baseline = 1.0e6
     gpu_tree_rows_baseline = 1.0e6
@@ -740,8 +767,13 @@ def main():
                        "outputs_identical_across_restart": cold_identical},
         }],
         # donation hygiene canary (see _donate_mask_for): nonzero means
-        # some jit site regressed to annotating non-aliasable donations
-        "detail": {"donated_buffers_not_usable_warnings": donation_warnings},
+        # some jit site regressed to annotating non-aliasable donations;
+        # synlint_findings_total counts ALL static-analysis findings
+        # (baselined included — docs/analysis.md) so hygiene drift in
+        # either direction shows up as a diffable number per round
+        "detail": {"donated_buffers_not_usable_warnings": donation_warnings,
+                   "synlint_findings_total": synlint_total,
+                   "synlint_runtime_s": round(synlint_s, 2)},
     }))
 
 

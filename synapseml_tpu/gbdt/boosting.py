@@ -1744,7 +1744,7 @@ def _train_distributed(p, mesh, binned_np, y, weight, k, init, obj_fn, gp,
     Validation margins/metrics accumulate on device exactly like the
     single-chip path (valid set replicated on every rank).
     """
-    from jax import shard_map
+    from synapseml_tpu.parallel.distributed import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     is_rank = p.objective in ("lambdarank", "rank_xendcg")

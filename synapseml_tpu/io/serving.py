@@ -429,12 +429,16 @@ class DistributedServer:
         self.server = HTTPSourceStateHolder.get_or_create_server(
             name, host, port, reply_timeout=reply_timeout)
         # exactly one distributor may own a server's request queue: a
-        # second consumer would silently steal an arbitrary subset
-        if getattr(self.server, "_dist_owner", None) is not None:
-            raise ValueError(
-                f"server {name!r} already has a DistributedServer "
-                f"attached; reuse that instance or pick another name")
-        self.server._dist_owner = self
+        # second consumer would silently steal an arbitrary subset.
+        # check-and-claim happens atomically under the server's lock —
+        # the historical unlocked getattr-then-set let two concurrent
+        # constructors both pass the check and both start distributors
+        with self.server._lock:
+            if getattr(self.server, "_dist_owner", None) is not None:
+                raise ValueError(
+                    f"server {name!r} already has a DistributedServer "
+                    f"attached; reuse that instance or pick another name")
+            self.server._dist_owner = self  # synlint: shared
         self.channels = MultiChannelMap(n_channels)
         self._stop = threading.Event()
         self._distributor = threading.Thread(
@@ -481,7 +485,8 @@ class DistributedServer:
     def stop(self):
         self._stop.set()
         self._distributor.join(timeout=2)
-        self.server._dist_owner = None
+        with self.server._lock:
+            self.server._dist_owner = None
         HTTPSourceStateHolder.remove(self.server.name)
 
 
@@ -636,7 +641,15 @@ class ContinuousServer:
         self._handoff: Optional["queue.Queue"] = None
         self._reply_q: Optional["queue.Queue"] = None
         self._reply_thread: Optional[threading.Thread] = None
-        self.errors: List[str] = []
+        # appended from every scorer thread AND the reply thread; guarded
+        # so concurrent failures can't lose entries (list.append happens
+        # to be GIL-atomic today, but the discipline is the contract)
+        self._err_lock = threading.Lock()
+        self.errors: List[str] = []  # synlint: shared
+
+    def _record_error(self, exc: BaseException):
+        with self._err_lock:
+            self.errors.append(repr(exc))
 
     @property
     def url(self) -> str:
@@ -651,7 +664,7 @@ class ContinuousServer:
                 table = parse_request(table)
             return self.pipeline_fn(table), None
         except Exception as e:  # noqa: BLE001 - serving loop must survive
-            self.errors.append(repr(e))
+            self._record_error(e)
             return None, e
 
     def _reply_scored(self, batch: List[CachedRequest], out, err):
@@ -667,7 +680,7 @@ class ContinuousServer:
                     send_replies(self.server, out, self.reply_col)
                     return
                 except Exception as e:  # noqa: BLE001 - bad reply col etc.
-                    self.errors.append(repr(e))
+                    self._record_error(e)
                     err = e
             for cr in batch:
                 self.server.reply_to(cr.rid, HTTPResponseData(

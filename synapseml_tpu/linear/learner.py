@@ -177,7 +177,7 @@ def train(p: VWParams, idx: np.ndarray, val: np.ndarray, y: np.ndarray,
         bs = max(bs // ndev * ndev, ndev)  # divisible global batch
 
         def sharded_step(state, bidx, bval, by, bw):
-            from jax.experimental.shard_map import shard_map
+            from synapseml_tpu.parallel.distributed import shard_map
             fn = shard_map(
                 lambda s, i2, v2, y2, w2: train_step(s, i2, v2, y2, w2, p, axis),
                 mesh=mesh,

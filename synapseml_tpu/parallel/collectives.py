@@ -33,19 +33,25 @@ def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
 
 
+def axis_size(axis: str):
+    """Concrete size of a mapped axis. ``lax.axis_size`` only exists on
+    newer jax; ``psum(1, axis)`` constant-folds to the same python int on
+    every version (the pre-axis_size idiom), so use it as the fallback."""
+    got = getattr(lax, "axis_size", None)
+    if got is not None:
+        return got(axis)
+    return lax.psum(1, axis)
+
+
 def ring_permute(x, axis: str, shift: int = 1):
     """Send this shard to the next rank on ``axis`` (a ring step)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
 
 def axis_index(axis: str):
     return lax.axis_index(axis)
-
-
-def axis_size(axis: str):
-    return lax.axis_size(axis)
 
 
 def barrier_sum(axis: AxisName):
@@ -94,7 +100,7 @@ def ring_all_reduce(x, axis: str, chunk_axis: int = 0):
     reference when validating psum performance. Requires
     ``x.shape[chunk_axis] % n == 0``.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     if n == 1:
         return x
     me = lax.axis_index(axis)

@@ -33,6 +33,39 @@ import jax
 
 from synapseml_tpu.utils.fault import retry_with_backoff
 
+# -- shard_map compat shim --------------------------------------------------
+# The pinned jax (0.4.37) ships shard_map at jax.experimental.shard_map
+# with a ``check_rep=`` kwarg; newer jax promotes it to ``jax.shard_map``
+# and renames the kwarg ``check_vma=``. Every module (and test) imports
+# the symbol from HERE so the package runs on either side of the move —
+# `from jax import shard_map` at module scope is what broke the
+# distributed test collection on the pinned jax.
+try:  # pinned jax: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+except ImportError:  # post-0.4.37: promoted into the jax namespace
+    from jax import shard_map as _shard_map_impl  # type: ignore
+
+try:
+    import inspect as _inspect
+
+    _SHARD_MAP_KWARGS = frozenset(
+        _inspect.signature(_shard_map_impl).parameters)
+except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+    _SHARD_MAP_KWARGS = frozenset()
+
+
+def shard_map(f, *args, **kwargs):
+    """``shard_map`` resolved against the installed jax, with the
+    ``check_vma``/``check_rep`` rename translated in whichever direction
+    the implementation needs — callers write either spelling."""
+    for ours, theirs in (("check_vma", "check_rep"),
+                         ("check_rep", "check_vma")):
+        if ours in kwargs and ours not in _SHARD_MAP_KWARGS \
+                and theirs in _SHARD_MAP_KWARGS:
+            kwargs[theirs] = kwargs.pop(ours)
+    return _shard_map_impl(f, *args, **kwargs)
+
+
 _COORD_PORT_DEFAULT = 12421  # near the reference's DefaultLocalListenPort
 _state = {"initialized": False}
 

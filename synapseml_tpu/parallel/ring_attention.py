@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from synapseml_tpu.parallel.collectives import axis_size
+from synapseml_tpu.parallel.distributed import shard_map
 
 NEG_INF = -1e30
 
@@ -60,7 +61,7 @@ def _ring_attention_local(q, k, v, *, axis: str, causal: bool, scale: float):
     """Body run per-device inside shard_map. q,k,v are local blocks."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     rank = lax.axis_index(axis)
 
     q = (q * scale).astype(q.dtype)

@@ -428,10 +428,11 @@ class BatchedExecutor:
                 jax.tree_util.tree_map(
                     lambda a: jax.device_put(a, device) if device
                     else jnp.asarray(a), b) for b in bound_args)
-        # round-robin fallback state: per-device bound-arg replicas (lazy)
-        # and the next-device cursor (dispatch-thread-serial)
-        self._bound_rr: Dict[int, tuple] = {}
-        self._rr_next = 0
+        # round-robin fallback state: per-device bound-arg replicas (lazy,
+        # also touched by warmup on the caller's thread) and the
+        # next-device cursor — both under _tables_lock (set just below)
+        self._bound_rr: Dict[int, tuple] = {}  # synlint: shared
+        self._rr_next = 0  # synlint: shared
         plat = (device.platform if device is not None
                 else devices[0].platform if devices is not None
                 else jax.default_backend())
@@ -449,8 +450,17 @@ class BatchedExecutor:
         # (arity, donate-mask); jax itself caches executables per input
         # sharding/placement under each callable, which keeps per-bucket
         # compiles separate per layout (single / dp-sharded / per-device)
-        self._jits: Dict[Tuple[int, Tuple[bool, ...]], Callable] = {}
-        self._donate_masks: Dict[tuple, Tuple[bool, ...]] = {}
+        #
+        # _tables_lock guards every compiled-artifact table below: they
+        # are written from caller threads (submit's eager mask prewarm,
+        # warmup) AND from the dispatch thread, and an unguarded
+        # check-then-set loses one thread's jit wrapper — with its
+        # per-executable cache — to the other's overwrite. Slow work
+        # (eval_shape, device_put, .lower().compile()) always happens
+        # OUTSIDE the lock; only the dict get/setdefault is guarded.
+        self._tables_lock = threading.Lock()
+        self._jits: Dict[Tuple[int, Tuple[bool, ...]], Callable] = {}  # synlint: shared
+        self._donate_masks: Dict[tuple, Tuple[bool, ...]] = {}  # synlint: shared
         self._pipeline: Optional[_PipelineState] = None
         self._pipeline_init_lock = threading.Lock()
         self._finalizer = None
@@ -466,9 +476,11 @@ class BatchedExecutor:
                     os.path.join(resolved_dir, "executables"))
         # AOT-compiled executables from warmup(), keyed by
         # (input sig, donate mask, layout, rr device index) — consulted
-        # by _dispatch before the lazy jit path
-        self._aot: Dict[tuple, Any] = {}
-        self._aot_hits = 0
+        # by _dispatch before the lazy jit path; written by warmup
+        # (caller thread) and retired by _dispatch (dispatch thread),
+        # so access rides _tables_lock too
+        self._aot: Dict[tuple, Any] = {}  # synlint: shared
+        self._aot_hits = 0  # synlint: shared
 
     @property
     def pipeline_depth(self) -> int:
@@ -484,12 +496,18 @@ class BatchedExecutor:
 
     def _jit_for(self, n_args: int,
                  mask: Tuple[bool, ...] = ()) -> Callable:
-        got = self._jits.get((n_args, mask))
-        if got is None:
-            donate = tuple(len(self._bound) + i
-                           for i, m in enumerate(mask) if m)
-            got = jax.jit(self._fn, donate_argnums=donate)
-            self._jits[(n_args, mask)] = got
+        # wrapper construction is cheap (no trace/compile), so it can sit
+        # inside the lock — an unguarded check-then-set here let warmup
+        # (caller thread) and _dispatch (dispatch thread) each build a
+        # wrapper and one overwrite the other, orphaning every executable
+        # jax had cached under the loser
+        with self._tables_lock:
+            got = self._jits.get((n_args, mask))
+            if got is None:
+                donate = tuple(len(self._bound) + i
+                               for i, m in enumerate(mask) if m)
+                got = jax.jit(self._fn, donate_argnums=donate)
+                self._jits[(n_args, mask)] = got
         return got
 
     def _donate_mask_for(self, padded: Sequence[Any]) -> Tuple[bool, ...]:
@@ -514,7 +532,8 @@ class BatchedExecutor:
         chance to poison the mask."""
         if not self._donate or not sig:
             return (False,) * len(sig)
-        got = self._donate_masks.get(sig)
+        with self._tables_lock:
+            got = self._donate_masks.get(sig)
         if got is None:
             try:
                 specs = [jax.ShapeDtypeStruct(s, jnp.dtype(d))
@@ -539,7 +558,11 @@ class BatchedExecutor:
                 # warning spam in the bench tails — donation is an
                 # optimization, silence + correctness beat a blind bet
                 got = (False,) * len(sig)
-            self._donate_masks[sig] = got
+            # eval_shape ran OUTSIDE the lock (it traces self._fn);
+            # setdefault keeps concurrent computers consistent — every
+            # thread returns the first writer's mask
+            with self._tables_lock:
+                got = self._donate_masks.setdefault(sig, got)
         return got
 
     def _staged_dtype(self, dt: Any, device_rules: bool = False):
@@ -619,12 +642,17 @@ class BatchedExecutor:
         extracted from the mesh-replicated copies (each chip already holds
         a shard-local replica; device_put pins a committed single-device
         view for the per-device jit)."""
-        got = self._bound_rr.get(dev.id)
+        with self._tables_lock:
+            got = self._bound_rr.get(dev.id)
         if got is None:
+            # the H2D replica transfer stays outside the lock; a racing
+            # warmup/dispatch pair may both transfer, setdefault picks
+            # one winner so every caller shares the same device buffers
             got = tuple(
                 jax.tree_util.tree_map(lambda a: jax.device_put(a, dev), b)
                 for b in self._bound)
-            self._bound_rr[dev.id] = got
+            with self._tables_lock:
+                got = self._bound_rr.setdefault(dev.id, got)
         return got
 
     def _bucket(self, n: int) -> int:
@@ -934,7 +962,9 @@ class BatchedExecutor:
                 aot_key = (sig, mask, layout, rr_idx)
                 entry = {"bucket": bucket, "layout": store_layout,
                          "sig": sig}
-                if aot_key in self._aot:
+                with self._tables_lock:
+                    warm = aot_key in self._aot
+                if warm:
                     entry["status"] = "warm"
                     report.entries.append(entry)
                     continue
@@ -948,7 +978,8 @@ class BatchedExecutor:
                             device_kind=self._device_kind())
                         compiled = self._store.load(skey)
                         if compiled is not None:
-                            self._aot[aot_key] = compiled
+                            with self._tables_lock:
+                                self._aot[aot_key] = compiled
                             entry["status"] = "loaded"
                             report.entries.append(entry)
                             continue
@@ -957,9 +988,14 @@ class BatchedExecutor:
                            if sharding is not None
                            else jax.ShapeDtypeStruct(s, jnp.dtype(d))
                            for s, d in sig]
+                    # the XLA compile deliberately runs OUTSIDE the
+                    # tables lock: holding it here would stall the
+                    # dispatch thread's AOT lookups behind a multi-second
+                    # compile (the CC003 shape synlint exists to catch)
                     compiled = self._jit_for(len(sds), mask).lower(
                         *bound, *sds).compile()
-                    self._aot[aot_key] = compiled
+                    with self._tables_lock:
+                        self._aot[aot_key] = compiled
                     entry["status"] = "compiled"
                     if skey is not None:
                         entry["persisted"] = self._store.save(skey, compiled)
@@ -991,9 +1027,10 @@ class BatchedExecutor:
             placement: Any = self._shard_data
             bound = self._bound
         elif layout == "rr":
-            rr_idx = self._rr_next % len(self._devices)
+            with self._tables_lock:
+                rr_idx = self._rr_next % len(self._devices)
+                self._rr_next += 1
             dev = self._devices[rr_idx]
-            self._rr_next += 1
             placement = dev
             bound = self._bound_for_device(dev)
         else:
@@ -1024,13 +1061,15 @@ class BatchedExecutor:
             if mask[i]:
                 # donation would delete the caller's own buffer
                 padded[i] = jnp.copy(padded[i])
-        compiled = self._aot.get((sig, mask, layout, rr_idx))
+        with self._tables_lock:
+            compiled = self._aot.get((sig, mask, layout, rr_idx))
         if compiled is not None:
             # warmup()-precompiled (or store-deserialized) executable:
             # no trace, no XLA compile on the serving path
             try:
                 out = compiled(*bound, *padded)
-                self._aot_hits += 1
+                with self._tables_lock:
+                    self._aot_hits += 1
                 return out, n, bucket
             except Exception:  # noqa: BLE001 - degrade, never error
                 # aval/sharding drift, or a store-deserialized executable
@@ -1038,7 +1077,8 @@ class BatchedExecutor:
                 # cover every host difference on a shared cache volume):
                 # retire the entry and fall back to the lazy jit path — a
                 # genuine program error will re-raise from the jit call
-                self._aot.pop((sig, mask, layout, rr_idx), None)
+                with self._tables_lock:
+                    self._aot.pop((sig, mask, layout, rr_idx), None)
         out = self._jit_for(len(padded), mask)(*bound, *padded)
         return out, n, bucket
 
@@ -1084,12 +1124,22 @@ class JitCache:
     """
 
     def __init__(self):
-        self._cache: Dict[Any, Callable] = {}
+        self._cache: Dict[Any, Callable] = {}  # synlint: shared
+        self._lock = threading.Lock()
 
     def get(self, key: Any, build: Callable[[], Callable]) -> Callable:
-        if key not in self._cache:
-            self._cache[key] = build()
-        return self._cache[key]
+        # models call this from arbitrary scorer threads: the historical
+        # unguarded check-then-set let two threads build two executors
+        # for one key and RETURN DIFFERENT ONES (each with its own
+        # pipeline + jit cache). build() runs outside the lock — it may
+        # trace/compile — and setdefault crowns one winner for everyone.
+        with self._lock:
+            got = self._cache.get(key)
+        if got is None:
+            built = build()
+            with self._lock:
+                got = self._cache.setdefault(key, built)
+        return got
 
     def clear(self):
         """Drop cached callables AND invalidate every open persistent-
@@ -1097,7 +1147,8 @@ class JitCache:
         back a memoized (possibly stale) deserialized executable — the
         next load re-reads disk, where a rewritten/deleted entry is
         visible."""
-        self._cache.clear()
+        with self._lock:
+            self._cache.clear()
         _cc.invalidate_open_stores()
 
 

@@ -1,0 +1,19 @@
+"""CC001 bad: shared fields written off-lock from thread functions."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.processed = 0
+        self.last_error = None       # synlint: shared
+
+    def start(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        self.processed += 1          # CC001: unguarded, also written below
+
+    def reset(self):
+        self.processed = 0           # CC001: second unguarded writer
+        self.last_error = None       # CC001: annotated shared, no lock

@@ -1,0 +1,23 @@
+"""CC002 bad: two lock-order cycles — AB/BA and a self-reacquire."""
+import threading
+
+_A_LOCK = threading.Lock()
+_B_LOCK = threading.Lock()
+
+
+def transfer():
+    with _A_LOCK:
+        with _B_LOCK:                # A -> B
+            pass
+
+
+def refund():
+    with _B_LOCK:
+        with _A_LOCK:                # CC002: B -> A closes the cycle
+            pass
+
+
+def reenter():
+    with _A_LOCK:
+        with _A_LOCK:                # CC002: non-reentrant re-acquire
+            pass

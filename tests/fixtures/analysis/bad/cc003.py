@@ -1,0 +1,16 @@
+"""CC003 bad: blocking work inside critical sections."""
+import queue
+import threading
+import time
+
+_LOCK = threading.Lock()
+_Q = queue.Queue()
+
+
+def consume(fut, fn, args):
+    with _LOCK:
+        item = _Q.get(timeout=1.0)   # CC003: queue wait under lock
+        res = fut.result()           # CC003: future wait under lock
+        time.sleep(0.1)              # CC003: sleep under lock
+        exe = fn.lower(*args).compile()  # CC003: XLA compile under lock
+    return item, res, exe

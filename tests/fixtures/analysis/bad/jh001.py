@@ -1,0 +1,12 @@
+"""JH001 bad: host syncs inside a dispatch hot path."""
+import jax
+import numpy as np
+
+
+def _dispatch(self, arrays, bucket):
+    out = self._jit_for(len(arrays))(*arrays)
+    out.block_until_ready()          # JH001: sync stalls the pipeline
+    host = np.asarray(out)           # JH001: D2H on a device value
+    loss = float(out)                # JH001: scalar sync
+    jax.device_get(out)              # JH001: explicit blocking fetch
+    return host, loss

@@ -1,0 +1,19 @@
+"""JH002 bad: python control flow on traced values."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scale(x, threshold):
+    if threshold > 0:                # JH002: tracer in `if`
+        return x * threshold
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def clip_loop(x, n):
+    while x.sum() > n:               # JH002: tracer in `while` (x traced)
+        x = x * 0.5
+    return x

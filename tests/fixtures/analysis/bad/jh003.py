@@ -1,0 +1,15 @@
+"""JH003 bad: non-hashable values in static positions."""
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(1,))
+def windowed(x, sizes=[8, 16]):      # JH003: list default for static arg
+    return x
+
+
+def run(x):
+    g = jax.jit(windowed, static_argnums=(1,))
+    return g(x, [32, 64])            # JH003: list passed in static slot

@@ -1,0 +1,19 @@
+"""JH004 bad: side effects inside jitted functions."""
+import jax
+
+_STATS = {"calls": 0}
+
+
+class Model:
+    @jax.jit
+    def forward(self, x):
+        self.last_batch = x          # JH004: self mutation under jit
+        _STATS["calls"] += 1         # JH004: module-global mutation
+        return x * 2
+
+
+@jax.jit
+def count(x):
+    global _TOTAL
+    _TOTAL = x.sum()                 # JH004: global write under jit
+    return x

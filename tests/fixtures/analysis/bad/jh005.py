@@ -1,0 +1,13 @@
+"""JH005 bad: donated buffer read after dispatch."""
+import jax
+
+
+def step(params, grads):
+    update = jax.jit(apply_update, donate_argnums=(0,))
+    new_params = update(params, grads)
+    norm = params["w"].sum()         # JH005: params was donated above
+    return new_params, norm
+
+
+def apply_update(params, grads):
+    return {"w": params["w"] - grads["w"]}

@@ -1,0 +1,12 @@
+"""Suppression fixture: the same JH001 violation three ways — bare (a
+finding), same-line disable, and previous-line disable. Exactly ONE
+finding must survive."""
+
+
+def _dispatch(self, arrays):
+    out = self._jit_for(1)(*arrays)
+    out.block_until_ready()                     # survives: no directive
+    out.block_until_ready()                     # synlint: disable=JH001
+    # synlint: disable=JH001
+    out.block_until_ready()
+    return out

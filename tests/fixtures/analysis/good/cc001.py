@@ -1,0 +1,21 @@
+"""CC001 good: every cross-thread write holds the owning lock."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.processed = 0
+        self.last_error = None       # synlint: shared
+
+    def start(self):
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        with self._lock:
+            self.processed += 1
+
+    def reset(self):
+        with self._lock:
+            self.processed = 0
+            self.last_error = None

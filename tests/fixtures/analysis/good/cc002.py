@@ -1,0 +1,17 @@
+"""CC002 good: one global acquisition order."""
+import threading
+
+_A_LOCK = threading.Lock()
+_B_LOCK = threading.Lock()
+
+
+def transfer():
+    with _A_LOCK:
+        with _B_LOCK:
+            pass
+
+
+def refund():
+    with _A_LOCK:
+        with _B_LOCK:
+            pass

@@ -1,0 +1,20 @@
+"""CC003 good: waits happen outside; the lock only guards the dict."""
+import queue
+import threading
+import time
+
+_LOCK = threading.Lock()
+_Q = queue.Queue()
+_CACHE = {}
+
+
+def consume(fut, fn, args, key):
+    item = _Q.get(timeout=1.0)
+    res = fut.result()
+    time.sleep(0.1)
+    exe = fn.lower(*args).compile()
+    with _LOCK:
+        got = _CACHE.get(key)        # dict.get: not a blocking call
+        if got is None:
+            _CACHE[key] = exe
+    return item, res, got

@@ -1,0 +1,16 @@
+"""JH001 good: the hot path stays non-blocking; syncs live on the
+drain side's _fetch (not a dispatch/drain-loop name)."""
+import numpy as np
+
+
+def _dispatch(self, arrays, bucket):
+    # np.asarray on a HOST input (not device-tainted) is fine
+    staged = [np.asarray(a) for a in arrays]
+    out = self._jit_for(len(staged))(*staged)
+    return out, bucket
+
+
+def fetch(self, out):
+    import jax
+
+    return jax.device_get(out)

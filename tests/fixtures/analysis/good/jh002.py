@@ -1,0 +1,21 @@
+"""JH002 good: branching on static properties or via lax.cond."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scale(x, threshold):
+    return jnp.where(threshold > 0, x * threshold, x)
+
+
+@partial(jax.jit, static_argnames=("causal",))
+def attend(x, causal):
+    if causal:                       # static arg: legal python branch
+        return x - 1
+    if x.ndim > 2:                   # .ndim is static under trace
+        return x.sum(-1)
+    if x is None:                    # identity check: static for tracers
+        return x
+    return x

@@ -1,0 +1,14 @@
+"""JH003 good: static args are hashable (tuples, scalars)."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnums=(1,))
+def windowed(x, sizes=(8, 16)):
+    return x
+
+
+def run(x):
+    g = jax.jit(windowed, static_argnums=(1,))
+    return g(x, (32, 64))

@@ -1,0 +1,14 @@
+"""JH004 good: state flows through arguments and returns."""
+import jax
+
+
+class Model:
+    @jax.jit
+    def forward(self, x):
+        y = x * 2                    # locals are fine
+        return y
+
+
+@jax.jit
+def count(x, total):
+    return x, total + x.sum()        # carry state functionally

@@ -1,0 +1,107 @@
+"""Regression tests for the concurrency violations synlint surfaced
+(tools/analysis — PR 5): each exercises the exact race the fix guards,
+so a future refactor that drops the lock fails here, not in prod.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from synapseml_tpu.io.serving import (ContinuousServer, DistributedServer,
+                                      HTTPSourceStateHolder)
+from synapseml_tpu.runtime.executor import BatchedExecutor, JitCache
+
+
+def _hammer(fn, n_threads=8, iters=25):
+    """Run fn concurrently; return every result produced."""
+    results, errors = [], []
+    start = threading.Barrier(n_threads)
+
+    def worker():
+        try:
+            start.wait(timeout=10)
+            for _ in range(iters):
+                results.append(fn())
+        except Exception as e:  # noqa: BLE001 - surfaced via assertion
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    return results
+
+
+def test_jit_for_concurrent_callers_share_one_wrapper():
+    ex = BatchedExecutor(lambda x: (x * 2,), donate=False)
+    got = _hammer(lambda: ex._jit_for(1, (False,)))
+    assert len({id(g) for g in got}) == 1
+    assert len(ex._jits) == 1
+
+
+def test_donate_mask_concurrent_resolution_is_consistent():
+    ex = BatchedExecutor(lambda x: (x * 2,), donate=True)
+    sig = (((8, 4), "float32"),)
+    got = _hammer(lambda: ex._donate_mask_for_sig(sig))
+    assert len(set(got)) == 1
+    assert len(ex._donate_masks) == 1
+
+
+def test_jitcache_concurrent_get_returns_single_winner():
+    cache = JitCache()
+    built = []
+
+    def build():
+        built.append(1)  # may run more than once; winner must be unique
+        return object()
+
+    got = _hammer(lambda: cache.get("k", build))
+    assert len({id(g) for g in got}) == 1
+
+
+def test_continuous_server_concurrent_errors_all_recorded():
+    def bad_pipeline(table):
+        raise RuntimeError("boom")
+
+    cs = ContinuousServer("lockdisc-errors", bad_pipeline)
+    try:
+        n = len(_hammer(lambda: cs._score_only([]), n_threads=6, iters=10))
+        assert len(cs.errors) == n == 60
+    finally:
+        HTTPSourceStateHolder.remove("lockdisc-errors")
+
+
+def test_distributed_server_attach_race_single_owner():
+    winners, losers = [], []
+    start = threading.Barrier(4)
+
+    def attach():
+        start.wait(timeout=10)
+        try:
+            winners.append(DistributedServer("lockdisc-owner", 2))
+        except ValueError:
+            losers.append(1)
+
+    threads = [threading.Thread(target=attach) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    try:
+        assert len(winners) == 1 and len(losers) == 3
+    finally:
+        for w in winners:
+            w.stop()
+
+
+def test_bound_for_device_concurrent_single_replica():
+    dev = jax.devices()[0]
+    ex = BatchedExecutor(lambda w, x: (x + w,),
+                         bound_args=(np.float32(1.0),), donate=False)
+    got = _hammer(lambda: ex._bound_for_device(dev), n_threads=6, iters=5)
+    assert len({id(g) for g in got}) == 1
+    assert set(ex._bound_rr) == {dev.id}

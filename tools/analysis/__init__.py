@@ -1,0 +1,35 @@
+"""synlint: repo-specific JAX-hygiene + concurrency static analysis.
+
+Two rule families over the package's AST (docs/analysis.md is the rule
+catalog):
+
+- **JH (JAX hygiene)** — host syncs on hot paths, Python branching on
+  tracer values inside jitted functions, non-hashable static args,
+  mutation of ``self``/globals under jit, donated buffers read after
+  dispatch. These are the silent TPU-stack killers: none of them raise;
+  they recompile, sync, or corrupt instead.
+- **CC (concurrency)** — shared fields written off-lock from thread-entry
+  functions, inconsistent lock acquisition order (potential deadlock),
+  and blocking calls made while holding a lock.
+
+Usage::
+
+    python -m tools.analysis synapseml_tpu tools bench.py --fail-on-new
+
+Inline annotations (comments):
+
+- ``# synlint: disable=JH001[,CC003]`` — suppress on this line (or on a
+  bare comment line directly above).
+- ``# synlint: shared`` — on a ``self.x = ...`` line: register the field
+  as cross-thread shared; every later write must hold a lock (CC001).
+- ``# synlint: hotpath`` — on a ``def`` line: treat the function as a
+  dispatch-critical hot path for JH001.
+
+Intentionally-kept findings live in ``tools/analysis/baseline.json``;
+``--fail-on-new`` fails only on findings not in the baseline, so CI
+catches regressions without forcing a big-bang cleanup.
+"""
+from tools.analysis.engine import analyze_paths
+from tools.analysis.findings import Finding, load_baseline, write_baseline
+
+__all__ = ["analyze_paths", "Finding", "load_baseline", "write_baseline"]

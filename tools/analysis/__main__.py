@@ -1,0 +1,103 @@
+"""CLI: ``python -m tools.analysis [paths] [--baseline F] [--fail-on-new]``.
+
+Exit codes: 0 = clean (or every finding baselined under ``--fail-on-new``),
+1 = findings (new findings under ``--fail-on-new``), 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from tools.analysis.engine import analyze_paths
+from tools.analysis.findings import (default_baseline_path, load_baseline,
+                                     split_new, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="synlint: JAX-hygiene + concurrency static analysis "
+                    "(rule catalog: docs/analysis.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze "
+                         "(default: synapseml_tpu tools bench.py)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of intentionally-kept findings "
+                         "(default: tools/analysis/baseline.json when it "
+                         "exists)")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit 1 only for findings NOT in the baseline "
+                         "(this is already the behavior whenever a "
+                         "baseline is found; the flag documents intent "
+                         "in CI invocations)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline: report and fail on every "
+                         "finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings to the baseline file "
+                         "and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as one JSON object on stdout")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or ["synapseml_tpu", "tools", "bench.py"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"synlint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    findings = analyze_paths(paths)
+    runtime_s = time.monotonic() - t0
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"synlint: wrote {len(findings)} findings to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = None
+    if args.no_baseline:
+        pass
+    elif os.path.exists(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (json.JSONDecodeError, KeyError, OSError) as e:
+            print(f"synlint: baseline {baseline_path} unreadable: {e}",
+                  file=sys.stderr)
+            return 2
+    elif args.baseline:
+        print(f"synlint: baseline {baseline_path} not found",
+              file=sys.stderr)
+        return 2
+
+    if baseline is not None:
+        new, matched = split_new(findings, baseline)
+    else:
+        new, matched = findings, 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings_total": len(findings),
+            "findings_new": len(new),
+            "baselined": matched,
+            "runtime_s": round(runtime_s, 3),
+            "findings": [f.to_json() | {"line": f.line} for f in new],
+        }))
+    else:
+        for f in new:
+            print(f.render())
+        tail = (f"synlint: {len(findings)} finding(s), {matched} "
+                f"baselined, {len(new)} new "
+                f"({runtime_s:.2f}s)")
+        print(tail, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

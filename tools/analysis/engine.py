@@ -1,0 +1,203 @@
+"""Analysis driver: file walking, directive parsing, suppression.
+
+One :class:`ModuleContext` per file carries everything a rule needs —
+the AST, raw source lines, the ``# synlint:`` directive map, and a
+node→enclosing-qualname map — so rules stay pure functions from context
+to findings.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from tools.analysis.findings import Finding
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*synlint:\s*(disable(?:=(?P<rules>[A-Z0-9, ]+))?|shared|hotpath)",
+    re.IGNORECASE)
+
+ALL_RULES = "ALL"
+
+
+def _comment_lines(source: str) -> Dict[int, str]:
+    """lineno -> comment text, from the token stream — directives in
+    string literals/docstrings must NOT count (a doc mentioning the
+    suppression syntax would otherwise suppress that line for real)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        pass  # ast.parse succeeded, so this is effectively unreachable
+    return out
+
+
+class Directives:
+    """Per-line ``# synlint:`` annotations for one file."""
+
+    def __init__(self, source: str):
+        self.disable: Dict[int, Set[str]] = {}
+        self.shared: Set[int] = set()
+        self.hotpath: Set[int] = set()
+        for i, text in sorted(_comment_lines(source).items()):
+            if "synlint" not in text:
+                continue
+            for m in _DIRECTIVE_RE.finditer(text):
+                word = m.group(1).lower()
+                if word.startswith("disable"):
+                    rules = m.group("rules")
+                    ids = ({r.strip().upper() for r in rules.split(",")
+                            if r.strip()} if rules else {ALL_RULES})
+                    self.disable.setdefault(i, set()).update(ids)
+                elif word == "shared":
+                    self.shared.add(i)
+                elif word == "hotpath":
+                    self.hotpath.add(i)
+
+    def suppressed(self, line: int, rule: str,
+                   lines: Sequence[str]) -> bool:
+        """A finding is suppressed by a directive on its own line, or on
+        a bare comment line directly above it."""
+        for cand in (line, line - 1):
+            ids = self.disable.get(cand)
+            if not ids:
+                continue
+            if cand == line - 1 and not lines[cand - 1].lstrip().startswith("#"):
+                continue  # code line above: its directive is its own
+            if ALL_RULES in ids or rule in ids:
+                return True
+        return False
+
+
+class ModuleContext:
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.directives = Directives(source)
+        # flat node list: rules iterate this instead of re-walking the
+        # tree (ast.walk per rule made the whole run O(rules * nodes))
+        self.nodes = list(ast.walk(self.tree))
+        self.qualnames: Dict[ast.AST, str] = {}
+        self._map_qualnames(self.tree, "")
+
+    def _map_qualnames(self, node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                self.qualnames[child] = qn
+                self._map_qualnames(child, qn)
+            else:
+                self._map_qualnames(child, prefix)
+
+    def context_for(self, node: ast.AST) -> str:
+        """Qualname of the innermost def/class whose span contains the
+        node (line-range containment — cheap and good enough)."""
+        best, best_span = "<module>", None
+        target = getattr(node, "lineno", 0)
+        for scope, qn in self.qualnames.items():
+            lo = scope.lineno
+            hi = getattr(scope, "end_lineno", lo)
+            if lo <= target <= hi:
+                span = hi - lo
+                if best_span is None or span < best_span:
+                    best, best_span = qn, span
+        return best
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       context=self.context_for(node), message=message)
+
+
+def walk_shallow(node: ast.AST):
+    """Yield ``node`` and descendants WITHOUT entering nested function/
+    class definitions — scope-local traversal for scope-local rules."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef,
+                                      ast.Lambda)):
+                stack.append(child)
+
+
+def expr_name(node: ast.AST) -> str:
+    """Stable short identity for a lock/receiver expression: the final
+    attribute (``self._lock`` -> ``_lock``) or the bare name — so the
+    same field reached through different receivers unifies."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - malformed node
+        return "<expr>"
+
+
+def expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - malformed node
+        return "<expr>"
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        if not os.path.isdir(p):
+            # a typo'd path must not silently analyze nothing — that
+            # reads as "clean" to whoever wired the command
+            raise FileNotFoundError(f"synlint: no such path: {p}")
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[Finding]:
+    """Run every rule over every ``.py`` under ``paths``; suppressed
+    findings are already filtered. Unparseable files yield a single
+    SYN000 finding instead of crashing the run."""
+    from tools.analysis import rules_concurrency, rules_jax
+
+    root = root or os.getcwd()
+    findings: List[Finding] = []
+    for fpath in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fpath), root)
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            ctx = ModuleContext(fpath, rel, source)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(
+                rule="SYN000", path=rel.replace(os.sep, "/"), line=1,
+                col=0, context="<module>",
+                message=f"unparseable file: {e.__class__.__name__}"))
+            continue
+        raw: List[Finding] = []
+        raw.extend(rules_jax.run(ctx))
+        raw.extend(rules_concurrency.run(ctx))
+        raw.sort(key=lambda f: (f.line, f.col, f.rule))
+        findings.extend(
+            f for f in raw
+            if not ctx.directives.suppressed(f.line, f.rule, ctx.lines))
+    return findings

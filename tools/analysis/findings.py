@@ -1,0 +1,99 @@
+"""Finding records + the committed-baseline format.
+
+A finding's identity must survive unrelated edits, so the fingerprint
+hashes (rule, path, enclosing-scope qualname, message) — never the line
+number. Identical findings in the same scope (e.g. two unguarded writes
+to the same field in one method) are disambiguated by an occurrence
+index at comparison time, not inside the fingerprint, so deleting one of
+them never orphans the other's baseline entry.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Tuple
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str           # "JH001" ... "CC003"
+    path: str           # repo-relative, posix separators
+    line: int
+    col: int
+    context: str        # enclosing function qualname, or "<module>"
+    message: str
+
+    def fingerprint(self) -> str:
+        blob = "|".join((self.rule, self.path, self.context, self.message))
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message} [{self.context}]")
+
+    def to_json(self) -> Dict:
+        return {"fingerprint": self.fingerprint(), "rule": self.rule,
+                "path": self.path, "context": self.context,
+                "message": self.message}
+
+
+BASELINE_VERSION = 1
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline as a multiset of fingerprints (a fingerprint may cover
+    several identical findings in one scope)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    counts: Counter = Counter()
+    for entry in data.get("findings", []):
+        counts[entry["fingerprint"]] += entry.get("count", 1)
+    return counts
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    by_fp: Dict[str, Dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in by_fp:
+            by_fp[fp]["count"] += 1
+        else:
+            entry = f.to_json()
+            entry["count"] = 1
+            by_fp[fp] = entry
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": "intentionally-kept synlint findings; regenerate with "
+                   "python -m tools.analysis <paths> --write-baseline",
+        "findings": sorted(by_fp.values(),
+                           key=lambda e: (e["path"], e["rule"],
+                                          e["context"], e["message"])),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def split_new(findings: List[Finding],
+              baseline: Counter) -> Tuple[List[Finding], int]:
+    """(new findings, number matched by the baseline). Occurrences of a
+    fingerprint beyond its baselined count are new."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    matched = 0
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            matched += 1
+        else:
+            new.append(f)
+    return new, matched

@@ -1,0 +1,360 @@
+"""CC rules: lock discipline across the package's threading sites.
+
+CC001  shared field written without holding a lock
+CC002  inconsistent lock acquisition order (potential deadlock)
+CC003  blocking call while holding a lock
+
+Model (heuristic, lexical — documented in docs/analysis.md):
+
+- *Thread entries* are functions referenced as ``threading.Thread(
+  target=...)``. Anything reachable from an entry through same-module
+  calls (matched by bare/attribute name — over-approximate on purpose)
+  runs off the creating thread.
+- A write is *guarded* when it sits lexically inside a ``with <lock>:``
+  block; lock-ness is detected from ``threading.Lock()``/``RLock()``
+  assignments plus a name heuristic ("lock" in the identifier).
+- A field is *shared* when written (outside ``__init__``) from two or
+  more functions at least one of which is thread-reachable, or when its
+  declaration carries a ``# synlint: shared`` annotation — the registry
+  for fields whose sharing the call graph cannot see (cross-object
+  handoffs, fields mutated through a non-``self`` receiver).
+- Fields holding intrinsically thread-safe objects (``queue.Queue``,
+  ``threading.Event``/``Semaphore``/locks) are exempt.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.engine import ModuleContext, expr_name, expr_text
+from tools.analysis.findings import Finding
+
+_LOCK_CTORS = re.compile(r"threading\.(R?Lock|Condition)\b|\b(R?Lock)\(\)")
+_THREADSAFE_CTORS = re.compile(
+    r"(queue|_queue)\.(Lifo|Priority)?Queue\(|threading\.(Event|Semaphore|"
+    r"BoundedSemaphore|Barrier|R?Lock|Condition)\(|Event\(\)|Semaphore\(")
+_MUTATION_METHODS = {"append", "appendleft", "extend", "insert", "remove",
+                     "pop", "popleft", "popitem", "clear", "update", "add",
+                     "discard", "setdefault"}
+_BLOCKING_ATTRS = {"result", "sleep", "block_until_ready",
+                   "device_get", "recv", "accept", "connect",
+                   "sendall", "readline", "urlopen", "wait"}
+
+
+class _Write:
+    __slots__ = ("receiver", "attr", "fn", "node", "guarded", "in_init")
+
+    def __init__(self, receiver: str, attr: str, fn: str, node: ast.AST,
+                 guarded: bool, in_init: bool):
+        self.receiver = receiver
+        self.attr = attr
+        self.fn = fn
+        self.node = node
+        self.guarded = guarded
+        self.in_init = in_init
+
+
+def _collect_lock_names(ctx: ModuleContext) -> Set[str]:
+    names: Set[str] = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _LOCK_CTORS.search(expr_text(node.value)):
+                for t in node.targets:
+                    names.add(expr_name(t))
+    return names
+
+
+def _is_lock_expr(node: ast.AST, lock_names: Set[str]) -> bool:
+    name = expr_name(node)
+    return name in lock_names or "lock" in name.lower()
+
+
+def _lock_id(node: ast.AST, cls: Optional[str]) -> str:
+    """Lock identity for order tracking: class-qualified for ``self``
+    receivers so two classes' ``_lock`` fields don't alias."""
+    name = expr_name(node)
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self" \
+            and cls:
+        return f"{cls}.{name}"
+    return name
+
+
+def _thread_entries(ctx: ModuleContext) -> Set[str]:
+    entries: Set[str] = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.Call) and \
+                expr_text(node.func).endswith("Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    entries.add(expr_name(kw.value))
+    return entries
+
+
+def _call_graph(ctx: ModuleContext) -> Dict[str, Set[str]]:
+    """fn-name -> names it calls (bare and attribute names). Name-based:
+    cross-class collisions over-approximate reachability, which errs
+    toward reporting — the right direction for a race detector."""
+    graph: Dict[str, Set[str]] = {}
+    for node in ctx.nodes:
+        if isinstance(node, ast.FunctionDef):
+            called: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    called.add(expr_name(sub.func))
+                elif isinstance(sub, ast.Attribute):
+                    # method handed around as a value (callbacks, targets)
+                    called.add(sub.attr)
+            graph.setdefault(node.name, set()).update(called)
+    return graph
+
+
+def _reachable(entries: Set[str], graph: Dict[str, Set[str]]) -> Set[str]:
+    seen: Set[str] = set()
+    frontier = [e for e in entries if e in graph]
+    while frontier:
+        fn = frontier.pop()
+        if fn in seen:
+            continue
+        seen.add(fn)
+        frontier.extend(c for c in graph.get(fn, ()) if c in graph)
+    return seen
+
+
+class _FnScan(ast.NodeVisitor):
+    """One pass per function: attr writes with guard state, lock-order
+    edges, blocking-calls-under-lock."""
+
+    def __init__(self, ctx: ModuleContext, fn: ast.FunctionDef,
+                 cls: Optional[str], lock_names: Set[str]):
+        self.ctx = ctx
+        self.fn = fn
+        self.cls = cls
+        self.lock_names = lock_names
+        self.held: List[Tuple[str, str]] = []  # (lock id, full text)
+        self.writes: List[_Write] = []
+        self.edges: List[Tuple[str, str, str, str, ast.AST]] = []
+        self.blocking: List[Tuple[ast.AST, str, str]] = []
+        self._in_init = fn.name == "__init__"
+
+    def scan(self):
+        for stmt in self.fn.body:
+            self.visit(stmt)
+        return self
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        pass  # nested defs scanned as their own functions
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        pass  # nested classes (handler factories) scanned separately
+
+    def visit_With(self, node: ast.With):
+        pushed = 0
+        for item in node.items:
+            expr = item.context_expr
+            if _is_lock_expr(expr, self.lock_names):
+                lid = _lock_id(expr, self.cls)
+                text = expr_text(expr)
+                if self.held:
+                    outer_id, outer_text = self.held[-1]
+                    self.edges.append(
+                        (outer_id, lid, outer_text, text, node))
+                self.held.append((lid, text))
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def _record_write(self, target: ast.expr, node: ast.AST):
+        base = target
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute):
+            self.writes.append(_Write(
+                expr_text(base.value), base.attr, self.fn.name, node,
+                bool(self.held), self._in_init))
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._record_write(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record_write(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record_write(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            self._record_write(t, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            if meth in _MUTATION_METHODS and \
+                    isinstance(node.func.value, (ast.Attribute,
+                                                 ast.Subscript)):
+                self._record_write(node.func.value, node)
+            if self.held and self._is_blocking(node, meth):
+                self.blocking.append((node, meth, self.held[-1][1]))
+        elif isinstance(node.func, ast.Name) and self.held and \
+                node.func.id == "sleep":
+            self.blocking.append((node, "sleep", self.held[-1][1]))
+        self.generic_visit(node)
+
+    def _is_blocking(self, node: ast.Call, meth: str) -> bool:
+        kwargs = {kw.arg for kw in node.keywords}
+        if meth == "join":
+            # Thread.join() / join(timeout=...) / join(5) block;
+            # str.join(seq) and os.path.join(a, b) don't
+            return (not node.args and (not kwargs or "timeout" in kwargs)) \
+                or (len(node.args) == 1
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, (int, float)))
+        if meth == "get":
+            # queue.get() / get(timeout=) blocks; dict.get(key[, default])
+            # carries positional args and neither kwarg
+            return not node.args or bool({"timeout", "block"} & kwargs)
+        if meth == "lower":
+            return bool(node.args)  # str.lower() takes none
+        if meth == "compile":
+            recv = expr_text(node.func.value)
+            return "lower(" in recv or "jit" in recv
+        if meth == "acquire":
+            return "blocking" not in kwargs and not (
+                node.args and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value is False)
+        return meth in _BLOCKING_ATTRS
+
+
+def _class_functions(ctx: ModuleContext
+                     ) -> List[Tuple[Optional[str], ast.FunctionDef]]:
+    """Every function with its nearest enclosing class name (None for
+    module-level functions)."""
+    out: List[Tuple[Optional[str], ast.FunctionDef]] = []
+
+    def walk(node: ast.AST, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, ast.FunctionDef):
+                out.append((cls, child))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(ctx.tree, None)
+    return out
+
+
+def _shared_annotated_attrs(ctx: ModuleContext,
+                            scans: Sequence[_FnScan]) -> Set[str]:
+    """Attr names whose write line carries ``# synlint: shared``."""
+    shared: Set[str] = set()
+    lines = ctx.directives.shared
+    if not lines:
+        return shared
+    for scan in scans:
+        for w in scan.writes:
+            span = range(w.node.lineno,
+                         getattr(w.node, "end_lineno", w.node.lineno) + 1)
+            if any(ln in lines for ln in span):
+                shared.add(w.attr)
+    return shared
+
+
+def _threadsafe_attrs(ctx: ModuleContext) -> Set[str]:
+    safe: Set[str] = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _THREADSAFE_CTORS.search(expr_text(node.value)):
+                for t in node.targets:
+                    safe.add(expr_name(t))
+    return safe
+
+
+def run(ctx: ModuleContext) -> List[Finding]:
+    if "threading" not in ctx.source and "Thread" not in ctx.source:
+        return []
+    lock_names = _collect_lock_names(ctx)
+    entries = _thread_entries(ctx)
+    reachable = _reachable(entries, _call_graph(ctx)) if entries else set()
+    scans = [_FnScan(ctx, fn, cls, lock_names).scan()
+             for cls, fn in _class_functions(ctx)]
+    findings: List[Finding] = []
+
+    # -- CC001: unguarded shared writes --------------------------------
+    shared_attrs = _shared_annotated_attrs(ctx, scans)
+    safe_attrs = _threadsafe_attrs(ctx) | lock_names
+    by_key: Dict[Tuple[Optional[str], str], List[_Write]] = {}
+    for scan in scans:
+        for w in scan.writes:
+            if w.receiver == "self":
+                by_key.setdefault((scan.cls, w.attr), []).append(w)
+            else:
+                by_key.setdefault((None, w.attr), []).append(w)
+    for (cls, attr), writes in sorted(
+            by_key.items(), key=lambda kv: (kv[0][0] or "", kv[0][1])):
+        if attr in safe_attrs:
+            continue
+        writers = {w.fn for w in writes if not w.in_init}
+        multi = len(writers) >= 2 and bool(writers & reachable)
+        if not multi and attr not in shared_attrs:
+            continue
+        for w in writes:
+            if w.in_init or w.guarded:
+                continue
+            where = f"{cls}.{attr}" if cls else attr
+            why = ("annotated `synlint: shared`" if attr in shared_attrs
+                   else f"written from {len(writers)} functions incl. a "
+                        "thread entry")
+            findings.append(ctx.finding(
+                "CC001", w.node,
+                f"unguarded write to shared field {where} in "
+                f"{w.fn!r} ({why}) — hold the owning lock"))
+
+    # -- CC002: lock-order cycles ---------------------------------------
+    adj: Dict[str, Dict[str, ast.AST]] = {}
+    self_edges: List[Tuple[str, ast.AST]] = []
+    for scan in scans:
+        for outer, inner, otext, itext, node in scan.edges:
+            if outer == inner:
+                if otext == itext:
+                    self_edges.append((otext, node))
+                continue
+            adj.setdefault(outer, {}).setdefault(inner, node)
+    for text, node in self_edges:
+        findings.append(ctx.finding(
+            "CC002", node,
+            f"lock {text} re-acquired while already held — deadlock for "
+            "a non-reentrant Lock"))
+    reported: Set[frozenset] = set()
+    for a, inners in sorted(adj.items()):
+        for b, node in sorted(inners.items()):
+            if a in adj.get(b, {}):
+                key = frozenset((a, b))
+                if key not in reported:
+                    reported.add(key)
+                    findings.append(ctx.finding(
+                        "CC002", node,
+                        f"inconsistent lock order: {a} -> {b} here but "
+                        f"{b} -> {a} elsewhere in this module — potential "
+                        "deadlock; pick one order"))
+
+    # -- CC003: blocking call under a lock ------------------------------
+    for scan in scans:
+        for node, meth, lock_text in scan.blocking:
+            findings.append(ctx.finding(
+                "CC003", node,
+                f"blocking call .{meth}(...) while holding {lock_text} — "
+                "move the wait outside the critical section"))
+    return findings

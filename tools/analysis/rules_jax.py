@@ -1,0 +1,446 @@
+"""JH rules: JAX hygiene.
+
+JH001  host-sync call inside a dispatch/drain hot path
+JH002  Python ``if``/``while`` on a tracer value inside a jitted function
+JH003  non-hashable / array-valued static arg (recompile or TypeError)
+JH004  mutation of ``self``/globals inside a jitted function
+JH005  donated buffer read after dispatch
+
+None of these raise at runtime in the obvious way: they sync, silently
+recompile per call, bake stale state into the trace, or read a deleted
+buffer. Catching them is pattern matching on the AST — heuristic by
+design, with ``# synlint: disable=`` as the escape hatch.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis.engine import (ModuleContext, expr_text,
+                                  walk_shallow)
+from tools.analysis.findings import Finding
+
+# functions treated as dispatch-critical even without a `# synlint:
+# hotpath` annotation — the executor pipeline's naming convention
+_HOT_NAME_RE = re.compile(r"^_?(dispatch|drain)|^submit$")
+
+# reading any of these off a tracer is static — not a tracer branch
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "device"}
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                 "callable", "id"}
+
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+_SYNC_CONVERTERS = {"float", "int", "bool"}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    text = expr_text(node.func)
+    if text == "jit" or text.endswith(".jit"):
+        return True
+    if text in ("partial", "functools.partial") and node.args:
+        inner = expr_text(node.args[0])
+        return inner == "jit" or inner.endswith(".jit")
+    return False
+
+
+def _jit_kwargs(node: ast.Call) -> Dict[str, ast.expr]:
+    return {kw.arg: kw.value for kw in node.keywords if kw.arg}
+
+
+def _const_int_collection(node: Optional[ast.expr]) -> List[int]:
+    """Literal ints out of ``static_argnums=0`` / ``(0, 2)`` / ``[1]``."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _const_str_collection(node: Optional[ast.expr]) -> List[str]:
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    args = fn.args
+    return [a.arg for a in (*args.posonlyargs, *args.args)]
+
+
+class _JittedFn:
+    def __init__(self, fn: ast.FunctionDef, static: Set[str],
+                 jit_node: ast.AST):
+        self.fn = fn
+        self.static = static
+        self.jit_node = jit_node
+
+
+def _collect_jitted(ctx: ModuleContext) -> List[_JittedFn]:
+    """Functions that are jit-compiled: decorated with (a partial of)
+    ``jax.jit``, or wrapped by name in a ``jax.jit(f, ...)`` call."""
+    by_name: Dict[str, ast.FunctionDef] = {}
+    out: List[_JittedFn] = []
+    claimed: Set[ast.FunctionDef] = set()
+    for node in ctx.nodes:
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                if _is_jit_call(dec) or expr_text(dec) in ("jax.jit", "jit"):
+                    kw = _jit_kwargs(dec) if isinstance(dec, ast.Call) else {}
+                    params = _param_names(node)
+                    static = set(_const_str_collection(
+                        kw.get("static_argnames")))
+                    static |= {params[i] for i in _const_int_collection(
+                        kw.get("static_argnums")) if i < len(params)}
+                    out.append(_JittedFn(node, static, dec))
+                    claimed.add(node)
+    for node in ctx.nodes:
+        if _is_jit_call(node) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in by_name:
+                fn = by_name[target.id]
+                if fn in claimed:
+                    continue
+                kw = _jit_kwargs(node)
+                params = _param_names(fn)
+                static = set(_const_str_collection(kw.get("static_argnames")))
+                static |= {params[i] for i in _const_int_collection(
+                    kw.get("static_argnums")) if i < len(params)}
+                out.append(_JittedFn(fn, static, node))
+                claimed.add(fn)
+    return out
+
+
+# -- JH001 ----------------------------------------------------------------
+
+def _hot_functions(ctx: ModuleContext) -> List[ast.FunctionDef]:
+    out = []
+    for node in ctx.nodes:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if (node.lineno in ctx.directives.hotpath
+                or _HOT_NAME_RE.search(node.name)):
+            out.append(node)
+    return out
+
+
+def _tainted_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names locally assigned from device-producing calls (device_put,
+    a jit/compiled callable) — the values a host conversion would sync."""
+    tainted: Set[str] = set()
+    device_re = re.compile(r"device_put|\bjit\b|_jit|compiled|\.aot\b|_aot")
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            text = expr_text(node.value.func)
+            if device_re.search(text):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        tainted.update(e.id for e in t.elts
+                                       if isinstance(e, ast.Name))
+    return tainted
+
+
+def _rule_jh001(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _hot_functions(ctx):
+        tainted = _tainted_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                meth = node.func.attr
+                if meth in _SYNC_METHODS:
+                    out.append(ctx.finding(
+                        "JH001", node,
+                        f"host-sync call .{meth}() inside hot path "
+                        f"{fn.name!r} stalls the dispatch pipeline"))
+                    continue
+                if meth in ("device_get",):
+                    out.append(ctx.finding(
+                        "JH001", node,
+                        f"blocking D2H transfer ({expr_text(node.func)}) "
+                        f"inside hot path {fn.name!r} — fetch belongs on "
+                        "the drain side"))
+                    continue
+                if meth in ("asarray", "array") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in tainted:
+                        out.append(ctx.finding(
+                            "JH001", node,
+                            f"np.{meth}({arg.id}) on a device value inside "
+                            f"hot path {fn.name!r} forces a blocking D2H "
+                            "copy"))
+            elif isinstance(node.func, ast.Name):
+                if node.func.id in _SYNC_CONVERTERS and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in tainted:
+                        out.append(ctx.finding(
+                            "JH001", node,
+                            f"{node.func.id}({arg.id}) on a device value "
+                            f"inside hot path {fn.name!r} blocks on the "
+                            "device"))
+    return out
+
+
+# -- JH002 ----------------------------------------------------------------
+
+def _traced_name_uses(test: ast.expr, traced: Set[str]) -> List[ast.Name]:
+    """Name nodes in a branch test that read a traced value *as a
+    value* — static accessors (.shape, len(), `is None`) excluded."""
+    hits: List[ast.Name] = []
+
+    def visit(node: ast.AST):
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return  # x.shape / x.dtype — static under trace
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            fname = expr_text(node.func)
+            if fname in _STATIC_CALLS:
+                return
+            visit(node.func)  # x.sum() > n reads x through the receiver
+            for a in node.args:
+                visit(a)
+            for kw in node.keywords:
+                visit(kw.value)
+            return
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` branches on python identity,
+            # which is static for a tracer
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) \
+                    and all(isinstance(c, ast.Constant)
+                            for c in node.comparators):
+                return
+        if isinstance(node, ast.Name) and node.id in traced:
+            hits.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return hits
+
+
+def _rule_jh002(ctx: ModuleContext,
+                jitted: Sequence[_JittedFn]) -> List[Finding]:
+    out: List[Finding] = []
+    for jf in jitted:
+        traced = {p for p in _param_names(jf.fn)
+                  if p not in jf.static and p != "self"}
+        if not traced:
+            continue
+        for node in ast.walk(jf.fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for use in _traced_name_uses(node.test, traced):
+                kind = "while" if isinstance(node, ast.While) else "if"
+                out.append(ctx.finding(
+                    "JH002", node,
+                    f"python `{kind}` on traced value {use.id!r} inside "
+                    f"jitted {jf.fn.name!r} — raises under trace or bakes "
+                    "one branch in; use lax.cond/select or mark the arg "
+                    "static"))
+                break  # one finding per branch statement
+    return out
+
+
+# -- JH003 ----------------------------------------------------------------
+
+_NONHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp)
+
+
+def _is_arraylike_call(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    text = expr_text(node.func)
+    return bool(re.search(r"(np|numpy|jnp)\.(as)?array|ones|zeros|arange",
+                          text))
+
+
+def _rule_jh003(ctx: ModuleContext,
+                jitted: Sequence[_JittedFn]) -> List[Finding]:
+    out: List[Finding] = []
+    static_fns: Dict[str, Tuple[_JittedFn, List[int]]] = {}
+    for jf in jitted:
+        params = _param_names(jf.fn)
+        idxs = [i for i, p in enumerate(params) if p in jf.static]
+        if not idxs:
+            continue
+        # defaults of static params that can never hash
+        defaults = jf.fn.args.defaults
+        offset = len(params) - len(defaults)
+        for i, d in enumerate(defaults):
+            pos = offset + i
+            if params[pos] in jf.static and (
+                    isinstance(d, _NONHASHABLE) or _is_arraylike_call(d)):
+                out.append(ctx.finding(
+                    "JH003", d,
+                    f"static arg {params[pos]!r} of jitted "
+                    f"{jf.fn.name!r} defaults to a non-hashable value — "
+                    "jit raises TypeError (or retraces per call); pass a "
+                    "tuple or hashable config object"))
+        static_fns[jf.fn.name] = (jf, idxs)
+    # wrapper-name call sites: g = jax.jit(f, static_argnums=...); g(...)
+    wrappers: Dict[str, Tuple[_JittedFn, List[int]]] = {}
+    for node in ctx.nodes:
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value) \
+                and node.value.args:
+            target = node.value.args[0]
+            if isinstance(target, ast.Name) and target.id in static_fns:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        wrappers[t.id] = static_fns[target.id]
+    callables = dict(wrappers)
+    for name, (jf, idxs) in static_fns.items():
+        if jf.jit_node in jf.fn.decorator_list or any(
+                jf.jit_node is d for d in jf.fn.decorator_list):
+            callables.setdefault(name, (jf, idxs))
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call) or not isinstance(node.func,
+                                                            ast.Name):
+            continue
+        entry = callables.get(node.func.id)
+        if entry is None or any(isinstance(a, ast.Starred)
+                                for a in node.args):
+            continue
+        jf, idxs = entry
+        for i in idxs:
+            if i < len(node.args):
+                arg = node.args[i]
+                if isinstance(arg, _NONHASHABLE) or _is_arraylike_call(arg):
+                    out.append(ctx.finding(
+                        "JH003", arg,
+                        f"non-hashable value passed for static arg "
+                        f"#{i} of jitted {jf.fn.name!r} — TypeError at "
+                        "call time (arrays: every call retraces)"))
+    return out
+
+
+# -- JH004 ----------------------------------------------------------------
+
+def _rule_jh004(ctx: ModuleContext,
+                jitted: Sequence[_JittedFn]) -> List[Finding]:
+    out: List[Finding] = []
+    module_globals = {t.id for node in ctx.tree.body
+                      if isinstance(node, ast.Assign)
+                      for t in node.targets if isinstance(t, ast.Name)}
+    for jf in jitted:
+        declared: Set[str] = set()
+        for node in ast.walk(jf.fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared.update(node.names)
+        for node in ast.walk(jf.fn):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                base = t
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    out.append(ctx.finding(
+                        "JH004", node,
+                        f"write to self.{base.attr} inside jitted "
+                        f"{jf.fn.name!r} — runs once at trace time, then "
+                        "the compiled program silently skips it"))
+                elif isinstance(base, ast.Name) and base.id in declared:
+                    out.append(ctx.finding(
+                        "JH004", node,
+                        f"write to global/nonlocal {base.id!r} inside "
+                        f"jitted {jf.fn.name!r} — trace-time side effect, "
+                        "not part of the compiled program"))
+                elif isinstance(t, ast.Subscript) and \
+                        isinstance(base, ast.Name) and \
+                        base.id in module_globals:
+                    out.append(ctx.finding(
+                        "JH004", node,
+                        f"subscript write to module global {base.id!r} "
+                        f"inside jitted {jf.fn.name!r} — trace-time side "
+                        "effect, not part of the compiled program"))
+    return out
+
+
+# -- JH005 ----------------------------------------------------------------
+
+def _rule_jh005(ctx: ModuleContext) -> List[Finding]:
+    """Within one function body: ``g = jax.jit(f, donate_argnums=...)``,
+    ``g(x, ...)``, then a later read of ``x`` — the buffer may already be
+    aliased into the output and deleted."""
+    out: List[Finding] = []
+    scopes = [n for n in ast.walk(ctx.tree)
+              if isinstance(n, ast.FunctionDef)] + [ctx.tree]
+    for scope in scopes:
+        body = getattr(scope, "body", [])
+        donating: Dict[str, List[int]] = {}
+        donated_at: Dict[str, int] = {}  # arg name -> lineno of dispatch
+        for stmt in body:
+            # reassignment of a previously-donated name clears the taint
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        donated_at.pop(t.id, None)
+                if _is_jit_call(stmt.value):
+                    kw = _jit_kwargs(stmt.value)
+                    nums = _const_int_collection(kw.get("donate_argnums"))
+                    if nums:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                donating[t.id] = nums
+                        continue
+            # reads of donated names anywhere in this statement
+            for node in walk_shallow(stmt):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in donated_at and \
+                        node.lineno > donated_at[node.id]:
+                    out.append(ctx.finding(
+                        "JH005", node,
+                        f"{node.id!r} was donated to a jitted call "
+                        f"(line {donated_at[node.id]}) and read "
+                        "afterwards — the buffer may be deleted; copy "
+                        "first or don't donate"))
+                    donated_at.pop(node.id, None)
+            # new dispatches through a donating wrapper
+            for node in walk_shallow(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id in donating and \
+                        not any(isinstance(a, ast.Starred)
+                                for a in node.args):
+                    for i in donating[node.func.id]:
+                        if i < len(node.args) and \
+                                isinstance(node.args[i], ast.Name):
+                            donated_at[node.args[i].id] = node.lineno
+    return out
+
+
+def run(ctx: ModuleContext) -> List[Finding]:
+    jitted = _collect_jitted(ctx)
+    out: List[Finding] = []
+    out.extend(_rule_jh001(ctx))
+    out.extend(_rule_jh002(ctx, jitted))
+    out.extend(_rule_jh003(ctx, jitted))
+    out.extend(_rule_jh004(ctx, jitted))
+    out.extend(_rule_jh005(ctx))
+    return out
