@@ -593,6 +593,18 @@ def bench_synlint():
         return -1, -1.0
 
 
+def _telemetry_snapshot():
+    """Compact runtime-telemetry snapshot for the committed JSON —
+    counters/gauges plus histogram summaries, no raw bucket arrays.
+    Never sinks the benchmark: any failure reports an error marker."""
+    try:
+        from synapseml_tpu.runtime import telemetry
+
+        return telemetry.snapshot(compact=True)
+    except Exception as e:  # noqa: BLE001 - the bench must survive
+        return {"error": repr(e)}
+
+
 def _with_retries(fn, attempts=3):
     """The tunneled device occasionally drops remote_compile connections;
     a transient failure must not zero out the recorded benchmark."""
@@ -770,10 +782,17 @@ def main():
         # some jit site regressed to annotating non-aliasable donations;
         # synlint_findings_total counts ALL static-analysis findings
         # (baselined included — docs/analysis.md) so hygiene drift in
-        # either direction shows up as a diffable number per round
+        # either direction shows up as a diffable number per round.
+        # "telemetry" embeds the full runtime-metrics snapshot of the
+        # run (runtime/telemetry.py, docs/observability.md): queue
+        # depths, per-stage latency histograms (count/sum/p50/p95/p99),
+        # AOT hit/miss, batch-size distribution — so every committed
+        # BENCH_r*.json carries the series the SLO scheduler work will
+        # regress against
         "detail": {"donated_buffers_not_usable_warnings": donation_warnings,
                    "synlint_findings_total": synlint_total,
-                   "synlint_runtime_s": round(synlint_s, 2)},
+                   "synlint_runtime_s": round(synlint_s, 2),
+                   "telemetry": _telemetry_snapshot()},
     }))
 
 

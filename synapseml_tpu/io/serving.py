@@ -30,6 +30,7 @@ import numpy as np
 
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
+from synapseml_tpu.runtime import telemetry as _tm
 
 _REGISTRY_LOCK = threading.Lock()
 
@@ -100,8 +101,13 @@ class _PendingReply:
 
 class CachedRequest:
     """(ref: HTTPSourceV2.scala CachedRequest). ``arrival`` (monotonic
-    enqueue time) anchors the deadline-based coalescing window."""
-    __slots__ = ("rid", "request", "epoch", "replied", "arrival")
+    enqueue time) anchors the deadline-based coalescing window and the
+    span's ``queue_wait`` stage; ``span`` is the request's telemetry
+    trace (a shared no-op when telemetry is disabled), ``drained`` the
+    moment a drain took it off the queue (stamped in
+    ``_record_epoch``)."""
+    __slots__ = ("rid", "request", "epoch", "replied", "arrival", "span",
+                 "drained")
 
     def __init__(self, rid: str, request: HTTPRequestData):
         self.rid = rid
@@ -109,6 +115,8 @@ class CachedRequest:
         self.epoch: Optional[int] = None
         self.replied = False
         self.arrival = time.monotonic()
+        self.span = _tm.start_span(rid)
+        self.drained = 0.0
 
 
 class WorkerServer:
@@ -142,6 +150,22 @@ class WorkerServer:
         self.history: Dict[int, List[CachedRequest]] = {}
         self.current_epoch = 0
         self._lock = threading.Lock()
+        # telemetry handles, resolved once per server (docs/
+        # observability.md catalogs the series); the queue-depth gauge
+        # samples qsize() at scrape time — nothing on the request path
+        self._m_requests = _tm.counter("serving_requests_total",
+                                       server=name)
+        self._m_batch_size = _tm.histogram(
+            "serving_batch_size", buckets=_tm.SIZE_BUCKETS, server=name)
+        self._m_queue_wait = _tm.histogram("serving_queue_wait_seconds",
+                                           server=name)
+        self._m_coalesce = _tm.histogram(
+            "serving_coalesce_delay_seconds", server=name)
+        self._m_roundtrip = _tm.histogram("serving_request_seconds",
+                                          server=name)
+        self._m_replies: Dict[int, _tm.Counter] = {}
+        _tm.gauge_fn("serving_queue_depth", self.requests.qsize,
+                     server=name)
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -163,10 +187,12 @@ class WorkerServer:
                     url=self.path, method=self.command,
                     headers=dict(self.headers.items()), entity=body)
                 rid = uuid.uuid4().hex
+                outer._m_requests.inc()
                 pending = _PendingReply()
                 with outer._lock:
                     outer.routing[rid] = pending
-                outer.requests.put(CachedRequest(rid, req))
+                cr = CachedRequest(rid, req)
+                outer.requests.put(cr)
                 pending.event.wait(outer.reply_timeout)
                 with outer._lock:
                     # claim-or-expire under the lock: if reply_to committed
@@ -175,8 +201,14 @@ class WorkerServer:
                     # reply_to returns False and the request stays replayable
                     outer.routing.pop(rid, None)
                     resp = pending.response
+                status = resp.status_code if resp is not None else 504
+                outer._reply_counter(status).inc()
+                outer._m_roundtrip.observe(time.monotonic() - cr.arrival)
                 if resp is None:
                     self.send_response(504)
+                    # the id still goes back: a timed-out client can ask
+                    # /span/<rid> where its request got stuck
+                    self.send_header("X-Request-Id", rid)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
@@ -185,6 +217,18 @@ class WorkerServer:
                 for k, v in resp.headers.items():
                     if k.lower() not in ("content-length", "date", "server"):
                         self.send_header(k, v)
+                # rid correlates the reply with its trace span (the
+                # telemetry e2e test asserts this header matches the
+                # span record)
+                self.send_header("X-Request-Id", rid)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_plain(self, status: int, body: bytes,
+                            content_type: str = "text/plain"):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -196,13 +240,26 @@ class WorkerServer:
                     # a replica that would park requests on a compiling
                     # (or not-yet-started) scoring query
                     if outer._ready.is_set():
-                        body, status = b"ok", 200
+                        self._send_plain(200, b"ok")
                     else:
-                        body, status = b"warming", 503
-                    self.send_response(status)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                        self._send_plain(503, b"warming")
+                    return
+                if self.path == "/metrics":
+                    # Prometheus scrape surface: the whole process-wide
+                    # registry (executor + serving + compile cache), off
+                    # the scoring pipeline entirely
+                    self._send_plain(
+                        200, _tm.prometheus_text().encode("utf-8"),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    return
+                if self.path.startswith("/span/"):
+                    span = _tm.get_span(self.path[len("/span/"):])
+                    if span is None:
+                        self._send_plain(404, b"no such span")
+                        return
+                    self._send_plain(
+                        200, json.dumps(span.breakdown()).encode("utf-8"),
+                        "application/json")
                     return
                 self._enqueue()
 
@@ -238,6 +295,15 @@ class WorkerServer:
         else:
             self._ready.clear()
 
+    def _reply_counter(self, status: int) -> "_tm.Counter":
+        """Per-status reply counter, registered on first use."""
+        c = self._m_replies.get(status)
+        if c is None:
+            c = self._m_replies.setdefault(status, _tm.counter(
+                "serving_replies_total", server=self.name,
+                code=str(status)))
+        return c
+
     # -- source side ----------------------------------------------------
     def get_batch(self, max_rows: int = 64, timeout: float = 0.1,
                   linger: float = 0.0,
@@ -254,8 +320,21 @@ class WorkerServer:
     def _record_epoch(self, out: List[CachedRequest]):
         """Stamp a batch with an epoch and park it in replay history —
         every consumption path (direct or via DistributedServer channels)
-        must pass through here or recover() cannot replay it."""
+        must pass through here or recover() cannot replay it. Also the
+        single choke point where batch-size / queue-wait / coalesce-delay
+        telemetry gets recorded (outside the lock)."""
         if out:
+            now = time.monotonic()
+            self._m_batch_size.observe(len(out))
+            # coalesce delay: how long the batch's HEAD request waited
+            # from arrival to being drained — the price the coalescing
+            # window charged (0 linger/coalesce => just scheduling lag)
+            self._m_coalesce.observe(now - out[0].arrival)
+            for cr in out:
+                wait = now - cr.arrival
+                cr.drained = now
+                self._m_queue_wait.observe(wait)
+                cr.span.note("queue_wait", wait)
             with self._lock:
                 epoch = self.current_epoch
                 self.current_epoch += 1
@@ -310,6 +389,9 @@ class WorkerServer:
         return True
 
     def stop(self):
+        # unhook the scrape-time sampler first: a scrape racing the
+        # shutdown must read 0, not call into a closed server
+        _tm.unregister("serving_queue_depth", server=self.name)
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -440,10 +522,26 @@ class DistributedServer:
                     f"attached; reuse that instance or pick another name")
             self.server._dist_owner = self  # synlint: shared
         self.channels = MultiChannelMap(n_channels)
+        self._n_channel_gauges = 0
+        self._sync_channel_gauges()
         self._stop = threading.Event()
         self._distributor = threading.Thread(
             target=self._distribute, name=f"dist-{name}", daemon=True)
         self._distributor.start()
+
+    def _sync_channel_gauges(self):
+        """One scrape-time depth sampler per live channel (re-synced on
+        elastic resize; samplers beyond the new count are dropped)."""
+        n = self.channels.n_channels
+        for i in range(n):
+            _tm.gauge_fn(
+                "serving_channel_depth",
+                lambda ch=i: self.channels.channel(ch).qsize(),
+                server=self.server.name, channel=str(i))
+        for i in range(n, self._n_channel_gauges):
+            _tm.unregister("serving_channel_depth",
+                           server=self.server.name, channel=str(i))
+        self._n_channel_gauges = n
 
     @property
     def url(self) -> str:
@@ -481,10 +579,15 @@ class DistributedServer:
 
     def update_n_channels(self, n: int):
         self.channels.update_n_channels(n)
+        self._sync_channel_gauges()
 
     def stop(self):
         self._stop.set()
         self._distributor.join(timeout=2)
+        for i in range(self._n_channel_gauges):
+            _tm.unregister("serving_channel_depth",
+                           server=self.server.name, channel=str(i))
+        self._n_channel_gauges = 0
         with self.server._lock:
             self.server._dist_owner = None
         HTTPSourceStateHolder.remove(self.server.name)
@@ -567,7 +670,7 @@ class ContinuousServer:
                  reply_col: str = "reply", reply_timeout: float = 60.0,
                  batch_linger: float = 0.0, pipelined: bool = True,
                  scoring_workers: int = 1, batch_coalesce: float = 0.0,
-                 ready: bool = True):
+                 ready: bool = True, max_errors: int = 256):
         """``batch_linger``: seconds to keep collecting after the first
         request of a batch arrives. A few ms turns concurrent clients'
         requests into ONE scored micro-batch (one device round trip
@@ -643,12 +746,30 @@ class ContinuousServer:
         self._reply_thread: Optional[threading.Thread] = None
         # appended from every scorer thread AND the reply thread; guarded
         # so concurrent failures can't lose entries (list.append happens
-        # to be GIL-atomic today, but the discipline is the contract)
+        # to be GIL-atomic today, but the discipline is the contract).
+        # BOUNDED: under sustained failure the list used to grow without
+        # limit — now the oldest entry is dropped past ``max_errors`` and
+        # the drop is counted (serving_errors_dropped_total), so a
+        # long-lived server keeps the *recent* errors and a flat memory
+        # profile
         self._err_lock = threading.Lock()
         self.errors: List[str] = []  # synlint: shared
+        self.max_errors = max(1, int(max_errors))
+        self.errors_dropped = 0  # synlint: shared
+        self._m_errors = _tm.counter("serving_errors_total", server=name)
+        self._m_err_dropped = _tm.counter("serving_errors_dropped_total",
+                                          server=name)
+        self._m_shed = _tm.counter("serving_shed_total", server=name)
+        self._m_score_s = _tm.histogram("serving_score_seconds",
+                                        server=name)
 
     def _record_error(self, exc: BaseException):
+        self._m_errors.inc()
         with self._err_lock:
+            if len(self.errors) >= self.max_errors:
+                del self.errors[0]
+                self.errors_dropped += 1
+                self._m_err_dropped.inc()
             self.errors.append(repr(exc))
 
     @property
@@ -657,7 +778,20 @@ class ContinuousServer:
 
     def _score_only(self, batch: List[CachedRequest]):
         """Stage 2 of the pipeline: score one micro-batch WITHOUT sending
-        replies. Returns ``(out_table, error)`` — exactly one is None."""
+        replies. Returns ``(out_table, error)`` — exactly one is None.
+
+        The batch's trace spans become the scorer thread's ambient span
+        context for the duration of ``pipeline_fn``: any
+        ``BatchedExecutor.submit`` the pipeline makes (ONNXModel et al.)
+        captures them, so the executor's stage/compute/drain stages land
+        on each request's span without any pipeline-fn API change."""
+        t0 = time.monotonic()
+        token = None
+        if _tm.enabled():
+            for cr in batch:
+                if cr.drained:
+                    cr.span.note("batch_form", t0 - cr.drained)
+            token = _tm.set_current_spans(cr.span for cr in batch)
         try:
             table = requests_to_table(batch)
             if self.parse_json:
@@ -666,6 +800,10 @@ class ContinuousServer:
         except Exception as e:  # noqa: BLE001 - serving loop must survive
             self._record_error(e)
             return None, e
+        finally:
+            if token is not None:
+                _tm.reset_current_spans(token)
+            self._m_score_s.observe(time.monotonic() - t0)
 
     def _reply_scored(self, batch: List[CachedRequest], out, err):
         """Stage 3: reply-send + exact epoch commits for one scored batch.
@@ -674,6 +812,7 @@ class ContinuousServer:
         exact commits, because concurrent workers finish epochs out of
         order and a cumulative commit of a later epoch would erase an
         earlier in-flight epoch's replay history."""
+        t0 = time.monotonic()
         try:
             if err is None:
                 try:
@@ -687,6 +826,10 @@ class ContinuousServer:
                     status_code=500, reason="pipeline error",
                     entity=repr(err).encode()))
         finally:
+            dt = time.monotonic() - t0
+            for cr in batch:
+                cr.span.note("reply", dt)
+                cr.span.finish("ok" if err is None else "error")
             for ep in sorted({cr.epoch for cr in batch}):
                 self.server.commit(ep, exact=True)
 
@@ -708,9 +851,11 @@ class ContinuousServer:
                     reason: str = "server stopping"):
         """Fast-fail a drained-but-unscored batch (shutdown path): the
         clients would otherwise block until reply_timeout."""
+        self._m_shed.inc(len(batch))
         for cr in batch:
             self.server.reply_to(cr.rid, HTTPResponseData(
                 status_code=status, reason=reason))
+            cr.span.finish("shed")
         for ep in sorted({cr.epoch for cr in batch}):
             self.server.commit(ep, exact=True)
 

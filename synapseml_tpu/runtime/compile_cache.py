@@ -36,9 +36,23 @@ import threading
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
+from synapseml_tpu.runtime import telemetry as _tm
+
 _ENV_KNOB = "SYNAPSEML_COMPILE_CACHE"
 _FORMAT_VERSION = 1
 _MAGIC = b"SMTXC1\n"
+
+# store traffic counters (docs/observability.md): hits split memo vs
+# disk; misses/skews/deserialize-failures are distinct — a volume full
+# of entries another runtime wrote looks like "misses" without the
+# skew/failure split, and that distinction is exactly what an operator
+# debugging a cold restart needs
+_M_HIT = _tm.counter("compile_cache_store_hits_total")
+_M_MISS = _tm.counter("compile_cache_store_misses_total")
+_M_SKEW = _tm.counter("compile_cache_store_skew_total")
+_M_DESER_FAIL = _tm.counter("compile_cache_deserialize_failures_total")
+_M_SAVE = _tm.counter("compile_cache_saves_total")
+_M_SAVE_FAIL = _tm.counter("compile_cache_save_failures_total")
 
 _STATE_LOCK = threading.Lock()
 _PERSISTENT_WIRED: Optional[str] = None
@@ -191,8 +205,10 @@ class ExecutableStore:
                 except OSError:
                     pass
                 raise
+            _M_SAVE.inc()
             return True
         except Exception:  # noqa: BLE001 - cache write is best-effort
+            _M_SAVE_FAIL.inc()
             return False
 
     def load(self, key: str) -> Optional[Any]:
@@ -200,11 +216,17 @@ class ExecutableStore:
             return None
         with self._lock:
             if key in self._memo:
+                _M_HIT.inc()
                 return self._memo[key]
         try:
             with open(self._path(key), "rb") as fh:
                 raw = fh.read()
+        except OSError:  # no such entry: the plain miss
+            _M_MISS.inc()
+            return None
+        try:
             if not raw.startswith(_MAGIC):
+                _M_DESER_FAIL.inc()  # truncated/foreign bytes
                 return None
             off = len(_MAGIC)
             mlen = int.from_bytes(raw[off:off + 4], "big")
@@ -212,19 +234,23 @@ class ExecutableStore:
             meta = json.loads(raw[off:off + mlen].decode())
             off += mlen
             if meta.get("v") != _FORMAT_VERSION:
+                _M_SKEW.inc()
                 return None
             if meta.get("env") != env_fingerprint():
                 # version/backend skew: the executable was built by a
                 # different runtime — unusable, compile fresh
+                _M_SKEW.inc()
                 return None
             from jax.experimental import serialize_executable as _se
 
             payload, in_tree, out_tree = pickle.loads(raw[off:])
             compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
         except Exception:  # noqa: BLE001 - any corruption = miss
+            _M_DESER_FAIL.inc()
             return None
         with self._lock:
             self._memo[key] = compiled
+        _M_HIT.inc()
         return compiled
 
     def invalidate(self):

@@ -42,6 +42,7 @@ import math
 import os
 import queue as _queue
 import threading
+import time
 import weakref
 from collections import deque
 from concurrent.futures import Future
@@ -53,6 +54,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from synapseml_tpu.runtime import compile_cache as _cc
+from synapseml_tpu.runtime import telemetry as _tm
+
+# module-level metric handles: resolved ONCE (the registry lookup takes
+# a lock; inc()/observe() on the handle is lock-free thread-striped —
+# see runtime/telemetry.py). Stage semantics for the async pipeline,
+# documented in docs/observability.md: "stage" is host coerce+pad wall
+# time, "dispatch" the host-side cost of starting H2D+compute,
+# "compute" dispatch-end -> drain-pickup (overlap-inclusive: the only
+# host-observable bound without a forbidden device sync on the hot
+# path), "drain" the blocking device_get.
+_M_SUBMIT = _tm.counter("executor_submit_total")
+_M_STAGE_S = _tm.histogram("executor_stage_seconds")
+_M_DISPATCH_S = _tm.histogram("executor_dispatch_seconds")
+_M_COMPUTE_S = _tm.histogram("executor_compute_seconds")
+_M_DRAIN_S = _tm.histogram("executor_drain_seconds")
+_M_AOT_HIT = _tm.counter("executor_aot_hits_total")
+_M_AOT_MISS = _tm.counter("executor_aot_misses_total")
+_M_AOT_RETIRED = _tm.counter("executor_aot_retired_total")
+_M_DONATE_FB = _tm.counter("executor_donation_fallback_total")
 
 
 def round_up_pow2(n: int, minimum: int = 8) -> int:
@@ -171,9 +191,11 @@ class _Unit:
     dispatches on device-side slices.
     """
 
-    __slots__ = ("stage", "futs", "staged", "error", "ready", "ex")
+    __slots__ = ("stage", "futs", "staged", "error", "ready", "ex",
+                 "spans")
 
-    def __init__(self, n_chunks: int):
+    def __init__(self, n_chunks: int,
+                 spans: Optional[Tuple["_tm.Span", ...]] = None):
         self.stage: Callable[[], List[tuple]] = None  # set by _plan
         self.futs = [Future() for _ in range(n_chunks)]
         self.staged: Optional[List[tuple]] = None
@@ -184,6 +206,10 @@ class _Unit:
         # ref is dropped as each stage finishes, so an IDLE executor is
         # still collectable (and its threads reaped via the finalizer).
         self.ex: Optional["BatchedExecutor"] = None
+        # trace spans of the requests riding this unit (captured from the
+        # submitting thread's ambient context — telemetry.current_spans);
+        # written ONLY here at construction, read by the pipeline threads
+        self.spans = spans
 
 
 class _PipelineState:
@@ -221,12 +247,19 @@ def _stage_worker(state: _PipelineState):
         if unit is _SHUTDOWN:
             state.stage_q.put(_SHUTDOWN)  # propagate to sibling workers
             return
+        t0 = time.monotonic()
         try:
-            unit.staged = unit.stage()
+            with _tm.trace_annotation("synapseml/executor/stage"):
+                unit.staged = unit.stage()
         except BaseException as e:  # noqa: BLE001 - delivered via futures
             unit.error = e
         finally:
             unit.stage = None  # drop array refs promptly
+            dt = time.monotonic() - t0
+            _M_STAGE_S.observe(dt)
+            if unit.spans:
+                for sp in unit.spans:
+                    sp.note("stage", dt)
             unit.ready.set()
 
 
@@ -246,20 +279,28 @@ def _dispatch_loop(state: _PipelineState):
             for (arrays, n, bucket, internal), fut in zip(
                     unit.staged, unit.futs):
                 state.depth_sem.acquire()
+                t0 = time.monotonic()
                 try:
                     # instance-attribute lookup: tests (and tracing
                     # wrappers) may patch ex._dispatch per instance
-                    out, n, bucket = (
-                        ex._dispatch(arrays, n, bucket, internal=True)
-                        if internal else
-                        ex._dispatch(arrays, n, bucket))
+                    with _tm.trace_annotation(
+                            "synapseml/executor/dispatch"):
+                        out, n, bucket = (
+                            ex._dispatch(arrays, n, bucket, internal=True)
+                            if internal else
+                            ex._dispatch(arrays, n, bucket))
                 except BaseException as e:  # noqa: BLE001
                     state.depth_sem.release()
                     fut.set_exception(e)
                     continue
+                t1 = time.monotonic()
+                _M_DISPATCH_S.observe(t1 - t0)
                 # the record carries the strong executor ref until the
-                # fetch resolves its future
-                state.inflight_q.put((out, n, bucket, fut, ex))
+                # fetch resolves its future (t1 lets the drain side
+                # derive the overlap-inclusive compute window without
+                # any device sync here)
+                state.inflight_q.put(
+                    (out, n, bucket, fut, ex, unit.spans, t1))
             del ex
         finally:
             unit.staged = None
@@ -273,18 +314,36 @@ def _drain_loop(state: _PipelineState):
         rec = state.inflight_q.get()
         if rec is _SHUTDOWN:
             return
-        out, n, bucket, fut, ex = rec
+        out, n, bucket, fut, ex, spans, t_disp = rec
         del rec
+        t0 = time.monotonic()
         try:
+            err: Optional[BaseException] = None
             try:
-                res = ex._fetch(out, n, bucket)
+                with _tm.trace_annotation("synapseml/executor/drain"):
+                    res = ex._fetch(out, n, bucket)
             except BaseException as e:  # noqa: BLE001
-                fut.set_exception(e)
+                err = e
+            t1 = time.monotonic()
+            # "compute": dispatch-end -> drain-pickup. Overlap-inclusive
+            # (in-flight queueing rides along) — the tightest bound a
+            # host can observe without a device sync on the hot path.
+            # Span notes land BEFORE the future resolves: resolving
+            # first would let the reply path finish() the span while
+            # these stages are still unrecorded
+            _M_COMPUTE_S.observe(t0 - t_disp)
+            _M_DRAIN_S.observe(t1 - t0)
+            if spans:
+                for sp in spans:
+                    sp.note("compute", t0 - t_disp)
+                    sp.note("drain", t1 - t0)
+            if err is not None:
+                fut.set_exception(err)
             else:
                 fut.set_result(res)
         finally:
             state.depth_sem.release()
-            del ex, out, fut
+            del ex, out, fut, spans
 
 
 def _shutdown_pipeline(state: _PipelineState):
@@ -303,6 +362,16 @@ def _shutdown_pipeline(state: _PipelineState):
 # exception" from the PJRT client destructor racing frozen daemon
 # threads). Drain every live pipeline while threading still works.
 _LIVE_PIPELINES: "weakref.WeakSet[_PipelineState]" = weakref.WeakSet()
+
+# pipeline-depth gauges, sampled at scrape time (never the hot path):
+# dispatched-but-unfetched batches and staged-but-undispatched units
+# across every live executor pipeline in the process
+_tm.gauge_fn(
+    "executor_inflight_batches",
+    lambda: sum(s.inflight_q.qsize() for s in list(_LIVE_PIPELINES)))
+_tm.gauge_fn(
+    "executor_staging_queue_depth",
+    lambda: sum(s.stage_q.qsize() for s in list(_LIVE_PIPELINES)))
 
 
 @atexit.register
@@ -481,6 +550,23 @@ class BatchedExecutor:
         # so access rides _tables_lock too
         self._aot: Dict[tuple, Any] = {}  # synlint: shared
         self._aot_hits = 0  # synlint: shared
+        # -- telemetry handles (resolved here, off the hot path) --------
+        # per-device dispatch counters: one series per target the
+        # dispatch thread can route a bucket to — rr/single layouts
+        # count per chip, a dp-sharded bucket counts ONCE under its
+        # mesh label, so the sum across series is always total batches
+        if devices is not None:
+            self._m_disp_rr = tuple(
+                _tm.counter("executor_dispatch_total", device=str(d.id))
+                for d in devices)
+            self._m_disp_one = _tm.counter(
+                "executor_dispatch_total", device=f"dp{len(devices)}")
+        else:
+            self._m_disp_rr = ()
+            self._m_disp_one = _tm.counter(
+                "executor_dispatch_total",
+                device=str(device.id) if device is not None else "default")
+        self._m_bucket: Dict[int, _tm.Counter] = {}
 
     @property
     def pipeline_depth(self) -> int:
@@ -558,6 +644,7 @@ class BatchedExecutor:
                 # warning spam in the bench tails — donation is an
                 # optimization, silence + correctness beat a blind bet
                 got = (False,) * len(sig)
+                _M_DONATE_FB.inc()
             # eval_shape ran OUTSIDE the lock (it traces self._fn);
             # setdefault keeps concurrent computers consistent — every
             # thread returns the first writer's mask
@@ -778,11 +865,12 @@ class BatchedExecutor:
                  min(bucket, sc_n - b), bucket, True)
                 for b in range(0, sc_n, bucket)]
 
-    def _plan(self, host_arrays, n: int, bucket: int) -> List[_Unit]:
+    def _plan(self, host_arrays, n: int, bucket: int,
+              spans: Optional[tuple] = None) -> List[_Unit]:
         """Split one logical call into ordered staging units."""
         if n == 0:
             # run one padded batch to learn output structure; slice to empty
-            unit = _Unit(1)
+            unit = _Unit(1, spans)
             unit.ex = self
             arrays = list(host_arrays)
             unit.stage = lambda: [(self._stage_host_chunk(arrays, 0, bucket),
@@ -795,14 +883,14 @@ class BatchedExecutor:
             sc_stop = min(sc_start + super_rows, n)
             sc_n = sc_stop - sc_start
             if tb == 1 or sc_n <= bucket:
-                unit = _Unit(1)
+                unit = _Unit(1, spans)
                 unit.stage = (
                     lambda s=sc_start, e=sc_stop, m=sc_n:
                     [(self._stage_host_chunk(
                         [a[s:e] for a in host_arrays], m, bucket),
                       m, bucket, False)])
             else:
-                unit = _Unit(-(-sc_n // bucket))
+                unit = _Unit(-(-sc_n // bucket), spans)
                 unit.stage = (
                     lambda s=sc_start, e=sc_stop:
                     self._stage_superchunk(host_arrays, s, e, bucket))
@@ -822,8 +910,12 @@ class BatchedExecutor:
         Staging reads the input arrays asynchronously: do not mutate
         them until the returned future resolves."""
         state = self._ensure_pipeline()
+        _M_SUBMIT.inc()
         n = len(host_arrays[0])
         bucket = self._bucket(max(n, 1))
+        # ambient trace spans (the serving scorer's micro-batch) ride the
+        # units so the pipeline threads can annotate per-request stages
+        spans = _tm.current_spans()
         if self._donate:
             # resolve the donate mask on the CALLER's thread (cached per
             # sig): the dispatch thread then only reads the cache — see
@@ -834,7 +926,7 @@ class BatchedExecutor:
                     self._donate_mask_for_sig(sig)
                 except Exception:  # noqa: BLE001 - best-effort prewarm
                     pass
-        units = self._plan(host_arrays, n, bucket)
+        units = self._plan(host_arrays, n, bucket, spans)
         futs: List[Future] = []
         for unit in units:
             # slot acquisition happens OUTSIDE the lock: a large
@@ -1026,6 +1118,7 @@ class BatchedExecutor:
         if layout == "shard":
             placement: Any = self._shard_data
             bound = self._bound
+            self._m_disp_one.inc()
         elif layout == "rr":
             with self._tables_lock:
                 rr_idx = self._rr_next % len(self._devices)
@@ -1033,9 +1126,16 @@ class BatchedExecutor:
             dev = self._devices[rr_idx]
             placement = dev
             bound = self._bound_for_device(dev)
+            self._m_disp_rr[rr_idx].inc()
         else:
             placement = self._device
             bound = self._bound
+            self._m_disp_one.inc()
+        mc = self._m_bucket.get(bucket)
+        if mc is None:  # first batch at this bucket: register the series
+            mc = self._m_bucket.setdefault(bucket, _tm.counter(
+                "executor_bucket_total", bucket=str(bucket)))
+        mc.inc()
         padded = []
         guard: List[int] = []  # external device arrays we did not copy
         for i, a in enumerate(arrays):
@@ -1070,6 +1170,7 @@ class BatchedExecutor:
                 out = compiled(*bound, *padded)
                 with self._tables_lock:
                     self._aot_hits += 1
+                _M_AOT_HIT.inc()
                 return out, n, bucket
             except Exception:  # noqa: BLE001 - degrade, never error
                 # aval/sharding drift, or a store-deserialized executable
@@ -1079,6 +1180,9 @@ class BatchedExecutor:
                 # genuine program error will re-raise from the jit call
                 with self._tables_lock:
                     self._aot.pop((sig, mask, layout, rr_idx), None)
+                _M_AOT_RETIRED.inc()
+        else:
+            _M_AOT_MISS.inc()
         out = self._jit_for(len(padded), mask)(*bound, *padded)
         return out, n, bucket
 

@@ -15,6 +15,8 @@ Three tiers:
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -22,11 +24,39 @@ import numpy as np
 
 from synapseml_tpu.data.table import Table
 
+# nesting-safe active-trace count: runtime/telemetry.py consults
+# trace_active() so the executor's pipeline-stage TraceAnnotations only
+# pay their cost while a profiler trace is actually recording
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_TRACES = 0
+
+
+def tracing_disabled() -> bool:
+    """``SYNAPSEML_TRACE=0`` is the kill switch: :func:`trace` and
+    :func:`annotate` degrade to no-ops (checked per call, so tests and
+    long-lived servers can flip the env var live)."""
+    return os.environ.get("SYNAPSEML_TRACE", "") == "0"
+
+
+def trace_active() -> bool:
+    """True while at least one :func:`trace` block is recording."""
+    return _ACTIVE_TRACES > 0
+
+
+def _trace_count(delta: int):
+    global _ACTIVE_TRACES
+    with _ACTIVE_LOCK:
+        _ACTIVE_TRACES = max(0, _ACTIVE_TRACES + delta)
+
 
 @contextlib.contextmanager
 def trace(log_dir: str, host_tracer_level: int = 2):
     """jax.profiler trace around a block; view in TensorBoard/XProf.
-    Degrades to a no-op where the profiler is unsupported."""
+    Degrades to a no-op where the profiler is unsupported, and honors
+    the ``SYNAPSEML_TRACE=0`` kill switch."""
+    if tracing_disabled():
+        yield
+        return
     import jax
 
     try:
@@ -44,10 +74,13 @@ def trace(log_dir: str, host_tracer_level: int = 2):
             started = True
         except Exception:  # noqa: BLE001
             started = False
+    if started:
+        _trace_count(+1)
     try:
         yield
     finally:
         if started:
+            _trace_count(-1)
             try:
                 jax.profiler.stop_trace()
             except Exception:  # noqa: BLE001
@@ -55,10 +88,17 @@ def trace(log_dir: str, host_tracer_level: int = 2):
 
 
 def annotate(name: str):
-    """Named region in the device trace (TraceAnnotation)."""
-    import jax
+    """Named region in the device trace (TraceAnnotation). A no-op
+    context when ``SYNAPSEML_TRACE=0`` or the profiler is unavailable —
+    annotation must never break (or slow) the annotated code."""
+    if tracing_disabled():
+        return contextlib.nullcontext()
+    try:
+        import jax
 
-    return jax.profiler.TraceAnnotation(name)
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 - degrade to no-op
+        return contextlib.nullcontext()
 
 
 def _sync():
@@ -75,34 +115,54 @@ def _sync():
 
 class StopWatch:
     """(ref: core/.../core/utils/StopWatch.scala) — accumulating timer with
-    optional device synchronization at measure boundaries."""
+    optional device synchronization at measure boundaries.
+
+    Thread-safe: the serving/executor pipeline threads time their stages
+    on shared instances now, so accumulation rides a lock and
+    :meth:`measure` keeps its start time on the *caller's* stack —
+    concurrent measures each contribute their full interval instead of
+    overwriting one shared ``_start`` slot (the historical lost-update).
+    ``start``/``stop`` keep the single-slot semantics for the sequential
+    callers that use them directly, just guarded."""
 
     def __init__(self, sync_device: bool = False):
         self.elapsed = 0.0
         self._start: Optional[float] = None
         self.sync_device = sync_device
+        self._lock = threading.Lock()
 
     def start(self):
         if self.sync_device:
             _sync()
-        self._start = time.perf_counter()
+        with self._lock:
+            self._start = time.perf_counter()
         return self
 
     def stop(self) -> float:
         if self.sync_device:
             _sync()
-        if self._start is not None:
-            self.elapsed += time.perf_counter() - self._start
-            self._start = None
-        return self.elapsed
+        with self._lock:
+            if self._start is not None:
+                self.elapsed += time.perf_counter() - self._start
+                self._start = None
+            return self.elapsed
+
+    def add(self, seconds: float) -> float:
+        with self._lock:
+            self.elapsed += seconds
+            return self.elapsed
 
     @contextlib.contextmanager
     def measure(self):
-        self.start()
+        if self.sync_device:
+            _sync()
+        t0 = time.perf_counter()
         try:
             yield self
         finally:
-            self.stop()
+            if self.sync_device:
+                _sync()
+            self.add(time.perf_counter() - t0)
 
 
 def stage_stats(pipeline_stages, table: Table,

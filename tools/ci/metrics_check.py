@@ -1,0 +1,142 @@
+"""Telemetry smoke: serve a real executor-backed pipeline, scrape
+``GET /metrics`` MID-RUN twice, and assert the core series are present,
+well-formed, and increasing. Driven by tools/ci/smoke_metrics.sh under a
+hard timeout (a wedged scrape or pipeline hangs rather than fails).
+
+Exit 0 = every assertion held; any failure prints the offending series
+and exits nonzero.
+"""
+import http.client
+import json
+import re
+import sys
+
+import numpy as np
+
+PROM_LINE = re.compile(
+    r"^(# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"[+-]?([0-9]*\.?[0-9]+([eE][+-]?[0-9]+)?|inf|nan))$")
+
+# one representative series per instrumented subsystem: executor
+# (pipeline stages + dispatch), serving (queue/batching/replies),
+# compile cache (registered at import — 0 until a store is configured),
+# and the span layer.
+CORE_SERIES = [
+    "synapseml_compile_cache_store_hits_total",
+    "synapseml_compile_cache_store_misses_total",
+    "synapseml_serving_requests_total",
+    "synapseml_serving_replies_total",
+    "synapseml_serving_batch_size",
+    "synapseml_serving_queue_wait_seconds",
+    "synapseml_serving_queue_depth",
+    "synapseml_serving_score_seconds",
+    "synapseml_executor_submit_total",
+    "synapseml_executor_dispatch_total",
+    "synapseml_executor_bucket_total",
+    "synapseml_executor_stage_seconds",
+    "synapseml_executor_compute_seconds",
+    "synapseml_executor_drain_seconds",
+    "synapseml_executor_inflight_batches",
+    "synapseml_request_stage_seconds",
+]
+
+INCREASING = [
+    "synapseml_serving_requests_total",
+    "synapseml_executor_submit_total",
+]
+
+
+def series_total(text: str, name: str) -> float:
+    """Sum every sample of one family (any label set)."""
+    total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(name) and not ln.startswith(name + "_"):
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def main() -> int:
+    from synapseml_tpu.io.serving import ContinuousServer, make_reply
+    from synapseml_tpu.runtime.executor import BatchedExecutor
+
+    ex = BatchedExecutor(lambda x: (x * 3.0 + 1.0,), min_bucket=8)
+
+    def pipeline(table):
+        feats = np.stack([np.asarray(v["x"], np.float32)
+                          for v in table["value"]])
+        (out,) = ex(feats)
+        replies = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            replies[i] = make_reply({"y": out[i].tolist()})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("metrics_smoke", pipeline, max_batch=16).start()
+    try:
+        host = cs.url.split("//")[1].rstrip("/")
+        conn = http.client.HTTPConnection(host, timeout=30)
+
+        def post():
+            conn.request("POST", "/", json.dumps({"x": [1.0, 2.0]}).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 200, (resp.status, body)
+            return resp
+
+        def scrape() -> str:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+            assert resp.status == 200, resp.status
+            ctype = resp.getheader("Content-Type", "")
+            assert ctype.startswith("text/plain"), ctype
+            return text
+
+        for _ in range(5):
+            post()
+        first = scrape()  # mid-run: the server keeps serving after this
+
+        bad = [ln for ln in first.rstrip("\n").splitlines()
+               if not PROM_LINE.match(ln)]
+        if bad:
+            print("malformed exposition lines:", *bad[:5], sep="\n  ")
+            return 1
+        missing = [s for s in CORE_SERIES if s not in first]
+        if missing:
+            print("missing core series:", *missing, sep="\n  ")
+            return 1
+
+        rid = post().getheader("X-Request-Id")
+        for _ in range(4):
+            post()
+        second = scrape()
+        for name in INCREASING:
+            v1, v2 = series_total(first, name), series_total(second, name)
+            if not v2 > v1:
+                print(f"series {name} did not increase: {v1} -> {v2}")
+                return 1
+
+        # the span surface answers for a real completed request
+        conn.request("GET", f"/span/{rid}")
+        resp = conn.getresponse()
+        span = json.loads(resp.read())
+        assert resp.status == 200, resp.status
+        stages = set(span["stages"])
+        need = {"queue_wait", "batch_form", "stage", "compute", "drain"}
+        if not need <= stages:
+            print(f"span {rid} missing stages: {sorted(need - stages)}")
+            return 1
+
+        print("metrics smoke ok:",
+              f"{len(first.splitlines())} exposition lines,",
+              "requests="
+              f"{series_total(second, 'synapseml_serving_requests_total'):.0f},",
+              f"span stages={sorted(stages)}")
+        return 0
+    finally:
+        cs.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

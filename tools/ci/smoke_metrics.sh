@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Telemetry scrape smoke (docs/observability.md).
+#
+# Serves a real BatchedExecutor-backed ContinuousServer, scrapes
+# GET /metrics MID-RUN twice, and asserts the core executor/serving/span
+# series are present, well-formed Prometheus text, and increasing — then
+# fetches the span breakdown for a completed request id. A wedged
+# pipeline or scrape HANGS rather than fails, so the hard wall-clock
+# timeout turns it into a fast red X (exit 124) instead of a stuck job.
+#
+# Usage: tools/ci/smoke_metrics.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+exec timeout -k 10 "${SMOKE_TIMEOUT:-180}" \
+  python tools/ci/metrics_check.py
