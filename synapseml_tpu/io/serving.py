@@ -17,22 +17,42 @@ drives source -> pipeline -> sink in a loop thread (the serving query).
 """
 from __future__ import annotations
 
+import errno
 import http.server
 import json
 import queue
+import random
 import socket
 import threading
 import time
 import uuid
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
+from synapseml_tpu.runtime import faults as _flt
 from synapseml_tpu.runtime import telemetry as _tm
+from synapseml_tpu.runtime.faults import PipelineBrokenError
 
 _REGISTRY_LOCK = threading.Lock()
+
+# fault-injection points (runtime/faults.py, docs/robustness.md) —
+# resolved once at import; fire() is a single attribute test when no
+# fault is armed. Unlike the executor's kill points (which fire with a
+# unit in hand, because the supervision registry fails its futures),
+# every serving thread_kill fires at the loop top BEFORE the blocking
+# get: a dying serving thread must never take a request batch with it —
+# there is no failure channel for an in-hand batch except the client's
+# reply_timeout.
+_F_REPLY = _flt.point("reply")
+_F_LAT_SCORE = _flt.point("latency", "score")
+_F_KILL_SCORER = _flt.point("thread_kill", "scorer")
+_F_KILL_REPLY = _flt.point("thread_kill", "reply")
+_F_KILL_COLLECT = _flt.point("thread_kill", "collector")
+_F_KILL_DIST = _flt.point("thread_kill", "distributor")
 
 
 def _drain_queue(q: "queue.Queue", max_rows: int,
@@ -80,7 +100,13 @@ def _drain_queue(q: "queue.Queue", max_rows: int,
 
 
 def find_open_port(base: int = 12400, host: str = "127.0.0.1") -> int:
-    """Ascending port search (ref: TrainUtils.findOpenPort:193-220)."""
+    """Ascending port search (ref: TrainUtils.findOpenPort:193-220).
+
+    Inherently TOCTOU — the port is free when probed, not when the
+    caller binds it. :class:`WorkerServer` therefore retries the bind
+    itself on the next ports (``port_attempts``) instead of trusting a
+    probe; keep this helper for non-HTTP uses (e.g. distributed
+    coordinator ports) where the consumer cannot retry."""
     for port in range(base, base + 1000):
         with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
             try:
@@ -89,6 +115,27 @@ def find_open_port(base: int = 12400, host: str = "127.0.0.1") -> int:
             except OSError:
                 continue
     raise OSError(f"no open port in [{base}, {base + 1000})")
+
+
+def _supervise_loop(fn: Callable[[], Any], stop: threading.Event,
+                    on_restart: Callable[[BaseException], None]):
+    """The supervision boundary every serving-stage thread body runs
+    under: an exception escaping ``fn`` (injected kill, bug) used to
+    kill the thread silently — every subsequent request then parked
+    until its reply_timeout. Instead ``on_restart`` records/counts the
+    death and the loop RESTARTS ``fn``. Exits only when ``fn`` returns
+    cleanly (stop requested) or the death raced ``stop``."""
+    while True:
+        try:
+            fn()
+            return
+        except BaseException as e:  # noqa: BLE001 - supervision boundary
+            if stop.is_set():
+                return
+            on_restart(e)
+            # tiny pause: a persistent crash (e.g. prob-1.0 injected
+            # kill) degrades to a slow restart loop, not a hot spin
+            time.sleep(0.01)
 
 
 class _PendingReply:
@@ -105,11 +152,16 @@ class CachedRequest:
     span's ``queue_wait`` stage; ``span`` is the request's telemetry
     trace (a shared no-op when telemetry is disabled), ``drained`` the
     moment a drain took it off the queue (stamped in
-    ``_record_epoch``)."""
+    ``_record_epoch``).
+    ``deadline`` is the absolute monotonic instant the client stops
+    caring (``X-Deadline-Ms`` header or the server default; None = no
+    deadline) — a request already past it at batch-form time is shed
+    504 before any scoring work is wasted."""
     __slots__ = ("rid", "request", "epoch", "replied", "arrival", "span",
-                 "drained")
+                 "drained", "deadline")
 
-    def __init__(self, rid: str, request: HTTPRequestData):
+    def __init__(self, rid: str, request: HTTPRequestData,
+                 deadline_ms: Optional[float] = None):
         self.rid = rid
         self.request = request
         self.epoch: Optional[int] = None
@@ -117,6 +169,8 @@ class CachedRequest:
         self.arrival = time.monotonic()
         self.span = _tm.start_span(rid)
         self.drained = 0.0
+        self.deadline = (None if not deadline_ms
+                         else self.arrival + deadline_ms / 1e3)
 
 
 class WorkerServer:
@@ -131,9 +185,24 @@ class WorkerServer:
 
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: Optional[int] = None, api_path: str = "/",
-                 reply_timeout: float = 60.0, ready: bool = True):
+                 reply_timeout: float = 60.0, ready: bool = True,
+                 default_deadline_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 port_attempts: int = 32):
+        """``default_deadline_ms``: per-request deadline applied when the
+        client sends no ``X-Deadline-Ms`` header (None/0 = none).
+        ``max_queue``: admission control — a request arriving while that
+        many are already queued is shed 429 at enqueue instead of
+        parking a connection it will likely time out on (None =
+        unbounded). ``port_attempts``: how many successive ports to try
+        when an explicit ``port`` is already bound — the bind itself
+        retries, closing the probe-then-bind TOCTOU race two
+        concurrently constructed servers used to crash on (read the
+        actual port back from ``self.port``)."""
         self.name = name
         self.host = host
+        self.default_deadline_ms = default_deadline_ms  # synlint: shared
+        self.max_queue = max_queue  # synlint: shared
         # readiness gate: /health answers 503 until set_ready(True) —
         # a k8s replica that is still AOT-warming its compile cache must
         # not receive traffic (the serving entry's --warmup flow)
@@ -163,6 +232,10 @@ class WorkerServer:
             "serving_coalesce_delay_seconds", server=name)
         self._m_roundtrip = _tm.histogram("serving_request_seconds",
                                           server=name)
+        self._m_reply_timeout = _tm.counter("serving_reply_timeout_total",
+                                            server=name)
+        self._m_queue_shed = _tm.counter("serving_queue_shed_total",
+                                         server=name)
         self._m_replies: Dict[int, _tm.Counter] = {}
         _tm.gauge_fn("serving_queue_depth", self.requests.qsize,
                      server=name)
@@ -188,10 +261,26 @@ class WorkerServer:
                     headers=dict(self.headers.items()), entity=body)
                 rid = uuid.uuid4().hex
                 outer._m_requests.inc()
+                if (outer.max_queue is not None
+                        and outer.requests.qsize() >= outer.max_queue):
+                    # admission control: shed at enqueue with 429 — a
+                    # request this far over capacity would only park a
+                    # connection it will likely 504 on anyway
+                    outer._m_queue_shed.inc()
+                    outer._reply_counter(429).inc()
+                    self._send_plain(429, b"request queue full")
+                    return
+                deadline_ms = outer.default_deadline_ms
+                hdr = self.headers.get("X-Deadline-Ms")
+                if hdr:
+                    try:
+                        deadline_ms = float(hdr)
+                    except ValueError:
+                        pass  # malformed header: keep the server default
                 pending = _PendingReply()
                 with outer._lock:
                     outer.routing[rid] = pending
-                cr = CachedRequest(rid, req)
+                cr = CachedRequest(rid, req, deadline_ms)
                 outer.requests.put(cr)
                 pending.event.wait(outer.reply_timeout)
                 with outer._lock:
@@ -205,6 +294,9 @@ class WorkerServer:
                 outer._reply_counter(status).inc()
                 outer._m_roundtrip.observe(time.monotonic() - cr.arrival)
                 if resp is None:
+                    # the wait expired with no response set: an explicit
+                    # 504, never a silent empty wait-out
+                    outer._m_reply_timeout.inc()
                     self.send_response(504)
                     # the id still goes back: a timed-out client can ask
                     # /span/<rid> where its request got stuck
@@ -271,7 +363,35 @@ class WorkerServer:
             # client bursts — the whole point of micro-batch serving
             request_queue_size = 128
 
-        self._httpd = Server((host, self.port), Handler)
+        # bind-with-next-port retry: an explicit port may have been
+        # probed free (find_open_port) and grabbed since — the TOCTOU
+        # window closes by retrying the BIND, not re-probing. port=0
+        # stays single-shot (the OS assigns race-free).
+        last_err: Optional[OSError] = None
+        for attempt in range(max(1, port_attempts) if self.port else 1):
+            try:
+                self._httpd = Server((host, self.port + attempt), Handler)
+                last_err = None
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE:
+                    # only in-use is the TOCTOU race; EACCES/
+                    # EADDRNOTAVAIL etc. would either silently serve a
+                    # port nobody is pointing at or retry futilely
+                    raise
+                last_err = e
+        if last_err is not None:
+            raise last_err
+        if self.port and self._httpd.server_address[1] != self.port:
+            # drift must be LOUD: a fixed-port deployment (k8s Service
+            # targetPort, a peer holding a pre-advertised probe result)
+            # routes to the REQUESTED port — only callers that read
+            # server.port back can follow the retry
+            warnings.warn(
+                f"WorkerServer {name!r}: requested port {self.port} in "
+                f"use; bound {self._httpd.server_address[1]} instead — "
+                "fixed-port consumers must read server.port back",
+                RuntimeWarning, stacklevel=2)
         self.port = self._httpd.server_address[1]
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
@@ -525,8 +645,12 @@ class DistributedServer:
         self._n_channel_gauges = 0
         self._sync_channel_gauges()
         self._stop = threading.Event()
+        self._m_dist_restarts = _tm.counter(
+            "serving_thread_restarts_total", server=name,
+            thread="distributor")
         self._distributor = threading.Thread(
-            target=self._distribute, name=f"dist-{name}", daemon=True)
+            target=self._distribute_supervised, name=f"dist-{name}",
+            daemon=True)
         self._distributor.start()
 
     def _sync_channel_gauges(self):
@@ -547,8 +671,17 @@ class DistributedServer:
     def url(self) -> str:
         return self.server.url
 
+    def _distribute_supervised(self):
+        """:func:`_supervise_loop` around :meth:`_distribute`: an
+        exception there used to silently stop ALL traffic."""
+        _supervise_loop(self._distribute, self._stop,
+                        lambda e: self._m_dist_restarts.inc())
+
     def _distribute(self):
         while not self._stop.is_set():
+            # kill point BEFORE the get: a dying distributor must never
+            # take an already-dequeued request with it
+            _F_KILL_DIST.fire()
             try:
                 item = self.server.requests.get(timeout=0.05)
             except queue.Empty:
@@ -670,7 +803,11 @@ class ContinuousServer:
                  reply_col: str = "reply", reply_timeout: float = 60.0,
                  batch_linger: float = 0.0, pipelined: bool = True,
                  scoring_workers: int = 1, batch_coalesce: float = 0.0,
-                 ready: bool = True, max_errors: int = 256):
+                 ready: bool = True, max_errors: int = 256,
+                 deadline_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 retry_transient: int = 1,
+                 retry_backoff: float = 0.05):
         """``batch_linger``: seconds to keep collecting after the first
         request of a batch arrives. A few ms turns concurrent clients'
         requests into ONE scored micro-batch (one device round trip
@@ -720,14 +857,29 @@ class ContinuousServer:
         ``ready=False`` starts the embedded server with its /health
         readiness gate CLOSED (503): the caller warms the compile cache
         first, then flips ``self.server.set_ready(True)`` — so traffic
-        never lands on a compiling chip (the ``main()`` --warmup flow)."""
+        never lands on a compiling chip (the ``main()`` --warmup flow).
+
+        Robustness knobs (docs/robustness.md): ``deadline_ms`` is the
+        default per-request deadline (clients override per request via
+        the ``X-Deadline-Ms`` header); a request already expired at
+        batch-form time is shed 504 BEFORE scoring. ``max_queue`` sheds
+        429 at enqueue past that backlog. ``retry_transient`` bounds
+        how many times a :class:`PipelineBrokenError` from the scoring
+        pipeline is retried (with ``retry_backoff``-scaled jittered
+        sleep) against the supervision-restarted executor pipeline
+        before the batch takes the 500 path."""
         self.server = HTTPSourceStateHolder.get_or_create_server(
-            name, host, port, reply_timeout=reply_timeout, ready=ready)
+            name, host, port, reply_timeout=reply_timeout, ready=ready,
+            default_deadline_ms=deadline_ms, max_queue=max_queue)
         if not ready:
             # the registry may have returned an EXISTING server (ctor
             # kwargs ignored): close the gate explicitly so a reused name
             # still holds /health at 503 through warmup
             self.server.set_ready(False)
+        if deadline_ms is not None:
+            self.server.default_deadline_ms = deadline_ms
+        if max_queue is not None:
+            self.server.max_queue = max_queue
         self.name = name
         self.pipeline_fn = pipeline_fn
         self.max_batch = max_batch
@@ -762,6 +914,18 @@ class ContinuousServer:
         self._m_shed = _tm.counter("serving_shed_total", server=name)
         self._m_score_s = _tm.histogram("serving_score_seconds",
                                         server=name)
+        self.retry_transient = max(0, int(retry_transient))
+        self.retry_backoff = float(retry_backoff)
+        self._m_deadline_shed = _tm.counter("serving_deadline_shed_total",
+                                            server=name)
+        self._m_retry = _tm.counter("serving_retry_total", server=name)
+        self._m_bisect = _tm.counter("serving_poison_bisect_total",
+                                     server=name)
+        self._m_poison = _tm.counter("serving_poison_requests_total",
+                                     server=name)
+        # per-thread restart counters (supervision), registered lazily
+        # like the per-status reply counters
+        self._m_restarts: Dict[str, _tm.Counter] = {}
 
     def _record_error(self, exc: BaseException):
         self._m_errors.inc()
@@ -771,6 +935,25 @@ class ContinuousServer:
                 self.errors_dropped += 1
                 self._m_err_dropped.inc()
             self.errors.append(repr(exc))
+
+    def _restart_counter(self, thread: str) -> "_tm.Counter":
+        c = self._m_restarts.get(thread)
+        if c is None:
+            c = self._m_restarts.setdefault(thread, _tm.counter(
+                "serving_thread_restarts_total", server=self.name,
+                thread=thread))
+        return c
+
+    def _supervised(self, thread: str, fn: Callable, *args):
+        """:func:`_supervise_loop` around one serving-stage loop: a
+        dead scorer/reply/collector thread is recorded, counted, and
+        restarted — never a silently wedged stage."""
+
+        def on_restart(e: BaseException):
+            self._record_error(e)
+            self._restart_counter(thread).inc()
+
+        _supervise_loop(lambda: fn(*args), self._stop, on_restart)
 
     @property
     def url(self) -> str:
@@ -793,6 +976,7 @@ class ContinuousServer:
                     cr.span.note("batch_form", t0 - cr.drained)
             token = _tm.set_current_spans(cr.span for cr in batch)
         try:
+            _F_LAT_SCORE.fire()
             table = requests_to_table(batch)
             if self.parse_json:
                 table = parse_request(table)
@@ -805,41 +989,151 @@ class ContinuousServer:
                 _tm.reset_current_spans(token)
             self._m_score_s.observe(time.monotonic() - t0)
 
-    def _reply_scored(self, batch: List[CachedRequest], out, err):
+    def _reply_scored(self, batch: List[CachedRequest], out, err,
+                      err_status: int = 500,
+                      commit_epochs: Optional[List[int]] = None):
         """Stage 3: reply-send + exact epoch commits for one scored batch.
         A pipelined batch may merge several drain epochs (each already
         recorded for replay), so every distinct epoch is committed —
         exact commits, because concurrent workers finish epochs out of
         order and a cumulative commit of a later epoch would erase an
-        earlier in-flight epoch's replay history."""
+        earlier in-flight epoch's replay history. ``err_status`` is the
+        reply code for a failed batch: 500 for pipeline errors, 400 for
+        a poison request the bisection isolated. ``commit_epochs``
+        overrides WHICH epochs commit (``()`` = none): bisection
+        segments of one batch share epochs, so only the last segment
+        commits them — committing per segment would prune replay
+        history for requests still unreplied in sibling segments."""
         t0 = time.monotonic()
         try:
             if err is None:
                 try:
+                    _F_REPLY.fire()
                     send_replies(self.server, out, self.reply_col)
                     return
                 except Exception as e:  # noqa: BLE001 - bad reply col etc.
                     self._record_error(e)
                     err = e
+                    err_status = 500
             for cr in batch:
                 self.server.reply_to(cr.rid, HTTPResponseData(
-                    status_code=500, reason="pipeline error",
+                    status_code=err_status,
+                    reason=("bad request" if err_status == 400
+                            else "pipeline error"),
                     entity=repr(err).encode()))
         finally:
             dt = time.monotonic() - t0
             for cr in batch:
                 cr.span.note("reply", dt)
                 cr.span.finish("ok" if err is None else "error")
-            for ep in sorted({cr.epoch for cr in batch}):
+            eps = (sorted({cr.epoch for cr in batch})
+                   if commit_epochs is None else commit_epochs)
+            for ep in eps:
                 self.server.commit(ep, exact=True)
+
+    def _shed_expired(self, batch: List[CachedRequest]
+                      ) -> List[CachedRequest]:
+        """Wasted-work elimination at batch-form time: a request whose
+        deadline already passed gets 504 NOW — scoring it would burn
+        device time on an answer nobody is waiting for. Returns the
+        still-live remainder; epochs only covered by shed requests are
+        committed here (shed requests are replied, so they are not
+        replayable either way)."""
+        now = time.monotonic()
+        live: List[CachedRequest] = []
+        expired: List[CachedRequest] = []
+        for cr in batch:
+            (expired if cr.deadline is not None and cr.deadline <= now
+             else live).append(cr)
+        if expired:
+            self._m_deadline_shed.inc(len(expired))
+            for cr in expired:
+                self.server.reply_to(cr.rid, HTTPResponseData(
+                    status_code=504, reason="deadline exceeded before "
+                    "scoring"))
+                cr.span.finish("shed")
+            live_eps = {cr.epoch for cr in live}
+            for ep in sorted({cr.epoch for cr in expired} - live_eps):
+                self.server.commit(ep, exact=True)
+        return live
+
+    def _bisect_score(self, batch: List[CachedRequest]):
+        """Poison isolation: recursively re-score halves (log2 n levels)
+        until the failing request(s) are singletons. Healthy halves
+        reply 200 with their real scores; an isolated poison request
+        replies 400 — one bad payload no longer fails its neighbors."""
+        out, err = self._score_only(batch)
+        if err is None:
+            return [(batch, out, None, 200)]
+        if isinstance(err, PipelineBrokenError):
+            # the pipeline died MID-bisection: that is transient
+            # infrastructure failure, not a poison payload — 500, never
+            # a client-blaming 400, and stop burning re-scores against
+            # a dead pipeline
+            return [(batch, None, err, 500)]
+        if len(batch) == 1:
+            # confirm before blaming the client: under probabilistic
+            # faults (chaos) a TRANSIENT failure can land on a healthy
+            # singleton's re-score — one more score must fail too
+            # before this counts as poison; a flake scores 200
+            out, err2 = self._score_only(batch)
+            if err2 is None:
+                return [(batch, out, None, 200)]
+            if isinstance(err2, PipelineBrokenError):
+                return [(batch, None, err2, 500)]
+            self._m_poison.inc()
+            return [(batch, None, err2, 400)]
+        mid = len(batch) // 2
+        return (self._bisect_score(batch[:mid])
+                + self._bisect_score(batch[mid:]))
+
+    def _score_resilient(self, batch: List[CachedRequest]):
+        """Score one micro-batch through the full degradation ladder:
+        (1) a transient :class:`PipelineBrokenError` (an executor
+        pipeline thread died; supervision restarts it) gets
+        ``retry_transient`` bounded retries with jittered backoff;
+        (2) any other error on a batch of n>1 is bisected to isolate
+        the poison request(s); (3) what remains fails with its status.
+        Returns ``[(sub_batch, out, err, err_status, commit_epochs),
+        ...]`` segments ready for :meth:`_reply_scored` — only the LAST
+        segment carries the batch's epochs to commit, so an epoch's
+        replay history is never pruned while sibling segments are still
+        unreplied (segments reply in order on one thread)."""
+        segments = self._score_segments(batch)
+        eps = sorted({cr.epoch for cr in batch})
+        return [(b, o, e, st, eps if i == len(segments) - 1 else ())
+                for i, (b, o, e, st) in enumerate(segments)]
+
+    def _score_segments(self, batch: List[CachedRequest]):
+        out, err = self._score_only(batch)
+        for _ in range(self.retry_transient):
+            if not isinstance(err, PipelineBrokenError):
+                break
+            self._m_retry.inc()
+            time.sleep(self.retry_backoff * (0.5 + random.random()))
+            out, err = self._score_only(batch)
+        if err is None:
+            return [(batch, out, None, 200)]
+        if isinstance(err, PipelineBrokenError) or len(batch) == 1:
+            # still-broken pipeline fails the whole batch (bisecting
+            # would just re-fail against the same dead pipeline)
+            return [(batch, None, err, 500)]
+        self._m_bisect.inc()
+        mid = len(batch) // 2
+        return (self._bisect_score(batch[:mid])
+                + self._bisect_score(batch[mid:]))
 
     def _score_batch(self, batch: List[CachedRequest]):
         """Score + reply inline (the strictly serial path)."""
-        out, err = self._score_only(batch)
-        self._reply_scored(batch, out, err)
+        batch = self._shed_expired(batch)
+        if not batch:
+            return
+        for seg in self._score_resilient(batch):
+            self._reply_scored(*seg)
 
     def _loop(self):
         while not self._stop.is_set():
+            _F_KILL_SCORER.fire()
             batch = self.server.get_batch(self.max_batch, timeout=0.05,
                                           linger=self.batch_linger,
                                           coalesce=self.batch_coalesce)
@@ -865,6 +1159,7 @@ class ContinuousServer:
         extra coalescing time — the linger adapts to the service rate
         instead of being a fixed prepaid delay."""
         while not self._stop.is_set():
+            _F_KILL_COLLECT.fire()
             batch = self.server.get_batch(self.max_batch, timeout=0.05,
                                           linger=self.batch_linger,
                                           coalesce=self.batch_coalesce)
@@ -890,19 +1185,25 @@ class ContinuousServer:
         thread — the scorer starts on batch k+1 while batch k's replies
         serialize and its epochs commit on the reply thread."""
         while not self._stop.is_set():
+            _F_KILL_SCORER.fire()
             try:
                 batch = handoff.get(timeout=0.05)
             except queue.Empty:
                 continue
-            out, err = self._score_only(batch)
+            batch = self._shed_expired(batch)
+            if not batch:
+                continue
+            segments = self._score_resilient(batch)
             rq = self._reply_q
             if rq is None or self._stop.is_set():
                 # reply stage not running — or stop() raced a long score
                 # and the reply thread may already have exited: reply
                 # inline so the batch's clients never hang
-                self._reply_scored(batch, out, err)
+                for seg in segments:
+                    self._reply_scored(*seg)
                 continue
-            rq.put((batch, out, err))
+            for seg in segments:
+                rq.put(seg)
             if self._stop.is_set():
                 # stop() landed between the check and the put — the
                 # reply thread may have seen an empty queue and exited
@@ -921,6 +1222,7 @@ class ContinuousServer:
         """Stage 3: reply-send + commits off the scoring threads. Exits
         only once stopped AND drained, so scored batches always reply."""
         while True:
+            _F_KILL_REPLY.fire()
             try:
                 item = self._reply_q.get(timeout=0.05)
             except queue.Empty:
@@ -936,26 +1238,32 @@ class ContinuousServer:
         # bounded: a stalled reply sink backpressures scoring instead of
         # queueing scored-but-unreplied batches without limit
         self._reply_q = queue.Queue(maxsize=max(2, 2 * self.scoring_workers))
+        # every stage thread runs under _supervised: a dead scorer/
+        # reply/collector thread restarts (counted) instead of silently
+        # wedging its stage of the pipeline
         self._reply_thread = threading.Thread(
-            target=self._reply_loop, name=f"serving-reply-{self.name}",
-            daemon=True)
+            target=self._supervised, args=("reply", self._reply_loop),
+            name=f"serving-reply-{self.name}", daemon=True)
         self._reply_thread.start()
         self._collector = threading.Thread(
-            target=self._collect_loop, args=(handoff,),
+            target=self._supervised,
+            args=("collector", self._collect_loop, handoff),
             name=f"serving-collect-{self.name}", daemon=True)
         self._collector.start()
         for i in range(self.scoring_workers - 1):
-            t = threading.Thread(target=self._score_loop, args=(handoff,),
-                                 name=f"serving-score-{self.name}-{i + 1}",
-                                 daemon=True)
+            t = threading.Thread(
+                target=self._supervised,
+                args=("scorer", self._score_loop, handoff),
+                name=f"serving-score-{self.name}-{i + 1}", daemon=True)
             t.start()
             self._extra_scorers.append(t)
-        self._score_loop(handoff)
+        self._supervised("scorer", self._score_loop, handoff)
 
     def start(self) -> "ContinuousServer":
+        target = (self._pipelined_loop if self.pipelined
+                  else lambda: self._supervised("scorer", self._loop))
         self._thread = threading.Thread(
-            target=self._pipelined_loop if self.pipelined else self._loop,
-            name=f"serving-query-{self.name}", daemon=True)
+            target=target, name=f"serving-query-{self.name}", daemon=True)
         self._thread.start()
         return self
 
@@ -1047,6 +1355,15 @@ def main(argv=None):
     ap.add_argument("--coalesce-ms", type=float, default=float(os.environ.get(
         "SYNAPSEML_COALESCE_MS", "0")),
         help="deadline-based batching window in ms (0 = off)")
+    ap.add_argument("--deadline-ms", type=float, default=float(os.environ.get(
+        "SYNAPSEML_DEADLINE_MS", "0")),
+        help="default per-request deadline in ms (clients override via "
+             "the X-Deadline-Ms header); a request already expired at "
+             "batch-form time is shed 504 before scoring. 0 = none")
+    ap.add_argument("--max-queue", type=int, default=int(os.environ.get(
+        "SYNAPSEML_MAX_QUEUE", "0")),
+        help="admission control: shed requests 429 at enqueue once this "
+             "many are already queued (0 = unbounded)")
     ap.add_argument("--cache-dir", default=os.environ.get(
         "SYNAPSEML_COMPILE_CACHE") or None,
         help="persistent compile-cache directory (mount a volume here so "
@@ -1102,6 +1419,8 @@ def main(argv=None):
     cs = ContinuousServer(args.name, pipeline, host=args.host,
                           port=args.port,
                           batch_coalesce=args.coalesce_ms / 1e3,
+                          deadline_ms=args.deadline_ms or None,
+                          max_queue=args.max_queue or None,
                           ready=not do_warmup)
     if do_warmup:
         buckets = None if args.warmup == "auto" else \

@@ -45,7 +45,7 @@ import threading
 import time
 import weakref
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Sequence, Tuple, Union)
 
@@ -54,7 +54,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from synapseml_tpu.runtime import compile_cache as _cc
+from synapseml_tpu.runtime import faults as _flt
 from synapseml_tpu.runtime import telemetry as _tm
+from synapseml_tpu.runtime.faults import PipelineBrokenError
 
 # module-level metric handles: resolved ONCE (the registry lookup takes
 # a lock; inc()/observe() on the handle is lock-free thread-striped —
@@ -73,6 +75,22 @@ _M_AOT_HIT = _tm.counter("executor_aot_hits_total")
 _M_AOT_MISS = _tm.counter("executor_aot_misses_total")
 _M_AOT_RETIRED = _tm.counter("executor_aot_retired_total")
 _M_DONATE_FB = _tm.counter("executor_donation_fallback_total")
+_M_PIPE_RESTARTS = _tm.counter("executor_pipeline_restarts_total")
+
+# fault-injection points (runtime/faults.py, docs/robustness.md):
+# resolved once at import, fire() is a single attribute test when no
+# fault is armed — the hot path pays nothing. The thread_kill points
+# sit at the pipeline-loop tops OUTSIDE every per-unit handler, so an
+# armed kill terminates the THREAD (the failure mode supervision
+# exists to catch), never just one batch.
+_F_STAGING = _flt.point("staging")
+_F_H2D = _flt.point("h2d")
+_F_COMPUTE = _flt.point("compute")
+_F_DRAIN = _flt.point("drain")
+_F_LAT_DISPATCH = _flt.point("latency", "dispatch")
+_F_KILL_STAGE = _flt.point("thread_kill", "stage")
+_F_KILL_DISPATCH = _flt.point("thread_kill", "dispatch")
+_F_KILL_DRAIN = _flt.point("thread_kill", "drain")
 
 
 def round_up_pow2(n: int, minimum: int = 8) -> int:
@@ -149,8 +167,15 @@ class ExecutorFuture:
         self._chunks = list(chunk_futs)
 
     def result(self, timeout: Optional[float] = None):
-        """Block until every chunk lands; ``timeout`` applies per chunk."""
-        outs = [f.result(timeout) for f in self._chunks]
+        """Block until every chunk lands; ``timeout`` is ONE overall
+        monotonic deadline across all chunks — waiting n_chunks slow
+        chunks can never stretch the total wait past ``timeout``."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        outs = [f.result(
+            None if deadline is None
+            else max(0.0, deadline - time.monotonic()))
+            for f in self._chunks]
         if len(outs) == 1:
             return outs[0]
         return tuple(
@@ -161,8 +186,14 @@ class ExecutorFuture:
         return all(f.done() for f in self._chunks)
 
     def exception(self, timeout: Optional[float] = None):
+        """First chunk error, or None; ``timeout`` is one overall
+        deadline, same as :meth:`result`."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
         for f in self._chunks:
-            exc = f.exception(timeout)
+            exc = f.exception(
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
             if exc is not None:
                 return exc
         return None
@@ -222,7 +253,8 @@ class _PipelineState:
     """
 
     __slots__ = ("stage_q", "dispatch_q", "inflight_q", "depth_sem",
-                 "stage_slots", "lock", "closed", "threads", "__weakref__")
+                 "stage_slots", "lock", "closed", "broken", "pending",
+                 "threads", "__weakref__")
 
     def __init__(self, depth: int, stage_workers: int):
         self.stage_q: "_queue.Queue" = _queue.Queue()
@@ -238,7 +270,57 @@ class _PipelineState:
         self.stage_slots = threading.Semaphore(depth + stage_workers)
         self.lock = threading.Lock()
         self.closed = False
+        # supervision: set (under lock) to the PipelineBrokenError when a
+        # pipeline thread dies unexpectedly; read by every loop and by
+        # submit/_ensure_pipeline (restart trigger)
+        self.broken: Optional["PipelineBrokenError"] = None  # synlint: shared
+        # every submitted-but-unresolved chunk Future, so a dying thread
+        # can fail ALL in-flight work — wherever it sits in the pipeline
+        # (stage_q, dispatch_q, inflight_q, or a thread's hands). Futures
+        # untrack themselves via done-callback on resolution, so the set
+        # is always bounded by the staging window.
+        self.pending: set = set()  # synlint: shared
         self.threads: List[threading.Thread] = []
+
+
+def _untrack_future(state: _PipelineState, fut: Future):
+    """Done-callback: a resolved chunk future leaves the supervision
+    registry (runs on whichever thread resolved it)."""
+    with state.lock:
+        state.pending.discard(fut)
+
+
+def _acquire_or_broken(sem: threading.Semaphore,
+                       state: _PipelineState) -> bool:
+    """Acquire ``sem``, polling the supervisor's broken flag: a dead
+    drain thread (its releases gone with it) must never park the
+    dispatch thread forever. False = the pipeline broke while waiting.
+
+    Re-checks ``broken`` AFTER a successful acquire: the permit may be
+    the wake-up one :func:`_break_pipeline` released, and dispatching a
+    chunk whose future is already failed would burn real device work —
+    the permit goes back so the cascade keeps waking other waiters."""
+    while True:
+        if sem.acquire(timeout=0.2):
+            if state.broken is not None:
+                sem.release()
+                return False
+            return True
+        if state.broken is not None:
+            return False
+
+
+def _fut_resolve(fut: Future, result=None, error: Optional[BaseException] = None):
+    """Resolve a chunk future, tolerating one already failed by
+    :func:`_break_pipeline` (the drain/dispatch thread may race the
+    supervisor on a unit both hold)."""
+    try:
+        if error is not None:
+            fut.set_exception(error)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        pass
 
 
 def _stage_worker(state: _PipelineState):
@@ -247,12 +329,29 @@ def _stage_worker(state: _PipelineState):
         if unit is _SHUTDOWN:
             state.stage_q.put(_SHUTDOWN)  # propagate to sibling workers
             return
+        # kill point AFTER the get, OUTSIDE the per-unit handler: the
+        # armed kill dies with a unit in hand — exactly the failure mode
+        # supervision must turn into failed futures, never a hang
+        _F_KILL_STAGE.fire()
         t0 = time.monotonic()
+        killed = False
         try:
             with _tm.trace_annotation("synapseml/executor/stage"):
+                _F_STAGING.fire()
                 unit.staged = unit.stage()
-        except BaseException as e:  # noqa: BLE001 - delivered via futures
+        except Exception as e:  # noqa: BLE001 - delivered via futures
+            # Exception, not BaseException: a kill (ThreadKilled) must
+            # escape to the supervisor and terminate the THREAD — the
+            # per-unit handler only converts per-batch errors
             unit.error = e
+        except BaseException:
+            # dying with the unit in hand: leave ready UNSET — setting
+            # it here (staged=None, error=None) would let the dispatch
+            # thread race ahead of the supervisor and die on a
+            # secondary TypeError, masking the real cause. Dispatch's
+            # bounded ready-poll sees state.broken instead.
+            killed = True
+            raise
         finally:
             unit.stage = None  # drop array refs promptly
             dt = time.monotonic() - t0
@@ -260,7 +359,8 @@ def _stage_worker(state: _PipelineState):
             if unit.spans:
                 for sp in unit.spans:
                     sp.note("stage", dt)
-            unit.ready.set()
+            if not killed:
+                unit.ready.set()
 
 
 def _dispatch_loop(state: _PipelineState):
@@ -269,16 +369,27 @@ def _dispatch_loop(state: _PipelineState):
         if unit is _SHUTDOWN:
             state.inflight_q.put(_SHUTDOWN)
             return
-        unit.ready.wait()
+        _F_KILL_DISPATCH.fire()
+        # bounded wait: a stage worker that DIED holding this unit never
+        # sets ready — poll the supervisor's broken flag so this thread
+        # exits instead of parking forever on a dead handshake
+        while not unit.ready.wait(0.2):
+            if state.broken is not None:
+                break
         try:
+            if state.broken is not None:
+                # _break_pipeline already failed every pending future;
+                # just drop refs and free the slot
+                continue
             if unit.error is not None:
                 for f in unit.futs:
-                    f.set_exception(unit.error)
+                    _fut_resolve(f, error=unit.error)
                 continue
             ex = unit.ex
             for (arrays, n, bucket, internal), fut in zip(
                     unit.staged, unit.futs):
-                state.depth_sem.acquire()
+                if not _acquire_or_broken(state.depth_sem, state):
+                    break  # broke while waiting; futures already failed
                 t0 = time.monotonic()
                 try:
                     # instance-attribute lookup: tests (and tracing
@@ -289,9 +400,9 @@ def _dispatch_loop(state: _PipelineState):
                             ex._dispatch(arrays, n, bucket, internal=True)
                             if internal else
                             ex._dispatch(arrays, n, bucket))
-                except BaseException as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
                     state.depth_sem.release()
-                    fut.set_exception(e)
+                    _fut_resolve(fut, error=e)
                     continue
                 t1 = time.monotonic()
                 _M_DISPATCH_S.observe(t1 - t0)
@@ -314,6 +425,7 @@ def _drain_loop(state: _PipelineState):
         rec = state.inflight_q.get()
         if rec is _SHUTDOWN:
             return
+        _F_KILL_DRAIN.fire()
         out, n, bucket, fut, ex, spans, t_disp = rec
         del rec
         t0 = time.monotonic()
@@ -321,8 +433,9 @@ def _drain_loop(state: _PipelineState):
             err: Optional[BaseException] = None
             try:
                 with _tm.trace_annotation("synapseml/executor/drain"):
+                    _F_DRAIN.fire()
                     res = ex._fetch(out, n, bucket)
-            except BaseException as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 err = e
             t1 = time.monotonic()
             # "compute": dispatch-end -> drain-pickup. Overlap-inclusive
@@ -338,9 +451,9 @@ def _drain_loop(state: _PipelineState):
                     sp.note("compute", t0 - t_disp)
                     sp.note("drain", t1 - t0)
             if err is not None:
-                fut.set_exception(err)
+                _fut_resolve(fut, error=err)
             else:
-                fut.set_result(res)
+                _fut_resolve(fut, res)
         finally:
             state.depth_sem.release()
             del ex, out, fut, spans
@@ -355,6 +468,79 @@ def _shutdown_pipeline(state: _PipelineState):
         state.closed = True
     state.stage_q.put(_SHUTDOWN)
     state.dispatch_q.put(_SHUTDOWN)
+
+
+def _break_pipeline(state: _PipelineState, exc: BaseException):
+    """Supervision: a pipeline thread died unexpectedly. Fail EVERY
+    in-flight future with a descriptive :class:`PipelineBrokenError`
+    (the contract: no future ever hangs on a dead thread), mark the
+    state broken so the owning executor's next submit builds a fresh
+    pipeline, and wake the surviving threads so they exit instead of
+    parking on dead queues."""
+    err = PipelineBrokenError(
+        f"executor pipeline thread "
+        f"{threading.current_thread().name!r} died: {exc!r}; all "
+        "in-flight work failed — the pipeline restarts on the next "
+        "submit")
+    err.__cause__ = exc
+    with state.lock:
+        if state.broken is not None:
+            return  # a sibling thread already broke the pipeline
+        state.broken = err
+        state.closed = True
+        pending = list(state.pending)
+        state.pending.clear()
+    _M_PIPE_RESTARTS.inc()
+    for fut in pending:
+        try:
+            fut.set_exception(err)
+        except InvalidStateError:
+            pass  # resolved in the race window — even better
+    # sentinels for every loop; surviving threads drain to them and exit
+    state.stage_q.put(_SHUTDOWN)
+    state.dispatch_q.put(_SHUTDOWN)
+    state.inflight_q.put(_SHUTDOWN)
+    # wake anything parked on backpressure: ONE extra permit cascades —
+    # each blocked submitter wakes, sees closed, releases it back, and
+    # raises; the dispatch loop likewise never waits on a dead drain
+    state.stage_slots.release()
+    state.depth_sem.release()
+    _reap_broken_pipeline(state)
+
+
+def _reap_broken_pipeline(state: _PipelineState):
+    """Post-break cleanup, run on the dying thread (cold path): wait for
+    the surviving loops to drain to their sentinels, then empty the dead
+    queues. Stranded ``inflight_q`` records would otherwise pin device
+    output buffers (and the executor, via their ``ex`` field) for the
+    life of the process — the superseded state stays strongly reachable
+    — and permanently inflate the scrape-time depth gauges. Sentinels
+    are re-put afterwards so a straggler that outlived the join timeout
+    still exits instead of parking on an emptied queue."""
+    me = threading.current_thread()
+    for t in state.threads:
+        if t is not me:
+            t.join(timeout=5)
+    for q in (state.stage_q, state.dispatch_q, state.inflight_q):
+        while True:
+            try:
+                q.get_nowait()
+            except _queue.Empty:
+                break
+    state.stage_q.put(_SHUTDOWN)
+    state.dispatch_q.put(_SHUTDOWN)
+    state.inflight_q.put(_SHUTDOWN)
+
+
+def _pipeline_thread(target, state: _PipelineState):
+    """Thread entry for every pipeline loop: an escaped exception —
+    including an injected :class:`~synapseml_tpu.runtime.faults.ThreadKilled`
+    — breaks the pipeline instead of dying silently with every in-flight
+    future deadlocked."""
+    try:
+        target(state)
+    except BaseException as e:  # noqa: BLE001 - supervision boundary
+        _break_pipeline(state, e)
 
 
 # Pipeline threads still parked inside the XLA runtime at interpreter
@@ -532,6 +718,9 @@ class BatchedExecutor:
         self._donate_masks: Dict[tuple, Tuple[bool, ...]] = {}  # synlint: shared
         self._pipeline: Optional[_PipelineState] = None
         self._pipeline_init_lock = threading.Lock()
+        # user-initiated close(): permanent, unlike a supervision break
+        # (which only closes ONE _PipelineState and restarts on submit)
+        self._closed = False  # synlint: shared
         self._finalizer = None
         # -- persistent compile cache / AOT warmup state ----------------
         resolved_dir = cache_dir if cache_dir is not None \
@@ -753,21 +942,32 @@ class BatchedExecutor:
     # -- pipeline plumbing ----------------------------------------------
     def _ensure_pipeline(self) -> _PipelineState:
         state = self._pipeline
-        if state is not None:
+        if state is not None and state.broken is None:
             return state
         with self._pipeline_init_lock:
             state = self._pipeline
+            if (state is not None and state.broken is not None
+                    and not self._closed):
+                # supervision restart: the broken state already failed
+                # its in-flight futures and its threads are exiting —
+                # drop it so subsequent submits ride a fresh pipeline.
+                # Detach the superseded finalizer: its registry entry
+                # would otherwise hold the dead state strongly for the
+                # life of the executor (leaked queues + phantom gauges)
+                if self._finalizer is not None:
+                    self._finalizer.detach()
+                self._pipeline = state = None
             if state is None:
                 state = _PipelineState(self._depth, self._stage_workers)
                 threads = [threading.Thread(
-                    target=_stage_worker, args=(state,),
+                    target=_pipeline_thread, args=(_stage_worker, state),
                     name=f"executor-stage-{i}", daemon=True)
                     for i in range(self._stage_workers)]
                 threads.append(threading.Thread(
-                    target=_dispatch_loop, args=(state,),
+                    target=_pipeline_thread, args=(_dispatch_loop, state),
                     name="executor-dispatch", daemon=True))
                 threads.append(threading.Thread(
-                    target=_drain_loop, args=(state,),
+                    target=_pipeline_thread, args=(_drain_loop, state),
                     name="executor-drain", daemon=True))
                 state.threads = threads
                 _LIVE_PIPELINES.add(state)
@@ -784,6 +984,11 @@ class BatchedExecutor:
         """Shut the pipeline down. Batches already submitted complete
         (their futures resolve); later :meth:`submit` calls raise.
         Idempotent; ``wait=True`` joins the pipeline threads."""
+        with self._pipeline_init_lock:
+            # under the init lock: _ensure_pipeline must never rebuild a
+            # broken pipeline after (or while) close() marks the
+            # executor permanently closed
+            self._closed = True
         state = self._pipeline
         if state is None:
             with self._pipeline_init_lock:
@@ -940,9 +1145,21 @@ class BatchedExecutor:
             with state.lock:
                 if state.closed:
                     state.stage_slots.release()
+                    if state.broken is not None and not self._closed:
+                        # the narrow window between a thread dying and
+                        # supervision swapping the pipeline: surface the
+                        # transient error (serving retries it) rather
+                        # than a permanent-sounding "closed"
+                        raise PipelineBrokenError(
+                            "submitted during the pipeline-restart "
+                            f"window: {state.broken}") from state.broken
                     raise RuntimeError("executor pipeline is closed")
                 state.stage_q.put(unit)
                 state.dispatch_q.put(unit)
+                state.pending.update(unit.futs)
+            for f in unit.futs:
+                f.add_done_callback(
+                    lambda f, s=state: _untrack_future(s, f))
             futs.extend(unit.futs)
         return ExecutorFuture(futs)
 
@@ -1113,6 +1330,7 @@ class BatchedExecutor:
         round-robin order. Either way this method stays ordered and
         non-blocking, so the surrounding pipeline semantics (submission
         order, depth backpressure) are untouched."""
+        _F_LAT_DISPATCH.fire()
         layout = self._layout(bucket)
         rr_idx: Optional[int] = None
         if layout == "shard":
@@ -1136,6 +1354,7 @@ class BatchedExecutor:
             mc = self._m_bucket.setdefault(bucket, _tm.counter(
                 "executor_bucket_total", bucket=str(bucket)))
         mc.inc()
+        _F_H2D.fire()
         padded = []
         guard: List[int] = []  # external device arrays we did not copy
         for i, a in enumerate(arrays):
@@ -1161,6 +1380,7 @@ class BatchedExecutor:
             if mask[i]:
                 # donation would delete the caller's own buffer
                 padded[i] = jnp.copy(padded[i])
+        _F_COMPUTE.fire()
         with self._tables_lock:
             compiled = self._aot.get((sig, mask, layout, rr_idx))
         if compiled is not None:

@@ -1,0 +1,541 @@
+"""Every degradation path, proven via the fault-injection framework
+(synapseml_tpu/runtime/faults.py, docs/robustness.md).
+
+The contract under test: with a fatal fault injected into ANY pipeline
+thread — executor stage/dispatch/drain, serving scorer/reply/collector,
+DistributedServer distributor — no future and no HTTP client ever
+hangs. Futures raise PipelineBrokenError, clients get 5xx, and the
+next request after supervision restart succeeds bit-identically.
+Every blocking assert rides a hard timeout so a regression fails fast
+instead of wedging the suite (the smoke_pipeline.sh discipline).
+"""
+import errno
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.http import HTTPRequestData
+from synapseml_tpu.io.serving import (CachedRequest, ContinuousServer,
+                                      DistributedServer, WorkerServer,
+                                      make_reply)
+from synapseml_tpu.runtime import faults as flt
+from synapseml_tpu.runtime import telemetry as tm
+from synapseml_tpu.runtime.executor import BatchedExecutor, ExecutorFuture
+from synapseml_tpu.runtime.faults import (FaultInjected, PipelineBrokenError,
+                                          ThreadKilled)
+
+HARD = 30.0  # hard wall for any blocking wait: hang -> fast red X
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    flt.deactivate()
+    yield
+    flt.deactivate()
+
+
+def _ctr(name, **labels):
+    """Sum one counter family, optionally filtered by exact labels."""
+    total = 0.0
+    for k, v in tm.snapshot()["counters"].items():
+        if not k.startswith("synapseml_" + name):
+            continue
+        if all(f'{lk}="{lv}"' in k for lk, lv in labels.items()):
+            total += v
+    return total
+
+
+def _post(url, obj, timeout=HARD, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST", headers=hdrs)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _echo_pipeline(table: Table) -> Table:
+    replies = np.empty(table.num_rows, dtype=object)
+    for i, v in enumerate(table["value"]):
+        replies[i] = make_reply(v)
+    return table.with_column("reply", replies)
+
+
+# ---------------------------------------------------------------------------
+# framework API + env grammar
+# ---------------------------------------------------------------------------
+
+def test_inactive_point_is_a_noop_and_api_validates():
+    p = flt.point("compute")
+    p.fire()  # nothing armed: returns
+    with pytest.raises(ValueError):
+        flt.activate("no_such_point")
+    with pytest.raises(ValueError):
+        flt.configure("compute:1:NotAnException")
+    # a typo'd scope must be a loud error, not a silently-inert spec no
+    # instrumentation site ever resolves (a chaos run that injects
+    # nothing proves nothing)
+    with pytest.raises(ValueError):
+        flt.activate("thread_kill.drian")
+    with pytest.raises(ValueError):
+        flt.activate("compute.foo")  # family takes no scope
+
+
+def test_env_grammar_arms_points_with_details():
+    armed = flt.configure(
+        "compute:0.5:ValueError,latency.score:1:25,thread_kill.drain:1")
+    assert set(armed) == {"compute", "latency.score", "thread_kill.drain"}
+    active = flt.active()
+    assert active["compute"]["prob"] == 0.5
+    assert active["compute"]["exc"] == "ValueError"
+    assert active["latency.score"]["latency_ms"] == 25.0
+    # thread_kill defaults to the BaseException no per-batch handler
+    # may swallow
+    assert active["thread_kill.drain"]["exc"] == "ThreadKilled"
+    flt.deactivate("compute")
+    assert "compute" not in flt.active()
+    flt.deactivate()
+    assert flt.active() == {}
+    flt.point("compute").fire()  # disarmed again
+
+
+def test_times_bound_caps_firings():
+    flt.activate("compute", times=2)
+    p = flt.point("compute")
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            p.fire()
+    p.fire()  # exhausted: armed but inert
+
+
+# ---------------------------------------------------------------------------
+# executor: per-batch faults fail the BATCH, kills fail the THREAD
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("point", ["staging", "h2d", "compute", "drain"])
+def test_injected_batch_fault_fails_future_not_pipeline(point):
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=8)
+    try:
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        base = ex(x)[0]
+        restarts0 = _ctr("executor_pipeline_restarts_total")
+        flt.activate(point)
+        exc = ex.submit(x).exception(timeout=HARD)
+        assert isinstance(exc, FaultInjected), exc
+        flt.deactivate()
+        # the pipeline survived: no restart, next batch is bit-identical
+        assert _ctr("executor_pipeline_restarts_total") == restarts0
+        assert np.array_equal(ex(x)[0], base)
+    finally:
+        ex.close(wait=False)
+
+
+@pytest.mark.parametrize("scope", ["stage", "dispatch", "drain"])
+def test_thread_kill_fails_inflight_and_restarts(scope):
+    """A dead pipeline thread must fail every in-flight future with
+    PipelineBrokenError (never a hang) and the NEXT submit must ride a
+    freshly restarted pipeline, bit-identically."""
+    ex = BatchedExecutor(lambda x: (x * 3.0 + 1.0,), min_bucket=8)
+    try:
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        base = ex(x)[0]
+        restarts0 = _ctr("executor_pipeline_restarts_total")
+        flt.activate(f"thread_kill.{scope}", times=1)
+        fut = ex.submit(x)
+        with pytest.raises(PipelineBrokenError):
+            fut.result(timeout=HARD)
+        assert _ctr("executor_pipeline_restarts_total") == restarts0 + 1
+        assert np.array_equal(ex(x)[0], base)
+    finally:
+        ex.close(wait=False)
+
+
+def test_thread_kill_fails_every_inflight_future():
+    ex = BatchedExecutor(lambda x: (x + 1.0,), min_bucket=8,
+                         pipeline_depth=4)
+    try:
+        x = np.ones((8, 1), np.float32)
+        ex(x)  # warm the compile so the kill lands mid-traffic
+        flt.activate("thread_kill.drain", times=1)
+        futs = [ex.submit(x) for _ in range(6)]
+        outcomes = [f.exception(timeout=HARD) for f in futs]
+        # nothing hung: every future resolved, at least one to the break
+        assert any(isinstance(e, PipelineBrokenError) for e in outcomes)
+        assert all(e is None or isinstance(e, PipelineBrokenError)
+                   for e in outcomes)
+        assert np.array_equal(ex(x)[0], x + 1.0)
+    finally:
+        ex.close(wait=False)
+
+
+def test_break_reaps_dead_pipeline_state():
+    """After a break, the dying thread drains the dead pipeline's
+    queues (stranded inflight records would pin device buffers and the
+    executor forever) and the superseded finalizer is detached — the
+    dead state becomes collectible once callers drop their futures."""
+    import gc
+    import weakref
+
+    from synapseml_tpu.runtime import executor as exmod
+
+    ex = BatchedExecutor(lambda x: (x + 1.0,), min_bucket=8,
+                         pipeline_depth=4)
+    try:
+        x = np.ones((8, 1), np.float32)
+        ex(x)
+        state0 = ex._pipeline
+        flt.activate("thread_kill.drain", times=1)
+        futs = [ex.submit(x) for _ in range(4)]
+        for f in futs:
+            f.exception(timeout=HARD)
+        assert np.array_equal(ex(x)[0], x + 1.0)  # fresh pipeline serves
+        # the reaper drained everything but its re-put exit sentinels
+        deadline = time.monotonic() + HARD
+        while any(t.is_alive() for t in state0.threads):
+            assert time.monotonic() < deadline, "dead threads never exited"
+            time.sleep(0.02)
+        for q in (state0.stage_q, state0.dispatch_q, state0.inflight_q):
+            assert all(item is exmod._SHUTDOWN for item in list(q.queue))
+        wr = weakref.ref(state0)
+        del state0, futs, f  # futures' done-callbacks hold the state
+        deadline = time.monotonic() + HARD
+        while wr() is not None:
+            assert time.monotonic() < deadline, \
+                "dead pipeline state never became collectible"
+            gc.collect()
+            time.sleep(0.02)
+    finally:
+        ex.close(wait=False)
+
+
+def test_latency_point_injects_sleep_without_failing():
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=8)
+    try:
+        x = np.ones((8, 1), np.float32)
+        ex(x)  # compile outside the measured window
+        flt.activate("latency.dispatch", latency_ms=80)
+        t0 = time.monotonic()
+        out = ex(x)[0]
+        assert time.monotonic() - t0 >= 0.08
+        assert np.array_equal(out, x * 2.0)
+    finally:
+        ex.close(wait=False)
+
+
+def test_executor_future_timeout_is_one_overall_deadline():
+    """Satellite: timeout applies across ALL chunks, not per chunk — a
+    3-chunk future with timeout=0.4 fails in ~0.4s, not 1.2s."""
+    fut = ExecutorFuture([Future(), Future(), Future()])
+    t0 = time.monotonic()
+    with pytest.raises(FutureTimeout):
+        fut.result(timeout=0.4)
+    assert time.monotonic() - t0 < 1.0
+    t0 = time.monotonic()
+    with pytest.raises(FutureTimeout):
+        fut.exception(timeout=0.4)
+    assert time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# serving: poison isolation, deadlines, shedding, retry
+# ---------------------------------------------------------------------------
+
+def _poison_pipeline(table: Table) -> Table:
+    vals = list(table["value"])
+    if any(isinstance(v, dict) and v.get("poison") for v in vals):
+        raise ValueError("poison payload")
+    replies = np.empty(table.num_rows, dtype=object)
+    for i, v in enumerate(vals):
+        replies[i] = make_reply({"y": v["x"] * 2})
+    return table.with_column("reply", replies)
+
+
+def _requests_batch(server, payloads):
+    """Hand-built CachedRequests riding the server's epoch machinery,
+    for driving the scoring internals without HTTP."""
+    batch = [CachedRequest(f"rid{i}", HTTPRequestData(
+        url="/", method="POST", headers={},
+        entity=json.dumps(p).encode())) for i, p in enumerate(payloads)]
+    server._record_epoch(batch)
+    return batch
+
+
+def test_bisection_isolates_poison_requests_unit():
+    cs = ContinuousServer("t_bisect_u", _poison_pipeline)
+    try:
+        batch = _requests_batch(
+            cs.server, [{"x": 1}, {"x": 2, "poison": True}, {"x": 3},
+                        {"x": 4}])
+        epoch = batch[0].epoch
+        segments = cs._score_resilient(batch)
+        by_rid = {}
+        for seg, out, err, status, commit_epochs in segments:
+            for cr in seg:
+                by_rid[cr.rid] = status
+        assert by_rid == {"rid0": 200, "rid1": 400, "rid2": 200,
+                          "rid3": 200}
+        # the shared epoch rides ONLY the last segment: committing it
+        # per segment would prune replay history for requests still
+        # unreplied in sibling segments
+        assert [s[4] for s in segments[:-1]] == [()] * (len(segments) - 1)
+        assert list(segments[-1][4]) == [epoch]
+    finally:
+        cs.stop()
+
+
+def test_pipeline_break_mid_bisection_is_500_not_400():
+    """A pipeline that dies DURING bisection is transient
+    infrastructure failure: healthy clients must see 500, never a
+    client-blaming 400."""
+    calls = {"n": 0}
+
+    def pipeline(table):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("looks like poison")
+        raise PipelineBrokenError("pipeline died mid-bisection")
+
+    cs = ContinuousServer("t_bisect_brk", pipeline, retry_transient=0)
+    try:
+        batch = _requests_batch(cs.server,
+                                [{"x": 1}, {"x": 2}, {"x": 3}, {"x": 4}])
+        statuses = {st for _, _, _, st, _ in cs._score_resilient(batch)}
+        assert statuses == {500}
+    finally:
+        cs.stop()
+
+
+def test_poison_batch_bisection_end_to_end():
+    """One poisoned payload in a coalesced micro-batch gets 400; its
+    neighbors still score 200 with correct outputs."""
+    poison0 = _ctr("serving_poison_requests_total", server="t_poison")
+    cs = ContinuousServer("t_poison", _poison_pipeline, max_batch=8,
+                          batch_linger=0.5).start()
+    try:
+        n = 4
+        barrier = threading.Barrier(n)
+        results = [None] * n
+
+        def client(i):
+            barrier.wait()
+            try:
+                results[i] = _post(cs.url, {"x": i, "poison": i == 2})
+            except urllib.error.HTTPError as e:
+                results[i] = (e.code, None)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=HARD)
+            assert not t.is_alive(), "client hung"
+        for i, (st, body) in enumerate(results):
+            if i == 2:
+                assert st == 400
+            else:
+                assert st == 200 and body == {"y": i * 2}
+        assert _ctr("serving_poison_requests_total",
+                    server="t_poison") == poison0 + 1
+    finally:
+        cs.stop()
+
+
+def test_expired_deadline_shed_504_before_scoring():
+    scored = []
+
+    def pipeline(table):
+        scored.extend(table["value"])
+        return _echo_pipeline(table)
+
+    shed0 = _ctr("serving_deadline_shed_total", server="t_dl")
+    cs = ContinuousServer("t_dl", pipeline)  # not started yet
+    try:
+        result = {}
+
+        def client():
+            try:
+                result["r"] = _post(cs.url, {"x": 1},
+                                    headers={"X-Deadline-Ms": "30"})
+            except urllib.error.HTTPError as e:
+                result["r"] = (e.code, None)
+
+        ct = threading.Thread(target=client)
+        ct.start()
+        time.sleep(0.3)  # the 30ms deadline expires while queued
+        cs.start()
+        ct.join(timeout=HARD)
+        assert not ct.is_alive()
+        assert result["r"][0] == 504
+        assert scored == []  # wasted-work elimination: never scored
+        assert _ctr("serving_deadline_shed_total",
+                    server="t_dl") == shed0 + 1
+        # live traffic (no deadline) still serves
+        assert _post(cs.url, {"x": 2}) == (200, {"x": 2})
+    finally:
+        cs.stop()
+
+
+def test_queue_shed_429_and_reply_timeout_504():
+    """Admission control past --max-queue is an immediate 429, and a
+    request that waits out reply_timeout gets an explicit 504 plus the
+    serving_reply_timeout_total count (satellite)."""
+    to0 = _ctr("serving_reply_timeout_total", server="t_q429")
+    q0 = _ctr("serving_queue_shed_total", server="t_q429")
+    cs = ContinuousServer("t_q429", _echo_pipeline, max_queue=1,
+                          reply_timeout=1.0)  # never started: all park
+    try:
+        result = {}
+
+        def client():
+            try:
+                result["r"] = _post(cs.url, {"x": 1})
+            except urllib.error.HTTPError as e:
+                result["r"] = (e.code, None)
+
+        ct = threading.Thread(target=client)
+        ct.start()
+        deadline = time.monotonic() + HARD
+        while cs.server.requests.qsize() < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(cs.url, {"x": 2})
+        assert ei.value.code == 429
+        assert time.monotonic() - t0 < 1.0  # shed at enqueue, no park
+        assert _ctr("serving_queue_shed_total",
+                    server="t_q429") == q0 + 1
+        ct.join(timeout=HARD)
+        assert not ct.is_alive()
+        assert result["r"][0] == 504  # waited out reply_timeout
+        assert _ctr("serving_reply_timeout_total",
+                    server="t_q429") == to0 + 1
+    finally:
+        cs.stop()
+
+
+def test_transient_pipeline_broken_gets_one_retry():
+    calls = {"n": 0}
+
+    def pipeline(table):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise PipelineBrokenError("injected transient break")
+        return _echo_pipeline(table)
+
+    retry0 = _ctr("serving_retry_total", server="t_retry")
+    cs = ContinuousServer("t_retry", pipeline, max_batch=1,
+                          retry_transient=1).start()
+    try:
+        # the first batch hits the break, the bounded retry resubmits
+        # against the (conceptually restarted) pipeline: the CLIENT
+        # sees 200, not 500
+        assert _post(cs.url, {"x": 9}) == (200, {"x": 9})
+        assert calls["n"] == 2
+        assert _ctr("serving_retry_total", server="t_retry") == retry0 + 1
+    finally:
+        cs.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving/distributor thread supervision
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scope", ["scorer", "collector", "reply"])
+def test_serving_thread_kill_recovery(scope):
+    """Kill each serving-stage thread in turn: supervision restarts it
+    (counted) and the next request still round-trips 200."""
+    cs = ContinuousServer(f"t_kill_{scope}", _echo_pipeline,
+                          scoring_workers=1).start()
+    try:
+        assert _post(cs.url, {"x": 1}) == (200, {"x": 1})
+        flt.activate(f"thread_kill.{scope}", times=1)
+        deadline = time.monotonic() + HARD
+        while _ctr("serving_thread_restarts_total",
+                   server=f"t_kill_{scope}", thread=scope) < 1:
+            assert time.monotonic() < deadline, "no restart recorded"
+            time.sleep(0.02)
+        assert _post(cs.url, {"x": 2}) == (200, {"x": 2})
+    finally:
+        cs.stop()
+
+
+def test_distributor_thread_kill_recovery():
+    """An exception in DistributedServer._distribute used to silently
+    stop ALL traffic; now supervision restarts the thread and requests
+    keep routing."""
+    ds = DistributedServer("t_kill_dist", n_channels=2)
+    try:
+        flt.activate("thread_kill.distributor", times=1)
+        deadline = time.monotonic() + HARD
+        while _ctr("serving_thread_restarts_total", server="t_kill_dist",
+                   thread="distributor") < 1:
+            assert time.monotonic() < deadline, "no restart recorded"
+            time.sleep(0.02)
+        result = {}
+
+        def client():
+            result["r"] = _post(ds.url, {"x": 7})
+
+        ct = threading.Thread(target=client)
+        ct.start()
+        got = []
+        deadline = time.monotonic() + HARD
+        while not got and time.monotonic() < deadline:
+            for ch in range(2):
+                got.extend(ds.get_batch(ch, timeout=0.2))
+        assert got, "request never routed after distributor restart"
+        ds.reply_to(got[0].rid, make_reply({"ok": True}))
+        ct.join(timeout=HARD)
+        assert not ct.is_alive()
+        assert result["r"] == (200, {"ok": True})
+    finally:
+        ds.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites: port TOCTOU
+# ---------------------------------------------------------------------------
+
+def test_worker_server_bind_retries_past_taken_port():
+    """Probe-then-bind TOCTOU: a port probed free can be taken before
+    the server binds — creation retries the NEXT ports instead of
+    crashing."""
+    blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blocker.bind(("127.0.0.1", 0))
+    taken = blocker.getsockname()[1]
+    try:
+        # drift off an explicitly requested port must be LOUD — a
+        # fixed-port consumer that doesn't read server.port back is
+        # routing to the wrong place
+        with pytest.warns(RuntimeWarning, match="requested port"):
+            srv = WorkerServer("t_toctou", port=taken)
+        try:
+            assert srv.port != taken
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/health",
+                    timeout=HARD) as r:
+                assert r.status == 200
+        finally:
+            srv.stop()
+    finally:
+        blocker.close()
+
+
+def test_worker_server_bind_raises_non_addrinuse_errors():
+    """Only EADDRINUSE is the TOCTOU race: any other bind failure
+    (EADDRNOTAVAIL here) must raise immediately — retrying would either
+    spin futilely or silently serve a port nobody is pointing at."""
+    with pytest.raises(OSError) as ei:
+        WorkerServer("t_bind_err", host="203.0.113.1", port=12631)
+    assert ei.value.errno != errno.EADDRINUSE

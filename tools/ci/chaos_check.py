@@ -1,0 +1,164 @@
+"""Chaos smoke: serve a real executor-backed pipeline with PROBABILISTIC
+compute faults injected via the ``SYNAPSEML_FAULTS`` env var (set by
+tools/ci/smoke_chaos.sh before the interpreter starts, so the
+import-time env path itself is under test), drive concurrent load, then
+deterministically kill the executor's drain thread mid-flight.
+
+Asserts (docs/robustness.md):
+- every client gets a terminal response — no request ever hangs;
+- non-faulted requests still succeed (correct payloads, and bisection
+  re-scores mean most faulted batches recover too);
+- a request with an already-expired deadline is shed 504;
+- after a drain-thread kill, supervision restarts the pipeline and the
+  serving retry masks the break (client sees 200);
+- GET /metrics shows the injections, restarts, and sheds.
+
+Driven under a hard timeout: a wedged pipeline hangs rather than fails,
+so it becomes a fast exit-124 instead of a stuck job.
+"""
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+REQUESTS_PER_CLIENT = 25
+CLIENTS = 4
+
+
+def post(url, obj, headers=None, timeout=60):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST", headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, None
+
+
+def series_total(text: str, name: str) -> float:
+    total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(name) and not ln.startswith(name + "_"):
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def main() -> int:
+    spec = os.environ.get("SYNAPSEML_FAULTS", "")
+    if "compute" not in spec:
+        print("SYNAPSEML_FAULTS must arm a compute fault "
+              f"(got {spec!r}) — run via tools/ci/smoke_chaos.sh")
+        return 2
+
+    from synapseml_tpu.io.serving import ContinuousServer, make_reply
+    from synapseml_tpu.runtime import faults as flt
+    from synapseml_tpu.runtime.executor import BatchedExecutor
+
+    assert "compute" in flt.active(), \
+        "env-armed fault did not survive import"
+
+    ex = BatchedExecutor(lambda x: (x * 3.0 + 1.0,), min_bucket=8)
+
+    def pipeline(table):
+        feats = np.stack([np.asarray(v["x"], np.float32)
+                          for v in table["value"]])
+        (out,) = ex(feats)
+        replies = np.empty(table.num_rows, dtype=object)
+        for i in range(table.num_rows):
+            replies[i] = make_reply({"y": out[i].tolist()})
+        return table.with_column("reply", replies)
+
+    cs = ContinuousServer("chaos_smoke", pipeline, max_batch=16,
+                          batch_linger=0.002, retry_transient=1).start()
+    try:
+        url = cs.url
+        host = url.split("//")[1].rstrip("/")
+
+        # -- phase 1: concurrent load under probabilistic compute faults
+        results = [[None] * REQUESTS_PER_CLIENT for _ in range(CLIENTS)]
+
+        def client(ci):
+            for i in range(REQUESTS_PER_CLIENT):
+                results[ci][i] = post(url, {"x": [float(ci), float(i)]})
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            if t.is_alive():
+                print("FAIL: a load client hung — some request never "
+                      "got a terminal response")
+                return 1
+
+        flat = [r for row in results for r in row]
+        codes = sorted({st for st, _ in flat})
+        n_ok = sum(1 for st, _ in flat if st == 200)
+        bad = [st for st, _ in flat if st not in (200, 400, 500, 504)]
+        if bad:
+            print(f"FAIL: unexpected statuses {bad}")
+            return 1
+        if n_ok == 0:
+            print("FAIL: zero non-faulted requests succeeded")
+            return 1
+        for (st, body), (ci, i) in zip(
+                flat, ((c, i) for c in range(CLIENTS)
+                       for i in range(REQUESTS_PER_CLIENT))):
+            if st == 200 and body["y"] != [ci * 3.0 + 1.0, i * 3.0 + 1.0]:
+                print(f"FAIL: wrong payload for ({ci},{i}): {body}")
+                return 1
+
+        # -- phase 2: pre-expired deadline is shed before scoring
+        st, _ = post(url, {"x": [1.0, 1.0]},
+                     headers={"X-Deadline-Ms": "0.01"})
+        if st != 504:
+            print(f"FAIL: expired-deadline request got {st}, wanted 504")
+            return 1
+
+        # -- phase 3: deterministic drain-thread kill mid-flight; the
+        # serving retry resubmits against the supervision-restarted
+        # pipeline, so the CLIENT still sees 200
+        flt.deactivate("compute")  # isolate the kill from random faults
+        flt.activate("thread_kill.drain", times=1)
+        st, body = post(url, {"x": [2.0, 2.0]})
+        if st != 200 or body["y"] != [7.0, 7.0]:
+            print(f"FAIL: post-kill request got {st} {body}, wanted "
+                  "200 [7.0, 7.0] via retry against restarted pipeline")
+            return 1
+
+        conn_req = urllib.request.Request(f"http://{host}/metrics")
+        with urllib.request.urlopen(conn_req, timeout=30) as r:
+            metrics = r.read().decode()
+        checks = {
+            "synapseml_faults_injected_total": 1,
+            "synapseml_executor_pipeline_restarts_total": 1,
+            "synapseml_serving_deadline_shed_total": 1,
+            "synapseml_serving_retry_total": 1,
+        }
+        for name, floor in checks.items():
+            got = series_total(metrics, name)
+            if got < floor:
+                print(f"FAIL: {name} = {got}, wanted >= {floor}")
+                return 1
+
+        print(f"chaos smoke ok: {n_ok}/{len(flat)} loaded requests "
+              f"succeeded under {spec!r} (codes seen: {codes}), "
+              f"restarts="
+              f"{series_total(metrics, 'synapseml_executor_pipeline_restarts_total'):.0f}, "
+              f"injected="
+              f"{series_total(metrics, 'synapseml_faults_injected_total'):.0f}")
+        return 0
+    finally:
+        cs.stop()
+        ex.close(wait=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Chaos smoke (docs/robustness.md): run the serving stack with
+# SYNAPSEML_FAULTS injecting probabilistic compute faults under
+# concurrent load, then a deterministic drain-thread kill — and assert
+# non-faulted requests still succeed, nothing ever hangs, and /metrics
+# shows the injections/restarts/sheds. The env var is exported BEFORE
+# the interpreter starts so the import-time fault-arming path is itself
+# under test. A wedged pipeline HANGS rather than fails, so the hard
+# wall-clock timeout turns it into a fast red X (exit 124).
+#
+# Usage: tools/ci/smoke_chaos.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export SYNAPSEML_FAULTS="${SYNAPSEML_FAULTS:-compute:0.1}"
+exec timeout -k 10 "${SMOKE_TIMEOUT:-240}" \
+  python tools/ci/chaos_check.py
