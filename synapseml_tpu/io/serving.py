@@ -20,6 +20,8 @@ from __future__ import annotations
 import errno
 import http.server
 import json
+import math
+import os
 import queue
 import random
 import socket
@@ -49,10 +51,30 @@ _REGISTRY_LOCK = threading.Lock()
 # reply_timeout.
 _F_REPLY = _flt.point("reply")
 _F_LAT_SCORE = _flt.point("latency", "score")
+# channel-scoped stall: fires inside EVERY channel's scoring path (the
+# per-channel compute points are resolved lazily — channel counts are a
+# runtime property, see DistributedServer._channel_point)
+_F_LAT_STALL = _flt.point("latency", "channel_stall")
 _F_KILL_SCORER = _flt.point("thread_kill", "scorer")
 _F_KILL_REPLY = _flt.point("thread_kill", "reply")
 _F_KILL_COLLECT = _flt.point("thread_kill", "collector")
 _F_KILL_DIST = _flt.point("thread_kill", "distributor")
+
+
+def _retry_rng(injected=None):
+    """The PRNG behind transient-retry jitter. Injectable (``retry_rng=``)
+    so tests control the draw; ``SYNAPSEML_RETRY_SEED`` seeds a private
+    deterministic stream (retry-timing assertions stop depending on
+    wall-clock luck); default is the shared module PRNG."""
+    if injected is not None:
+        return injected
+    seed = os.environ.get("SYNAPSEML_RETRY_SEED")
+    if seed:
+        try:
+            return random.Random(int(seed))
+        except ValueError:
+            pass  # malformed seed: fall through to the shared PRNG
+    return random
 
 
 def _drain_queue(q: "queue.Queue", max_rows: int,
@@ -97,6 +119,18 @@ def _drain_queue(q: "queue.Queue", max_rows: int,
                 if arrival is not None:
                     deadline = max(deadline, arrival + coalesce)
     return out
+
+
+def _drain_all(q: "queue.Queue") -> List["CachedRequest"]:
+    """Non-blocking pop-until-empty — the shed/redisperse paths all
+    take EVERYTHING off a queue before acting on it (acting while
+    popping can chase concurrent re-puts forever)."""
+    out: List[CachedRequest] = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue.Empty:
+            return out
 
 
 def find_open_port(base: int = 12400, host: str = "127.0.0.1") -> int:
@@ -188,7 +222,8 @@ class WorkerServer:
                  reply_timeout: float = 60.0, ready: bool = True,
                  default_deadline_ms: Optional[float] = None,
                  max_queue: Optional[int] = None,
-                 port_attempts: int = 32):
+                 port_attempts: int = 32,
+                 retry_after_s: float = 1.0):
         """``default_deadline_ms``: per-request deadline applied when the
         client sends no ``X-Deadline-Ms`` header (None/0 = none).
         ``max_queue``: admission control — a request arriving while that
@@ -198,17 +233,26 @@ class WorkerServer:
         when an explicit ``port`` is already bound — the bind itself
         retries, closing the probe-then-bind TOCTOU race two
         concurrently constructed servers used to crash on (read the
-        actual port back from ``self.port``)."""
+        actual port back from ``self.port``). ``retry_after_s``: the
+        ``Retry-After`` hint every shed path (429/503/504) carries so
+        load balancers and clients back off on a schedule instead of
+        immediately re-hammering a saturated or draining replica."""
         self.name = name
         self.host = host
         self.default_deadline_ms = default_deadline_ms  # synlint: shared
         self.max_queue = max_queue  # synlint: shared
+        self.retry_after_s = retry_after_s
         # readiness gate: /health answers 503 until set_ready(True) —
         # a k8s replica that is still AOT-warming its compile cache must
         # not receive traffic (the serving entry's --warmup flow)
         self._ready = threading.Event()
         if ready:
             self._ready.set()
+        # graceful-drain gate: while draining, /health/ready answers 503
+        # (the load balancer routes away) and NEW enqueues are refused
+        # 503 + Retry-After — already-accepted requests keep scoring to
+        # a real reply (the SIGTERM rolling-restart contract)
+        self._draining = threading.Event()
         # port=0 lets the OS assign one race-free; the actual port is read
         # back from server_address after bind
         self.port = 0 if port is None else port
@@ -236,6 +280,10 @@ class WorkerServer:
                                             server=name)
         self._m_queue_shed = _tm.counter("serving_queue_shed_total",
                                          server=name)
+        self._m_drain_shed = _tm.counter("serving_drain_shed_total",
+                                         server=name)
+        self._m_drain_s = _tm.histogram("serving_drain_seconds",
+                                        server=name)
         self._m_replies: Dict[int, _tm.Counter] = {}
         _tm.gauge_fn("serving_queue_depth", self.requests.qsize,
                      server=name)
@@ -261,14 +309,27 @@ class WorkerServer:
                     headers=dict(self.headers.items()), entity=body)
                 rid = uuid.uuid4().hex
                 outer._m_requests.inc()
+                retry_hdr = (("Retry-After", outer._retry_after_value()),)
+                if outer._draining.is_set():
+                    # graceful drain: the replica is going away — refuse
+                    # NEW work with an explicit 503 + Retry-After (the
+                    # LB's cue to route elsewhere) while accepted
+                    # requests keep scoring to a real reply
+                    outer._m_drain_shed.inc()
+                    outer._reply_counter(503).inc()
+                    self._send_plain(503, b"draining", headers=retry_hdr)
+                    return
                 if (outer.max_queue is not None
                         and outer.requests.qsize() >= outer.max_queue):
                     # admission control: shed at enqueue with 429 — a
                     # request this far over capacity would only park a
-                    # connection it will likely 504 on anyway
+                    # connection it will likely 504 on anyway.
+                    # Retry-After makes the client's backoff principled
+                    # instead of an immediate re-hammer
                     outer._m_queue_shed.inc()
                     outer._reply_counter(429).inc()
-                    self._send_plain(429, b"request queue full")
+                    self._send_plain(429, b"request queue full",
+                                     headers=retry_hdr)
                     return
                 deadline_ms = outer.default_deadline_ms
                 hdr = self.headers.get("X-Deadline-Ms")
@@ -318,20 +379,38 @@ class WorkerServer:
                 self.wfile.write(body)
 
             def _send_plain(self, status: int, body: bytes,
-                            content_type: str = "text/plain"):
+                            content_type: str = "text/plain",
+                            headers: Tuple[Tuple[str, str], ...] = ()):
                 self.send_response(status)
                 self.send_header("Content-Type", content_type)
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/health":
-                    # k8s readiness fast-path: never rides the pipeline.
-                    # 503 while warming keeps the load balancer away from
-                    # a replica that would park requests on a compiling
-                    # (or not-yet-started) scoring query
-                    if outer._ready.is_set():
+                if self.path == "/health/live":
+                    # liveness: the PROCESS is up and its accept loop
+                    # answers — true throughout warmup AND drain, so k8s
+                    # never kills a replica that is merely warming or
+                    # gracefully draining (that is readiness's job)
+                    self._send_plain(200, b"alive")
+                    return
+                if self.path in ("/health", "/health/ready"):
+                    # readiness fast-path: never rides the pipeline.
+                    # 503 while warming keeps the load balancer away
+                    # from a replica that would park requests on a
+                    # compiling (or not-yet-started) scoring query; 503
+                    # while DRAINING routes rollouts away before the
+                    # replica exits. /health stays an alias for ready —
+                    # existing probes keep their semantics.
+                    if outer._draining.is_set():
+                        self._send_plain(
+                            503, b"draining",
+                            headers=(("Retry-After",
+                                      outer._retry_after_value()),))
+                    elif outer._ready.is_set():
                         self._send_plain(200, b"ok")
                     else:
                         self._send_plain(503, b"warming")
@@ -414,6 +493,62 @@ class WorkerServer:
             self._ready.set()
         else:
             self._ready.clear()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self):
+        """Flip the graceful-drain gate: /health/ready (and /health)
+        answer 503 so the load balancer routes away, and NEW enqueues
+        are refused 503 + Retry-After — while every already-accepted
+        request keeps scoring to a real reply. The SIGTERM half of the
+        k8s rolling-restart contract (ContinuousServer.drain drives the
+        wait-then-stop half)."""
+        self._draining.set()
+
+    def wait_drained(self, timeout: float) -> bool:
+        """Block until every ACCEPTED request has a terminal reply —
+        the queue is empty and no connection is parked in the routing
+        table — or ``timeout`` elapses. Call after :meth:`begin_drain`
+        (otherwise new arrivals can keep this from ever converging).
+        Returns True when fully drained."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            with self._lock:
+                empty = not self.routing
+            if empty and self.requests.qsize() == 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    def drain(self, timeout_ms: float = 5000.0) -> bool:
+        """Graceful drain, one call (the SIGTERM half of a k8s rolling
+        restart — the chart's preStop + terminationGracePeriodSeconds
+        budget this window): flip the drain gate (new enqueues 503 +
+        Retry-After, /health/ready goes 503 so the LB routes away),
+        then wait up to ``timeout_ms`` for every accepted request to
+        reach a terminal reply. Observes ``serving_drain_seconds``;
+        returns True when nothing in flight remained. Both serving
+        front-ends (:meth:`ContinuousServer.drain`,
+        :meth:`DistributedServer.drain`) delegate here — parked
+        connections live in THIS routing table until replied, whatever
+        queue their requests ride. Call the front-end's ``stop`` after:
+        anything still queued past the deadline gets an explicit 503
+        there, never a silent drop."""
+        t0 = time.monotonic()
+        self.begin_drain()
+        drained = self.wait_drained(timeout_ms / 1e3)
+        self._m_drain_s.observe(time.monotonic() - t0)
+        return drained
+
+    def _retry_after_value(self) -> str:
+        """``Retry-After`` is integer seconds (RFC 9110): round the
+        configured hint UP so a sub-second hint never renders as 0
+        (= retry immediately, the exact re-hammer the header exists to
+        prevent)."""
+        return str(max(1, math.ceil(self.retry_after_s)))
 
     def _reply_counter(self, status: int) -> "_tm.Counter":
         """Per-status reply counter, registered on first use."""
@@ -508,11 +643,40 @@ class WorkerServer:
         pending.event.set()
         return True
 
+    def fail_queued(self, status: int = 503,
+                    reason: str = "server stopping",
+                    q: Optional["queue.Queue"] = None) -> int:
+        """Reply ``status`` to every request still parked on ``q``
+        (default: this server's intake queue; DistributedServer passes
+        its channel queues) — the explicit-shed half of shutdown/drain.
+        Counted in ``serving_drain_shed_total``; carries Retry-After so
+        clients back off before re-trying the replacement replica.
+        Returns how many were failed."""
+        hdrs = {"Retry-After": self._retry_after_value()}
+        shed = _drain_all(self.requests if q is None else q)
+        for cr in shed:
+            self._m_drain_shed.inc()
+            self.reply_to(cr.rid, HTTPResponseData(
+                status_code=status, reason=reason, headers=hdrs))
+            cr.span.finish("shed")
+        return len(shed)
+
     def stop(self):
         # unhook the scrape-time sampler first: a scrape racing the
         # shutdown must read 0, not call into a closed server
         _tm.unregister("serving_queue_depth", server=self.name)
+        # queued-but-unconsumed requests get an explicit 503 + Retry-
+        # After instead of a silent drop that parks their clients until
+        # reply_timeout (their handler threads still hold live
+        # connection sockets; only the accept loop closes below).
+        # Gate first: a handler racing this shed would otherwise pass
+        # the drain check and re-park on the just-emptied queue with no
+        # consumer left — then shed again after the accept loop stops,
+        # for handlers that were already past the gate check.
+        self._draining.set()
+        self.fail_queued()
         self._httpd.shutdown()
+        self.fail_queued()
         self._httpd.server_close()
 
 
@@ -546,10 +710,21 @@ class HTTPSourceStateHolder:
 
 
 class MultiChannelMap:
-    """Round-robin request distribution across N consumer channels
+    """Depth-aware request distribution across N consumer channels
     (ref: DistributedHTTPSource.scala MultiChannelMap:27-80 — adds rotate
     through channel lists; updateNLists disperses orphaned channels on
     elastic resize).
+
+    Placement is least-loaded-first among ENABLED channels (rotation
+    order breaks ties, so an idle map degrades to exact round-robin):
+    a channel whose consumer backs up sheds new load to its siblings
+    instead of accumulating it — the queue-depth half of the channel
+    circuit breakers. ``set_channel_enabled(i, False)`` quarantines a
+    channel (breaker OPEN): placement never picks it while any enabled
+    channel exists, and its parked requests re-disperse immediately.
+    When EVERY channel is disabled, placement degrades to least-loaded
+    over all of them — availability over purity; the half-open probes
+    re-admit channels as they heal.
 
     All channel-list access stays under the lock (queue puts included —
     they never block, so holding the lock is safe): a put outside it
@@ -562,17 +737,45 @@ class MultiChannelMap:
             queue.Queue() for _ in range(max(1, n_channels))
         ]
         self._add_index = 0
+        self._disabled: set = set()
 
     @property
     def n_channels(self) -> int:
         with self._lock:
             return len(self._channels)
 
+    def depths(self) -> List[int]:
+        """Current queue depth per channel (one consistent snapshot)."""
+        with self._lock:
+            return [q.qsize() for q in self._channels]
+
+    def enabled_channels(self) -> List[int]:
+        """Indices placement may currently target (breaker CLOSED)."""
+        with self._lock:
+            return [i for i in range(len(self._channels))
+                    if i not in self._disabled]
+
+    def _place(self, item: CachedRequest):
+        """Least-loaded enabled channel, rotation-order tiebreak —
+        caller holds the lock. With no consumers draining, depths grow
+        uniformly and this IS round-robin; under skewed drain rates the
+        deepest channel stops receiving."""
+        n = len(self._channels)
+        candidates = [i for i in range(n) if i not in self._disabled] \
+            or list(range(n))
+        start = self._add_index
+        best = min(candidates,
+                   key=lambda i: (self._channels[i].qsize(),
+                                  (i - start) % n))
+        # every caller holds self._lock (the "caller holds the lock"
+        # contract in this method's docstring) — invisible to the
+        # analyzer's same-function guard detection
+        self._add_index = (best + 1) % n  # synlint: disable=CC001
+        self._channels[best].put(item)
+
     def add(self, item: CachedRequest):
         with self._lock:
-            i = self._add_index
-            self._add_index = (self._add_index + 1) % len(self._channels)
-            self._channels[i].put(item)
+            self._place(item)
 
     def channel(self, i: int) -> "queue.Queue[CachedRequest]":
         """Current queue for channel ``i`` (clamped: a concurrent shrink
@@ -580,26 +783,42 @@ class MultiChannelMap:
         with self._lock:
             return self._channels[i % len(self._channels)]
 
+    def set_channel_enabled(self, i: int, enabled: bool) -> int:
+        """Quarantine (``False``) or re-admit (``True``) channel ``i``.
+        Quarantining re-disperses its parked requests onto enabled
+        channels — a request must never sit on a queue no healthy
+        consumer drains. Returns how many requests moved."""
+        with self._lock:
+            if not 0 <= i < len(self._channels):
+                return 0
+            if enabled:
+                self._disabled.discard(i)
+                return 0
+            self._disabled.add(i)
+            # drain FULLY before re-placing: when every channel is
+            # disabled _place's availability fallback may legitimately
+            # pick this channel again
+            orphaned = _drain_all(self._channels[i])
+            for item in orphaned:
+                self._place(item)
+            return len(orphaned)
+
     def update_n_channels(self, n: int):
         """Resize; requests parked on removed channels are re-dispersed
-        (ref: updateNLists:39-52)."""
+        (ref: updateNLists:39-52). Quarantine state for surviving
+        indices is preserved; removed indices forget theirs."""
         n = max(1, n)
         with self._lock:
             orphaned: List[CachedRequest] = []
             while len(self._channels) > n:
                 dead = self._channels.pop()
-                while True:
-                    try:
-                        orphaned.append(dead.get_nowait())
-                    except queue.Empty:
-                        break
+                self._disabled.discard(len(self._channels))
+                orphaned.extend(_drain_all(dead))
             while len(self._channels) < n:
                 self._channels.append(queue.Queue())
             self._add_index %= len(self._channels)
             for item in orphaned:
-                i = self._add_index
-                self._add_index = (self._add_index + 1) % len(self._channels)
-                self._channels[i].put(item)
+                self._place(item)
 
 
 def device_for_channel(channel: int, devices=None):
@@ -617,17 +836,55 @@ def device_for_channel(channel: int, devices=None):
     return devices[channel % len(devices)]
 
 
+# circuit-breaker states, exported on the
+# serving_channel_breaker_state{channel=} gauge. CLOSED = traffic flows
+# (electrical convention: the circuit conducts); OPEN = quarantined;
+# HALF_OPEN = a canary probe is in flight.
+BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 0, 1, 2
+_BREAKER_STATE_NAMES = {BREAKER_CLOSED: "closed", BREAKER_OPEN: "open",
+                        BREAKER_HALF_OPEN: "half_open"}
+
+
 class DistributedServer:
     """Serving v1 analogue: ONE shared HTTP server per host whose
-    requests distribute round-robin across worker channels
+    requests distribute across worker channels
     (ref: DistributedHTTPSource.scala JVMSharedServer:90 shared via
-    SharedSingleton :384, MultiChannelMap round-robin :27,
-    DistributedHTTPSink:364). Each shard drains its own channel with
-    ``get_batch(channel=i)`` and replies through the shared server."""
+    SharedSingleton :384, MultiChannelMap :27, DistributedHTTPSink:364).
+    Each shard drains its own channel with ``get_batch(channel=i)`` and
+    replies through the shared server — or :meth:`serve` runs the
+    per-channel scoring loops in-process.
+
+    The CHANNEL is the unit of fault tolerance (docs/robustness.md,
+    "channel failure domains"): each channel carries a circuit breaker.
+    ``breaker_threshold`` consecutive scoring failures — or a score
+    stalled past ``stall_timeout`` — trip it OPEN: the channel's device
+    is quarantined, its parked requests re-disperse onto healthy
+    channels, and new placement avoids it. A background probe then
+    flips it HALF_OPEN, re-scores a canary under the channel's own
+    fault points, and re-admits (CLOSED) on success. An in-hand batch
+    whose channel breaks mid-score fails over ONCE to a healthy channel
+    (:meth:`score_on_channel`) before any client-visible error —
+    bit-identically, since the failover re-runs the same scoring fn."""
 
     def __init__(self, name: str, n_channels: int,
                  host: str = "127.0.0.1", port: Optional[int] = None,
-                 reply_timeout: float = 60.0):
+                 reply_timeout: float = 60.0,
+                 breaker_threshold: int = 3,
+                 probe_interval: float = 0.25,
+                 stall_timeout: Optional[float] = None,
+                 canary_fn: Optional[Callable[[int], Any]] = None):
+        """``breaker_threshold``: consecutive failures that trip a
+        channel OPEN (1 = first failure quarantines). ``probe_interval``
+        seconds between half-open canary probes of OPEN channels.
+        ``stall_timeout``: a score wall-time past this counts as a
+        breaker failure even though its result still returns (the
+        slow-channel trip condition; None = off). ``canary_fn(ch)``:
+        extra health work the half-open probe runs on the quarantined
+        channel (e.g. re-score a pinned canary batch on its device);
+        when None, :meth:`serve` wires a default that re-scores the
+        first successfully scored row through the real pipeline. The
+        probe always fires the channel's fault points, so injected
+        chaos alone round-trips OPEN -> HALF_OPEN -> CLOSED."""
         self.server = HTTPSourceStateHolder.get_or_create_server(
             name, host, port, reply_timeout=reply_timeout)
         # exactly one distributor may own a server's request queue: a
@@ -642,6 +899,35 @@ class DistributedServer:
                     f"attached; reuse that instance or pick another name")
             self.server._dist_owner = self  # synlint: shared
         self.channels = MultiChannelMap(n_channels)
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.probe_interval = float(probe_interval)
+        self.stall_timeout = stall_timeout
+        self.canary_fn = canary_fn
+        # breaker state: guarded by its own lock. Lock order is
+        # breaker -> map, ONE direction — state flips and their matching
+        # map enable/disable happen together under the breaker lock
+        # (map calls never block: queue puts only), so a channel can
+        # never be breaker-OPEN yet placement-enabled, which would park
+        # requests on a queue whose consumer loop is idling
+        self._breaker_lock = threading.Lock()
+        self._breaker_state: Dict[int, int] = {}
+        self._breaker_fails: Dict[int, int] = {}
+        # one-row snapshot of the first successfully scored input:
+        # serve()'s default canary re-scores it through the REAL
+        # pipeline so the half-open probe proves the device works, not
+        # just that a no-op returns (benign last-write-wins race between
+        # channel loops: every candidate snapshot is known-good)
+        self._canary_table: Optional[Table] = None  # synlint: shared
+        self._channel_points: Dict[int, "_flt.FaultPoint"] = {}
+        self._m_failover = _tm.counter("serving_failover_total",
+                                       server=name)
+        self._m_redispersed = _tm.counter("serving_redispersed_total",
+                                          server=name)
+        self._m_trips = _tm.counter("serving_channel_trips_total",
+                                    server=name)
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_wake = threading.Event()
+        self._serve_threads: List[threading.Thread] = []
         self._n_channel_gauges = 0
         self._sync_channel_gauges()
         self._stop = threading.Event()
@@ -654,22 +940,214 @@ class DistributedServer:
         self._distributor.start()
 
     def _sync_channel_gauges(self):
-        """One scrape-time depth sampler per live channel (re-synced on
-        elastic resize; samplers beyond the new count are dropped)."""
+        """One scrape-time depth sampler + breaker-state gauge per live
+        channel (re-synced on elastic resize; samplers beyond the new
+        count are dropped)."""
         n = self.channels.n_channels
         for i in range(n):
             _tm.gauge_fn(
                 "serving_channel_depth",
                 lambda ch=i: self.channels.channel(ch).qsize(),
                 server=self.server.name, channel=str(i))
+            _tm.gauge_fn(
+                "serving_channel_breaker_state",
+                lambda ch=i: self.channel_state(ch),
+                server=self.server.name, channel=str(i))
         for i in range(n, self._n_channel_gauges):
             _tm.unregister("serving_channel_depth",
+                           server=self.server.name, channel=str(i))
+            _tm.unregister("serving_channel_breaker_state",
                            server=self.server.name, channel=str(i))
         self._n_channel_gauges = n
 
     @property
     def url(self) -> str:
         return self.server.url
+
+    # -- channel circuit breakers ---------------------------------------
+
+    def channel_state(self, channel: int) -> int:
+        """BREAKER_CLOSED / BREAKER_OPEN / BREAKER_HALF_OPEN."""
+        with self._breaker_lock:
+            return self._breaker_state.get(channel, BREAKER_CLOSED)
+
+    def _set_state_locked(self, channel: int, state: int):
+        # caller holds _breaker_lock. Transitions are COUNTED (not just
+        # gauged): a probe's OPEN->HALF_OPEN->OPEN bounce is faster than
+        # any scrape interval, so the chaos check asserts the counter,
+        # the dashboards plot the gauge.
+        prev = self._breaker_state.get(channel, BREAKER_CLOSED)
+        if prev == state:
+            return
+        self._breaker_state[channel] = state
+        _tm.counter("serving_breaker_transitions_total",
+                    server=self.server.name, channel=str(channel),
+                    state=_BREAKER_STATE_NAMES[state]).inc()
+
+    def _channel_point(self, channel: int) -> "_flt.FaultPoint":
+        """The channel's ``compute.channel<N>`` fault point, resolved
+        lazily — channel counts are a runtime property, unlike the
+        import-time module points."""
+        p = self._channel_points.get(channel)
+        if p is None:
+            p = self._channel_points.setdefault(
+                channel, _flt.point("compute", f"channel{channel}"))
+        return p
+
+    def _record_channel_success(self, channel: int):
+        with self._breaker_lock:
+            self._breaker_fails[channel] = 0
+            if self._breaker_state.get(channel,
+                                       BREAKER_CLOSED) != BREAKER_CLOSED:
+                # state flip + map re-enable are ATOMIC under the
+                # breaker lock: a racing trip on another thread cannot
+                # interleave its disable between them and leave the
+                # channel OPEN-but-enabled (a request black hole)
+                self._set_state_locked(channel, BREAKER_CLOSED)
+                self.channels.set_channel_enabled(channel, True)
+
+    def _record_channel_failure(self, channel: int) -> bool:
+        """Count one failure against the channel; returns True when it
+        tripped the breaker just now (quarantine + redisperse done)."""
+        with self._breaker_lock:
+            if self._breaker_state.get(channel,
+                                       BREAKER_CLOSED) == BREAKER_OPEN:
+                return False
+            fails = self._breaker_fails.get(channel, 0) + 1
+            self._breaker_fails[channel] = fails
+            if fails < self.breaker_threshold:
+                return False
+            self._set_state_locked(channel, BREAKER_OPEN)
+            # quarantine atomically with the state flip (breaker -> map
+            # order): re-disperse what was parked on the channel
+            moved = self.channels.set_channel_enabled(channel, False)
+        if moved:
+            self._m_redispersed.inc(moved)
+        self._m_trips.inc()
+        self._ensure_probe_thread()
+        self._probe_wake.set()
+        return True
+
+    def _channel_score(self, channel: int, score_fn: Callable[[], Any]):
+        """Run one unit of scoring work AS channel ``channel``: fires
+        the shared stall point and the channel's own compute point
+        first, so injected channel faults land exactly here."""
+        _F_LAT_STALL.fire()
+        self._channel_point(channel).fire()
+        return score_fn()
+
+    def _failover_target(self, exclude: int) -> Optional[int]:
+        """Least-loaded healthy channel other than ``exclude`` (depth-
+        aware, same policy as placement), or None when no healthy
+        sibling exists."""
+        depths = self.channels.depths()
+        best, best_depth = None, None
+        for ch in self.channels.enabled_channels():
+            if ch == exclude or self.channel_state(ch) != BREAKER_CLOSED:
+                continue
+            d = depths[ch] if ch < len(depths) else 0
+            if best is None or d < best_depth:
+                best, best_depth = ch, d
+        return best
+
+    def score_on_channel(self, channel: int,
+                         score_fn: Callable[[], Any]):
+        """Failover dispatch: run ``score_fn`` as channel ``channel``'s
+        scoring work under its fault points and breaker accounting. On
+        failure, the SAME in-hand work is re-dispatched ONCE to a
+        healthy channel before any client-visible error — bit-identical
+        output, because the failover re-runs the identical fn (the
+        channel only selects WHERE it runs). A score stalled past
+        ``stall_timeout`` counts as a breaker failure even though its
+        result still returns."""
+        t0 = time.monotonic()
+        try:
+            out = self._channel_score(channel, score_fn)
+        except Exception:
+            self._record_channel_failure(channel)
+            target = self._failover_target(exclude=channel)
+            if target is None:
+                raise  # no healthy sibling: the caller's error path
+            self._m_failover.inc()
+            t1 = time.monotonic()
+            try:
+                out = self._channel_score(target, score_fn)
+            except Exception:
+                # the same work failed on a healthy channel too: likely
+                # the BATCH, not the channel — but count it anyway; a
+                # wrongly tripped channel is re-admitted by its probe
+                self._record_channel_failure(target)
+                raise
+            self._record_outcome(target, t1)
+            return out
+        self._record_outcome(channel, t0)
+        return out
+
+    def _record_outcome(self, channel: int, t0: float):
+        """Success-or-stall accounting for one completed score: a score
+        stalled past ``stall_timeout`` counts as a breaker failure even
+        though its result still returns — on the FAILOVER attempt too,
+        or a degraded channel every failover lands on would be recorded
+        as an unconditional success and convoy the cluster."""
+        if (self.stall_timeout is not None
+                and time.monotonic() - t0 > self.stall_timeout):
+            self._record_channel_failure(channel)
+        else:
+            self._record_channel_success(channel)
+
+    def _ensure_probe_thread(self):
+        # check-and-start under the breaker lock: two channels tripping
+        # in the same instant must not each spawn a probe loop (the
+        # loser's thread would double-probe quarantined devices and
+        # escape stop()'s join, which only knows self._probe_thread)
+        with self._breaker_lock:
+            if (self._probe_thread is not None
+                    and self._probe_thread.is_alive()):
+                return
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop,
+                name=f"breaker-probe-{self.server.name}", daemon=True)
+            self._probe_thread.start()
+
+    def _probe_loop(self):
+        """Half-open probe: every ``probe_interval`` seconds, each OPEN
+        channel goes HALF_OPEN and re-scores a canary under its own
+        fault points (plus ``canary_fn``); success re-admits it CLOSED
+        (and re-enables placement), failure re-opens it."""
+        while not self._stop.is_set():
+            self._probe_wake.wait(self.probe_interval)
+            self._probe_wake.clear()
+            if self._stop.is_set():
+                return
+            with self._breaker_lock:
+                open_chs = [ch for ch, st in self._breaker_state.items()
+                            if st == BREAKER_OPEN]
+            for ch in open_chs:
+                with self._breaker_lock:
+                    self._set_state_locked(ch, BREAKER_HALF_OPEN)
+                t0 = time.monotonic()
+                try:
+                    self._channel_score(
+                        ch, (lambda: self.canary_fn(ch))
+                        if self.canary_fn is not None else lambda: None)
+                except Exception:
+                    ok = False
+                else:
+                    # the canary gets the SAME stall accounting as a
+                    # real score: a channel tripped for slowness must
+                    # not be re-admitted by a canary that itself
+                    # stalled (trip -> re-admit -> trip flapping, with
+                    # a redisperse every cycle)
+                    ok = (self.stall_timeout is None
+                          or time.monotonic() - t0 <= self.stall_timeout)
+                _tm.counter("serving_channel_probe_total",
+                            server=self.server.name,
+                            outcome="ok" if ok else "fail").inc()
+                if ok:
+                    self._record_channel_success(ch)
+                else:
+                    with self._breaker_lock:
+                        self._set_state_locked(ch, BREAKER_OPEN)
 
     def _distribute_supervised(self):
         """:func:`_supervise_loop` around :meth:`_distribute`: an
@@ -711,14 +1189,158 @@ class DistributedServer:
         return self.server.reply_to(rid, response)
 
     def update_n_channels(self, n: int):
+        if self._serve_threads:
+            # serve() snapshots the channel count: growing it now would
+            # route new requests (depth-aware _place prefers the empty
+            # newcomers) onto queues NO scorer loop drains — clients
+            # would park until reply_timeout with no error at the
+            # resize call
+            raise ValueError(
+                f"server {self.server.name!r} has channel scorers "
+                "running; resize while serving is not supported "
+                "(stop, resize, re-serve)")
         self.channels.update_n_channels(n)
         self._sync_channel_gauges()
 
+    # -- in-process channel scoring loops -------------------------------
+
+    def serve(self, pipeline_fn: Callable[[Table], Table],
+              max_batch: int = 64, linger: float = 0.0,
+              coalesce: float = 0.0, parse_json: bool = True,
+              reply_col: str = "reply") -> "DistributedServer":
+        """Start one supervised scorer thread per channel — the
+        multi-channel serving query. Each loop drains its own channel
+        and scores through :meth:`score_on_channel`, so a channel whose
+        device breaks mid-score fails its in-hand batch over to a
+        healthy sibling (200, bit-identical) instead of 500ing, and a
+        tripped channel idles until its half-open probe re-admits it
+        (its parked requests having re-dispersed at trip time). The
+        channel-count is snapshotted here; resize while serving is not
+        supported (stop, resize, re-serve)."""
+        if self._serve_threads:
+            raise ValueError(
+                f"server {self.server.name!r} already has channel "
+                "scorers running")
+        if self.canary_fn is None:
+            # a no-op canary would re-admit a genuinely broken device
+            # every probe_interval (trip -> re-admit flapping, one
+            # redisperse per cycle): probe with the real pipeline
+            self.canary_fn = self._pipeline_canary(pipeline_fn)
+        for ch in range(self.channels.n_channels):
+            t = threading.Thread(
+                target=self._channel_loop_supervised,
+                args=(ch, pipeline_fn, max_batch, linger, coalesce,
+                      parse_json, reply_col),
+                name=f"chan-scorer-{self.server.name}-{ch}", daemon=True)
+            t.start()
+            self._serve_threads.append(t)
+        return self
+
+    def _pipeline_canary(self, pipeline_fn) -> Callable[[int], Any]:
+        """Default half-open canary for :meth:`serve`: re-score the
+        captured known-good one-row input through the REAL pipeline, so
+        re-admission proves the channel can score — not just that a
+        no-op returns. Before the first successful score nothing is
+        known-good, so the probe degrades to firing the channel's fault
+        points only (injected chaos still round-trips the breaker)."""
+        def canary(ch: int):
+            table = self._canary_table
+            if table is not None:
+                pipeline_fn(table)
+        return canary
+
+    def _channel_loop_supervised(self, ch: int, *args):
+        _supervise_loop(
+            lambda: self._channel_loop(ch, *args), self._stop,
+            lambda e: _tm.counter("serving_thread_restarts_total",
+                                  server=self.server.name,
+                                  thread=f"channel{ch}").inc())
+
+    def _channel_loop(self, ch: int, pipeline_fn, max_batch, linger,
+                      coalesce, parse_json, reply_col):
+        while not self._stop.is_set():
+            if self.channel_state(ch) != BREAKER_CLOSED:
+                # quarantined: parked requests re-dispersed at trip
+                # time and placement avoids this channel — idle until
+                # the probe re-admits it
+                time.sleep(0.02)
+                continue
+            batch = self.get_batch(ch, max_batch, timeout=0.05,
+                                   linger=linger, coalesce=coalesce)
+            if not batch:
+                continue
+            self._score_channel_batch(ch, batch, pipeline_fn,
+                                      parse_json, reply_col)
+
+    def _score_channel_batch(self, ch: int, batch: List[CachedRequest],
+                             pipeline_fn, parse_json, reply_col):
+        """Score one channel's micro-batch (with one-shot failover via
+        :meth:`score_on_channel`) and reply; a batch that failed on TWO
+        channels gets an explicit 500 — never a hang, never a silent
+        drop."""
+        def run():
+            table = requests_to_table(batch)
+            if parse_json:
+                table = parse_request(table)
+            out = pipeline_fn(table)
+            if self._canary_table is None:
+                # first known-good input: one row is all the probe
+                # needs (copied so the slice doesn't pin the batch)
+                snap = Table({c: table[c][:1].copy()
+                              for c in table.columns})
+                with self._breaker_lock:
+                    if self._canary_table is None:
+                        self._canary_table = snap
+            return out
+
+        err: Optional[BaseException] = None
+        try:
+            out = self.score_on_channel(ch, run)
+        except Exception as e:  # noqa: BLE001 - channel loop must survive
+            err = e
+        if err is None:
+            try:
+                send_replies(self.server, out, reply_col)
+            except Exception as e:  # noqa: BLE001 - bad reply col etc.
+                err = e
+        if err is not None:
+            for cr in batch:
+                self.server.reply_to(cr.rid, HTTPResponseData(
+                    status_code=500, reason="channel scoring error",
+                    entity=repr(err).encode()))
+        for cr in batch:
+            cr.span.finish("ok" if err is None else "error")
+        for ep in sorted({cr.epoch for cr in batch}):
+            self.server.commit(ep, exact=True)
+
+    def drain(self, timeout_ms: float = 5000.0) -> bool:
+        """Graceful drain across ALL channels — delegates to
+        :meth:`WorkerServer.drain` (requests fanned out onto channel
+        queues still park their connections in the shared server's
+        routing table, so its convergence check covers them). Returns
+        True when fully drained; call :meth:`stop` after either way."""
+        return self.server.drain(timeout_ms)
+
     def stop(self):
         self._stop.set()
+        self._probe_wake.set()
         self._distributor.join(timeout=2)
+        for t in self._serve_threads:
+            t.join(timeout=5)
+        self._serve_threads = []
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=2)
+        # requests still parked on channel queues when the scorers exit
+        # get an explicit 503 + Retry-After (counted), never a silent
+        # drop into reply_timeout; the shared server's own intake queue
+        # is shed the same way by server.stop() via the registry
+        # removal below
+        for ch in range(self.channels.n_channels):
+            self.server.fail_queued(q=self.channels.channel(ch))
         for i in range(self._n_channel_gauges):
             _tm.unregister("serving_channel_depth",
+                           server=self.server.name, channel=str(i))
+            _tm.unregister("serving_channel_breaker_state",
                            server=self.server.name, channel=str(i))
         self._n_channel_gauges = 0
         with self.server._lock:
@@ -807,7 +1429,9 @@ class ContinuousServer:
                  deadline_ms: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  retry_transient: int = 1,
-                 retry_backoff: float = 0.05):
+                 retry_backoff: float = 0.05,
+                 retry_rng: Optional[Any] = None,
+                 retry_after_s: Optional[float] = None):
         """``batch_linger``: seconds to keep collecting after the first
         request of a batch arrives. A few ms turns concurrent clients'
         requests into ONE scored micro-batch (one device round trip
@@ -867,7 +1491,11 @@ class ContinuousServer:
         how many times a :class:`PipelineBrokenError` from the scoring
         pipeline is retried (with ``retry_backoff``-scaled jittered
         sleep) against the supervision-restarted executor pipeline
-        before the batch takes the 500 path."""
+        before the batch takes the 500 path. ``retry_rng``: the PRNG
+        behind the jitter — inject a seeded ``random.Random`` so retry
+        timing is deterministic under test (``SYNAPSEML_RETRY_SEED``
+        is the env route, see :func:`_retry_rng`). ``retry_after_s``
+        overrides the server's Retry-After hint on shed replies."""
         self.server = HTTPSourceStateHolder.get_or_create_server(
             name, host, port, reply_timeout=reply_timeout, ready=ready,
             default_deadline_ms=deadline_ms, max_queue=max_queue)
@@ -880,6 +1508,8 @@ class ContinuousServer:
             self.server.default_deadline_ms = deadline_ms
         if max_queue is not None:
             self.server.max_queue = max_queue
+        if retry_after_s is not None:
+            self.server.retry_after_s = retry_after_s
         self.name = name
         self.pipeline_fn = pipeline_fn
         self.max_batch = max_batch
@@ -916,6 +1546,7 @@ class ContinuousServer:
                                         server=name)
         self.retry_transient = max(0, int(retry_transient))
         self.retry_backoff = float(retry_backoff)
+        self._retry_rng = _retry_rng(retry_rng)
         self._m_deadline_shed = _tm.counter("serving_deadline_shed_total",
                                             server=name)
         self._m_retry = _tm.counter("serving_retry_total", server=name)
@@ -1047,10 +1678,14 @@ class ContinuousServer:
              else live).append(cr)
         if expired:
             self._m_deadline_shed.inc(len(expired))
+            # Retry-After rides the shed 504 too: a deadline-expired
+            # request usually means the replica is saturated — backing
+            # off beats an immediate re-hammer that will expire again
+            hdrs = {"Retry-After": self.server._retry_after_value()}
             for cr in expired:
                 self.server.reply_to(cr.rid, HTTPResponseData(
                     status_code=504, reason="deadline exceeded before "
-                    "scoring"))
+                    "scoring", headers=hdrs))
                 cr.span.finish("shed")
             live_eps = {cr.epoch for cr in live}
             for ep in sorted({cr.epoch for cr in expired} - live_eps):
@@ -1110,7 +1745,8 @@ class ContinuousServer:
             if not isinstance(err, PipelineBrokenError):
                 break
             self._m_retry.inc()
-            time.sleep(self.retry_backoff * (0.5 + random.random()))
+            time.sleep(self.retry_backoff
+                       * (0.5 + self._retry_rng.random()))
             out, err = self._score_only(batch)
         if err is None:
             return [(batch, out, None, 200)]
@@ -1267,6 +1903,12 @@ class ContinuousServer:
         self._thread.start()
         return self
 
+    def drain(self, timeout_ms: float = 5000.0) -> bool:
+        """Graceful drain — delegates to :meth:`WorkerServer.drain`
+        (the SIGTERM half of a k8s rolling restart; ``main()`` calls
+        this on signal, then :meth:`stop`)."""
+        return self.server.drain(timeout_ms)
+
     def stop(self):
         self._stop.set()
         if self._thread is not None:
@@ -1364,6 +2006,13 @@ def main(argv=None):
         "SYNAPSEML_MAX_QUEUE", "0")),
         help="admission control: shed requests 429 at enqueue once this "
              "many are already queued (0 = unbounded)")
+    ap.add_argument("--drain-timeout-ms", type=float,
+                    default=float(os.environ.get(
+                        "SYNAPSEML_DRAIN_TIMEOUT_MS", "5000")),
+        help="graceful-drain budget on SIGTERM: new requests get 503 + "
+             "Retry-After immediately, accepted requests get up to this "
+             "long to finish to a real reply before the process exits "
+             "(k8s terminationGracePeriodSeconds must exceed it)")
     ap.add_argument("--cache-dir", default=os.environ.get(
         "SYNAPSEML_COMPILE_CACHE") or None,
         help="persistent compile-cache directory (mount a volume here so "
@@ -1445,7 +2094,45 @@ def main(argv=None):
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    # graceful lifecycle: readiness flips 503 (+ Retry-After on new
+    # enqueues) IMMEDIATELY, accepted requests drain to real replies
+    # under the deadline, then stop() 503s any stragglers explicitly —
+    # a k8s rolling restart drops zero accepted requests
+    print(f"SIGTERM: draining (budget {args.drain_timeout_ms:.0f}ms) ...",
+          flush=True)
+    drained = cs.drain(args.drain_timeout_ms)
+    print(f"drain {'complete' if drained else 'timed out'}; stopping",
+          flush=True)
     cs.stop()
+    # exact zero-drop accounting for the rolling-restart contract:
+    # serving_requests_total counts every request the HTTP layer saw,
+    # serving_replies_total counts every terminal reply (incremented
+    # before the socket send, so a dead client still counts) — equal
+    # numbers prove no admitted request exited without a reply. The
+    # chaos CI sigterm check asserts on this line; client-side socket
+    # errors can't distinguish a dropped admitted request from a
+    # connection RST out of the never-accepted TCP backlog. Handler
+    # threads woken by the drain/stop shed may not have been scheduled
+    # yet when stop() returns (nothing joins daemon handlers), so wait
+    # briefly for the counters to converge; a genuinely dropped request
+    # has nothing left to wake it and still reports a mismatch
+    def _accounting() -> Tuple[float, float]:
+        counters = _tm.snapshot()["counters"]
+        admitted = sum(v for k, v in counters.items()
+                       if k.startswith("synapseml_serving_requests_total")
+                       and f'server="{args.name}"' in k)
+        replied = sum(v for k, v in counters.items()
+                      if k.startswith("synapseml_serving_replies_total")
+                      and f'server="{args.name}"' in k)
+        return admitted, replied
+
+    admitted, replied = _accounting()
+    deadline = time.monotonic() + 2.0
+    while admitted != replied and time.monotonic() < deadline:
+        time.sleep(0.02)
+        admitted, replied = _accounting()
+    print(f"exit accounting: admitted={admitted:.0f} "
+          f"replied={replied:.0f}", flush=True)
     return 0
 
 

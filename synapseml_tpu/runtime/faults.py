@@ -30,10 +30,15 @@ Points (catalog in docs/robustness.md):
 ====================  =====================================================
 ``staging``           host coerce+pad worker (executor ``_stage_worker``)
 ``h2d``               host->device placement (executor ``_dispatch``)
-``compute``           compiled-program call (executor ``_dispatch``)
+``compute``           compiled-program call (executor ``_dispatch``);
+                      scopes ``channel<N>`` hit ONE serving channel's
+                      scoring path (``DistributedServer``) — the failure
+                      domain the channel circuit breakers quarantine
 ``drain``             device->host fetch (executor ``_drain_loop``)
 ``reply``             reply serialization/send (serving ``_reply_scored``)
-``latency``           injected sleep — scopes ``dispatch``, ``score``
+``latency``           injected sleep — scopes ``dispatch``, ``score``,
+                      ``channel_stall`` (per-channel scoring stall: the
+                      breaker's slow-channel trip condition)
 ``thread_kill``       raises :class:`ThreadKilled` (a BaseException) at a
                       pipeline-loop top so the THREAD dies, not the batch
                       — scopes ``stage``, ``dispatch``, ``drain``,
@@ -58,6 +63,7 @@ from __future__ import annotations
 import builtins
 import os
 import random
+import re
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -67,7 +73,7 @@ from synapseml_tpu.runtime import telemetry as _tm
 __all__ = [
     "FaultInjected", "ThreadKilled", "PipelineBrokenError", "FaultPoint",
     "point", "activate", "deactivate", "configure", "active",
-    "POINT_NAMES", "POINT_SCOPES",
+    "POINT_NAMES", "POINT_SCOPES", "POINT_SCOPE_PATTERNS",
 ]
 
 POINT_NAMES = ("staging", "h2d", "compute", "drain", "reply",
@@ -79,9 +85,17 @@ POINT_NAMES = ("staging", "h2d", "compute", "drain", "reply",
 # injects NOTHING and proves nothing. Families absent here take no
 # scope at all.
 POINT_SCOPES: Dict[str, Tuple[str, ...]] = {
-    "latency": ("dispatch", "score"),
+    "latency": ("dispatch", "score", "channel_stall"),
     "thread_kill": ("stage", "dispatch", "drain", "scorer", "reply",
                     "collector", "distributor"),
+}
+
+# open-ended scope families: serving channels are numbered at runtime
+# (``compute.channel0``, ``compute.channel7``, ...), so the catalog
+# validates them by pattern instead of enumeration — ``channelX`` is
+# still a loud ValueError
+POINT_SCOPE_PATTERNS: Dict[str, "re.Pattern[str]"] = {
+    "compute": re.compile(r"^channel\d+$"),
 }
 
 
@@ -218,10 +232,15 @@ def activate(point_name: str, prob: float = 1.0,
             f"unknown fault point {point_name!r} (families: "
             f"{', '.join(POINT_NAMES)})")
     known_scopes = POINT_SCOPES.get(name, ())
-    if scope is not None and scope not in known_scopes:
+    pattern = POINT_SCOPE_PATTERNS.get(name)
+    if scope is not None and scope not in known_scopes and not (
+            pattern is not None and pattern.match(scope)):
+        hints = list(known_scopes)
+        if pattern is not None:
+            hints.append(pattern.pattern)
         raise ValueError(
             f"unknown scope {scope!r} for fault point {name!r}"
-            + (f" (scopes: {', '.join(known_scopes)})" if known_scopes
+            + (f" (scopes: {', '.join(hints)})" if hints
                else " (this family takes no scope)"))
     if name == "latency" and latency_ms == 0.0:
         latency_ms = 10.0

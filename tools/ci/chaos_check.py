@@ -11,15 +11,29 @@ Asserts (docs/robustness.md):
 - a request with an already-expired deadline is shed 504;
 - after a drain-thread kill, supervision restarts the pipeline and the
   serving retry masks the break (client sees 200);
-- GET /metrics shows the injections, restarts, and sheds.
+- GET /metrics shows the injections, restarts, and sheds;
+- channel kill under open-loop load (phase 4): with ``compute.channel0``
+  armed at prob 1.0 on a 2-channel DistributedServer, loadgen traffic
+  keeps flowing — requests on the broken channel fail over to the
+  healthy sibling (200, bit-identical), the breaker trips
+  CLOSED->OPEN, the half-open probe re-admits the channel once the
+  fault is disarmed, and goodput recovers to 100%;
+- SIGTERM rolling restart (phase 5): a real serving subprocess under
+  loadgen traffic drains on SIGTERM — every accepted request gets a
+  real reply, new requests get 503 + Retry-After, and the process
+  exits 0 within its --drain-timeout-ms budget.
 
 Driven under a hard timeout: a wedged pipeline hangs rather than fails,
 so it becomes a fast exit-124 instead of a stuck job.
 """
 import json
 import os
+import re
+import signal
+import subprocess
 import sys
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -47,6 +61,246 @@ def series_total(text: str, name: str) -> float:
         if ln.startswith(name) and not ln.startswith(name + "_"):
             total += float(ln.rsplit(" ", 1)[1])
     return total
+
+
+def channel_kill_phase() -> int:
+    """Phase 4: kill one channel of a DistributedServer under open-loop
+    loadgen traffic; assert failover (200, bit-identical), breaker
+    CLOSED->OPEN->HALF_OPEN->CLOSED, goodput recovery, zero hangs.
+    Requires the ``compute`` family DISARMED (phase 3 does that) so the
+    only fault in play is the channel-scoped one."""
+    from synapseml_tpu.io.serving import (BREAKER_CLOSED,
+                                          DistributedServer, make_reply)
+    from synapseml_tpu.runtime import faults as flt
+    from tools.loadgen import run_load
+
+    def pipeline(table):
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply(
+                {"y": [x * 3.0 + 1.0 for x in v["x"]]})
+        return table.with_column("reply", replies)
+
+    ds = DistributedServer("chaos_channels", n_channels=2,
+                           breaker_threshold=2, probe_interval=0.1)
+    ds.serve(pipeline, max_batch=16, linger=0.002)
+    try:
+        flt.activate("compute.channel0", prob=1.0)
+        # open-loop load against the half-broken server: every request
+        # must reach a terminal status, and failover means they succeed
+        s = run_load(ds.url, rps=120, duration_s=2.0, shapes=[2, 4, 8],
+                     seed=11, timeout=30.0)
+        if s["hung"]:
+            print(f"FAIL[ch]: {s['hung']} loadgen requests never got a "
+                  "terminal response")
+            return 1
+        bad = [c for c in s["by_status"]
+               if c not in ("200", "500", "503")]
+        if bad:
+            print(f"FAIL[ch]: unexpected statuses {bad} under channel "
+                  f"kill ({s['by_status']})")
+            return 1
+        if s["by_status"].get("200", 0) == 0:
+            print(f"FAIL[ch]: zero successes under channel kill "
+                  f"({s['by_status']})")
+            return 1
+        # bit-identity while the fault is STILL armed: a request routed
+        # to the broken channel fails over and scores the same numbers
+        # a healthy channel produces
+        for k in range(6):
+            st, body = post(ds.url, {"x": [float(k), 2.0]})
+            want = [k * 3.0 + 1.0, 7.0]
+            if st != 200 or body["y"] != want:
+                print(f"FAIL[ch]: under armed channel0 fault got "
+                      f"{st} {body}, wanted 200 {want}")
+                return 1
+        # quarantined = NOT CLOSED: the trip-woken probe may be
+        # mid-pass (HALF_OPEN) at observation time, and the armed
+        # fault fails its canary so CLOSED is unreachable
+        if ds.channel_state(0) == BREAKER_CLOSED:
+            print(f"FAIL[ch]: channel0 breaker state "
+                  f"{ds.channel_state(0)}, wanted quarantined "
+                  "(OPEN/HALF_OPEN)")
+            return 1
+        # disarm -> the half-open probe must re-admit the channel
+        flt.deactivate("compute.channel0")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                ds.channel_state(0) != BREAKER_CLOSED:
+            time.sleep(0.05)
+        if ds.channel_state(0) != BREAKER_CLOSED:
+            print("FAIL[ch]: probe never re-admitted channel0 after "
+                  "the fault was disarmed")
+            return 1
+        # goodput recovers to 100% on the healed pair
+        s2 = run_load(ds.url, rps=120, duration_s=1.0, shapes=[2],
+                      seed=12, timeout=30.0)
+        if s2["hung"] or s2["by_status"].get("200", 0) != s2["scheduled"]:
+            print(f"FAIL[ch]: goodput did not recover after re-admit "
+                  f"({s2['by_status']}, hung={s2['hung']})")
+            return 1
+
+        host = ds.url.split("//")[1].rstrip("/")
+        with urllib.request.urlopen(
+                urllib.request.Request(f"http://{host}/metrics"),
+                timeout=30) as r:
+            metrics = r.read().decode()
+        # transition COUNTERS, not the gauge: the probe's
+        # OPEN->HALF_OPEN->CLOSED bounce is faster than any scrape
+        floors = {
+            'synapseml_serving_failover_total': 1,
+            'synapseml_serving_channel_trips_total': 1,
+        }
+        for st_name in ("open", "half_open", "closed"):
+            floors['synapseml_serving_breaker_transitions_total{'
+                   f'channel="0",server="chaos_channels",'
+                   f'state="{st_name}"}}'] = 1
+        for name, floor in floors.items():
+            got = series_total(metrics, name)
+            if got < floor:
+                print(f"FAIL[ch]: {name} = {got}, wanted >= {floor}")
+                return 1
+        print(f"channel-kill ok: {s['by_status'].get('200', 0)}"
+              f"/{s['scheduled']} under armed channel0 fault, "
+              f"failovers="
+              f"{series_total(metrics, 'synapseml_serving_failover_total'):.0f}, "
+              f"goodput recovered {s2['by_status'].get('200', 0)}"
+              f"/{s2['scheduled']}")
+        return 0
+    finally:
+        flt.deactivate("compute.channel0")
+        ds.stop()
+
+
+def sigterm_phase() -> int:
+    """Phase 5: SIGTERM a REAL serving subprocess (echo pipeline) under
+    open-loop loadgen traffic. Every request started before the signal
+    gets a real reply (200 — or 503 if it raced the drain flip); new
+    requests during drain get 503 + Retry-After; the process exits 0
+    within its --drain-timeout-ms budget. Zero dropped accepted
+    requests is THE rolling-restart contract the k8s preStop/
+    terminationGracePeriodSeconds wiring depends on."""
+    from tools.loadgen import run_load
+
+    env = dict(os.environ)
+    env.pop("SYNAPSEML_FAULTS", None)  # the child serves clean
+    env.setdefault("PYTHONPATH", os.getcwd())
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "synapseml_tpu.io.serving",
+         "--host", "127.0.0.1", "--port", "0", "--name", "chaos_drain",
+         "--drain-timeout-ms", "4000"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    try:
+        # one reader thread for the child's whole stdout: readline
+        # blocks, so waiting on an Event (not the read loop) is what
+        # makes the announce deadline real — and continuous reading
+        # means the child never blocks on a full pipe either
+        lines: list = []
+        url_box: dict = {}
+        url_found = threading.Event()
+
+        def read_stdout():
+            for line in proc.stdout:
+                lines.append(line)
+                if not url_found.is_set():
+                    m = re.search(r"serving \[.*\] on (http://\S+/)",
+                                  line)
+                    if m:
+                        url_box["url"] = m.group(1)
+                        url_found.set()
+
+        t_reader = threading.Thread(target=read_stdout, daemon=True)
+        t_reader.start()
+        if not url_found.wait(60.0):
+            print("FAIL[term]: serving subprocess never announced its "
+                  "URL")
+            return 1
+        url = url_box["url"]
+
+        t_sig = {}
+        completions = []
+        lock = threading.Lock()
+
+        def on_result(i, status, dt):
+            with lock:
+                completions.append((i, status, time.monotonic() - dt))
+
+        def fire_sigterm():
+            time.sleep(0.8)
+            t_sig["t"] = time.monotonic()
+            proc.send_signal(signal.SIGTERM)
+
+        killer = threading.Thread(target=fire_sigterm, daemon=True)
+        killer.start()
+        s = run_load(url, rps=100, duration_s=1.6, shapes=[2, 4],
+                     seed=21, timeout=30.0, on_result=on_result)
+        killer.join(timeout=10)
+        rc = proc.wait(timeout=20)
+        if rc != 0:
+            print(f"FAIL[term]: serving subprocess exited {rc}, "
+                  "wanted 0")
+            return 1
+        if s["hung"]:
+            print(f"FAIL[term]: {s['hung']} requests never got a "
+                  "terminal record")
+            return 1
+        # client side: a request started before SIGTERM that got an
+        # HTTP reply must have gotten a REAL one (200, or 503 if it
+        # raced the drain flip) — a 500/504 here is a drop. Socket
+        # 'error' records are NOT classified from the client: under
+        # load a connection can land in the TCP backlog, never reach
+        # the HTTP layer, and get RST when the listener closes — the
+        # server never admitted it. Admitted-request drops are caught
+        # EXACTLY by the child's exit accounting below.
+        dropped = [(i, st) for i, st, started in completions
+                   if started < t_sig["t"]
+                   and st not in (200, 503, "error")]
+        if dropped:
+            print(f"FAIL[term]: accepted-before-SIGTERM requests "
+                  f"dropped: {dropped[:5]}")
+            return 1
+        n_ok = s["by_status"].get("200", 0)
+        n_drained = s["by_status"].get("503", 0)
+        if n_ok == 0:
+            print(f"FAIL[term]: zero requests succeeded before drain "
+                  f"({s['by_status']})")
+            return 1
+        if n_drained == 0:
+            print(f"FAIL[term]: zero requests saw the drain 503 "
+                  f"({s['by_status']}) — SIGTERM landed after the "
+                  "load window?")
+            return 1
+        t_reader.join(timeout=10)  # child exited: stdout hits EOF
+        out = "".join(lines)
+        if "drain complete" not in out:
+            print(f"FAIL[term]: child never logged drain completion:\n"
+                  f"{out[-2000:]}")
+            return 1
+        # server side, exact: every request the HTTP layer admitted
+        # committed a terminal reply before exit — THE zero-drop
+        # invariant (the counter commits before the socket send, so a
+        # client whose connection broke still counts as replied)
+        m_acct = re.search(r"exit accounting: admitted=(\d+) "
+                           r"replied=(\d+)", out)
+        if not m_acct:
+            print(f"FAIL[term]: child printed no exit accounting:\n"
+                  f"{out[-2000:]}")
+            return 1
+        admitted, replied = int(m_acct.group(1)), int(m_acct.group(2))
+        if admitted != replied:
+            print(f"FAIL[term]: {admitted - replied} admitted requests "
+                  f"never got a reply (admitted={admitted}, "
+                  f"replied={replied})")
+            return 1
+        print(f"sigterm ok: {n_ok} replied, {n_drained} drained-503, "
+              f"admitted={admitted}=replied, "
+              f"statuses={s['by_status']}, clean exit inside budget")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
 
 
 def main() -> int:
@@ -154,10 +408,16 @@ def main() -> int:
               f"{series_total(metrics, 'synapseml_executor_pipeline_restarts_total'):.0f}, "
               f"injected="
               f"{series_total(metrics, 'synapseml_faults_injected_total'):.0f}")
-        return 0
     finally:
         cs.stop()
         ex.close(wait=False)
+
+    # -- phase 4: channel kill under open-loop load (loadgen-driven)
+    rc = channel_kill_phase()
+    if rc:
+        return rc
+    # -- phase 5: SIGTERM rolling-restart drain on a real subprocess
+    return sigterm_phase()
 
 
 if __name__ == "__main__":

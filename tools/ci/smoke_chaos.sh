@@ -5,8 +5,16 @@
 # non-faulted requests still succeed, nothing ever hangs, and /metrics
 # shows the injections/restarts/sheds. The env var is exported BEFORE
 # the interpreter starts so the import-time fault-arming path is itself
-# under test. A wedged pipeline HANGS rather than fails, so the hard
-# wall-clock timeout turns it into a fast red X (exit 124).
+# under test. Then the channel failure domain (docs/robustness.md,
+# "channel failure domains"): kill one DistributedServer channel at
+# prob 1.0 under open-loop tools/loadgen.py traffic — failover keeps
+# every request 200 (bit-identical), the breaker trips
+# CLOSED->OPEN->HALF_OPEN->CLOSED, goodput recovers — and finally a
+# SIGTERM rolling-restart drain of a real serving subprocess (zero
+# dropped accepted requests, 503 + Retry-After for new ones, clean
+# exit inside --drain-timeout-ms). A wedged pipeline HANGS rather than
+# fails, so the hard wall-clock timeout turns it into a fast red X
+# (exit 124).
 #
 # Usage: tools/ci/smoke_chaos.sh   [SMOKE_TIMEOUT=seconds]
 set -euo pipefail
@@ -14,5 +22,5 @@ cd "$(dirname "$0")/../.."
 export JAX_PLATFORMS=cpu
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 export SYNAPSEML_FAULTS="${SYNAPSEML_FAULTS:-compute:0.1}"
-exec timeout -k 10 "${SMOKE_TIMEOUT:-240}" \
+exec timeout -k 10 "${SMOKE_TIMEOUT:-360}" \
   python tools/ci/chaos_check.py

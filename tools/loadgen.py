@@ -1,0 +1,198 @@
+"""Open-loop Poisson load generator for the serving stack.
+
+Open-loop means arrivals are scheduled by the CLOCK, not by completions:
+a slow or shedding server does not slow the offered rate down, which is
+the only way to observe real saturation behavior (a closed-loop client
+self-throttles and hides it — the coordinated-omission trap). Arrival
+gaps are exponential (Poisson process) and every request runs on its
+own sender thread, so in-flight requests never gate the next arrival.
+
+Mixed shapes: each request's payload feature vector length cycles
+through ``shapes`` (weighted round-robin over the arrival sequence), so
+the server's bucket ladder / padding paths are exercised the way mixed
+production traffic would.
+
+Reported: per-status counts, latency percentiles (p50/p95/p99) over
+successful (200) replies and over ALL terminal replies, goodput
+(200s/sec of wall time), offered vs achieved request rate. A request
+that errors at the socket level (refused, reset, timed out) is counted
+under ``"error"`` — the assertion surface for "zero hangs, zero silent
+drops" is that every scheduled request reaches SOME terminal record.
+
+Usage (also importable: :func:`run_load` drives the chaos CI scenarios
+in tools/ci/chaos_check.py)::
+
+    python tools/loadgen.py --url http://127.0.0.1:8898/ \
+        --rps 200 --duration 10 --shapes 2,8,32 [--deadline-ms 250] \
+        [--seed 7] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def _default_payload(i: int, shape: int) -> Dict[str, Any]:
+    """``{"x": [...]}`` of ``shape`` floats, deterministic in ``i`` —
+    a scorer that computes a pure function of x lets the caller verify
+    bit-identical replies (the failover acceptance check)."""
+    return {"x": [float((i + k) % 7) for k in range(shape)]}
+
+
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sequence."""
+    if not sorted_vals:
+        return float("nan")
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def _send(url: str, body: bytes, headers: Dict[str, str],
+          timeout: float) -> Tuple[Any, Optional[bytes]]:
+    req = urllib.request.Request(url, data=body, method="POST",
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        # explicit non-2xx IS a terminal reply (shed/drain/error paths);
+        # read drains the connection so keep-alive sockets recycle
+        try:
+            e.read()
+        except Exception:  # noqa: BLE001 - best-effort drain
+            pass
+        return e.code, None
+    except Exception:  # noqa: BLE001 - refused/reset/socket timeout
+        return "error", None
+
+
+def run_load(url: str, rps: float, duration_s: float,
+             shapes: Sequence[int] = (2,),
+             deadline_ms: Optional[float] = None,
+             timeout: float = 30.0,
+             seed: Optional[int] = None,
+             payload_fn: Callable[[int, int], Any] = _default_payload,
+             on_result: Optional[Callable[[int, Any, float], None]] = None,
+             stop: Optional[threading.Event] = None) -> Dict[str, Any]:
+    """Drive ``rps`` Poisson arrivals against ``url`` for ``duration_s``
+    seconds; block until every sender reaches a terminal record; return
+    the summary dict. ``seed`` makes the arrival schedule and shape
+    sequence deterministic (the payloads already are). ``on_result(i,
+    status, latency_s)`` observes each completion (chaos checks hook
+    assertions here); ``stop`` aborts scheduling early (senders already
+    launched still complete)."""
+    rng = random.Random(seed)
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms is not None:
+        headers["X-Deadline-Ms"] = str(deadline_ms)
+    shapes = list(shapes) or [2]
+
+    results: List[Optional[Tuple[Any, float]]] = []
+    senders: List[threading.Thread] = []
+    lock = threading.Lock()
+
+    def sender(i: int, body: bytes):
+        t0 = time.monotonic()
+        status, _ = _send(url, body, headers, timeout)
+        dt = time.monotonic() - t0
+        with lock:
+            results[i] = (status, dt)
+        if on_result is not None:
+            on_result(i, status, dt)
+
+    t_start = time.monotonic()
+    t_end = t_start + duration_s
+    next_arrival = t_start
+    i = 0
+    while next_arrival < t_end and (stop is None or not stop.is_set()):
+        delay = next_arrival - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        body = json.dumps(
+            payload_fn(i, shapes[i % len(shapes)])).encode()
+        with lock:
+            results.append(None)
+        t = threading.Thread(target=sender, args=(i, body), daemon=True)
+        t.start()
+        senders.append(t)
+        i += 1
+        # open loop: the NEXT arrival is clocked off the schedule, not
+        # off this request's completion
+        next_arrival += rng.expovariate(rps)
+    for t in senders:
+        t.join(timeout=timeout + 10.0)
+    wall = time.monotonic() - t_start
+
+    by_status: Dict[str, int] = {}
+    ok_lat: List[float] = []
+    all_lat: List[float] = []
+    hung = 0
+    with lock:
+        snapshot = list(results)
+    for rec in snapshot:
+        if rec is None:
+            hung += 1  # sender never recorded: the one forbidden outcome
+            continue
+        status, dt = rec
+        by_status[str(status)] = by_status.get(str(status), 0) + 1
+        all_lat.append(dt)
+        if status == 200:
+            ok_lat.append(dt)
+    ok_lat.sort()
+    all_lat.sort()
+    return {
+        "scheduled": i,
+        "hung": hung,
+        "by_status": by_status,
+        "offered_rps": rps,
+        "achieved_rps": i / wall if wall > 0 else 0.0,
+        "goodput_rps": len(ok_lat) / wall if wall > 0 else 0.0,
+        "wall_s": wall,
+        "latency_ok_s": {q: percentile(ok_lat, q)
+                         for q in (50.0, 95.0, 99.0)},
+        "latency_all_s": {q: percentile(all_lat, q)
+                          for q in (50.0, 95.0, 99.0)},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True)
+    ap.add_argument("--rps", type=float, default=50.0)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--shapes", default="2",
+                    help="comma-separated feature-vector lengths the "
+                         "arrival sequence cycles through")
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw summary dict as JSON")
+    args = ap.parse_args(argv)
+    shapes = [int(s) for s in args.shapes.split(",") if s.strip()]
+    summary = run_load(args.url, args.rps, args.duration, shapes,
+                       deadline_ms=args.deadline_ms,
+                       timeout=args.timeout, seed=args.seed)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        lat = summary["latency_ok_s"]
+        print(f"scheduled={summary['scheduled']} hung={summary['hung']} "
+              f"by_status={summary['by_status']}")
+        print(f"offered={summary['offered_rps']:.1f}rps "
+              f"achieved={summary['achieved_rps']:.1f}rps "
+              f"goodput={summary['goodput_rps']:.1f}rps")
+        print("latency(200s): " + "  ".join(
+            f"p{q:.0f}={lat[q] * 1e3:.2f}ms" for q in (50.0, 95.0, 99.0)))
+    return 1 if summary["hung"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
