@@ -24,9 +24,11 @@ import math
 import os
 import queue
 import random
+import re
 import socket
 import threading
 import time
+import urllib.parse
 import uuid
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -35,11 +37,34 @@ import numpy as np
 
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
+from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import faults as _flt
+from synapseml_tpu.runtime import slo as _slo
+from synapseml_tpu.runtime import structlog as _slog
 from synapseml_tpu.runtime import telemetry as _tm
 from synapseml_tpu.runtime.faults import PipelineBrokenError
 
 _REGISTRY_LOCK = threading.Lock()
+
+# client-supplied X-Request-Id acceptance (docs/observability.md): a
+# well-formed external id becomes THE rid — span, logs, flight events,
+# and the echoed reply header all carry the caller's own correlation
+# key. Anything else (missing, oversized, exotic charset) falls back to
+# a minted uuid; never reject a request over its id.
+_RID_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+# flight-recorder slow-batch threshold: a pipeline_fn call slower than
+# this lands a "slow_batch" event (with its rids) in the ring — the
+# breadcrumb a latency incident is diagnosed from. 0 disables.
+_SLOW_BATCH_S = float(os.environ.get("SYNAPSEML_SLOW_BATCH_MS",
+                                     "1000")) / 1e3
+
+# /debug/profile single-flight gate: jax.profiler supports one trace at
+# a time per process, so a second concurrent request gets 409 instead
+# of corrupting the first trace. SYNAPSEML_DEBUG_PROFILE=0 disables the
+# endpoint entirely (403) for deployments that lock debug surfaces down.
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_MAX_MS = 10_000.0
 
 # fault-injection points (runtime/faults.py, docs/robustness.md) —
 # resolved once at import; fire() is a single attribute test when no
@@ -172,6 +197,51 @@ def _supervise_loop(fn: Callable[[], Any], stop: threading.Event,
             time.sleep(0.01)
 
 
+def _debug_profile(path: str) -> Tuple[int, Dict[str, Any]]:
+    """``GET /debug/profile?ms=<n>``: record a bounded on-demand
+    ``jax.profiler`` trace (via :func:`utils.profiling.trace`, so the
+    executor's live ``TraceAnnotation`` bridge lights up for exactly
+    this window) into the flight-recorder dump dir. Gated
+    (``SYNAPSEML_DEBUG_PROFILE=0`` → 403) and single-flight (the jax
+    profiler supports one trace per process — a concurrent request
+    gets 409, never a corrupted trace). The handler thread blocks for
+    the window; scoring continues on the pipeline threads."""
+    if os.environ.get("SYNAPSEML_DEBUG_PROFILE", "") == "0":
+        return 403, {"error":
+                     "profiling disabled (SYNAPSEML_DEBUG_PROFILE=0)"}
+    params = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+    try:
+        ms = float(params.get("ms", ["500"])[0])
+    except ValueError:
+        return 400, {"error": "ms must be a number"}
+    ms = max(1.0, min(_PROFILE_MAX_MS, ms))
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        return 409, {"error": "a profile is already in flight"}
+    try:
+        from synapseml_tpu.utils import profiling
+
+        # uuid suffix: two short profiles inside one wall-clock second
+        # (the single-flight lock only serializes, it doesn't space
+        # them out) must not interleave traces in one directory
+        stamp = (time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+                 + "-" + uuid.uuid4().hex[:8])
+        out_dir = os.path.join(_bb.dump_dir(), f"profile-{stamp}")
+        t0 = time.monotonic()
+        with profiling.trace(out_dir):
+            # trace() degrades to a no-op where the profiler is
+            # unsupported; report whether anything actually recorded
+            recorded = profiling.trace_active()
+            time.sleep(ms / 1e3)
+        wall = time.monotonic() - t0
+        _bb.record("debug_profile", ms=ms, recorded=recorded,
+                   trace_dir=out_dir)
+        return 200, {"trace_dir": out_dir, "ms": ms,
+                     "recorded": recorded,
+                     "seconds": round(wall, 6)}
+    finally:
+        _PROFILE_LOCK.release()
+
+
 class _PendingReply:
     __slots__ = ("event", "response")
 
@@ -287,6 +357,37 @@ class WorkerServer:
         self._m_replies: Dict[int, _tm.Counter] = {}
         _tm.gauge_fn("serving_queue_depth", self.requests.qsize,
                      server=name)
+        # SLO accounting (runtime/slo.py; methodology in docs/
+        # observability.md "SLO accounting"): scrape-time views over
+        # the reply counters and roundtrip histogram this server
+        # already feeds — nothing new on the request path. Targets are
+        # env-configured once per server (the chart wires them); the
+        # attributes stay writable for tests/embedding callers.
+        self.slo_availability_target = float(os.environ.get(
+            "SYNAPSEML_SLO_AVAILABILITY",
+            str(_slo.DEFAULT_AVAILABILITY_TARGET)))  # synlint: shared
+        self.slo_latency_target = float(os.environ.get(
+            "SYNAPSEML_SLO_LATENCY_TARGET", "0.99"))  # synlint: shared
+        self.slo_latency_threshold_s = float(os.environ.get(
+            "SYNAPSEML_SLO_LATENCY_MS",
+            str(_slo.DEFAULT_LATENCY_MS))) / 1e3  # synlint: shared
+        _tm.gauge_fn("serving_slo_availability",
+                     self._slo_availability, server=name)
+        _tm.gauge_fn(
+            "serving_slo_availability_burn_rate",
+            lambda: _slo.burn_rate(self._slo_availability(),
+                                   self.slo_availability_target),
+            server=name)
+        _tm.gauge_fn("serving_slo_latency_good_fraction",
+                     self._slo_latency_good, server=name)
+        _tm.gauge_fn(
+            "serving_slo_latency_burn_rate",
+            lambda: _slo.burn_rate(self._slo_latency_good(),
+                                   self.slo_latency_target),
+            server=name)
+        _tm.gauge_fn("serving_slo_latency_threshold_ms",
+                     lambda: self.slo_latency_threshold_s * 1e3,
+                     server=name)
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -307,9 +408,21 @@ class WorkerServer:
                 req = HTTPRequestData(
                     url=self.path, method=self.command,
                     headers=dict(self.headers.items()), entity=body)
-                rid = uuid.uuid4().hex
+                # client-supplied X-Request-Id becomes THE rid when
+                # well-formed (validated + length-capped), so the
+                # caller's own logs correlate with ours; otherwise mint.
+                # Echoed on EVERY reply path — sheds included — below.
+                client_rid = (self.headers.get("X-Request-Id")
+                              or "").strip()
+                rid = (client_rid if _RID_RE.match(client_rid)
+                       else uuid.uuid4().hex)
                 outer._m_requests.inc()
-                retry_hdr = (("Retry-After", outer._retry_after_value()),)
+                if _slog.enabled("debug"):
+                    _slog.log("debug", "request", rid=rid,
+                              server=outer.name, method=self.command,
+                              path=self.path, bytes=length)
+                retry_hdr = (("Retry-After", outer._retry_after_value()),
+                             ("X-Request-Id", rid))
                 if outer._draining.is_set():
                     # graceful drain: the replica is going away — refuse
                     # NEW work with an explicit 503 + Retry-After (the
@@ -317,6 +430,8 @@ class WorkerServer:
                     # requests keep scoring to a real reply
                     outer._m_drain_shed.inc()
                     outer._reply_counter(503).inc()
+                    _bb.record("shed_drain", rid=rid, level="warn",
+                               server=outer.name)
                     self._send_plain(503, b"draining", headers=retry_hdr)
                     return
                 if (outer.max_queue is not None
@@ -328,6 +443,9 @@ class WorkerServer:
                     # instead of an immediate re-hammer
                     outer._m_queue_shed.inc()
                     outer._reply_counter(429).inc()
+                    _bb.record("shed_queue", rid=rid, level="warn",
+                               server=outer.name,
+                               depth=outer.requests.qsize())
                     self._send_plain(429, b"request queue full",
                                      headers=retry_hdr)
                     return
@@ -340,7 +458,22 @@ class WorkerServer:
                         pass  # malformed header: keep the server default
                 pending = _PendingReply()
                 with outer._lock:
+                    collided = rid in outer.routing
+                    if collided:
+                        # a client reusing an id while its first request
+                        # is still in flight must not hijack that
+                        # request's reply slot: the second gets a minted
+                        # id (still echoed back, so the caller sees the
+                        # substitution)
+                        requested_rid, rid = rid, uuid.uuid4().hex
                     outer.routing[rid] = pending
+                if collided and _slog.enabled("debug"):
+                    # keep the grep-by-rid trail intact both ways: the
+                    # "request" line above carries the requested id,
+                    # this one links it to the id the reply will carry
+                    _slog.log("debug", "rid_substituted", rid=rid,
+                              server=outer.name,
+                              requested=requested_rid)
                 cr = CachedRequest(rid, req, deadline_ms)
                 outer.requests.put(cr)
                 pending.event.wait(outer.reply_timeout)
@@ -353,7 +486,12 @@ class WorkerServer:
                     resp = pending.response
                 status = resp.status_code if resp is not None else 504
                 outer._reply_counter(status).inc()
-                outer._m_roundtrip.observe(time.monotonic() - cr.arrival)
+                dt = time.monotonic() - cr.arrival
+                outer._m_roundtrip.observe(dt)
+                if _slog.enabled("debug"):
+                    _slog.log("debug", "reply", rid=rid,
+                              server=outer.name, status=status,
+                              seconds=round(dt, 6))
                 if resp is None:
                     # the wait expired with no response set: an explicit
                     # 504, never a silent empty wait-out
@@ -422,6 +560,40 @@ class WorkerServer:
                     self._send_plain(
                         200, _tm.prometheus_text().encode("utf-8"),
                         "text/plain; version=0.0.4; charset=utf-8")
+                    return
+                if (self.path.startswith("/debug/")
+                        and os.environ.get("SYNAPSEML_DEBUG_ENDPOINTS",
+                                           "") == "0"):
+                    # locked-down deployments: thread stacks + event
+                    # history are internals no unauthenticated client
+                    # should read — one switch gates the whole /debug
+                    # surface (profile keeps its own finer-grained
+                    # SYNAPSEML_DEBUG_PROFILE gate on top)
+                    self._send_plain(403, b"debug endpoints disabled")
+                    return
+                if self.path == "/debug/flight":
+                    # live flight-recorder snapshot: ring events +
+                    # telemetry + per-thread stacks — what a dump file
+                    # contains, without waiting for a trigger
+                    self._send_plain(
+                        200,
+                        json.dumps(_bb.snapshot(),
+                                   default=repr).encode("utf-8"),
+                        "application/json")
+                    return
+                if self.path == "/debug/threads":
+                    # every live thread's current stack (pipeline/
+                    # scorer/probe supervision forensics)
+                    self._send_plain(
+                        200,
+                        json.dumps(_bb.thread_stacks()).encode("utf-8"),
+                        "application/json")
+                    return
+                if self.path.startswith("/debug/profile"):
+                    status, payload = _debug_profile(self.path)
+                    self._send_plain(
+                        status, json.dumps(payload).encode("utf-8"),
+                        "application/json")
                     return
                 if self.path.startswith("/span/"):
                     span = _tm.get_span(self.path[len("/span/"):])
@@ -505,6 +677,8 @@ class WorkerServer:
         request keeps scoring to a real reply. The SIGTERM half of the
         k8s rolling-restart contract (ContinuousServer.drain drives the
         wait-then-stop half)."""
+        if not self._draining.is_set():
+            _bb.record("drain_begin", server=self.name)
         self._draining.set()
 
     def wait_drained(self, timeout: float) -> bool:
@@ -540,8 +714,24 @@ class WorkerServer:
         t0 = time.monotonic()
         self.begin_drain()
         drained = self.wait_drained(timeout_ms / 1e3)
-        self._m_drain_s.observe(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._m_drain_s.observe(dt)
+        _bb.record("drain_end", server=self.name, drained=drained,
+                   seconds=round(dt, 6))
         return drained
+
+    def _slo_availability(self) -> float:
+        """Good-reply fraction over every terminal reply this server
+        committed (5xx = bad; see runtime/slo.py for the policy)."""
+        return _slo.availability(
+            {code: c.value for code, c in list(self._m_replies.items())})
+
+    def _slo_latency_good(self) -> float:
+        """Fraction of roundtrips at or under the latency threshold,
+        estimated from the roundtrip histogram's buckets."""
+        counts, _total, _n = self._m_roundtrip._aggregate()
+        return _slo.fraction_le(self._m_roundtrip.bounds, counts,
+                                self.slo_latency_threshold_s)
 
     def _retry_after_value(self) -> str:
         """``Retry-After`` is integer seconds (RFC 9110): round the
@@ -659,12 +849,23 @@ class WorkerServer:
             self.reply_to(cr.rid, HTTPResponseData(
                 status_code=status, reason=reason, headers=hdrs))
             cr.span.finish("shed")
+        if shed:
+            _bb.record("shed_stop", level="warn", server=self.name,
+                       status=status, n=len(shed),
+                       rids=[cr.rid for cr in shed[:8]])
         return len(shed)
 
     def stop(self):
-        # unhook the scrape-time sampler first: a scrape racing the
+        # unhook the scrape-time samplers first: a scrape racing the
         # shutdown must read 0, not call into a closed server
         _tm.unregister("serving_queue_depth", server=self.name)
+        for slo_series in ("serving_slo_availability",
+                           "serving_slo_availability_burn_rate",
+                           "serving_slo_latency_good_fraction",
+                           "serving_slo_latency_burn_rate",
+                           "serving_slo_latency_threshold_ms"):
+            _tm.unregister(slo_series, server=self.name)
+        _slog.log("info", "server_stop", server=self.name)
         # queued-but-unconsumed requests get an explicit 503 + Retry-
         # After instead of a silent drop that parks their clients until
         # reply_timeout (their handler threads still hold live
@@ -801,7 +1002,14 @@ class MultiChannelMap:
             orphaned = _drain_all(self._channels[i])
             for item in orphaned:
                 self._place(item)
-            return len(orphaned)
+        if orphaned:
+            # the flight-recorder breadcrumb a trip forensic needs:
+            # WHICH requests moved off the quarantined channel (rids
+            # capped — counts tell the scale, ids tell the story)
+            _bb.record("redisperse", channel=i, level="warn",
+                       n=len(orphaned),
+                       rids=[cr.rid for cr in orphaned[:8]])
+        return len(orphaned)
 
     def update_n_channels(self, n: int):
         """Resize; requests parked on removed channels are re-dispersed
@@ -983,6 +1191,14 @@ class DistributedServer:
         _tm.counter("serving_breaker_transitions_total",
                     server=self.server.name, channel=str(channel),
                     state=_BREAKER_STATE_NAMES[state]).inc()
+        # ring + log breadcrumb (blackbox.record is leaf-lock safe
+        # under _breaker_lock): every state entry, including the
+        # OPEN->HALF_OPEN->OPEN probe bounces no scrape ever sees
+        _bb.record("breaker_transition", channel=channel,
+                   level="warn" if state != BREAKER_CLOSED else "info",
+                   server=self.server.name,
+                   state=_BREAKER_STATE_NAMES[state],
+                   prev=_BREAKER_STATE_NAMES[prev])
 
     def _channel_point(self, channel: int) -> "_flt.FaultPoint":
         """The channel's ``compute.channel<N>`` fault point, resolved
@@ -1024,6 +1240,13 @@ class DistributedServer:
         if moved:
             self._m_redispersed.inc(moved)
         self._m_trips.inc()
+        # the incident trigger: the trip event lands in the ring, then
+        # the recorder dumps ring + gauges + thread stacks to the dump
+        # dir (debounced) — the forensic file the runbook says to pull
+        # first (docs/robustness.md). Runs with no locks held.
+        _bb.trigger("breaker_trip", channel=channel,
+                    server=self.server.name, fails=fails,
+                    redispersed=moved)
         self._ensure_probe_thread()
         self._probe_wake.set()
         return True
@@ -1051,7 +1274,8 @@ class DistributedServer:
         return best
 
     def score_on_channel(self, channel: int,
-                         score_fn: Callable[[], Any]):
+                         score_fn: Callable[[], Any],
+                         rids: Optional[List[str]] = None):
         """Failover dispatch: run ``score_fn`` as channel ``channel``'s
         scoring work under its fault points and breaker accounting. On
         failure, the SAME in-hand work is re-dispatched ONCE to a
@@ -1059,16 +1283,22 @@ class DistributedServer:
         output, because the failover re-runs the identical fn (the
         channel only selects WHERE it runs). A score stalled past
         ``stall_timeout`` counts as a breaker failure even though its
-        result still returns."""
+        result still returns. ``rids``: the request ids riding the
+        in-hand work — they ride the flight-recorder failover event so
+        a dump names WHICH requests moved channels."""
         t0 = time.monotonic()
         try:
             out = self._channel_score(channel, score_fn)
-        except Exception:
+        except Exception as first_err:
             self._record_channel_failure(channel)
             target = self._failover_target(exclude=channel)
             if target is None:
                 raise  # no healthy sibling: the caller's error path
             self._m_failover.inc()
+            _bb.record("failover", channel=channel, level="warn",
+                       server=self.server.name, to_channel=target,
+                       rids=(rids or [])[:8],
+                       error=repr(first_err)[:200])
             t1 = time.monotonic()
             try:
                 out = self._channel_score(target, score_fn)
@@ -1143,6 +1373,9 @@ class DistributedServer:
                 _tm.counter("serving_channel_probe_total",
                             server=self.server.name,
                             outcome="ok" if ok else "fail").inc()
+                _bb.record("breaker_probe", channel=ch,
+                           server=self.server.name,
+                           outcome="ok" if ok else "fail")
                 if ok:
                     self._record_channel_success(ch)
                 else:
@@ -1152,8 +1385,13 @@ class DistributedServer:
     def _distribute_supervised(self):
         """:func:`_supervise_loop` around :meth:`_distribute`: an
         exception there used to silently stop ALL traffic."""
-        _supervise_loop(self._distribute, self._stop,
-                        lambda e: self._m_dist_restarts.inc())
+        def on_restart(e: BaseException):
+            self._m_dist_restarts.inc()
+            _bb.record("thread_restart", level="error",
+                       server=self.server.name, thread="distributor",
+                       error=repr(e)[:200])
+
+        _supervise_loop(self._distribute, self._stop, on_restart)
 
     def _distribute(self):
         while not self._stop.is_set():
@@ -1250,11 +1488,17 @@ class DistributedServer:
         return canary
 
     def _channel_loop_supervised(self, ch: int, *args):
+        def on_restart(e: BaseException):
+            _tm.counter("serving_thread_restarts_total",
+                        server=self.server.name,
+                        thread=f"channel{ch}").inc()
+            _bb.record("thread_restart", channel=ch, level="error",
+                       server=self.server.name,
+                       thread=f"channel{ch}", error=repr(e)[:200])
+
         _supervise_loop(
             lambda: self._channel_loop(ch, *args), self._stop,
-            lambda e: _tm.counter("serving_thread_restarts_total",
-                                  server=self.server.name,
-                                  thread=f"channel{ch}").inc())
+            on_restart)
 
     def _channel_loop(self, ch: int, pipeline_fn, max_batch, linger,
                       coalesce, parse_json, reply_col):
@@ -1294,10 +1538,18 @@ class DistributedServer:
             return out
 
         err: Optional[BaseException] = None
+        t0 = time.monotonic()
         try:
-            out = self.score_on_channel(ch, run)
+            out = self.score_on_channel(
+                ch, run, rids=[cr.rid for cr in batch])
         except Exception as e:  # noqa: BLE001 - channel loop must survive
             err = e
+        dt = time.monotonic() - t0
+        if _SLOW_BATCH_S and dt > _SLOW_BATCH_S:
+            _bb.record("slow_batch", channel=ch, level="warn",
+                       server=self.server.name, seconds=round(dt, 6),
+                       size=len(batch),
+                       rids=[cr.rid for cr in batch[:8]])
         if err is None:
             try:
                 send_replies(self.server, out, reply_col)
@@ -1566,6 +1818,8 @@ class ContinuousServer:
                 self.errors_dropped += 1
                 self._m_err_dropped.inc()
             self.errors.append(repr(exc))
+        _slog.log("error", "serving_error", server=self.name,
+                  error=repr(exc)[:400])
 
     def _restart_counter(self, thread: str) -> "_tm.Counter":
         c = self._m_restarts.get(thread)
@@ -1583,6 +1837,9 @@ class ContinuousServer:
         def on_restart(e: BaseException):
             self._record_error(e)
             self._restart_counter(thread).inc()
+            _bb.record("thread_restart", level="error",
+                       server=self.name, thread=thread,
+                       error=repr(e)[:200])
 
         _supervise_loop(lambda: fn(*args), self._stop, on_restart)
 
@@ -1618,7 +1875,13 @@ class ContinuousServer:
         finally:
             if token is not None:
                 _tm.reset_current_spans(token)
-            self._m_score_s.observe(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self._m_score_s.observe(dt)
+            if _SLOW_BATCH_S and dt > _SLOW_BATCH_S:
+                _bb.record("slow_batch", level="warn",
+                           server=self.name, seconds=round(dt, 6),
+                           size=len(batch), stage="score",
+                           rids=[cr.rid for cr in batch[:8]])
 
     def _reply_scored(self, batch: List[CachedRequest], out, err,
                       err_status: int = 500,
@@ -1678,6 +1941,9 @@ class ContinuousServer:
              else live).append(cr)
         if expired:
             self._m_deadline_shed.inc(len(expired))
+            _bb.record("shed_deadline", level="warn", server=self.name,
+                       n=len(expired),
+                       rids=[cr.rid for cr in expired[:8]])
             # Retry-After rides the shed 504 too: a deadline-expired
             # request usually means the replica is saturated — backing
             # off beats an immediate re-hammer that will expire again
@@ -1717,6 +1983,9 @@ class ContinuousServer:
             if isinstance(err2, PipelineBrokenError):
                 return [(batch, None, err2, 500)]
             self._m_poison.inc()
+            _bb.record("poison_isolated", rid=batch[0].rid,
+                       level="warn", server=self.name,
+                       error=repr(err2)[:200])
             return [(batch, None, err2, 400)]
         mid = len(batch) // 2
         return (self._bisect_score(batch[:mid])
@@ -1755,6 +2024,9 @@ class ContinuousServer:
             # would just re-fail against the same dead pipeline)
             return [(batch, None, err, 500)]
         self._m_bisect.inc()
+        _bb.record("poison_bisect", level="warn", server=self.name,
+                   size=len(batch), error=repr(err)[:200],
+                   rids=[cr.rid for cr in batch[:8]])
         mid = len(batch) // 2
         return (self._bisect_score(batch[:mid])
                 + self._bisect_score(batch[mid:]))
@@ -2025,7 +2297,27 @@ def main(argv=None):
              "bucket sizes; empty = no warmup. /health answers 503 "
              "until warmup completes, so traffic never lands on a "
              "compiling chip")
+    ap.add_argument("--log", default=os.environ.get(
+        "SYNAPSEML_LOG", ""),
+        help="structured-log emission: 'json' (JSON lines on stderr), "
+             "'text', or '0'/empty = silent (docs/observability.md, "
+             "'Structured log schema')")
+    ap.add_argument("--dump-dir", default=os.environ.get(
+        "SYNAPSEML_DUMP_DIR") or None,
+        help="flight-recorder dump directory (breaker trips, pipeline "
+             "breaks, and SIGUSR2 snapshot ring+stacks+gauges here); "
+             "default: <tmpdir>/synapseml_flight")
     args = ap.parse_args(argv)
+    try:
+        _slog.set_mode(args.log.strip().lower())
+    except ValueError as e:
+        print(f"error: --log {args.log!r}: {e}", flush=True)
+        return 2
+    if args.dump_dir:
+        _bb.set_dump_dir(args.dump_dir)
+    # kill -USR2 <pid> snapshots the flight recorder to the dump dir —
+    # the operator's "what is this replica doing right now" surface
+    _bb.install_signal_trigger()
     devices = args.devices or None  # unset env var arrives as ""
     if devices is not None:
         # fail fast on a bad spec — discovering it per request would
@@ -2090,6 +2382,8 @@ def main(argv=None):
         cs.server.set_ready(True)
     cs.start()
     print(f"serving [{what}] on {cs.url} (GET /health ready)", flush=True)
+    _slog.log("info", "server_start", server=args.name, url=cs.url,
+              what=what, dump_dir=_bb.dump_dir())
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import compile_cache as _cc
 from synapseml_tpu.runtime import faults as _flt
 from synapseml_tpu.runtime import telemetry as _tm
@@ -491,6 +492,14 @@ def _break_pipeline(state: _PipelineState, exc: BaseException):
         pending = list(state.pending)
         state.pending.clear()
     _M_PIPE_RESTARTS.inc()
+    # incident trigger (runtime/blackbox.py): the break lands in the
+    # flight-recorder ring and — debounced — snapshots ring + gauges +
+    # thread stacks to the dump dir, so "which thread died holding how
+    # much in flight" survives the restart. Runs on the dying thread,
+    # no locks held, and never raises back into supervision.
+    _bb.trigger("pipeline_break",
+                thread=threading.current_thread().name,
+                n_inflight=len(pending), error=repr(exc)[:200])
     for fut in pending:
         try:
             fut.set_exception(err)

@@ -68,6 +68,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from synapseml_tpu.runtime import structlog as _slog
 from synapseml_tpu.runtime import telemetry as _tm
 
 __all__ = [
@@ -181,6 +182,11 @@ class FaultPoint:
                     return
                 spec.remaining -= 1
         _tm.counter("faults_injected_total", point=self.full_name).inc()
+        # structured breadcrumb for chaos-run log correlation (debug:
+        # probabilistic injections under load are high-volume); only
+        # reached when a fault actually fires, so the disarmed hot
+        # path stays a single attribute test
+        _slog.log("debug", "fault_injected", point=self.full_name)
         if spec.latency_s > 0.0:
             time.sleep(spec.latency_s)
             if spec.exc is None:

@@ -1,0 +1,496 @@
+"""Incident-grade observability surfaces (docs/observability.md):
+flight recorder ring + triggered dumps, /debug endpoints, structured
+JSON-lines logging with rid round-trip, and SLO burn-rate math.
+
+Discipline matches tests/test_faults.py: every blocking wait rides a
+HARD timeout so a regression fails fast instead of wedging the suite
+(this file runs inside tools/ci/smoke_pipeline.sh's wall clock).
+"""
+import glob
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.serving import (ContinuousServer,
+                                      DistributedServer,
+                                      MultiChannelMap, WorkerServer,
+                                      make_reply)
+from synapseml_tpu.io.http import HTTPRequestData
+from synapseml_tpu.io.serving import CachedRequest
+from synapseml_tpu.runtime import blackbox as bb
+from synapseml_tpu.runtime import faults as flt
+from synapseml_tpu.runtime import slo
+from synapseml_tpu.runtime import structlog as slog
+from synapseml_tpu.runtime import telemetry as tm
+
+HARD = 30.0  # hard wall for any blocking wait: hang -> fast red X
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability(tmp_path):
+    """Fresh recorder + silent logs per test; dumps land in tmp."""
+    flt.deactivate()
+    prev_mode = slog.set_mode("")
+    bb.set_dump_dir(str(tmp_path / "flight"))
+    bb.configure(capacity=bb.DEFAULT_CAPACITY, min_dump_interval_s=0.0)
+    bb.reset()
+    yield
+    flt.deactivate()
+    slog.set_mode(prev_mode[0], level=prev_mode[1])
+    bb.set_dump_dir(None)
+    bb.configure(capacity=bb.DEFAULT_CAPACITY,
+                 min_dump_interval_s=10.0)
+    bb.reset()
+
+
+def _get(url, timeout=HARD):
+    with urllib.request.urlopen(
+            urllib.request.Request(url), timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _post(url, obj, timeout=HARD, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST", headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, dict(e.headers), body
+
+
+def _echo_pipeline(table: Table) -> Table:
+    replies = np.empty(table.num_rows, dtype=object)
+    for i, v in enumerate(table["value"]):
+        replies[i] = make_reply({"echo": v})
+    return table.with_column("reply", replies)
+
+
+def _cr(rid: str) -> CachedRequest:
+    return CachedRequest(rid, HTTPRequestData(url="/", method="POST"))
+
+
+# -- flight recorder ring ---------------------------------------------------
+
+def test_ring_bounds_and_eviction():
+    bb.configure(capacity=8)
+    for i in range(20):
+        bb.record("evt", idx=i)
+    events = bb.snapshot(stacks=False)["events"]
+    assert len(events) == 8
+    assert [e["idx"] for e in events] == list(range(12, 20))
+    seqs = [e["seq"] for e in events]
+    assert seqs == sorted(seqs)  # monotone seq survives eviction
+
+
+def test_record_fields_and_kill_switch():
+    bb.record("rich", rid="r-1", channel=3, level="warn", n=2,
+              rids=["a", "b"])
+    (ev,) = bb.snapshot(stacks=False)["events"]
+    assert ev["rid"] == "r-1" and ev["channel"] == 3
+    assert ev["level"] == "warn" and ev["rids"] == ["a", "b"]
+    assert ev["ts"] > 0 and "mono" in ev
+    prev = bb.set_enabled(False)
+    try:
+        bb.record("dropped")
+        assert bb.trigger("dropped_too") is None
+        assert len(bb.snapshot(stacks=False)["events"]) == 1
+    finally:
+        bb.set_enabled(prev)
+
+
+def test_snapshot_carries_threads_and_telemetry():
+    bb.record("x")
+    snap = bb.snapshot()
+    names = {t["name"] for t in snap["threads"]}
+    assert "MainThread" in names
+    main = next(t for t in snap["threads"] if t["name"] == "MainThread")
+    assert any("test_blackbox" in fr["file"] for fr in main["stack"])
+    assert "counters" in snap["telemetry"]
+
+
+def test_trigger_dumps_and_debounces(tmp_path):
+    bb.configure(min_dump_interval_s=60.0)
+    path = bb.trigger("unit_trip", channel=2, extra="ctx")
+    assert path is not None
+    # the write is async (triggers sit on failure paths);
+    # last_dump_path flips once the file is fully on disk
+    deadline = time.monotonic() + HARD
+    while bb.last_dump_path() != path and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert bb.last_dump_path() == path
+    with open(path) as fh:
+        d = json.load(fh)
+    assert d["trigger"]["reason"] == "unit_trip"
+    assert d["trigger"]["channel"] == 2
+    assert d["events"][-1]["event"] == "unit_trip"
+    assert d["threads"]  # per-thread stacks ride every dump
+    # second trigger inside the window: event recorded, NO second dump
+    assert bb.trigger("unit_trip") is None
+    assert len(glob.glob(str(tmp_path / "flight" / "flight-*"))) == 1
+    assert bb.last_dump_path() == path
+
+
+# -- breaker trip -> auto dump, redisperse rids -----------------------------
+
+def test_redisperse_records_rids():
+    m = MultiChannelMap(3)
+    rids = [f"p{i}" for i in range(5)]
+    for r in rids:
+        m.channel(0).put(_cr(r))
+    assert m.set_channel_enabled(0, False) == 5
+    evs = [e for e in bb.snapshot(stacks=False)["events"]
+           if e["event"] == "redisperse"]
+    assert evs and evs[-1]["channel"] == 0 and evs[-1]["n"] == 5
+    assert set(evs[-1]["rids"]) <= set(rids)
+
+
+def test_breaker_trip_auto_dumps_with_thread_stacks():
+    flt.activate("compute.channel0", prob=1.0)
+    ds = DistributedServer("bb_trip", n_channels=2,
+                           breaker_threshold=1, probe_interval=5.0)
+    ds.serve(_echo_pipeline, max_batch=4)
+    try:
+        # first scored batch on channel 0 fails -> trip (threshold 1)
+        # -> failover to channel 1 -> the client still sees 200
+        st, hdrs, body = _post(ds.url, {"x": [1.0]})
+        assert st == 200, (st, body)
+        deadline = time.monotonic() + HARD
+        while bb.last_dump_path() is None and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        path = bb.last_dump_path()
+        assert path, "breaker trip produced no flight dump"
+        with open(path) as fh:
+            d = json.load(fh)
+        kinds = [e["event"] for e in d["events"]]
+        assert "breaker_trip" in kinds
+        assert "breaker_transition" in kinds
+        trip = next(e for e in d["events"]
+                    if e["event"] == "breaker_trip")
+        assert trip["channel"] == 0 and trip["server"] == "bb_trip"
+        names = {t["name"] for t in d["threads"]}
+        assert any(n.startswith("chan-scorer-bb_trip") for n in names)
+        # the failover for the SAME batch lands in the ring right
+        # after the trip dump; the live snapshot must carry its rid
+        live = [e for e in bb.snapshot(stacks=False)["events"]
+                if e["event"] == "failover"]
+        assert live and live[-1]["channel"] == 0
+        assert hdrs["X-Request-Id"] in live[-1]["rids"]
+    finally:
+        flt.deactivate()
+        ds.stop()
+
+
+def test_executor_pipeline_break_records_event():
+    from synapseml_tpu.runtime.executor import BatchedExecutor
+    from synapseml_tpu.runtime.faults import PipelineBrokenError
+
+    ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=4)
+    try:
+        flt.activate("thread_kill.drain", times=1)
+        exc = ex.submit(np.ones((3, 2), np.float32)).exception(
+            timeout=HARD)
+        assert isinstance(exc, PipelineBrokenError)
+        deadline = time.monotonic() + HARD
+        while time.monotonic() < deadline:
+            evs = [e for e in bb.snapshot(stacks=False)["events"]
+                   if e["event"] == "pipeline_break"]
+            if evs:
+                break
+            time.sleep(0.02)
+        assert evs, "pipeline break never hit the flight ring"
+        assert "drain" in evs[-1]["thread"]
+        deadline = time.monotonic() + HARD
+        while bb.last_dump_path() is None and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert bb.last_dump_path() is not None
+    finally:
+        flt.deactivate()
+        ex.close(wait=False)
+
+
+# -- debug endpoints over HTTP ----------------------------------------------
+
+def test_debug_flight_and_threads_endpoints():
+    bb.record("marker", rid="dbg-1")
+    srv = WorkerServer("bb_debug")
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        st, body = _get(f"{base}/debug/flight")
+        assert st == 200
+        snap = json.loads(body)
+        assert any(e.get("rid") == "dbg-1" for e in snap["events"])
+        assert snap["threads"] and snap["telemetry"]
+        st, body = _get(f"{base}/debug/threads")
+        assert st == 200
+        names = {t["name"] for t in json.loads(body)}
+        assert "serving-bb_debug" in names  # the accept loop itself
+        for t in json.loads(body):
+            assert {"name", "ident", "daemon", "stack"} <= set(t)
+    finally:
+        srv.stop()
+
+
+def test_debug_endpoints_gate(monkeypatch):
+    # SYNAPSEML_DEBUG_ENDPOINTS=0 locks the whole /debug surface down:
+    # thread stacks and event history are internals no unauthenticated
+    # client should read from a hardened deployment
+    monkeypatch.setenv("SYNAPSEML_DEBUG_ENDPOINTS", "0")
+    srv = WorkerServer("bb_gated")
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        for path in ("/debug/flight", "/debug/threads",
+                     "/debug/profile?ms=10"):
+            try:
+                st, _ = _get(f"{base}{path}")
+            except urllib.error.HTTPError as e:
+                st = e.code
+            assert st == 403, path
+        # /metrics and /span stay open — they expose no stacks
+        st, _ = _get(f"{base}/metrics")
+        assert st == 200
+    finally:
+        srv.stop()
+
+
+def test_debug_profile_bounded_gated_single_flight(monkeypatch):
+    srv = WorkerServer("bb_prof")
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        st, body = _get(f"{base}/debug/profile?ms=40")
+        assert st == 200
+        rep = json.loads(body)
+        assert rep["ms"] == 40.0 and "trace_dir" in rep
+        assert rep["seconds"] >= 0.04
+        # bounded: out-of-range windows clamp instead of DoS-ing
+        st, body = _get(f"{base}/debug/profile?ms=-5")
+        assert json.loads(body)["ms"] == 1.0  # clamped low end
+        # single-flight: hold the lock, concurrent request gets 409
+        results = {}
+
+        def long_profile():
+            try:
+                _get(f"{base}/debug/profile?ms=1500")
+                results["first"] = 200
+            except urllib.error.HTTPError as e:
+                results["first"] = e.code
+
+        t = threading.Thread(target=long_profile, daemon=True)
+        t.start()
+        time.sleep(0.3)  # the long profile is inside its window
+        try:
+            st2, _ = _get(f"{base}/debug/profile?ms=10")
+        except urllib.error.HTTPError as e:
+            st2 = e.code
+        assert st2 == 409
+        t.join(timeout=HARD)
+        assert not t.is_alive() and results["first"] == 200
+        # gate: disabled surface answers 403, runs nothing
+        monkeypatch.setenv("SYNAPSEML_DEBUG_PROFILE", "0")
+        try:
+            st3, _ = _get(f"{base}/debug/profile?ms=10")
+        except urllib.error.HTTPError as e:
+            st3 = e.code
+        assert st3 == 403
+    finally:
+        srv.stop()
+
+
+# -- structured logging + rid round trip ------------------------------------
+
+def test_structlog_schema_text_and_levels():
+    buf = io.StringIO()
+    slog.set_mode("json", level="info", stream=buf)
+    slog.log("debug", "below_floor", rid="x")  # filtered
+    slog.log("warn", "kept", rid="r9", channel=1, n=3)
+    lines = [ln for ln in buf.getvalue().splitlines() if ln]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["event"] == "kept" and rec["level"] == "warn"
+    assert rec["rid"] == "r9" and rec["channel"] == 1 and rec["n"] == 3
+    assert rec["ts"] > 0
+    # text mode renders the same record human-readably
+    buf2 = io.StringIO()
+    slog.set_mode("text", stream=buf2)
+    slog.log("info", "human", rid="r10")
+    assert "human" in buf2.getvalue() and "rid=r10" in buf2.getvalue()
+    with pytest.raises(ValueError):
+        slog.set_mode("yaml")
+
+
+def test_client_request_id_round_trip_through_serving():
+    buf = io.StringIO()
+    slog.set_mode("json", level="debug", stream=buf)
+    cs = ContinuousServer("bb_rid", _echo_pipeline, max_batch=4).start()
+    try:
+        st, hdrs, body = _post(cs.url, {"x": 1},
+                               headers={"X-Request-Id": "caller-abc.1"})
+        assert st == 200
+        # the caller's id IS the rid: echoed on the reply, names the
+        # span, and correlates the structured log lines
+        assert hdrs["X-Request-Id"] == "caller-abc.1"
+        assert tm.get_span("caller-abc.1") is not None
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                if ln.startswith("{")]
+        mine = [r for r in recs if r.get("rid") == "caller-abc.1"]
+        assert {"request", "reply"} <= {r["event"] for r in mine}
+        # a malformed id (length cap) falls back to a minted uuid,
+        # still echoed so the caller sees the substitution
+        st, hdrs, _ = _post(cs.url, {"x": 2},
+                            headers={"X-Request-Id": "y" * 300})
+        assert st == 200
+        assert hdrs["X-Request-Id"] != "y" * 300
+        assert len(hdrs["X-Request-Id"]) == 32
+    finally:
+        cs.stop()
+
+
+def test_request_id_echoed_on_shed_paths():
+    # max_queue=0: every enqueue sheds 429 — the shed reply must still
+    # carry the caller's id (and Retry-After)
+    srv = WorkerServer("bb_shed", max_queue=0)
+    try:
+        st, hdrs, _ = _post(f"http://{srv.host}:{srv.port}/", {"x": 1},
+                            headers={"X-Request-Id": "shed-me-7"})
+        assert st == 429
+        assert hdrs["X-Request-Id"] == "shed-me-7"
+        assert int(hdrs["Retry-After"]) >= 1
+        srv.begin_drain()
+        st, hdrs, _ = _post(f"http://{srv.host}:{srv.port}/", {"x": 1},
+                            headers={"X-Request-Id": "drain-me-8"})
+        assert st == 503
+        assert hdrs["X-Request-Id"] == "drain-me-8"
+        shed_evs = [e["event"] for e in
+                    bb.snapshot(stacks=False)["events"]]
+        assert "shed_queue" in shed_evs and "shed_drain" in shed_evs
+    finally:
+        srv.stop()
+
+
+# -- SLO math ---------------------------------------------------------------
+
+def test_slo_availability_math():
+    assert slo.availability({}) == 1.0
+    assert slo.availability({200: 99, 500: 1}) == pytest.approx(0.99)
+    assert slo.availability({200: 50, 503: 25, 504: 25}) == \
+        pytest.approx(0.5)
+    # 4xx are deliberate answers, not availability losses
+    assert slo.availability({200: 1, 400: 7, 429: 2}) == 1.0
+    # unparseable status buckets count bad
+    assert slo.availability({"error": 1, 200: 1}) == pytest.approx(0.5)
+
+
+def test_slo_fraction_le_against_known_histogram():
+    bounds = (0.1, 0.2, 0.4)
+    # counts: [<=0.1, <=0.2, <=0.4, overflow]
+    assert slo.fraction_le(bounds, [0, 0, 0, 0], 0.2) == 1.0
+    assert slo.fraction_le(bounds, [4, 4, 0, 0], 0.2) == 1.0
+    assert slo.fraction_le(bounds, [4, 0, 0, 4], 0.2) == \
+        pytest.approx(0.5)  # overflow bucket never counts good
+    # interpolation: threshold halfway through the (0.2, 0.4] bucket
+    # credits half its observations
+    assert slo.fraction_le(bounds, [0, 0, 10, 0], 0.3) == \
+        pytest.approx(0.5)
+    # matches the telemetry Histogram's own aggregation layout
+    h = tm.Histogram("synapseml_t_slo_hist", (), buckets=bounds)
+    for v in (0.05, 0.15, 0.15, 0.3, 0.9):
+        h.observe(v)
+    counts, _, _ = h._aggregate()
+    assert slo.fraction_le(bounds, counts, 0.2) == pytest.approx(3 / 5)
+
+
+def test_slo_burn_rate_math():
+    assert slo.burn_rate(1.0, 0.999) == 0.0
+    # 2% bad against a 1% budget burns 2x
+    assert slo.burn_rate(0.98, 0.99) == pytest.approx(2.0)
+    assert slo.burn_rate(0.999, 0.999) == pytest.approx(1.0)
+    assert slo.burn_rate(0.5, 1.0) == float("inf")
+    assert slo.burn_rate(1.0, 1.0) == 0.0
+
+
+def test_server_slo_gauges_on_scrape():
+    srv = WorkerServer("bb_slo")
+    try:
+        srv.slo_availability_target = 0.99
+        srv.slo_latency_target = 0.99
+        srv.slo_latency_threshold_s = 0.25
+        # synthesize a known reply/latency history: 98 good + 2 bad,
+        # latencies split around the threshold
+        srv._reply_counter(200).inc(98)
+        srv._reply_counter(500).inc(2)
+        for _ in range(8):
+            srv._m_roundtrip.observe(0.01)
+        for _ in range(2):
+            srv._m_roundtrip.observe(5.0)
+        gauges = tm.snapshot()["gauges"]
+
+        def g(name):
+            return gauges[
+                f'synapseml_{name}{{server="bb_slo"}}']
+
+        assert g("serving_slo_availability") == pytest.approx(0.98)
+        assert g("serving_slo_availability_burn_rate") == \
+            pytest.approx(2.0)
+        assert g("serving_slo_latency_good_fraction") == \
+            pytest.approx(0.8)
+        assert g("serving_slo_latency_burn_rate") == \
+            pytest.approx(20.0)
+        assert g("serving_slo_latency_threshold_ms") == \
+            pytest.approx(250.0)
+        text = tm.prometheus_text()
+        assert 'synapseml_serving_slo_availability{server="bb_slo"}' \
+            in text
+    finally:
+        srv.stop()
+    # stopped server unhooks its SLO samplers (scrape-after-stop)
+    assert 'server="bb_slo"' not in "".join(
+        k for k in tm.snapshot()["gauges"])
+
+
+# -- loadgen SLO assertion mode + JSON results ------------------------------
+
+def test_loadgen_out_json_and_slo_assertion(tmp_path):
+    from tools.loadgen import evaluate_slo, main as loadgen_main
+
+    cs = ContinuousServer("bb_loadgen", _echo_pipeline,
+                          max_batch=8).start()
+    try:
+        out = str(tmp_path / "results.json")
+        rc = loadgen_main([
+            "--url", cs.url, "--rps", "40", "--duration", "0.5",
+            "--shapes", "2", "--seed", "5", "--out", out,
+            "--slo-p99-ms", "20000", "--slo-availability", "0.9"])
+        assert rc == 0
+        with open(out) as fh:
+            res = json.load(fh)
+        assert res["hung"] == 0 and res["slo"]["pass"]
+        assert res["slo"]["p99"]["pass"]
+        assert res["slo"]["availability"]["observed"] >= 0.9
+        # impossible p99 objective: assertion mode fails with exit 2
+        rc = loadgen_main([
+            "--url", cs.url, "--rps", "40", "--duration", "0.3",
+            "--seed", "6", "--out", out, "--slo-p99-ms", "0.000001"])
+        assert rc == 2
+        with open(out) as fh:
+            assert not json.load(fh)["slo"]["pass"]
+        # evaluate_slo is pure over a summary dict
+        v = evaluate_slo({"scheduled": 10, "hung": 0,
+                          "by_status": {"200": 9, "503": 1},
+                          "latency_ok_s": {99.0: 0.050}},
+                         slo_p99_ms=100.0, slo_availability=0.95)
+        assert v["p99"]["pass"] and not v["availability"]["pass"]
+        assert not v["pass"]
+    finally:
+        cs.stop()

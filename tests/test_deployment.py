@@ -23,8 +23,11 @@ def test_chart_renders_without_placeholders(tmp_path):
         [sys.executable, os.path.join(ROOT, "tools", "k8s", "render.py"),
          "--out", out], capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
+    # every template must render (a template missing its values keys
+    # raises in render.py, failing the subprocess above)
     names = sorted(os.listdir(out))
-    assert names == ["serving.yaml", "train-job.yaml"]
+    assert names == ["alerts.yaml", "cache-pvc.yaml", "serving.yaml",
+                     "train-job.yaml"]
     for n in names:
         text = open(os.path.join(out, n)).read()
         assert "{{" not in text

@@ -17,11 +17,17 @@ Asserts (docs/robustness.md):
   keeps flowing — requests on the broken channel fail over to the
   healthy sibling (200, bit-identical), the breaker trips
   CLOSED->OPEN, the half-open probe re-admits the channel once the
-  fault is disarmed, and goodput recovers to 100%;
+  fault is disarmed, and goodput recovers to 100% (asserted via the
+  loadgen CLI's --out JSON results + SLO assertion mode);
+- the trip auto-produces a FLIGHT DUMP (runtime/blackbox.py) whose
+  events include the trip, the failover, and the redisperse with
+  matching rids/channel ids plus per-thread stacks, and
+  /debug/threads + /debug/flight serve the live picture;
 - SIGTERM rolling restart (phase 5): a real serving subprocess under
   loadgen traffic drains on SIGTERM — every accepted request gets a
-  real reply, new requests get 503 + Retry-After, and the process
-  exits 0 within its --drain-timeout-ms budget.
+  real reply, new requests get 503 + Retry-After, the process exits 0
+  within its --drain-timeout-ms budget, and its structured JSON log
+  (SYNAPSEML_LOG=json) reconstructs a request's life by rid.
 
 Driven under a hard timeout: a wedged pipeline hangs rather than fails,
 so it becomes a fast exit-124 instead of a stuck job.
@@ -66,11 +72,22 @@ def series_total(text: str, name: str) -> float:
 def channel_kill_phase() -> int:
     """Phase 4: kill one channel of a DistributedServer under open-loop
     loadgen traffic; assert failover (200, bit-identical), breaker
-    CLOSED->OPEN->HALF_OPEN->CLOSED, goodput recovery, zero hangs.
+    CLOSED->OPEN->HALF_OPEN->CLOSED, goodput recovery, zero hangs —
+    AND the incident-diagnosis loop (docs/observability.md): the trip
+    auto-produces a flight-recorder dump whose events include the
+    trip, the failover, and the redisperse with matching rids/channel
+    ids; /debug/threads lists every live scorer thread; and the
+    healthy-phase goodput run goes through the loadgen CLI's JSON
+    results + SLO assertion mode instead of in-process stdout.
     Requires the ``compute`` family DISARMED (phase 3 does that) so the
     only fault in play is the channel-scoped one."""
+    import glob
+    import subprocess
+    import tempfile
+
     from synapseml_tpu.io.serving import (BREAKER_CLOSED,
                                           DistributedServer, make_reply)
+    from synapseml_tpu.runtime import blackbox as bb
     from synapseml_tpu.runtime import faults as flt
     from tools.loadgen import run_load
 
@@ -81,11 +98,22 @@ def channel_kill_phase() -> int:
                 {"y": [x * 3.0 + 1.0 for x in v["x"]]})
         return table.with_column("reply", replies)
 
+    # fresh flight-recorder state: phase 3's pipeline-break dump must
+    # not eat the trip dump's debounce window, and the dump dir must be
+    # ours to glob
+    dump_dir = tempfile.mkdtemp(prefix="chaos_flight_")
+    bb.set_dump_dir(dump_dir)
+    bb.reset()
+
     ds = DistributedServer("chaos_channels", n_channels=2,
                            breaker_threshold=2, probe_interval=0.1)
     ds.serve(pipeline, max_batch=16, linger=0.002)
     try:
-        flt.activate("compute.channel0", prob=1.0)
+        # latency + exception: each channel0 attempt stalls 150ms THEN
+        # fails, so the trip catches requests parked on the channel —
+        # the redisperse the flight dump must name rids for
+        flt.activate("compute.channel0", prob=1.0, latency_ms=150,
+                     exc=flt.FaultInjected)
         # open-loop load against the half-broken server: every request
         # must reach a terminal status, and failover means they succeed
         s = run_load(ds.url, rps=120, duration_s=2.0, shapes=[2, 4, 8],
@@ -132,15 +160,102 @@ def channel_kill_phase() -> int:
             print("FAIL[ch]: probe never re-admitted channel0 after "
                   "the fault was disarmed")
             return 1
-        # goodput recovers to 100% on the healed pair
-        s2 = run_load(ds.url, rps=120, duration_s=1.0, shapes=[2],
-                      seed=12, timeout=30.0)
+        # goodput recovers to 100% on the healed pair — driven through
+        # the loadgen CLI in SLO assertion mode, its JSON results file
+        # (not stdout) the source of truth: exit 0 means the run met
+        # --slo-p99-ms AND --slo-availability on top of zero hangs
+        results_json = os.path.join(dump_dir, "loadgen_results.json")
+        lg = subprocess.run(
+            [sys.executable, os.path.join("tools", "loadgen.py"),
+             "--url", ds.url, "--rps", "120", "--duration", "1.0",
+             "--shapes", "2", "--seed", "12", "--timeout", "30",
+             "--out", results_json,
+             "--slo-p99-ms", "2000", "--slo-availability", "0.99"],
+            capture_output=True, text=True, timeout=120)
+        if lg.returncode != 0:
+            print(f"FAIL[ch]: loadgen SLO assertion mode exited "
+                  f"{lg.returncode} on the healthy phase:\n"
+                  f"{lg.stdout}\n{lg.stderr}")
+            return 1
+        with open(results_json) as fh:
+            s2 = json.load(fh)
+        if not s2.get("slo", {}).get("pass"):
+            print(f"FAIL[ch]: loadgen results file carries a failed "
+                  f"SLO verdict: {s2.get('slo')}")
+            return 1
         if s2["hung"] or s2["by_status"].get("200", 0) != s2["scheduled"]:
             print(f"FAIL[ch]: goodput did not recover after re-admit "
                   f"({s2['by_status']}, hung={s2['hung']})")
             return 1
 
+        # -- the incident loop: the trip must have auto-produced a
+        # flight dump naming the trip, the failover, and the
+        # redisperse, rid/channel-correlated (docs/observability.md)
+        dumps = sorted(glob.glob(
+            os.path.join(dump_dir, "flight-*breaker_trip*.json")))
+        if not dumps:
+            print(f"FAIL[ch]: breaker trip produced no flight dump in "
+                  f"{dump_dir} (found: "
+                  f"{os.listdir(dump_dir)})")
+            return 1
+        with open(dumps[-1]) as fh:
+            flight = json.load(fh)
+        evs = flight.get("events", [])
+
+        def _of(kind):
+            return [e for e in evs if e.get("event") == kind]
+
+        trips = [e for e in _of("breaker_trip") if e.get("channel") == 0]
+        fails_ev = [e for e in _of("failover") if e.get("channel") == 0]
+        reds = [e for e in _of("redisperse") if e.get("channel") == 0]
+        if not trips:
+            print(f"FAIL[ch]: flight dump has no channel-0 "
+                  f"breaker_trip event ({[e.get('event') for e in evs]})")
+            return 1
+        if not fails_ev or not fails_ev[0].get("rids"):
+            print(f"FAIL[ch]: flight dump has no rid-carrying "
+                  f"channel-0 failover event ({fails_ev})")
+            return 1
+        if fails_ev[0].get("to_channel") != 1:
+            print(f"FAIL[ch]: failover event names to_channel="
+                  f"{fails_ev[0].get('to_channel')}, wanted 1")
+            return 1
+        if not reds or not reds[-1].get("rids"):
+            print(f"FAIL[ch]: flight dump has no rid-carrying "
+                  f"channel-0 redisperse event ({reds})")
+            return 1
+        if not flight.get("threads"):
+            print("FAIL[ch]: flight dump carries no thread stacks")
+            return 1
+        dump_threads = {t["name"] for t in flight["threads"]}
+        if not any(n.startswith("chan-scorer-chaos_channels")
+                   for n in dump_threads):
+            print(f"FAIL[ch]: flight dump thread stacks miss the "
+                  f"channel scorers ({sorted(dump_threads)})")
+            return 1
+
+        # -- /debug/threads must list every live scorer/pipeline thread
         host = ds.url.split("//")[1].rstrip("/")
+        with urllib.request.urlopen(
+                urllib.request.Request(f"http://{host}/debug/threads"),
+                timeout=30) as r:
+            live_threads = {t["name"] for t in json.loads(r.read())}
+        want_threads = {f"chan-scorer-chaos_channels-{ch}"
+                        for ch in range(2)} | {"dist-chaos_channels"}
+        missing_t = want_threads - live_threads
+        if missing_t:
+            print(f"FAIL[ch]: /debug/threads missing live threads "
+                  f"{sorted(missing_t)} (got {sorted(live_threads)})")
+            return 1
+        # -- and /debug/flight serves the same picture live
+        with urllib.request.urlopen(
+                urllib.request.Request(f"http://{host}/debug/flight"),
+                timeout=30) as r:
+            live_flight = json.loads(r.read())
+        if not live_flight.get("events") or not live_flight.get("threads"):
+            print("FAIL[ch]: /debug/flight returned an empty snapshot")
+            return 1
+
         with urllib.request.urlopen(
                 urllib.request.Request(f"http://{host}/metrics"),
                 timeout=30) as r:
@@ -185,6 +300,11 @@ def sigterm_phase() -> int:
     env = dict(os.environ)
     env.pop("SYNAPSEML_FAULTS", None)  # the child serves clean
     env.setdefault("PYTHONPATH", os.getcwd())
+    # structured logging end-to-end: the child emits the JSON-lines
+    # schema (per-request debug events included) on stderr; this check
+    # asserts a grep-by-rid reconstructs a request's life
+    env["SYNAPSEML_LOG"] = "json"
+    env["SYNAPSEML_LOG_LEVEL"] = "debug"
     proc = subprocess.Popen(
         [sys.executable, "-m", "synapseml_tpu.io.serving",
          "--host", "127.0.0.1", "--port", "0", "--name", "chaos_drain",
@@ -292,6 +412,28 @@ def sigterm_phase() -> int:
             print(f"FAIL[term]: {admitted - replied} admitted requests "
                   f"never got a reply (admitted={admitted}, "
                   f"replied={replied})")
+            return 1
+        # structured-log rid round trip: the child's JSON lines must
+        # let a grep by rid reconstruct a request's life — at least
+        # one rid with BOTH its "request" and "reply" events
+        by_rid: dict = {}
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("rid"):
+                by_rid.setdefault(rec["rid"], set()).add(
+                    rec.get("event"))
+        correlated = [r for r, evs in by_rid.items()
+                      if {"request", "reply"} <= evs]
+        if not correlated:
+            print(f"FAIL[term]: no rid in the child's structured log "
+                  f"carries both request and reply events "
+                  f"({len(by_rid)} rids seen)")
             return 1
         print(f"sigterm ok: {n_ok} replied, {n_drained} drained-503, "
               f"admitted={admitted}=replied, "

@@ -3,6 +3,13 @@
 well-formed, and increasing. Driven by tools/ci/smoke_metrics.sh under a
 hard timeout (a wedged scrape or pipeline hangs rather than fails).
 
+Also covers the breaker/failover surface (PR 8 series): a 2-channel
+DistributedServer is served, drained, and scraped so
+``serving_channel_breaker_state``, ``serving_failover_total``, and an
+observed ``serving_drain_seconds`` are asserted on a live exposition —
+plus the incident-diagnosis read surfaces (``/debug/flight``,
+``/debug/threads``) and the scrape-time ``serving_slo_*`` gauges.
+
 Exit 0 = every assertion held; any failure prints the offending series
 and exits nonzero.
 """
@@ -10,6 +17,7 @@ import http.client
 import json
 import re
 import sys
+import urllib.request
 
 import numpy as np
 
@@ -39,6 +47,21 @@ CORE_SERIES = [
     "synapseml_executor_drain_seconds",
     "synapseml_executor_inflight_batches",
     "synapseml_request_stage_seconds",
+    # SLO accounting gauges (runtime/slo.py), registered per server
+    "synapseml_serving_slo_availability",
+    "synapseml_serving_slo_availability_burn_rate",
+    "synapseml_serving_slo_latency_good_fraction",
+    "synapseml_serving_slo_latency_burn_rate",
+    "synapseml_serving_slo_latency_threshold_ms",
+]
+
+# the breaker/failover/drain surface (docs/robustness.md, PR 8): these
+# register on a DistributedServer, so they are asserted on the
+# dedicated scrape below, not the ContinuousServer one
+CHANNEL_SERIES = [
+    "synapseml_serving_channel_breaker_state",
+    "synapseml_serving_failover_total",
+    "synapseml_serving_drain_seconds",
 ]
 
 INCREASING = [
@@ -54,6 +77,74 @@ def series_total(text: str, name: str) -> float:
         if ln.startswith(name) and not ln.startswith(name + "_"):
             total += float(ln.rsplit(" ", 1)[1])
     return total
+
+
+def channel_phase() -> int:
+    """Breaker/failover/drain + debug-surface coverage: serve a
+    2-channel DistributedServer, score through it, drain it, and
+    assert the PR 8 series and the /debug read surfaces on its live
+    exposition."""
+    from synapseml_tpu.io.serving import DistributedServer, make_reply
+
+    def pipeline(table):
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply({"echo": v})
+        return table.with_column("reply", replies)
+
+    ds = DistributedServer("metrics_channels", n_channels=2)
+    ds.serve(pipeline, max_batch=8)
+    try:
+        host = ds.url.split("//")[1].rstrip("/")
+
+        def get_json(path):
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://{host}{path}"), timeout=30) as r:
+                assert r.status == 200, (path, r.status)
+                return json.loads(r.read())
+
+        for k in range(4):
+            req = urllib.request.Request(
+                ds.url, data=json.dumps({"x": [float(k)]}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200, r.status
+        ds.drain(5000)  # observes serving_drain_seconds
+        with urllib.request.urlopen(urllib.request.Request(
+                f"http://{host}/metrics"), timeout=30) as r:
+            text = r.read().decode()
+        missing = [s for s in CHANNEL_SERIES if s not in text]
+        if missing:
+            print("missing channel series:", *missing, sep="\n  ")
+            return 1
+        if series_total(text,
+                        "synapseml_serving_drain_seconds_count") < 1:
+            print("serving_drain_seconds never observed a drain")
+            return 1
+        for ch in ("0", "1"):
+            want = ('synapseml_serving_channel_breaker_state{'
+                    f'channel="{ch}"')
+            if want not in text:
+                print(f"no breaker-state gauge for channel {ch}")
+                return 1
+
+        # incident-diagnosis read surfaces (docs/observability.md)
+        flight = get_json("/debug/flight")
+        if not flight.get("threads") or "events" not in flight:
+            print(f"/debug/flight snapshot malformed: "
+                  f"{sorted(flight)}")
+            return 1
+        names = {t["name"] for t in get_json("/debug/threads")}
+        if "chan-scorer-metrics_channels-0" not in names:
+            print(f"/debug/threads misses the channel scorers "
+                  f"({sorted(names)})")
+            return 1
+        print(f"channel-surface ok: breaker/failover/drain series + "
+              f"debug surfaces live ({len(names)} threads)")
+        return 0
+    finally:
+        ds.stop()
 
 
 def main() -> int:
@@ -133,9 +224,9 @@ def main() -> int:
               "requests="
               f"{series_total(second, 'synapseml_serving_requests_total'):.0f},",
               f"span stages={sorted(stages)}")
-        return 0
     finally:
         cs.stop()
+    return channel_phase()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,175 @@
+"""Generate the serving Grafana dashboard FROM the metric catalog.
+
+The catalog tables in docs/observability.md are the contract for every
+observability consumer — the doc-drift gate
+(tools/ci/metrics_doc_check.py) pins them to the code, and this
+generator turns the same rows into a Grafana dashboard JSON, so a new
+metric needs exactly one catalog row to reach both the gate and the
+dashboards. Deterministic output (stable panel ids, doc ordering):
+regeneration of an unchanged catalog is byte-identical, which is what
+lets CI run ``--check`` against the committed file.
+
+Panel mapping:
+
+- ``serving_slo_*`` gauges -> a stat row at the top (the at-a-glance
+  SLO view: availability, burn rates, latency good fraction);
+- counters -> ``sum(rate(...[5m]))`` timeseries, grouped by the label
+  the catalog row names (``{channel=}`` etc.);
+- gauges   -> ``sum(...)`` timeseries (same grouping);
+- histograms -> p50/p95/p99 ``histogram_quantile`` timeseries.
+
+Usage::
+
+    python tools/k8s/gen_dashboard.py            # rewrite the JSON
+    python tools/k8s/gen_dashboard.py --check    # CI: fail on drift
+"""
+import argparse
+import json
+import os
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.abspath(os.path.join(HERE, os.pardir, os.pardir))
+DOC = os.path.join(ROOT, "docs", "observability.md")
+OUT = os.path.join(HERE, "chart", "dashboards",
+                   "serving-dashboard.json")
+
+PREFIXES = ("serving_", "executor_", "faults_", "blackbox_")
+_NAME = re.compile(r"([a-z][a-z0-9_]*)(\{([a-z_=,]*)\})?")
+
+
+def catalog_rows(doc_path=DOC):
+    """[(name, labels, kind, meaning)] in doc order, from every
+    markdown table inside the '## Metric catalog' section."""
+    with open(doc_path, encoding="utf-8") as fh:
+        text = fh.read()
+    m = re.search(r"^## Metric catalog$(.*?)(?=^## )", text,
+                  re.M | re.S)
+    if not m:
+        raise SystemExit("docs/observability.md: no metric catalog")
+    rows = []
+    seen = set()
+    for line in m.group(1).splitlines():
+        if not line.startswith("|") or line.startswith("|-"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if len(cells) < 3 or cells[1].startswith("---"):
+            continue
+        kind = cells[1].strip()
+        if kind not in ("counter", "gauge", "histogram"):
+            continue
+        meaning = cells[2].split(".")[0].strip()
+        for token in re.findall(r"`([^`]+)`", cells[0]):
+            nm = _NAME.match(token.strip())
+            if not nm or not nm.group(1).startswith(PREFIXES):
+                continue
+            name = nm.group(1)
+            if name in seen:
+                continue
+            seen.add(name)
+            labels = [p.split("=")[0].strip() for p in
+                      (nm.group(3) or "").split(",") if p.strip()]
+            rows.append((name, labels, kind, meaning))
+    return rows
+
+
+def _grid(i, per_row=3, w=8, h=7, y0=0):
+    return {"x": (i % per_row) * w, "y": y0 + (i // per_row) * h,
+            "w": w, "h": h}
+
+
+def _panel(pid, title, kind, targets, grid, description=""):
+    return {"id": pid, "title": title, "type": kind,
+            "datasource": {"type": "prometheus",
+                           "uid": "${datasource}"},
+            "description": description, "gridPos": grid,
+            "targets": targets}
+
+
+def build(rows):
+    panels = []
+    pid = 1
+    slo = [(n, ls, k, mn) for n, ls, k, mn in rows
+           if n.startswith("serving_slo_")]
+    rest = [(n, ls, k, mn) for n, ls, k, mn in rows
+            if not n.startswith("serving_slo_")]
+    for i, (name, _labels, _kind, meaning) in enumerate(slo):
+        panels.append(_panel(
+            pid, name.replace("serving_slo_", "SLO "), "stat",
+            [{"expr": f"avg(synapseml_{name})", "refId": "A"}],
+            {"x": (i % 5) * 5, "y": (i // 5) * 4, "w": 5, "h": 4},
+            meaning))
+        pid += 1
+    y0 = 4 * ((len(slo) + 4) // 5 or 1)
+    for i, (name, labels, kind, meaning) in enumerate(rest):
+        by = f" by ({', '.join(labels)})" if labels else ""
+        if kind == "counter":
+            targets = [{"expr": f"sum(rate(synapseml_{name}[5m]))"
+                                f"{by}", "refId": "A"}]
+        elif kind == "gauge":
+            targets = [{"expr": f"sum(synapseml_{name}){by}",
+                        "refId": "A"}]
+        else:  # histogram
+            targets = [
+                {"expr": f"histogram_quantile({q}, sum(rate("
+                         f"synapseml_{name}_bucket[5m])) by (le))",
+                 "legendFormat": f"p{int(q * 100)}",
+                 "refId": chr(ord("A") + j)}
+                for j, q in enumerate((0.5, 0.95, 0.99))]
+        panels.append(_panel(pid, name, "timeseries", targets,
+                             _grid(i, y0=y0), meaning))
+        pid += 1
+    return {
+        "title": "SynapseML TPU serving",
+        "uid": "synapseml-serving",
+        "tags": ["synapseml", "serving", "generated"],
+        "schemaVersion": 39,
+        "editable": True,
+        "time": {"from": "now-1h", "to": "now"},
+        "templating": {"list": [{"name": "datasource",
+                                 "type": "datasource",
+                                 "query": "prometheus"}]},
+        "__generator": "tools/k8s/gen_dashboard.py — regenerate, "
+                       "do not hand-edit (CI checks sync with the "
+                       "docs/observability.md metric catalog)",
+        "panels": panels,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=OUT)
+    ap.add_argument("--check", action="store_true",
+                    help="fail (exit 1) when the committed dashboard "
+                         "differs from a fresh generation")
+    args = ap.parse_args(argv)
+    rows = catalog_rows()
+    if not rows:
+        print("no catalog rows parsed — is the doc table intact?")
+        return 2
+    text = json.dumps(build(rows), indent=2, sort_keys=False) + "\n"
+    if args.check:
+        try:
+            with open(args.out, encoding="utf-8") as fh:
+                committed = fh.read()
+        except FileNotFoundError:
+            print(f"{args.out} missing — run tools/k8s/gen_dashboard.py")
+            return 1
+        if committed != text:
+            print(f"{os.path.relpath(args.out, ROOT)} is out of sync "
+                  "with the metric catalog — regenerate with "
+                  "python tools/k8s/gen_dashboard.py")
+            return 1
+        print(f"dashboard in sync ({len(rows)} catalog rows)")
+        return 0
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        fh.write(text)
+    print(f"wrote {os.path.relpath(args.out, ROOT)} "
+          f"({len(rows)} catalog rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

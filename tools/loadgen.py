@@ -19,12 +19,23 @@ that errors at the socket level (refused, reset, timed out) is counted
 under ``"error"`` — the assertion surface for "zero hangs, zero silent
 drops" is that every scheduled request reaches SOME terminal record.
 
+Machine-readable results: ``--out results.json`` writes the full
+summary dict (plus the SLO verdict, when asserted) to a file — the
+surface CI consumes (tools/ci/chaos_check.py reads the file instead of
+parsing stdout). SLO assertion mode: ``--slo-p99-ms`` and/or
+``--slo-availability`` turn the run into a pass/fail gate
+(:func:`evaluate_slo`) — p99 over successful replies must sit at or
+under the threshold and the 200-fraction of every *scheduled* request
+(socket errors count against — an unanswered request is an
+availability loss) must meet the target; violation exits 2.
+
 Usage (also importable: :func:`run_load` drives the chaos CI scenarios
 in tools/ci/chaos_check.py)::
 
     python tools/loadgen.py --url http://127.0.0.1:8898/ \
         --rps 200 --duration 10 --shapes 2,8,32 [--deadline-ms 250] \
-        [--seed 7] [--json]
+        [--seed 7] [--json] [--out results.json] \
+        [--slo-p99-ms 250] [--slo-availability 0.999]
 """
 from __future__ import annotations
 
@@ -162,6 +173,54 @@ def run_load(url: str, rps: float, duration_s: float,
     }
 
 
+def _json_finite(obj: Any) -> Any:
+    """Replace non-finite floats with None so the results file is
+    strict RFC-8259 JSON — ``json.dump`` would otherwise emit a bare
+    ``NaN`` token (e.g. the p99 of a zero-success run), breaking every
+    strict consumer exactly on the failure runs the file matters for."""
+    if isinstance(obj, float):
+        return obj if obj == obj and abs(obj) != float("inf") else None
+    if isinstance(obj, dict):
+        return {k: _json_finite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_finite(v) for v in obj]
+    return obj
+
+
+def evaluate_slo(summary: Dict[str, Any],
+                 slo_p99_ms: Optional[float] = None,
+                 slo_availability: Optional[float] = None
+                 ) -> Optional[Dict[str, Any]]:
+    """SLO verdict over a :func:`run_load` summary; None when no
+    objective was given. Availability is strict: 200s over every
+    SCHEDULED request, so socket errors and hung senders count against
+    the target (an unanswered request is an availability loss whatever
+    the transport did). p99 is over successful replies — shed replies
+    are availability losses, not latency samples — and a run with zero
+    successes fails a p99 objective outright (NaN must not pass)."""
+    if slo_p99_ms is None and slo_availability is None:
+        return None
+    verdict: Dict[str, Any] = {"pass": True}
+    if slo_p99_ms is not None:
+        p99_ms = summary["latency_ok_s"][99.0] * 1e3
+        ok = (summary["by_status"].get("200", 0) > 0
+              and p99_ms == p99_ms and p99_ms <= slo_p99_ms)
+        verdict["p99"] = {"target_ms": slo_p99_ms,
+                          "observed_ms": (round(p99_ms, 3)
+                                          if p99_ms == p99_ms else None),
+                          "pass": ok}
+        verdict["pass"] = verdict["pass"] and ok
+    if slo_availability is not None:
+        n = summary["scheduled"]
+        avail = (summary["by_status"].get("200", 0) / n) if n else 1.0
+        ok = avail >= slo_availability
+        verdict["availability"] = {"target": slo_availability,
+                                   "observed": round(avail, 6),
+                                   "pass": ok}
+        verdict["pass"] = verdict["pass"] and ok
+    return verdict
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", required=True)
@@ -175,13 +234,31 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--json", action="store_true",
                     help="emit the raw summary dict as JSON")
+    ap.add_argument("--out", default=None,
+                    help="write the summary dict (plus any SLO "
+                         "verdict) as JSON to this file — the "
+                         "machine-readable surface CI consumes")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="assert p99 latency over successful replies "
+                         "is at or under this many ms (violation: "
+                         "exit 2)")
+    ap.add_argument("--slo-availability", type=float, default=None,
+                    help="assert this fraction of SCHEDULED requests "
+                         "replied 200 (socket errors count against; "
+                         "violation: exit 2)")
     args = ap.parse_args(argv)
     shapes = [int(s) for s in args.shapes.split(",") if s.strip()]
     summary = run_load(args.url, args.rps, args.duration, shapes,
                        deadline_ms=args.deadline_ms,
                        timeout=args.timeout, seed=args.seed)
+    slo = evaluate_slo(summary, args.slo_p99_ms, args.slo_availability)
+    if slo is not None:
+        summary["slo"] = slo
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(_json_finite(summary), fh, indent=2)
     if args.json:
-        print(json.dumps(summary, indent=2))
+        print(json.dumps(_json_finite(summary), indent=2))
     else:
         lat = summary["latency_ok_s"]
         print(f"scheduled={summary['scheduled']} hung={summary['hung']} "
@@ -191,7 +268,11 @@ def main(argv=None) -> int:
               f"goodput={summary['goodput_rps']:.1f}rps")
         print("latency(200s): " + "  ".join(
             f"p{q:.0f}={lat[q] * 1e3:.2f}ms" for q in (50.0, 95.0, 99.0)))
-    return 1 if summary["hung"] else 0
+        if slo is not None:
+            print(f"slo: {'PASS' if slo['pass'] else 'FAIL'} {slo}")
+    if summary["hung"]:
+        return 1
+    return 0 if slo is None or slo["pass"] else 2
 
 
 if __name__ == "__main__":
