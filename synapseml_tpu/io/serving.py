@@ -39,6 +39,7 @@ from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
 from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import faults as _flt
+from synapseml_tpu.runtime import perfwatch as _pw
 from synapseml_tpu.runtime import slo as _slo
 from synapseml_tpu.runtime import structlog as _slog
 from synapseml_tpu.runtime import telemetry as _tm
@@ -357,6 +358,13 @@ class WorkerServer:
         self._m_replies: Dict[int, _tm.Counter] = {}
         _tm.gauge_fn("serving_queue_depth", self.requests.qsize,
                      server=name)
+        # performance observatory (runtime/perfwatch.py): per-device
+        # memory gauges registered once per process. lazy=True — a
+        # jax-free front-end (pure-numpy pipeline, router beside a
+        # separate scorer holding exclusive libtpu access) must not
+        # force-initialize the backend by merely binding a port; any
+        # scoring replica registers via its executor's construction
+        _pw.ensure_registered(lazy=True)
         # SLO accounting (runtime/slo.py; methodology in docs/
         # observability.md "SLO accounting"): scrape-time views over
         # the reply counters and roundtrip histogram this server
@@ -587,6 +595,17 @@ class WorkerServer:
                     self._send_plain(
                         200,
                         json.dumps(_bb.thread_stacks()).encode("utf-8"),
+                        "application/json")
+                    return
+                if self.path == "/debug/memory":
+                    # per-device memory picture (runtime/perfwatch.py):
+                    # memory_stats where the backend has an allocator,
+                    # live_arrays aggregation otherwise, plus process
+                    # peaks — fresh sample, the operator wants NOW
+                    self._send_plain(
+                        200,
+                        json.dumps(_pw.memory_snapshot(),
+                                   default=repr).encode("utf-8"),
                         "application/json")
                     return
                 if self.path.startswith("/debug/profile"):

@@ -33,6 +33,7 @@ import os
 import pickle
 import tempfile
 import threading
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -53,6 +54,12 @@ _M_SKEW = _tm.counter("compile_cache_store_skew_total")
 _M_DESER_FAIL = _tm.counter("compile_cache_deserialize_failures_total")
 _M_SAVE = _tm.counter("compile_cache_saves_total")
 _M_SAVE_FAIL = _tm.counter("compile_cache_save_failures_total")
+# recompile-sentinel companion (runtime/executor.py registers the
+# warmup/dispatch phases): how long a warm restart spends turning a
+# store entry back into a runnable executable — the cost a "loaded"
+# warmup disposition actually paid
+_M_DESER_S = _tm.histogram("executor_compile_seconds",
+                           phase="deserialize")
 
 _STATE_LOCK = threading.Lock()
 _PERSISTENT_WIRED: Optional[str] = None
@@ -243,8 +250,10 @@ class ExecutableStore:
                 return None
             from jax.experimental import serialize_executable as _se
 
+            t0 = time.monotonic()
             payload, in_tree, out_tree = pickle.loads(raw[off:])
             compiled = _se.deserialize_and_load(payload, in_tree, out_tree)
+            _M_DESER_S.observe(time.monotonic() - t0)
         except Exception:  # noqa: BLE001 - any corruption = miss
             _M_DESER_FAIL.inc()
             return None

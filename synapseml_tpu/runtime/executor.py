@@ -56,6 +56,7 @@ import numpy as np
 from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import compile_cache as _cc
 from synapseml_tpu.runtime import faults as _flt
+from synapseml_tpu.runtime import perfwatch as _pw
 from synapseml_tpu.runtime import telemetry as _tm
 from synapseml_tpu.runtime.faults import PipelineBrokenError
 
@@ -77,6 +78,29 @@ _M_AOT_MISS = _tm.counter("executor_aot_misses_total")
 _M_AOT_RETIRED = _tm.counter("executor_aot_retired_total")
 _M_DONATE_FB = _tm.counter("executor_donation_fallback_total")
 _M_PIPE_RESTARTS = _tm.counter("executor_pipeline_restarts_total")
+
+# -- recompile sentinel (docs/observability.md "Recompile sentinel") --------
+# After warmup() has AOT-compiled the executor's full signature set, any
+# trace/compile on the dispatch path is an INCIDENT — a mystery latency
+# spike with a name. Reasons: "shape_drift" (a signature outside the
+# warmed set — usually an unwarmed bucket or feature-width change),
+# "arity" (a call arity warmup never saw), "donation_mask" (same
+# shapes, different donation annotation — a distinct XLA program),
+# "cache_skew" (a warmed executable retired after failing to run — a
+# shared cache volume written by a different host). Handles resolved at
+# import so the series exist (at 0) on every scrape.
+RECOMPILE_REASONS = ("shape_drift", "arity", "donation_mask",
+                     "cache_skew")
+_M_RECOMPILE = {r: _tm.counter("executor_recompiles_total", reason=r)
+                for r in RECOMPILE_REASONS}
+# XLA trace+compile wall time by phase: "warmup" = AOT precompiles
+# (off the serving path), "dispatch" = a first-call lazy compile ON the
+# dispatch path (post-warmup these are exactly the recompiles above),
+# "deserialize" = store loads (runtime/compile_cache.py)
+_M_COMPILE_WARM_S = _tm.histogram("executor_compile_seconds",
+                                  phase="warmup")
+_M_COMPILE_DISP_S = _tm.histogram("executor_compile_seconds",
+                                  phase="dispatch")
 
 # fault-injection points (runtime/faults.py, docs/robustness.md):
 # resolved once at import, fire() is a single attribute test when no
@@ -748,6 +772,18 @@ class BatchedExecutor:
         # so access rides _tables_lock too
         self._aot: Dict[tuple, Any] = {}  # synlint: shared
         self._aot_hits = 0  # synlint: shared
+        # -- recompile-sentinel state (under _tables_lock too) ----------
+        # warmup() flips _warmed and records what it compiled so a
+        # post-warmup lazy compile on the dispatch path can be counted
+        # AND classified: _warm_masks maps each warmed input signature
+        # to its donation masks, _warm_arities the call arities warmup
+        # covered, _lazy_seen every (sig, mask, layout, device) the lazy
+        # jit path has already compiled (so only FIRST calls — the ones
+        # that actually trace+compile — are timed and counted)
+        self._warmed = False  # synlint: shared
+        self._warm_masks: Dict[tuple, set] = {}  # synlint: shared
+        self._warm_arities: set = set()  # synlint: shared
+        self._lazy_seen: set = set()  # synlint: shared
         # -- telemetry handles (resolved here, off the hot path) --------
         # per-device dispatch counters: one series per target the
         # dispatch thread can route a bucket to — rr/single layouts
@@ -765,6 +801,18 @@ class BatchedExecutor:
                 "executor_dispatch_total",
                 device=str(device.id) if device is not None else "default")
         self._m_bucket: Dict[int, _tm.Counter] = {}
+        # performance observatory (runtime/perfwatch.py): per-device
+        # memory gauges once per process, plus a duty-cycle gauge per
+        # dispatch target this executor counts under — both sampled at
+        # scrape time only, nothing on the hot path
+        _pw.ensure_registered()
+        if devices is not None:
+            for d in devices:
+                _pw.register_duty_gauge(str(d.id))
+            _pw.register_duty_gauge(f"dp{len(devices)}")
+        else:
+            _pw.register_duty_gauge(
+                str(device.id) if device is not None else "default")
 
     @property
     def pipeline_depth(self) -> int:
@@ -1284,6 +1332,7 @@ class BatchedExecutor:
                     warm = aot_key in self._aot
                 if warm:
                     entry["status"] = "warm"
+                    self._note_warm_sig(sig, mask)
                     report.entries.append(entry)
                     continue
                 skey = None
@@ -1299,6 +1348,7 @@ class BatchedExecutor:
                             with self._tables_lock:
                                 self._aot[aot_key] = compiled
                             entry["status"] = "loaded"
+                            self._note_warm_sig(sig, mask)
                             report.entries.append(entry)
                             continue
                     sds = [jax.ShapeDtypeStruct(s, jnp.dtype(d),
@@ -1310,11 +1360,14 @@ class BatchedExecutor:
                     # tables lock: holding it here would stall the
                     # dispatch thread's AOT lookups behind a multi-second
                     # compile (the CC003 shape synlint exists to catch)
+                    t0c = time.monotonic()
                     compiled = self._jit_for(len(sds), mask).lower(
                         *bound, *sds).compile()
+                    _M_COMPILE_WARM_S.observe(time.monotonic() - t0c)
                     with self._tables_lock:
                         self._aot[aot_key] = compiled
                     entry["status"] = "compiled"
+                    self._note_warm_sig(sig, mask)
                     if skey is not None:
                         entry["persisted"] = self._store.save(skey, compiled)
                 except Exception as e:  # noqa: BLE001 - degrade to lazy jit
@@ -1322,7 +1375,33 @@ class BatchedExecutor:
                     report.errors.append(
                         f"bucket={bucket} {store_layout}: {e!r}")
                 report.entries.append(entry)
+        # the sentinel arms HERE: from now on, any trace/compile the
+        # dispatch path performs is a counted, classified, ring-recorded
+        # recompile incident (signatures warmup failed on — status
+        # "error" — surface as shape_drift when they compile lazily)
+        with self._tables_lock:
+            self._warmed = True
         return report
+
+    def _note_warm_sig(self, sig: tuple, mask: Tuple[bool, ...]):
+        """Record one warmed signature for the recompile sentinel's
+        post-warmup classification (shape vs arity vs donation drift)."""
+        with self._tables_lock:
+            self._warm_masks.setdefault(sig, set()).add(mask)
+            self._warm_arities.add(len(sig))
+
+    def _classify_recompile(self, sig: tuple, mask: Tuple[bool, ...],
+                            retired: bool) -> str:
+        """Why is the dispatch path compiling after warmup? Called with
+        ``_tables_lock`` held (reads the warm tables only)."""
+        if retired:
+            return "cache_skew"
+        masks = self._warm_masks.get(sig)
+        if masks and mask not in masks:
+            return "donation_mask"
+        if self._warm_arities and len(sig) not in self._warm_arities:
+            return "arity"
+        return "shape_drift"
 
     # -- pipeline stages (overridable/patchable per instance) ------------
     def _dispatch(self, arrays, n: int, bucket: int, internal: bool = False):
@@ -1390,8 +1469,10 @@ class BatchedExecutor:
                 # donation would delete the caller's own buffer
                 padded[i] = jnp.copy(padded[i])
         _F_COMPUTE.fire()
+        aot_key = (sig, mask, layout, rr_idx)
+        retired = False
         with self._tables_lock:
-            compiled = self._aot.get((sig, mask, layout, rr_idx))
+            compiled = self._aot.get(aot_key)
         if compiled is not None:
             # warmup()-precompiled (or store-deserialized) executable:
             # no trace, no XLA compile on the serving path
@@ -1408,11 +1489,50 @@ class BatchedExecutor:
                 # retire the entry and fall back to the lazy jit path — a
                 # genuine program error will re-raise from the jit call
                 with self._tables_lock:
-                    self._aot.pop((sig, mask, layout, rr_idx), None)
+                    self._aot.pop(aot_key, None)
                 _M_AOT_RETIRED.inc()
+                retired = True
         else:
             _M_AOT_MISS.inc()
-        out = self._jit_for(len(padded), mask)(*bound, *padded)
+        # -- recompile sentinel (docs/observability.md): the lazy jit
+        # call below traces+compiles exactly when this (sig, mask,
+        # layout, device) is NEW to this executor. First calls are
+        # timed into executor_compile_seconds{phase="dispatch"}; after
+        # warmup() they are additionally counted by reason, recorded in
+        # the flight-recorder ring (which emits the matching structlog
+        # line), and carry the offending signature — a post-warmup
+        # recompile is an incident, not a mystery latency spike. Note
+        # the timed wall includes the (non-blocking) dispatch start;
+        # on a first call the trace+compile dominates it.
+        with self._tables_lock:
+            unseen = aot_key not in self._lazy_seen
+            if unseen:
+                self._lazy_seen.add(aot_key)
+            reason = (self._classify_recompile(sig, mask, retired)
+                      if unseen and self._warmed else None)
+        t0 = time.monotonic() if unseen else 0.0
+        try:
+            out = self._jit_for(len(padded), mask)(*bound, *padded)
+        except BaseException:
+            if unseen:
+                # a first attempt that RAISED (transient XLA error,
+                # injected fault) did not cache an executable — un-see
+                # the key so the retry's real compile is still counted
+                # and timed instead of slipping past the sentinel
+                with self._tables_lock:
+                    self._lazy_seen.discard(aot_key)
+            raise
+        if unseen:
+            dt = time.monotonic() - t0
+            _M_COMPILE_DISP_S.observe(dt)
+            if reason is not None:
+                _M_RECOMPILE[reason].inc()
+                _bb.record(
+                    "recompile", level="warn", reason=reason,
+                    bucket=bucket, layout=layout,
+                    device=(None if rr_idx is None
+                            else str(self._devices[rr_idx].id)),
+                    seconds=round(dt, 6), signature=repr(sig)[:240])
         return out, n, bucket
 
     def _fetch(self, out, n: int, bucket: int):

@@ -1,0 +1,425 @@
+"""Performance observatory: device-memory telemetry + utilization attribution.
+
+PRs 6 and 9 made *requests* and *incidents* observable; the performance
+plane stayed dark — HBM pressure was never measured and "are the chips
+busy or starved" had no answer short of attaching a profiler. This
+module is the scrape-time half of the performance-observability layer
+(the recompile sentinel in :mod:`~synapseml_tpu.runtime.executor` is
+the dispatch-path half):
+
+- **Device-memory gauges** (``device_hbm_bytes_in_use{device=}``,
+  ``device_hbm_bytes_limit``, ``device_hbm_peak_bytes``,
+  ``device_live_buffer_count``), sampled at scrape time via
+  ``device.memory_stats()`` where the backend provides it (TPU/GPU)
+  with a ``jax.live_arrays()`` aggregation fallback (CPU, including
+  the forced-8-device test platform). One real sample serves a whole
+  scrape (short TTL cache) — many gauges, one walk. A per-process
+  **peak high-water mark** is tracked across samples, so a transient
+  allocation spike between scrapes that the backend's own peak counter
+  caught is never lost.
+- **HBM high-water events**: a device crossing
+  ``SYNAPSEML_HBM_HIGH_WATER`` (fraction of ``bytes_limit``, default
+  0.9; 0 disables) lands one ``hbm_high_water`` event in the flight
+  recorder ring + structured log per *crossing* (re-armed only after
+  usage falls 15% below the threshold — a device hovering at the line
+  produces one breadcrumb, not one per scrape).
+- **Utilization attribution** (``executor_duty_cycle{device=}``):
+  per-dispatch-target compute duty-cycle gauges derived from series the
+  executor already records — no new hot-path instrumentation. Between
+  consecutive scrapes, the delta of ``executor_compute_seconds``'s sum
+  is attributed to dispatch targets proportionally to their
+  ``executor_dispatch_total`` deltas and divided by the wall-clock
+  window: the fraction of the window each target spent with a batch in
+  flight. A dp-sharded mesh counts under its ``dp<N>`` label — one
+  batch keeps *all N chips* busy for its window, so the value is the
+  per-chip busy fraction of the mesh, not 1/N of it. Because "compute"
+  is the overlap-inclusive dispatch-end → drain-pickup bound
+  (docs/observability.md), overlapping in-flight batches can push the
+  raw ratio past 1; the gauge clamps at 1.0 — saturated means
+  saturated. Low duty with a deep queue = the chips are starved
+  (host staging or H2D bound); high duty with low throughput = the
+  program itself is slow.
+
+Everything here is scrape-time only: nothing records on the submit/
+dispatch/drain hot paths, and a process that never scrapes pays one
+``ensure_registered()`` flag test per server/executor construction.
+``GET /debug/memory`` (io/serving.py) serves :func:`memory_snapshot`
+live beside ``/debug/flight``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from synapseml_tpu.runtime import blackbox as _bb
+from synapseml_tpu.runtime import telemetry as _tm
+
+__all__ = [
+    "ensure_registered", "register_duty_gauge", "device_memory",
+    "memory_snapshot", "duty_cycles", "check_high_water",
+    "high_water_fraction", "set_high_water_fraction",
+]
+
+_LOCK = threading.Lock()
+_T0 = time.monotonic()
+
+# one real device walk serves every gauge of a scrape: /metrics reads
+# 4 gauges per device back to back, and memory_stats()/live_arrays()
+# are not free — the TTL is well under any sane scrape interval
+_MEM_TTL_S = 0.5
+
+
+class _State:
+    def __init__(self):
+        self.registered = False
+        # process-lifetime high-water per device key (bytes): the max of
+        # every sampled bytes_in_use and the backend's own peak counter
+        self.peak: Dict[str, int] = {}
+        # per-device "already above the line" latch for the high-water
+        # event debounce (one event per crossing, not per scrape)
+        self.high: Dict[str, bool] = {}
+        self.mem_cache: Optional[List[Dict[str, Any]]] = None
+        self.mem_cache_ts = 0.0
+        frac = os.environ.get("SYNAPSEML_HBM_HIGH_WATER", "0.9")
+        try:
+            self.high_water = float(frac)
+        except ValueError:
+            self.high_water = 0.9
+        # duty-cycle window state: the raw (wall, compute_sum, counts)
+        # snapshot the previous evaluation ended on, plus the evaluated
+        # values served to every gauge read inside one scrape
+        self.duty_prev: Optional[Dict[str, Any]] = None
+        self.duty_vals: Dict[str, float] = {}
+        self.duty_vals_ts = 0.0
+        self.duty_registered: set = set()
+
+
+_S = _State()
+
+
+def high_water_fraction() -> float:
+    return _S.high_water
+
+
+def set_high_water_fraction(frac: float) -> float:
+    """Retune the high-water threshold (tests, serving entry); returns
+    the previous value. 0 disables the event."""
+    prev = _S.high_water
+    _S.high_water = float(frac)
+    return prev
+
+
+# -- device memory ----------------------------------------------------------
+
+def _stats_record(d, stats: Dict[str, Any]) -> Dict[str, Any]:
+    def _int(key) -> int:
+        try:
+            return int(stats.get(key) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    return {
+        "device": str(d.id), "platform": str(d.platform),
+        "source": "memory_stats",
+        "bytes_in_use": _int("bytes_in_use"),
+        "bytes_limit": _int("bytes_limit"),
+        "peak_bytes_in_use": _int("peak_bytes_in_use"),
+        "live_buffers": _int("num_allocs"),
+    }
+
+
+def _live_array_totals() -> Dict[int, Tuple[int, int]]:
+    """{device_id: (bytes, buffer_count)} aggregated from
+    ``jax.live_arrays()`` — the fallback where the backend exposes no
+    allocator stats (CPU, incl. the forced-8-device test platform).
+    Per-device bytes come from each array's ``addressable_shards``
+    (``shard.data.nbytes`` on ``shard.device``), so a REPLICATED array
+    counts its full size on every device holding a copy — an even
+    split of ``a.nbytes`` would read N× low exactly for the
+    weights-replicated layouts the executor uses. Fallback for arrays
+    whose shards are unreadable mid-walk: even split."""
+    import jax
+
+    totals: Dict[int, List[int]] = {}
+
+    def _add(dev_id: int, nbytes: int):
+        ent = totals.setdefault(dev_id, [0, 0])
+        ent[0] += nbytes
+        ent[1] += 1
+
+    try:
+        arrays = jax.live_arrays()
+    except Exception:  # noqa: BLE001 - introspection is best-effort
+        return {}
+    for a in arrays:
+        try:
+            for shard in a.addressable_shards:
+                _add(shard.device.id, int(shard.data.nbytes))
+        except Exception:  # noqa: BLE001 - deleted/donated mid-walk
+            try:
+                nbytes = int(a.nbytes)
+                devs = list(a.devices())
+            except Exception:  # noqa: BLE001
+                continue
+            if not devs:
+                continue
+            for d in devs:
+                _add(d.id, nbytes // len(devs))
+    return {k: (v[0], v[1]) for k, v in totals.items()}
+
+
+def device_memory() -> List[Dict[str, Any]]:
+    """One record per local device: ``memory_stats()`` where available,
+    the ``live_arrays`` aggregation otherwise. Pure sample — no peak
+    update, no events (that is :func:`_sampled`'s job)."""
+    import jax
+
+    out: List[Dict[str, Any]] = []
+    live: Optional[Dict[int, Tuple[int, int]]] = None
+    for d in jax.local_devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 - backend without allocator stats
+            stats = None
+        if stats:
+            out.append(_stats_record(d, stats))
+            continue
+        if live is None:
+            live = _live_array_totals()
+        used, count = live.get(d.id, (0, 0))
+        out.append({
+            "device": str(d.id), "platform": str(d.platform),
+            "source": "live_arrays",
+            "bytes_in_use": used, "bytes_limit": 0,
+            "peak_bytes_in_use": 0, "live_buffers": count,
+        })
+    return out
+
+
+def _apply_peaks(devices: List[Dict[str, Any]]) -> None:
+    """Fold a sample into the process-lifetime peaks and annotate each
+    record with ``process_peak_bytes``."""
+    with _LOCK:
+        for rec in devices:
+            key = rec["device"]
+            peak = max(_S.peak.get(key, 0), rec["bytes_in_use"],
+                       rec["peak_bytes_in_use"])
+            _S.peak[key] = peak
+            rec["process_peak_bytes"] = peak
+
+
+def check_high_water(devices: List[Dict[str, Any]],
+                     fraction: Optional[float] = None) -> List[str]:
+    """Latch-debounced high-water detection over one sample: a device
+    whose ``bytes_in_use / bytes_limit`` crosses ``fraction`` records
+    ONE ``hbm_high_water`` flight-recorder event (which also emits the
+    structured log line); the latch re-arms when usage falls below 85%
+    of the threshold. Devices with no known limit (the live_arrays
+    fallback) never fire. Returns the device keys that fired."""
+    frac = _S.high_water if fraction is None else fraction
+    fired: List[str] = []
+    if frac <= 0:
+        return fired
+    for rec in devices:
+        limit = rec.get("bytes_limit") or 0
+        if limit <= 0:
+            continue
+        key = rec["device"]
+        ratio = rec["bytes_in_use"] / limit
+        with _LOCK:
+            was = _S.high.get(key, False)
+            if ratio >= frac and not was:
+                _S.high[key] = True
+                fire = True
+            else:
+                fire = False
+                if was and ratio < frac * 0.85:
+                    _S.high[key] = False
+        if fire:
+            # leaf call: blackbox.record takes only its own ring lock
+            _bb.record("hbm_high_water", level="warn", device=key,
+                       platform=rec.get("platform"),
+                       bytes_in_use=rec["bytes_in_use"],
+                       bytes_limit=limit,
+                       fraction=round(ratio, 4), threshold=frac)
+            fired.append(key)
+    return fired
+
+
+def _sampled(force: bool = False) -> List[Dict[str, Any]]:
+    """TTL-cached sample with the peak/high-water side effects applied —
+    what the gauges read. ``force`` bypasses the cache (the
+    ``/debug/memory`` surface: an operator asking wants *now*)."""
+    now = time.monotonic()
+    if not force:
+        with _LOCK:
+            if (_S.mem_cache is not None
+                    and now - _S.mem_cache_ts < _MEM_TTL_S):
+                return _S.mem_cache
+    devices = device_memory()  # jax walk outside the lock
+    _apply_peaks(devices)
+    check_high_water(devices)
+    with _LOCK:
+        _S.mem_cache = devices
+        _S.mem_cache_ts = now
+    return devices
+
+
+def _mem_field(device_key: str, field: str) -> float:
+    for rec in _sampled():
+        if rec["device"] == device_key:
+            return float(rec.get(field, 0))
+    return 0.0
+
+
+def memory_snapshot(force: bool = True) -> Dict[str, Any]:
+    """The ``GET /debug/memory`` payload: per-device records plus
+    process totals. ``force=True`` (the default) takes a fresh sample."""
+    devices = _sampled(force=force)
+    return {
+        "ts": round(time.time(), 6),
+        "pid": os.getpid(),
+        "high_water_fraction": _S.high_water,
+        "devices": devices,
+        "totals": {
+            "bytes_in_use": sum(d["bytes_in_use"] for d in devices),
+            "live_buffers": sum(d["live_buffers"] for d in devices),
+            "process_peak_bytes": sum(
+                d.get("process_peak_bytes", 0) for d in devices),
+        },
+    }
+
+
+def _jax_initialized() -> bool:
+    """Whether a jax backend already exists WITHOUT creating one —
+    best-effort over a private surface; False when undetectable."""
+    try:
+        import sys
+
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return False
+        from jax._src import xla_bridge as _xb
+
+        return bool(getattr(_xb, "_backends", None))
+    except Exception:  # noqa: BLE001 - private surface moved
+        return False
+
+
+def ensure_registered(lazy: bool = False) -> bool:
+    """Register the per-device memory gauges once per process.
+    ``BatchedExecutor`` construction calls this eagerly (the backend is
+    in use by definition); ``WorkerServer`` passes ``lazy=True`` so a
+    jax-free serving front-end (a pure-numpy echo/proxy pipeline, or a
+    router process sharing a TPU host with a separate scorer that needs
+    exclusive libtpu access) never force-initializes the backend just
+    by binding a port — registration then happens when the first
+    executor appears. (``/debug/memory`` still samples on demand: an
+    operator explicitly asking pays the init.) Idempotent and cheap
+    after the first call; returns True once registered."""
+    if _S.registered:
+        return True
+    if lazy and not _jax_initialized():
+        return False
+    with _LOCK:
+        if _S.registered:
+            return True
+        _S.registered = True
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 - no backend: stay unregistered
+        with _LOCK:
+            _S.registered = False
+        return False
+    for d in devices:
+        key = str(d.id)
+        _tm.gauge_fn("device_hbm_bytes_in_use",
+                     lambda k=key: _mem_field(k, "bytes_in_use"),
+                     device=key)
+        _tm.gauge_fn("device_hbm_bytes_limit",
+                     lambda k=key: _mem_field(k, "bytes_limit"),
+                     device=key)
+        _tm.gauge_fn("device_hbm_peak_bytes",
+                     lambda k=key: _mem_field(k, "process_peak_bytes"),
+                     device=key)
+        _tm.gauge_fn("device_live_buffer_count",
+                     lambda k=key: _mem_field(k, "live_buffers"),
+                     device=key)
+    return True
+
+
+# -- utilization attribution ------------------------------------------------
+
+def _duty_raw() -> Dict[str, Any]:
+    """Current raw totals the attribution differentiates: wall clock,
+    the summed ``executor_compute_seconds`` across all label sets, and
+    per-target ``executor_dispatch_total`` values."""
+    compute = 0.0
+    for _labels, m in _tm.series("executor_compute_seconds"):
+        compute += m._aggregate()[1]
+    counts: Dict[str, float] = {}
+    for labels, m in _tm.series("executor_dispatch_total"):
+        dev = labels.get("device", "default")
+        counts[dev] = counts.get(dev, 0.0) + m.value
+    return {"t": time.monotonic(), "compute": compute, "counts": counts}
+
+
+def _attribute(prev: Dict[str, Any],
+               cur: Dict[str, Any]) -> Dict[str, float]:
+    """Pure window math: the compute-seconds delta split across targets
+    by their dispatch-count deltas, over the wall window, clamped to
+    [0, 1]. Targets with no batches in the window read 0."""
+    d_wall = max(1e-9, cur["t"] - prev["t"])
+    d_compute = max(0.0, cur["compute"] - prev["compute"])
+    deltas = {k: max(0.0, v - prev["counts"].get(k, 0.0))
+              for k, v in cur["counts"].items()}
+    total = sum(deltas.values())
+    if total <= 0 or d_compute <= 0:
+        return {k: 0.0 for k in cur["counts"]}
+    return {k: min(1.0, (d / total) * d_compute / d_wall)
+            for k, d in deltas.items()}
+
+
+def duty_cycles(force: bool = False) -> Dict[str, float]:
+    """{dispatch target: duty cycle in [0,1]} over the window since the
+    previous evaluation. TTL-cached (1s) so the many per-label gauge
+    reads of one scrape share a single window; each scrape's window is
+    scrape-to-scrape, the first one is process-start-to-scrape.
+
+    The whole check-evaluate-advance runs under the state lock: two
+    concurrent TTL-missing readers (a /metrics scrape racing a
+    /debug/flight telemetry snapshot) must not BOTH advance the
+    window, or the loser attributes over a microsecond wall and every
+    gauge reads a spurious 0 for busy chips. ``_duty_raw``'s registry
+    walk under the lock is fine — scrape-time only, and the lock order
+    (perfwatch lock → registry lock) is taken nowhere in reverse."""
+    with _LOCK:
+        now = time.monotonic()
+        if not force and now - _S.duty_vals_ts < 1.0 and _S.duty_vals:
+            return _S.duty_vals
+        cur = _duty_raw()
+        prev = _S.duty_prev or {"t": _T0, "compute": 0.0, "counts": {}}
+        vals = _attribute(prev, cur)
+        _S.duty_prev = cur
+        _S.duty_vals = vals
+        _S.duty_vals_ts = cur["t"]
+        return vals
+
+
+def register_duty_gauge(label: str):
+    """Register ``executor_duty_cycle{device=<label>}`` once per
+    dispatch target — called by ``BatchedExecutor`` construction for
+    each label it will count dispatches under, so the gauge set always
+    matches the counter set."""
+    with _LOCK:
+        if label in _S.duty_registered:
+            return
+        _S.duty_registered.add(label)
+    _tm.gauge_fn("executor_duty_cycle",
+                 lambda l=label: duty_cycles().get(l, 0.0),
+                 device=label)

@@ -46,7 +46,8 @@ from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Span", "counter", "gauge", "gauge_fn",
-    "histogram", "unregister", "snapshot", "prometheus_text", "reset",
+    "histogram", "series", "unregister", "snapshot", "prometheus_text",
+    "reset",
     "enabled", "set_enabled", "start_span", "get_span", "completed_spans",
     "set_current_spans", "reset_current_spans", "current_spans",
     "trace_annotation", "LATENCY_BUCKETS", "SIZE_BUCKETS",
@@ -296,6 +297,18 @@ def gauge_fn(name: str, fn: Callable[[], float], **labels: Any) -> Gauge:
 def histogram(name: str, buckets: Sequence[float] = LATENCY_BUCKETS,
               **labels: Any) -> Histogram:
     return _get_or_make(Histogram, name, labels, buckets=buckets)
+
+
+def series(name: str) -> List[Tuple[Dict[str, str], _Metric]]:
+    """Every registered label set of one family:
+    ``[({label: value}, metric), ...]``. The read-side lookup derived
+    views use (e.g. the duty-cycle attribution in
+    ``runtime/perfwatch.py`` walks ``executor_dispatch_total``) —
+    registry-lock cost, never on a hot path."""
+    name = _qualify(name)
+    with _REG_LOCK:
+        return [(dict(k[1]), m) for k, m in _METRICS.items()
+                if k[0] == name]
 
 
 def unregister(name: str, **labels: Any) -> bool:

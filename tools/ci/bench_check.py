@@ -1,0 +1,303 @@
+"""Noise-aware bench regression gate (docs/perf.md "Regression gate").
+
+Bench numbers on a shared CI box drift — identical-config legs on this
+class of runner have measured ±30% (throughput) to ±100% (latency)
+run-to-run jitter (docs/observability.md "Overhead methodology"). A
+naive "new < old" comparison would page on noise daily and train
+everyone to ignore it. This gate is built to catch *step-function*
+regressions (a 2× cold start, a serving path that grew a sync) while
+staying silent inside the measured noise envelope:
+
+1. **min-of-N**: every run is appended to a ``bench_history.jsonl``
+   (one strict-JSON run per line — the same files ``bench.py --out``
+   writes); the gate evaluates the **best** value per metric over the
+   last N runs (min for latency-unit metrics, max for throughput).
+   Noise is one-sided — contention only ever makes a box *slower* — so
+   best-of-N estimates the box's capability, not its worst moment.
+2. **Per-metric noise tolerances**: the committed baseline
+   (``tools/ci/bench_baseline.json``) carries an explicit tolerance
+   per metric — the measured jitter envelope of that metric on the CI
+   runner class, plus safety margin. A latency metric regresses when
+   ``best > baseline * (1 + tol)``; a throughput metric when
+   ``best < baseline * (1 - tol)``.
+3. A metric in the baseline that is **missing** from every evaluated
+   run is a failure too — silently losing a metric would defeat the
+   gate exactly when a bench crashes.
+
+Exit codes: 0 = within tolerance, 2 = regression (or vanished metric),
+1 = usage/malformed input. Importable: :func:`evaluate` is the pure
+comparison (tests/test_perfwatch.py pins pass-on-jitter and
+fail-on-20%-regression on synthetic fixtures).
+
+Usage::
+
+    python bench.py --fast --out run1.json
+    python bench.py --fast --out run2.json
+    python tools/ci/bench_check.py --baseline tools/ci/bench_baseline.json \
+        --history /tmp/bench_history.jsonl --n 2 run1.json run2.json
+
+    # refresh the committed baseline from the runs (keeps tolerances):
+    python tools/ci/bench_check.py --write-baseline \
+        --baseline tools/ci/bench_baseline.json run1.json run2.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# default per-metric tolerances for --write-baseline (fractional; the
+# rationale lives in docs/perf.md "Regression gate"): latency metrics
+# on this runner class drift up to ±100% leg-to-leg, min-of-N pulls the
+# estimate toward the floor but a 1.0 band is still needed to keep the
+# gate quiet on contended runners; the cold-start A/B adds XLA-compile
+# variance on top. The gate is a tripwire for 2-3x steps, not percent
+# drift — percent-level claims ride the TPU driver's BENCH history.
+DEFAULT_TOLERANCE = 0.5
+TOLERANCES = {
+    "serving_roundtrip_p50_ms": 1.0,
+    "serving_scored_roundtrip_p50_ms": 1.0,
+    "serving_scored_concurrent_p50_ms": 1.0,
+    "serving_cold_start_first_batch_ms": 1.5,
+}
+
+# units whose metrics are better when SMALLER (latency-domain); every
+# other unit is a rate/throughput where bigger is better
+_LOWER_IS_BETTER_UNITS = ("ms", "s", "seconds")
+
+
+def lower_is_better(unit: str) -> bool:
+    return (unit or "").strip().lower() in _LOWER_IS_BETTER_UNITS
+
+
+def flatten_metrics(run: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """{metric: {value, unit}} over the headline entry + every
+    ``secondary`` entry of one bench payload. Non-numeric values (a
+    nulled-out NaN) are skipped — "missing" is the honest reading."""
+    out: Dict[str, Dict[str, Any]] = {}
+    entries = [run] + list(run.get("secondary") or [])
+    for e in entries:
+        name = e.get("metric")
+        value = e.get("value")
+        if isinstance(name, str) and isinstance(value, (int, float)) \
+                and not isinstance(value, bool):
+            out[name] = {"value": float(value),
+                         "unit": str(e.get("unit", ""))}
+    return out
+
+
+def best_of(runs: List[Dict[str, Any]], metric: str,
+            unit: str) -> Optional[float]:
+    """Best value of one metric across runs: min for latency-domain
+    units, max otherwise; None when absent from every run."""
+    values = []
+    for run in runs:
+        rec = flatten_metrics(run).get(metric)
+        if rec is not None:
+            values.append(rec["value"])
+    if not values:
+        return None
+    return min(values) if lower_is_better(unit) else max(values)
+
+
+def evaluate(runs: List[Dict[str, Any]],
+             baseline: Dict[str, Any]) -> Tuple[List[Dict[str, Any]],
+                                                List[Dict[str, Any]]]:
+    """Compare best-of-``runs`` against ``baseline``; returns
+    ``(rows, regressions)`` where each row describes one baseline
+    metric's verdict and ``regressions`` is the failing subset."""
+    metrics = baseline.get("metrics") or {}
+    default_tol = float(
+        (baseline.get("defaults") or {}).get("tolerance",
+                                             DEFAULT_TOLERANCE))
+    rows: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    for name in sorted(metrics):
+        spec = metrics[name]
+        base = float(spec["value"])
+        unit = str(spec.get("unit", ""))
+        tol = float(spec.get("tolerance", default_tol))
+        lower = lower_is_better(unit)
+        best = best_of(runs, name, unit)
+        if best is None:
+            row = {"metric": name, "unit": unit, "baseline": base,
+                   "best": None, "tolerance": tol, "ratio": None,
+                   "status": "missing"}
+            rows.append(row)
+            regressions.append(row)
+            continue
+        if lower:
+            limit = base * (1 + tol)
+            regressed = best > limit
+        else:
+            # a throughput tolerance >= 1.0 would push the limit to or
+            # below 0 and silently disable the gate — clamp so even a
+            # deliberately loose band still trips on a collapse
+            limit = base * (1 - min(tol, 0.9))
+            regressed = best < limit
+        ratio = (best / base) if base else float("inf")
+        row = {"metric": name, "unit": unit, "baseline": base,
+               "best": best, "tolerance": tol,
+               "ratio": round(ratio, 3),
+               "status": "regressed" if regressed else "ok"}
+        rows.append(row)
+        if regressed:
+            regressions.append(row)
+    return rows, regressions
+
+
+def append_history(path: str, runs: List[Dict[str, Any]]) -> None:
+    """One strict-JSON run per line, stamped — the bench's flight
+    history. Append-only so successive CI runs on a persistent runner
+    accumulate a local record alongside the committed baseline."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a", encoding="utf-8") as fh:
+        for run in runs:
+            rec = {"ts": round(time.time(), 3), "run": run}
+            fh.write(json.dumps(rec, allow_nan=False) + "\n")
+
+
+def load_history(path: str, n: int) -> List[Dict[str, Any]]:
+    """The last ``n`` runs from a history file (malformed lines are
+    skipped with a warning — a torn tail line must not kill the gate)."""
+    runs: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                print(f"bench_check: skipping malformed history line "
+                      f"{i + 1}", file=sys.stderr)
+                continue
+            runs.append(rec.get("run", rec))
+    return runs[-n:]
+
+
+def write_baseline(path: str, runs: List[Dict[str, Any]],
+                   default_tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Baseline = best-of-``runs`` per metric + the per-metric
+    tolerance table; committed next to the pipeline so every future
+    perf claim lands against a recorded reference."""
+    names: Dict[str, str] = {}
+    for run in runs:
+        for name, rec in flatten_metrics(run).items():
+            names.setdefault(name, rec["unit"])
+    metrics = {}
+    for name, unit in sorted(names.items()):
+        best = best_of(runs, name, unit)
+        if best is None:
+            continue
+        metrics[name] = {
+            "value": best, "unit": unit,
+            "tolerance": TOLERANCES.get(name, default_tolerance),
+        }
+    baseline = {
+        "generated_by": "tools/ci/bench_check.py --write-baseline",
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+        "n_runs": len(runs),
+        "defaults": {"tolerance": default_tolerance},
+        "metrics": metrics,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(baseline, fh, indent=2, allow_nan=False)
+        fh.write("\n")
+    return baseline
+
+
+def _print_report(rows: List[Dict[str, Any]], n_runs: int) -> None:
+    width = max([len(r["metric"]) for r in rows] + [6])
+    print(f"bench_check: best-of-{n_runs} vs baseline")
+    for r in rows:
+        best = "MISSING" if r["best"] is None else f"{r['best']:.4g}"
+        ratio = "" if r["ratio"] is None else f" ({r['ratio']:.2f}x)"
+        mark = "FAIL" if r["status"] != "ok" else " ok "
+        print(f"  [{mark}] {r['metric']:<{width}} best={best}"
+              f" baseline={r['baseline']:.4g} {r['unit']}"
+              f" tol=±{r['tolerance']:.0%}{ratio}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("runs", nargs="*", metavar="RUN_JSON",
+                    help="bench run files (bench.py --out); appended "
+                         "to --history when given")
+    ap.add_argument("--baseline",
+                    default=os.path.join(os.path.dirname(
+                        os.path.abspath(__file__)),
+                        "bench_baseline.json"))
+    ap.add_argument("--history", metavar="JSONL",
+                    help="append runs here and evaluate over its tail")
+    ap.add_argument("--n", type=int, default=3,
+                    help="evaluate best-of over the last N runs "
+                         "(default 3)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write best-of-runs as the new baseline "
+                         "instead of gating")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="default tolerance for --write-baseline "
+                         "metrics without a per-metric entry")
+    args = ap.parse_args(argv)
+
+    new_runs: List[Dict[str, Any]] = []
+    for path in args.runs:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                new_runs.append(json.load(fh))
+        except (OSError, ValueError) as e:
+            print(f"bench_check: cannot read run {path}: {e}")
+            return 1
+
+    if args.write_baseline:
+        if not new_runs:
+            print("bench_check: --write-baseline needs run files")
+            return 1
+        baseline = write_baseline(args.baseline, new_runs,
+                                  args.tolerance)
+        print(f"wrote {args.baseline}: {len(baseline['metrics'])} "
+              f"metrics from {len(new_runs)} run(s)")
+        return 0
+
+    if args.history:
+        if new_runs:
+            append_history(args.history, new_runs)
+        try:
+            runs = load_history(args.history, args.n)
+        except OSError as e:
+            print(f"bench_check: cannot read history {args.history}: {e}")
+            return 1
+    else:
+        runs = new_runs[-args.n:]
+    if not runs:
+        print("bench_check: no runs to evaluate (pass run files or "
+              "--history)")
+        return 1
+
+    try:
+        with open(args.baseline, encoding="utf-8") as fh:
+            baseline = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"bench_check: cannot read baseline {args.baseline}: {e}")
+        return 1
+
+    rows, regressions = evaluate(runs, baseline)
+    if not rows:
+        print("bench_check: baseline holds no metrics")
+        return 1
+    _print_report(rows, len(runs))
+    if regressions:
+        print(f"bench_check: {len(regressions)} regression(s) past "
+              "tolerance — see docs/perf.md \"Regression gate\"")
+        return 2
+    print(f"bench_check ok: {len(rows)} metrics within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

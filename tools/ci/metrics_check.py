@@ -10,6 +10,13 @@ observed ``serving_drain_seconds`` are asserted on a live exposition —
 plus the incident-diagnosis read surfaces (``/debug/flight``,
 ``/debug/threads``) and the scrape-time ``serving_slo_*`` gauges.
 
+And the performance observatory (runtime/perfwatch.py + the recompile
+sentinel): the executor is AOT-warmed, a deliberately shape-drifted
+request is posted, and ``executor_recompiles_total{reason=
+"shape_drift"}`` must move on the live exposition; the device-memory
+gauges are in CORE_SERIES and ``GET /debug/memory`` must answer
+mid-run with a record per local device.
+
 Exit 0 = every assertion held; any failure prints the offending series
 and exits nonzero.
 """
@@ -53,6 +60,18 @@ CORE_SERIES = [
     "synapseml_serving_slo_latency_good_fraction",
     "synapseml_serving_slo_latency_burn_rate",
     "synapseml_serving_slo_latency_threshold_ms",
+    # performance observatory (runtime/perfwatch.py + the recompile
+    # sentinel in runtime/executor.py, docs/observability.md):
+    # post-warmup recompile counters/compile timings register at
+    # executor import, duty-cycle + device-memory gauges at executor
+    # construction (servers register lazily, only when a jax backend
+    # already exists — a jax-free front-end must not init one)
+    "synapseml_executor_recompiles_total",
+    "synapseml_executor_compile_seconds",
+    "synapseml_executor_duty_cycle",
+    "synapseml_device_hbm_bytes_in_use",
+    "synapseml_device_hbm_peak_bytes",
+    "synapseml_device_live_buffer_count",
 ]
 
 # the breaker/failover/drain surface (docs/robustness.md, PR 8): these
@@ -152,6 +171,10 @@ def main() -> int:
     from synapseml_tpu.runtime.executor import BatchedExecutor
 
     ex = BatchedExecutor(lambda x: (x * 3.0 + 1.0,), min_bucket=8)
+    # arm the recompile sentinel: AOT-warm the 2-feature signature the
+    # normal posts below ride, so the deliberately drifted post becomes
+    # a counted post-warmup recompile on the live exposition
+    ex.warmup([((2,), np.float32)], buckets=[8])
 
     def pipeline(table):
         feats = np.stack([np.asarray(v["x"], np.float32)
@@ -201,12 +224,56 @@ def main() -> int:
         rid = post().getheader("X-Request-Id")
         for _ in range(4):
             post()
+
+        # recompile sentinel (docs/observability.md): a shape-drifted
+        # request AFTER warmup — 5 features vs the warmed 2 — must
+        # surface as executor_recompiles_total on the live exposition,
+        # under the shape_drift reason SPECIFICALLY (all four reason
+        # series pre-register at 0, so only a value delta on the
+        # labeled series proves the classification)
+        drift_series = ('synapseml_executor_recompiles_total'
+                        '{reason="shape_drift"}')
+        recompiles_before = series_total(
+            first, "synapseml_executor_recompiles_total")
+        drift_before = series_total(first, drift_series)
+        conn.request("POST", "/",
+                     json.dumps({"x": [1.0] * 5}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        drift_body = resp.read()
+        assert resp.status == 200, (resp.status, drift_body)
+
         second = scrape()
         for name in INCREASING:
             v1, v2 = series_total(first, name), series_total(second, name)
             if not v2 > v1:
                 print(f"series {name} did not increase: {v1} -> {v2}")
                 return 1
+        recompiles_after = series_total(
+            second, "synapseml_executor_recompiles_total")
+        if not recompiles_after > recompiles_before:
+            print("post-warmup shape drift did not move "
+                  f"executor_recompiles_total: {recompiles_before} -> "
+                  f"{recompiles_after}")
+            return 1
+        drift_after = series_total(second, drift_series)
+        if not drift_after > drift_before:
+            print("the drifted post was not classified shape_drift: "
+                  f"{drift_series} {drift_before} -> {drift_after}")
+            return 1
+
+        # device-memory surface (runtime/perfwatch.py): /debug/memory
+        # answers mid-run with one record per local device
+        conn.request("GET", "/debug/memory")
+        resp = conn.getresponse()
+        mem = json.loads(resp.read())
+        assert resp.status == 200, resp.status
+        if not mem.get("devices") or "totals" not in mem:
+            print(f"/debug/memory snapshot malformed: {sorted(mem)}")
+            return 1
+        if any("bytes_in_use" not in d for d in mem["devices"]):
+            print("/debug/memory device records miss bytes_in_use")
+            return 1
 
         # the span surface answers for a real completed request
         conn.request("GET", f"/span/{rid}")
@@ -223,6 +290,8 @@ def main() -> int:
               f"{len(first.splitlines())} exposition lines,",
               "requests="
               f"{series_total(second, 'synapseml_serving_requests_total'):.0f},",
+              f"recompiles={recompiles_after:.0f},",
+              f"memory devices={len(mem['devices'])},",
               f"span stages={sorted(stages)}")
     finally:
         cs.stop()

@@ -5,7 +5,8 @@ Code side: an AST pass over ``synapseml_tpu/`` collecting every string
 literal passed as the first argument to a telemetry registration call
 (``counter`` / ``gauge`` / ``gauge_fn`` / ``histogram``, bare or
 attribute-qualified) whose name carries one of the gated prefixes
-(``serving_``, ``executor_``, ``faults_``, ``blackbox_``). The
+(``serving_``, ``executor_``, ``faults_``, ``blackbox_``,
+``device_``). The
 registry qualifies names dynamically (``synapseml_`` wire prefix), so
 the literal at the call site IS the catalog name.
 
@@ -26,7 +27,7 @@ import ast
 import os
 import sys
 
-PREFIXES = ("serving_", "executor_", "faults_", "blackbox_")
+PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_")
 REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
 
 HERE = os.path.dirname(os.path.abspath(__file__))

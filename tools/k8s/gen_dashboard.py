@@ -35,7 +35,7 @@ DOC = os.path.join(ROOT, "docs", "observability.md")
 OUT = os.path.join(HERE, "chart", "dashboards",
                    "serving-dashboard.json")
 
-PREFIXES = ("serving_", "executor_", "faults_", "blackbox_")
+PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_")
 _NAME = re.compile(r"([a-z][a-z0-9_]*)(\{([a-z_=,]*)\})?")
 
 
