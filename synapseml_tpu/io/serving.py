@@ -243,6 +243,79 @@ def _debug_profile(path: str) -> Tuple[int, Dict[str, Any]]:
         _PROFILE_LOCK.release()
 
 
+_BUILD_STATIC: Optional[Dict[str, Any]] = None
+_BUILD_LOCK = threading.Lock()
+
+
+def _build_static() -> Dict[str, Any]:
+    """The immutable half of the /debug/build payload, resolved once:
+    git sha (``SYNAPSEML_GIT_SHA`` — the image build arg — else a
+    best-effort ``git rev-parse`` over the source tree), python and
+    jax/jaxlib versions via importlib.metadata (NO jax import: a
+    jax-free front-end answering /debug/build must stay jax-free)."""
+    global _BUILD_STATIC
+    with _BUILD_LOCK:
+        if _BUILD_STATIC is not None:
+            return _BUILD_STATIC
+        import platform
+        import subprocess
+
+        sha = os.environ.get("SYNAPSEML_GIT_SHA", "").strip()
+        if not sha:
+            try:
+                root = os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+                sha = subprocess.run(
+                    ["git", "rev-parse", "HEAD"], cwd=root, timeout=5,
+                    capture_output=True, text=True).stdout.strip()
+            except Exception:  # noqa: BLE001 - no git in the image
+                sha = ""
+
+        def _ver(dist: str) -> Optional[str]:
+            try:
+                from importlib import metadata
+
+                return metadata.version(dist)
+            except Exception:  # noqa: BLE001 - dist absent
+                return None
+
+        _BUILD_STATIC = {
+            "git_sha": sha or None,
+            "python": platform.python_version(),
+            "jax": _ver("jax"),
+            "jaxlib": _ver("jaxlib"),
+            "pid": os.getpid(),
+        }
+        return _BUILD_STATIC
+
+
+def _build_info(server: "WorkerServer") -> Dict[str, Any]:
+    """``GET /debug/build``: version-skew + lifecycle diagnosis for one
+    replica — what a fleet operator diffs across pods when a shared
+    cache starts reporting ``cache_skew``. Backend/device fields are
+    read ONLY when a jax backend already exists (the endpoint itself
+    never initializes one)."""
+    info = dict(_build_static())
+    backend = device_kind = None
+    if _pw._jax_initialized():
+        try:
+            import jax
+
+            backend = jax.default_backend()
+            devs = jax.local_devices()
+            device_kind = devs[0].device_kind if devs else None
+        except Exception:  # noqa: BLE001 - introspection is best-effort
+            pass
+    info.update({
+        "backend": backend or "uninitialized",
+        "device_kind": device_kind,
+        "server": server.name,
+        "ready": server.ready,
+        "draining": server.draining,
+    })
+    return info
+
+
 class _PendingReply:
     __slots__ = ("event", "response")
 
@@ -595,6 +668,17 @@ class WorkerServer:
                     self._send_plain(
                         200,
                         json.dumps(_bb.thread_stacks()).encode("utf-8"),
+                        "application/json")
+                    return
+                if self.path == "/debug/build":
+                    # fleet version-skew diagnosis: git sha + jax/
+                    # jaxlib/backend + device kind + lifecycle state,
+                    # per replica (docs/observability.md "Debug
+                    # endpoints"; behind the SYNAPSEML_DEBUG_ENDPOINTS
+                    # gate above like the whole /debug surface)
+                    self._send_plain(
+                        200,
+                        json.dumps(_build_info(outer)).encode("utf-8"),
                         "application/json")
                     return
                 if self.path == "/debug/memory":

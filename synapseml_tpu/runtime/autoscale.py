@@ -1,0 +1,452 @@
+"""Fleet autoscaling: the pure decision math behind the fleet controller.
+
+PRs 6-10 built the telemetry — ``executor_duty_cycle``,
+``serving_slo_*_burn_rate``, the recompile sentinel, ``cache_skew`` —
+and every one of those gauges was still only a dashboard. This module
+turns them into a **control signal**: given one scrape sample per
+replica, :func:`decide` answers "scale up, scale down, or hold" with
+hysteresis (consecutive-breach streaks), per-direction cooldowns,
+min/max clamps, and hard safety rails around missing telemetry. The
+controller that acts on the decision (``tools/fleet/controller.py``)
+owns the I/O — subprocesses, HTTP, signals; everything here is a pure
+function over plain data, which is what makes the policy unit-testable
+(tests/test_fleet.py) without a single process spawn.
+
+Safety rails (docs/deployment.md, "Fleet operations"):
+
+- **A scrape failure must never scale the fleet down.** An
+  unreachable or stale replica removes the *evidence*, not the
+  *capacity*; scaling down on blindness is how autoscalers cause the
+  outage they exist to prevent. ``decide`` refuses ``down`` unless a
+  fresh sample exists for EVERY live replica — and with zero fresh
+  samples it holds outright (``no_fresh_telemetry``).
+- **Scale-down waits for a fully hydrated fleet**: a replica still
+  warming (not ready) blocks ``down`` — capacity in flight counts.
+- **Hysteresis + cooldown**: one hot scrape never scales; the breach
+  must persist ``up_consecutive`` evaluations, and each direction has
+  its own cooldown so the fleet cannot flap faster than replicas
+  hydrate.
+
+Signals:
+
+- **duty cycle**: mean of each ready replica's busiest dispatch target
+  (``executor_duty_cycle{device=}``, runtime/perfwatch.py). Above
+  ``duty_high`` the chips are saturated — add capacity; below
+  ``duty_low`` the fleet idles — shed it.
+- **SLO burn rate**: max over replicas of the availability/latency
+  error-budget burn computed over the controller's OWN scrape window
+  (:func:`window_availability` + :func:`~synapseml_tpu.runtime.slo.
+  burn_rate` — windowed, not cumulative, so a recovered fleet stops
+  signalling). Burn at/above ``burn_high`` scales up even at low duty:
+  an SLO on fire is a capacity problem until proven otherwise.
+
+**Warm hydration audit** (:func:`hydration_audit`): a replica that
+booted from the shared ``ExecutableStore`` must show ZERO
+post-warmup recompiles (``executor_recompiles_total``, all reasons —
+``cache_skew`` included) and zero store-skew counts; the controller
+records every new replica's audit as ``fleet_hydrations_total
+{outcome=}`` and a ``fleet_hydration`` flight event, so "capacity
+arrives in seconds" is a measured claim, not a hope.
+
+The ``fleet_*`` metric series are registered HERE (the controller
+calls the helpers) so the doc-drift gate's AST scan over the package
+sees the literal names exactly like every other catalogued series.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from synapseml_tpu.runtime import slo as _slo
+from synapseml_tpu.runtime import telemetry as _tm
+
+__all__ = [
+    "FleetPolicy", "FleetState", "ReplicaSample", "Decision",
+    "decide", "aggregate", "parse_prometheus", "sample_from_scrape",
+    "window_availability", "hydration_audit",
+    "scale_event_counter", "hydration_counter",
+    "scrape_failure_counter", "register_fleet_gauges",
+    "register_replica_gauges", "unregister_replica_gauges",
+]
+
+
+class FleetPolicy:
+    """The knobs one fleet scales by (CLI flags / chart values map 1:1;
+    defaults are production-shaped — CI tightens them)."""
+
+    __slots__ = ("min_replicas", "max_replicas", "duty_high", "duty_low",
+                 "burn_high", "up_consecutive", "down_consecutive",
+                 "up_cooldown_s", "down_cooldown_s", "stale_after_s")
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 8,
+                 duty_high: float = 0.75, duty_low: float = 0.20,
+                 burn_high: float = 2.0, up_consecutive: int = 2,
+                 down_consecutive: int = 4, up_cooldown_s: float = 15.0,
+                 down_cooldown_s: float = 60.0,
+                 stale_after_s: float = 10.0):
+        if min_replicas < 1:
+            # the zero-floor is a policy error, not a runtime surprise:
+            # this fleet serves traffic, and 0 replicas is an outage
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if duty_low >= duty_high:
+            raise ValueError("duty_low must be < duty_high "
+                             "(the hysteresis band)")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.duty_high = float(duty_high)
+        self.duty_low = float(duty_low)
+        self.burn_high = float(burn_high)
+        self.up_consecutive = max(1, int(up_consecutive))
+        self.down_consecutive = max(1, int(down_consecutive))
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.stale_after_s = float(stale_after_s)
+
+
+class ReplicaSample:
+    """One replica's scrape, reduced to the control inputs. ``ts`` is
+    the monotonic instant the scrape *succeeded*; ``reachable=False``
+    means this poll failed (ts then carries the attempt time).
+    ``duty`` is the busiest dispatch target's duty cycle; burn values
+    are None when the window carried no signal (no new replies)."""
+
+    __slots__ = ("name", "url", "ts", "reachable", "ready", "duty",
+                 "avail_burn", "latency_burn", "recompiles",
+                 "store_skew", "replies_by_code", "store_hits")
+
+    def __init__(self, name: str, url: str = "", ts: float = 0.0,
+                 reachable: bool = False, ready: bool = False,
+                 duty: float = 0.0,
+                 avail_burn: Optional[float] = None,
+                 latency_burn: Optional[float] = None,
+                 recompiles: Optional[Dict[str, float]] = None,
+                 store_skew: float = 0.0,
+                 store_hits: float = 0.0,
+                 replies_by_code: Optional[Dict[str, float]] = None):
+        self.name = name
+        self.url = url
+        self.ts = ts
+        self.reachable = reachable
+        self.ready = ready
+        self.duty = duty
+        self.avail_burn = avail_burn
+        self.latency_burn = latency_burn
+        self.recompiles = dict(recompiles or {})
+        self.store_skew = store_skew
+        self.store_hits = store_hits
+        self.replies_by_code = dict(replies_by_code or {})
+
+    @property
+    def recompiles_total(self) -> float:
+        return sum(self.recompiles.values())
+
+    def burn_max(self) -> float:
+        return max(self.avail_burn or 0.0, self.latency_burn or 0.0)
+
+
+class FleetState:
+    """Mutable controller-side memory between evaluations: the breach
+    streaks (hysteresis) and the last scale action (cooldowns).
+    :func:`decide` updates it in place."""
+
+    __slots__ = ("up_streak", "down_streak", "last_scale_ts",
+                 "last_direction")
+
+    def __init__(self):
+        self.up_streak = 0
+        self.down_streak = 0
+        self.last_scale_ts: Optional[float] = None
+        self.last_direction = ""
+
+    def mark_scaled(self, now: float, direction: str):
+        self.last_scale_ts = now
+        self.last_direction = direction
+        self.up_streak = 0
+        self.down_streak = 0
+
+
+class Decision:
+    """One evaluation's verdict. ``direction`` is ``up`` / ``down`` /
+    ``hold``; ``reason`` names the signal (``duty_cycle`` /
+    ``burn_rate``) or the rail that blocked one (``cooldown``,
+    ``at_max``, ``stale_telemetry``, ...); ``aggregates`` is the fleet
+    view the decision was made from (served on /fleet/status)."""
+
+    __slots__ = ("direction", "target", "reason", "aggregates")
+
+    def __init__(self, direction: str, target: int, reason: str,
+                 aggregates: Dict[str, Any]):
+        self.direction = direction
+        self.target = target
+        self.reason = reason
+        self.aggregates = aggregates
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"direction": self.direction, "target": self.target,
+                "reason": self.reason, "aggregates": self.aggregates}
+
+
+def aggregate(samples: List[ReplicaSample], now: float,
+              policy: FleetPolicy) -> Dict[str, Any]:
+    """The fleet-level view one evaluation acts on: freshness split,
+    mean duty over ready+fresh replicas, max burn over fresh ones."""
+    fresh = [s for s in samples
+             if s.reachable and now - s.ts <= policy.stale_after_s]
+    ready = [s for s in fresh if s.ready]
+    duty_mean = (sum(s.duty for s in ready) / len(ready)) if ready else 0.0
+    burn_max = max([s.burn_max() for s in fresh], default=0.0)
+    return {
+        "replicas": len(samples),
+        "fresh": len(fresh),
+        "stale": len(samples) - len(fresh),
+        "ready": len(ready),
+        "duty_mean": round(duty_mean, 6),
+        "burn_max": round(burn_max, 6),
+    }
+
+
+def decide(now: float, samples: List[ReplicaSample], state: FleetState,
+           policy: FleetPolicy) -> Decision:
+    """One pure evaluation of the scaling policy over the fleet's
+    samples. Mutates ``state`` (streaks, never the cooldown stamp —
+    the controller calls ``state.mark_scaled`` only once it actually
+    acted, so a failed spawn does not eat the cooldown)."""
+    n = len(samples)
+    agg = aggregate(samples, now, policy)
+
+    if agg["fresh"] == 0:
+        # total blindness: hold, whatever the streaks said before. A
+        # fleet the controller cannot see has UNKNOWN load — scaling
+        # it (to zero, especially) on no evidence is the one move the
+        # rails exist to forbid.
+        state.up_streak = 0
+        state.down_streak = 0
+        return Decision("hold", n, "no_fresh_telemetry", agg)
+
+    duty = agg["duty_mean"]
+    burn = agg["burn_max"]
+    up_reason = ""
+    if burn >= policy.burn_high:
+        up_reason = "burn_rate"
+    elif agg["ready"] > 0 and duty >= policy.duty_high:
+        up_reason = "duty_cycle"
+    down_ok = (agg["ready"] > 0 and duty <= policy.duty_low
+               and burn < policy.burn_high)
+
+    if up_reason:
+        state.up_streak += 1
+        state.down_streak = 0
+    elif down_ok:
+        state.down_streak += 1
+        state.up_streak = 0
+    else:
+        state.up_streak = 0
+        state.down_streak = 0
+
+    def _cooled(window: float) -> bool:
+        return (state.last_scale_ts is None
+                or now - state.last_scale_ts >= window)
+
+    if state.up_streak >= policy.up_consecutive:
+        if n >= policy.max_replicas:
+            return Decision("hold", n, "at_max", agg)
+        if not _cooled(policy.up_cooldown_s):
+            return Decision("hold", n, "cooldown", agg)
+        return Decision("up", min(n + 1, policy.max_replicas),
+                        up_reason, agg)
+
+    if state.down_streak >= policy.down_consecutive:
+        if n <= policy.min_replicas:
+            return Decision("hold", n, "at_min", agg)
+        if agg["stale"] > 0:
+            # capacity without evidence: a replica that stopped
+            # answering scrapes may still be serving — down requires a
+            # fresh sample for EVERY live replica
+            return Decision("hold", n, "stale_telemetry", agg)
+        if agg["ready"] < agg["fresh"]:
+            return Decision("hold", n, "replicas_warming", agg)
+        if not _cooled(policy.down_cooldown_s):
+            return Decision("hold", n, "cooldown", agg)
+        return Decision("down", max(n - 1, policy.min_replicas),
+                        "duty_cycle", agg)
+
+    return Decision("hold", n, "steady", agg)
+
+
+# -- scrape parsing ---------------------------------------------------------
+
+_LINE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Dict[str,
+                                        List[Tuple[Dict[str, str],
+                                                   float]]]:
+    """Prometheus text exposition -> ``{name: [(labels, value), ...]}``.
+    Tolerant: comment/TYPE lines and malformed samples are skipped —
+    the controller must keep flying on a partially garbled scrape."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE_RE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group(4))
+        except ValueError:
+            continue
+        labels = {k: v for k, v in _LABEL_RE.findall(m.group(3) or "")}
+        out.setdefault(m.group(1), []).append((labels, value))
+    return out
+
+
+def _series_sum(metrics: Mapping[str, List[Tuple[Dict[str, str], float]]],
+                name: str) -> float:
+    return sum(v for _l, v in metrics.get(name, ()))
+
+
+def sample_from_scrape(name: str, url: str, now: float,
+                       metrics_text: Optional[str],
+                       ready: bool) -> ReplicaSample:
+    """Reduce one replica's ``/metrics`` text (None = unreachable) to a
+    :class:`ReplicaSample`. Burn values are left None — the controller
+    fills them from its own scrape-window reply deltas
+    (:func:`window_availability`), not the replica's cumulative
+    gauges, so the signal decays when the fleet recovers."""
+    if metrics_text is None:
+        return ReplicaSample(name, url, ts=now, reachable=False)
+    metrics = parse_prometheus(metrics_text)
+    duty = max([v for _l, v in
+                metrics.get("synapseml_executor_duty_cycle", ())],
+               default=0.0)
+    recompiles = {
+        labels.get("reason", ""): v for labels, v in
+        metrics.get("synapseml_executor_recompiles_total", ())
+        if v > 0}
+    replies = {}
+    for labels, v in metrics.get("synapseml_serving_replies_total", ()):
+        code = labels.get("code", "")
+        replies[code] = replies.get(code, 0.0) + v
+    return ReplicaSample(
+        name, url, ts=now, reachable=True, ready=ready, duty=duty,
+        recompiles=recompiles,
+        store_skew=_series_sum(
+            metrics, "synapseml_compile_cache_store_skew_total"),
+        store_hits=_series_sum(
+            metrics, "synapseml_compile_cache_store_hits_total"),
+        replies_by_code=replies)
+
+
+def window_availability(prev_replies: Mapping[str, float],
+                        cur_replies: Mapping[str, float]
+                        ) -> Optional[float]:
+    """Availability over ONE controller scrape window: the per-code
+    reply deltas between two cumulative snapshots, run through the
+    standard availability policy (non-5xx = good). None when the
+    window carried no replies — idle is *no signal*, not 100% good
+    (and not an outage either)."""
+    deltas = {code: max(0.0, cur - prev_replies.get(code, 0.0))
+              for code, cur in cur_replies.items()}
+    if sum(deltas.values()) <= 0:
+        return None
+    return _slo.availability(deltas)
+
+
+def hydration_audit(sample: ReplicaSample) -> Dict[str, Any]:
+    """The warm-boot verdict for a freshly ready replica: clean means
+    the recompile sentinel never fired post-warmup (``cache_skew``
+    reason included — the shared-volume poison case) and the
+    ExecutableStore reported zero skew. ``store_hits`` > 0 is the
+    positive proof capacity came FROM the shared store rather than a
+    fresh compile."""
+    clean = (sample.recompiles_total == 0 and sample.store_skew == 0)
+    return {
+        "replica": sample.name,
+        "clean": clean,
+        "recompiles": dict(sample.recompiles),
+        "store_skew": sample.store_skew,
+        "store_hits": sample.store_hits,
+        "outcome": "warm" if clean and sample.store_hits > 0
+        else ("clean_cold" if clean else "dirty"),
+    }
+
+
+# -- fleet telemetry registration -------------------------------------------
+# The literal series names live here (inside the package) so the
+# doc-drift gate's AST scan ties them to docs/observability.md rows;
+# the controller resolves handles through these helpers.
+
+def scale_event_counter(direction: str, reason: str) -> "_tm.Counter":
+    """``fleet_scale_events_total{direction=,reason=}`` — one count per
+    scaling ACTION the controller actually took (spawn/terminate),
+    never per evaluation."""
+    return _tm.counter("fleet_scale_events_total", direction=direction,
+                       reason=reason)
+
+
+def hydration_counter(outcome: str) -> "_tm.Counter":
+    """``fleet_hydrations_total{outcome=}`` — warm-boot audits of
+    newly ready replicas: ``warm`` (zero recompiles, served from the
+    shared store), ``clean_cold`` (zero recompiles, fresh compiles —
+    the seed replica), ``dirty`` (the sentinel fired)."""
+    return _tm.counter("fleet_hydrations_total", outcome=outcome)
+
+
+def scrape_failure_counter() -> "_tm.Counter":
+    """``fleet_scrape_failures_total`` — replica polls that returned
+    no usable /metrics (the blindness the down-rail guards against)."""
+    return _tm.counter("fleet_scrape_failures_total")
+
+
+_REPLICA_STATES = ("ready", "warming", "unreachable")
+
+
+def register_fleet_gauges(counts_fn: Callable[[], Dict[str, int]],
+                          aggregates_fn: Callable[[], Dict[str, Any]]):
+    """Register the fleet-level scrape-time gauges:
+    ``fleet_replicas{state=}`` off ``counts_fn`` (state -> count) and
+    the aggregate signal gauges off ``aggregates_fn`` (the dict
+    :func:`aggregate` builds)."""
+    for st in _REPLICA_STATES:
+        _tm.gauge_fn("fleet_replicas",
+                     lambda s=st: float(counts_fn().get(s, 0)),
+                     state=st)
+    _tm.gauge_fn("fleet_duty_cycle_mean",
+                 lambda: float(aggregates_fn().get("duty_mean", 0.0)))
+    _tm.gauge_fn("fleet_burn_rate_max",
+                 lambda: float(aggregates_fn().get("burn_max", 0.0)))
+
+
+def register_replica_gauges(name: str,
+                            sample_fn: Callable[[], ReplicaSample]):
+    """Per-replica series under the controller's own registry, so
+    ``/fleet/metrics`` carries the fleet AND each member:
+    ``fleet_replica_duty_cycle{replica=}``,
+    ``fleet_replica_burn_rate{replica=}``,
+    ``fleet_replica_up{replica=}`` (1 = last scrape succeeded)."""
+    _tm.gauge_fn("fleet_replica_duty_cycle",
+                 lambda: float(sample_fn().duty), replica=name)
+    _tm.gauge_fn("fleet_replica_burn_rate",
+                 lambda: float(sample_fn().burn_max()), replica=name)
+    _tm.gauge_fn("fleet_replica_up",
+                 lambda: 1.0 if sample_fn().reachable else 0.0,
+                 replica=name)
+
+
+def unregister_replica_gauges(name: str):
+    """Drop a reaped/terminated replica's series — a scrape must never
+    keep reading a ghost."""
+    for series in ("fleet_replica_duty_cycle", "fleet_replica_burn_rate",
+                   "fleet_replica_up"):
+        _tm.unregister(series, replica=name)
+
+
+def now_monotonic() -> float:
+    """Injection seam for tests (decide() itself never reads clocks)."""
+    return time.monotonic()

@@ -57,9 +57,10 @@ from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import telemetry as _tm
 
 __all__ = [
-    "ensure_registered", "register_duty_gauge", "device_memory",
+    "ensure_registered", "ensure_process_registered",
+    "register_duty_gauge", "device_memory",
     "memory_snapshot", "duty_cycles", "check_high_water",
-    "high_water_fraction", "set_high_water_fraction",
+    "high_water_fraction", "set_high_water_fraction", "process_stats",
 ]
 
 _LOCK = threading.Lock()
@@ -74,6 +75,12 @@ _MEM_TTL_S = 0.5
 class _State:
     def __init__(self):
         self.registered = False
+        self.process_registered = False
+        # TTL memo for process_stats(): the four process_* gauges all
+        # read inside one scrape, and the fd-directory listing is
+        # O(open fds) — one /proc walk serves them all
+        self.proc_cache: Optional[Dict[str, float]] = None
+        self.proc_cache_ts = 0.0
         # process-lifetime high-water per device key (bytes): the max of
         # every sampled bytes_in_use and the backend's own peak counter
         self.peak: Dict[str, int] = {}
@@ -293,6 +300,73 @@ def memory_snapshot(force: bool = True) -> Dict[str, Any]:
     }
 
 
+def process_stats() -> Dict[str, float]:
+    """One process self-telemetry sample: RSS bytes, open fd count,
+    live thread count, uptime seconds. Linux-first (/proc), degrading
+    per field to 0 where the surface is missing — a gauge reading 0 on
+    an exotic platform beats an exception in a scrape. TTL-memoized
+    (same pattern as the device-memory cache) so the four gauges of
+    one scrape share a single /proc walk."""
+    import threading as _threading
+
+    now = time.monotonic()
+    with _LOCK:
+        if (_S.proc_cache is not None
+                and now - _S.proc_cache_ts < _MEM_TTL_S):
+            return _S.proc_cache
+
+    rss = 0.0
+    try:
+        with open("/proc/self/statm", "rb") as fh:
+            # field 2 = resident pages
+            rss = float(int(fh.read().split()[1])) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 - no /proc: try rusage
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux (a peak, not current — still
+            # the honest fallback where /proc is absent)
+            rss = float(resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+        except Exception:  # noqa: BLE001
+            rss = 0.0
+    try:
+        fds = float(len(os.listdir("/proc/self/fd")))
+    except Exception:  # noqa: BLE001
+        fds = 0.0
+    stats = {
+        "rss_bytes": rss,
+        "open_fds": fds,
+        "thread_count": float(_threading.active_count()),
+        "uptime_seconds": time.monotonic() - _T0,
+    }
+    with _LOCK:
+        _S.proc_cache = stats
+        _S.proc_cache_ts = now
+    return stats
+
+
+def ensure_process_registered() -> bool:
+    """Register the ``process_*`` self-telemetry gauges once per
+    process — scrape-time samplers over :func:`process_stats`, no jax
+    required (the fleet controller and jax-free serving front-ends
+    register these too; the replica-leak alerts and the fleet
+    controller's own /fleet/metrics read them). Idempotent."""
+    with _LOCK:
+        if _S.process_registered:
+            return True
+        _S.process_registered = True
+    _tm.gauge_fn("process_rss_bytes",
+                 lambda: process_stats()["rss_bytes"])
+    _tm.gauge_fn("process_open_fds",
+                 lambda: process_stats()["open_fds"])
+    _tm.gauge_fn("process_thread_count",
+                 lambda: process_stats()["thread_count"])
+    _tm.gauge_fn("process_uptime_seconds",
+                 lambda: process_stats()["uptime_seconds"])
+    return True
+
+
 def _jax_initialized() -> bool:
     """Whether a jax backend already exists WITHOUT creating one —
     best-effort over a private surface; False when undetectable."""
@@ -319,7 +393,12 @@ def ensure_registered(lazy: bool = False) -> bool:
     by binding a port — registration then happens when the first
     executor appears. (``/debug/memory`` still samples on demand: an
     operator explicitly asking pays the init.) Idempotent and cheap
-    after the first call; returns True once registered."""
+    after the first call; returns True once registered.
+
+    The ``process_*`` self-telemetry gauges register unconditionally —
+    they read /proc, not jax, so even a jax-free front-end (and the
+    fleet controller watching it) gets RSS/fd/thread/uptime series."""
+    ensure_process_registered()
     if _S.registered:
         return True
     if lazy and not _jax_initialized():

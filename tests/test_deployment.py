@@ -26,8 +26,8 @@ def test_chart_renders_without_placeholders(tmp_path):
     # every template must render (a template missing its values keys
     # raises in render.py, failing the subprocess above)
     names = sorted(os.listdir(out))
-    assert names == ["alerts.yaml", "cache-pvc.yaml", "serving.yaml",
-                     "train-job.yaml"]
+    assert names == ["alerts.yaml", "cache-pvc.yaml", "hpa.yaml",
+                     "serving.yaml", "train-job.yaml"]
     for n in names:
         text = open(os.path.join(out, n)).read()
         assert "{{" not in text
@@ -39,12 +39,17 @@ def test_chart_renders_with_overridden_values(tmp_path):
     vals = tmp_path / "values.yaml"
     base = open(os.path.join(ROOT, "tools", "k8s", "chart",
                              "values.yaml")).read()
-    vals.write_text(base.replace("replicas: 2", "replicas: 7"))
+    vals.write_text(base.replace("max: 8", "max: 7"))
     out = str(tmp_path / "r2")
     subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "k8s", "render.py"),
          "--values", str(vals), "--out", out], check=True)
-    assert "replicas: 7" in open(os.path.join(out, "serving.yaml")).read()
+    assert "maxReplicas: 7" in open(os.path.join(out, "hpa.yaml")).read()
+    # the Deployment must NOT pin spec.replicas — the HPA owns the
+    # count, and a pinned value would be reasserted on every apply
+    assert "replicas:" not in "".join(
+        ln for ln in open(os.path.join(out, "serving.yaml"))
+        if not ln.lstrip().startswith("#"))
 
 
 def test_ci_pipeline_lists_all_e2e_scripts():

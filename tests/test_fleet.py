@@ -1,0 +1,543 @@
+"""Fleet autoscaling (runtime/autoscale.py + tools/fleet/controller.py,
+docs/deployment.md "Fleet operations") and its satellites: the pure
+decision math (hysteresis, cooldowns, clamps, the never-scale-on-
+blindness rails), scrape parsing, the warm-boot hydration audit, the
+controller loop against a fake backend AND a real echo subprocess,
+/debug/build, the process self-telemetry gauges, the bench-history
+rotation cap, and loadgen's multi-target LB stand-in mode.
+
+Discipline matches tests/test_blackbox.py: every blocking wait rides a
+HARD timeout so a regression fails fast instead of wedging the suite
+(this file runs inside tools/ci/smoke_pipeline.sh's wall clock).
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.io.serving import (ContinuousServer, WorkerServer,
+                                      make_reply)
+from synapseml_tpu.runtime import autoscale as aut
+from synapseml_tpu.runtime import blackbox as bb
+from synapseml_tpu.runtime import perfwatch as pw
+from synapseml_tpu.runtime import telemetry as tm
+
+HARD = 30.0  # hard wall for any blocking wait: hang -> fast red X
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _policy(**kw):
+    base = dict(min_replicas=1, max_replicas=4, duty_high=0.7,
+                duty_low=0.2, burn_high=2.0, up_consecutive=2,
+                down_consecutive=2, up_cooldown_s=0.0,
+                down_cooldown_s=0.0, stale_after_s=10.0)
+    base.update(kw)
+    return aut.FleetPolicy(**base)
+
+
+def _sample(name="r1", *, ts=100.0, reachable=True, ready=True,
+            duty=0.0, avail_burn=None, **kw):
+    return aut.ReplicaSample(name, ts=ts, reachable=reachable,
+                             ready=ready, duty=duty,
+                             avail_burn=avail_burn, **kw)
+
+
+def _decide_n(policy, state, samples, now=100.0, n=1):
+    last = None
+    for _ in range(n):
+        last = aut.decide(now, samples, state, policy)
+    return last
+
+
+# -- decision math ----------------------------------------------------------
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        aut.FleetPolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        aut.FleetPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        aut.FleetPolicy(duty_high=0.2, duty_low=0.5)
+
+
+def test_scale_up_needs_consecutive_breaches():
+    policy = _policy(up_consecutive=3)
+    state = aut.FleetState()
+    hot = [_sample(duty=0.9)]
+    assert aut.decide(100.0, hot, state, policy).direction == "hold"
+    assert aut.decide(100.5, hot, state, policy).direction == "hold"
+    d = aut.decide(101.0, hot, state, policy)
+    assert (d.direction, d.reason, d.target) == ("up", "duty_cycle", 2)
+
+
+def test_scale_up_on_burn_rate_even_at_low_duty():
+    policy = _policy()
+    state = aut.FleetState()
+    burning = [_sample(duty=0.05, avail_burn=5.0)]
+    d = _decide_n(policy, state, burning, n=2)
+    assert (d.direction, d.reason) == ("up", "burn_rate")
+
+
+def test_up_cooldown_blocks_flapping():
+    policy = _policy(up_cooldown_s=30.0)
+    state = aut.FleetState()
+    state.mark_scaled(95.0, "up")  # scaled 5s ago, 30s cooldown
+    d = _decide_n(policy, state, [_sample(duty=0.9)], n=2)
+    assert (d.direction, d.reason) == ("hold", "cooldown")
+    # once the window passes, the (still breaching) streak scales
+    d = aut.decide(130.0, [_sample(ts=130.0, duty=0.9)], state, policy)
+    assert d.direction == "up"
+
+
+def test_up_clamped_at_max():
+    policy = _policy(max_replicas=2)
+    state = aut.FleetState()
+    fleet = [_sample("r1", duty=0.9), _sample("r2", duty=0.9)]
+    d = _decide_n(policy, state, fleet, n=2)
+    assert (d.direction, d.reason) == ("hold", "at_max")
+
+
+def test_scale_down_after_streak():
+    policy = _policy(down_consecutive=3)
+    state = aut.FleetState()
+    idle = [_sample("r1", duty=0.01), _sample("r2", duty=0.01)]
+    assert _decide_n(policy, state, idle, n=2).direction == "hold"
+    d = aut.decide(100.0, idle, state, policy)
+    assert (d.direction, d.target, d.reason) == ("down", 1,
+                                                 "duty_cycle")
+
+
+def test_down_clamped_at_min():
+    policy = _policy(min_replicas=2)
+    state = aut.FleetState()
+    idle = [_sample("r1", duty=0.0), _sample("r2", duty=0.0)]
+    d = _decide_n(policy, state, idle, n=3)
+    assert (d.direction, d.reason) == ("hold", "at_min")
+
+
+def test_scrape_failure_never_scales_down():
+    """THE safety rail: an unreachable replica removes evidence, not
+    capacity — down is forbidden while any live replica lacks a fresh
+    sample, and total blindness holds outright."""
+    policy = _policy()
+    state = aut.FleetState()
+    mixed = [_sample("r1", duty=0.0),
+             _sample("r2", reachable=False)]
+    d = _decide_n(policy, state, mixed, n=4)
+    assert (d.direction, d.reason) == ("hold", "stale_telemetry")
+    # every scrape failing: hold with streaks reset, never scale-to-min
+    blind = [_sample("r1", reachable=False),
+             _sample("r2", reachable=False)]
+    d = _decide_n(policy, state, blind, n=6)
+    assert (d.direction, d.reason) == ("hold", "no_fresh_telemetry")
+    assert state.down_streak == 0 and state.up_streak == 0
+
+
+def test_stale_sample_counts_as_unreachable():
+    policy = _policy(stale_after_s=5.0)
+    state = aut.FleetState()
+    # r2 answered long ago: fresh at t=100 it is not
+    mixed = [_sample("r1", ts=100.0, duty=0.0),
+             _sample("r2", ts=80.0, duty=0.0)]
+    d = _decide_n(policy, state, mixed, now=100.0, n=4)
+    assert (d.direction, d.reason) == ("hold", "stale_telemetry")
+    assert d.aggregates["stale"] == 1
+
+
+def test_down_blocked_while_replica_warming():
+    policy = _policy()
+    state = aut.FleetState()
+    fleet = [_sample("r1", duty=0.0),
+             _sample("r2", ready=False)]  # hydrating: capacity in flight
+    d = _decide_n(policy, state, fleet, n=4)
+    assert (d.direction, d.reason) == ("hold", "replicas_warming")
+
+
+def test_streaks_reset_on_opposite_signal():
+    policy = _policy(up_consecutive=2, down_consecutive=2)
+    state = aut.FleetState()
+    aut.decide(100.0, [_sample(duty=0.9)], state, policy)
+    assert state.up_streak == 1
+    aut.decide(100.5, [_sample(duty=0.5)], state, policy)  # mid-band
+    assert state.up_streak == 0 and state.down_streak == 0
+    aut.decide(101.0, [_sample(duty=0.01)], state, policy)
+    assert state.down_streak == 1
+    aut.decide(101.5, [_sample(duty=0.9)], state, policy)
+    assert state.down_streak == 0 and state.up_streak == 1
+
+
+# -- scrape parsing + windows -----------------------------------------------
+
+METRICS_TEXT = """# TYPE synapseml_executor_duty_cycle gauge
+synapseml_executor_duty_cycle{device="0"} 0.25
+synapseml_executor_duty_cycle{device="dp8"} 0.65
+synapseml_executor_recompiles_total{reason="shape_drift"} 2
+synapseml_executor_recompiles_total{reason="cache_skew"} 0
+synapseml_serving_replies_total{code="200",server="a"} 10
+synapseml_serving_replies_total{code="200",server="b"} 5
+synapseml_serving_replies_total{code="503",server="a"} 1
+synapseml_compile_cache_store_hits_total 7
+synapseml_compile_cache_store_skew_total 0
+garbage line that must not parse
+"""
+
+
+def test_parse_prometheus():
+    m = aut.parse_prometheus(METRICS_TEXT)
+    assert m["synapseml_executor_duty_cycle"] == [
+        ({"device": "0"}, 0.25), ({"device": "dp8"}, 0.65)]
+    assert ({"code": "200", "server": "a"}, 10.0) in \
+        m["synapseml_serving_replies_total"]
+    assert "garbage" not in " ".join(m)
+
+
+def test_sample_from_scrape():
+    s = aut.sample_from_scrape("r1", "http://x/", 50.0, METRICS_TEXT,
+                               ready=True)
+    assert s.reachable and s.ready and s.ts == 50.0
+    assert s.duty == 0.65  # busiest dispatch target
+    assert s.recompiles == {"shape_drift": 2.0}  # zero series dropped
+    assert s.recompiles_total == 2.0
+    assert s.replies_by_code == {"200": 15.0, "503": 1.0}
+    assert s.store_hits == 7.0 and s.store_skew == 0.0
+
+
+def test_sample_unreachable_scrape():
+    s = aut.sample_from_scrape("r1", "http://x/", 50.0, None,
+                               ready=False)
+    assert not s.reachable and s.duty == 0.0
+    assert aut.aggregate([s], 50.0, _policy())["fresh"] == 0
+
+
+def test_window_availability():
+    prev = {"200": 100.0, "503": 2.0}
+    assert aut.window_availability(prev, prev) is None  # idle window
+    cur = {"200": 190.0, "503": 2.0, "500": 10.0}
+    # window: 90 good, 10 bad
+    assert aut.window_availability(prev, cur) == pytest.approx(0.9)
+
+
+def test_hydration_audit_outcomes():
+    """The warm-boot no-recompile assertion, unit-level: zero sentinel
+    counts + zero store skew + store hits = warm; any post-warmup
+    recompile (cache_skew included) = dirty."""
+    warm = aut.hydration_audit(_sample(store_hits=5.0))
+    assert warm["outcome"] == "warm" and warm["clean"]
+    seed = aut.hydration_audit(_sample(store_hits=0.0))
+    assert seed["outcome"] == "clean_cold" and seed["clean"]
+    dirty = aut.hydration_audit(
+        _sample(recompiles={"cache_skew": 1.0}, store_hits=5.0))
+    assert dirty["outcome"] == "dirty" and not dirty["clean"]
+    skewed = aut.hydration_audit(_sample(store_skew=2.0,
+                                         store_hits=5.0))
+    assert skewed["outcome"] == "dirty"
+
+
+def test_fleet_series_register_and_unregister():
+    c0 = aut.scale_event_counter("up", "unit_test").value
+    aut.scale_event_counter("up", "unit_test").inc()
+    assert aut.scale_event_counter("up", "unit_test").value == c0 + 1
+    box = {"s": _sample("ghost", duty=0.5)}
+    aut.register_replica_gauges("ghost", lambda: box["s"])
+    assert ('synapseml_fleet_replica_duty_cycle{replica="ghost"} 0.5'
+            in tm.prometheus_text())
+    aut.unregister_replica_gauges("ghost")
+    assert 'replica="ghost"' not in tm.prometheus_text()
+
+
+# -- controller loop (fake backend: pure loop logic) ------------------------
+
+class FakeReplica:
+    def __init__(self, name):
+        self.name = name
+        self.url = f"http://fake/{name}"
+        self.dead = False
+
+    def alive(self):
+        return not self.dead
+
+
+class FakeBackend:
+    def __init__(self):
+        self.seq = 0
+        self.spawned = []
+        self.terminated = []
+
+    def spawn(self, name=None):
+        self.seq += 1
+        r = FakeReplica(name or f"fake{self.seq}")
+        self.spawned.append(r)
+        return r
+
+    def terminate(self, replica, timeout_s=30.0):
+        self.terminated.append(replica.name)
+        return {"replica": replica.name, "exit_code": 0,
+                "admitted": 3, "replied": 3, "zero_dropped": True}
+
+
+def _fake_controller(duty_box, policy=None, **kw):
+    from tools.fleet.controller import FleetController
+
+    backend = FakeBackend()
+    c = FleetController(
+        backend, policy or _policy(),
+        scrape_fn=lambda replica: (
+            f'synapseml_executor_duty_cycle{{device="0"}} '
+            f'{duty_box["duty"]}\n', True),
+        **kw)
+    return backend, c
+
+
+def _wait(cond, timeout=HARD):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_controller_scales_up_then_down():
+    duty = {"duty": 0.9}
+    backend, c = _fake_controller(duty)
+    c._spawn("initial")
+    assert [r.name for r in c.replicas] == ["fake1"]
+    assert c.tick().direction == "hold"  # streak 1 of 2
+    d = c.tick()
+    assert d.direction == "up" and len(c.replicas) == 2
+    # scale events recorded in ring + counters
+    evs = [e for e in bb.snapshot(stacks=False)["events"]
+           if e["event"] == "fleet_scale"]
+    assert any(e.get("direction") == "up"
+               and e.get("reason") == "duty_cycle" for e in evs)
+    duty["duty"] = 0.01
+    c.tick()
+    assert c.tick().direction == "down"
+    assert _wait(lambda: backend.terminated == ["fake2"])  # LIFO victim
+    assert len(c.replicas) == 1
+    assert _wait(lambda: any(t.get("zero_dropped")
+                             for t in c._terminations))
+
+
+def test_controller_min_floor_replaces_dead_replica():
+    duty = {"duty": 0.5}  # mid-band: no policy scaling in play
+    backend, c = _fake_controller(duty)
+    c._spawn("initial")
+    c.replicas[0].dead = True  # SIGKILL chaos, OOM, crash
+    c.tick()
+    names = [r.name for r in c.replicas]
+    assert names == ["fake2"]  # corpse reaped, floor restored
+    died = [e for e in bb.snapshot(stacks=False)["events"]
+            if e["event"] == "fleet_replica_died"]
+    assert died and died[-1]["replica"] == "fake1"
+
+
+def test_controller_status_and_metrics_http():
+    duty = {"duty": 0.4}
+    backend, c = _fake_controller(duty)
+    c._spawn("initial")
+    base = c.serve(port=0)
+    try:
+        c.tick()
+        with urllib.request.urlopen(base + "/fleet/status",
+                                    timeout=HARD) as r:
+            status = json.loads(r.read())
+        assert [x["state"] for x in status["replicas"]] == ["ready"]
+        assert status["replicas"][0]["duty"] == 0.4
+        assert status["aggregates"]["fresh"] == 1
+        assert status["decisions"][-1]["direction"] == "hold"
+        with urllib.request.urlopen(base + "/fleet/metrics",
+                                    timeout=HARD) as r:
+            text = r.read().decode()
+        assert 'synapseml_fleet_replicas{state="ready"} 1' in text
+        assert "synapseml_process_rss_bytes" in text
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=HARD) as r:
+            assert r.status == 200  # scrape-compatible alias
+    finally:
+        c._httpd.shutdown()
+        c._httpd.server_close()
+
+
+def test_local_backend_echo_replica_round_trip():
+    """A REAL serving subprocess: spawn (echo pipeline — no model, no
+    jax warmup), score one request through it, then SIGTERM and read
+    the zero-drop exit accounting back."""
+    from tools.fleet.controller import LocalProcessBackend
+
+    backend = LocalProcessBackend(announce_timeout_s=120.0)
+    replica = backend.spawn("fleet_echo_test")
+    try:
+        req = urllib.request.Request(
+            replica.url, data=json.dumps({"ping": 1}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=HARD) as r:
+            assert r.status == 200 and json.loads(r.read()) == {"ping": 1}
+    finally:
+        verdict = backend.terminate(replica, timeout_s=HARD)
+    assert verdict["exit_code"] == 0
+    assert verdict["admitted"] >= 1
+    assert verdict["zero_dropped"], verdict
+
+
+# -- satellites -------------------------------------------------------------
+
+def test_debug_build_endpoint():
+    srv = WorkerServer("buildinfo_test")
+    try:
+        with urllib.request.urlopen(
+                srv.url.rstrip("/") + "/debug/build",
+                timeout=HARD) as r:
+            info = json.loads(r.read())
+        assert info["server"] == "buildinfo_test"
+        assert info["ready"] is True and info["draining"] is False
+        assert info["python"] and info["pid"] == os.getpid()
+        # jax/jaxlib versions come from dist metadata, never an import
+        assert "jax" in info and "backend" in info
+        srv.set_ready(False)
+        with urllib.request.urlopen(
+                srv.url.rstrip("/") + "/debug/build",
+                timeout=HARD) as r:
+            assert json.loads(r.read())["ready"] is False
+    finally:
+        srv.stop()
+
+
+def test_debug_build_behind_debug_gate(monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_DEBUG_ENDPOINTS", "0")
+    srv = WorkerServer("buildinfo_gated")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                srv.url.rstrip("/") + "/debug/build", timeout=HARD)
+        assert ei.value.code == 403
+    finally:
+        srv.stop()
+
+
+def test_process_self_telemetry_gauges():
+    assert pw.ensure_process_registered()
+    stats = pw.process_stats()
+    assert stats["rss_bytes"] > 0
+    assert stats["open_fds"] > 0
+    assert stats["thread_count"] >= 1
+    assert stats["uptime_seconds"] > 0
+    text = tm.prometheus_text()
+    for series in ("synapseml_process_rss_bytes",
+                   "synapseml_process_open_fds",
+                   "synapseml_process_thread_count",
+                   "synapseml_process_uptime_seconds"):
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith(series + " "))
+        assert float(line.split()[1]) > 0
+
+
+def test_bench_history_rotation_cap(tmp_path):
+    from tools.ci.bench_check import append_history, load_history
+
+    path = str(tmp_path / "hist.jsonl")
+    for i in range(10):
+        append_history(path, [{"metric": "m", "value": float(i),
+                               "unit": "ms"}], max_lines=4)
+    lines = open(path).read().splitlines()
+    assert len(lines) == 4  # capped at the newest K
+    runs = load_history(path, 99)
+    assert [r["value"] for r in runs] == [6.0, 7.0, 8.0, 9.0]
+    # torn tail (killed writer): rotation neither crashes nor keeps it
+    with open(path, "a") as fh:
+        fh.write('{"ts": 1, "run": {"metric": "torn"')
+    append_history(path, [{"metric": "m", "value": 10.0,
+                           "unit": "ms"}], max_lines=4)
+    assert len(open(path).read().splitlines()) == 4
+    assert load_history(path, 99)[-1]["value"] == 10.0
+    # max_lines=0 disables rotation
+    for i in range(8):
+        append_history(path, [{"metric": "m", "value": 0.0,
+                               "unit": "ms"}], max_lines=0)
+    assert len(open(path).read().splitlines()) == 12
+
+
+def _echo_pipeline(table):
+    replies = np.empty(table.num_rows, dtype=object)
+    for i, v in enumerate(table["value"]):
+        replies[i] = make_reply(v)
+    return table.with_column("reply", replies)
+
+
+def test_loadgen_multi_target_round_robin():
+    from tools.loadgen import run_load
+
+    a = ContinuousServer("fleet_lg_a", _echo_pipeline,
+                         max_batch=16).start()
+    b = ContinuousServer("fleet_lg_b", _echo_pipeline,
+                         max_batch=16).start()
+    try:
+        s = run_load(None, rps=150, duration_s=0.6, shapes=[2],
+                     seed=3, timeout=HARD, targets=[a.url, b.url])
+        assert s["hung"] == 0
+        assert s["by_status"].get("200", 0) == s["scheduled"]
+        assert set(s["per_target"]) == {a.url, b.url}
+        hits = [t["by_status"].get("200", 0)
+                for t in s["per_target"].values()]
+        assert all(h > 0 for h in hits)  # both endpoints carried load
+        assert sum(hits) == s["scheduled"]
+        assert s["failover_retries"] == 0
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_loadgen_multi_target_failover_on_dead_target():
+    """The LB stand-in behavior the fleet chaos phase leans on: a
+    socket-dead target's requests retry once on the next target, so a
+    killed replica costs retries, not availability."""
+    from tools.loadgen import evaluate_slo, run_load
+
+    a = ContinuousServer("fleet_lg_c", _echo_pipeline,
+                         max_batch=16).start()
+    dead = "http://127.0.0.1:1/"  # connection refused, instantly
+    try:
+        s = run_load(None, rps=120, duration_s=0.6, shapes=[2],
+                     seed=4, timeout=HARD, targets=[a.url, dead])
+        assert s["hung"] == 0
+        assert s["by_status"].get("200", 0) == s["scheduled"]
+        assert s["failover_retries"] > 0
+        assert s["per_target"][dead]["by_status"].get("error", 0) > 0
+        slo = evaluate_slo(s, slo_availability=0.99)
+        assert slo["pass"], slo
+    finally:
+        a.stop()
+
+
+def test_loadgen_cli_targets_and_payload_key(tmp_path):
+    import subprocess
+    import sys
+
+    a = ContinuousServer("fleet_lg_cli", _echo_pipeline,
+                         max_batch=16).start()
+    out = str(tmp_path / "lg.json")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "loadgen.py"),
+             "--targets", f"{a.url},{a.url}", "--payload-key",
+             "features", "--rps", "60", "--duration", "0.4",
+             "--seed", "6", "--timeout", "20", "--out", out,
+             "--slo-availability", "0.99"],
+            capture_output=True, text=True, timeout=HARD * 4,
+            cwd=ROOT)
+        assert r.returncode == 0, r.stdout + r.stderr
+        summary = json.load(open(out))
+        assert summary["per_target"]
+        assert summary["slo"]["pass"]
+    finally:
+        a.stop()
+    # neither --url nor --targets is a usage error
+    from tools.loadgen import main as lg_main
+
+    with pytest.raises(SystemExit) as ei:
+        lg_main(["--rps", "1", "--duration", "0.1"])
+    assert ei.value.code == 2

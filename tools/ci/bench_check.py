@@ -149,15 +149,53 @@ def evaluate(runs: List[Dict[str, Any]],
     return rows, regressions
 
 
-def append_history(path: str, runs: List[Dict[str, Any]]) -> None:
+DEFAULT_HISTORY_MAX = 500
+
+
+def append_history(path: str, runs: List[Dict[str, Any]],
+                   max_lines: int = DEFAULT_HISTORY_MAX) -> None:
     """One strict-JSON run per line, stamped — the bench's flight
-    history. Append-only so successive CI runs on a persistent runner
-    accumulate a local record alongside the committed baseline."""
+    history. Appends, then ROTATES the file down to its newest
+    ``max_lines`` lines: on a persistent runner the history used to
+    grow without bound (every CI run appended forever). Rotation works
+    on raw lines — a torn tail line (a killed writer) neither crashes
+    it nor survives a rotation that drops it, and the gate's reader
+    already skips malformed lines either way."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    torn_tail = False
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            if fh.tell() > 0:
+                fh.seek(-1, os.SEEK_END)
+                torn_tail = fh.read(1) != b"\n"
+    except OSError:
+        pass
     with open(path, "a", encoding="utf-8") as fh:
+        if torn_tail:
+            # a killed writer left a line without its newline: close it
+            # off so the next record starts a line of its own instead
+            # of being swallowed into the torn one (the reader skips
+            # the malformed line either way)
+            fh.write("\n")
         for run in runs:
             rec = {"ts": round(time.time(), 3), "run": run}
             fh.write(json.dumps(rec, allow_nan=False) + "\n")
+    if max_lines <= 0:
+        return
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return
+    if len(lines) <= max_lines:
+        return
+    # tmp-then-rename: a reader (or a crash) mid-rotation sees either
+    # the old full file or the new tail, never a half-written one
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.writelines(lines[-max_lines:])
+    os.replace(tmp, path)
 
 
 def load_history(path: str, n: int) -> List[Dict[str, Any]]:
@@ -237,6 +275,11 @@ def main(argv=None) -> int:
     ap.add_argument("--n", type=int, default=3,
                     help="evaluate best-of over the last N runs "
                          "(default 3)")
+    ap.add_argument("--history-max", type=int,
+                    default=DEFAULT_HISTORY_MAX,
+                    help="rotate --history down to its newest K lines "
+                         "on append (0 = never rotate; default "
+                         f"{DEFAULT_HISTORY_MAX})")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write best-of-runs as the new baseline "
                          "instead of gating")
@@ -266,7 +309,8 @@ def main(argv=None) -> int:
 
     if args.history:
         if new_runs:
-            append_history(args.history, new_runs)
+            append_history(args.history, new_runs,
+                           max_lines=args.history_max)
         try:
             runs = load_history(args.history, args.n)
         except OSError as e:

@@ -445,7 +445,284 @@ def sigterm_phase() -> int:
             proc.wait(timeout=10)
 
 
-def main() -> int:
+def fleet_phase() -> int:
+    """Fleet chaos (``--fleet``; its own CI job, tools/ci/
+    smoke_fleet.sh): the closed telemetry->control loop end to end, on
+    REAL serving subprocesses sharing one ExecutableStore.
+
+    1. A fleet controller (tools/fleet/controller.py) brings up 2
+       model-scoring replicas sequentially — the first seeds the shared
+       compile cache, the second must HYDRATE from it (audit: zero
+       recompiles, store hits > 0).
+    2. An open-loop Poisson ramp (tools/loadgen.py --targets, one
+       arrival clock round-robined across both replicas) pushes duty
+       cycle over the policy line -> the controller scales 2->3; the
+       new replica must warm-boot recompile-free from the store.
+    3. A replica is SIGKILLed mid-load; loadgen's LB-style next-target
+       retry keeps the run's SLO assertion (availability >= 0.99)
+       green while the controller reaps the corpse.
+    4. The ramp ends; duty collapses -> the controller scales down via
+       SIGTERM graceful drain, and the drained child's own exit
+       accounting proves zero admitted requests dropped.
+
+    Every scale decision must land in the flight-recorder ring, the
+    structured log, and /fleet/metrics — the forensics triple the
+    observability PRs built, now driven by a controller instead of an
+    operator."""
+    import io
+    import tempfile
+
+    from synapseml_tpu.onnx import zoo
+    from synapseml_tpu.runtime import autoscale as aut
+    from synapseml_tpu.runtime import blackbox as bb
+    from synapseml_tpu.runtime import structlog as slog
+    from tools.fleet.controller import (FleetController,
+                                        LocalProcessBackend)
+
+    def get_json(url):
+        with urllib.request.urlopen(urllib.request.Request(url),
+                                    timeout=10) as r:
+            return json.loads(r.read())
+
+    def get_text(url):
+        with urllib.request.urlopen(urllib.request.Request(url),
+                                    timeout=10) as r:
+            return r.read().decode()
+
+    def series_sum(metrics, name, **labels):
+        return sum(v for lbl, v in metrics.get(name, ())
+                   if all(lbl.get(k) == want
+                          for k, want in labels.items()))
+
+    work = tempfile.mkdtemp(prefix="chaos_fleet_")
+    model_path = os.path.join(work, "model.onnx")
+    with open(model_path, "wb") as fh:
+        fh.write(zoo.mlp([16, 32], num_classes=4, seed=0))
+    cache_dir = os.path.join(work, "cache")
+
+    bb.reset()
+    log_buf = io.StringIO()
+    prev_log = slog.set_mode("json", stream=log_buf)
+
+    # CI-shaped policy: the thresholds are tightened so ANY sustained
+    # scored traffic reads as saturation on a 2-core runner (duty on a
+    # tiny MLP never hits production's 0.75) — the phase proves the
+    # LOOP, production tunes the numbers (docs/deployment.md)
+    policy = aut.FleetPolicy(
+        min_replicas=1, max_replicas=3, duty_high=0.003,
+        duty_low=0.0005, burn_high=10.0, up_consecutive=2,
+        down_consecutive=16, up_cooldown_s=2.0, down_cooldown_s=2.0,
+        stale_after_s=5.0)
+    backend = LocalProcessBackend(
+        model=model_path, cache_dir=cache_dir, warmup="auto",
+        announce_timeout_s=300.0)
+    controller = FleetController(backend, policy, interval_s=0.4,
+                                 initial_replicas=2)
+    base = controller.serve()
+    lg_proc = None
+    try:
+        t0 = time.monotonic()
+        controller.start(wait_ready_s=300.0)
+        if len(controller.replicas) != 2:
+            print(f"FAIL[fleet]: bring-up gave "
+                  f"{len(controller.replicas)} replicas, wanted 2")
+            return 1
+        print(f"fleet up (2 replicas) in {time.monotonic() - t0:.1f}s",
+              flush=True)
+
+        # replica 2 must have HYDRATED from the store replica 1 seeded
+        status = get_json(base + "/fleet/status")
+        hydr = {h["replica"]: h for h in status["hydrations"]}
+        second = controller.replicas[1].name
+        if hydr.get(second, {}).get("outcome") != "warm":
+            print(f"FAIL[fleet]: replica 2 hydration not warm: "
+                  f"{hydr.get(second)}")
+            return 1
+
+        # open-loop ramp across BOTH replicas: one Poisson clock, LB
+        # stand-in round-robin, SLO assertion armed (the loadgen CLI
+        # is the source of truth — its --out JSON is what we judge)
+        urls = [r.url for r in controller.replicas]
+        results_json = os.path.join(work, "fleet_loadgen.json")
+        # rps is sized to the CI box, NOT to saturation: the duty
+        # thresholds above read any sustained scoring as "scale up",
+        # while an overloaded 2-core runner would park hundreds of
+        # requests on the victim's queue — a kill then resets parked
+        # connections en masse and the failover retries land on an
+        # equally saturated sibling (observed: 60 rps -> p99 16s,
+        # availability 0.92). The kill resilience being proven is the
+        # LB retry path, not overload shedding — chaos phases 1-5 own
+        # saturation behavior.
+        lg_proc = subprocess.Popen(
+            [sys.executable, os.path.join("tools", "loadgen.py"),
+             "--targets", ",".join(urls), "--payload-key", "features",
+             "--shapes", "16", "--rps", "25", "--duration", "40",
+             "--seed", "5", "--timeout", "15",
+             "--out", results_json,
+             "--slo-availability", "0.99"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+
+        # milestone 1: duty crosses the line -> scale-up to 3
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            status = get_json(base + "/fleet/status")
+            if len(status["replicas"]) >= 3:
+                break
+            time.sleep(0.3)
+        else:
+            print(f"FAIL[fleet]: controller never scaled 2->3 under "
+                  f"load (status: {status['aggregates']}, decisions "
+                  f"{status['decisions'][-3:]})")
+            return 1
+        third = controller.replicas[-1]
+        print(f"scaled up to 3 ({third.name}) at "
+              f"{time.monotonic() - t0:.1f}s", flush=True)
+
+        # milestone 2: the scale-up replica warm-boots from the shared
+        # store — ready, ZERO post-warmup recompiles (cache_skew
+        # included), zero store skew, store hits prove the bytes came
+        # from a sibling's compiles
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            status = get_json(base + "/fleet/status")
+            rec = {r["name"]: r for r in status["replicas"]}
+            if rec.get(third.name, {}).get("state") == "ready":
+                break
+            time.sleep(0.3)
+        else:
+            print(f"FAIL[fleet]: scale-up replica never went ready "
+                  f"({status['replicas']})")
+            return 1
+        m3 = aut.parse_prometheus(
+            get_text(third.url.rstrip("/") + "/metrics"))
+        recompiles = series_sum(m3,
+                                "synapseml_executor_recompiles_total")
+        skew = series_sum(m3,
+                          "synapseml_compile_cache_store_skew_total")
+        hits = series_sum(m3,
+                          "synapseml_compile_cache_store_hits_total")
+        if recompiles != 0 or skew != 0 or hits < 1:
+            print(f"FAIL[fleet]: scale-up replica not a warm boot: "
+                  f"recompiles={recompiles} store_skew={skew} "
+                  f"store_hits={hits}")
+            return 1
+        status = get_json(base + "/fleet/status")
+        hydr = {h["replica"]: h for h in status["hydrations"]}
+        if hydr.get(third.name, {}).get("outcome") != "warm":
+            print(f"FAIL[fleet]: scale-up hydration audit not warm: "
+                  f"{hydr.get(third.name)}")
+            return 1
+        print(f"warm boot verified: {third.name} recompiles=0 "
+              f"store_hits={hits:.0f}", flush=True)
+
+        # milestone 3: kill a loaded replica MID-LOAD (SIGKILL — a
+        # crash, not a drain); loadgen's next-target retry is the LB,
+        # the controller reaps the corpse
+        victim = controller.replicas[0]
+        victim.proc.kill()
+        print(f"killed {victim.name} mid-load", flush=True)
+
+        out, _ = lg_proc.communicate(timeout=120)
+        if lg_proc.returncode != 0:
+            print(f"FAIL[fleet]: loadgen SLO assertion failed under "
+                  f"replica kill (exit {lg_proc.returncode}):\n{out}")
+            return 1
+        with open(results_json) as fh:
+            summary = json.load(fh)
+        if summary["hung"]:
+            print(f"FAIL[fleet]: {summary['hung']} loadgen requests "
+                  "never got a terminal record")
+            return 1
+        if not summary.get("slo", {}).get("pass"):
+            print(f"FAIL[fleet]: loadgen SLO verdict failed: "
+                  f"{summary.get('slo')}")
+            return 1
+        if summary.get("failover_retries", 0) < 1:
+            print(f"FAIL[fleet]: kill landed but zero failover "
+                  f"retries recorded ({summary.get('per_target')})")
+            return 1
+        print(f"SLO green through the kill: "
+              f"{summary['by_status'].get('200', 0)}"
+              f"/{summary['scheduled']} ok, "
+              f"{summary['failover_retries']} failovers", flush=True)
+
+        # milestone 4: the ramp is over — duty collapses and the
+        # controller scales down via SIGTERM graceful drain; the
+        # child's exit accounting is the zero-drop proof
+        deadline = time.monotonic() + 60.0
+        term = None
+        while time.monotonic() < deadline:
+            status = get_json(base + "/fleet/status")
+            terms = [t for t in status["terminations"]
+                     if t.get("reason") == "duty_cycle"]
+            if terms:
+                term = terms[0]
+                break
+            time.sleep(0.5)
+        if term is None:
+            print(f"FAIL[fleet]: no scale-down after the ramp "
+                  f"(decisions {status['decisions'][-3:]})")
+            return 1
+        if term.get("exit_code") != 0 or not term.get("zero_dropped"):
+            print(f"FAIL[fleet]: scale-down drain not clean: {term}")
+            return 1
+        print(f"scale-down drained clean: {term}", flush=True)
+
+        # forensics triple: every scale action in /fleet/metrics, the
+        # flight-recorder ring, and the structured log
+        fm = aut.parse_prometheus(get_text(base + "/fleet/metrics"))
+        ups = series_sum(fm, "synapseml_fleet_scale_events_total",
+                         direction="up")
+        downs = series_sum(fm, "synapseml_fleet_scale_events_total",
+                           direction="down")
+        if ups < 3 or downs < 1:  # 2 initial + >=1 duty up, >=1 down
+            print(f"FAIL[fleet]: scale-event counters wrong "
+                  f"(up={ups}, down={downs})")
+            return 1
+        if series_sum(fm, "synapseml_process_rss_bytes") <= 0:
+            print("FAIL[fleet]: controller process self-telemetry "
+                  "missing from /fleet/metrics")
+            return 1
+        ring = [e.get("event") for e in bb.snapshot()["events"]]
+        for want in ("fleet_scale", "fleet_hydration",
+                     "fleet_replica_died", "fleet_drain"):
+            if want not in ring:
+                print(f"FAIL[fleet]: flight-recorder ring has no "
+                      f"{want} event ({sorted(set(ring))})")
+                return 1
+        log_events = set()
+        for line in log_buf.getvalue().splitlines():
+            try:
+                log_events.add(json.loads(line).get("event"))
+            except json.JSONDecodeError:
+                continue
+        if "fleet_scale" not in log_events:
+            print(f"FAIL[fleet]: structured log carries no "
+                  f"fleet_scale event ({sorted(log_events)})")
+            return 1
+        print(f"fleet chaos ok: 2->3 warm scale-up, SLO green "
+              f"through a replica kill, drain-clean scale-down "
+              f"(up={ups:.0f} down={downs:.0f} events)", flush=True)
+        return 0
+    finally:
+        if lg_proc is not None and lg_proc.poll() is None:
+            lg_proc.kill()
+        controller.stop(drain_replicas=True)
+        slog.set_mode(prev_log[0], level=prev_log[1])
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="chaos CI gate")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run ONLY the fleet autoscaling chaos phase "
+                         "(no SYNAPSEML_FAULTS needed; its own CI "
+                         "job, tools/ci/smoke_fleet.sh)")
+    args = ap.parse_args(argv)
+    if args.fleet:
+        return fleet_phase()
     spec = os.environ.get("SYNAPSEML_FAULTS", "")
     if "compute" not in spec:
         print("SYNAPSEML_FAULTS must arm a compute fault "

@@ -6,7 +6,7 @@ literal passed as the first argument to a telemetry registration call
 (``counter`` / ``gauge`` / ``gauge_fn`` / ``histogram``, bare or
 attribute-qualified) whose name carries one of the gated prefixes
 (``serving_``, ``executor_``, ``faults_``, ``blackbox_``,
-``device_``). The
+``device_``, ``fleet_``, ``process_``). The
 registry qualifies names dynamically (``synapseml_`` wire prefix), so
 the literal at the call site IS the catalog name.
 
@@ -27,7 +27,8 @@ import ast
 import os
 import sys
 
-PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_")
+PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
+            "fleet_", "process_")
 REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
 
 HERE = os.path.dirname(os.path.abspath(__file__))

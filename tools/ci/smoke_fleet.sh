@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Hard-timeout smoke for the fleet autoscaling loop (tools/fleet/
+# controller.py + runtime/autoscale.py, docs/deployment.md "Fleet
+# operations").
+#
+# Drives tools/ci/chaos_check.py --fleet: a controller brings up 2
+# REAL model-scoring serving subprocesses on one shared
+# ExecutableStore, an open-loop Poisson ramp (tools/loadgen.py
+# --targets) pushes duty cycle over the policy line, and the phase
+# asserts the whole closed loop — scale-up 2->3 with a recompile-free
+# warm boot from the shared store, SLO green through a mid-load
+# replica SIGKILL, and a SIGTERM drain-clean scale-down with zero
+# dropped admitted requests. A wedged replica or controller loop hangs
+# rather than fails, so the timeout turns it into a fast exit-124.
+#
+# Usage: tools/ci/smoke_fleet.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+exec timeout -k 10 "${SMOKE_TIMEOUT:-600}" \
+  python tools/ci/chaos_check.py --fleet

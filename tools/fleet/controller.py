@@ -1,0 +1,694 @@
+"""Fleet controller: telemetry-driven autoscaling over serving replicas.
+
+The closed observability loop (ROADMAP "fleet-scale serving"): every
+gauge PRs 6-10 built — ``executor_duty_cycle``, the SLO burn rates,
+the recompile sentinel, ``cache_skew`` — becomes a **control signal**
+here. The controller polls each replica's ``/metrics`` +
+``/health/ready``, reduces the scrape to a
+:class:`~synapseml_tpu.runtime.autoscale.ReplicaSample`, and acts on
+the pure policy in :mod:`synapseml_tpu.runtime.autoscale` (hysteresis,
+cooldowns, min/max clamps, and the never-scale-on-blindness rails).
+
+Two backends:
+
+- **local** (:class:`LocalProcessBackend`): spawns REAL
+  ``python -m synapseml_tpu.io.serving`` subprocesses on this host and
+  scales them down with SIGTERM — riding the PR-8 graceful drain, so a
+  scale-down drops zero admitted requests (the exit-accounting line is
+  parsed and re-asserted per termination). This is what the fleet
+  chaos CI phase drives (tools/ci/chaos_check.py --fleet).
+- **k8s**: the same policy runs as an HPA on the custom metrics the
+  chart already scrapes — ``--emit-hpa`` renders the committed
+  ``tools/k8s/chart/templates/hpa.yaml`` manifest from values.yaml
+  (the shipping path; this process is not needed in-cluster).
+
+Warm replica hydration: every spawn carries ``--cache-dir`` on the
+shared ``ExecutableStore`` volume plus ``--warmup``, so a scale-up
+deserializes executables a sibling already compiled. The first ready
+scrape of each new replica is audited
+(:func:`~synapseml_tpu.runtime.autoscale.hydration_audit`): zero
+post-warmup recompiles + zero store skew = ``warm``; counted in
+``fleet_hydrations_total{outcome=}`` and recorded as a
+``fleet_hydration`` flight event.
+
+Fleet observability: the controller serves ``GET /fleet/status``
+(JSON: per-replica state + samples, aggregates, the last decisions)
+and ``GET /fleet/metrics`` (its own Prometheus registry —
+``fleet_replicas{state=}``, ``fleet_scale_events_total{direction=,
+reason=}``, per-replica ``fleet_replica_*`` series, and the
+``process_*`` self-telemetry; ``/metrics`` is an alias). Every scale
+action and replica death lands in the flight recorder AND the
+structured log (``blackbox.record`` emits both), so
+``grep '"event":"fleet_scale"'`` reconstructs a scaling incident end
+to end (docs/deployment.md, "Fleet operations").
+
+Usage (CI-shaped example; production knobs in docs/deployment.md)::
+
+    python -m tools.fleet.controller \
+        --model model.onnx --cache-dir /cache --warmup auto \
+        --min 2 --max 4 --interval 2 \
+        --duty-high 0.75 --duty-low 0.2 --burn-high 2
+    python -m tools.fleet.controller --emit-hpa -   # k8s manifest
+"""
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+_ROOT = os.path.abspath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from synapseml_tpu.runtime import autoscale as _as  # noqa: E402
+from synapseml_tpu.runtime import blackbox as _bb  # noqa: E402
+from synapseml_tpu.runtime import perfwatch as _pw  # noqa: E402
+from synapseml_tpu.runtime import telemetry as _tm  # noqa: E402
+
+_ANNOUNCE_RE = re.compile(r"serving \[.*\] on (http://\S+/)")
+_ACCOUNTING_RE = re.compile(
+    r"exit accounting: admitted=(\d+) replied=(\d+)")
+
+
+def _http_get(url: str, timeout: float = 2.0) -> Optional[bytes]:
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(url), timeout=timeout) as r:
+            return r.read()
+    except Exception:  # noqa: BLE001 - poll failure IS the signal
+        return None
+
+
+def _http_status(url: str, timeout: float = 2.0) -> Optional[int]:
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(url), timeout=timeout) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class LocalReplica:
+    """One serving subprocess the local backend owns. Its stdout is
+    drained continuously on a reader thread (a full pipe would wedge
+    the child) into a bounded tail; the URL announce line and the exit
+    accounting line are captured as they pass."""
+
+    _MAX_LINES = 400
+
+    def __init__(self, name: str, proc: subprocess.Popen):
+        self.name = name
+        self.proc = proc
+        self.url: Optional[str] = None
+        self.spawned_ts = time.monotonic()
+        self.lines: List[str] = []
+        self.accounting: Optional[Dict[str, int]] = None
+        self._url_found = threading.Event()
+        self._lock = threading.Lock()
+        self._reader = threading.Thread(
+            target=self._read_stdout, name=f"fleet-stdout-{name}",
+            daemon=True)
+        self._reader.start()
+
+    def _read_stdout(self):
+        for line in self.proc.stdout:
+            with self._lock:
+                self.lines.append(line)
+                del self.lines[:-self._MAX_LINES]
+            if not self._url_found.is_set():
+                m = _ANNOUNCE_RE.search(line)
+                if m:
+                    self.url = m.group(1)
+                    self._url_found.set()
+            m = _ACCOUNTING_RE.search(line)
+            if m:
+                self.accounting = {"admitted": int(m.group(1)),
+                                   "replied": int(m.group(2))}
+
+    def wait_url(self, timeout: float) -> Optional[str]:
+        self._url_found.wait(timeout)
+        return self.url
+
+    def tail(self, n: int = 40) -> List[str]:
+        with self._lock:
+            return self.lines[-n:]
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class LocalProcessBackend:
+    """Spawns/terminates real serving subprocesses on this host — the
+    CI/laptop stand-in for a k8s Deployment, faithful where it counts:
+    replicas are OS processes, scale-down is SIGTERM + graceful drain,
+    and the zero-drop contract is read back from each child's exit
+    accounting line."""
+
+    def __init__(self, model: Optional[str] = None,
+                 cache_dir: Optional[str] = None,
+                 warmup: Optional[str] = None,
+                 extra_args: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 announce_timeout_s: float = 120.0):
+        self.model = model
+        self.cache_dir = cache_dir
+        self.warmup = warmup
+        self.extra_args = list(extra_args or [])
+        self.env = env
+        self.announce_timeout_s = announce_timeout_s
+        self._seq = 0
+
+    def _child_env(self) -> Dict[str, str]:
+        env = dict(os.environ if self.env is None else self.env)
+        # the replica must import the repo the controller runs from
+        env["PYTHONPATH"] = _ROOT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        # fault specs are the chaos harness's business, never inherited
+        # into fleet replicas by accident
+        env.pop("SYNAPSEML_FAULTS", None)
+        return env
+
+    def spawn(self, name: Optional[str] = None) -> LocalReplica:
+        """Start one replica (``--port 0``: the OS assigns, the
+        announce line tells us) and block until it announces its URL —
+        NOT until ready; warmup runs behind the readiness gate and the
+        controller tracks the warming state."""
+        self._seq += 1
+        name = name or f"replica{self._seq}"
+        argv = [sys.executable, "-m", "synapseml_tpu.io.serving",
+                "--host", "127.0.0.1", "--port", "0", "--name", name]
+        if self.model:
+            argv += ["--model", self.model]
+        if self.cache_dir:
+            argv += ["--cache-dir", self.cache_dir]
+        if self.warmup:
+            argv += ["--warmup", self.warmup]
+        argv += self.extra_args
+        proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=self._child_env(), cwd=_ROOT)
+        replica = LocalReplica(name, proc)
+        if replica.wait_url(self.announce_timeout_s) is None:
+            proc.kill()
+            proc.wait(timeout=10)
+            raise RuntimeError(
+                f"replica {name} never announced its URL "
+                f"(tail: {replica.tail(10)})")
+        return replica
+
+    def terminate(self, replica: LocalReplica,
+                  timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Graceful scale-down: SIGTERM rides the serving entry's drain
+        path (new requests 503 + Retry-After, accepted ones finish to
+        real replies). Returns the drain verdict, including the
+        child's own exit-accounting proof that zero admitted requests
+        were dropped."""
+        if replica.alive():
+            replica.proc.send_signal(signal.SIGTERM)
+        try:
+            code = replica.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            replica.proc.kill()
+            code = replica.proc.wait(timeout=10)
+        replica._reader.join(timeout=5)
+        acct = replica.accounting or {}
+        admitted = acct.get("admitted")
+        replied = acct.get("replied")
+        return {
+            "replica": replica.name,
+            "exit_code": code,
+            "admitted": admitted,
+            "replied": replied,
+            "zero_dropped": (admitted is not None
+                             and admitted == replied),
+        }
+
+
+class FleetController:
+    """The control loop: scrape -> aggregate -> decide -> act, once per
+    ``interval_s``, against whatever backend owns the replicas. Also
+    the fleet's observability surface (``serve()`` binds
+    /fleet/status + /fleet/metrics).
+
+    ``scrape_fn(replica) -> (metrics_text | None, ready)`` is
+    injectable so the decision loop is testable without HTTP; the
+    default polls the replica's real endpoints."""
+
+    def __init__(self, backend: LocalProcessBackend,
+                 policy: "_as.FleetPolicy",
+                 interval_s: float = 2.0,
+                 initial_replicas: Optional[int] = None,
+                 scrape_timeout_s: float = 2.0,
+                 scrape_fn: Optional[Callable[[Any], Any]] = None):
+        self.backend = backend
+        self.policy = policy
+        self.interval_s = float(interval_s)
+        self.initial_replicas = min(policy.max_replicas, max(
+            policy.min_replicas,
+            policy.min_replicas if initial_replicas is None
+            else int(initial_replicas)))
+        self.scrape_timeout_s = scrape_timeout_s
+        self.scrape_fn = scrape_fn or self._scrape_http
+        self.replicas: List[Any] = []
+        self.state = _as.FleetState()
+        self._samples: Dict[str, "_as.ReplicaSample"] = {}
+        self._prev_replies: Dict[str, Dict[str, float]] = {}
+        self._ever_ready: set = set()
+        self._hydrations: List[Dict[str, Any]] = []
+        self._terminations: List[Dict[str, Any]] = []
+        self._decisions: List[Dict[str, Any]] = []
+        self._aggregates: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+        # controller self-telemetry: process gauges + fleet gauges on
+        # the controller's OWN registry (it never imports jax)
+        _pw.ensure_process_registered()
+        _as.register_fleet_gauges(self.replica_state_counts,
+                                  lambda: self.aggregates())
+
+    # -- observability --------------------------------------------------
+
+    def replica_state_counts(self) -> Dict[str, int]:
+        counts = {"ready": 0, "warming": 0, "unreachable": 0}
+        with self._lock:
+            for r in list(self.replicas):
+                s = self._samples.get(r.name)
+                if s is None or not s.reachable:
+                    counts["unreachable"] += 1
+                elif s.ready:
+                    counts["ready"] += 1
+                else:
+                    counts["warming"] += 1
+        return counts
+
+    def aggregates(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._aggregates)
+
+    def status(self) -> Dict[str, Any]:
+        """The /fleet/status payload: one JSON document an operator (or
+        the chaos gate) reads the whole fleet from."""
+        with self._lock:
+            samples = dict(self._samples)
+            replicas = [{
+                "name": r.name,
+                "url": getattr(r, "url", None),
+                "alive": r.alive() if hasattr(r, "alive") else None,
+                "state": ("unreachable"
+                          if (samples.get(r.name) is None
+                              or not samples[r.name].reachable)
+                          else ("ready" if samples[r.name].ready
+                                else "warming")),
+                "duty": getattr(samples.get(r.name), "duty", 0.0),
+                "burn": (samples[r.name].burn_max()
+                         if samples.get(r.name) else 0.0),
+                "recompiles": (samples[r.name].recompiles_total
+                               if samples.get(r.name) else None),
+            } for r in self.replicas]
+            return {
+                "replicas": replicas,
+                "aggregates": dict(self._aggregates),
+                "policy": {k: getattr(self.policy, k)
+                           for k in self.policy.__slots__},
+                "hydrations": list(self._hydrations[-8:]),
+                "terminations": list(self._terminations[-8:]),
+                "decisions": list(self._decisions[-8:]),
+            }
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Bind the controller's observability endpoints; returns the
+        base URL."""
+        controller = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, status: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/fleet/status":
+                    self._send(200, json.dumps(
+                        controller.status(), default=repr).encode())
+                elif self.path in ("/fleet/metrics", "/metrics"):
+                    self._send(
+                        200, _tm.prometheus_text().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path in ("/health", "/health/live",
+                                   "/health/ready"):
+                    self._send(200, b"ok", "text/plain")
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         name="fleet-http", daemon=True).start()
+        return f"http://{host}:{self.port}"
+
+    # -- scrape ---------------------------------------------------------
+
+    def _scrape_http(self, replica) -> Any:
+        url = getattr(replica, "url", None)
+        if not url:
+            return None, False
+        text = _http_get(url.rstrip("/") + "/metrics",
+                         self.scrape_timeout_s)
+        if text is None:
+            return None, False
+        ready = _http_status(url.rstrip("/") + "/health/ready",
+                             self.scrape_timeout_s) == 200
+        return text.decode("utf-8", "replace"), ready
+
+    def _sample(self, replica, now: float) -> "_as.ReplicaSample":
+        text, ready = self.scrape_fn(replica)
+        sample = _as.sample_from_scrape(replica.name,
+                                        getattr(replica, "url", "")
+                                        or "", now, text, ready)
+        if not sample.reachable:
+            _as.scrape_failure_counter().inc()
+            return sample
+        # burn over the controller's OWN window: reply-count deltas
+        # between this scrape and the previous one (recovery decays
+        # the signal; cumulative gauges never would). _prev_replies is
+        # shared with the drain/reap paths (their threads pop
+        # terminated names), so access stays under the lock.
+        with self._lock:
+            prev = self._prev_replies.get(replica.name)
+            self._prev_replies[replica.name] = dict(
+                sample.replies_by_code)
+        if prev is not None:
+            avail = _as.window_availability(prev, sample.replies_by_code)
+            if avail is not None:
+                sample.avail_burn = _slo_burn(avail)
+        return sample
+
+    def _audit_if_newly_ready(self, sample: "_as.ReplicaSample"):
+        if not sample.ready:
+            return
+        with self._lock:
+            if sample.name in self._ever_ready:
+                return
+            self._ever_ready.add(sample.name)
+        audit = _as.hydration_audit(sample)
+        _as.hydration_counter(audit["outcome"]).inc()
+        with self._lock:
+            self._hydrations.append(audit)
+            del self._hydrations[:-64]  # bounded like _decisions
+        _bb.record("fleet_hydration",
+                   level="info" if audit["clean"] else "warn", **audit)
+
+    # -- the loop -------------------------------------------------------
+
+    def start(self, wait_ready_s: float = 300.0) -> "FleetController":
+        """Sequential initial bring-up to ``initial_replicas`` (the
+        FIRST replica seeds the shared ExecutableStore; waiting for
+        its readiness before spawning siblings is what makes every
+        later boot a warm one), then the control loop."""
+        for _ in range(self.initial_replicas):
+            self._spawn("initial")
+            self.wait_all_ready(wait_ready_s)
+        self.state.mark_scaled(time.monotonic(), "up")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-controller",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def wait_all_ready(self, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            now = time.monotonic()
+            samples = [self._sample(r, now) for r in self.replicas]
+            with self._lock:
+                for s in samples:
+                    self._samples[s.name] = s
+            for s in samples:
+                self._audit_if_newly_ready(s)
+            if samples and all(s.ready for s in samples):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def _spawn(self, reason: str):
+        replica = self.backend.spawn()
+        with self._lock:
+            self.replicas.append(replica)
+            n = len(self.replicas)
+        _as.register_replica_gauges(
+            replica.name,
+            lambda name=replica.name: self._samples.get(
+                name, _as.ReplicaSample(name)))
+        _as.scale_event_counter("up", reason).inc()
+        _bb.record("fleet_scale", direction="up", reason=reason,
+                   replica=replica.name, replicas=n,
+                   url=getattr(replica, "url", None))
+
+    def _terminate(self, replica, reason: str):
+        with self._lock:
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+            n = len(self.replicas)
+            self._samples.pop(replica.name, None)
+            self._prev_replies.pop(replica.name, None)
+        _as.unregister_replica_gauges(replica.name)
+        _as.scale_event_counter("down", reason).inc()
+        _bb.record("fleet_scale", direction="down", reason=reason,
+                   replica=replica.name, replicas=n)
+        verdict = self.backend.terminate(replica)
+        verdict["reason"] = reason
+        with self._lock:
+            self._terminations.append(verdict)
+            del self._terminations[:-64]  # bounded like _decisions
+            # the name never returns (spawn sequence numbers), so its
+            # audit latch can go too — a crash-looping fleet must not
+            # accumulate a set of dead names
+            self._ever_ready.discard(replica.name)
+        _bb.record("fleet_drain",
+                   level="info" if verdict.get("zero_dropped")
+                   else "warn", **verdict)
+
+    def _reap(self):
+        """Remove replicas whose PROCESS died under us (crash, OOM,
+        chaos kill) — dead capacity must leave the decision's replica
+        count, or a stale ghost would block scale-down forever and
+        hide the shortfall scale-up needs to see."""
+        for replica in list(self.replicas):
+            if hasattr(replica, "alive") and not replica.alive():
+                with self._lock:
+                    self.replicas.remove(replica)
+                    self._samples.pop(replica.name, None)
+                    self._prev_replies.pop(replica.name, None)
+                    self._ever_ready.discard(replica.name)
+                _as.unregister_replica_gauges(replica.name)
+                _bb.record("fleet_replica_died", level="error",
+                           replica=replica.name,
+                           exit_code=replica.proc.returncode
+                           if hasattr(replica, "proc") else None)
+
+    def tick(self) -> "_as.Decision":
+        """One evaluation: reap -> enforce the min floor -> scrape ->
+        decide -> act. Public so tests (and the chaos gate) can drive
+        the loop deterministically."""
+        self._reap()
+        if len(self.replicas) < self.policy.min_replicas:
+            # the min floor is not a *decision*, it is an invariant: a
+            # died replica is replaced before any policy math runs
+            self._spawn("min_floor")
+        now = time.monotonic()
+        samples = [self._sample(r, now) for r in self.replicas]
+        with self._lock:
+            self._samples = {s.name: s for s in samples}
+        for s in samples:
+            self._audit_if_newly_ready(s)
+        decision = _as.decide(now, samples, self.state, self.policy)
+        with self._lock:
+            self._aggregates = decision.aggregates
+            self._decisions.append(decision.as_dict())
+            del self._decisions[:-64]
+        if decision.direction == "up":
+            # spawn FIRST: a failed spawn (announce timeout, bind
+            # failure) raises into the loop's error handler with the
+            # cooldown un-stamped, so the starved fleet retries on the
+            # next breach instead of serving out a cooldown it never
+            # bought capacity with (the decide() docstring contract)
+            self._spawn(decision.reason)
+            self.state.mark_scaled(time.monotonic(), "up")
+        elif decision.direction == "down":
+            victim = self._downscale_victim()
+            if victim is not None:
+                self.state.mark_scaled(now, "down")
+                # drain in the background: a graceful drain takes
+                # seconds and must not blind the control loop
+                threading.Thread(
+                    target=self._terminate,
+                    args=(victim, decision.reason),
+                    name=f"fleet-drain-{victim.name}",
+                    daemon=True).start()
+        return decision
+
+    def _downscale_victim(self):
+        """Newest ready replica first (LIFO): the oldest replicas carry
+        the warmest caches and the longest uptime evidence."""
+        with self._lock:
+            candidates = [r for r in self.replicas
+                          if self._samples.get(r.name) is not None
+                          and self._samples[r.name].ready]
+            return candidates[-1] if candidates else None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 - the loop must survive
+                _bb.record("fleet_tick_error", level="error",
+                           error=repr(e)[:200])
+
+    def stop(self, drain_replicas: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, 2 * self.interval_s))
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if drain_replicas:
+            for replica in list(self.replicas):
+                self._terminate(replica, "shutdown")
+
+
+def _slo_burn(availability: float) -> float:
+    from synapseml_tpu.runtime import slo
+
+    target = float(os.environ.get("SYNAPSEML_SLO_AVAILABILITY",
+                                  str(slo.DEFAULT_AVAILABILITY_TARGET)))
+    return slo.burn_rate(availability, target)
+
+
+def emit_hpa(values_path: Optional[str] = None) -> str:
+    """Render the chart's HPA-on-custom-metrics manifest (the k8s mode
+    of this controller: the policy runs IN the cluster, scaling on the
+    same duty-cycle/burn-rate series the chart's scrape annotations
+    already export)."""
+    from tools.k8s import render as _render
+
+    k8s_dir = os.path.join(_ROOT, "tools", "k8s")
+    with open(values_path
+              or os.path.join(k8s_dir, "chart", "values.yaml")) as fh:
+        values = _render.parse_simple_yaml(fh.read())
+    with open(os.path.join(k8s_dir, "chart", "templates",
+                           "hpa.yaml")) as fh:
+        return _render.render(fh.read(), values)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--min", type=int, default=1)
+    ap.add_argument("--max", type=int, default=4)
+    ap.add_argument("--initial", type=int, default=None,
+                    help="initial replica count (default: --min)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between control evaluations")
+    ap.add_argument("--duty-high", type=float, default=0.75)
+    ap.add_argument("--duty-low", type=float, default=0.20)
+    ap.add_argument("--burn-high", type=float, default=2.0)
+    ap.add_argument("--up-consecutive", type=int, default=2)
+    ap.add_argument("--down-consecutive", type=int, default=4)
+    ap.add_argument("--up-cooldown", type=float, default=15.0)
+    ap.add_argument("--down-cooldown", type=float, default=60.0)
+    ap.add_argument("--stale-after", type=float, default=10.0)
+    ap.add_argument("--model", default=os.environ.get(
+        "SYNAPSEML_MODEL_PATH") or None)
+    ap.add_argument("--cache-dir", default=os.environ.get(
+        "SYNAPSEML_COMPILE_CACHE") or None,
+        help="shared ExecutableStore dir — what makes scale-up warm")
+    ap.add_argument("--warmup", default=os.environ.get(
+        "SYNAPSEML_WARMUP") or None)
+    ap.add_argument("--replica-arg", action="append", default=[],
+                    help="extra argv token passed to every replica "
+                         "(repeatable)")
+    ap.add_argument("--port", type=int, default=8899,
+                    help="controller HTTP port (/fleet/status, "
+                         "/fleet/metrics); 0 = OS-assigned")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--emit-hpa", metavar="PATH", default=None,
+                    help="render the k8s HPA manifest from the chart "
+                         "values and write it to PATH ('-' = stdout), "
+                         "then exit — the in-cluster deployment path")
+    ap.add_argument("--values", default=None,
+                    help="values.yaml override for --emit-hpa")
+    args = ap.parse_args(argv)
+
+    if args.emit_hpa is not None:
+        text = emit_hpa(args.values)
+        if args.emit_hpa == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.emit_hpa, "w", encoding="utf-8") as fh:
+                fh.write(text)
+            print(f"wrote {args.emit_hpa}")
+        return 0
+
+    try:
+        policy = _as.FleetPolicy(
+            min_replicas=args.min, max_replicas=args.max,
+            duty_high=args.duty_high, duty_low=args.duty_low,
+            burn_high=args.burn_high,
+            up_consecutive=args.up_consecutive,
+            down_consecutive=args.down_consecutive,
+            up_cooldown_s=args.up_cooldown,
+            down_cooldown_s=args.down_cooldown,
+            stale_after_s=args.stale_after)
+    except ValueError as e:
+        print(f"error: {e}", flush=True)
+        return 2
+    backend = LocalProcessBackend(
+        model=args.model, cache_dir=args.cache_dir, warmup=args.warmup,
+        extra_args=args.replica_arg)
+    controller = FleetController(backend, policy,
+                                 interval_s=args.interval,
+                                 initial_replicas=args.initial)
+    url = controller.serve(host=args.host, port=args.port)
+    print(f"fleet controller on {url} (GET /fleet/status, "
+          f"/fleet/metrics)", flush=True)
+    controller.start()
+    print(f"fleet up: {len(controller.replicas)} replicas "
+          f"{[r.name for r in controller.replicas]}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    print("fleet controller: draining fleet ...", flush=True)
+    controller.stop(drain_replicas=True)
+    print("fleet controller: stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

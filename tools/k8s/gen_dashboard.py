@@ -35,7 +35,8 @@ DOC = os.path.join(ROOT, "docs", "observability.md")
 OUT = os.path.join(HERE, "chart", "dashboards",
                    "serving-dashboard.json")
 
-PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_")
+PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
+            "fleet_", "process_")
 _NAME = re.compile(r"([a-z][a-z0-9_]*)(\{([a-z_=,]*)\})?")
 
 

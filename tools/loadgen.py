@@ -29,13 +29,20 @@ under the threshold and the 200-fraction of every *scheduled* request
 (socket errors count against — an unanswered request is an
 availability loss) must meet the target; violation exits 2.
 
+Multi-endpoint mode: ``--targets a,b,c`` round-robins ONE open-loop
+arrival clock across several replicas (the fleet chaos phase's
+load-balancer stand-in) with a per-target status/latency breakdown in
+the summary; a request whose send dies at the socket level retries
+once on the next target, the way an LB health-checks a member out.
+
 Usage (also importable: :func:`run_load` drives the chaos CI scenarios
 in tools/ci/chaos_check.py)::
 
     python tools/loadgen.py --url http://127.0.0.1:8898/ \
         --rps 200 --duration 10 --shapes 2,8,32 [--deadline-ms 250] \
         [--seed 7] [--json] [--out results.json] \
-        [--slo-p99-ms 250] [--slo-availability 0.999]
+        [--slo-p99-ms 250] [--slo-availability 0.999] \
+        [--targets http://a/,http://b/] [--payload-key features]
 """
 from __future__ import annotations
 
@@ -84,34 +91,74 @@ def _send(url: str, body: bytes, headers: Dict[str, str],
         return "error", None
 
 
-def run_load(url: str, rps: float, duration_s: float,
+def run_load(url: Optional[str], rps: float, duration_s: float,
              shapes: Sequence[int] = (2,),
              deadline_ms: Optional[float] = None,
              timeout: float = 30.0,
              seed: Optional[int] = None,
              payload_fn: Callable[[int, int], Any] = _default_payload,
              on_result: Optional[Callable[[int, Any, float], None]] = None,
-             stop: Optional[threading.Event] = None) -> Dict[str, Any]:
+             stop: Optional[threading.Event] = None,
+             targets: Optional[Sequence[str]] = None) -> Dict[str, Any]:
     """Drive ``rps`` Poisson arrivals against ``url`` for ``duration_s``
     seconds; block until every sender reaches a terminal record; return
     the summary dict. ``seed`` makes the arrival schedule and shape
     sequence deterministic (the payloads already are). ``on_result(i,
     status, latency_s)`` observes each completion (chaos checks hook
     assertions here); ``stop`` aborts scheduling early (senders already
-    launched still complete)."""
+    launched still complete).
+
+    ``targets`` (multi-endpoint mode, ``--targets``): ONE open-loop
+    arrival clock round-robins requests across the given endpoints —
+    the load-balancer stand-in the fleet chaos phase drives. A request
+    whose send dies at the SOCKET level (refused/reset — a killed
+    replica) retries once on the next target before recording, the
+    way an LB health-checks a member out mid-flight; explicit HTTP
+    replies (including sheds) never retry. The summary gains
+    ``per_target`` (every attempt's status + ok-latency percentiles
+    per endpoint) and ``failover_retries``; top-level ``by_status``
+    stays final-outcome-per-request, so the SLO math is unchanged."""
     rng = random.Random(seed)
     headers = {"Content-Type": "application/json"}
     if deadline_ms is not None:
         headers["X-Deadline-Ms"] = str(deadline_ms)
     shapes = list(shapes) or [2]
+    target_list = [u for u in (targets or ()) if u] or \
+        ([url] if url else [])
+    if not target_list:
+        raise ValueError("run_load needs a url or a non-empty targets")
 
     results: List[Optional[Tuple[Any, float]]] = []
     senders: List[threading.Thread] = []
     lock = threading.Lock()
+    per_target: Dict[str, Dict[str, Any]] = {
+        t: {"by_status": {}, "ok_lat": []} for t in target_list}
+    failovers = [0]
+
+    def _record_attempt(target: str, status: Any, dt: float):
+        rec = per_target[target]
+        key = str(status)
+        rec["by_status"][key] = rec["by_status"].get(key, 0) + 1
+        if status == 200:
+            rec["ok_lat"].append(dt)
 
     def sender(i: int, body: bytes):
+        target = target_list[i % len(target_list)]
         t0 = time.monotonic()
-        status, _ = _send(url, body, headers, timeout)
+        status, _ = _send(target, body, headers, timeout)
+        with lock:
+            _record_attempt(target, status, time.monotonic() - t0)
+        if status == "error" and len(target_list) > 1:
+            # LB-style one-shot failover on transport death only: the
+            # request never reached an HTTP layer, so re-sending it to
+            # a sibling cannot double-apply it any more than an LB
+            # retry would
+            target = target_list[(i + 1) % len(target_list)]
+            t1 = time.monotonic()
+            status, _ = _send(target, body, headers, timeout)
+            with lock:
+                failovers[0] += 1
+                _record_attempt(target, status, time.monotonic() - t1)
         dt = time.monotonic() - t0
         with lock:
             results[i] = (status, dt)
@@ -137,8 +184,14 @@ def run_load(url: str, rps: float, duration_s: float,
         # open loop: the NEXT arrival is clocked off the schedule, not
         # off this request's completion
         next_arrival += rng.expovariate(rps)
+    # multi-target senders may legally spend a full socket timeout on
+    # the first attempt (a killed replica that drops packets instead
+    # of RSTing) and another on the failover retry — the join window
+    # must cover both legs or a still-retrying request is miscounted
+    # as the one forbidden outcome ("hung")
+    join_wait = (2 * timeout if len(target_list) > 1 else timeout) + 10.0
     for t in senders:
-        t.join(timeout=timeout + 10.0)
+        t.join(timeout=join_wait)
     wall = time.monotonic() - t_start
 
     by_status: Dict[str, int] = {}
@@ -158,7 +211,7 @@ def run_load(url: str, rps: float, duration_s: float,
             ok_lat.append(dt)
     ok_lat.sort()
     all_lat.sort()
-    return {
+    summary = {
         "scheduled": i,
         "hung": hung,
         "by_status": by_status,
@@ -171,6 +224,17 @@ def run_load(url: str, rps: float, duration_s: float,
         "latency_all_s": {q: percentile(all_lat, q)
                           for q in (50.0, 95.0, 99.0)},
     }
+    if len(target_list) > 1 or targets:
+        with lock:
+            summary["failover_retries"] = failovers[0]
+            summary["per_target"] = {
+                t: {
+                    "by_status": dict(rec["by_status"]),
+                    "latency_ok_s": {
+                        q: percentile(sorted(rec["ok_lat"]), q)
+                        for q in (50.0, 95.0, 99.0)},
+                } for t, rec in per_target.items()}
+    return summary
 
 
 def _json_finite(obj: Any) -> Any:
@@ -223,7 +287,19 @@ def evaluate_slo(summary: Dict[str, Any],
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--url", required=True)
+    ap.add_argument("--url", default=None,
+                    help="single endpoint (or use --targets)")
+    ap.add_argument("--targets", default=None,
+                    help="comma-separated endpoints: ONE open-loop "
+                         "arrival clock round-robins across them with "
+                         "per-target status/latency breakdown in the "
+                         "summary — the fleet chaos phase's LB "
+                         "stand-in (socket-dead sends retry once on "
+                         "the next target)")
+    ap.add_argument("--payload-key", default="x",
+                    help="JSON field name the feature vector rides "
+                         "under (the serving model pipeline expects "
+                         "'features'; default 'x')")
     ap.add_argument("--rps", type=float, default=50.0)
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--shapes", default="2",
@@ -247,10 +323,20 @@ def main(argv=None) -> int:
                          "replied 200 (socket errors count against; "
                          "violation: exit 2)")
     args = ap.parse_args(argv)
+    targets = [t.strip() for t in (args.targets or "").split(",")
+               if t.strip()] or None
+    if not args.url and not targets:
+        ap.error("one of --url / --targets is required")
     shapes = [int(s) for s in args.shapes.split(",") if s.strip()]
+    key = args.payload_key
+
+    def payload(i: int, shape: int) -> Dict[str, Any]:
+        return {key: _default_payload(i, shape)["x"]}
+
     summary = run_load(args.url, args.rps, args.duration, shapes,
                        deadline_ms=args.deadline_ms,
-                       timeout=args.timeout, seed=args.seed)
+                       timeout=args.timeout, seed=args.seed,
+                       payload_fn=payload, targets=targets)
     slo = evaluate_slo(summary, args.slo_p99_ms, args.slo_availability)
     if slo is not None:
         summary["slo"] = slo
