@@ -424,10 +424,14 @@ def bench_serving_scored_latency():
             replies[i] = make_reply({"pred": int(scored["pred"][i])})
         return table.with_column("reply", replies)
 
-    # prewarm every pow2 bucket the varying micro-batch sizes can hit
+    # AOT-warm every pow2 bucket the varying micro-batch sizes can hit
     # (max_batch is 64 on the concurrent leg), so no jit compile lands
-    # inside a timed request — workers share the warmed cache
-    for n in (1, 9, 17, 33):
+    # inside a timed request — workers share the warmed cache. warmup()
+    # (vs the old transform-loop prewarm) also lands each bucket's
+    # flops/bytes in the roofline cost table, which is what attributes
+    # this group in perf_report
+    model.warmup(buckets=[8, 16, 32, 64])
+    for n in (1, 9, 17, 33):  # belt over braces: drive the drain path
         model.transform(Table({"input": np.zeros((n, 16), np.float32)}))
 
     body = json.dumps({"features": [0.1] * 16}).encode()
@@ -810,16 +814,72 @@ def _entries_cold_start():
     }]
 
 
+class BenchGroup:
+    """One bench group: runner + the metadata --list prints and
+    tools/perf_report.py attributes against. ``kind`` says whether the
+    group exercises a device program ("device" — perf_report requires
+    a captured cost signature) or only the host framework ("host" —
+    the echo legs, where a roofline fraction would be a lie)."""
+
+    __slots__ = ("name", "fn", "kind", "describe", "metrics")
+
+    def __init__(self, name, fn, kind, describe, metrics):
+        self.name = name
+        self.fn = fn
+        self.kind = kind
+        self.describe = describe
+        self.metrics = tuple(metrics)
+
+
 BENCH_GROUPS = [
-    ("resnet50", _entries_resnet50),
-    ("gbdt_train", _entries_gbdt_train),
-    ("dp_scaling", _entries_dp_scaling),
-    ("onnx_lightgbm", _entries_onnx_lightgbm),
-    ("transformer", _entries_transformer),
-    ("serving", _entries_serving),
-    ("serving_scored", _entries_serving_scored),
-    ("gbdt_histogram", _entries_gbdt_histogram),
-    ("cold_start", _entries_cold_start),
+    BenchGroup(
+        "resnet50", _entries_resnet50, "device",
+        "ONNX ResNet-50 imported-graph inference: device-resident, "
+        "uint8-wire host feed, and the cross-call pipeline-overlap A/B",
+        ("onnx_resnet50_images_per_sec_per_chip",
+         "onnx_resnet50_hostfeed_images_per_sec",
+         "executor_pipeline_overlap_img_per_sec")),
+    BenchGroup(
+        "gbdt_train", _entries_gbdt_train, "device",
+        "LightGBM training on Adult-census shape via the measured "
+        "pallas/xla histogram router, full-loop A/B in detail",
+        ("lightgbm_train_rows_iters_per_sec_per_chip",)),
+    BenchGroup(
+        "dp_scaling", _entries_dp_scaling, "device",
+        "same ResNet-50 stream dp-sharded across all chips vs pinned "
+        "to one — the chip-count scaling of the hot scoring path",
+        ("executor_dp_scaling_images_per_sec",)),
+    BenchGroup(
+        "onnx_lightgbm", _entries_onnx_lightgbm, "device",
+        "LightGBM-converted ONNX tree ensemble scored device-resident "
+        "(GEMM formulation) — the reference notebook's workload",
+        ("onnx_lightgbm_scoring_rows_per_sec_per_chip",)),
+    BenchGroup(
+        "transformer", _entries_transformer, "device",
+        "BERT-base-shaped imported ONNX encoder, bf16, bs=128 — the "
+        "transformer-era counterpart of the ResNet metric",
+        ("onnx_bert_base_sequences_per_sec_per_chip",)),
+    BenchGroup(
+        "serving", _entries_serving, "host",
+        "echo round trip through ContinuousServer — isolates the "
+        "serving framework's own overhead, no device program",
+        ("serving_roundtrip_p50_ms",)),
+    BenchGroup(
+        "serving_scored", _entries_serving_scored, "device",
+        "real imported-ONNX MLP scored per request, sequential and "
+        "under ~32 concurrent clients with micro-batch coalescing",
+        ("serving_scored_roundtrip_p50_ms",
+         "serving_scored_concurrent_p50_ms")),
+    BenchGroup(
+        "gbdt_histogram", _entries_gbdt_histogram, "device",
+        "isolated GBDT histogram hot-op shootout: Pallas VMEM kernel "
+        "vs XLA one-hot einsum at Adult-x2 shape",
+        ("gbdt_histogram_rows_per_sec_per_chip",)),
+    BenchGroup(
+        "cold_start", _entries_cold_start, "device",
+        "serving cold start cold-vs-warm-cache A/B: warmup + first "
+        "scored batch against an empty vs populated executable store",
+        ("serving_cold_start_first_batch_ms",)),
 ]
 
 # the CI-bounded subset (tools/ci/pipeline.yaml bench-smoke): groups
@@ -853,13 +913,14 @@ def _finite(obj):
 
 
 def _select_groups(groups):
-    """Resolve group names to (name, fn) pairs, honoring the CALLER's
-    ordering (deduped): the first selected group's first entry is the
-    headline, so ``--only cold_start,serving`` must headline
-    cold_start, not whichever appears first in the registry."""
-    by_name = dict(BENCH_GROUPS)
+    """Resolve group names to BenchGroup records, honoring the
+    CALLER's ordering (deduped): the first selected group's first
+    entry is the headline, so ``--only cold_start,serving`` must
+    headline cold_start, not whichever appears first in the
+    registry."""
+    by_name = {g.name: g for g in BENCH_GROUPS}
     seen = set()
-    return [(name, by_name[name]) for name in groups
+    return [by_name[name] for name in groups
             if name in by_name
             and not (name in seen or seen.add(name))]
 
@@ -877,8 +938,15 @@ def run_bench(groups, synlint: bool = True):
     with _warnings.catch_warnings(record=True) as _rec:
         _warnings.simplefilter("always")
         entries = []
-        for _name, fn in selected:
-            entries.extend(fn())
+        for g in selected:
+            # every cost-table signature a group's warmups compile is
+            # tagged with the group name — the join key perf_report
+            # uses to attribute bench groups offline (detail.cost)
+            with _cost_tag_scope(g.name):
+                got = g.fn()
+            for e in got:
+                e.setdefault("group", g.name)
+            entries.extend(got)
     donation_warnings = sum(
         1 for w in _rec
         if "donated buffers were not usable" in str(w.message).lower())
@@ -899,7 +967,36 @@ def run_bench(groups, synlint: bool = True):
         detail["synlint_findings_total"] = synlint_total
         detail["synlint_runtime_s"] = round(synlint_s, 2)
     detail["telemetry"] = _telemetry_snapshot()
+    # roofline cost-table snapshot + group metadata: everything
+    # tools/perf_report.py needs to attribute this run OFFLINE from
+    # the one committed artifact (docs/perf.md "Roofline methodology")
+    detail["cost"] = _cost_snapshot()
+    detail["bench_groups"] = {
+        g.name: {"kind": g.kind, "description": g.describe,
+                 "metrics": list(g.metrics)} for g in selected}
     return _compose_payload(entries, detail)
+
+
+def _cost_tag_scope(name):
+    """costmodel.tag_scope when the runtime imports; inert otherwise
+    (bench.py must run even where the package is trimmed)."""
+    try:
+        from synapseml_tpu.runtime import costmodel
+
+        return costmodel.tag_scope(name)
+    except Exception:  # noqa: BLE001
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def _cost_snapshot():
+    try:
+        from synapseml_tpu.runtime import costmodel
+
+        return costmodel.snapshot(force=True)
+    except Exception as e:  # noqa: BLE001 - the bench must survive
+        return {"error": repr(e), "entries": []}
 
 
 def _compose_payload(entries, detail):
@@ -916,14 +1013,19 @@ def _compose_payload(entries, detail):
 def main(argv=None) -> int:
     import argparse
 
-    names = [name for name, _fn in BENCH_GROUPS]
+    names = [g.name for g in BENCH_GROUPS]
     ap = argparse.ArgumentParser(
         description="Benchmark driver — prints ONE JSON line "
                     "(docs/perf.md).")
     ap.add_argument("--out", metavar="FILE",
                     help="also write the payload as strict RFC-8259 "
                          "JSON (non-finite floats -> null) — the file "
-                         "tools/ci/bench_check.py consumes")
+                         "tools/ci/bench_check.py and "
+                         "tools/perf_report.py consume")
+    ap.add_argument("--cost-report", metavar="FILE",
+                    help="also render the ranked roofline bottleneck "
+                         "report (tools/perf_report.py) from this "
+                         "run's payload into FILE")
     ap.add_argument("--only", metavar="G1,G2",
                     help="run only these groups (comma-separated; see "
                          "--list). Overrides --fast. Subset runs skip "
@@ -934,8 +1036,9 @@ def main(argv=None) -> int:
                     help="print group names and exit")
     args = ap.parse_args(argv)
     if args.list:
-        for name in names:
-            print(name)
+        for g in BENCH_GROUPS:
+            print(f"{g.name}  [{g.kind}]  {g.describe}")
+            print(f"  metrics: {', '.join(g.metrics)}")
         return 0
     if args.only:
         groups = [g.strip() for g in args.only.split(",") if g.strip()]
@@ -957,6 +1060,18 @@ def main(argv=None) -> int:
         with open(args.out, "w", encoding="utf-8") as fh:
             json.dump(payload, fh, indent=2, allow_nan=False)
             fh.write("\n")
+    if args.cost_report:
+        try:
+            from tools.perf_report import build_report
+
+            _rows, md, unattributed = build_report(payload)
+            with open(args.cost_report, "w", encoding="utf-8") as fh:
+                fh.write(md)
+            if unattributed:
+                print("cost report: unattributed groups: "
+                      + ", ".join(unattributed))
+        except Exception as e:  # noqa: BLE001 - report is a side dish
+            print(f"cost report failed: {e!r}")
     return 0
 
 

@@ -38,6 +38,7 @@ import numpy as np
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
 from synapseml_tpu.runtime import blackbox as _bb
+from synapseml_tpu.runtime import costmodel as _cm
 from synapseml_tpu.runtime import faults as _flt
 from synapseml_tpu.runtime import perfwatch as _pw
 from synapseml_tpu.runtime import slo as _slo
@@ -689,6 +690,18 @@ class WorkerServer:
                     self._send_plain(
                         200,
                         json.dumps(_pw.memory_snapshot(),
+                                   default=repr).encode("utf-8"),
+                        "application/json")
+                    return
+                if self.path == "/debug/cost":
+                    # roofline cost table (runtime/costmodel.py): the
+                    # per-signature flops/bytes/bound ledger captured
+                    # at warmup, with the current window's achieved
+                    # attribution folded in — what tools/perf_report.py
+                    # reads offline, served live beside /debug/memory
+                    self._send_plain(
+                        200,
+                        json.dumps(_cm.snapshot(),
                                    default=repr).encode("utf-8"),
                         "application/json")
                     return

@@ -190,6 +190,17 @@ def snapshot(max_events: Optional[int] = None,
         "events": events,
         "telemetry": _tm.snapshot(compact=True),
     }
+    # roofline cost table (runtime/costmodel.py): folded into every
+    # dump/flight view so an incident snapshot says what the warmed
+    # programs COST, not just what they did. Lazy import (costmodel is
+    # upstream of perfwatch, not of the recorder) and best-effort — a
+    # forensic snapshot must never fail on its garnish.
+    try:
+        from synapseml_tpu.runtime import costmodel as _cm
+
+        snap["cost"] = _cm.snapshot()
+    except Exception:  # noqa: BLE001
+        pass
     if stacks:
         snap["threads"] = thread_stacks()
     return snap

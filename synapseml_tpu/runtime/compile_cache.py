@@ -296,7 +296,12 @@ class WarmupReport:
     its disposition: ``"loaded"`` (deserialized from the store — no XLA
     compile), ``"compiled"`` (fresh compile, persisted when a store is
     configured), or ``"error"`` (that signature fell back to lazy jit;
-    the error rides in ``errors``). Warmup itself never raises for cache
+    the error rides in ``errors``). Loaded/compiled entries also carry
+    ``cost_captured``: whether XLA's compiled cost model yielded a
+    flops/bytes ledger for the roofline cost table
+    (runtime/costmodel.py) — False for e.g. a store-deserialized
+    executable that refuses analysis, which lands an ``unknown``-bound
+    entry instead. Warmup itself never raises for cache
     or compile problems — a failed signature just compiles on first use,
     today's behavior."""
 

@@ -1,0 +1,541 @@
+"""Roofline cost observatory: per-signature HLO cost capture + attribution.
+
+PRs 6-11 made the runtime observable (telemetry, flight recorder,
+duty-cycle, HBM gauges) but none of it answers the question the flat
+bench line keeps asking: *which compiled program is the bottleneck and
+what bound is it at?* The reference stack ships per-engine perf
+accounting at the native layer; our equivalent is XLA's own compiled
+cost model — ``compiled.cost_analysis()`` / ``memory_analysis()`` —
+which docs/perf.md already uses by hand. This module wires it into the
+telemetry plane:
+
+- **Cost table** (:func:`record`): at ``warmup()``/AOT-compile time —
+  zero hot-path cost; the capture rides a code path that just paid a
+  multi-second XLA compile — every (bucket, arity, layout, device-kind)
+  signature lands one entry: flops, bytes accessed, transcendentals,
+  argument/output/temp bytes. Tolerant of every cost-model shape jax
+  has shipped (list-of-dicts or dict, missing keys, a deserialized
+  executable that refuses analysis): a signature that cannot be
+  analyzed degrades to ``bound="unknown"``, never a crash.
+- **Roofline math** (pure, unit-tested): per device kind a peak
+  (FLOP/s, HBM bytes/s) pair from a small table —
+  ``SYNAPSEML_PEAK_FLOPS`` / ``SYNAPSEML_PEAK_BW`` override it, and
+  the snapshot records which source won — gives each signature an
+  arithmetic intensity, a compute-/memory-bound classification
+  (vs the ridge point), and an attainable roofline
+  ``min(peak_flops, AI * peak_bw)``.
+- **Achieved attribution** (:func:`achieved`): the PR-10 duty-cycle
+  pattern over the counters the executor already records — between
+  scrapes, ``executor_bucket_total{bucket=}`` deltas are attributed to
+  the cost entries at that bucket (proportional split when several
+  programs share one; the snapshot says so) and multiplied by each
+  entry's flops over the wall window: achieved FLOP/s per device kind,
+  and per entry an achieved-vs-attainable fraction. No new hot-path
+  instrumentation — the attribution is a scrape-time derivative.
+- **Read surfaces**: ``executor_signature_{flops,bytes}{signature=}``
+  and ``executor_achieved_flops_per_sec`` /
+  ``executor_roofline_fraction{device=}`` gauges (registered through
+  the same :func:`~synapseml_tpu.runtime.perfwatch.ensure_registered`
+  path as the memory gauges), ``GET /debug/cost`` (io/serving.py,
+  behind the ``SYNAPSEML_DEBUG_ENDPOINTS`` gate), cost snapshots in
+  flight-recorder dumps (runtime/blackbox.py), ``bench.py --out``'s
+  ``detail.cost``, and the offline ``tools/perf_report.py`` bottleneck
+  report.
+
+Honesty note (docs/perf.md "Roofline methodology"): XLA's cost model
+is a pre-fusion *estimate* — it counts the HLO the compiler planned,
+not the bytes the chip moved. It ranks bottlenecks and classifies
+bounds; it is not a profiler. For ground truth open a
+``profiling.trace``.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from synapseml_tpu.runtime import telemetry as _tm
+
+__all__ = [
+    "record", "ensure_registered", "snapshot", "achieved", "reset",
+    "entries", "peak_for", "classify_bound", "arithmetic_intensity",
+    "attainable_flops", "parse_cost_analysis", "parse_memory_analysis",
+    "tag_scope", "current_tag", "MAX_ENTRIES",
+]
+
+# -- peak table -------------------------------------------------------------
+# (peak FLOP/s dense bf16/f32-accum, HBM bytes/s) per device kind —
+# matched by lowercased substring so "TPU v5 lite" and "TPU v5e" both
+# land on the v5e row. Provenance: published per-chip specs (v4 275TF
+# 1.2TB/s; v5e 197TF 819GB/s; v5p 459TF 2.765TB/s; v6e 918TF
+# 1.64TB/s) — the same 197 TF/s docs/perf.md has always used for MFU.
+# The cpu row is a deliberately round placeholder for the forced-CPU
+# test platform: fractions against it mean nothing, which the
+# ``peak_source: "default"`` marker makes machine-checkable.
+_PEAK_TABLE: Tuple[Tuple[str, float, float], ...] = (
+    ("v5 lite", 197e12, 8.19e11),
+    ("v5e", 197e12, 8.19e11),
+    ("v5p", 459e12, 2.765e12),
+    ("v6e", 918e12, 1.64e12),
+    ("v6", 918e12, 1.64e12),
+    ("v4", 275e12, 1.2e12),
+    ("cpu", 1e11, 5e10),
+)
+_DEFAULT_PEAK = (1e11, 5e10)
+
+_ENV_FLOPS = "SYNAPSEML_PEAK_FLOPS"
+_ENV_BW = "SYNAPSEML_PEAK_BW"
+
+# the cost table is process-global and append-only; a runaway test
+# suite warming thousands of distinct signatures must not grow gauges
+# without bound — past the cap, entries are counted but not stored
+MAX_ENTRIES = 4096
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def peak_for(device_kind: str) -> Dict[str, Any]:
+    """``{flops_per_sec, bytes_per_sec, source}`` for one device kind.
+    Env overrides win (both knobs are independent — override only the
+    one you measured), then the kind table, then the default row."""
+    kind = (device_kind or "").lower()
+    flops = bw = None
+    source = "default"
+    for key, f, b in _PEAK_TABLE:
+        if key in kind:
+            flops, bw, source = f, b, "table"
+            break
+    if flops is None:
+        flops, bw = _DEFAULT_PEAK
+    env_f, env_b = _env_float(_ENV_FLOPS), _env_float(_ENV_BW)
+    if env_f is not None:
+        flops, source = env_f, "env"
+    if env_b is not None:
+        bw, source = env_b, "env"
+    return {"flops_per_sec": float(flops), "bytes_per_sec": float(bw),
+            "source": source}
+
+
+# -- pure roofline math -----------------------------------------------------
+
+def arithmetic_intensity(flops: float, bytes_accessed: float) -> float:
+    """FLOPs per byte moved; 0 when either side is unknown/zero (the
+    classification handles the degenerate cases explicitly)."""
+    if flops <= 0 or bytes_accessed <= 0:
+        return 0.0
+    return flops / bytes_accessed
+
+
+def classify_bound(flops: float, bytes_accessed: float,
+                   peak_flops: float, peak_bw: float) -> str:
+    """``"compute"`` / ``"memory"`` / ``"unknown"`` against the ridge
+    point ``peak_flops / peak_bw``. Degenerate programs classify by
+    whichever side exists: pure-flops (bytes 0) is compute-bound,
+    pure-movement (flops 0) is memory-bound, neither is unknown —
+    never an exception (the capture path must not be able to crash a
+    warmup)."""
+    if flops <= 0 and bytes_accessed <= 0:
+        return "unknown"
+    if bytes_accessed <= 0:
+        return "compute"
+    if flops <= 0:
+        return "memory"
+    if peak_flops <= 0 or peak_bw <= 0:
+        return "unknown"
+    ridge = peak_flops / peak_bw
+    return "compute" if flops / bytes_accessed >= ridge else "memory"
+
+
+def attainable_flops(flops: float, bytes_accessed: float,
+                     peak_flops: float, peak_bw: float) -> float:
+    """The roofline ceiling for this program's arithmetic intensity:
+    ``min(peak_flops, AI * peak_bw)`` — what a perfectly-scheduled
+    execution of the same HLO could sustain."""
+    if peak_flops <= 0:
+        return 0.0
+    ai = arithmetic_intensity(flops, bytes_accessed)
+    if ai <= 0:
+        # no byte count to bound by: the flat compute roof is all we know
+        return peak_flops
+    return min(peak_flops, ai * peak_bw)
+
+
+# -- tolerant cost/memory-analysis parsing ----------------------------------
+
+def parse_cost_analysis(ca: Any) -> Dict[str, float]:
+    """``{flops, bytes_accessed, transcendentals, output_bytes}`` from
+    whatever ``compiled.cost_analysis()`` returned — a list of
+    per-computation dicts (jax<=0.4.x) or one dict (newer), any key
+    missing. A shape this can't read yields zeros — the entry then
+    classifies ``unknown``, never raises."""
+    out = {"flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0,
+           "output_bytes": 0.0}
+    try:
+        dicts = ca if isinstance(ca, (list, tuple)) else [ca]
+        for d in dicts:
+            if not isinstance(d, dict):
+                continue
+            for key, field in (("flops", "flops"),
+                               ("bytes accessed", "bytes_accessed"),
+                               ("transcendentals", "transcendentals"),
+                               ("bytes accessedout{}", "output_bytes")):
+                try:
+                    v = float(d.get(key, 0.0) or 0.0)
+                except (TypeError, ValueError):
+                    v = 0.0
+                if v > 0:
+                    out[field] += v
+    except Exception:  # noqa: BLE001 - capture is best-effort
+        pass
+    return out
+
+
+def parse_memory_analysis(ma: Any) -> Dict[str, float]:
+    """``{argument_bytes, output_bytes, temp_bytes, code_bytes}`` from a
+    ``CompiledMemoryStats`` (attribute names pinned since jaxlib 0.4);
+    zeros wherever the surface is missing."""
+    out = {"argument_bytes": 0.0, "output_bytes": 0.0, "temp_bytes": 0.0,
+           "code_bytes": 0.0}
+    for attr, field in (("argument_size_in_bytes", "argument_bytes"),
+                        ("output_size_in_bytes", "output_bytes"),
+                        ("temp_size_in_bytes", "temp_bytes"),
+                        ("generated_code_size_in_bytes", "code_bytes")):
+        try:
+            v = float(getattr(ma, attr))
+        except Exception:  # noqa: BLE001 - field moved/absent
+            v = 0.0
+        if v > 0:
+            out[field] = v
+    return out
+
+
+# -- attribution tags -------------------------------------------------------
+# bench.py wraps each bench group in tag_scope(group) so the cost
+# entries its warmups create carry the group name — what lets
+# tools/perf_report.py join "bench group" to "compiled program" offline
+# from one artifact. Contextvar, not a global: warmups can run on
+# serving scorer threads concurrently.
+
+_TAG: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "synapseml_cost_tag", default="")
+
+
+def current_tag() -> str:
+    return _TAG.get()
+
+
+@contextlib.contextmanager
+def tag_scope(tag: str):
+    """Attribute every cost entry recorded inside the block to ``tag``."""
+    token = _TAG.set(str(tag))
+    try:
+        yield
+    finally:
+        _TAG.reset(token)
+
+
+# -- the table --------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_T0 = time.monotonic()
+
+
+class _State:
+    def __init__(self):
+        self.entries: Dict[str, Dict[str, Any]] = {}
+        self.overflow = 0  # entries dropped past MAX_ENTRIES
+        self.kinds_registered: set = set()
+        # achieved-attribution window state (the duty-cycle pattern):
+        # previous (wall, per-bucket counts) plus the evaluated values
+        # served to every gauge read inside one scrape (1s TTL)
+        self.prev: Optional[Dict[str, Any]] = None
+        self.vals: Optional[Dict[str, Any]] = None
+        self.vals_ts = 0.0
+
+
+_S = _State()
+
+
+def _sig_label(bucket: int, arity: int, layout: str, device_kind: str,
+               sig_repr: str, tag: str) -> str:
+    """Stable, human-scannable gauge label for one signature:
+    ``[tag/]b<bucket>-a<arity>-<layout>-<hash6>`` — the hash keeps two
+    different programs at the same (bucket, arity, layout) distinct."""
+    h = hashlib.sha256(
+        f"{sig_repr}|{layout}|{device_kind}|{tag}".encode()).hexdigest()[:6]
+    prefix = f"{tag}/" if tag else ""
+    return f"{prefix}b{bucket}-a{arity}-{layout}-{h}"
+
+
+def record(compiled: Any, *, bucket: int, arity: int, layout: str,
+           device_kind: str, sig: Any = None,
+           tag: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Capture one compiled signature into the cost table; returns the
+    entry (or the already-recorded one — dedup by label). Called from
+    ``BatchedExecutor.warmup`` for every ``compiled``/``loaded``
+    disposition; never raises — a signature whose analysis fails is
+    recorded with ``captured=False`` and classifies ``unknown``."""
+    try:
+        tag = current_tag() if tag is None else str(tag)
+        label = _sig_label(int(bucket), int(arity), str(layout),
+                           str(device_kind), repr(sig), tag)
+        with _LOCK:
+            got = _S.entries.get(label)
+        if got is not None:
+            return got
+
+        cost = {"flops": 0.0, "bytes_accessed": 0.0,
+                "transcendentals": 0.0, "output_bytes": 0.0}
+        mem = {"argument_bytes": 0.0, "output_bytes": 0.0,
+               "temp_bytes": 0.0, "code_bytes": 0.0}
+        captured = False
+        try:
+            cost = parse_cost_analysis(compiled.cost_analysis())
+            captured = cost["flops"] > 0 or cost["bytes_accessed"] > 0
+        except Exception:  # noqa: BLE001 - e.g. a store-deserialized
+            pass           # executable that refuses analysis
+        try:
+            mem = parse_memory_analysis(compiled.memory_analysis())
+        except Exception:  # noqa: BLE001
+            pass
+
+        peak = peak_for(device_kind)
+        entry = {
+            "signature": label,
+            "tag": tag,
+            "bucket": int(bucket),
+            "arity": int(arity),
+            "layout": str(layout),
+            "device_kind": str(device_kind),
+            "captured": captured,
+            "flops": cost["flops"],
+            "bytes_accessed": cost["bytes_accessed"],
+            "transcendentals": cost["transcendentals"],
+            "argument_bytes": mem["argument_bytes"],
+            "output_bytes": mem["output_bytes"] or cost["output_bytes"],
+            "temp_bytes": mem["temp_bytes"],
+            "arithmetic_intensity": round(arithmetic_intensity(
+                cost["flops"], cost["bytes_accessed"]), 4),
+            "bound": (classify_bound(
+                cost["flops"], cost["bytes_accessed"],
+                peak["flops_per_sec"], peak["bytes_per_sec"])
+                if captured else "unknown"),
+            "attainable_flops_per_sec": (attainable_flops(
+                cost["flops"], cost["bytes_accessed"],
+                peak["flops_per_sec"], peak["bytes_per_sec"])
+                if captured else 0.0),
+        }
+        with _LOCK:
+            if label in _S.entries:  # lost a benign race: keep the first
+                return _S.entries[label]
+            if len(_S.entries) >= MAX_ENTRIES:
+                _S.overflow += 1
+                return None
+            _S.entries[label] = entry
+        _register_entry_gauges(label)
+        _register_kind_gauges(str(device_kind))
+        return entry
+    except Exception:  # noqa: BLE001 - the observatory must never
+        return None    # break a warmup
+
+
+def _entry_field(label: str, field: str) -> float:
+    with _LOCK:
+        e = _S.entries.get(label)
+    return float(e.get(field, 0.0)) if e else 0.0
+
+
+def _register_entry_gauges(label: str):
+    _tm.gauge_fn("executor_signature_flops",
+                 lambda l=label: _entry_field(l, "flops"),
+                 signature=label)
+    _tm.gauge_fn("executor_signature_bytes",
+                 lambda l=label: _entry_field(l, "bytes_accessed"),
+                 signature=label)
+
+
+def _register_kind_gauges(kind: str):
+    with _LOCK:
+        if kind in _S.kinds_registered:
+            return
+        _S.kinds_registered.add(kind)
+    _tm.gauge_fn("executor_achieved_flops_per_sec",
+                 lambda k=kind: achieved().get(
+                     k, {}).get("achieved_flops_per_sec", 0.0),
+                 device=kind)
+    _tm.gauge_fn("executor_roofline_fraction",
+                 lambda k=kind: achieved().get(
+                     k, {}).get("roofline_fraction", 0.0),
+                 device=kind)
+
+
+def ensure_registered() -> int:
+    """Re-register every recorded entry's and device kind's gauges —
+    idempotent (``gauge_fn`` replaces samplers); called from
+    :func:`perfwatch.ensure_registered` so the cost series ride the
+    same registration path as the memory gauges. Returns the entry
+    count."""
+    with _LOCK:
+        labels = list(_S.entries)
+        kinds = {e["device_kind"] for e in _S.entries.values()}
+        _S.kinds_registered -= kinds  # force re-register below
+    for label in labels:
+        _register_entry_gauges(label)
+    for kind in kinds:
+        _register_kind_gauges(kind)
+    return len(labels)
+
+
+# -- achieved attribution (the duty-cycle window pattern) -------------------
+
+def _bucket_counts() -> Dict[str, float]:
+    """Cumulative ``executor_bucket_total`` per bucket label — the
+    series the executor's dispatch path already counts; registry-lock
+    cost only, scrape-time only."""
+    counts: Dict[str, float] = {}
+    for labels, m in _tm.series("executor_bucket_total"):
+        b = labels.get("bucket", "")
+        counts[b] = counts.get(b, 0.0) + m.value
+    return counts
+
+
+def _attribute(prev: Dict[str, Any], cur: Dict[str, Any],
+               table: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pure window math: per-bucket dispatch deltas over the wall
+    window, split evenly across the cost entries recorded at that
+    bucket (several programs can share a bucket — the split is the
+    documented approximation), times each entry's flops. Returns
+    ``{"per_kind": {kind: {...}}, "per_entry": {label: {...}}}``."""
+    d_wall = max(1e-9, cur["t"] - prev["t"])
+    deltas = {b: max(0.0, v - prev["counts"].get(b, 0.0))
+              for b, v in cur["counts"].items()}
+    by_bucket: Dict[str, List[Dict[str, Any]]] = {}
+    for e in table:
+        by_bucket.setdefault(str(e["bucket"]), []).append(e)
+    per_entry: Dict[str, Dict[str, float]] = {}
+    per_kind: Dict[str, Dict[str, float]] = {}
+    for b, delta in deltas.items():
+        group = by_bucket.get(b)
+        if not group or delta <= 0:
+            continue
+        share = delta / len(group)
+        for e in group:
+            rate = share / d_wall
+            ach = e["flops"] * rate
+            attainable = e.get("attainable_flops_per_sec", 0.0)
+            per_entry[e["signature"]] = {
+                "dispatch_rate_per_sec": round(rate, 4),
+                "achieved_flops_per_sec": ach,
+                "achieved_fraction": (round(ach / attainable, 6)
+                                      if attainable > 0 else 0.0),
+            }
+            kind = per_kind.setdefault(e["device_kind"], {
+                "achieved_flops_per_sec": 0.0,
+                "achieved_bytes_per_sec": 0.0})
+            kind["achieved_flops_per_sec"] += ach
+            kind["achieved_bytes_per_sec"] += e["bytes_accessed"] * rate
+    for kind, vals in per_kind.items():
+        peak = peak_for(kind)
+        vals["roofline_fraction"] = (
+            round(vals["achieved_flops_per_sec"]
+                  / peak["flops_per_sec"], 6)
+            if peak["flops_per_sec"] > 0 else 0.0)
+    return {"per_kind": per_kind, "per_entry": per_entry,
+            "window_seconds": round(d_wall, 3)}
+
+
+def achieved(force: bool = False) -> Dict[str, Any]:
+    """``{device_kind: {achieved_flops_per_sec, achieved_bytes_per_sec,
+    roofline_fraction}}`` over the window since the previous
+    evaluation — TTL-cached (1s) so the many gauge reads of one scrape
+    share a single window, and the whole check-evaluate-advance runs
+    under the lock (two racing TTL-missed readers must not both
+    advance the window — the perfwatch duty-cycle comment applies
+    verbatim)."""
+    with _LOCK:
+        now = time.monotonic()
+        if not force and _S.vals is not None and now - _S.vals_ts < 1.0:
+            return _S.vals["per_kind"]
+        cur = {"t": now, "counts": _bucket_counts()}
+        prev = _S.prev or {"t": _T0, "counts": {}}
+        table = list(_S.entries.values())
+        vals = _attribute(prev, cur, table)
+        _S.prev = cur
+        _S.vals = vals
+        _S.vals_ts = now
+        return vals["per_kind"]
+
+
+def entries() -> List[Dict[str, Any]]:
+    with _LOCK:
+        return [dict(e) for e in _S.entries.values()]
+
+
+def snapshot(force: bool = False) -> Dict[str, Any]:
+    """The ``GET /debug/cost`` payload (and the shape ``bench.py
+    --out`` embeds under ``detail.cost``): the per-signature table with
+    the current window's achieved attribution folded in, the peak
+    provenance per device kind, and the attribution caveats spelled
+    out so an offline reader (tools/perf_report.py) needs no other
+    context."""
+    achieved(force=force)  # refresh/advance the shared window
+    with _LOCK:
+        window = _S.vals or {"per_kind": {}, "per_entry": {},
+                             "window_seconds": 0.0}
+        table = [dict(e) for e in _S.entries.values()]
+        overflow = _S.overflow
+    per_entry = window["per_entry"]
+    for e in table:
+        e.update(per_entry.get(e["signature"], {
+            "dispatch_rate_per_sec": 0.0,
+            "achieved_flops_per_sec": 0.0,
+            "achieved_fraction": 0.0}))
+    kinds = sorted({e["device_kind"] for e in table})
+    return {
+        "ts": round(time.time(), 6),
+        "pid": os.getpid(),
+        "entries": sorted(table, key=lambda e: e["signature"]),
+        "per_kind": window["per_kind"],
+        "window_seconds": window["window_seconds"],
+        "peaks": {k: peak_for(k) for k in kinds},
+        "attribution": "bucket-proportional",  # even split per bucket
+        "overflow_dropped": overflow,
+        "note": ("XLA cost model: pre-fusion estimate, not measured "
+                 "hardware counters (docs/perf.md 'Roofline "
+                 "methodology')"),
+    }
+
+
+def reset() -> int:
+    """Tests/teardown: drop every entry and unregister every gauge this
+    module registered, so a scrape after reset carries no cost series.
+    Returns the number of entries dropped."""
+    with _LOCK:
+        labels = list(_S.entries)
+        kinds = set(_S.kinds_registered)
+        _S.entries.clear()
+        _S.kinds_registered.clear()
+        _S.overflow = 0
+        _S.prev = None
+        _S.vals = None
+        _S.vals_ts = 0.0
+    for label in labels:
+        _tm.unregister("executor_signature_flops", signature=label)
+        _tm.unregister("executor_signature_bytes", signature=label)
+    for kind in kinds:
+        _tm.unregister("executor_achieved_flops_per_sec", device=kind)
+        _tm.unregister("executor_roofline_fraction", device=kind)
+    return len(labels)

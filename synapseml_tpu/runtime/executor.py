@@ -55,6 +55,7 @@ import numpy as np
 
 from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import compile_cache as _cc
+from synapseml_tpu.runtime import costmodel as _cm
 from synapseml_tpu.runtime import faults as _flt
 from synapseml_tpu.runtime import perfwatch as _pw
 from synapseml_tpu.runtime import telemetry as _tm
@@ -1349,6 +1350,8 @@ class BatchedExecutor:
                                 self._aot[aot_key] = compiled
                             entry["status"] = "loaded"
                             self._note_warm_sig(sig, mask)
+                            entry["cost_captured"] = self._record_cost(
+                                compiled, bucket, sig, store_layout)
                             report.entries.append(entry)
                             continue
                     sds = [jax.ShapeDtypeStruct(s, jnp.dtype(d),
@@ -1368,6 +1371,8 @@ class BatchedExecutor:
                         self._aot[aot_key] = compiled
                     entry["status"] = "compiled"
                     self._note_warm_sig(sig, mask)
+                    entry["cost_captured"] = self._record_cost(
+                        compiled, bucket, sig, store_layout)
                     if skey is not None:
                         entry["persisted"] = self._store.save(skey, compiled)
                 except Exception as e:  # noqa: BLE001 - degrade to lazy jit
@@ -1382,6 +1387,21 @@ class BatchedExecutor:
         with self._tables_lock:
             self._warmed = True
         return report
+
+    def _record_cost(self, compiled: Any, bucket: int, sig: tuple,
+                     store_layout: str) -> bool:
+        """Fold one warmed executable into the roofline cost table
+        (runtime/costmodel.py) — flops/bytes from XLA's own compiled
+        cost model, captured HERE because warmup is the one moment the
+        ``Compiled`` object is in hand and the serving path is not yet
+        live (zero hot-path cost; the capture is trivial next to the
+        compile that just happened). Store-deserialized executables
+        are captured too — they may refuse analysis, which degrades to
+        an ``unknown``-bound entry, never an error."""
+        rec = _cm.record(compiled, bucket=bucket, arity=len(sig),
+                         layout=store_layout,
+                         device_kind=self._device_kind(), sig=sig)
+        return bool(rec and rec.get("captured"))
 
     def _note_warm_sig(self, sig: tuple, mask: Tuple[bool, ...]):
         """Record one warmed signature for the recompile sentinel's

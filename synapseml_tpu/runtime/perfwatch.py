@@ -54,6 +54,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from synapseml_tpu.runtime import blackbox as _bb
+from synapseml_tpu.runtime import costmodel as _cm
 from synapseml_tpu.runtime import telemetry as _tm
 
 __all__ = [
@@ -397,8 +398,13 @@ def ensure_registered(lazy: bool = False) -> bool:
 
     The ``process_*`` self-telemetry gauges register unconditionally —
     they read /proc, not jax, so even a jax-free front-end (and the
-    fleet controller watching it) gets RSS/fd/thread/uptime series."""
+    fleet controller watching it) gets RSS/fd/thread/uptime series.
+    The roofline cost series (runtime/costmodel.py) re-register here
+    too — same registration path, so a process that re-enters after a
+    telemetry reset gets its ``executor_signature_*`` /
+    ``executor_roofline_fraction`` samplers back."""
     ensure_process_registered()
+    _cm.ensure_registered()
     if _S.registered:
         return True
     if lazy and not _jax_initialized():

@@ -1,0 +1,379 @@
+"""Roofline cost observatory (runtime/costmodel.py,
+tools/perf_report.py; docs/perf.md "Roofline methodology",
+docs/observability.md "Roofline cost observatory"): cost-table capture
+at warmup on the forced-8-device platform, the pure roofline math
+against hand-computed fixtures, bound-classification edge cases,
+/debug/cost live + gated, gauge registration lifecycle, and the
+perf_report CLI contract.
+
+Discipline matches tests/test_perfwatch.py: the cost table is
+process-global, so every test scopes its entries with a unique
+``tag_scope`` and the autouse fixture resets the table — this file
+runs inside tools/ci/smoke_pipeline.sh's wall clock.
+"""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from synapseml_tpu.io.serving import WorkerServer
+from synapseml_tpu.runtime import blackbox as bb
+from synapseml_tpu.runtime import costmodel as cm
+from synapseml_tpu.runtime import telemetry as tm
+from synapseml_tpu.runtime.executor import BatchedExecutor
+
+HARD = 30.0
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_table():
+    """The cost table is process-global; each test starts empty and
+    leaves nothing registered (other suites' scrapes must not see this
+    file's synthetic signatures)."""
+    cm.reset()
+    yield
+    cm.reset()
+
+
+def _get(url, timeout=HARD):
+    try:
+        with urllib.request.urlopen(
+                urllib.request.Request(url), timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- capture ----------------------------------------------------------------
+
+def test_warmup_captures_cost_entries():
+    with cm.tag_scope("t_capture"):
+        ex = BatchedExecutor(lambda x: (x @ x.T,), min_bucket=8)
+        rep = ex.warmup([((16,), np.float32)], buckets=[8, 16])
+    assert rep.compiled == 2
+    assert all(e.get("cost_captured") for e in rep.entries)
+    mine = [e for e in cm.entries() if e["tag"] == "t_capture"]
+    assert {e["bucket"] for e in mine} == {8, 16}
+    for e in mine:
+        # 2*N*N*16 madd flops for (N,16)@(16,N): the ledger is XLA's,
+        # so only sanity-bound it — positive and scaling with N^2
+        assert e["flops"] > 0 and e["bytes_accessed"] > 0
+        assert e["arity"] == 1 and e["layout"] == "single"
+        assert e["device_kind"] == "cpu"
+        assert e["captured"] is True
+        assert e["bound"] in ("compute", "memory")
+        assert e["attainable_flops_per_sec"] > 0
+    by_bucket = {e["bucket"]: e for e in mine}
+    assert by_bucket[16]["flops"] > by_bucket[8]["flops"]
+
+
+def test_warmup_capture_multidevice_shard_layout():
+    # 8 virtual devices (conftest): a dp-shardable bucket compiles once
+    # against the mesh and its cost entry carries the shard layout
+    devs = jax.local_devices()
+    assert len(devs) == 8, "forced-8-device platform required"
+    with cm.tag_scope("t_shard"):
+        ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=8,
+                             devices="all")
+        ex.warmup([((4,), np.float32)], buckets=[16])
+    mine = [e for e in cm.entries() if e["tag"] == "t_shard"]
+    assert len(mine) == 1 and mine[0]["layout"] == "shard"
+
+
+def test_record_dedupes_by_signature():
+    with cm.tag_scope("t_dedupe"):
+        ex = BatchedExecutor(lambda x: (x + 1.0,), min_bucket=8)
+        ex.warmup([((4,), np.float32)], buckets=[8])
+        before = [e for e in cm.entries() if e["tag"] == "t_dedupe"]
+        ex.warmup([((4,), np.float32)], buckets=[8])  # warm -> no-op
+        after = [e for e in cm.entries() if e["tag"] == "t_dedupe"]
+    assert len(before) == len(after) == 1
+
+
+def test_record_tolerates_broken_cost_analysis():
+    class Refuses:
+        def cost_analysis(self):
+            raise RuntimeError("deserialized executable")
+
+        def memory_analysis(self):
+            raise RuntimeError("nope")
+
+    e = cm.record(Refuses(), bucket=8, arity=1, layout="single",
+                  device_kind="cpu", sig="s1", tag="t_broken")
+    assert e is not None and e["captured"] is False
+    assert e["bound"] == "unknown"
+    assert e["flops"] == 0.0 and e["bytes_accessed"] == 0.0
+
+
+def test_record_tolerates_missing_cost_keys():
+    class Empty:
+        def cost_analysis(self):
+            return [{}]  # jax's list-of-dicts shape, no keys
+
+        def memory_analysis(self):
+            return object()  # no *_size_in_bytes attrs
+
+    e = cm.record(Empty(), bucket=8, arity=1, layout="single",
+                  device_kind="cpu", sig="s2", tag="t_missing")
+    assert e is not None and e["captured"] is False
+    assert e["bound"] == "unknown"
+
+
+# -- pure roofline math -----------------------------------------------------
+
+def test_roofline_math_hand_fixture():
+    # flops=100, bytes=10 -> AI 10; peak (100 F/s, 5 B/s) -> ridge 20:
+    # AI below the ridge is memory-bound, attainable = 10*5 = 50
+    assert cm.arithmetic_intensity(100, 10) == 10.0
+    assert cm.classify_bound(100, 10, 100, 5) == "memory"
+    assert cm.attainable_flops(100, 10, 100, 5) == 50.0
+    # AI 40 >= ridge 20 -> compute-bound, attainable clamps at peak
+    assert cm.classify_bound(400, 10, 100, 5) == "compute"
+    assert cm.attainable_flops(400, 10, 100, 5) == 100.0
+
+
+def test_bound_classification_edge_cases():
+    # pure flops (zero bytes) -> compute; pure movement -> memory;
+    # neither -> unknown; broken peak -> unknown — never an exception
+    assert cm.classify_bound(10, 0, 100, 5) == "compute"
+    assert cm.classify_bound(0, 10, 100, 5) == "memory"
+    assert cm.classify_bound(0, 0, 100, 5) == "unknown"
+    assert cm.classify_bound(10, 10, 0, 5) == "unknown"
+    assert cm.arithmetic_intensity(0, 10) == 0.0
+    assert cm.arithmetic_intensity(10, 0) == 0.0
+    # no byte ledger: the flat compute roof is all we know
+    assert cm.attainable_flops(10, 0, 100, 5) == 100.0
+    assert cm.attainable_flops(0, 0, 0, 0) == 0.0
+
+
+def test_parse_cost_analysis_shapes():
+    good = [{"flops": 8.0, "bytes accessed": 4.0, "transcendentals": 1.0,
+             "bytes accessedout{}": 2.0}]
+    got = cm.parse_cost_analysis(good)
+    assert got == {"flops": 8.0, "bytes_accessed": 4.0,
+                   "transcendentals": 1.0, "output_bytes": 2.0}
+    # dict (newer jax), junk values, junk shapes: zeros, no raise
+    assert cm.parse_cost_analysis({"flops": 8.0})["flops"] == 8.0
+    assert cm.parse_cost_analysis({"flops": "x"})["flops"] == 0.0
+    assert cm.parse_cost_analysis(None)["flops"] == 0.0
+    assert cm.parse_cost_analysis(["junk", 3])["flops"] == 0.0
+
+
+def test_peak_table_and_env_overrides(monkeypatch):
+    monkeypatch.delenv("SYNAPSEML_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("SYNAPSEML_PEAK_BW", raising=False)
+    v5e = cm.peak_for("TPU v5 lite")
+    assert v5e["flops_per_sec"] == 197e12 and v5e["source"] == "table"
+    assert cm.peak_for("TPU v4")["flops_per_sec"] == 275e12
+    assert cm.peak_for("never-heard-of-it")["source"] == "default"
+    monkeypatch.setenv("SYNAPSEML_PEAK_FLOPS", "123e9")
+    got = cm.peak_for("TPU v5 lite")
+    assert got["flops_per_sec"] == 123e9 and got["source"] == "env"
+    assert got["bytes_per_sec"] == 8.19e11  # only the set axis moves
+    monkeypatch.setenv("SYNAPSEML_PEAK_FLOPS", "garbage")
+    assert cm.peak_for("TPU v5 lite")["source"] == "table"  # ignored
+
+
+# -- achieved attribution ---------------------------------------------------
+
+def test_achieved_attribution_pure_window_math():
+    table = [{"signature": "sA", "bucket": 8, "flops": 100.0,
+              "bytes_accessed": 50.0, "device_kind": "cpu",
+              "attainable_flops_per_sec": 1000.0},
+             {"signature": "sB", "bucket": 8, "flops": 300.0,
+              "bytes_accessed": 50.0, "device_kind": "cpu",
+              "attainable_flops_per_sec": 1000.0}]
+    prev = {"t": 0.0, "counts": {"8": 0.0}}
+    cur = {"t": 2.0, "counts": {"8": 8.0}}  # 8 dispatches over 2s
+    out = cm._attribute(prev, cur, table)
+    # bucket-proportional even split: 4 dispatches each over 2s = 2/s
+    a = out["per_entry"]["sA"]
+    assert a["dispatch_rate_per_sec"] == 2.0
+    assert a["achieved_flops_per_sec"] == 200.0
+    assert a["achieved_fraction"] == 0.2
+    # per-kind sums both entries: 200 + 600 = 800 F/s
+    assert out["per_kind"]["cpu"]["achieved_flops_per_sec"] == 800.0
+    assert out["window_seconds"] == 2.0
+
+
+def test_achieved_moves_with_real_dispatches():
+    with cm.tag_scope("t_ach"):
+        ex = BatchedExecutor(lambda x: (x @ x.T,), min_bucket=8)
+        ex.warmup([((16,), np.float32)], buckets=[8])
+        cm.achieved(force=True)  # pin the window start
+        ex(np.ones((8, 16), np.float32))
+        got = cm.achieved(force=True)
+    assert got.get("cpu", {}).get("achieved_flops_per_sec", 0.0) > 0
+
+
+# -- read surfaces ----------------------------------------------------------
+
+def test_gauges_register_on_warmup_and_unregister_on_reset():
+    with cm.tag_scope("t_gauges"):
+        ex = BatchedExecutor(lambda x: (x * 3.0,), min_bucket=8)
+        ex.warmup([((4,), np.float32)], buckets=[8])
+    text = tm.prometheus_text()
+    assert "synapseml_executor_signature_flops{signature=\"t_gauges/" \
+        in text
+    assert "synapseml_executor_signature_bytes{signature=\"t_gauges/" \
+        in text
+    assert 'synapseml_executor_achieved_flops_per_sec{device="cpu"}' \
+        in text
+    assert 'synapseml_executor_roofline_fraction{device="cpu"}' in text
+    dropped = cm.reset()
+    assert dropped >= 1
+    text = tm.prometheus_text()
+    assert "executor_signature_flops" not in text
+    assert "executor_roofline_fraction" not in text
+
+
+def test_snapshot_shape_and_flight_recorder_fold():
+    with cm.tag_scope("t_snap"):
+        ex = BatchedExecutor(lambda x: (x + 1.0,), min_bucket=8)
+        ex.warmup([((4,), np.float32)], buckets=[8])
+    snap = cm.snapshot(force=True)
+    assert snap["attribution"] == "bucket-proportional"
+    assert "cpu" in snap["peaks"]
+    mine = [e for e in snap["entries"] if e["tag"] == "t_snap"]
+    assert len(mine) == 1
+    assert {"achieved_fraction", "dispatch_rate_per_sec",
+            "bound"} <= set(mine[0])
+    # flight-recorder dumps carry the table (docs/observability.md)
+    flight = bb.snapshot(stacks=False)
+    assert "cost" in flight and "entries" in flight["cost"]
+
+
+def test_debug_cost_endpoint_live_and_gated(monkeypatch):
+    with cm.tag_scope("t_endpoint"):
+        ex = BatchedExecutor(lambda x: (x * 2.0,), min_bucket=8)
+        ex.warmup([((4,), np.float32)], buckets=[8])
+    srv = WorkerServer("cost_dbg")
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        st, body = _get(f"{base}/debug/cost")
+        assert st == 200
+        snap = json.loads(body)
+        assert any(e["tag"] == "t_endpoint" for e in snap["entries"])
+        assert {"peaks", "attribution", "per_kind"} <= set(snap)
+        monkeypatch.setenv("SYNAPSEML_DEBUG_ENDPOINTS", "0")
+        st, _body = _get(f"{base}/debug/cost")
+        assert st == 403
+    finally:
+        srv.stop()
+
+
+def test_overflow_cap_never_grows_unbounded(monkeypatch):
+    monkeypatch.setattr(cm, "MAX_ENTRIES", 2)
+
+    class Fake:
+        def cost_analysis(self):
+            return [{"flops": 1.0, "bytes accessed": 1.0}]
+
+        def memory_analysis(self):
+            raise RuntimeError
+
+    for i in range(4):
+        cm.record(Fake(), bucket=8, arity=1, layout="single",
+                  device_kind="cpu", sig=f"s{i}", tag="t_cap")
+    snap = cm.snapshot(force=True)
+    assert len(snap["entries"]) == 2
+    assert snap["overflow_dropped"] == 2
+
+
+# -- perf_report CLI --------------------------------------------------------
+
+def _payload(with_cost=True, group_kind="device"):
+    cost = {"entries": [], "peaks": {}, "attribution":
+            "bucket-proportional"}
+    if with_cost:
+        cost["entries"] = [{
+            "signature": "g/b8-a1-single-abc123", "tag": "g",
+            "bucket": 8, "arity": 1, "layout": "single",
+            "device_kind": "cpu", "captured": True, "flops": 800.0,
+            "bytes_accessed": 80.0, "transcendentals": 0.0,
+            "argument_bytes": 32.0, "output_bytes": 32.0,
+            "temp_bytes": 0.0, "arithmetic_intensity": 10.0,
+            "bound": "memory", "attainable_flops_per_sec": 1e6,
+            "achieved_fraction": 0.0, "dispatch_rate_per_sec": 0.0,
+            "achieved_flops_per_sec": 0.0}]
+        cost["peaks"] = {"cpu": {"flops_per_sec": 1e11,
+                                 "bytes_per_sec": 5e10,
+                                 "source": "default"}}
+    return {
+        "metric": "g_rows_per_sec", "value": 100.0, "unit": "rows/sec",
+        "group": "g", "secondary": [],
+        "detail": {"cost": cost,
+                   "bench_groups": {"g": {"kind": group_kind,
+                                          "description": "test group"}}},
+    }
+
+
+def _run_report(tmp_path, payload, *extra):
+    from tools import perf_report
+
+    src = tmp_path / "bench.json"
+    src.write_text(json.dumps(payload))
+    out = tmp_path / "report.md"
+    rc = perf_report.main([str(src), "--out", str(out), *extra])
+    return rc, out
+
+
+def test_perf_report_exit_0_and_report_content(tmp_path):
+    rc, out = _run_report(tmp_path, _payload(), "--check")
+    assert rc == 0
+    md = out.read_text()
+    assert "# Bench bottleneck report" in md
+    assert "| 1 | g | memory |" in md
+    # achieved = 100 rows/s * 100 flops/row = 1e4; frac = 1e4/1e6
+    assert "1.00%" in md
+    assert "g/b8-a1-single-abc123" in md
+
+
+def test_perf_report_exit_2_on_unattributed_group(tmp_path):
+    rc, out = _run_report(tmp_path, _payload(with_cost=False))
+    assert rc == 2
+    assert "UNATTRIBUTED" in out.read_text()
+
+
+def test_perf_report_host_group_needs_no_signature(tmp_path):
+    rc, _out = _run_report(
+        tmp_path, _payload(with_cost=False, group_kind="host"),
+        "--check")
+    assert rc == 0
+
+
+def test_perf_report_exit_1_on_usage():
+    from tools import perf_report
+
+    assert perf_report.main(["/nonexistent/bench.json"]) == 1
+    with pytest.raises(SystemExit) as exc:
+        perf_report.main([])  # missing positional -> usage error
+    assert exc.value.code == 1
+
+
+def test_perf_report_exit_1_on_non_bench_payload(tmp_path):
+    src = tmp_path / "junk.json"
+    src.write_text(json.dumps({"not": "a bench payload"}))
+    from tools import perf_report
+
+    assert perf_report.main([str(src)]) == 1
+
+
+def test_bench_list_prints_descriptions_and_metrics(capsys):
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(ROOT)
+    assert bench.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "serving_roundtrip_p50_ms" in out  # measured metric names
+    assert "echo round trip" in out           # one-line description
+    assert "[host]" in out and "[device]" in out

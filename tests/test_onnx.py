@@ -1859,7 +1859,18 @@ def test_qoperator_contrib_family():
         gi = import_model(g.to_bytes())
         got = np.asarray(jax.jit(gi.apply)(gi.params, jnp.asarray(a))[0])
         want = q(fn(dq(a, sa, za), dq(b, sb, zb)), sc, zc)
-        np.testing.assert_array_equal(got, want, err_msg=op_name)
+        # <=1 LSB, not bit-exact: under jit XLA rewrites the requant's
+        # constant-divisor division (v / y_scale) into a multiply by
+        # the reciprocal, which perturbs EXACT-TIE quotients (v/s =
+        # n + 0.5 — e.g. 22.5, -12.5 in this fixture) by 1 ulp, so
+        # round-half-to-even lands 1 LSB away from numpy's
+        # true-division reference; unjitted jax matches numpy
+        # element-exactly. ORT's own QLinear kernels promise the same
+        # <=1 LSB (importer.py "matches ORT's lookup-table kernels"),
+        # and the sigmoid/leakyrelu assertions below already use it —
+        # assert that contract here too: never >1 off, ties rare.
+        diff = np.abs(got.astype(int) - want.astype(int))
+        assert diff.max() <= 1 and (diff == 0).mean() > 0.9, op_name
 
     # QLinearSigmoid + QLinearLeakyRelu + QLinearGlobalAveragePool
     x = rng.integers(0, 255, (2, 5, 6, 6)).astype(np.uint8)

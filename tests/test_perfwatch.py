@@ -550,10 +550,10 @@ def test_bench_group_selection_honors_caller_order():
     import bench
 
     sel = bench._select_groups(["cold_start", "serving", "cold_start"])
-    assert [name for name, _fn in sel] == ["cold_start", "serving"]
+    assert [g.name for g in sel] == ["cold_start", "serving"]
     # the default full run keeps registry order (resnet50 headline)
-    full = bench._select_groups([n for n, _f in bench.BENCH_GROUPS])
-    assert [n for n, _f in full][0] == "resnet50"
+    full = bench._select_groups([g.name for g in bench.BENCH_GROUPS])
+    assert [g.name for g in full][0] == "resnet50"
 
 
 def test_bench_check_write_baseline_roundtrip(tmp_path):
@@ -620,10 +620,15 @@ def test_duty_cycles_ttl_serves_one_window():
 def test_bench_groups_fast_subset_is_valid():
     import bench
 
-    names = [name for name, _fn in bench.BENCH_GROUPS]
+    names = [g.name for g in bench.BENCH_GROUPS]
     assert len(names) == len(set(names))
     assert set(bench.FAST_GROUPS) < set(names)
     assert names[0] == "resnet50"  # the headline group stays first
+    # round-15 registry metadata: every group carries the description
+    # + metric names --list prints and the kind perf_report keys on
+    for g in bench.BENCH_GROUPS:
+        assert g.kind in ("device", "host")
+        assert g.describe and g.metrics
 
 
 # -- donation-warning hygiene (ISSUE-10 satellite) --------------------------

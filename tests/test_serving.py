@@ -327,30 +327,51 @@ def test_distributed_server_replay_and_ownership():
 
 def test_pipelined_scoring_overlaps_device_time():
     """The two-stage pipeline + N scoring workers must overlap batch
-    collection AND scoring: with a 40 ms 'device' and max_batch=4, eight
-    open-loop requests take ~2 overlapped rounds pipelined vs ~2x that
-    strictly serial. Also asserts the adaptive path commits every merged
-    epoch (no request is left replayable after its reply)."""
-    calls = []
+    collection AND scoring. Deflaked (round 15): the original
+    assertion compared WALL CLOCKS (pipelined < 0.8 x serial,
+    best-of-2 per leg) — it tolerated the race that an oversubscribed
+    2-core CI box's scheduler can stall the pipelined leg's second
+    scorer thread past the margin, so both legs' best runs could land
+    on load spikes and invert the ratio. The overlap is now observed
+    EVENT-DRIVEN inside the scorer itself: the pipelined leg must
+    reach >=2 concurrent pipeline_fn calls (two micro-batches
+    genuinely in flight at once — the architectural claim), the
+    serial leg must never exceed 1 (proof the comparison leg is
+    actually serial). Concurrency inside a 100 ms sleep window is
+    immune to absolute wall time; the only residual assumption is
+    that worker pickup skew stays under the 100 ms 'device' time.
+    Also asserts the adaptive path commits every merged epoch (no
+    request is left replayable after its reply)."""
+    state = {"active": 0, "max_active": 0}
+    state_lock = threading.Lock()
 
     def slow_pipeline(table: Table) -> Table:
-        calls.append(table.num_rows)
+        with state_lock:
+            state["active"] += 1
+            state["max_active"] = max(state["max_active"],
+                                      state["active"])
         # 100ms "device": large vs the tens-of-ms scheduler jitter an
-        # oversubscribed 2-core CI box injects, so the ratio assert
-        # below measures architecture, not the OS run queue
+        # oversubscribed CI box injects, so two in-flight batches
+        # reliably coexist inside the window
         time.sleep(0.1)
+        with state_lock:
+            state["active"] -= 1
         replies = np.empty(table.num_rows, dtype=object)
         for i in range(table.num_rows):
             replies[i] = make_reply({"ok": True})
         return table.with_column("reply", replies)
 
-    def run(pipelined, rep):
-        name = f"t_overlap_{pipelined}_{rep}"
+    def run(pipelined):
+        name = f"t_overlap_{pipelined}"
+        with state_lock:
+            state["active"] = 0
+            state["max_active"] = 0
         # linger 20ms + a client barrier: the 8 posts land near-
         # simultaneously and coalesce into exactly two micro-batches
         # even when thread startup is staggered by a loaded CI box —
-        # ragged arrival would split them into 3-4 batches and charge
-        # the pipelined leg an extra device round
+        # ragged arrival would split them into 3-4 batches, which the
+        # concurrency assert tolerates (any 2 batches overlapping is
+        # enough) where the old wall-ratio did not
         cs = ContinuousServer(name, slow_pipeline, max_batch=4,
                               batch_linger=0.02, pipelined=pipelined,
                               scoring_workers=2).start()
@@ -365,27 +386,25 @@ def test_pipelined_scoring_overlaps_device_time():
 
             threads = [threading.Thread(target=client, args=(i,))
                        for i in range(8)]
-            t0 = time.perf_counter()
             for t in threads:
                 t.start()
             for t in threads:
                 t.join(timeout=30)
-            wall = time.perf_counter() - t0
             assert all(r is not None and r[0] == 200 for r in results)
             # every drained epoch was committed -> nothing replayable
             assert cs.server.recover() == 0
-            return wall
+            with state_lock:
+                return state["max_active"]
         finally:
             cs.stop()
 
-    # best-of-2 per leg: a single background-load spike on a shared CI
-    # box cannot decide the comparison
-    wall_serial = min(run(False, r) for r in range(2))
-    wall_pipe = min(run(True, r) for r in range(2))
-    # serial: >=2 rounds of (linger + 100ms) strictly one at a time;
-    # pipelined: two 100ms rounds in flight concurrently. Generous
-    # margin so scheduler jitter can't flake the assertion.
-    assert wall_pipe < wall_serial * 0.8, (wall_pipe, wall_serial)
+    serial_conc = run(False)
+    pipe_conc = run(True)
+    # serial: one loop thread collects AND scores — structurally never
+    # two pipeline_fn calls at once; pipelined + 2 scoring workers:
+    # both micro-batches score inside the same 100 ms window
+    assert serial_conc == 1, serial_conc
+    assert pipe_conc >= 2, pipe_conc
 
 
 def test_reply_send_runs_off_the_scoring_thread():

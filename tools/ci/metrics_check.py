@@ -72,6 +72,14 @@ CORE_SERIES = [
     "synapseml_device_hbm_bytes_in_use",
     "synapseml_device_hbm_peak_bytes",
     "synapseml_device_live_buffer_count",
+    # roofline cost observatory (runtime/costmodel.py): per-signature
+    # flops/bytes gauges + per-device-kind achieved/roofline — all
+    # register at warmup() time (the ex.warmup below), sampled at
+    # scrape time only
+    "synapseml_executor_signature_flops",
+    "synapseml_executor_signature_bytes",
+    "synapseml_executor_achieved_flops_per_sec",
+    "synapseml_executor_roofline_fraction",
 ]
 
 # the breaker/failover/drain surface (docs/robustness.md, PR 8): these
@@ -275,6 +283,40 @@ def main() -> int:
             print("/debug/memory device records miss bytes_in_use")
             return 1
 
+        # roofline cost surface (runtime/costmodel.py): /debug/cost
+        # serves the per-signature table LIVE mid-run — the warmed
+        # 2-feature signature must be present with a captured
+        # flops/bytes ledger and a bound classification, and the
+        # payload must carry the peak-provenance + attribution notes
+        # perf_report relies on offline
+        conn.request("GET", "/debug/cost")
+        resp = conn.getresponse()
+        cost = json.loads(resp.read())
+        assert resp.status == 200, resp.status
+        for key in ("entries", "peaks", "attribution", "per_kind"):
+            if key not in cost:
+                print(f"/debug/cost payload missing {key!r}: "
+                      f"{sorted(cost)}")
+                return 1
+        if not cost["entries"]:
+            print("/debug/cost has no cost-table entries after warmup")
+            return 1
+        ent = cost["entries"][0]
+        need_fields = {"signature", "flops", "bytes_accessed", "bound",
+                       "achieved_fraction", "attainable_flops_per_sec"}
+        if not need_fields <= set(ent):
+            print(f"/debug/cost entry missing fields: "
+                  f"{sorted(need_fields - set(ent))}")
+            return 1
+        if not any(e.get("captured") and e.get("flops", 0) > 0
+                   for e in cost["entries"]):
+            print("/debug/cost: no entry captured a flops ledger")
+            return 1
+        if any(e.get("bound") not in ("compute", "memory", "unknown")
+               for e in cost["entries"]):
+            print("/debug/cost: invalid bound classification")
+            return 1
+
         # the span surface answers for a real completed request
         conn.request("GET", f"/span/{rid}")
         resp = conn.getresponse()
@@ -292,6 +334,7 @@ def main() -> int:
               f"{series_total(second, 'synapseml_serving_requests_total'):.0f},",
               f"recompiles={recompiles_after:.0f},",
               f"memory devices={len(mem['devices'])},",
+              f"cost signatures={len(cost['entries'])},",
               f"span stages={sorted(stages)}")
     finally:
         cs.stop()

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Roofline cost-observatory gate (docs/perf.md "Roofline methodology").
+#
+# Runs the bounded `bench.py --fast` subset with cost capture live,
+# then asserts tools/perf_report.py can attribute EVERY selected bench
+# group from the one committed-shaped artifact: a flops/bytes cost
+# signature per device group (captured at warmup from XLA's own
+# compiled cost model, runtime/costmodel.py), a compute/memory-bound
+# class, an achieved-vs-roofline fraction, and a complete report
+# schema (--check exits 2 on any unattributed group). A wedged bench
+# or report HANGS rather than fails, so the hard wall-clock timeout
+# turns it into a fast red X (exit 124) instead of a stuck job.
+#
+# Usage: tools/ci/smoke_perf_report.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+out="$(mktemp /tmp/bench_cost_XXXXXX.json)"
+report="${out%.json}.md"
+trap 'rm -f "$out" "$report"' EXIT
+timeout -k 10 "${SMOKE_TIMEOUT:-600}" \
+  python bench.py --fast --out "$out" > /dev/null
+timeout -k 10 60 \
+  python tools/perf_report.py "$out" --check --out "$report"
+# the report is a real artifact, not just an exit code: show the
+# ranked table so the CI log answers "what is the bottleneck" directly
+sed -n '/## Ranked bottlenecks/,/## Per-group/p' "$report" | head -20

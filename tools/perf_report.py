@@ -1,0 +1,384 @@
+"""Automated bottleneck report: bench results x the roofline cost table.
+
+Consumes ONE artifact — a ``bench.py --out`` JSON whose ``detail.cost``
+embeds the roofline cost-table snapshot (runtime/costmodel.py) — and
+emits a ranked markdown report answering, per bench group: what did it
+achieve, what could its compiled program attain on this device's
+roofline, which bound is it at, and what is the implied lever. This is
+the machine-checked form of the "which signature is the bottleneck"
+question the ROADMAP's next perf items (Pallas traversal kernel, int8
+lane, TP serving) are judged against.
+
+Attribution model (documented caveats, in the report itself):
+
+- Each bench group's warmups run inside ``costmodel.tag_scope(group)``,
+  so its compiled signatures carry the group name; the report joins on
+  that tag. The *representative* signature is the tagged entry with the
+  most flops (the big-bucket program dominates the group's wall time).
+- Achieved FLOP/s = metric rate x flops-per-item, where flops-per-item
+  is the representative entry's flops over its bucket (rows == items
+  for every throughput metric we emit). Latency (ms) metrics convert
+  through ``rate = 1000/value``; one-shot wall metrics (cold start)
+  carry an achieved fraction of 0 by construction — their lever is the
+  compile cache, not the roofline.
+- XLA's cost model is a pre-fusion ESTIMATE (docs/perf.md "Roofline
+  methodology"): the report ranks bottlenecks and classifies bounds;
+  it does not replace a profiler trace. Pass ``--trace-dir`` to have
+  the report inventory ``jax.profiler`` artifacts alongside.
+
+Exit codes: **0** report written, **2** an attributed-kind group has no
+captured cost signature (or ``--check`` schema violation), **1** usage/
+unreadable input. Wired into CI as the ``perf-report`` smoke job
+(``bench.py --fast --out`` -> ``perf_report.py --check``) and into
+``bench.py --cost-report`` for one-command local runs.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# levers by bound class — the generic direction when no group-specific
+# diagnosis applies
+_BOUND_LEVERS = {
+    "memory": ("memory-bound: raise arithmetic intensity — fuse the "
+               "gather/elementwise chain (Pallas), grow the bucket, or "
+               "shrink bytes (int8/bf16 operands)"),
+    "compute": ("compute-bound: raise achieved FLOP/s — feed the MXU "
+                "integer-native (int8 lane), improve occupancy with "
+                "larger batches, or shard across chips (dp/tp)"),
+    "unknown": ("unattributed program: XLA yielded no flops/bytes "
+                "ledger — re-warm with a capturable executable or "
+                "profile directly"),
+    "host": ("host-bound: no device program in the loop — the lever is "
+             "framework overhead (batching, linger, staging, reply "
+             "path), not the roofline"),
+}
+
+# group-specific diagnoses — sharper than the bound-generic lever when
+# we know what the group runs (kept in sync with bench.py's groups;
+# unknown groups fall back to the bound lever alone)
+_GROUP_LEVERS = {
+    "gbdt_train": "histogram build routes via the measured prober — "
+                  "next win is the fused Pallas traversal kernel for "
+                  "predict (ROADMAP)",
+    "onnx_lightgbm": "tree scoring is an XLA gather chain — the "
+                     "Pallas fused traversal kernel is the named lever "
+                     "(ROADMAP 'rawest speed lever left')",
+    "gbdt_histogram": "already Pallas-routed where it wins; regression "
+                      "here means the prober re-routed — check "
+                      "auto_routed_to in the bench detail",
+    "transformer": "occupancy-sensitive (docs/perf.md: bs=128 vs 32 "
+                   "nearly 2x) — keep batches >=4k rows per matmul; "
+                   "int8 QOperator lane is the next step",
+    "resnet50": "conv stack near its measured MFU — next lever is the "
+                "int8 lane or more chips (dp_scaling tracks that)",
+    "dp_scaling": "speedup below ~0.9x/chip means dispatch or H2D "
+                  "serialization — check executor_duty_cycle spread "
+                  "across devices",
+    "serving": "echo round trip: serving framework overhead only — "
+               "batching/linger/reply-path tuning",
+    "serving_scored": "per-request cost amortizes across the "
+                      "micro-batch — deepen coalescing before touching "
+                      "the model",
+    "cold_start": "ruled by compile/deserialize wall, not FLOP/s — "
+                  "lever is the executable store hit rate "
+                  "(compile_cache_store_hits_total) and warm hydration",
+}
+
+_REQUIRED_ROW_KEYS = (
+    "group", "kind", "bound", "flops_per_item", "bytes_per_item",
+    "achieved_flops_per_sec", "attainable_flops_per_sec",
+    "roofline_fraction", "lever", "metric", "value", "unit",
+)
+
+
+def _fmt_eng(v: float, unit: str = "") -> str:
+    """1.23e9 -> '1.23 G'; keeps tables scannable."""
+    if v is None or v == 0:
+        return "0" + (f" {unit}" if unit else "")
+    for thresh, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                           (1e3, "k")):
+        if abs(v) >= thresh:
+            return f"{v / thresh:.2f} {suffix}{unit}"
+    return f"{v:.3g}{(' ' + unit) if unit else ''}"
+
+
+def _entries_for(cost: Dict[str, Any], group: str) -> List[Dict[str, Any]]:
+    return [e for e in cost.get("entries", [])
+            if e.get("tag") == group]
+
+
+def _representative(entries: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    captured = [e for e in entries if e.get("captured")]
+    if not captured:
+        return None
+    return max(captured, key=lambda e: e.get("flops", 0.0))
+
+
+def _group_metrics(payload: Dict[str, Any],
+                   group: str) -> List[Dict[str, Any]]:
+    all_entries = [payload] + list(payload.get("secondary", []))
+    return [e for e in all_entries if e.get("group") == group]
+
+
+def _rate_per_sec(metric: Dict[str, Any]) -> Optional[float]:
+    """items/sec implied by one bench metric: throughput units pass
+    through, latency ms inverts, anything else (one-shot walls) is
+    None — no rate, no achieved attribution."""
+    value = metric.get("value")
+    unit = str(metric.get("unit", ""))
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None
+    if "/sec" in unit:
+        return float(value)
+    if unit == "ms" and "cold_start" not in str(metric.get("metric", "")):
+        return 1000.0 / float(value)
+    return None
+
+
+def attribute_group(group: str, meta: Dict[str, Any],
+                    payload: Dict[str, Any],
+                    cost: Dict[str, Any]) -> Dict[str, Any]:
+    """One report row: join the group's headline metric with its
+    representative cost signature and the roofline math already in the
+    snapshot. Never raises — a group the table cannot attribute comes
+    back with ``attributed=False`` (the --check failure)."""
+    kind = meta.get("kind", "device")
+    metrics = _group_metrics(payload, group)
+    head = metrics[0] if metrics else {"metric": "?", "value": None,
+                                       "unit": "?"}
+    tagged = _entries_for(cost, group)
+    rep = _representative(tagged)
+    row: Dict[str, Any] = {
+        "group": group,
+        "kind": kind,
+        "description": meta.get("description", ""),
+        "metric": head.get("metric"),
+        "value": head.get("value"),
+        "unit": head.get("unit"),
+        "n_signatures": len(tagged),
+        "flops_per_item": 0.0,
+        "bytes_per_item": 0.0,
+        "bound": "host" if kind == "host" else "unknown",
+        "achieved_flops_per_sec": 0.0,
+        "attainable_flops_per_sec": 0.0,
+        "roofline_fraction": 0.0,
+        "attributed": kind == "host",  # host groups need no signature
+        "signature": None,
+        "device_kind": None,
+    }
+    if rep is not None:
+        bucket = max(1, int(rep.get("bucket", 1)))
+        flops_item = rep.get("flops", 0.0) / bucket
+        bytes_item = rep.get("bytes_accessed", 0.0) / bucket
+        row.update({
+            "attributed": True,
+            "signature": rep.get("signature"),
+            "device_kind": rep.get("device_kind"),
+            "bound": rep.get("bound", "unknown"),
+            "flops_per_item": flops_item,
+            "bytes_per_item": bytes_item,
+            "arithmetic_intensity": rep.get("arithmetic_intensity", 0.0),
+            "attainable_flops_per_sec": rep.get(
+                "attainable_flops_per_sec", 0.0),
+        })
+        rate = _rate_per_sec(head)
+        if rate is not None and flops_item > 0:
+            ach = rate * flops_item
+            row["achieved_flops_per_sec"] = ach
+            if row["attainable_flops_per_sec"] > 0:
+                row["roofline_fraction"] = round(
+                    ach / row["attainable_flops_per_sec"], 6)
+    lever = _BOUND_LEVERS.get(row["bound"], _BOUND_LEVERS["unknown"])
+    extra = _GROUP_LEVERS.get(group)
+    row["lever"] = f"{extra} — {lever}" if extra else lever
+    return row
+
+
+def _trace_inventory(trace_dir: str) -> List[str]:
+    """jax.profiler artifacts under a trace dir, for the report's
+    ground-truth pointer (we inventory, we do not parse xplane)."""
+    pats = ("**/*.xplane.pb", "**/*.trace.json.gz", "**/*.trace.json")
+    out: List[str] = []
+    for p in pats:
+        out.extend(glob.glob(os.path.join(trace_dir, p), recursive=True))
+    return sorted(out)
+
+
+def build_report(payload: Dict[str, Any],
+                 trace_dir: Optional[str] = None
+                 ) -> Tuple[List[Dict[str, Any]], str, List[str]]:
+    """``(rows, markdown, unattributed_groups)`` from one bench
+    payload. Rows are ranked worst-first: device groups by ascending
+    roofline fraction (the bottleneck order), host groups last."""
+    detail = payload.get("detail", {}) or {}
+    cost = detail.get("cost", {}) or {}
+    groups_meta = detail.get("bench_groups", {}) or {}
+    if not groups_meta:
+        # tolerate a pre-cost artifact: derive groups from the entries
+        groups_meta = {e.get("group"): {"kind": "device"}
+                       for e in [payload] + list(payload.get(
+                           "secondary", []))
+                       if e.get("group")}
+    rows = [attribute_group(g, meta, payload, cost)
+            for g, meta in groups_meta.items()]
+    rows.sort(key=lambda r: (r["kind"] == "host",
+                             r["roofline_fraction"]
+                             if r["attributed"] else -1.0,
+                             r["group"]))
+    unattributed = [r["group"] for r in rows if not r["attributed"]]
+
+    lines: List[str] = []
+    add = lines.append
+    add("# Bench bottleneck report")
+    add("")
+    head_metric = payload.get("metric", "?")
+    add(f"Headline: `{head_metric}` = {payload.get('value')} "
+        f"{payload.get('unit', '')}")
+    peaks = cost.get("peaks", {})
+    if peaks:
+        add("")
+        add("| device kind | peak FLOP/s | peak HBM B/s | provenance |")
+        add("|---|---|---|---|")
+        for kind, p in sorted(peaks.items()):
+            add(f"| {kind} | {_fmt_eng(p.get('flops_per_sec', 0))}F/s "
+                f"| {_fmt_eng(p.get('bytes_per_sec', 0))}B/s "
+                f"| {p.get('source', '?')} |")
+    add("")
+    add("## Ranked bottlenecks (worst roofline fraction first)")
+    add("")
+    add("| rank | group | bound | metric | flops/item | "
+        "achieved FLOP/s | attainable | fraction | lever |")
+    add("|---|---|---|---|---|---|---|---|---|")
+    for i, r in enumerate(rows, 1):
+        frac = (f"{r['roofline_fraction']:.2%}"
+                if r["attributed"] and r["kind"] != "host" else "—")
+        add(f"| {i} | {r['group']} | {r['bound']} "
+            f"| `{r['metric']}` = {r['value']} {r['unit']} "
+            f"| {_fmt_eng(r['flops_per_item'])} "
+            f"| {_fmt_eng(r['achieved_flops_per_sec'])} "
+            f"| {_fmt_eng(r['attainable_flops_per_sec'])} "
+            f"| {frac} | {r['lever']} |")
+    add("")
+    add("## Per-group signatures")
+    for r in rows:
+        add("")
+        add(f"### {r['group']} ({r['kind']})")
+        if r.get("description"):
+            add(f"{r['description']}")
+        tagged = _entries_for(cost, r["group"])
+        if not tagged:
+            add("no cost-table signatures recorded for this group"
+                + (" (host-only: expected)" if r["kind"] == "host"
+                   else " — **UNATTRIBUTED**"))
+            continue
+        add("")
+        add("| signature | bucket | flops | bytes | AI | bound |")
+        add("|---|---|---|---|---|---|")
+        for e in sorted(tagged, key=lambda x: -x.get("flops", 0.0)):
+            add(f"| `{e['signature']}` | {e['bucket']} "
+                f"| {_fmt_eng(e.get('flops', 0))} "
+                f"| {_fmt_eng(e.get('bytes_accessed', 0))} "
+                f"| {e.get('arithmetic_intensity', 0)} "
+                f"| {e.get('bound', '?')} |")
+    if trace_dir:
+        arts = _trace_inventory(trace_dir)
+        add("")
+        add("## Profiler artifacts")
+        if arts:
+            for a in arts[:20]:
+                add(f"- `{a}`")
+            if len(arts) > 20:
+                add(f"- … {len(arts) - 20} more")
+        else:
+            add(f"- none under `{trace_dir}`")
+    add("")
+    add("---")
+    add("*Attribution: "
+        + str(cost.get("attribution", "bucket-proportional"))
+        + "; flops/bytes are XLA's pre-fusion cost-model estimate, "
+          "not hardware counters (docs/perf.md 'Roofline "
+          "methodology').*")
+    return rows, "\n".join(lines) + "\n", unattributed
+
+
+def _check_schema(rows: List[Dict[str, Any]]) -> List[str]:
+    """--check: every row must carry the full attribution schema."""
+    problems = []
+    for r in rows:
+        missing = [k for k in _REQUIRED_ROW_KEYS if k not in r]
+        if missing:
+            problems.append(f"{r.get('group', '?')}: missing {missing}")
+        if r.get("bound") not in ("compute", "memory", "host", "unknown"):
+            problems.append(
+                f"{r.get('group', '?')}: bad bound {r.get('bound')!r}")
+    return problems
+
+
+class _Parser(argparse.ArgumentParser):
+    # the documented contract is 1 for usage errors (2 means an
+    # unattributed group — a different failure an operator greps for)
+    def error(self, message):
+        self.print_usage(sys.stderr)
+        self.exit(1, f"{self.prog}: error: {message}\n")
+
+
+def main(argv=None) -> int:
+    ap = _Parser(description=__doc__.splitlines()[0])
+    ap.add_argument("bench_json",
+                    help="bench.py --out artifact (detail.cost embedded)")
+    ap.add_argument("--out", metavar="FILE",
+                    help="write the markdown report here (default: "
+                         "stdout)")
+    ap.add_argument("--trace-dir", metavar="DIR",
+                    help="inventory jax.profiler artifacts under DIR "
+                         "into the report")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: validate the report schema and that "
+                         "every non-host bench group is attributed")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.bench_json, encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"cannot read bench artifact {args.bench_json}: {e}",
+              file=sys.stderr)
+        return 1
+    if not isinstance(payload, dict) or "metric" not in payload:
+        print(f"{args.bench_json} is not a bench.py --out payload",
+              file=sys.stderr)
+        return 1
+
+    rows, md, unattributed = build_report(payload, args.trace_dir)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(md)
+        print(f"wrote {args.out} ({len(rows)} groups, "
+              f"{len(unattributed)} unattributed)")
+    else:
+        sys.stdout.write(md)
+
+    rc = 0
+    if unattributed:
+        print("unattributed bench groups (no captured cost signature): "
+              + ", ".join(unattributed), file=sys.stderr)
+        rc = 2
+    if args.check:
+        problems = _check_schema(rows)
+        if problems:
+            print("report schema violations:", *problems, sep="\n  ",
+                  file=sys.stderr)
+            rc = 2
+        elif rc == 0:
+            print(f"perf-report check ok: {len(rows)} groups "
+                  "attributed, schema complete")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
