@@ -44,6 +44,7 @@ from synapseml_tpu.runtime import perfwatch as _pw
 from synapseml_tpu.runtime import slo as _slo
 from synapseml_tpu.runtime import structlog as _slog
 from synapseml_tpu.runtime import telemetry as _tm
+from synapseml_tpu.runtime import tracearchive as _ta
 from synapseml_tpu.runtime.faults import PipelineBrokenError
 
 _REGISTRY_LOCK = threading.Lock()
@@ -335,18 +336,29 @@ class CachedRequest:
     ``deadline`` is the absolute monotonic instant the client stops
     caring (``X-Deadline-Ms`` header or the server default; None = no
     deadline) — a request already past it at batch-form time is shed
-    504 before any scoring work is wasted."""
+    504 before any scoring work is wasted.
+    ``trace_id``/``parent_span_id``/``span_id`` thread the request's
+    W3C trace context (accepted from ``traceparent`` or minted at
+    enqueue) into its span, so this server's leg stitches into the
+    caller's distributed trace; ``origin`` names the server on the
+    span for multi-leg disambiguation."""
     __slots__ = ("rid", "request", "epoch", "replied", "arrival", "span",
                  "drained", "deadline")
 
     def __init__(self, rid: str, request: HTTPRequestData,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None,
+                 span_id: Optional[str] = None,
+                 origin: str = ""):
         self.rid = rid
         self.request = request
         self.epoch: Optional[int] = None
         self.replied = False
         self.arrival = time.monotonic()
-        self.span = _tm.start_span(rid)
+        self.span = _tm.start_span(rid, trace_id=trace_id,
+                                   parent_span_id=parent_span_id,
+                                   span_id=span_id, origin=origin)
         self.drained = 0.0
         self.deadline = (None if not deadline_ms
                          else self.arrival + deadline_ms / 1e3)
@@ -498,13 +510,30 @@ class WorkerServer:
                               or "").strip()
                 rid = (client_rid if _RID_RE.match(client_rid)
                        else uuid.uuid4().hex)
+                # W3C trace context: a well-formed traceparent is
+                # ADOPTED (this server's span becomes one leg of the
+                # caller's trace); anything else mints a fresh trace.
+                # One regex fullmatch + two uuid4 draws — no lock, and
+                # the echoed header names OUR span as the parent so
+                # every reply path (sheds included) continues the
+                # trace (docs/observability.md "Distributed tracing")
+                parsed_tp = _tm.parse_traceparent(
+                    self.headers.get("traceparent"))
+                if parsed_tp is not None:
+                    trace_id, parent_span_id = parsed_tp
+                else:
+                    trace_id, parent_span_id = _tm.mint_trace_id(), None
+                span_id = _tm.mint_span_id()
+                tp_echo = _tm.format_traceparent(trace_id, span_id)
                 outer._m_requests.inc()
                 if _slog.enabled("debug"):
                     _slog.log("debug", "request", rid=rid,
+                              trace=trace_id,
                               server=outer.name, method=self.command,
                               path=self.path, bytes=length)
                 retry_hdr = (("Retry-After", outer._retry_after_value()),
-                             ("X-Request-Id", rid))
+                             ("X-Request-Id", rid),
+                             ("traceparent", tp_echo))
                 if outer._draining.is_set():
                     # graceful drain: the replica is going away — refuse
                     # NEW work with an explicit 503 + Retry-After (the
@@ -513,7 +542,7 @@ class WorkerServer:
                     outer._m_drain_shed.inc()
                     outer._reply_counter(503).inc()
                     _bb.record("shed_drain", rid=rid, level="warn",
-                               server=outer.name)
+                               trace=trace_id, server=outer.name)
                     self._send_plain(503, b"draining", headers=retry_hdr)
                     return
                 if (outer.max_queue is not None
@@ -526,7 +555,7 @@ class WorkerServer:
                     outer._m_queue_shed.inc()
                     outer._reply_counter(429).inc()
                     _bb.record("shed_queue", rid=rid, level="warn",
-                               server=outer.name,
+                               trace=trace_id, server=outer.name,
                                depth=outer.requests.qsize())
                     self._send_plain(429, b"request queue full",
                                      headers=retry_hdr)
@@ -556,7 +585,10 @@ class WorkerServer:
                     _slog.log("debug", "rid_substituted", rid=rid,
                               server=outer.name,
                               requested=requested_rid)
-                cr = CachedRequest(rid, req, deadline_ms)
+                cr = CachedRequest(rid, req, deadline_ms,
+                                   trace_id=trace_id,
+                                   parent_span_id=parent_span_id,
+                                   span_id=span_id, origin=outer.name)
                 outer.requests.put(cr)
                 pending.event.wait(outer.reply_timeout)
                 with outer._lock:
@@ -569,34 +601,58 @@ class WorkerServer:
                 status = resp.status_code if resp is not None else 504
                 outer._reply_counter(status).inc()
                 dt = time.monotonic() - cr.arrival
-                outer._m_roundtrip.observe(dt)
+                # exemplar: this trace becomes the covering latency
+                # bucket's link-out (last-write-wins slot assignment —
+                # still no lock on the request path)
+                outer._m_roundtrip.observe(dt, exemplar=trace_id)
                 if _slog.enabled("debug"):
                     _slog.log("debug", "reply", rid=rid,
+                              trace=trace_id,
                               server=outer.name, status=status,
                               seconds=round(dt, 6))
-                if resp is None:
-                    # the wait expired with no response set: an explicit
-                    # 504, never a silent empty wait-out
-                    outer._m_reply_timeout.inc()
-                    self.send_response(504)
-                    # the id still goes back: a timed-out client can ask
-                    # /span/<rid> where its request got stuck
-                    self.send_header("X-Request-Id", rid)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                body = resp.entity or b""
-                self.send_response(resp.status_code)
-                for k, v in resp.headers.items():
-                    if k.lower() not in ("content-length", "date", "server"):
-                        self.send_header(k, v)
-                # rid correlates the reply with its trace span (the
-                # telemetry e2e test asserts this header matches the
-                # span record)
-                self.send_header("X-Request-Id", rid)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    if resp is None:
+                        # the wait expired with no response set: an
+                        # explicit 504, never a silent empty wait-out
+                        outer._m_reply_timeout.inc()
+                        self.send_response(504)
+                        # the id still goes back: a timed-out client
+                        # can ask /span/<rid> where its request got
+                        # stuck
+                        self.send_header("X-Request-Id", rid)
+                        self.send_header("traceparent", tp_echo)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                    else:
+                        body = resp.entity or b""
+                        self.send_response(resp.status_code)
+                        for k, v in resp.headers.items():
+                            if k.lower() not in ("content-length",
+                                                 "date", "server"):
+                                self.send_header(k, v)
+                        # rid correlates the reply with its trace span
+                        # (the telemetry e2e test asserts this header
+                        # matches the span record); traceparent hands
+                        # the caller its continued trace context back
+                        self.send_header("X-Request-Id", rid)
+                        self.send_header("traceparent", tp_echo)
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                finally:
+                    # tail-based retention: the outcome is known here —
+                    # breaches (5xx / shed / over-threshold latency)
+                    # and the head-sampled healthy few land one JSONL
+                    # record in the archive. Deliberately AFTER the
+                    # socket write (a slow dump volume must delay
+                    # forensics, never the client's reply during an
+                    # incident when every reply breaches) — but in a
+                    # finally: a client that hung up mid-write is
+                    # breach evidence, not a reason to lose the record
+                    _ta.maybe_archive(
+                        cr.span, status, dt,
+                        threshold_s=outer.slo_latency_threshold_s)
 
             def _send_plain(self, status: int, body: bytes,
                             content_type: str = "text/plain",
@@ -638,9 +694,22 @@ class WorkerServer:
                 if self.path == "/metrics":
                     # Prometheus scrape surface: the whole process-wide
                     # registry (executor + serving + compile cache), off
-                    # the scoring pipeline entirely
+                    # the scoring pipeline entirely. OpenMetrics (with
+                    # histogram exemplars linking latency buckets to
+                    # trace ids) is negotiated on the Accept header or
+                    # forced by SYNAPSEML_OPENMETRICS=1; the default
+                    # 0.0.4 exposition never carries an exemplar, so
+                    # strict format-0.0.4 parsers are unaffected
+                    om = ("application/openmetrics-text"
+                          in (self.headers.get("Accept") or "")
+                          or os.environ.get("SYNAPSEML_OPENMETRICS",
+                                            "") == "1")
                     self._send_plain(
-                        200, _tm.prometheus_text().encode("utf-8"),
+                        200,
+                        _tm.prometheus_text(
+                            openmetrics=om).encode("utf-8"),
+                        ("application/openmetrics-text; version=1.0.0; "
+                         "charset=utf-8") if om else
                         "text/plain; version=0.0.4; charset=utf-8")
                     return
                 if (self.path.startswith("/debug/")
@@ -718,6 +787,29 @@ class WorkerServer:
                         return
                     self._send_plain(
                         200, json.dumps(span.breakdown()).encode("utf-8"),
+                        "application/json")
+                    return
+                if self.path.startswith("/trace/"):
+                    # this replica's legs of one distributed trace —
+                    # what the controller's /fleet/trace fans out to.
+                    # Span storage is process-wide, so every leg any
+                    # server in this process created comes back, each
+                    # labeled with its origin server
+                    tid = self.path[len("/trace/"):].strip("/").lower()
+                    if not re.fullmatch(r"[0-9a-f]{32}", tid):
+                        self._send_plain(400, b"trace id must be 32 "
+                                              b"lowercase hex chars")
+                        return
+                    legs = _tm.trace_spans(tid)
+                    if not legs:
+                        self._send_plain(404, b"no spans for trace")
+                        return
+                    self._send_plain(
+                        200,
+                        json.dumps({"trace_id": tid,
+                                    "server": outer.name,
+                                    "pid": os.getpid(),
+                                    "legs": legs}).encode("utf-8"),
                         "application/json")
                     return
                 self._enqueue()
@@ -968,7 +1060,8 @@ class WorkerServer:
         if shed:
             _bb.record("shed_stop", level="warn", server=self.name,
                        status=status, n=len(shed),
-                       rids=[cr.rid for cr in shed[:8]])
+                       rids=[cr.rid for cr in shed[:8]],
+                       trace_ids=[cr.span.trace_id for cr in shed[:8]])
         return len(shed)
 
     def stop(self):
@@ -1391,7 +1484,8 @@ class DistributedServer:
 
     def score_on_channel(self, channel: int,
                          score_fn: Callable[[], Any],
-                         rids: Optional[List[str]] = None):
+                         rids: Optional[List[str]] = None,
+                         trace_ids: Optional[List[str]] = None):
         """Failover dispatch: run ``score_fn`` as channel ``channel``'s
         scoring work under its fault points and breaker accounting. On
         failure, the SAME in-hand work is re-dispatched ONCE to a
@@ -1414,6 +1508,7 @@ class DistributedServer:
             _bb.record("failover", channel=channel, level="warn",
                        server=self.server.name, to_channel=target,
                        rids=(rids or [])[:8],
+                       trace_ids=(trace_ids or [])[:8],
                        error=repr(first_err)[:200])
             t1 = time.monotonic()
             try:
@@ -1657,7 +1752,8 @@ class DistributedServer:
         t0 = time.monotonic()
         try:
             out = self.score_on_channel(
-                ch, run, rids=[cr.rid for cr in batch])
+                ch, run, rids=[cr.rid for cr in batch],
+                trace_ids=[cr.span.trace_id for cr in batch])
         except Exception as e:  # noqa: BLE001 - channel loop must survive
             err = e
         dt = time.monotonic() - t0
@@ -1665,7 +1761,8 @@ class DistributedServer:
             _bb.record("slow_batch", channel=ch, level="warn",
                        server=self.server.name, seconds=round(dt, 6),
                        size=len(batch),
-                       rids=[cr.rid for cr in batch[:8]])
+                       rids=[cr.rid for cr in batch[:8]],
+                       trace_ids=[cr.span.trace_id for cr in batch[:8]])
         if err is None:
             try:
                 send_replies(self.server, out, reply_col)
@@ -1997,7 +2094,9 @@ class ContinuousServer:
                 _bb.record("slow_batch", level="warn",
                            server=self.name, seconds=round(dt, 6),
                            size=len(batch), stage="score",
-                           rids=[cr.rid for cr in batch[:8]])
+                           rids=[cr.rid for cr in batch[:8]],
+                           trace_ids=[cr.span.trace_id
+                                      for cr in batch[:8]])
 
     def _reply_scored(self, batch: List[CachedRequest], out, err,
                       err_status: int = 500,
@@ -2059,7 +2158,9 @@ class ContinuousServer:
             self._m_deadline_shed.inc(len(expired))
             _bb.record("shed_deadline", level="warn", server=self.name,
                        n=len(expired),
-                       rids=[cr.rid for cr in expired[:8]])
+                       rids=[cr.rid for cr in expired[:8]],
+                       trace_ids=[cr.span.trace_id
+                                  for cr in expired[:8]])
             # Retry-After rides the shed 504 too: a deadline-expired
             # request usually means the replica is saturated — backing
             # off beats an immediate re-hammer that will expire again

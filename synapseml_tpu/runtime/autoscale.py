@@ -66,7 +66,8 @@ __all__ = [
     "decide", "aggregate", "parse_prometheus", "sample_from_scrape",
     "window_availability", "hydration_audit",
     "scale_event_counter", "hydration_counter",
-    "scrape_failure_counter", "register_fleet_gauges",
+    "scrape_failure_counter", "trace_stitch_counter",
+    "register_fleet_gauges",
     "register_replica_gauges", "unregister_replica_gauges",
 ]
 
@@ -402,6 +403,13 @@ def scrape_failure_counter() -> "_tm.Counter":
     """``fleet_scrape_failures_total`` — replica polls that returned
     no usable /metrics (the blindness the down-rail guards against)."""
     return _tm.counter("fleet_scrape_failures_total")
+
+
+def trace_stitch_counter(result: str) -> "_tm.Counter":
+    """``fleet_trace_stitch_total{result=}`` — ``/fleet/trace``
+    stitches by outcome: ``found`` (>=1 leg merged from live replicas
+    and/or the trace archive) / ``not_found``."""
+    return _tm.counter("fleet_trace_stitch_total", result=result)
 
 
 _REPLICA_STATES = ("ready", "warming", "unreachable")

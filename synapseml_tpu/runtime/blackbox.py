@@ -127,6 +127,7 @@ def last_dump_path() -> Optional[str]:
 
 def record(event: str, rid: Optional[str] = None,
            channel: Optional[int] = None, level: str = "info",
+           trace: Optional[str] = None,
            **fields: Any) -> None:
     """Append one structured event to the ring and (when logging is on)
     emit it as a structured log line — ONE instrumentation call per
@@ -136,8 +137,12 @@ def record(event: str, rid: Optional[str] = None,
     The log line is emitted even with the ring disabled
     (``SYNAPSEML_BLACKBOX=0``) — the two layers are independent, and
     turning off the in-memory recorder must not silence the operator's
-    incident log."""
-    _slog.log(level, event, rid=rid, channel=channel, **fields)
+    incident log. ``trace`` is the distributed-trace correlation key
+    (``rid``'s fleet-wide sibling): grep one trace id across any
+    replica's ring, log, span store, and the stitched
+    ``/fleet/trace`` view and they tell one story."""
+    _slog.log(level, event, rid=rid, channel=channel, trace=trace,
+              **fields)
     if not _S.enabled:
         return
     ev: Dict[str, Any] = {"seq": next(_S.seq),
@@ -148,6 +153,8 @@ def record(event: str, rid: Optional[str] = None,
         ev["rid"] = rid
     if channel is not None:
         ev["channel"] = channel
+    if trace is not None:
+        ev["trace"] = trace
     for k, v in fields.items():
         if v is not None:
             ev[k] = v
@@ -189,6 +196,10 @@ def snapshot(max_events: Optional[int] = None,
         "n_events": len(events),
         "events": events,
         "telemetry": _tm.snapshot(compact=True),
+        # the last 32 completed span breakdowns (trace ids included):
+        # a forensic file alone answers "what was in flight, and which
+        # traces were those requests" without a live replica to query
+        "spans": _tm.completed_spans(32),
     }
     # roofline cost table (runtime/costmodel.py): folded into every
     # dump/flight view so an incident snapshot says what the warmed

@@ -186,13 +186,19 @@ def _json_safe(v: Any) -> Any:
 
 
 def log(level: str, event: str, rid: Optional[str] = None,
-        channel: Optional[int] = None, **fields: Any):
+        channel: Optional[int] = None, trace: Optional[str] = None,
+        **fields: Any):
     """Emit one structured event. A no-op (single attribute test) when
     logging is off or the level is below the configured floor — safe on
     any path, including under locks: production lines are enqueued to
     the writer thread (full queue drops + counts, never blocks), so a
     stalled stderr consumer cannot wedge a caller holding the breaker
-    or channel-map lock."""
+    or channel-map lock. ``trace`` is the distributed-trace id
+    (``rid``'s fleet-wide sibling, docs/observability.md "Distributed
+    tracing"): the same 32-hex value rides the ``traceparent``
+    headers, the span store, and the flight ring, so grep-by-trace
+    reconstructs a request across REPLICAS the way grep-by-rid does
+    within one."""
     if not _CFG.mode:
         return
     if LEVELS.get(level, 20) < _CFG.min_level:
@@ -203,6 +209,8 @@ def log(level: str, event: str, rid: Optional[str] = None,
         rec["rid"] = rid
     if channel is not None:
         rec["channel"] = channel
+    if trace is not None:
+        rec["trace"] = trace
     for k, v in fields.items():
         if v is not None:
             rec[k] = _json_safe(v)

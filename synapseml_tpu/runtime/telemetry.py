@@ -25,6 +25,17 @@ signal layer the SLO-aware serving scheduler (ROADMAP) will act on:
   ``utils.profiling.trace`` is live — :func:`trace_annotation` regions
   that land the executor's pipeline stages on the TensorBoard timeline.
 
+Round 16 makes a span one **leg of a distributed trace**: W3C
+``traceparent`` context (:func:`parse_traceparent` /
+:func:`format_traceparent`) threads ``trace_id``/``parent_span_id``
+through :class:`Span`, :func:`trace_spans` answers "every leg this
+process holds for one trace" (``GET /trace/<trace_id>``, stitched
+fleet-wide by the controller's ``/fleet/trace``), histograms carry
+last-write-wins OpenMetrics **exemplars** linking latency buckets to
+trace ids, and the completed-span ring depth is operator-tunable
+(``SYNAPSEML_SPAN_RING``). Tail-based retention lives in
+:mod:`~synapseml_tpu.runtime.tracearchive`.
+
 Recording stays cheap enough for the dispatch/drain hot paths (no host
 syncs, no locks, a handful of dict/list operations per *batch*, not per
 row); ``SYNAPSEML_TELEMETRY=0`` (or :func:`set_enabled`) turns every
@@ -40,6 +51,7 @@ import os
 import re
 import threading
 import time
+import uuid
 from collections import deque
 from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
                     Tuple)
@@ -49,8 +61,12 @@ __all__ = [
     "histogram", "series", "unregister", "snapshot", "prometheus_text",
     "reset",
     "enabled", "set_enabled", "start_span", "get_span", "completed_spans",
+    "trace_spans", "configure_span_ring", "span_ring_depth",
+    "parse_traceparent", "format_traceparent", "mint_trace_id",
+    "mint_span_id",
     "set_current_spans", "reset_current_spans", "current_spans",
     "trace_annotation", "LATENCY_BUCKETS", "SIZE_BUCKETS",
+    "DEFAULT_SPAN_RING",
 ]
 
 # log-spaced latency ladder, 100us .. 30s — covers the sub-ms serving
@@ -197,14 +213,28 @@ class Histogram(_Metric):
                  buckets: Sequence[float] = LATENCY_BUCKETS):
         super().__init__(name, labels)
         self.bounds: Tuple[float, ...] = tuple(float(b) for b in buckets)
+        # per-bucket OpenMetrics exemplars, last-write-wins: each slot
+        # holds one (trace_id, value, wall_ts) tuple. A single list-item
+        # assignment per stamped observe — atomic under the GIL, no
+        # lock, and losing a race just means the OTHER request's trace
+        # becomes the bucket's exemplar (the sampling policy IS
+        # last-write-wins, docs/observability.md "Distributed tracing")
+        self._exemplars: List[Optional[Tuple[str, float, float]]] = \
+            [None] * (len(self.bounds) + 1)
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: Optional[str] = None):
+        """``exemplar``: a trace id to stamp on the covering bucket —
+        surfaced on the OpenMetrics exposition so a dashboard's latency
+        bucket links straight to the trace that landed in it."""
         if not _STATE.enabled:
             return
+        idx = bisect.bisect_left(self.bounds, v)
         cell = self._cell(len(self.bounds) + 1)
-        cell.counts[bisect.bisect_left(self.bounds, v)] += 1
+        cell.counts[idx] += 1
         cell.total += v
         cell.count += 1
+        if exemplar:
+            self._exemplars[idx] = (exemplar, v, time.time())
 
     def _aggregate(self) -> Tuple[List[int], float, int]:
         counts = [0] * (len(self.bounds) + 1)
@@ -331,17 +361,112 @@ def reset():
             m._cells.clear()
             if isinstance(m, Gauge):
                 m._set_value = None
+            elif isinstance(m, Histogram):
+                m._exemplars = [None] * (len(m.bounds) + 1)
     with _SPAN_LOCK:
         _ACTIVE_SPANS.clear()
         _DONE_SPANS.clear()
+
+
+# -- trace context (W3C traceparent) ----------------------------------------
+
+# grammar per https://www.w3.org/TR/trace-context/:
+#   version "-" trace-id "-" parent-id "-" trace-flags
+# (2 / 32 / 16 / 2 lowercase hex). Version ff and all-zero ids are
+# invalid; a well-formed header with an unknown version is still
+# usable, INCLUDING trailing "-suffixed" data a future version may
+# append (the spec's forward-compat rule: parse the first four
+# fields, ignore the rest — but only for versions above 00, whose
+# grammar is exactly four fields). One fullmatch on the request
+# path — no lock, no allocation beyond the match object.
+_TRACEPARENT_RE = re.compile(
+    r"([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})(-.*)?")
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+    """``(trace_id, parent_span_id)`` from a W3C ``traceparent``
+    header, or None when absent/malformed (the caller mints a fresh
+    context then — a bad header must never reject a request)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.fullmatch(header.strip())
+    if m is None:
+        return None
+    version, trace_id, parent_id, _flags, tail = m.groups()
+    if version == "ff":
+        return None  # forbidden by the spec
+    if version == "00" and tail is not None:
+        return None  # version 00 is EXACTLY four fields
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None  # all-zero ids are explicitly invalid
+    return trace_id, parent_id
+
+
+def format_traceparent(trace_id: str, span_id: str,
+                       sampled: bool = True) -> str:
+    """Version-00 traceparent naming OUR span as the parent — what
+    every reply path echoes so the caller's next hop (or its logs)
+    continues the same trace."""
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def mint_trace_id() -> str:
+    return uuid.uuid4().hex  # 32 hex, never all-zero
+
+
+def mint_span_id() -> str:
+    return uuid.uuid4().hex[:16]
 
 
 # -- trace spans ------------------------------------------------------------
 
 _SPAN_LOCK = threading.Lock()
 _ACTIVE_SPANS: Dict[str, "Span"] = {}
-_DONE_SPANS: "deque[Span]" = deque(maxlen=1024)
 _MAX_ACTIVE = 4096
+
+DEFAULT_SPAN_RING = 1024
+
+
+def _ring_depth_from_env() -> int:
+    """``SYNAPSEML_SPAN_RING`` (0/unset = default 1024), validated at
+    first use: a malformed or non-positive value degrades to the
+    default — a bad env var must never crash a server at import."""
+    raw = os.environ.get("SYNAPSEML_SPAN_RING", "").strip()
+    if not raw:
+        return DEFAULT_SPAN_RING
+    try:
+        depth = int(raw)
+    except ValueError:
+        return DEFAULT_SPAN_RING
+    return depth if depth > 0 else DEFAULT_SPAN_RING
+
+
+_DONE_SPANS: "deque[Span]" = deque(maxlen=_ring_depth_from_env())
+
+
+def span_ring_depth() -> int:
+    """Current completed-span ring capacity."""
+    with _SPAN_LOCK:
+        return _DONE_SPANS.maxlen or DEFAULT_SPAN_RING
+
+
+def configure_span_ring(depth: Optional[int] = None) -> int:
+    """Resize the completed-span ring, keeping the newest spans.
+    ``None`` re-reads ``SYNAPSEML_SPAN_RING``; an explicit non-positive
+    or non-int ``depth`` raises (the env path degrades instead).
+    Returns the new capacity."""
+    global _DONE_SPANS
+    if depth is None:
+        depth = _ring_depth_from_env()
+    else:
+        depth = int(depth)
+        if depth <= 0:
+            raise ValueError(f"span ring depth must be positive, "
+                             f"got {depth}")
+    with _SPAN_LOCK:
+        _DONE_SPANS = deque(_DONE_SPANS, maxlen=depth)
+    return depth
 
 _STAGE_ORDER = ("queue_wait", "batch_form", "stage", "compute", "drain",
                 "reply")
@@ -351,16 +476,32 @@ class Span:
     """One request's stage breakdown through the serving + executor
     pipeline. ``note`` appends to a thread-safe-enough list (appends are
     atomic under the GIL and each stage notes once); ``finish`` moves
-    the span to the completed ring and feeds the per-stage histograms."""
+    the span to the completed ring and feeds the per-stage histograms.
 
-    __slots__ = ("rid", "start", "events", "status", "finished")
+    Round 16: a span is one LEG of a distributed trace — ``trace_id``
+    (shared across every process the request touched, accepted from or
+    minted for the W3C ``traceparent`` header), ``span_id`` (this
+    leg), ``parent_span_id`` (the caller's leg, "" at the trace root)
+    and ``origin`` (which server created it) are what
+    ``GET /fleet/trace/<trace_id>`` stitches legs together on."""
 
-    def __init__(self, rid: str):
+    __slots__ = ("rid", "start", "wall", "events", "status", "finished",
+                 "trace_id", "span_id", "parent_span_id", "origin")
+
+    def __init__(self, rid: str, trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None,
+                 span_id: Optional[str] = None,
+                 origin: str = ""):
         self.rid = rid
         self.start = time.monotonic()
+        self.wall = time.time()  # orders legs across processes
         self.events: List[Tuple[str, float]] = []
         self.status = "active"
         self.finished = 0.0
+        self.trace_id = trace_id or mint_trace_id()
+        self.span_id = span_id or mint_span_id()
+        self.parent_span_id = parent_span_id or ""
+        self.origin = origin
 
     def note(self, stage: str, seconds: float):
         # finished spans drop late notes: a request replayed through
@@ -394,6 +535,9 @@ class Span:
             ordered.setdefault(s, round(stages[s], 6))
         end = self.finished if self.finished else time.monotonic()
         return {"rid": self.rid, "status": self.status,
+                "trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_span_id": self.parent_span_id,
+                "origin": self.origin, "ts": round(self.wall, 6),
                 "total_seconds": round(end - self.start, 6),
                 "stages": ordered}
 
@@ -404,9 +548,14 @@ class _NoopSpan(Span):
     def __init__(self):  # noqa: D107 - trivially empty
         self.rid = ""
         self.start = 0.0
+        self.wall = 0.0
         self.events = []
         self.status = "disabled"
         self.finished = 0.0
+        self.trace_id = ""
+        self.span_id = ""
+        self.parent_span_id = ""
+        self.origin = ""
 
     def note(self, stage: str, seconds: float):
         pass
@@ -428,11 +577,18 @@ def _span_stage_hist(stage: str) -> Histogram:
     return h
 
 
-def start_span(rid: str) -> Span:
-    """Mint a span for one request id (the serving enqueue path)."""
+def start_span(rid: str, trace_id: Optional[str] = None,
+               parent_span_id: Optional[str] = None,
+               span_id: Optional[str] = None,
+               origin: str = "") -> Span:
+    """Mint a span for one request id (the serving enqueue path).
+    ``trace_id``/``parent_span_id`` thread an accepted W3C traceparent
+    through (both minted when absent); ``origin`` names the server so
+    a stitched trace tells its legs apart."""
     if not _STATE.enabled:
         return _NOOP_SPAN
-    span = Span(rid)
+    span = Span(rid, trace_id=trace_id, parent_span_id=parent_span_id,
+                span_id=span_id, origin=origin)
     with _SPAN_LOCK:
         _ACTIVE_SPANS[rid] = span
         while len(_ACTIVE_SPANS) > _MAX_ACTIVE:
@@ -460,6 +616,24 @@ def completed_spans(limit: int = 64) -> List[Dict[str, Any]]:
     with _SPAN_LOCK:
         spans = list(_DONE_SPANS)[-limit:]
     return [s.breakdown() for s in spans]
+
+
+def trace_spans(trace_id: str, limit: int = 64) -> List[Dict[str, Any]]:
+    """Every span this PROCESS holds for one trace id — active and
+    completed, oldest first. The per-replica half of distributed-trace
+    stitching (``GET /trace/<trace_id>`` on the serving port; the
+    fleet controller merges these across replicas). The lock hold is a
+    bare snapshot copy — the O(ring) filter runs OUTSIDE it, so a
+    polled trace surface over an operator-deepened ring
+    (``SYNAPSEML_SPAN_RING``) never stalls ``start_span``/``finish``
+    on the request path."""
+    with _SPAN_LOCK:
+        done = list(_DONE_SPANS)
+        active = list(_ACTIVE_SPANS.values())
+    spans = [s for s in done if s.trace_id == trace_id]
+    spans += [s for s in active if s.trace_id == trace_id]
+    spans.sort(key=lambda s: s.wall)
+    return [s.breakdown() for s in spans[:limit]]
 
 
 # ambient span context: the serving scorer sets the micro-batch's spans
@@ -567,10 +741,21 @@ def _labels_text(labels: Tuple[Tuple[str, str], ...],
     return "{%s}" % body
 
 
-def prometheus_text() -> str:
+def prometheus_text(openmetrics: bool = False) -> str:
     """Prometheus text exposition (format 0.0.4): counters and gauges as
     single samples, histograms as cumulative ``_bucket{le=}`` series
-    plus ``_sum``/``_count`` — what ``GET /metrics`` serves."""
+    plus ``_sum``/``_count`` — what ``GET /metrics`` serves.
+
+    ``openmetrics=True`` emits the OpenMetrics-flavored variant the
+    serving port negotiates on ``Accept: application/openmetrics-text``
+    (or ``SYNAPSEML_OPENMETRICS=1``): identical samples, plus
+    ``# {trace_id="..."} value timestamp`` **exemplars** on histogram
+    bucket lines that have one, and the terminating ``# EOF``. Honesty
+    caveat: series names keep their registered ``_total`` suffixes
+    rather than the OpenMetrics family/suffix split — tolerant parsers
+    (Prometheus's openmetrics scrape mode included) accept it; the
+    default exposition is unchanged, so format-0.0.4 consumers never
+    see an exemplar."""
     seen_types: Dict[str, str] = {}
     lines: List[str] = []
     for m in _sorted_metrics():
@@ -579,12 +764,19 @@ def prometheus_text() -> str:
             lines.append(f"# TYPE {m.name} {m.kind}")
         if isinstance(m, Histogram):
             counts, total, n = m._aggregate()
+            exemplars = list(m._exemplars) if openmetrics else None
             cum = 0
-            for b, c in zip(list(m.bounds) + [float("inf")], counts):
+            for i, (b, c) in enumerate(
+                    zip(list(m.bounds) + [float("inf")], counts)):
                 cum += c
                 le = "+Inf" if b == float("inf") else repr(b)
-                lines.append("%s_bucket%s %d" % (
-                    m.name, _labels_text(m.labels, (("le", le),)), cum))
+                line = "%s_bucket%s %d" % (
+                    m.name, _labels_text(m.labels, (("le", le),)), cum)
+                ex = exemplars[i] if exemplars else None
+                if ex is not None:
+                    tid, v, ts = ex
+                    line += ' # {trace_id="%s"} %.9g %.3f' % (tid, v, ts)
+                lines.append(line)
             lines.append("%s_sum%s %.9g" % (
                 m.name, _labels_text(m.labels), total))
             lines.append("%s_count%s %d" % (
@@ -593,4 +785,6 @@ def prometheus_text() -> str:
             v = m.value
             text = "%d" % v if float(v).is_integer() else "%.9g" % v
             lines.append("%s%s %s" % (m.name, _labels_text(m.labels), text))
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"

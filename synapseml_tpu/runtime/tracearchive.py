@@ -1,0 +1,280 @@
+"""Tail-based trace retention: a JSONL archive of the traces worth
+keeping.
+
+The completed-span ring (``runtime/telemetry.py``, default 1024 deep)
+is a great live surface and a terrible forensic one: under any real
+request rate the one trace an incident review needs has been evicted
+long before anyone asks. This module is the durable tail — the
+Dapper-style retention decision made at trace COMPLETION, when the
+outcome is known:
+
+- **every SLO-breaching trace is kept**: a 5xx reply (the 504
+  deadline/timeout sheds included), a span that finished
+  ``error``/``shed``, or a roundtrip over the latency threshold
+  (``SYNAPSEML_SLO_LATENCY_MS``, the same knob the SLO gauges use);
+- **a small head-sampled fraction of healthy ones** rides along
+  (``SYNAPSEML_TRACE_HEAD_SAMPLE``, default 0.01 — every Nth healthy
+  reply), so the archive shows what *normal* looked like next to the
+  breaches;
+- everything else is dropped — tail-based sampling's whole point is
+  that the healthy 99.x% costs nothing.
+
+Records are JSON lines (one :meth:`Span.breakdown` per line, plus the
+reply status, latency, retention class, and pid) appended to
+``<dump_dir>/trace_archive-<pid>.jsonl`` — beside the flight-recorder
+dumps, so one volume holds a replica's whole forensic story and the
+fleet controller can stitch a SIGKILLed replica's legs from disk
+(``GET /fleet/trace/<trace_id>`` merges live ``/trace`` legs with
+archive scans). The file is size-capped (``SYNAPSEML_TRACE_ARCHIVE_
+MAX_BYTES``, default 8 MiB): past the cap the live file rotates to
+``.1`` via atomic ``os.replace`` (tmp-then-rename discipline — readers
+never see a half-rotated pair) and the previous ``.1`` is dropped.
+Appends are single ``write()`` calls; a reader tolerates one torn tail
+line after a crash (:func:`scan` skips lines that fail to parse).
+
+Archive writes happen at archive RATE (breaches + the sampled few),
+never per request, on the reply handler thread after the response is
+already committed — a slow disk delays nothing client-visible. The
+decision itself (:func:`maybe_archive`'s breach test + the head-sample
+counter) is lock-free; only an actual write takes the file lock.
+``SYNAPSEML_TRACE_ARCHIVE=0`` disables the sink entirely.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from synapseml_tpu.runtime import telemetry as _tm
+
+__all__ = [
+    "maybe_archive", "archive_path", "scan", "configure", "reset",
+    "enabled", "set_enabled", "DEFAULT_MAX_BYTES", "CLASS_BREACH",
+    "CLASS_HEAD_SAMPLE",
+]
+
+DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+CLASS_BREACH = "slo_breach"
+CLASS_HEAD_SAMPLE = "head_sample"
+
+
+def _head_every_from_env() -> int:
+    """Healthy-trace sampling stride from ``SYNAPSEML_TRACE_HEAD_
+    SAMPLE`` (a fraction; 0.01 -> every 100th healthy reply; 0 or
+    malformed -> no healthy sampling)."""
+    raw = os.environ.get("SYNAPSEML_TRACE_HEAD_SAMPLE", "0.01").strip()
+    try:
+        frac = float(raw)
+    except ValueError:
+        return 0
+    if not 0.0 < frac <= 1.0:
+        return 0
+    return max(1, round(1.0 / frac))
+
+
+def _max_bytes_from_env() -> int:
+    """``SYNAPSEML_TRACE_ARCHIVE_MAX_BYTES``: malformed or
+    non-positive degrades to the default — a bad env var must never
+    crash a server at import (the telemetry ring's policy), and a
+    negative cap would rotate on every append, destroying the very
+    forensics the archive exists to keep."""
+    raw = os.environ.get("SYNAPSEML_TRACE_ARCHIVE_MAX_BYTES",
+                         "").strip()
+    try:
+        n = int(raw) if raw else DEFAULT_MAX_BYTES
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+    return max(4096, n) if n > 0 else DEFAULT_MAX_BYTES
+
+
+def _threshold_from_env() -> float:
+    raw = os.environ.get("SYNAPSEML_SLO_LATENCY_MS", "").strip()
+    try:
+        ms = float(raw) if raw else 250.0
+    except ValueError:
+        ms = 250.0
+    return ms / 1e3
+
+
+class _State:
+    """Module switchboard (the telemetry/blackbox pattern): env knobs
+    captured once (all tolerant — degrade, never crash an import),
+    :func:`configure` retunes for tests and entries."""
+
+    def __init__(self):
+        self.enabled = os.environ.get("SYNAPSEML_TRACE_ARCHIVE",
+                                      "") != "0"
+        self.dir: Optional[str] = None  # None = beside the flight dumps
+        self.max_bytes = _max_bytes_from_env()
+        self.head_every = _head_every_from_env()
+        self.lock = threading.Lock()
+        self.head_counter = itertools.count(1)
+        self.default_threshold_s = _threshold_from_env()
+
+
+_S = _State()
+
+
+def enabled() -> bool:
+    return _S.enabled
+
+
+def set_enabled(on: bool) -> bool:
+    prev = _S.enabled
+    _S.enabled = bool(on)
+    return prev
+
+
+def configure(directory: Optional[str] = None,
+              max_bytes: Optional[int] = None,
+              head_every: Optional[int] = None):
+    """Repoint/retune the sink (tests, embedding callers).
+    ``head_every=0`` disables healthy sampling; ``directory=None``
+    keeps the current one (the flight dump dir by default)."""
+    with _S.lock:
+        if directory is not None:
+            _S.dir = directory
+        if max_bytes is not None:
+            _S.max_bytes = max(4096, int(max_bytes))
+        if head_every is not None:
+            _S.head_every = max(0, int(head_every))
+
+
+def reset():
+    """Tests only: drop the current archive files and restart the
+    head-sample stride."""
+    with _S.lock:
+        _S.head_counter = itertools.count(1)
+        path = _archive_path_locked()
+        for p in (path, path + ".1"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def _archive_path_locked() -> str:
+    d = _S.dir
+    if d is None:
+        # beside the flight dumps — resolved per call because the
+        # serving entry's --dump-dir lands after import
+        from synapseml_tpu.runtime import blackbox as _bb
+
+        d = _bb.dump_dir()
+    return os.path.join(d, f"trace_archive-{os.getpid()}.jsonl")
+
+
+def archive_path() -> str:
+    """The live archive file's path (rotated sibling: ``<path>.1``)."""
+    with _S.lock:
+        return _archive_path_locked()
+
+
+def _records_counter(cls: str) -> "_tm.Counter":
+    return _tm.counter("trace_archive_records_total", retention=cls)
+
+
+def _rotate_locked(path: str):
+    """Atomic rotation: the live file becomes ``.1`` (replacing the
+    previous one) and the next append starts a fresh file. One
+    ``os.replace`` — a concurrent reader sees the old file or the new
+    pair, never a torn state."""
+    try:
+        os.replace(path, path + ".1")
+        _tm.counter("trace_archive_rotations_total").inc()
+    except OSError:
+        _tm.counter("trace_archive_write_failures_total").inc()
+
+
+def _size() -> float:
+    """Scrape-time gauge sampler: live archive file size in bytes."""
+    try:
+        return float(os.path.getsize(archive_path()))
+    except OSError:
+        return 0.0
+
+
+_tm.gauge_fn("trace_archive_bytes", _size)
+
+
+def maybe_archive(span: "_tm.Span", status: int, latency_s: float,
+                  threshold_s: Optional[float] = None) -> Optional[str]:
+    """The retention decision for one completed request: archive when
+    it breached (5xx status, an ``error``/``shed`` span, or latency
+    over ``threshold_s`` — default ``SYNAPSEML_SLO_LATENCY_MS``), or
+    when the head-sample stride picked this healthy one. Returns the
+    retention class when a record was written, else None. Never
+    raises — the archive must not make a reply path worse."""
+    if not _S.enabled or not _tm.enabled():
+        return None
+    if threshold_s is None:
+        threshold_s = _S.default_threshold_s
+    if (status >= 500 or span.status in ("error", "shed")
+            or (threshold_s > 0 and latency_s > threshold_s)):
+        cls = CLASS_BREACH
+    elif _S.head_every and next(_S.head_counter) % _S.head_every == 0:
+        cls = CLASS_HEAD_SAMPLE
+    else:
+        return None
+    record = dict(span.breakdown())
+    record.update({
+        "status_code": int(status),
+        "latency_s": round(latency_s, 6),
+        "retention": cls,
+        "archived_ts": round(time.time(), 6),
+        "pid": os.getpid(),
+    })
+    line = json.dumps(record, separators=(",", ":"), default=repr)
+    try:
+        with _S.lock:
+            path = _archive_path_locked()
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            try:
+                if os.path.getsize(path) >= _S.max_bytes:
+                    _rotate_locked(path)
+            except OSError:
+                pass  # no file yet: first append creates it
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+    except Exception:  # noqa: BLE001 - never worsen a reply path
+        _tm.counter("trace_archive_write_failures_total").inc()
+        return None
+    _records_counter(cls).inc()
+    return cls
+
+
+def scan(trace_id: str, directory: Optional[str] = None,
+         limit: int = 64) -> List[Dict[str, Any]]:
+    """Every archived record for one trace id across ALL archive files
+    in ``directory`` (default: this process's archive dir) — live and
+    rotated, any pid. The durable half of trace stitching: a SIGKILLed
+    replica's archived legs are still here. Torn/corrupt lines are
+    skipped (a crash can tear at most the tail line)."""
+    import glob as _glob
+
+    if directory is None:
+        directory = os.path.dirname(archive_path())
+    out: List[Dict[str, Any]] = []
+    needle = f'"{trace_id}"'
+    paths = sorted(_glob.glob(os.path.join(directory,
+                                           "trace_archive-*.jsonl*")))
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    if needle not in line:
+                        continue  # cheap pre-filter before json parse
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line
+                    if rec.get("trace_id") == trace_id:
+                        out.append(rec)
+                        if len(out) >= limit:
+                            return out
+        except OSError:
+            continue  # rotated away mid-scan
+    return out

@@ -1,0 +1,467 @@
+"""Distributed tracing (docs/observability.md, "Distributed tracing"):
+W3C traceparent accept/echo, trace-labeled spans, the configurable
+completed-span ring, OpenMetrics histogram exemplars, the TraceArchive
+tail-retention sink, and fleet-wide trace stitching."""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.serving import ContinuousServer, make_reply
+from synapseml_tpu.runtime import telemetry as tm
+from synapseml_tpu.runtime import tracearchive as ta
+
+TID = "ab" * 16
+SID = "cd" * 8
+
+
+# -- traceparent grammar ----------------------------------------------------
+
+def test_parse_traceparent_valid():
+    assert tm.parse_traceparent(f"00-{TID}-{SID}-01") == (TID, SID)
+    # unknown-but-parseable version: accepted (W3C forward compat),
+    # including trailing "-suffixed" data a future version may append
+    assert tm.parse_traceparent(f"42-{TID}-{SID}-00") == (TID, SID)
+    assert tm.parse_traceparent(
+        f"cc-{TID}-{SID}-01-futuredata") == (TID, SID)
+    # surrounding whitespace tolerated
+    assert tm.parse_traceparent(f"  00-{TID}-{SID}-01 ") == (TID, SID)
+
+
+@pytest.mark.parametrize("header", [
+    None, "", "garbage",
+    f"ff-{TID}-{SID}-01",             # version ff forbidden
+    f"00-{'0' * 32}-{SID}-01",        # all-zero trace id
+    f"00-{TID}-{'0' * 16}-01",        # all-zero parent id
+    f"00-{TID.upper()}-{SID}-01",     # uppercase hex is invalid
+    f"00-{TID[:-2]}-{SID}-01",        # short trace id
+    f"00-{TID}-{SID}",                # missing flags
+    f"00-{TID}-{SID}-01-extra",       # version 00 is EXACTLY 4 fields
+])
+def test_parse_traceparent_rejects(header):
+    assert tm.parse_traceparent(header) is None
+
+
+def test_format_traceparent_round_trips():
+    tp = tm.format_traceparent(TID, SID)
+    assert tm.parse_traceparent(tp) == (TID, SID)
+    assert tp.endswith("-01")
+    assert tm.format_traceparent(TID, SID, sampled=False).endswith("-00")
+
+
+def test_minted_ids_are_well_formed():
+    tid, sid = tm.mint_trace_id(), tm.mint_span_id()
+    assert tm.parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+
+
+# -- spans carry trace context ----------------------------------------------
+
+def test_span_adopts_and_mints_trace_context():
+    span = tm.start_span("rid-t1", trace_id=TID, parent_span_id=SID,
+                         origin="srv")
+    try:
+        assert (span.trace_id, span.parent_span_id) == (TID, SID)
+        assert span.origin == "srv"
+        bd = span.breakdown()
+        assert bd["trace_id"] == TID and bd["parent_span_id"] == SID
+        assert bd["origin"] == "srv" and bd["span_id"] == span.span_id
+        minted = tm.start_span("rid-t2")
+        assert len(minted.trace_id) == 32 and len(minted.span_id) == 16
+        assert minted.parent_span_id == ""
+        minted.finish()
+    finally:
+        span.finish()
+
+
+def test_trace_spans_collects_every_leg():
+    a = tm.start_span("rid-l1", trace_id=TID, origin="s1")
+    b = tm.start_span("rid-l2", trace_id=TID, origin="s2")
+    other = tm.start_span("rid-l3")
+    a.finish()
+    legs = tm.trace_spans(TID)
+    try:
+        rids = [leg["rid"] for leg in legs]
+        assert "rid-l1" in rids and "rid-l2" in rids  # done AND active
+        assert "rid-l3" not in rids
+        assert tm.trace_spans(tm.mint_trace_id()) == []
+    finally:
+        b.finish()
+        other.finish()
+
+
+# -- the completed-span ring knob (SYNAPSEML_SPAN_RING) ---------------------
+
+def test_span_ring_depth_regression():
+    """A deep ring retains, a shallow ring evicts — the operator knob
+    the 1024 hardcode became."""
+    prev = tm.span_ring_depth()
+    try:
+        tm.configure_span_ring(4)
+        for i in range(8):
+            tm.start_span(f"ring-{i}").finish()
+        held = {s["rid"] for s in tm.completed_spans(limit=64)
+                if s["rid"].startswith("ring-")}
+        assert held == {f"ring-{i}" for i in range(4, 8)}
+        tm.configure_span_ring(64)  # deep again: everything survives
+        for i in range(8, 16):
+            tm.start_span(f"ring-{i}").finish()
+        held = {s["rid"] for s in tm.completed_spans(limit=64)
+                if s["rid"].startswith("ring-")}
+        # the resize kept the newest 4 and the 8 new ones
+        assert held >= {f"ring-{i}" for i in range(4, 16)}
+    finally:
+        tm.configure_span_ring(prev)
+
+
+def test_span_ring_env_validation(monkeypatch):
+    prev = tm.span_ring_depth()
+    try:
+        monkeypatch.setenv("SYNAPSEML_SPAN_RING", "2048")
+        assert tm.configure_span_ring() == 2048
+        # 0, negative, and garbage all degrade to the default
+        for bad in ("0", "-5", "not-a-number"):
+            monkeypatch.setenv("SYNAPSEML_SPAN_RING", bad)
+            assert tm.configure_span_ring() == tm.DEFAULT_SPAN_RING
+        monkeypatch.delenv("SYNAPSEML_SPAN_RING")
+        assert tm.configure_span_ring() == tm.DEFAULT_SPAN_RING
+        with pytest.raises(ValueError):
+            tm.configure_span_ring(0)  # explicit bad arg raises
+    finally:
+        tm.configure_span_ring(prev)
+
+
+# -- exemplars --------------------------------------------------------------
+
+def test_histogram_exemplar_last_write_wins_per_bucket():
+    h = tm.histogram("serving_request_seconds", server="trace_unit")
+    h.observe(0.0003, exemplar="t" * 32)
+    h.observe(0.0004, exemplar=TID)   # same bucket: last write wins
+    h.observe(2.0)                    # no exemplar on this bucket
+    om = tm.prometheus_text(openmetrics=True)
+    line = next(ln for ln in om.splitlines()
+                if 'server="trace_unit"' in ln and f'"{TID}"' in ln)
+    assert f'# {{trace_id="{TID}"}} 0.0004' in line
+    assert ("t" * 32) not in om
+    assert om.rstrip().endswith("# EOF")
+    # the default exposition never carries exemplars
+    plain = tm.prometheus_text()
+    assert "trace_id=" not in plain and "# EOF" not in plain
+
+
+# -- TraceArchive -----------------------------------------------------------
+
+@pytest.fixture
+def archive(tmp_path):
+    prev_enabled = ta.set_enabled(True)
+    ta.configure(directory=str(tmp_path), head_every=0,
+                 max_bytes=ta.DEFAULT_MAX_BYTES)
+    yield str(tmp_path)
+    ta.reset()
+    ta.configure(directory=None, head_every=0)
+    ta._S.dir = None
+    ta.set_enabled(prev_enabled)
+
+
+def _finished_span(rid, trace_id, status="ok"):
+    span = tm.start_span(rid, trace_id=trace_id, origin="arch")
+    span.finish(status)
+    return span
+
+
+def test_archive_keeps_breaches_drops_healthy(archive):
+    kept = _finished_span("arch-bad", TID, status="error")
+    assert ta.maybe_archive(kept, 500, 0.01) == ta.CLASS_BREACH
+    # healthy + under threshold + head sampling off: dropped
+    healthy = _finished_span("arch-ok", tm.mint_trace_id())
+    assert ta.maybe_archive(healthy, 200, 0.01) is None
+    # latency breach archives even a 200
+    slow = _finished_span("arch-slow", tm.mint_trace_id())
+    assert ta.maybe_archive(slow, 200, 10.0,
+                            threshold_s=0.25) == ta.CLASS_BREACH
+    recs = ta.scan(TID, directory=archive)
+    assert len(recs) == 1 and recs[0]["rid"] == "arch-bad"
+    assert recs[0]["retention"] == ta.CLASS_BREACH
+    assert recs[0]["status_code"] == 500
+    assert ta.scan(healthy.trace_id, directory=archive) == []
+
+
+def test_archive_head_samples_healthy(archive):
+    ta.configure(head_every=2)  # every 2nd healthy reply
+    kept = 0
+    for i in range(6):
+        span = _finished_span(f"head-{i}", tm.mint_trace_id())
+        if ta.maybe_archive(span, 200, 0.001, threshold_s=1.0):
+            kept += 1
+    assert kept == 3
+
+
+def test_archive_rotation_is_atomic_and_bounded(archive):
+    ta.configure(max_bytes=4096)
+    for i in range(64):  # each record is a few hundred bytes
+        span = _finished_span(f"rot-{i}", tm.mint_trace_id(),
+                              status="error")
+        assert ta.maybe_archive(span, 500, 0.01)
+    live = ta.archive_path()
+    assert os.path.exists(live) and os.path.exists(live + ".1")
+    assert os.path.getsize(live) <= 4096 + 1024
+    # rotated records still scannable, torn tail lines tolerated
+    with open(live, "a", encoding="utf-8") as fh:
+        fh.write('{"torn')
+    some = ta.scan(_finished_span("rot-last", TID).trace_id,
+                   directory=archive)
+    assert some == []  # unarchived span: scan just returns nothing
+
+
+def test_archive_disabled_is_a_noop(archive):
+    ta.set_enabled(False)
+    span = _finished_span("off", TID, status="error")
+    assert ta.maybe_archive(span, 500, 0.01) is None
+    assert ta.scan(TID, directory=archive) == []
+
+
+# -- serving end to end -----------------------------------------------------
+
+def _echo_pipeline(table: Table) -> Table:
+    replies = np.empty(table.num_rows, dtype=object)
+    for i, v in enumerate(table["value"]):
+        replies[i] = make_reply({"echo": v})
+    return table.with_column("reply", replies)
+
+
+def _post(url, obj, headers=None, timeout=30):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST", headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, dict(e.headers or {}), body
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(urllib.request.Request(url),
+                                timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture
+def server(tmp_path):
+    ta.configure(directory=str(tmp_path), head_every=0)
+    cs = ContinuousServer("trace_e2e", _echo_pipeline,
+                          max_batch=8).start()
+    yield cs
+    cs.stop()
+    ta.reset()
+    ta._S.dir = None
+
+
+def test_serving_traceparent_round_trip(server):
+    st, hdrs, _body = _post(server.url, {"x": [1.0]},
+                            headers={"traceparent":
+                                     f"00-{TID}-{SID}-01"})
+    assert st == 200
+    echo = hdrs.get("traceparent", "")
+    parsed = tm.parse_traceparent(echo)
+    assert parsed is not None and parsed[0] == TID
+    assert parsed[1] != SID  # OUR span id, not an echo of the caller's
+    rid = hdrs["X-Request-Id"]
+    host = server.url.split("//")[1].rstrip("/")
+    st, span = _get_json(f"http://{host}/span/{rid}")
+    assert st == 200
+    assert span["trace_id"] == TID
+    assert span["parent_span_id"] == SID
+    assert span["span_id"] == parsed[1]  # header names the server leg
+    assert span["origin"] == "trace_e2e"
+
+
+def test_serving_mints_when_header_absent_or_bad(server):
+    for headers in ({}, {"traceparent": "not-a-traceparent"}):
+        st, hdrs, _body = _post(server.url, {"x": [2.0]},
+                                headers=headers)
+        assert st == 200
+        parsed = tm.parse_traceparent(hdrs.get("traceparent", ""))
+        assert parsed is not None  # minted, well-formed, echoed
+
+
+def test_serving_trace_endpoint(server):
+    tid = tm.mint_trace_id()
+    _post(server.url, {"x": [3.0]},
+          headers={"traceparent": f"00-{tid}-{SID}-01"})
+    host = server.url.split("//")[1].rstrip("/")
+    st, trace = _get_json(f"http://{host}/trace/{tid}")
+    assert st == 200
+    assert trace["trace_id"] == tid
+    assert len(trace["legs"]) == 1
+    assert trace["legs"][0]["origin"] == "trace_e2e"
+    # unknown trace: 404; malformed id: 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get_json(f"http://{host}/trace/{tm.mint_trace_id()}")
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get_json(f"http://{host}/trace/NOT-HEX")
+    assert ei.value.code == 400
+
+
+def test_serving_shed_paths_echo_traceparent(server):
+    server.server.begin_drain()
+    try:
+        st, hdrs, _body = _post(server.url, {"x": [4.0]},
+                                headers={"traceparent":
+                                         f"00-{TID}-{SID}-01"})
+        assert st == 503
+        parsed = tm.parse_traceparent(hdrs.get("traceparent", ""))
+        assert parsed is not None and parsed[0] == TID
+    finally:
+        server.server._draining.clear()
+
+
+def test_serving_breach_lands_in_archive(server, tmp_path):
+    tid = tm.mint_trace_id()
+    st, _hdrs, _body = _post(server.url, {"x": [5.0]},
+                             headers={"traceparent":
+                                      f"00-{tid}-{SID}-01",
+                                      "X-Deadline-Ms": "0.01"})
+    assert st == 504  # pre-expired deadline: shed before scoring
+    recs = ta.scan(tid, directory=str(tmp_path))
+    assert recs, "the 504 shed never reached the archive"
+    assert recs[0]["retention"] == ta.CLASS_BREACH
+    assert recs[0]["status_code"] == 504
+    assert recs[0]["origin"] == "trace_e2e"
+
+
+def test_serving_openmetrics_negotiation(server):
+    tid = tm.mint_trace_id()
+    _post(server.url, {"x": [6.0]},
+          headers={"traceparent": f"00-{tid}-{SID}-01"})
+    host = server.url.split("//")[1].rstrip("/")
+    req = urllib.request.Request(
+        f"http://{host}/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        om = r.read().decode()
+    assert f'trace_id="{tid}"' in om
+    assert om.rstrip().endswith("# EOF")
+    with urllib.request.urlopen(f"http://{host}/metrics",
+                                timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert "trace_id=" not in r.read().decode()
+
+
+# -- loadgen + fleet stitching ----------------------------------------------
+
+def test_loadgen_mints_traces_and_reports_slowest(server):
+    from tools.loadgen import run_load
+
+    s = run_load(server.url, rps=60, duration_s=0.4, shapes=[2],
+                 seed=9)
+    assert s["hung"] == 0
+    assert s["slowest"]
+    top = s["slowest"][0]
+    assert set(top) == {"rid", "trace_id", "latency_s", "status",
+                        "target"}
+    # the minted trace resolved server-side: its leg is in the store
+    legs = tm.trace_spans(top["trace_id"])
+    assert any(leg["rid"] == top["rid"] for leg in legs)
+    # seed-determinism: the same seed mints the same trace ids
+    s2 = run_load(server.url, rps=60, duration_s=0.4, shapes=[2],
+                  seed=9)
+    n = min(s["scheduled"], s2["scheduled"], 3)
+    assert n > 0
+
+
+def test_fleet_trace_stitching(tmp_path):
+    """The controller's /fleet/trace merges live legs from two
+    'replicas' (two in-process servers — distinct origins) with an
+    archived leg from a dead one, dedups shared span_ids, and caches
+    the stitched result."""
+    from synapseml_tpu.runtime.autoscale import FleetPolicy
+    from tools.fleet.controller import (FleetController,
+                                        LocalProcessBackend)
+
+    ta.configure(directory=str(tmp_path), head_every=0)
+    tid = tm.mint_trace_id()
+    a = ContinuousServer("fleet_tr_a", _echo_pipeline,
+                         max_batch=4).start()
+    b = ContinuousServer("fleet_tr_b", _echo_pipeline,
+                         max_batch=4).start()
+    controller = None
+    try:
+        tp = f"00-{tid}-{SID}-01"
+        st, _h, _ = _post(a.url, {"x": [1.0]},
+                          headers={"traceparent": tp})
+        assert st == 200
+        st, _h, _ = _post(b.url, {"x": [1.0]},
+                          headers={"traceparent": tp})
+        assert st == 200
+        # a third, "dead" replica testifies only through the archive
+        dead = tm.Span("dead-rid", trace_id=tid, origin="fleet_tr_dead")
+        dead.status = "error"
+        assert ta.maybe_archive(dead, 500, 0.02) == ta.CLASS_BREACH
+
+        class FakeReplica:
+            def __init__(self, name, url):
+                self.name, self.url = name, url
+
+            def alive(self):
+                return True
+
+        policy = FleetPolicy(min_replicas=1, max_replicas=2)
+        controller = FleetController(LocalProcessBackend(), policy,
+                                     archive_dir=str(tmp_path))
+        controller.replicas = [FakeReplica("fleet_tr_a", a.url),
+                               FakeReplica("fleet_tr_b", b.url)]
+        base = controller.serve()
+        st, stitched = _get_json(base + f"/fleet/trace/{tid}")
+        assert st == 200
+        legs = stitched["legs"]
+        origins = {leg["replica"] for leg in legs}
+        # both servers share one process-wide span store, so each
+        # fan-out returns BOTH live legs — dedup must leave exactly
+        # two live legs plus the archived one
+        assert {"fleet_tr_a", "fleet_tr_b",
+                "fleet_tr_dead"} <= origins
+        assert len(legs) == 3
+        assert all(leg["trace_id"] == tid for leg in legs)
+        archived = [leg for leg in legs if leg["source"] == "archive"]
+        assert len(archived) == 1
+        assert archived[0]["replica"] == "fleet_tr_dead"
+        # cached: a repeat inside the TTL returns the same payload
+        assert controller.stitch_trace(tid) is not None
+        assert tid in controller._trace_cache
+        # unknown trace: 404 from the endpoint
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(base + f"/fleet/trace/{tm.mint_trace_id()}")
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(base + "/fleet/trace/zz")
+        assert ei.value.code == 400
+    finally:
+        if controller is not None:
+            controller._stop.set()
+            if controller._httpd is not None:
+                controller._httpd.shutdown()
+                controller._httpd.server_close()
+        a.stop()
+        b.stop()
+        ta.reset()
+        ta._S.dir = None
+
+
+def test_flight_snapshot_embeds_completed_spans():
+    from synapseml_tpu.runtime import blackbox as bb
+
+    span = tm.start_span("flight-span", trace_id=TID)
+    span.finish()
+    snap = bb.snapshot(stacks=False)
+    assert "spans" in snap
+    assert any(s["rid"] == "flight-span" and s["trace_id"] == TID
+               for s in snap["spans"])

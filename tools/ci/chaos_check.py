@@ -461,7 +461,20 @@ def fleet_phase() -> int:
     3. A replica is SIGKILLed mid-load; loadgen's LB-style next-target
        retry keeps the run's SLO assertion (availability >= 0.99)
        green while the controller reaps the corpse.
-    4. The ramp ends; duty collapses -> the controller scales down via
+    4. Distributed tracing (round 16) across the kill: a doomed
+       request (pre-expired deadline -> 504, the SLO-breach retention
+       class) rides a KNOWN traceparent into the victim right before
+       the SIGKILL; after the kill its retry leg rides the SAME
+       traceparent into a surviving sibling. GET /fleet/trace/<id>
+       must stitch ONE trace with two legs on two replica ids — the
+       victim's from the shared TraceArchive (the process is gone; the
+       archive testifies), the sibling's live — and the same trace_id
+       must appear in the victim's captured structured log and as a
+       latency-bucket exemplar on the sibling's OpenMetrics
+       exposition. loadgen's --out `slowest` array is consumed the way
+       an operator would: its top entry's trace_id resolves via
+       /fleet/trace.
+    5. The ramp ends; duty collapses -> the controller scales down via
        SIGTERM graceful drain, and the drained child's own exit
        accounting proves zero admitted requests dropped.
 
@@ -499,6 +512,15 @@ def fleet_phase() -> int:
     with open(model_path, "wb") as fh:
         fh.write(zoo.mlp([16, 32], num_classes=4, seed=0))
     cache_dir = os.path.join(work, "cache")
+    # ONE shared forensics dir for the whole fleet: every replica's
+    # flight dumps AND trace-archive JSONL land here (--dump-dir), and
+    # the controller's /fleet/trace stitches archived legs from it —
+    # the surface that survives the SIGKILL below
+    flight_dir = os.path.join(work, "flight")
+    stderr_dir = os.path.join(work, "stderr")
+    replica_env = dict(os.environ)
+    replica_env["SYNAPSEML_LOG"] = "json"
+    replica_env["SYNAPSEML_LOG_LEVEL"] = "debug"
 
     bb.reset()
     log_buf = io.StringIO()
@@ -515,9 +537,11 @@ def fleet_phase() -> int:
         stale_after_s=5.0)
     backend = LocalProcessBackend(
         model=model_path, cache_dir=cache_dir, warmup="auto",
-        announce_timeout_s=300.0)
+        announce_timeout_s=300.0, dump_dir=flight_dir,
+        stderr_dir=stderr_dir, env=replica_env)
     controller = FleetController(backend, policy, interval_s=0.4,
-                                 initial_replicas=2)
+                                 initial_replicas=2,
+                                 archive_dir=flight_dir)
     base = controller.serve()
     lg_proc = None
     try:
@@ -618,10 +642,40 @@ def fleet_phase() -> int:
 
         # milestone 3: kill a loaded replica MID-LOAD (SIGKILL — a
         # crash, not a drain); loadgen's next-target retry is the LB,
-        # the controller reaps the corpse
+        # the controller reaps the corpse. Right before the kill, a
+        # DOOMED first trace leg lands on the victim: a pre-expired
+        # deadline rides a known traceparent in and is shed 504 — the
+        # SLO-breach retention class — so the victim's TraceArchive
+        # (on the shared dir) and its captured structured log both
+        # hold the trace when the process dies. The retry leg below
+        # reuses the traceparent on a sibling, exactly what loadgen's
+        # LB stand-in does on a socket death.
+        from synapseml_tpu.runtime import tracearchive as tarch
+
         victim = controller.replicas[0]
+        doomed_tid = "deadbeefcafef00d" * 2
+        doomed_tp = f"00-{doomed_tid}-00000000000000aa-01"
+        doomed_payload = {"features": [0.5] * 16}
+        st, _ = post(victim.url, doomed_payload,
+                     headers={"traceparent": doomed_tp,
+                              "X-Deadline-Ms": "0.01"})
+        if st != 504:
+            print(f"FAIL[fleet]: doomed leg on {victim.name} got {st},"
+                  " wanted a 504 deadline shed")
+            return 1
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                not tarch.scan(doomed_tid, directory=flight_dir):
+            time.sleep(0.1)
+        victim_legs = tarch.scan(doomed_tid, directory=flight_dir)
+        if not victim_legs or victim_legs[0].get("retention") != \
+                "slo_breach":
+            print(f"FAIL[fleet]: the 504 leg never reached the trace "
+                  f"archive under the slo_breach rule ({victim_legs})")
+            return 1
         victim.proc.kill()
-        print(f"killed {victim.name} mid-load", flush=True)
+        print(f"killed {victim.name} mid-load (doomed trace "
+              f"{doomed_tid[:8]}... archived first)", flush=True)
 
         out, _ = lg_proc.communicate(timeout=120)
         if lg_proc.returncode != 0:
@@ -646,6 +700,90 @@ def fleet_phase() -> int:
               f"{summary['by_status'].get('200', 0)}"
               f"/{summary['scheduled']} ok, "
               f"{summary['failover_retries']} failovers", flush=True)
+
+        # milestone 3b: the distributed-tracing loop across the kill.
+        # The retry leg rides the SAME traceparent into a survivor,
+        # then /fleet/trace must stitch ONE trace from the sibling's
+        # live span and the dead victim's archived 504 leg — two legs,
+        # two replica ids, one trace_id.
+        survivors = [r for r in controller.replicas
+                     if r.alive() and getattr(r, "url", None)]
+        if not survivors:
+            print("FAIL[fleet]: no surviving replica for the retry leg")
+            return 1
+        sibling = survivors[0]
+        st, _ = post(sibling.url, doomed_payload,
+                     headers={"traceparent": doomed_tp})
+        if st != 200:
+            print(f"FAIL[fleet]: retry leg on {sibling.name} got {st},"
+                  " wanted 200")
+            return 1
+        try:
+            stitched = get_json(base + f"/fleet/trace/{doomed_tid}")
+        except urllib.error.HTTPError as e:
+            print(f"FAIL[fleet]: /fleet/trace/{doomed_tid} answered "
+                  f"{e.code}")
+            return 1
+        legs = stitched.get("legs", [])
+        leg_replicas = {leg.get("replica") for leg in legs}
+        if len(legs) < 2 or len(leg_replicas) < 2:
+            print(f"FAIL[fleet]: stitched trace has {len(legs)} legs "
+                  f"on replicas {sorted(leg_replicas)}, wanted >=2 "
+                  f"legs on >=2 replicas ({stitched})")
+            return 1
+        if any(leg.get("trace_id") != doomed_tid for leg in legs):
+            print(f"FAIL[fleet]: stitched legs disagree on trace_id "
+                  f"({legs})")
+            return 1
+        if not any(leg.get("source") == "archive"
+                   and leg.get("replica") == victim.name
+                   for leg in legs):
+            print(f"FAIL[fleet]: the dead victim's leg did not come "
+                  f"from the trace archive ({legs})")
+            return 1
+        # the victim's captured structured log still names the trace —
+        # grep-by-trace works on a corpse's log
+        with open(victim.stderr_path, encoding="utf-8") as fh:
+            victim_log = fh.read()
+        if doomed_tid not in victim_log:
+            print(f"FAIL[fleet]: victim structured log carries no "
+                  f"{doomed_tid} line ({victim.stderr_path})")
+            return 1
+        # ...and the sibling's OpenMetrics exposition links a latency
+        # bucket to the same trace via an exemplar
+        om = urllib.request.urlopen(urllib.request.Request(
+            sibling.url.rstrip("/") + "/metrics",
+            headers={"Accept": "application/openmetrics-text"}),
+            timeout=10).read().decode()
+        if f'trace_id="{doomed_tid}"' not in om:
+            print(f"FAIL[fleet]: {sibling.name} OpenMetrics exposition "
+                  f"carries no exemplar for the failover trace")
+            return 1
+        # operator jump-off: loadgen's slowest array resolves straight
+        # to /fleet/trace (entries on the dead victim excluded — its
+        # unarchived healthy spans died with it)
+        surviving_ok = [e for e in summary.get("slowest", [])
+                        if e["status"] == "200"
+                        and e["target"] != victim.url]
+        if not surviving_ok:
+            print(f"FAIL[fleet]: loadgen slowest array unusable "
+                  f"({summary.get('slowest')})")
+            return 1
+        top = surviving_ok[0]
+        try:
+            jump = get_json(base + f"/fleet/trace/{top['trace_id']}")
+        except urllib.error.HTTPError as e:
+            print(f"FAIL[fleet]: slowest entry {top} did not resolve "
+                  f"via /fleet/trace ({e.code})")
+            return 1
+        if not jump.get("legs"):
+            print(f"FAIL[fleet]: slowest entry {top} stitched zero "
+                  f"legs")
+            return 1
+        print(f"trace stitched across the kill: {len(legs)} legs on "
+              f"{sorted(leg_replicas)}, victim leg from the archive; "
+              f"slowest [{top['latency_s'] * 1e3:.1f}ms {top['rid'][:8]}"
+              f"...] resolves via /fleet/trace", flush=True)
 
         # milestone 4: the ramp is over — duty collapses and the
         # controller scales down via SIGTERM graceful drain; the

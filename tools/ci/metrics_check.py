@@ -17,6 +17,11 @@ request is posted, and ``executor_recompiles_total{reason=
 gauges are in CORE_SERIES and ``GET /debug/memory`` must answer
 mid-run with a record per local device.
 
+And the distributed-tracing surface (round 16): a request with a known
+``traceparent`` must echo the same trace id, report it on
+``/span/<rid>``, and land it as a histogram-bucket exemplar on the
+Accept-negotiated OpenMetrics exposition.
+
 Exit 0 = every assertion held; any failure prints the offending series
 and exits nonzero.
 """
@@ -328,6 +333,54 @@ def main() -> int:
             print(f"span {rid} missing stages: {sorted(need - stages)}")
             return 1
 
+        # distributed-trace round trip (docs/observability.md,
+        # "Distributed tracing"): a request with a KNOWN traceparent
+        # must echo our leg's traceparent under the same trace id,
+        # /span/<rid> must report that trace id, and the
+        # Accept-negotiated OpenMetrics exposition must carry a bucket
+        # exemplar naming it
+        known_tid = "feedfacecafebeef" * 2
+        conn.request("POST", "/",
+                     json.dumps({"x": [1.0, 2.0]}).encode(),
+                     {"Content-Type": "application/json",
+                      "traceparent":
+                          f"00-{known_tid}-1234567890abcdef-01"})
+        resp = conn.getresponse()
+        tr_body = resp.read()
+        assert resp.status == 200, (resp.status, tr_body)
+        tr_rid = resp.getheader("X-Request-Id")
+        echo = resp.getheader("traceparent") or ""
+        if not echo.startswith(f"00-{known_tid}-"):
+            print(f"traceparent echo lost the caller's trace id: "
+                  f"{echo!r}")
+            return 1
+        conn.request("GET", f"/span/{tr_rid}")
+        resp = conn.getresponse()
+        tr_span = json.loads(resp.read())
+        assert resp.status == 200, resp.status
+        if tr_span.get("trace_id") != known_tid:
+            print(f"span {tr_rid} does not carry the caller's trace "
+                  f"id: {tr_span.get('trace_id')!r}")
+            return 1
+        conn.request("GET", "/metrics",
+                     headers={"Accept":
+                              "application/openmetrics-text"})
+        resp = conn.getresponse()
+        om = resp.read().decode()
+        om_ctype = resp.getheader("Content-Type", "")
+        assert resp.status == 200, resp.status
+        if not om_ctype.startswith("application/openmetrics-text"):
+            print(f"OpenMetrics Accept negotiation failed: "
+                  f"Content-Type {om_ctype!r}")
+            return 1
+        if "# EOF" not in om or '# {trace_id="' not in om:
+            print("OpenMetrics exposition carries no exemplar")
+            return 1
+        if f'trace_id="{known_tid}"' not in om:
+            print("the known trace id never landed as a latency-"
+                  "bucket exemplar")
+            return 1
+
         print("metrics smoke ok:",
               f"{len(first.splitlines())} exposition lines,",
               "requests="
@@ -335,7 +388,8 @@ def main() -> int:
               f"recompiles={recompiles_after:.0f},",
               f"memory devices={len(mem['devices'])},",
               f"cost signatures={len(cost['entries'])},",
-              f"span stages={sorted(stages)}")
+              f"span stages={sorted(stages)},",
+              "traceparent round trip + exemplar ok")
     finally:
         cs.stop()
     return channel_phase()

@@ -28,7 +28,7 @@ import os
 import sys
 
 PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
-            "fleet_", "process_")
+            "fleet_", "process_", "trace_")
 REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
 
 HERE = os.path.dirname(os.path.abspath(__file__))

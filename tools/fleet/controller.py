@@ -31,6 +31,16 @@ post-warmup recompiles + zero store skew = ``warm``; counted in
 ``fleet_hydrations_total{outcome=}`` and recorded as a
 ``fleet_hydration`` flight event.
 
+Distributed-trace stitching (round 16): ``GET /fleet/trace/<trace_id>``
+answers the incident question PR 8's failover made unanswerable —
+"where did THIS request spend its time, across which replicas" — by
+fanning out to every live replica's ``/trace`` surface and merging the
+legs with the shared trace archive's records
+(:mod:`synapseml_tpu.runtime.tracearchive`; ``--dump-dir`` is the
+shared directory), behind a bounded cache of recently stitched
+traces. Archive merge is what keeps a SIGKILLed replica's legs
+retrievable after the process is gone.
+
 Fleet observability: the controller serves ``GET /fleet/status``
 (JSON: per-replica state + samples, aggregates, the last decisions)
 and ``GET /fleet/metrics`` (its own Prometheus registry —
@@ -162,13 +172,25 @@ class LocalProcessBackend:
                  warmup: Optional[str] = None,
                  extra_args: Optional[List[str]] = None,
                  env: Optional[Dict[str, str]] = None,
-                 announce_timeout_s: float = 120.0):
+                 announce_timeout_s: float = 120.0,
+                 dump_dir: Optional[str] = None,
+                 stderr_dir: Optional[str] = None):
+        """``dump_dir``: forwarded to every replica as ``--dump-dir``,
+        so flight dumps AND trace-archive files from the whole fleet
+        land in ONE directory — which is what lets the controller's
+        ``/fleet/trace`` stitch a SIGKILLed replica's archived legs
+        after the process is gone. ``stderr_dir``: capture each
+        replica's stderr (the structured log when ``SYNAPSEML_LOG`` is
+        set in ``env``) to ``<stderr_dir>/<name>.stderr.log`` instead
+        of devnull — a dead replica's log is forensics, not noise."""
         self.model = model
         self.cache_dir = cache_dir
         self.warmup = warmup
         self.extra_args = list(extra_args or [])
         self.env = env
         self.announce_timeout_s = announce_timeout_s
+        self.dump_dir = dump_dir
+        self.stderr_dir = stderr_dir
         self._seq = 0
 
     def _child_env(self) -> Dict[str, str]:
@@ -197,11 +219,25 @@ class LocalProcessBackend:
             argv += ["--cache-dir", self.cache_dir]
         if self.warmup:
             argv += ["--warmup", self.warmup]
+        if self.dump_dir:
+            argv += ["--dump-dir", self.dump_dir]
         argv += self.extra_args
-        proc = subprocess.Popen(
-            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, env=self._child_env(), cwd=_ROOT)
+        stderr_file = subprocess.DEVNULL
+        stderr_path = None
+        if self.stderr_dir:
+            os.makedirs(self.stderr_dir, exist_ok=True)
+            stderr_path = os.path.join(self.stderr_dir,
+                                       f"{name}.stderr.log")
+            stderr_file = open(stderr_path, "a", encoding="utf-8")
+        try:
+            proc = subprocess.Popen(
+                argv, stdout=subprocess.PIPE, stderr=stderr_file,
+                text=True, env=self._child_env(), cwd=_ROOT)
+        finally:
+            if stderr_path is not None:
+                stderr_file.close()  # the child holds its own fd
         replica = LocalReplica(name, proc)
+        replica.stderr_path = stderr_path
         if replica.wait_url(self.announce_timeout_s) is None:
             proc.kill()
             proc.wait(timeout=10)
@@ -248,14 +284,28 @@ class FleetController:
     injectable so the decision loop is testable without HTTP; the
     default polls the replica's real endpoints."""
 
+    _TRACE_CACHE_MAX = 64
+    _TRACE_CACHE_TTL_S = 2.0
+
     def __init__(self, backend: LocalProcessBackend,
                  policy: "_as.FleetPolicy",
                  interval_s: float = 2.0,
                  initial_replicas: Optional[int] = None,
                  scrape_timeout_s: float = 2.0,
-                 scrape_fn: Optional[Callable[[Any], Any]] = None):
+                 scrape_fn: Optional[Callable[[Any], Any]] = None,
+                 archive_dir: Optional[str] = None):
+        """``archive_dir``: where the fleet's trace-archive JSONL files
+        live (the backend's shared ``dump_dir``) — ``/fleet/trace``
+        merges archived legs from here with live ``/trace`` fan-out,
+        which is what makes a SIGKILLed replica's legs stitchable."""
         self.backend = backend
         self.policy = policy
+        self.archive_dir = archive_dir
+        # bounded cache of recently stitched traces: repeat reads of a
+        # hot incident trace (dashboard link-outs, a runbook loop)
+        # skip the fleet fan-out inside the TTL; insertion-ordered
+        # dict, oldest evicted past the cap
+        self._trace_cache: Dict[str, Any] = {}
         self.interval_s = float(interval_s)
         self.initial_replicas = min(policy.max_replicas, max(
             policy.min_replicas,
@@ -332,6 +382,70 @@ class FleetController:
                 "decisions": list(self._decisions[-8:]),
             }
 
+    def stitch_trace(self, trace_id: str) -> Dict[str, Any]:
+        """One distributed trace, fleet-wide: fan out to every live
+        replica's ``GET /trace/<trace_id>`` and merge the legs with
+        any records the shared trace archive holds (``archive_dir``) —
+        live legs win on a shared span_id, archived legs are how a
+        SIGKILLed replica still testifies. Legs come back
+        wall-clock-ordered, each naming its replica; recently stitched
+        traces are served from a bounded TTL cache."""
+        now = time.monotonic()
+        with self._lock:
+            hit = self._trace_cache.get(trace_id)
+            if hit is not None and now - hit[0] < self._TRACE_CACHE_TTL_S:
+                return hit[1]
+            replicas = list(self.replicas)
+        legs: Dict[str, Dict[str, Any]] = {}
+        queried = 0
+        for r in replicas:
+            url = getattr(r, "url", None)
+            if not url:
+                continue
+            queried += 1
+            raw = _http_get(url.rstrip("/") + f"/trace/{trace_id}",
+                            self.scrape_timeout_s)
+            if raw is None:
+                continue  # dead/warming replica: the archive may testify
+            try:
+                payload = json.loads(raw)
+            except ValueError:
+                continue
+            for leg in payload.get("legs", ()):
+                leg = dict(leg)
+                leg["source"] = "live"
+                leg["replica"] = leg.get("origin") or r.name
+                legs.setdefault(leg.get("span_id")
+                                or f"live{len(legs)}", leg)
+        archived = 0
+        if self.archive_dir:
+            from synapseml_tpu.runtime import tracearchive as _tarch
+
+            for rec in _tarch.scan(trace_id, directory=self.archive_dir):
+                key = rec.get("span_id") or f"arch{archived}"
+                if key in legs:
+                    continue  # the live span store is fresher
+                leg = dict(rec)
+                leg["source"] = "archive"
+                leg["replica"] = leg.get("origin") or ""
+                legs[key] = leg
+                archived += 1
+        merged = sorted(legs.values(),
+                        key=lambda leg: leg.get("ts") or 0.0)
+        payload = {"trace_id": trace_id, "legs": merged,
+                   "replicas": sorted({leg["replica"] for leg in merged
+                                       if leg.get("replica")}),
+                   "replicas_queried": queried,
+                   "archived_legs": archived,
+                   "stitched_ts": round(time.time(), 6)}
+        _as.trace_stitch_counter(
+            "found" if merged else "not_found").inc()
+        with self._lock:
+            self._trace_cache[trace_id] = (now, payload)
+            while len(self._trace_cache) > self._TRACE_CACHE_MAX:
+                self._trace_cache.pop(next(iter(self._trace_cache)))
+        return payload
+
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> str:
         """Bind the controller's observability endpoints; returns the
         base URL."""
@@ -355,6 +469,20 @@ class FleetController:
                 if self.path == "/fleet/status":
                     self._send(200, json.dumps(
                         controller.status(), default=repr).encode())
+                elif self.path.startswith("/fleet/trace/"):
+                    # the cross-replica trace view: merged live +
+                    # archived legs for one trace id (404 = no replica
+                    # and no archive file holds a leg)
+                    tid = (self.path[len("/fleet/trace/"):]
+                           .strip("/").lower())
+                    if not re.fullmatch(r"[0-9a-f]{32}", tid):
+                        self._send(400, b'{"error": "trace id must be '
+                                        b'32 lowercase hex chars"}')
+                        return
+                    payload = controller.stitch_trace(tid)
+                    self._send(200 if payload["legs"] else 404,
+                               json.dumps(payload,
+                                          default=repr).encode())
                 elif self.path in ("/fleet/metrics", "/metrics"):
                     self._send(
                         200, _tm.prometheus_text().encode(),
@@ -633,6 +761,16 @@ def main(argv=None) -> int:
     ap.add_argument("--replica-arg", action="append", default=[],
                     help="extra argv token passed to every replica "
                          "(repeatable)")
+    ap.add_argument("--dump-dir", default=os.environ.get(
+        "SYNAPSEML_DUMP_DIR") or None,
+        help="shared forensics dir forwarded to every replica "
+             "(--dump-dir): flight dumps + trace-archive JSONL land "
+             "here, and /fleet/trace stitches archived legs from it — "
+             "a SIGKILLed replica's legs stay retrievable")
+    ap.add_argument("--stderr-dir", default=None,
+                    help="capture each replica's stderr (its "
+                         "structured log) to <dir>/<name>.stderr.log "
+                         "instead of devnull")
     ap.add_argument("--port", type=int, default=8899,
                     help="controller HTTP port (/fleet/status, "
                          "/fleet/metrics); 0 = OS-assigned")
@@ -670,10 +808,12 @@ def main(argv=None) -> int:
         return 2
     backend = LocalProcessBackend(
         model=args.model, cache_dir=args.cache_dir, warmup=args.warmup,
-        extra_args=args.replica_arg)
+        extra_args=args.replica_arg, dump_dir=args.dump_dir,
+        stderr_dir=args.stderr_dir)
     controller = FleetController(backend, policy,
                                  interval_s=args.interval,
-                                 initial_replicas=args.initial)
+                                 initial_replicas=args.initial,
+                                 archive_dir=args.dump_dir)
     url = controller.serve(host=args.host, port=args.port)
     print(f"fleet controller on {url} (GET /fleet/status, "
           f"/fleet/metrics)", flush=True)

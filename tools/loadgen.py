@@ -35,6 +35,13 @@ load-balancer stand-in) with a per-target status/latency breakdown in
 the summary; a request whose send dies at the socket level retries
 once on the next target, the way an LB health-checks a member out.
 
+Distributed tracing: every scheduled arrival carries a minted W3C
+``traceparent`` header (seed-deterministic), REUSED on the failover
+retry leg — one request, one trace, however many replicas it crossed.
+The summary's ``slowest`` array (top-10 by latency: rid, trace_id,
+status, target) links a bench/chaos report straight to
+``GET /fleet/trace/<trace_id>`` on the fleet controller.
+
 Usage (also importable: :func:`run_load` drives the chaos CI scenarios
 in tools/ci/chaos_check.py)::
 
@@ -73,12 +80,16 @@ def percentile(sorted_vals: Sequence[float], q: float) -> float:
 
 
 def _send(url: str, body: bytes, headers: Dict[str, str],
-          timeout: float) -> Tuple[Any, Optional[bytes]]:
+          timeout: float) -> Tuple[Any, Optional[str]]:
+    """``(status, rid)`` for one attempt — the rid comes back from the
+    server's ``X-Request-Id`` reply header (every reply path echoes
+    one), so a summary entry can link straight to ``/span/<rid>``."""
     req = urllib.request.Request(url, data=body, method="POST",
                                  headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, r.read()
+            r.read()
+            return r.status, r.headers.get("X-Request-Id")
     except urllib.error.HTTPError as e:
         # explicit non-2xx IS a terminal reply (shed/drain/error paths);
         # read drains the connection so keep-alive sockets recycle
@@ -86,7 +97,8 @@ def _send(url: str, body: bytes, headers: Dict[str, str],
             e.read()
         except Exception:  # noqa: BLE001 - best-effort drain
             pass
-        return e.code, None
+        return e.code, (e.headers.get("X-Request-Id")
+                        if e.headers is not None else None)
     except Exception:  # noqa: BLE001 - refused/reset/socket timeout
         return "error", None
 
@@ -99,7 +111,8 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
              payload_fn: Callable[[int, int], Any] = _default_payload,
              on_result: Optional[Callable[[int, Any, float], None]] = None,
              stop: Optional[threading.Event] = None,
-             targets: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+             targets: Optional[Sequence[str]] = None,
+             slowest_n: int = 10) -> Dict[str, Any]:
     """Drive ``rps`` Poisson arrivals against ``url`` for ``duration_s``
     seconds; block until every sender reaches a terminal record; return
     the summary dict. ``seed`` makes the arrival schedule and shape
@@ -117,7 +130,16 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
     replies (including sheds) never retry. The summary gains
     ``per_target`` (every attempt's status + ok-latency percentiles
     per endpoint) and ``failover_retries``; top-level ``by_status``
-    stays final-outcome-per-request, so the SLO math is unchanged."""
+    stays final-outcome-per-request, so the SLO math is unchanged.
+
+    Distributed tracing: every scheduled arrival mints one W3C
+    ``traceparent`` (deterministic under ``seed``) and the failover
+    retry leg REUSES it — a killed-replica request is therefore ONE
+    trace with two sibling legs, stitchable fleet-wide via
+    ``GET /fleet/trace/<trace_id>``. The summary's ``slowest`` array
+    (top ``slowest_n`` by latency: rid, trace_id, latency_s, status,
+    target) is the jump-off from a bench/chaos report to exactly that
+    endpoint."""
     rng = random.Random(seed)
     headers = {"Content-Type": "application/json"}
     if deadline_ms is not None:
@@ -128,7 +150,8 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
     if not target_list:
         raise ValueError("run_load needs a url or a non-empty targets")
 
-    results: List[Optional[Tuple[Any, float]]] = []
+    results: List[Optional[Tuple[Any, float, Optional[str], str,
+                                 str]]] = []
     senders: List[threading.Thread] = []
     lock = threading.Lock()
     per_target: Dict[str, Dict[str, Any]] = {
@@ -142,26 +165,29 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
         if status == 200:
             rec["ok_lat"].append(dt)
 
-    def sender(i: int, body: bytes):
+    def sender(i: int, body: bytes, trace_id: str, traceparent: str):
+        hdrs = dict(headers)
+        hdrs["traceparent"] = traceparent
         target = target_list[i % len(target_list)]
         t0 = time.monotonic()
-        status, _ = _send(target, body, headers, timeout)
+        status, rid = _send(target, body, hdrs, timeout)
         with lock:
             _record_attempt(target, status, time.monotonic() - t0)
         if status == "error" and len(target_list) > 1:
             # LB-style one-shot failover on transport death only: the
             # request never reached an HTTP layer, so re-sending it to
             # a sibling cannot double-apply it any more than an LB
-            # retry would
+            # retry would. The SAME traceparent rides the retry leg,
+            # so both attempts stitch into one trace.
             target = target_list[(i + 1) % len(target_list)]
             t1 = time.monotonic()
-            status, _ = _send(target, body, headers, timeout)
+            status, rid = _send(target, body, hdrs, timeout)
             with lock:
                 failovers[0] += 1
                 _record_attempt(target, status, time.monotonic() - t1)
         dt = time.monotonic() - t0
         with lock:
-            results[i] = (status, dt)
+            results[i] = (status, dt, rid, trace_id, target)
         if on_result is not None:
             on_result(i, status, dt)
 
@@ -175,9 +201,17 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
             time.sleep(delay)
         body = json.dumps(
             payload_fn(i, shapes[i % len(shapes)])).encode()
+        # one trace per scheduled arrival (or-1 guards the 2^-128
+        # all-zero draw the W3C grammar forbids); deterministic under
+        # --seed like the schedule itself
+        trace_id = "%032x" % (rng.getrandbits(128) or 1)
+        traceparent = "00-%s-%016x-01" % (trace_id,
+                                          rng.getrandbits(64) or 1)
         with lock:
             results.append(None)
-        t = threading.Thread(target=sender, args=(i, body), daemon=True)
+        t = threading.Thread(target=sender,
+                             args=(i, body, trace_id, traceparent),
+                             daemon=True)
         t.start()
         senders.append(t)
         i += 1
@@ -197,6 +231,7 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
     by_status: Dict[str, int] = {}
     ok_lat: List[float] = []
     all_lat: List[float] = []
+    terminal: List[Tuple[Any, float, Optional[str], str, str]] = []
     hung = 0
     with lock:
         snapshot = list(results)
@@ -204,13 +239,24 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
         if rec is None:
             hung += 1  # sender never recorded: the one forbidden outcome
             continue
-        status, dt = rec
+        status, dt, _rid, _tid, _target = rec
+        terminal.append(rec)
         by_status[str(status)] = by_status.get(str(status), 0) + 1
         all_lat.append(dt)
         if status == 200:
             ok_lat.append(dt)
     ok_lat.sort()
     all_lat.sort()
+    # the operator's jump-off: top-N slowest terminal requests, each
+    # with the keys that resolve it — /span/<rid> on the replica,
+    # GET /fleet/trace/<trace_id> on the controller (chaos_check's
+    # fleet phase consumes exactly this array)
+    slowest = [
+        {"rid": rid, "trace_id": tid, "latency_s": round(dt, 6),
+         "status": str(status), "target": target}
+        for status, dt, rid, tid, target in
+        sorted(terminal, key=lambda r: r[1],
+               reverse=True)[:max(0, slowest_n)]]
     summary = {
         "scheduled": i,
         "hung": hung,
@@ -223,6 +269,7 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
                          for q in (50.0, 95.0, 99.0)},
         "latency_all_s": {q: percentile(all_lat, q)
                           for q in (50.0, 95.0, 99.0)},
+        "slowest": slowest,
     }
     if len(target_list) > 1 or targets:
         with lock:
