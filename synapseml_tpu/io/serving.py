@@ -18,6 +18,7 @@ drives source -> pipeline -> sink in a loop thread (the serving query).
 from __future__ import annotations
 
 import errno
+import hashlib
 import http.server
 import json
 import math
@@ -38,6 +39,7 @@ import numpy as np
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.io.http import HTTPRequestData, HTTPResponseData
 from synapseml_tpu.runtime import blackbox as _bb
+from synapseml_tpu.runtime import capture as _cap
 from synapseml_tpu.runtime import costmodel as _cm
 from synapseml_tpu.runtime import faults as _flt
 from synapseml_tpu.runtime import perfwatch as _pw
@@ -544,6 +546,14 @@ class WorkerServer:
                     _bb.record("shed_drain", rid=rid, level="warn",
                                trace=trace_id, server=outer.name)
                     self._send_plain(503, b"draining", headers=retry_hdr)
+                    # incident capture: an enqueue-path shed never
+                    # reaches the reply handler's retention hook below,
+                    # so the breach is captured here — after the socket
+                    # write, like every capture
+                    _cap.maybe_capture(
+                        req, 503, 0.0, rid=rid, trace_id=trace_id,
+                        origin=outer.name,
+                        threshold_s=outer.slo_latency_threshold_s)
                     return
                 if (outer.max_queue is not None
                         and outer.requests.qsize() >= outer.max_queue):
@@ -559,6 +569,10 @@ class WorkerServer:
                                depth=outer.requests.qsize())
                     self._send_plain(429, b"request queue full",
                                      headers=retry_hdr)
+                    _cap.maybe_capture(
+                        req, 429, 0.0, rid=rid, trace_id=trace_id,
+                        origin=outer.name,
+                        threshold_s=outer.slo_latency_threshold_s)
                     return
                 deadline_ms = outer.default_deadline_ms
                 hdr = self.headers.get("X-Deadline-Ms")
@@ -601,6 +615,28 @@ class WorkerServer:
                 status = resp.status_code if resp is not None else 504
                 outer._reply_counter(status).inc()
                 dt = time.monotonic() - cr.arrival
+                # output digest: sha256 over the exact reply bytes,
+                # echoed as X-Output-Digest and stamped on the span —
+                # the determinism fingerprint clients, loadgen, and
+                # tools/replay.py verify without storing the output.
+                # Computed once per reply (~2.6us at 32B, ~6us at 4KiB
+                # on the CI box), before the headers leave.
+                body = (resp.entity or b"") if resp is not None else b""
+                if resp is not None:
+                    digest = hashlib.sha256(body).hexdigest()
+                    if cr.span.span_id:
+                        # raw attribute write, so it must skip the
+                        # shared _NOOP_SPAN (span_id "") telemetry
+                        # hands out when disabled — stamping that
+                        # singleton would smear one request's digest
+                        # across every concurrent handler
+                        cr.span.output_digest = digest
+                else:
+                    # a reply-timeout 504 sends no body and no digest
+                    # header: stamping sha256(b"") would hand forensics
+                    # a concrete-looking fingerprint for a reply that
+                    # carried none
+                    digest = ""
                 # exemplar: this trace becomes the covering latency
                 # bucket's link-out (last-write-wins slot assignment —
                 # still no lock on the request path)
@@ -624,7 +660,6 @@ class WorkerServer:
                         self.send_header("Content-Length", "0")
                         self.end_headers()
                     else:
-                        body = resp.entity or b""
                         self.send_response(resp.status_code)
                         for k, v in resp.headers.items():
                             if k.lower() not in ("content-length",
@@ -633,14 +668,29 @@ class WorkerServer:
                         # rid correlates the reply with its trace span
                         # (the telemetry e2e test asserts this header
                         # matches the span record); traceparent hands
-                        # the caller its continued trace context back
+                        # the caller its continued trace context back;
+                        # X-Output-Digest lets the caller assert
+                        # determinism against a replay without either
+                        # side storing the body
                         self.send_header("X-Request-Id", rid)
                         self.send_header("traceparent", tp_echo)
+                        self.send_header("X-Output-Digest", digest)
                         self.send_header("Content-Length",
                                          str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
                 finally:
+                    # the reply bytes must be ON the socket before any
+                    # retention work: the wfile buffer normally flushes
+                    # at handler return, which would put the archive +
+                    # capture file writes below BETWEEN the client's
+                    # reply and its flush — a process exiting mid-drain
+                    # kills daemon handler threads parked there,
+                    # turning committed replies into connection resets
+                    try:
+                        self.wfile.flush()
+                    except OSError:
+                        pass  # client hung up: still breach evidence
                     # tail-based retention: the outcome is known here —
                     # breaches (5xx / shed / over-threshold latency)
                     # and the head-sampled healthy few land one JSONL
@@ -653,6 +703,18 @@ class WorkerServer:
                     _ta.maybe_archive(
                         cr.span, status, dt,
                         threshold_s=outer.slo_latency_threshold_s)
+                    # incident capture (runtime/capture.py): same
+                    # tail-based decision, but keeping the request
+                    # BYTES — the replay harness's input. Also after
+                    # the socket write: a slow capture volume delays
+                    # forensics, never the reply
+                    _cap.maybe_capture(
+                        cr.request, status, dt, rid=rid,
+                        trace_id=trace_id, span_id=cr.span.span_id,
+                        origin=outer.name, digest=digest,
+                        reply_entity=(resp.entity or b""
+                                      if resp is not None else None),
+                        threshold_s=outer.slo_latency_threshold_s)
 
             def _send_plain(self, status: int, body: bytes,
                             content_type: str = "text/plain",
@@ -664,6 +726,20 @@ class WorkerServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                # flush NOW, not at handler return: the wfile is a
+                # 64KB buffer, and the shed paths do post-reply work
+                # (incident capture — a file write) after sending. A
+                # draining process exits by killing daemon handler
+                # threads; one parked in that work with its 503 still
+                # buffered turns a clean shed into a client-visible
+                # connection reset (found by the chaos sigterm phase:
+                # zero drain 503s observed once capture landed there).
+                # OSError-tolerant: a client that already hung up must
+                # not skip the capture that follows at the call site
+                try:
+                    self.wfile.flush()
+                except OSError:
+                    pass
 
             def do_GET(self):
                 if self.path == "/health/live":
@@ -772,6 +848,38 @@ class WorkerServer:
                         200,
                         json.dumps(_cm.snapshot(),
                                    default=repr).encode("utf-8"),
+                        "application/json")
+                    return
+                if self.path.startswith("/debug/capture"):
+                    # the incident-capture ledger (runtime/capture.py):
+                    # last-N record summaries + the live file's path/
+                    # size, so an operator can confirm a breach was
+                    # kept — and where to point tools/replay.py —
+                    # without shelling into the pod. Bodies are elided
+                    # (the file has them); behind the same
+                    # SYNAPSEML_DEBUG_ENDPOINTS gate as the whole
+                    # /debug surface (403 handled above)
+                    params = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query)
+                    try:
+                        n = int(params.get("n", ["32"])[0])
+                    except ValueError:
+                        n = 32
+                    cap_path = _cap.capture_path()
+                    try:
+                        cap_size = os.path.getsize(cap_path)
+                    except OSError:
+                        cap_size = 0
+                    self._send_plain(
+                        200,
+                        json.dumps({
+                            "enabled": _cap.enabled(),
+                            "path": cap_path,
+                            "size_bytes": cap_size,
+                            "model_hash": _cap.model_hash(),
+                            "records": _cap.tail_summaries(
+                                max(1, min(256, n))),
+                        }, default=repr).encode("utf-8"),
                         "application/json")
                     return
                 if self.path.startswith("/debug/profile"):
@@ -2441,12 +2549,19 @@ def _model_pipeline(model_path: str, devices=None, cache_dir=None):
     import numpy as np
 
     from synapseml_tpu.onnx import ONNXModel
+    from synapseml_tpu.runtime import compile_cache as _cc
 
     model = ONNXModel(model_path=model_path)
     if devices is not None:
         model.set(devices=devices)
     if cache_dir is not None:
         model.set(compile_cache_dir=cache_dir)
+    # every capture record carries the scoring model's content hash
+    # (the compile-cache key ingredient): tools/replay.py recomputes
+    # the same hash over the model file it is handed and refuses a
+    # mismatch — replaying yesterday's incident against today's
+    # weights would "diverge" meaninglessly
+    _cap.set_model_hash(_cc.content_hash(model.model_payload or b""))
     feed = model.graph.input_names[0]
 
     def pipeline(table: Table) -> Table:

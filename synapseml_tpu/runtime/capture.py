@@ -1,0 +1,522 @@
+"""Incident capture: tail-based payload retention for deterministic replay.
+
+The trace archive (``runtime/tracearchive.py``) keeps the *timing* of
+the requests worth keeping; the flight recorder keeps the *process
+state* around an incident. Neither keeps the request itself — so
+"does this 500 reproduce?" and "did the rollout change scores?" were
+unanswerable the moment the reply left the socket. This module is the
+missing forensic surface: at reply time, when the outcome is known
+(the same Dapper-style tail decision the trace archive makes), the
+request's **exact input bytes** land in a JSONL capture file that
+``tools/replay.py`` can re-score offline and diff bit-for-bit.
+
+Retention policy (:func:`classify`):
+
+- **every SLO-breaching request is kept**: a 5xx reply
+  (``error_5xx``), an admission/drain shed (429/503, ``shed``), a
+  deadline expiry or reply timeout (504, ``deadline``), a poison
+  payload the bisection isolated (400, ``poison``), or a roundtrip
+  over the latency threshold (``SYNAPSEML_SLO_LATENCY_MS``,
+  ``slo_latency``);
+- **a head-sampled healthy fraction** rides along
+  (``SYNAPSEML_CAPTURE_HEAD_SAMPLE``, default 0.01 — every Nth healthy
+  reply), so a replay run can assert what *normal* scoring looks like
+  next to the breaches;
+- everything else takes the lock-free drop path
+  (``capture_dropped_total``) — the healthy hot path pays a handful of
+  integer compares, and with ``SYNAPSEML_CAPTURE=0`` a single flag
+  test.
+
+Each record is **self-contained** for replay: the payload bytes (utf-8
+text inline, else base64), best-effort shapes/dtypes of the JSON
+feature lists, rid/trace_id/span_id, the model content hash (the same
+``content_hash`` ingredient the compile-cache key uses — replay
+verifies it against the model file it was handed), the ``/debug/build``
+git sha, the reply status, and the sha256 **output digest** computed
+from the reply bytes (also echoed to clients as ``X-Output-Digest``
+and stamped on the span). The reply body itself is retained up to
+``SYNAPSEML_CAPTURE_REPLY_BYTES`` (default 4096; ``SYNAPSEML_CAPTURE_
+OUTPUTS=0`` disables) so replay can report a max-abs-diff, not just a
+digest mismatch.
+
+Files: ``<dump_dir>/capture-<pid>.jsonl`` beside the flight dumps —
+one volume holds the replica's whole forensic story. Size-capped
+(``SYNAPSEML_CAPTURE_MAX_BYTES``, default 16 MiB) with atomic
+``os.replace`` rotation to ``.1``; appends are single writes and
+:func:`scan` tolerates one torn tail line after a crash. Writes happen
+at capture RATE on the reply handler thread AFTER the response is
+committed — a slow dump volume delays forensics, never a reply.
+"""
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from synapseml_tpu.runtime import telemetry as _tm
+
+__all__ = [
+    "maybe_capture", "classify", "capture_path", "scan",
+    "tail_summaries", "configure", "reset", "enabled", "set_enabled",
+    "set_model_hash", "model_hash", "DEFAULT_MAX_BYTES",
+    "REASON_5XX", "REASON_SHED", "REASON_DEADLINE", "REASON_POISON",
+    "REASON_LATENCY", "REASON_HEAD",
+]
+
+DEFAULT_MAX_BYTES = 16 * 1024 * 1024
+DEFAULT_REPLY_BYTES = 4096
+DEFAULT_PAYLOAD_BYTES = 64 * 1024
+
+REASON_5XX = "error_5xx"
+REASON_SHED = "shed"
+REASON_DEADLINE = "deadline"
+REASON_POISON = "poison"
+REASON_LATENCY = "slo_latency"
+REASON_HEAD = "head_sample"
+
+# pre-register every reason series at import (the recompile-sentinel
+# pattern): a scrape sees all classes at 0 before the first incident,
+# so CI can assert a labeled VALUE delta instead of a substring
+_REASONS = (REASON_5XX, REASON_SHED, REASON_DEADLINE, REASON_POISON,
+            REASON_LATENCY, REASON_HEAD)
+_M_RECORDS = {r: _tm.counter("capture_records_total", reason=r)
+              for r in _REASONS}
+_M_DROPPED = _tm.counter("capture_dropped_total")
+_M_ROTATIONS = _tm.counter("capture_rotations_total")
+_M_WRITE_FAIL = _tm.counter("capture_write_failures_total")
+
+
+def _head_every_from_env() -> int:
+    """Healthy-reply sampling stride from ``SYNAPSEML_CAPTURE_HEAD_
+    SAMPLE`` (a fraction; 0.01 -> every 100th healthy reply; 0 or
+    malformed -> no healthy sampling)."""
+    raw = os.environ.get("SYNAPSEML_CAPTURE_HEAD_SAMPLE", "0.01").strip()
+    try:
+        frac = float(raw)
+    except ValueError:
+        return 0
+    if not 0.0 < frac <= 1.0:
+        return 0
+    return max(1, round(1.0 / frac))
+
+
+def _max_bytes_from_env() -> int:
+    """Malformed or non-positive degrades to the default (the trace
+    archive's policy: a bad env var must never crash a server at
+    import, and a negative cap would rotate on every append)."""
+    raw = os.environ.get("SYNAPSEML_CAPTURE_MAX_BYTES", "").strip()
+    try:
+        n = int(raw) if raw else DEFAULT_MAX_BYTES
+    except ValueError:
+        return DEFAULT_MAX_BYTES
+    return max(4096, n) if n > 0 else DEFAULT_MAX_BYTES
+
+
+def _payload_cap_from_env() -> int:
+    """Per-record payload byte cap (``SYNAPSEML_CAPTURE_PAYLOAD_
+    BYTES``): a 100 MB breaching POST must not blow past the file's
+    own size cap in one record, nor serialize every handler thread
+    behind a multi-second append under the module lock. An over-cap
+    payload is NOTED (``payload_truncated``), never stored truncated —
+    a half payload would replay to a meaningless divergence."""
+    raw = os.environ.get("SYNAPSEML_CAPTURE_PAYLOAD_BYTES", "").strip()
+    try:
+        n = int(raw) if raw else DEFAULT_PAYLOAD_BYTES
+    except ValueError:
+        return DEFAULT_PAYLOAD_BYTES
+    return max(1024, n)
+
+
+def _reply_cap_from_env() -> int:
+    """Per-record retained-reply byte cap; ``SYNAPSEML_CAPTURE_
+    OUTPUTS=0`` disables reply retention entirely (digests alone still
+    gate determinism — retained bodies only add the max-abs-diff)."""
+    if os.environ.get("SYNAPSEML_CAPTURE_OUTPUTS", "") == "0":
+        return 0
+    raw = os.environ.get("SYNAPSEML_CAPTURE_REPLY_BYTES", "").strip()
+    try:
+        n = int(raw) if raw else DEFAULT_REPLY_BYTES
+    except ValueError:
+        return DEFAULT_REPLY_BYTES
+    return max(0, n)
+
+
+def _threshold_from_env() -> float:
+    raw = os.environ.get("SYNAPSEML_SLO_LATENCY_MS", "").strip()
+    try:
+        ms = float(raw) if raw else 250.0
+    except ValueError:
+        ms = 250.0
+    return ms / 1e3
+
+
+class _State:
+    """Module switchboard (the tracearchive pattern): env knobs
+    captured once, all tolerant; :func:`configure` retunes for tests
+    and embedding callers."""
+
+    def __init__(self):
+        self.enabled = os.environ.get("SYNAPSEML_CAPTURE", "") != "0"
+        self.dir: Optional[str] = None  # None = beside the flight dumps
+        self.max_bytes = _max_bytes_from_env()
+        self.head_every = _head_every_from_env()
+        self.reply_cap = _reply_cap_from_env()
+        self.payload_cap = _payload_cap_from_env()
+        self.lock = threading.Lock()
+        self.head_counter = itertools.count(1)
+        self.default_threshold_s = _threshold_from_env()
+        # the serving entry stamps the scoring model's content hash
+        # here (None = no model, e.g. the echo pipeline) — every
+        # record carries it so replay can refuse the wrong model
+        self.model_hash: Optional[str] = None
+
+
+_S = _State()
+
+
+def enabled() -> bool:
+    return _S.enabled
+
+
+def set_enabled(on: bool) -> bool:
+    prev = _S.enabled
+    _S.enabled = bool(on)
+    return prev
+
+
+def set_model_hash(h: Optional[str]) -> Optional[str]:
+    """Stamp the scoring model's content hash (the compile-cache
+    ``content_hash`` over the raw model bytes) into every subsequent
+    record; returns the previous value. The serving entry calls this
+    when it builds the model pipeline."""
+    prev = _S.model_hash
+    _S.model_hash = h
+    return prev
+
+
+def model_hash() -> Optional[str]:
+    return _S.model_hash
+
+
+def configure(directory: Optional[str] = None,
+              max_bytes: Optional[int] = None,
+              head_every: Optional[int] = None,
+              reply_cap: Optional[int] = None,
+              payload_cap: Optional[int] = None):
+    """Repoint/retune the sink (tests, embedding callers).
+    ``head_every=0`` disables healthy sampling; ``reply_cap=0``
+    disables reply-body retention; ``directory=None`` keeps the
+    current one (the flight dump dir by default)."""
+    with _S.lock:
+        if directory is not None:
+            _S.dir = directory
+        if max_bytes is not None:
+            _S.max_bytes = max(4096, int(max_bytes))
+        if head_every is not None:
+            _S.head_every = max(0, int(head_every))
+        if reply_cap is not None:
+            _S.reply_cap = max(0, int(reply_cap))
+        if payload_cap is not None:
+            _S.payload_cap = max(1024, int(payload_cap))
+
+
+def reset():
+    """Tests only: drop the current capture files and restart the
+    head-sample stride."""
+    with _S.lock:
+        _S.head_counter = itertools.count(1)
+        path = _capture_path()
+        for p in (path, path + ".1"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def _capture_path() -> str:
+    # lock-free: reads only the GIL-atomic _S.dir reference. The
+    # scrape-time capture_bytes gauge stats this path — taking the
+    # module lock here would park every /metrics scrape behind the
+    # dump-volume file writes maybe_capture does under it, degrading
+    # the monitoring surface exactly during the incidents it exists
+    # for
+    d = _S.dir
+    if d is None:
+        # beside the flight dumps — resolved per call because the
+        # serving entry's --dump-dir lands after import
+        from synapseml_tpu.runtime import blackbox as _bb
+
+        d = _bb.dump_dir()
+    return os.path.join(d, f"capture-{os.getpid()}.jsonl")
+
+
+def capture_path() -> str:
+    """The live capture file's path (rotated sibling: ``<path>.1``)."""
+    return _capture_path()
+
+
+def _size() -> float:
+    """Scrape-time gauge sampler: live capture file size in bytes."""
+    try:
+        return float(os.path.getsize(capture_path()))
+    except OSError:
+        return 0.0
+
+
+_tm.gauge_fn("capture_bytes", _size)
+
+
+def classify(status: int, latency_s: float,
+             threshold_s: Optional[float] = None) -> Optional[str]:
+    """The breach half of the retention decision, pure and exported
+    for tests: the retention reason for one completed reply, or None
+    when it is healthy (the head-sample stride then gets its say in
+    :func:`maybe_capture`). Order matters: 504 is a deadline before it
+    is a 5xx, 429/503 are deliberate sheds, any other 5xx is an error,
+    400 is the poison-bisection verdict, and a healthy status over the
+    latency threshold still breached the SLO."""
+    if threshold_s is None:
+        threshold_s = _S.default_threshold_s
+    if status == 504:
+        return REASON_DEADLINE
+    if status in (429, 503):
+        return REASON_SHED
+    if status >= 500:
+        return REASON_5XX
+    if status == 400:
+        return REASON_POISON
+    if threshold_s > 0 and latency_s > threshold_s:
+        return REASON_LATENCY
+    return None
+
+
+def _payload_fields(entity: bytes) -> Dict[str, Any]:
+    """Self-containment for replay: the payload bytes (utf-8 text
+    inline — the JSON-body common case stays grep-able — else base64)
+    plus best-effort shapes/dtypes of top-level JSON list fields (the
+    feature vectors a replay report names without re-parsing)."""
+    out: Dict[str, Any] = {}
+    try:
+        out["payload"] = entity.decode("utf-8")
+    except UnicodeDecodeError:
+        out["payload_b64"] = base64.b64encode(entity).decode("ascii")
+        return out
+    try:
+        doc = json.loads(out["payload"])
+    except json.JSONDecodeError:
+        return out
+    if isinstance(doc, dict):
+        shapes: Dict[str, List[int]] = {}
+        dtypes: Dict[str, str] = {}
+        for key, val in doc.items():
+            shape: List[int] = []
+            leaf = val
+            while isinstance(leaf, list):
+                shape.append(len(leaf))
+                leaf = leaf[0] if leaf else None
+            if shape:
+                shapes[key] = shape
+                dtypes[key] = type(leaf).__name__
+        if shapes:
+            out["payload_shapes"] = shapes
+            out["payload_dtypes"] = dtypes
+    return out
+
+
+def _build_sha() -> Optional[str]:
+    """The /debug/build git sha, resolved once (lazy import: serving
+    imports this module at its own import time, so the reverse edge
+    must stay deferred — and by the first capture, serving is
+    loaded)."""
+    global _BUILD_SHA
+    if _BUILD_SHA is _UNRESOLVED:
+        try:
+            from synapseml_tpu.io.serving import _build_static
+
+            _BUILD_SHA = _build_static().get("git_sha")
+        except Exception:  # noqa: BLE001 - best-effort provenance
+            _BUILD_SHA = None
+    return _BUILD_SHA
+
+
+_UNRESOLVED = object()
+_BUILD_SHA: Any = _UNRESOLVED
+
+
+def _rotate_locked(path: str):
+    """Atomic rotation: the live file becomes ``.1`` (replacing the
+    previous one); a concurrent reader sees the old file or the new
+    pair, never a torn state."""
+    try:
+        os.replace(path, path + ".1")
+        _M_ROTATIONS.inc()
+    except OSError:
+        _M_WRITE_FAIL.inc()
+
+
+def maybe_capture(request: Any, status: int, latency_s: float, *,
+                  rid: str = "", trace_id: str = "", span_id: str = "",
+                  origin: str = "", digest: str = "",
+                  reply_entity: Optional[bytes] = None,
+                  threshold_s: Optional[float] = None) -> Optional[str]:
+    """The retention decision for one completed request: capture when
+    it breached (:func:`classify`) or when the head-sample stride
+    picked this healthy one. ``request`` is the
+    :class:`~synapseml_tpu.io.http.HTTPRequestData` in hand at reply
+    time; ``digest`` the sha256 of the reply bytes (what
+    ``X-Output-Digest`` carried); ``reply_entity`` the reply body,
+    retained up to the configured cap so replay can diff values, not
+    just digests. Returns the retention reason when a record was
+    written, else None. Never raises — capture must not make a reply
+    path worse."""
+    if not _S.enabled or not _tm.enabled():
+        return None
+    reason = classify(status, latency_s, threshold_s)
+    if reason is None:
+        if not (_S.head_every
+                and next(_S.head_counter) % _S.head_every == 0):
+            _M_DROPPED.inc()
+            return None
+        reason = REASON_HEAD
+    try:
+        record: Dict[str, Any] = {
+            "rid": rid,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "origin": origin,
+            "reason": reason,
+            "status_code": int(status),
+            "latency_s": round(latency_s, 6),
+            "method": getattr(request, "method", None),
+            "path": getattr(request, "url", None),
+            "model_hash": _S.model_hash,
+            "build_sha": _build_sha(),
+            "output_digest": digest,
+            "captured_ts": round(time.time(), 6),
+            "pid": os.getpid(),
+        }
+        headers = getattr(request, "headers", None) or {}
+        ctype = next((v for k, v in headers.items()
+                      if k.lower() == "content-type"), None)
+        if ctype:
+            record["content_type"] = ctype
+        entity = getattr(request, "entity", b"") or b""
+        if len(entity) <= _S.payload_cap:
+            record.update(_payload_fields(entity))
+        else:
+            # noted, never stored truncated: a half payload would
+            # replay to a meaningless divergence, and one giant record
+            # must not blow the file cap or convoy handler threads
+            # behind a multi-second append under the module lock
+            record["payload_truncated"] = len(entity)
+        if reply_entity is not None and _S.reply_cap:
+            if len(reply_entity) <= _S.reply_cap:
+                try:
+                    record["reply"] = reply_entity.decode("utf-8")
+                except UnicodeDecodeError:
+                    record["reply_b64"] = base64.b64encode(
+                        reply_entity).decode("ascii")
+            else:
+                # a truncated body is useless for value diffing and
+                # actively misleading for digest checks: note the
+                # elision instead of storing a lie
+                record["reply_truncated"] = len(reply_entity)
+        line = json.dumps(record, separators=(",", ":"), default=repr)
+        with _S.lock:
+            path = _capture_path()
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            try:
+                if os.path.getsize(path) >= _S.max_bytes:
+                    _rotate_locked(path)
+            except OSError:
+                pass  # no file yet: first append creates it
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+    except Exception:  # noqa: BLE001 - never worsen a reply path
+        _M_WRITE_FAIL.inc()
+        return None
+    _M_RECORDS[reason].inc()
+    return reason
+
+
+def payload_bytes(record: Dict[str, Any]) -> Optional[bytes]:
+    """A scanned record's request body back as bytes (inline utf-8 or
+    base64) — the replay harness's input."""
+    if "payload" in record:
+        return record["payload"].encode("utf-8")
+    if "payload_b64" in record:
+        try:
+            return base64.b64decode(record["payload_b64"])
+        except (ValueError, TypeError):
+            return None
+    return None
+
+
+def reply_bytes(record: Dict[str, Any]) -> Optional[bytes]:
+    """A scanned record's retained reply body back as bytes, or None
+    when it was not retained (cap, kill switch, or truncation)."""
+    if "reply" in record:
+        return record["reply"].encode("utf-8")
+    if "reply_b64" in record:
+        try:
+            return base64.b64decode(record["reply_b64"])
+        except (ValueError, TypeError):
+            return None
+    return None
+
+
+def scan(path: Optional[str] = None,
+         limit: int = 100_000) -> List[Dict[str, Any]]:
+    """Every record in one capture file (default: this process's live
+    file), oldest first. Torn/corrupt lines are skipped — a crash can
+    tear at most the tail line, and replay must shrug at it."""
+    if path is None:
+        path = capture_path()
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail line
+                if isinstance(rec, dict):
+                    out.append(rec)
+                    if len(out) >= limit:
+                        break
+    except OSError:
+        pass
+    return out
+
+
+def tail_summaries(n: int = 32) -> List[Dict[str, Any]]:
+    """The last ``n`` records' summaries (payload/reply bodies elided)
+    — what ``GET /debug/capture`` serves. Reads only the file tail
+    (bounded), so a polled debug surface never re-parses a full
+    capture file on the handler thread."""
+    path = capture_path()
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - 256 * 1024))
+            tail = fh.read().decode("utf-8", errors="replace")
+    except OSError:
+        return []
+    out: List[Dict[str, Any]] = []
+    for line in tail.splitlines()[-max(1, n):]:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        out.append({k: rec.get(k) for k in (
+            "rid", "trace_id", "reason", "status_code", "latency_s",
+            "output_digest", "model_hash", "captured_ts",
+            "payload_shapes")})
+    return out

@@ -486,7 +486,8 @@ class Span:
     ``GET /fleet/trace/<trace_id>`` stitches legs together on."""
 
     __slots__ = ("rid", "start", "wall", "events", "status", "finished",
-                 "trace_id", "span_id", "parent_span_id", "origin")
+                 "trace_id", "span_id", "parent_span_id", "origin",
+                 "output_digest")
 
     def __init__(self, rid: str, trace_id: Optional[str] = None,
                  parent_span_id: Optional[str] = None,
@@ -502,6 +503,11 @@ class Span:
         self.span_id = span_id or mint_span_id()
         self.parent_span_id = parent_span_id or ""
         self.origin = origin
+        # sha256 of the reply bytes, stamped by the serving reply path
+        # (the X-Output-Digest header's value): /span/<rid> and the
+        # trace archive then carry the determinism fingerprint replay
+        # diffs against, without storing the output itself
+        self.output_digest = ""
 
     def note(self, stage: str, seconds: float):
         # finished spans drop late notes: a request replayed through
@@ -534,12 +540,15 @@ class Span:
         for s in sorted(stages):
             ordered.setdefault(s, round(stages[s], 6))
         end = self.finished if self.finished else time.monotonic()
-        return {"rid": self.rid, "status": self.status,
-                "trace_id": self.trace_id, "span_id": self.span_id,
-                "parent_span_id": self.parent_span_id,
-                "origin": self.origin, "ts": round(self.wall, 6),
-                "total_seconds": round(end - self.start, 6),
-                "stages": ordered}
+        out = {"rid": self.rid, "status": self.status,
+               "trace_id": self.trace_id, "span_id": self.span_id,
+               "parent_span_id": self.parent_span_id,
+               "origin": self.origin, "ts": round(self.wall, 6),
+               "total_seconds": round(end - self.start, 6),
+               "stages": ordered}
+        if self.output_digest:
+            out["output_digest"] = self.output_digest
+        return out
 
 
 class _NoopSpan(Span):
@@ -556,6 +565,7 @@ class _NoopSpan(Span):
         self.span_id = ""
         self.parent_span_id = ""
         self.origin = ""
+        self.output_digest = ""
 
     def note(self, stage: str, seconds: float):
         pass

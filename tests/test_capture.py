@@ -1,0 +1,653 @@
+"""Incident capture & deterministic replay (docs/observability.md,
+"Incident capture & replay"): the tail-based payload capture sink
+(runtime/capture.py), the X-Output-Digest reply header, /debug/capture,
+the offline replay harness (tools/replay.py), and loadgen --replay."""
+import hashlib
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.data.table import Table
+from synapseml_tpu.io.serving import ContinuousServer, make_reply
+from synapseml_tpu.runtime import capture as cap
+from synapseml_tpu.runtime import compile_cache as cc
+
+
+class _Req:
+    """Minimal HTTPRequestData stand-in for unit tests."""
+
+    def __init__(self, entity=b"{}", headers=None, method="POST",
+                 url="/"):
+        self.entity = entity
+        self.headers = headers or {"Content-Type": "application/json"}
+        self.method = method
+        self.url = url
+
+
+@pytest.fixture
+def sink(tmp_path):
+    """Capture sink pointed at a private dir, healthy sampling off,
+    every knob restored after — tier-1 runs everything in one
+    process."""
+    prev_enabled = cap.set_enabled(True)
+    prev_hash = cap.set_model_hash(None)
+    cap.configure(directory=str(tmp_path), head_every=0,
+                  max_bytes=cap.DEFAULT_MAX_BYTES,
+                  reply_cap=cap.DEFAULT_REPLY_BYTES,
+                  payload_cap=cap.DEFAULT_PAYLOAD_BYTES)
+    yield str(tmp_path)
+    cap.reset()
+    cap._S.dir = None
+    cap.configure(head_every=0, max_bytes=cap.DEFAULT_MAX_BYTES,
+                  reply_cap=cap.DEFAULT_REPLY_BYTES,
+                  payload_cap=cap.DEFAULT_PAYLOAD_BYTES)
+    cap.set_model_hash(prev_hash)
+    cap.set_enabled(prev_enabled)
+
+
+# -- retention policy -------------------------------------------------------
+
+@pytest.mark.parametrize("status,latency,expect", [
+    (200, 0.001, None),                 # healthy: the drop path
+    (204, 0.001, None),
+    (404, 0.001, None),                 # deliberate 4xx answers drop
+    (500, 0.001, cap.REASON_5XX),
+    (502, 0.001, cap.REASON_5XX),
+    (429, 0.001, cap.REASON_SHED),      # admission shed
+    (503, 0.001, cap.REASON_SHED),      # drain shed
+    (504, 0.001, cap.REASON_DEADLINE),  # deadline before it is a 5xx
+    (400, 0.001, cap.REASON_POISON),    # the bisection verdict
+    (200, 10.0, cap.REASON_LATENCY),    # healthy status, breached SLO
+])
+def test_classify_matrix(status, latency, expect):
+    assert cap.classify(status, latency, threshold_s=0.25) == expect
+
+
+def test_head_sample_stride_and_drop_counter(sink):
+    cap.configure(head_every=3)
+    from synapseml_tpu.runtime import telemetry as tm
+
+    dropped = tm.counter("capture_dropped_total")
+    before = dropped.value
+    kept = sum(1 for _ in range(9)
+               if cap.maybe_capture(_Req(), 200, 0.001, rid="h",
+                                    threshold_s=1.0))
+    assert kept == 3
+    assert dropped.value - before == 6
+    recs = cap.scan()
+    assert len(recs) == 3
+    assert all(r["reason"] == cap.REASON_HEAD for r in recs)
+
+
+def test_kill_switch(sink):
+    cap.configure(head_every=1)
+    cap.set_enabled(False)
+    assert cap.maybe_capture(_Req(), 500, 0.01, rid="off") is None
+    assert cap.scan() == []
+    cap.set_enabled(True)
+    assert cap.maybe_capture(_Req(), 500, 0.01, rid="on") \
+        == cap.REASON_5XX
+
+
+def test_record_is_self_contained(sink):
+    cap.set_model_hash("m" * 64)
+    payload = json.dumps({"features": [1.0, 2.0, 3.0],
+                          "meta": "x"}).encode()
+    reason = cap.maybe_capture(
+        _Req(entity=payload), 500, 0.123, rid="rid-1",
+        trace_id="t" * 32, span_id="s" * 16, origin="srv",
+        digest="d" * 64, reply_entity=b'{"output": [0.5]}')
+    assert reason == cap.REASON_5XX
+    (rec,) = cap.scan()
+    assert rec["rid"] == "rid-1" and rec["trace_id"] == "t" * 32
+    assert rec["span_id"] == "s" * 16 and rec["origin"] == "srv"
+    assert rec["status_code"] == 500 and rec["reason"] == cap.REASON_5XX
+    assert rec["model_hash"] == "m" * 64
+    assert rec["output_digest"] == "d" * 64
+    assert rec["latency_s"] == pytest.approx(0.123)
+    assert rec["method"] == "POST" and rec["path"] == "/"
+    assert rec["content_type"] == "application/json"
+    # the replay inputs: payload bytes + best-effort shapes/dtypes
+    assert cap.payload_bytes(rec) == payload
+    assert rec["payload_shapes"] == {"features": [3]}
+    assert rec["payload_dtypes"] == {"features": "float"}
+    assert cap.reply_bytes(rec) == b'{"output": [0.5]}'
+    assert rec["pid"] == os.getpid()
+
+
+def test_binary_payload_base64_roundtrip(sink):
+    blob = bytes(range(256))
+    cap.maybe_capture(_Req(entity=blob), 500, 0.01, rid="bin")
+    (rec,) = cap.scan()
+    assert "payload" not in rec
+    assert cap.payload_bytes(rec) == blob
+
+
+def test_reply_retention_cap(sink):
+    cap.configure(reply_cap=32)
+    cap.maybe_capture(_Req(), 500, 0.01, rid="small",
+                      reply_entity=b"x" * 16)
+    cap.maybe_capture(_Req(), 500, 0.01, rid="big",
+                      reply_entity=b"y" * 64)
+    small, big = cap.scan()
+    assert cap.reply_bytes(small) == b"x" * 16
+    # an oversized reply is NOTED, never stored truncated (a truncated
+    # body would be a lying diff input)
+    assert cap.reply_bytes(big) is None
+    assert big["reply_truncated"] == 64
+    # reply_cap=0 disables retention entirely
+    cap.configure(reply_cap=0)
+    cap.maybe_capture(_Req(), 500, 0.01, rid="none",
+                      reply_entity=b"z")
+    assert cap.reply_bytes(cap.scan()[-1]) is None
+
+
+def test_payload_cap_notes_never_truncates(sink):
+    cap.configure(payload_cap=1024)
+    big = b'{"features": [' + b"1.0," * 1024 + b"1.0]}"
+    cap.maybe_capture(_Req(entity=big), 500, 0.01, rid="huge")
+    (rec,) = cap.scan()
+    # noted, never stored truncated: a half payload would replay to a
+    # meaningless divergence
+    assert rec["payload_truncated"] == len(big)
+    assert cap.payload_bytes(rec) is None
+    # and replay skips a record with no payload instead of erroring
+    from tools.replay import main as replay_main
+
+    assert replay_main([cap.capture_path()]) == 1  # nothing replayable
+
+
+def test_rotation_and_torn_tail(sink):
+    cap.configure(max_bytes=4096)
+    for i in range(64):
+        assert cap.maybe_capture(_Req(entity=b'{"x": [1.0]}'), 500,
+                                 0.01, rid=f"rot-{i}")
+    live = cap.capture_path()
+    assert os.path.exists(live) and os.path.exists(live + ".1")
+    assert os.path.getsize(live) <= 4096 + 1024
+    # a crash can tear at most the tail line: scan shrugs at it
+    with open(live, "a", encoding="utf-8") as fh:
+        fh.write('{"torn')
+    recs = cap.scan()
+    assert recs and all(r["rid"].startswith("rot-") for r in recs)
+    # tail_summaries reads the same tail, bodies elided
+    tail = cap.tail_summaries(8)
+    assert 0 < len(tail) <= 8
+    assert "payload" not in tail[-1] and "rid" in tail[-1]
+
+
+def test_scan_missing_file_is_empty(sink):
+    assert cap.scan(os.path.join(sink, "nope.jsonl")) == []
+
+
+# -- serving end to end -----------------------------------------------------
+
+def _echo_pipeline(table: Table) -> Table:
+    replies = np.empty(table.num_rows, dtype=object)
+    for i, v in enumerate(table["value"]):
+        replies[i] = make_reply(v)
+    return table.with_column("reply", replies)
+
+
+def _post(url, obj, headers=None, timeout=30):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(),
+                                 method="POST", headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, dict(e.headers or {}), body
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(urllib.request.Request(url),
+                                timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _wait_records(pred, n=1, timeout=5.0):
+    """Poll the capture file until at least ``n`` records match
+    ``pred``: the reply deliberately flushes to the client BEFORE the
+    capture record is appended (a reply must never wait on the dump
+    volume), so a test that scans right after its HTTP reply races
+    the handler thread."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = [r for r in cap.scan() if pred(r)]
+        if len(out) >= n:
+            return out
+        time.sleep(0.02)
+    return [r for r in cap.scan() if pred(r)]
+
+
+@pytest.fixture
+def server(sink):
+    cs = ContinuousServer("cap_e2e", _echo_pipeline, max_batch=8).start()
+    yield cs
+    cs.stop()
+
+
+def test_digest_header_matches_reply_and_span(server):
+    st, hdrs, body = _post(server.url, {"x": [1.0, 2.0]})
+    assert st == 200
+    digest = hdrs.get("X-Output-Digest")
+    assert digest == hashlib.sha256(body).hexdigest()
+    host = server.url.split("//")[1].rstrip("/")
+    st, span = _get_json(f"http://{host}/span/{hdrs['X-Request-Id']}")
+    assert st == 200 and span["output_digest"] == digest
+
+
+def test_deadline_shed_is_captured(server):
+    st, hdrs, _ = _post(server.url, {"x": [9.0]},
+                        headers={"X-Deadline-Ms": "0.001"})
+    assert st == 504
+    recs = _wait_records(lambda r: r["rid"] == hdrs["X-Request-Id"])
+    assert recs and recs[0]["reason"] == cap.REASON_DEADLINE
+    assert recs[0]["status_code"] == 504
+    assert cap.payload_bytes(recs[0]) == json.dumps({"x": [9.0]}).encode()
+    assert recs[0]["origin"] == "cap_e2e"
+
+
+def test_drain_shed_is_captured(server):
+    server.server.begin_drain()
+    try:
+        st, hdrs, _ = _post(server.url, {"x": [7.0]})
+        assert st == 503
+        recs = _wait_records(lambda r: r["rid"] == hdrs["X-Request-Id"])
+        assert recs and recs[0]["reason"] == cap.REASON_SHED
+    finally:
+        server.server._draining.clear()
+
+
+def test_healthy_head_sample_rides_with_digest(server):
+    cap.configure(head_every=1)
+    st, hdrs, body = _post(server.url, {"x": [5.0]})
+    assert st == 200
+    recs = _wait_records(lambda r: r["rid"] == hdrs["X-Request-Id"])
+    assert recs and recs[0]["reason"] == cap.REASON_HEAD
+    assert recs[0]["output_digest"] == hashlib.sha256(body).hexdigest()
+    assert cap.reply_bytes(recs[0]) == body
+
+
+def test_debug_capture_endpoint_and_gate(server, monkeypatch):
+    cap.configure(head_every=1)
+    _post(server.url, {"x": [6.0]})
+    assert _wait_records(lambda r: True)  # record on disk before GET
+    host = server.url.split("//")[1].rstrip("/")
+    st, dbg = _get_json(f"http://{host}/debug/capture?n=4")
+    assert st == 200
+    assert dbg["enabled"] is True
+    assert dbg["path"] == cap.capture_path()
+    assert dbg["size_bytes"] > 0
+    assert dbg["records"] and "rid" in dbg["records"][-1]
+    # the whole /debug surface gate covers the new endpoint
+    monkeypatch.setenv("SYNAPSEML_DEBUG_ENDPOINTS", "0")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get_json(f"http://{host}/debug/capture")
+    assert ei.value.code == 403
+
+
+# -- digest determinism across fresh pipelines ------------------------------
+
+@pytest.fixture(scope="module")
+def mlp_model(tmp_path_factory):
+    from synapseml_tpu.onnx import zoo
+
+    work = tmp_path_factory.mktemp("cap_mlp")
+    path = os.path.join(str(work), "model.onnx")
+    with open(path, "wb") as fh:
+        fh.write(zoo.mlp([4, 8], num_classes=3, seed=0))
+    return path, os.path.join(str(work), "cache")
+
+
+def _score_payloads(model_path, cache_dir, payloads):
+    """Fresh pipeline, one reply digest per payload — scored one
+    batch so the per-row digests are what serving would have sent."""
+    from synapseml_tpu.io.http import HTTPRequestData
+    from synapseml_tpu.io.serving import (ID_COL, REQUEST_COL,
+                                          _model_pipeline, parse_request)
+
+    pipeline, _model = _model_pipeline(model_path, cache_dir=cache_dir)
+    ids = np.array([f"r{i}" for i in range(len(payloads))], dtype=object)
+    reqs = np.empty(len(payloads), dtype=object)
+    reqs[:] = [HTTPRequestData(url="/", method="POST", headers={},
+                               entity=p) for p in payloads]
+    out = pipeline(parse_request(Table({ID_COL: ids,
+                                        REQUEST_COL: reqs})))
+    return [hashlib.sha256(r.entity or b"").hexdigest()
+            for r in out["reply"]]
+
+
+def test_digest_stable_across_fresh_pipelines(mlp_model):
+    model_path, cache_dir = mlp_model
+    p1 = json.dumps({"features": [0.1, 0.2, 0.3, 0.4]}).encode()
+    p2 = json.dumps({"features": [1.0, -1.0, 2.0, 0.0]}).encode()
+    a = _score_payloads(model_path, cache_dir, [p1, p2])
+    # a brand-new pipeline (fresh ONNXModel, fresh executor), scored
+    # in a DIFFERENT batch composition, must reproduce every per-row
+    # digest bit-identically — the property replay depends on
+    b = _score_payloads(model_path, cache_dir, [p1])
+    c = _score_payloads(model_path, cache_dir, [p2, p1])
+    assert a[0] == b[0] == c[1]
+    assert a[1] == c[0]
+    assert a[0] != a[1]
+
+
+# -- offline replay harness -------------------------------------------------
+
+def _write_records(path, records):
+    with open(path, "w", encoding="utf-8") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+
+
+def test_replay_offline_echo_roundtrip(server, sink):
+    from tools.replay import main as replay_main
+
+    cap.configure(head_every=1)
+    for k in range(4):
+        _post(server.url, {"x": [float(k)]})
+    assert len(_wait_records(lambda r: True, n=4)) >= 4
+    out = os.path.join(sink, "report.json")
+    rc = replay_main([cap.capture_path(), "--out", out])
+    assert rc == 0
+    report = json.load(open(out))
+    assert report["matched"] == 4 and report["diverged"] == []
+    assert report["mode"] == "offline"
+
+
+def test_replay_divergence_exits_2_with_report(server, sink, capsys):
+    from tools.replay import main as replay_main
+
+    cap.configure(head_every=1)
+    _post(server.url, {"x": [1.0]})
+    _post(server.url, {"x": [2.0]})
+    assert len(_wait_records(lambda r: True, n=2)) >= 2
+    recs = cap.scan()
+    recs[0]["output_digest"] = "0" * 64
+    perturbed = os.path.join(sink, "perturbed.jsonl")
+    _write_records(perturbed, recs)
+    out = os.path.join(sink, "report.json")
+    rc = replay_main([perturbed, "--keep-outputs", "--out", out])
+    assert rc == 2
+    report = json.load(open(out))
+    assert len(report["diverged"]) == 1
+    d = report["diverged"][0]
+    assert d["rid"] == recs[0]["rid"]
+    assert d["trace_id"] == recs[0]["trace_id"]
+    assert d["captured_digest"] == "0" * 64
+    assert d["replayed_digest"] != "0" * 64
+    # values identical (only the recorded digest was flipped): the
+    # max-abs-diff says "digest lies, numbers agree"
+    assert d["max_abs_diff"] == 0.0
+    assert "DIVERGED" in capsys.readouterr().out
+
+
+def test_replay_skips_environmental_statuses(server, sink):
+    from tools.replay import main as replay_main
+
+    cap.configure(head_every=1)
+    _post(server.url, {"x": [1.0]})
+    # a deadline shed is an environmental outcome, not a payload
+    # property: replay must not "diverge" on it
+    _post(server.url, {"x": [2.0]}, headers={"X-Deadline-Ms": "0.001"})
+    assert len(_wait_records(lambda r: True, n=2)) >= 2
+    out = os.path.join(sink, "report.json")
+    rc = replay_main([cap.capture_path(), "--out", out])
+    assert rc == 0
+    report = json.load(open(out))
+    assert report["matched"] == 1 and report["skipped"] == 1
+
+
+def test_replay_undecodable_payloads_inconclusive(sink):
+    """A file whose every replayable record has a corrupt payload must
+    end inconclusive (exit 1), never 'ok: 0 bit-identical'."""
+    from tools.replay import main as replay_main
+
+    rec = {"rid": "r1", "trace_id": "t" * 32, "status_code": 200,
+           "reason": "head_sample", "output_digest": "d" * 64,
+           "payload_b64": "!!!corrupt!!!"}
+    f = os.path.join(sink, "undecodable.jsonl")
+    _write_records(f, [rec])
+    out = os.path.join(sink, "report.json")
+    assert replay_main([f, "--out", out]) == 1
+    assert json.load(open(out))["undecodable"] == 1
+
+
+def test_replay_empty_capture_exits_1(sink):
+    from tools.replay import main as replay_main
+
+    empty = os.path.join(sink, "empty.jsonl")
+    open(empty, "w").close()
+    assert replay_main([empty]) == 1
+
+
+def test_replay_model_hash_guard(mlp_model, sink):
+    from tools.replay import main as replay_main
+
+    model_path, _cache = mlp_model
+    rec = {"rid": "r1", "trace_id": "t" * 32, "status_code": 200,
+           "reason": "head_sample", "output_digest": "d" * 64,
+           "payload": json.dumps({"features": [0.0] * 4}),
+           "model_hash": "not-the-real-hash"}
+    f = os.path.join(sink, "hash.jsonl")
+    _write_records(f, [rec])
+    # records carry a model hash: --model is mandatory ...
+    assert replay_main([f]) == 1
+    # ... and a mismatching model file is refused before any scoring
+    assert replay_main([f, "--model", model_path]) == 1
+
+
+def test_replay_poison_reproduces_400(mlp_model, sink):
+    from tools.replay import main as replay_main
+
+    model_path, cache_dir = mlp_model
+    with open(model_path, "rb") as fh:
+        model_hash = cc.content_hash(fh.read())
+    healthy_payload = json.dumps({"features": [0.5, 1.5, -0.5, 2.0]}
+                                 ).encode()
+    (healthy_digest,) = _score_payloads(model_path, cache_dir,
+                                        [healthy_payload])
+    records = [
+        {"rid": "ok-1", "trace_id": "a" * 32, "status_code": 200,
+         "reason": "head_sample", "output_digest": healthy_digest,
+         "payload": healthy_payload.decode(), "model_hash": model_hash},
+        # the poison contract: a non-numeric feature raised at capture
+        # time (bisection -> 400) and must STILL raise on replay
+        {"rid": "poison-1", "trace_id": "b" * 32, "status_code": 400,
+         "reason": "poison", "output_digest": "",
+         "payload": json.dumps({"features": ["boom", 1.0, 1.0, 1.0]}),
+         "model_hash": model_hash},
+    ]
+    f = os.path.join(sink, "poison.jsonl")
+    _write_records(f, records)
+    out = os.path.join(sink, "report.json")
+    rc = replay_main([f, "--model", model_path,
+                      "--cache-dir", cache_dir, "--out", out])
+    assert rc == 0
+    report = json.load(open(out))
+    assert report["matched"] == 1
+    assert report["reproduced_errors"] == 1
+    assert report["model_hash"] == model_hash
+    # a poison that suddenly scores clean IS a divergence
+    records[1]["payload"] = healthy_payload.decode()
+    _write_records(f, records)
+    rc = replay_main([f, "--model", model_path,
+                      "--cache-dir", cache_dir, "--out", out])
+    assert rc == 2
+    report = json.load(open(out))
+    assert report["diverged"][0]["rid"] == "poison-1"
+
+
+def test_replay_poison_only_file_is_inconclusive(mlp_model, sink):
+    """A capture whose every replayable record errors on replay must
+    NOT exit 0: an all-error run is indistinguishable from a broken
+    replay environment, and crediting it as 'reproduced' would
+    false-pass the determinism gate."""
+    from tools.replay import main as replay_main
+
+    model_path, cache_dir = mlp_model
+    with open(model_path, "rb") as fh:
+        model_hash = cc.content_hash(fh.read())
+    rec = {"rid": "poison-solo", "trace_id": "c" * 32,
+           "status_code": 400, "reason": "poison", "output_digest": "",
+           "payload": json.dumps({"features": ["boom", 1.0, 1.0, 1.0]}),
+           "model_hash": model_hash}
+    f = os.path.join(sink, "poison_only.jsonl")
+    _write_records(f, [rec])
+    assert replay_main([f, "--model", model_path,
+                        "--cache-dir", cache_dir]) == 1
+
+
+def test_replay_serve_mode(server, sink):
+    from tools.replay import main as replay_main
+
+    cap.configure(head_every=1)
+    for k in range(3):
+        _post(server.url, {"x": [float(k), 1.0]})
+    assert len(_wait_records(lambda r: True, n=3)) >= 3
+    rc = replay_main([cap.capture_path(), "--serve", server.url])
+    assert rc == 0
+    # perturbed: the live endpoint's digest header must expose it
+    recs = cap.scan()
+    recs[1]["output_digest"] = "f" * 64
+    perturbed = os.path.join(sink, "serve_perturbed.jsonl")
+    _write_records(perturbed, recs)
+    rc = replay_main([perturbed, "--serve", server.url])
+    assert rc == 2
+
+
+def test_serve_poison_singleton_500_reproduces(mlp_model, sink):
+    """--serve replays sequentially, so a poison arrives as a SINGLETON
+    batch and serving legally replies 500 (bisection isolates to 400
+    only at n>1) — that still reproduces the captured 400, never a
+    divergence."""
+    from synapseml_tpu.io.serving import _model_pipeline
+    from tools.replay import main as replay_main
+
+    model_path, cache_dir = mlp_model
+    pipeline, _model = _model_pipeline(model_path, cache_dir=cache_dir)
+    cs = ContinuousServer("cap_poison_srv", pipeline,
+                          max_batch=8).start()
+    try:
+        healthy = {"features": [0.5, 1.5, -0.5, 2.0]}
+        st, hdrs, _ = _post(cs.url, healthy)
+        assert st == 200
+        st, _, _ = _post(cs.url, {"features": ["boom", 1.0, 1.0, 1.0]})
+        assert st == 500  # singleton: no batch-mates to bisect from
+        records = [
+            {"rid": "ok", "trace_id": "a" * 32, "status_code": 200,
+             "reason": "head_sample",
+             "output_digest": hdrs["X-Output-Digest"],
+             "payload": json.dumps(healthy)},
+            {"rid": "poison", "trace_id": "b" * 32, "status_code": 400,
+             "reason": "poison", "output_digest": "",
+             "payload": json.dumps({"features":
+                                    ["boom", 1.0, 1.0, 1.0]})},
+        ]
+        f = os.path.join(sink, "serve_poison.jsonl")
+        _write_records(f, records)
+        assert replay_main([f, "--serve", cs.url]) == 0
+    finally:
+        cs.stop()
+
+
+def test_disabled_telemetry_never_stamps_the_noop_span(server):
+    """With telemetry off every request shares the _NOOP_SPAN
+    singleton: the digest stamp must skip it (a raw attribute write
+    would smear one request's digest across all handlers)."""
+    from synapseml_tpu.runtime import telemetry as tm
+
+    prev = tm.set_enabled(False)
+    try:
+        st, hdrs, body = _post(server.url, {"x": [1.0]})
+        assert st == 200
+        # the header is still served (sha of the bytes in hand) ...
+        assert hdrs.get("X-Output-Digest") == \
+            hashlib.sha256(body).hexdigest()
+        # ... but the shared no-op span stays unstamped
+        assert tm._NOOP_SPAN.output_digest == ""
+    finally:
+        tm.set_enabled(prev)
+
+
+def test_replay_serve_unreachable_is_inconclusive(server, sink):
+    """--serve against a dead endpoint must exit 1 (environment), not
+    2 (divergence) and never 0: no request was scored, so nothing was
+    verified either way."""
+    from tools.replay import main as replay_main
+
+    cap.configure(head_every=1)
+    _post(server.url, {"x": [1.0]})
+    assert _wait_records(lambda r: True)
+    rc = replay_main([cap.capture_path(),
+                      "--serve", "http://127.0.0.1:9/",
+                      "--timeout", "2"])
+    assert rc == 1
+
+
+# -- loadgen --replay -------------------------------------------------------
+
+def test_loadgen_replay_roundtrip(server, sink):
+    from tools.loadgen import load_capture_records, run_load
+
+    cap.configure(head_every=1)
+    for k in range(5):
+        _post(server.url, {"x": [float(k), 2.0]})
+    assert len(_wait_records(lambda r: True, n=5)) >= 5
+    records = load_capture_records(cap.capture_path())
+    assert len(records) == 5
+    s = run_load(server.url, rps=200.0, duration_s=10.0, seed=3,
+                 replay_records=records)
+    assert s["hung"] == 0
+    assert s["replayed"] == 5
+    assert s["digest_checked"] == 5
+    assert s["digest_mismatches"] == 0
+    # recorded trace ids ride the replay legs (the replays stitch
+    # next to the incident's own legs): every slowest[] entry's trace
+    # id is one the capture file named
+    tids = {r["trace_id"] for r in records}
+    assert {e["trace_id"] for e in s["slowest"]} <= tids
+    # a flipped digest is reported as a mismatch
+    records[2]["output_digest"] = "0" * 64
+    s = run_load(server.url, rps=200.0, duration_s=10.0, seed=3,
+                 replay_records=records)
+    assert s["digest_mismatches"] == 1
+
+
+def test_loadgen_replay_cli(server, sink, tmp_path):
+    from tools.loadgen import main as lg_main
+
+    cap.configure(head_every=1)
+    for k in range(3):
+        _post(server.url, {"x": [float(k), 3.0]})
+    assert len(_wait_records(lambda r: True, n=3)) >= 3
+    out = str(tmp_path / "replay_out.json")
+    rc = lg_main(["--url", server.url, "--replay", cap.capture_path(),
+                  "--rps", "200", "--out", out])
+    assert rc == 0
+    summary = json.load(open(out))
+    assert summary["digest_mismatches"] == 0
+    assert summary["digest_checked"] >= 3
+    # nonzero mismatches exit 2
+    recs = cap.scan()
+    recs[0]["output_digest"] = "0" * 64
+    perturbed = str(tmp_path / "perturbed.jsonl")
+    _write_records(perturbed, recs)
+    rc = lg_main(["--url", server.url, "--replay", perturbed,
+                  "--rps", "200"])
+    assert rc == 2
+    # a dead endpoint verifies NOTHING: digest_checked == 0 must be a
+    # loud exit 2, never a vacuous pass of the determinism gate
+    rc = lg_main(["--url", "http://127.0.0.1:9/", "--replay",
+                  cap.capture_path(), "--rps", "200",
+                  "--timeout", "2"])
+    assert rc == 2

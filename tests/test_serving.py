@@ -337,9 +337,11 @@ def test_pipelined_scoring_overlaps_device_time():
     reach >=2 concurrent pipeline_fn calls (two micro-batches
     genuinely in flight at once — the architectural claim), the
     serial leg must never exceed 1 (proof the comparison leg is
-    actually serial). Concurrency inside a 100 ms sleep window is
+    actually serial). Concurrency inside the 250 ms sleep window is
     immune to absolute wall time; the only residual assumption is
-    that worker pickup skew stays under the 100 ms 'device' time.
+    that worker pickup skew stays under the 250 ms 'device' time
+    (round 17: widened from 100 ms, which a loaded tier-1 box
+    occasionally exceeded).
     Also asserts the adaptive path commits every merged epoch (no
     request is left replayable after its reply)."""
     state = {"active": 0, "max_active": 0}
@@ -350,10 +352,15 @@ def test_pipelined_scoring_overlaps_device_time():
             state["active"] += 1
             state["max_active"] = max(state["max_active"],
                                       state["active"])
-        # 100ms "device": large vs the tens-of-ms scheduler jitter an
-        # oversubscribed CI box injects, so two in-flight batches
-        # reliably coexist inside the window
-        time.sleep(0.1)
+        # 250ms "device" (round 17: widened from 100ms — the residual
+        # tier-1 flake, see repo-test-baseline): under a full-suite
+        # run on a 2-core box the second scorer's pickup skew was
+        # occasionally observed past 100ms, reading max_active==1 on
+        # the pipelined leg. 250ms is an order of magnitude over the
+        # tens-of-ms scheduler jitter an oversubscribed box injects
+        # while keeping the test ~2s; do NOT re-narrow without a
+        # loaded-box soak
+        time.sleep(0.25)
         with state_lock:
             state["active"] -= 1
         replies = np.empty(table.num_rows, dtype=object)
@@ -402,7 +409,7 @@ def test_pipelined_scoring_overlaps_device_time():
     pipe_conc = run(True)
     # serial: one loop thread collects AND scores — structurally never
     # two pipeline_fn calls at once; pipelined + 2 scoring workers:
-    # both micro-batches score inside the same 100 ms window
+    # both micro-batches score inside the same 250 ms window
     assert serial_conc == 1, serial_conc
     assert pipe_conc >= 2, pipe_conc
 

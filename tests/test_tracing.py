@@ -329,7 +329,15 @@ def test_serving_breach_lands_in_archive(server, tmp_path):
                                       f"00-{tid}-{SID}-01",
                                       "X-Deadline-Ms": "0.01"})
     assert st == 504  # pre-expired deadline: shed before scoring
+    # the reply flushes to the client BEFORE the archive append (round
+    # 17: a reply must never wait on the dump volume), so poll briefly
+    import time as _time
+
+    deadline = _time.monotonic() + 5.0
     recs = ta.scan(tid, directory=str(tmp_path))
+    while not recs and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+        recs = ta.scan(tid, directory=str(tmp_path))
     assert recs, "the 504 shed never reached the archive"
     assert recs[0]["retention"] == ta.CLASS_BREACH
     assert recs[0]["status_code"] == 504

@@ -22,6 +22,13 @@ And the distributed-tracing surface (round 16): a request with a known
 ``/span/<rid>``, and land it as a histogram-bucket exemplar on the
 Accept-negotiated OpenMetrics exposition.
 
+And the incident-capture surface (round 17, runtime/capture.py): every
+200 must echo an ``X-Output-Digest`` header that is exactly the sha256
+of its reply bytes, and a deliberately pre-expired-deadline request
+(shed 504 before scoring) must move the
+``capture_records_total{reason="deadline"}`` series between scrapes —
+the labeled VALUE delta, since every reason series pre-registers at 0.
+
 Exit 0 = every assertion held; any failure prints the offending series
 and exits nonzero.
 """
@@ -85,6 +92,12 @@ CORE_SERIES = [
     "synapseml_executor_signature_bytes",
     "synapseml_executor_achieved_flops_per_sec",
     "synapseml_executor_roofline_fraction",
+    # incident capture (runtime/capture.py): reason-labeled record
+    # counters pre-register at import, the drop path and file-size
+    # gauge beside them
+    "synapseml_capture_records_total",
+    "synapseml_capture_dropped_total",
+    "synapseml_capture_bytes",
 ]
 
 # the breaker/failover/drain surface (docs/robustness.md, PR 8): these
@@ -273,6 +286,51 @@ def main() -> int:
         if not drift_after > drift_before:
             print("the drifted post was not classified shape_drift: "
                   f"{drift_series} {drift_before} -> {drift_after}")
+            return 1
+
+        # incident capture (runtime/capture.py, round 17): the digest
+        # echo first — a 200's X-Output-Digest must be exactly the
+        # sha256 of the reply bytes the client read
+        import hashlib
+
+        conn.request("POST", "/",
+                     json.dumps({"x": [2.0, 3.0]}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        dig_body = resp.read()
+        dig_hdr = resp.getheader("X-Output-Digest")
+        assert resp.status == 200, (resp.status, dig_body)
+        if dig_hdr != hashlib.sha256(dig_body).hexdigest():
+            print(f"X-Output-Digest echo wrong: header {dig_hdr!r} vs "
+                  f"sha256 {hashlib.sha256(dig_body).hexdigest()}")
+            return 1
+        # then the tail-based retention decision: a request already
+        # past its deadline at batch-form time sheds 504 — an SLO
+        # breach the capture sink must keep, visible as a VALUE delta
+        # on the reason-labeled series
+        cap_series = ('synapseml_capture_records_total'
+                      '{reason="deadline"}')
+        cap_before = series_total(scrape(), cap_series)
+        conn.request("POST", "/",
+                     json.dumps({"x": [4.0, 5.0]}).encode(),
+                     {"Content-Type": "application/json",
+                      "X-Deadline-Ms": "0.001"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 504, resp.status
+        # the 504 flushes to the client BEFORE the capture append (a
+        # reply never waits on the dump volume), so the counter may
+        # trail the reply by a beat — poll briefly
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        cap_after = series_total(scrape(), cap_series)
+        while cap_after <= cap_before and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+            cap_after = series_total(scrape(), cap_series)
+        if not cap_after > cap_before:
+            print("the deadline-shed 504 was not captured: "
+                  f"{cap_series} {cap_before} -> {cap_after}")
             return 1
 
         # device-memory surface (runtime/perfwatch.py): /debug/memory

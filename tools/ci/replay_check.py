@@ -1,0 +1,297 @@
+"""Incident capture & deterministic replay proof (docs/observability.md,
+"Incident capture & replay"): the full loop on a REAL model-scoring
+serving subprocess —
+
+1. a serving replica (MLP ONNX model, AOT-warmed against a shared
+   ExecutableStore, capture armed with head-sample 1.0 so healthy
+   requests are kept too) takes open-loop loadgen traffic;
+2. a poison payload (a non-numeric feature the scorer's ``np.asarray``
+   deterministically rejects) rides a coalesced burst so the
+   poison-bisection isolates it to a 400 while its healthy batch-mates
+   score 200;
+3. the live ``/metrics`` must show ``capture_records_total`` moving for
+   both the ``poison`` and ``head_sample`` reasons, and
+   ``/debug/capture`` must list the records;
+4. after a SIGTERM drain, the capture file is replayed OFFLINE in a
+   FRESH interpreter (``tools/replay.py --model --cache-dir``): every
+   healthy record must reproduce a bit-identical output digest, the
+   poison record must reproduce its 400, warmup must deserialize
+   every signature from the store (compiled == 0) and the recompile
+   sentinel must read ZERO — the replay compiled nothing;
+5. a deliberately perturbed record (flipped digest) must make the
+   harness exit 2 with a divergence report naming the rid.
+
+"It broke once" becomes a committed, re-runnable artifact. Driven by
+tools/ci/smoke_replay.sh under a hard timeout: a wedged warmup or
+replay hangs rather than fails, so it becomes a fast exit-124.
+"""
+import hashlib
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+POISON_FEATURES = ["not-a-number"] + [1.0] * 15  # np.asarray -> ValueError
+FEATURE_DIM = 16
+
+
+def series_total(text: str, name: str) -> float:
+    total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(name) and not ln.startswith(name + "_"):
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def get(url: str, timeout: float = 15.0):
+    with urllib.request.urlopen(urllib.request.Request(url),
+                                timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def post(url: str, obj, timeout: float = 60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers.items())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, body, dict(e.headers.items()) if e.headers else {}
+
+
+def poison_burst(url: str, attempts: int = 3):
+    """One coalesced burst of 8 concurrent posts, exactly one poisoned:
+    the 25ms coalesce window batches them, the bisection isolates the
+    poison to a 400 while the mates score 200. Retried a couple of
+    times — an unlucky singleton drain replies 500 (the bisection only
+    runs at n>1), which is not the contract under test."""
+    for _ in range(attempts):
+        results = [None] * 8
+        barrier = threading.Barrier(8)
+
+        def client(i):
+            body = (POISON_FEATURES if i == 3
+                    else [float((i + k) % 7) for k in range(FEATURE_DIM)])
+            barrier.wait(timeout=30)
+            results[i] = post(url, {"features": body})
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if any(r is None for r in results):
+            return None, "a burst client hung"
+        statuses = [r[0] for r in results]
+        if statuses[3] == 400 and statuses.count(200) == 7:
+            return results, None
+        time.sleep(0.2)
+    return None, f"burst never isolated the poison to a 400 ({statuses})"
+
+
+def main() -> int:
+    from synapseml_tpu.onnx import zoo
+    from tools.loadgen import run_load
+
+    work = tempfile.mkdtemp(prefix="replay_proof_")
+    model_path = os.path.join(work, "model.onnx")
+    with open(model_path, "wb") as fh:
+        fh.write(zoo.mlp([16, 32], num_classes=4, seed=0))
+    cache_dir = os.path.join(work, "cache")
+    cap_dir = os.path.join(work, "capture")
+
+    env = dict(os.environ)
+    env.pop("SYNAPSEML_FAULTS", None)
+    env.setdefault("PYTHONPATH", os.getcwd())
+    # keep EVERY healthy reply: the proof replays normal scoring next
+    # to the breach (production default is 0.01)
+    env["SYNAPSEML_CAPTURE_HEAD_SAMPLE"] = "1.0"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "synapseml_tpu.io.serving",
+         "--host", "127.0.0.1", "--port", "0", "--name", "replay_proof",
+         "--model", model_path, "--cache-dir", cache_dir,
+         "--warmup", "auto", "--coalesce-ms", "25",
+         "--dump-dir", cap_dir, "--drain-timeout-ms", "4000"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    capture_file = os.path.join(cap_dir, f"capture-{proc.pid}.jsonl")
+    try:
+        lines, url_box = [], {}
+        url_found = threading.Event()
+
+        def read_stdout():
+            for line in proc.stdout:
+                lines.append(line)
+                if not url_found.is_set():
+                    m = re.search(r"serving \[.*\] on (http://\S+/)",
+                                  line)
+                    if m:
+                        url_box["url"] = m.group(1)
+                        url_found.set()
+
+        threading.Thread(target=read_stdout, daemon=True).start()
+        # generous: --warmup auto compiles the full bucket ladder on a
+        # cold cache (the replay below then proves the store pays out)
+        if not url_found.wait(420.0):
+            print("FAIL: serving subprocess never announced its URL")
+            return 1
+        url = url_box["url"]
+        base = url.rstrip("/")
+        print(f"replica up at {url}", flush=True)
+
+        _, before_b = get(base + "/metrics")
+        before = before_b.decode()
+
+        # open-loop healthy traffic (digest-bearing 200s to replay)
+        s = run_load(url, rps=30, duration_s=1.5, shapes=[FEATURE_DIM],
+                     seed=11, timeout=30.0,
+                     payload_fn=lambda i, shape: {
+                         "features": [float((i + k) % 7)
+                                      for k in range(shape)]})
+        if s["hung"] or s["by_status"].get("200", 0) < 10:
+            print(f"FAIL: healthy load did not score: {s['by_status']} "
+                  f"hung={s['hung']}")
+            return 1
+
+        burst, err = poison_burst(url)
+        if err:
+            print(f"FAIL: {err}")
+            return 1
+        # the mates' replies carry the digest the replay must reproduce
+        mate_digest = burst[0][2].get("X-Output-Digest")
+        if not mate_digest or mate_digest != hashlib.sha256(
+                burst[0][1]).hexdigest():
+            print(f"FAIL: X-Output-Digest missing/wrong on a burst "
+                  f"mate: {mate_digest!r}")
+            return 1
+
+        # mid-run telemetry: the reason-labeled capture counters moved.
+        # Replies flush to clients BEFORE the capture append, so the
+        # counters may trail the burst by a beat — poll briefly
+        def _capture_deltas():
+            _, after_b = get(base + "/metrics")
+            after = after_b.decode()
+            out = {}
+            for reason in ("poison", "head_sample"):
+                series = ('synapseml_capture_records_total'
+                          f'{{reason="{reason}"}}')
+                out[reason] = (series_total(after, series)
+                               - series_total(before, series))
+            return out
+
+        floors = {"poison": 1, "head_sample": 10}
+        deadline = time.monotonic() + 10.0
+        deltas = _capture_deltas()
+        while (any(deltas[r] < f for r, f in floors.items())
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+            deltas = _capture_deltas()
+        short = {r: d for r, d in deltas.items() if d < floors[r]}
+        if short:
+            print(f"FAIL: capture_records_total deltas short of their "
+                  f"floors: {short}")
+            return 1
+
+        # /debug/capture lists the breach with its file location
+        _, dbg_b = get(base + "/debug/capture?n=64")
+        dbg = json.loads(dbg_b)
+        if not dbg.get("records") or not any(
+                r.get("reason") == "poison" for r in dbg["records"]):
+            print(f"FAIL: /debug/capture shows no poison record "
+                  f"({len(dbg.get('records', []))} records)")
+            return 1
+        if dbg.get("path") != capture_file:
+            print(f"FAIL: /debug/capture path {dbg.get('path')!r} != "
+                  f"{capture_file!r}")
+            return 1
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        if rc != 0:
+            print(f"FAIL: serving exited {rc}")
+            return 1
+        print("capture phase ok: poison=400 isolated, counters moved, "
+              "clean drain", flush=True)
+
+        # --- offline replay in a FRESH interpreter ------------------
+        report_path = os.path.join(work, "report.json")
+        rp = subprocess.run(
+            [sys.executable, "tools/replay.py", capture_file,
+             "--model", model_path, "--cache-dir", cache_dir,
+             "--keep-outputs", "--out", report_path],
+            capture_output=True, text=True, env=env, timeout=420)
+        print(rp.stdout.strip(), flush=True)
+        if rp.returncode != 0:
+            print(f"FAIL: offline replay exited {rp.returncode}: "
+                  f"{rp.stderr[-2000:]}")
+            return 1
+        with open(report_path, encoding="utf-8") as fh:
+            report = json.load(fh)
+        if report["diverged"]:
+            print(f"FAIL: replay diverged: {report['diverged'][:3]}")
+            return 1
+        if report["matched"] < 10 or report["reproduced_errors"] < 1:
+            print(f"FAIL: replay matched={report['matched']} "
+                  f"reproduced_errors={report['reproduced_errors']}")
+            return 1
+        # the zero-recompile proof: warmup deserialized EVERY signature
+        # from the store the serving process seeded, and nothing
+        # compiled on the scoring path either
+        if report.get("recompiles") != 0:
+            print(f"FAIL: replay recompiled "
+                  f"({report.get('recompiles')}) — the shared store "
+                  "did not pay out")
+            return 1
+        wu = report.get("warmup", {})
+        if wu.get("compiled", 1) != 0 or wu.get("loaded", 0) < 1:
+            print(f"FAIL: replay warmup was not store-fed: {wu}")
+            return 1
+
+        # --- a perturbed record must fail loudly --------------------
+        perturbed = os.path.join(work, "perturbed.jsonl")
+        flipped = None
+        with open(capture_file, encoding="utf-8") as src, \
+                open(perturbed, "w", encoding="utf-8") as dst:
+            for line in src:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if flipped is None and rec.get("status_code") == 200:
+                    rec["output_digest"] = "0" * 64
+                    flipped = rec["rid"]
+                dst.write(json.dumps(rec) + "\n")
+        rp2 = subprocess.run(
+            [sys.executable, "tools/replay.py", perturbed,
+             "--model", model_path, "--cache-dir", cache_dir],
+            capture_output=True, text=True, env=env, timeout=420)
+        if rp2.returncode != 2:
+            print(f"FAIL: perturbed replay exited {rp2.returncode}, "
+                  f"wanted 2: {rp2.stdout[-1000:]}")
+            return 1
+        if flipped not in rp2.stdout:
+            print(f"FAIL: divergence report does not name the "
+                  f"perturbed rid {flipped}: {rp2.stdout[-1000:]}")
+            return 1
+        print(f"replay proof ok: {report['matched']} bit-identical, "
+              f"poison 400 reproduced, 0 recompiles "
+              f"({wu.get('loaded')} store-loaded), perturbed rid "
+              f"{flipped[:8]}... exits 2")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,5 +14,5 @@ exec timeout -k 10 "${SMOKE_TIMEOUT:-300}" env JAX_PLATFORMS=cpu \
   python -m pytest tests/test_executor_pipeline.py tests/test_serving.py \
   tests/test_faults.py tests/test_channel_failover.py \
   tests/test_blackbox.py tests/test_perfwatch.py tests/test_fleet.py \
-  tests/test_costmodel.py tests/test_tracing.py \
+  tests/test_costmodel.py tests/test_tracing.py tests/test_capture.py \
   -q -p no:cacheprovider

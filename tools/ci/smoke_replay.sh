@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Incident capture & deterministic replay proof (tools/ci/replay_check.py,
+# docs/observability.md "Incident capture & replay"): a real model-scoring
+# serving subprocess captures a poison-isolated 400 and its healthy
+# batch-mates under load, then a FRESH interpreter replays the capture
+# file offline — bit-identical digests, the poison's 400 reproduced, zero
+# post-warmup recompiles (the shared ExecutableStore pays out), and a
+# deliberately perturbed record exits 2 with a divergence report.
+#
+# Hard wall-clock timeout: a wedged warmup/replay hangs rather than
+# fails, so it becomes a fast exit-124 instead of a stuck job.
+#
+# Usage: tools/ci/smoke_replay.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+exec timeout -k 10 "${SMOKE_TIMEOUT:-600}" \
+  python tools/ci/replay_check.py

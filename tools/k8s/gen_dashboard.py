@@ -36,7 +36,7 @@ OUT = os.path.join(HERE, "chart", "dashboards",
                    "serving-dashboard.json")
 
 PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
-            "fleet_", "process_", "trace_")
+            "fleet_", "process_", "trace_", "capture_")
 _NAME = re.compile(r"([a-z][a-z0-9_]*)(\{([a-z_=,]*)\})?")
 
 

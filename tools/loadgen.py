@@ -49,11 +49,19 @@ in tools/ci/chaos_check.py)::
         --rps 200 --duration 10 --shapes 2,8,32 [--deadline-ms 250] \
         [--seed 7] [--json] [--out results.json] \
         [--slo-p99-ms 250] [--slo-availability 0.999] \
-        [--targets http://a/,http://b/] [--payload-key features]
+        [--targets http://a/,http://b/] [--payload-key features] \
+        [--replay capture.jsonl]
+
+Replay verification mode: ``--replay capture.jsonl`` drives a capture
+file's payloads (``runtime/capture.py``) in recorded order through the
+same open-loop clock and verifies each reply's ``X-Output-Digest``
+against the record — ``digest_mismatches`` in the summary/``--out``
+JSON, nonzero exits 2 (the "did the rollout change scores?" gate).
 """
 from __future__ import annotations
 
 import argparse
+import base64
 import json
 import random
 import threading
@@ -61,6 +69,35 @@ import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def _record_payload(rec: Dict[str, Any]) -> Optional[bytes]:
+    """A capture record's request body back as bytes (inline utf-8 or
+    base64) — duplicated from runtime/capture.py so this tool stays
+    stdlib-only and runnable from an operator's laptop."""
+    if "payload" in rec:
+        return str(rec["payload"]).encode("utf-8")
+    if "payload_b64" in rec:
+        try:
+            return base64.b64decode(rec["payload_b64"])
+        except (ValueError, TypeError):
+            return None
+    return None
+
+
+def load_capture_records(path: str) -> List[Dict[str, Any]]:
+    """Parse a capture JSONL file (runtime/capture.py), skipping the
+    one torn tail line a crash can leave."""
+    out: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
 
 
 def _default_payload(i: int, shape: int) -> Dict[str, Any]:
@@ -80,16 +117,20 @@ def percentile(sorted_vals: Sequence[float], q: float) -> float:
 
 
 def _send(url: str, body: bytes, headers: Dict[str, str],
-          timeout: float) -> Tuple[Any, Optional[str]]:
-    """``(status, rid)`` for one attempt — the rid comes back from the
-    server's ``X-Request-Id`` reply header (every reply path echoes
-    one), so a summary entry can link straight to ``/span/<rid>``."""
+          timeout: float) -> Tuple[Any, Optional[str], Optional[str]]:
+    """``(status, rid, output_digest)`` for one attempt — the rid comes
+    back from the server's ``X-Request-Id`` reply header (every reply
+    path echoes one), so a summary entry can link straight to
+    ``/span/<rid>``; the ``X-Output-Digest`` header is what the
+    ``--replay`` verification mode compares against the capture
+    record's digest."""
     req = urllib.request.Request(url, data=body, method="POST",
                                  headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             r.read()
-            return r.status, r.headers.get("X-Request-Id")
+            return (r.status, r.headers.get("X-Request-Id"),
+                    r.headers.get("X-Output-Digest"))
     except urllib.error.HTTPError as e:
         # explicit non-2xx IS a terminal reply (shed/drain/error paths);
         # read drains the connection so keep-alive sockets recycle
@@ -97,10 +138,12 @@ def _send(url: str, body: bytes, headers: Dict[str, str],
             e.read()
         except Exception:  # noqa: BLE001 - best-effort drain
             pass
-        return e.code, (e.headers.get("X-Request-Id")
-                        if e.headers is not None else None)
+        if e.headers is not None:
+            return (e.code, e.headers.get("X-Request-Id"),
+                    e.headers.get("X-Output-Digest"))
+        return e.code, None, None
     except Exception:  # noqa: BLE001 - refused/reset/socket timeout
-        return "error", None
+        return "error", None, None
 
 
 def run_load(url: Optional[str], rps: float, duration_s: float,
@@ -112,7 +155,9 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
              on_result: Optional[Callable[[int, Any, float], None]] = None,
              stop: Optional[threading.Event] = None,
              targets: Optional[Sequence[str]] = None,
-             slowest_n: int = 10) -> Dict[str, Any]:
+             slowest_n: int = 10,
+             replay_records: Optional[Sequence[Dict[str, Any]]] = None
+             ) -> Dict[str, Any]:
     """Drive ``rps`` Poisson arrivals against ``url`` for ``duration_s``
     seconds; block until every sender reaches a terminal record; return
     the summary dict. ``seed`` makes the arrival schedule and shape
@@ -139,7 +184,18 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
     ``GET /fleet/trace/<trace_id>``. The summary's ``slowest`` array
     (top ``slowest_n`` by latency: rid, trace_id, latency_s, status,
     target) is the jump-off from a bench/chaos report to exactly that
-    endpoint."""
+    endpoint.
+
+    Replay mode (``--replay``): ``replay_records`` is a sequence of
+    capture records (runtime/capture.py JSONL dicts) driven in
+    RECORDED order — same open-loop Poisson clock, but the bodies are
+    the captured payload bytes and each record's ``trace_id`` rides
+    the replayed request's traceparent, so the replay legs stitch
+    next to the incident's own. Every reply to a record captured as
+    200 has its ``X-Output-Digest`` header verified against the
+    record's digest; the summary gains ``replayed`` /
+    ``digest_checked`` / ``digest_mismatches`` (the "did the rollout
+    change scores?" counter — the CLI exits 2 when it is nonzero)."""
     rng = random.Random(seed)
     headers = {"Content-Type": "application/json"}
     if deadline_ms is not None:
@@ -157,6 +213,7 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
     per_target: Dict[str, Dict[str, Any]] = {
         t: {"by_status": {}, "ok_lat": []} for t in target_list}
     failovers = [0]
+    digest_stats = {"checked": 0, "mismatches": 0, "unverified": 0}
 
     def _record_attempt(target: str, status: Any, dt: float):
         rec = per_target[target]
@@ -165,12 +222,13 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
         if status == 200:
             rec["ok_lat"].append(dt)
 
-    def sender(i: int, body: bytes, trace_id: str, traceparent: str):
+    def sender(i: int, body: bytes, trace_id: str, traceparent: str,
+               expect_digest: Optional[str] = None):
         hdrs = dict(headers)
         hdrs["traceparent"] = traceparent
         target = target_list[i % len(target_list)]
         t0 = time.monotonic()
-        status, rid = _send(target, body, hdrs, timeout)
+        status, rid, out_digest = _send(target, body, hdrs, timeout)
         with lock:
             _record_attempt(target, status, time.monotonic() - t0)
         if status == "error" and len(target_list) > 1:
@@ -181,36 +239,88 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
             # so both attempts stitch into one trace.
             target = target_list[(i + 1) % len(target_list)]
             t1 = time.monotonic()
-            status, rid = _send(target, body, hdrs, timeout)
+            status, rid, out_digest = _send(target, body, hdrs, timeout)
             with lock:
                 failovers[0] += 1
                 _record_attempt(target, status, time.monotonic() - t1)
         dt = time.monotonic() - t0
         with lock:
             results[i] = (status, dt, rid, trace_id, target)
+            if expect_digest is not None:
+                if expect_digest == "":
+                    # the record itself carries no digest to compare:
+                    # unverified, visibly
+                    digest_stats["unverified"] += 1
+                elif status == 200:
+                    # the determinism check: a 200 whose digest header
+                    # is absent or different means the server's output
+                    # for this exact payload CHANGED since capture
+                    digest_stats["checked"] += 1
+                    if out_digest != expect_digest:
+                        digest_stats["mismatches"] += 1
+                elif status in (429, 503, 504, "error"):
+                    # shed/transport: never reached the scoring path —
+                    # unverified, not a verdict (counted so the gate
+                    # is never silently partial)
+                    digest_stats["unverified"] += 1
+                else:
+                    # 400/5xx to a payload that scored 200 at capture:
+                    # the rollout now FAILS this request — that is a
+                    # score change, not an environmental outcome
+                    digest_stats["checked"] += 1
+                    digest_stats["mismatches"] += 1
         if on_result is not None:
             on_result(i, status, dt)
 
+    replay_list = (list(replay_records) if replay_records is not None
+                   else None)
+    replay_skipped = 0
     t_start = time.monotonic()
     t_end = t_start + duration_s
     next_arrival = t_start
     i = 0
-    while next_arrival < t_end and (stop is None or not stop.is_set()):
+    while stop is None or not stop.is_set():
+        if replay_list is None:
+            if next_arrival >= t_end:
+                break
+        elif i + replay_skipped >= len(replay_list):
+            break
         delay = next_arrival - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        body = json.dumps(
-            payload_fn(i, shapes[i % len(shapes)])).encode()
-        # one trace per scheduled arrival (or-1 guards the 2^-128
-        # all-zero draw the W3C grammar forbids); deterministic under
-        # --seed like the schedule itself
-        trace_id = "%032x" % (rng.getrandbits(128) or 1)
+        expect_digest = None
+        if replay_list is not None:
+            # recorded order, same Poisson clock: the replay offers
+            # the incident's payloads at a controlled rate, not the
+            # incident's (possibly pathological) arrival pattern
+            rec = replay_list[i + replay_skipped]
+            body = _record_payload(rec)
+            if body is None:
+                replay_skipped += 1
+                continue
+            if rec.get("status_code") == 200:
+                # "" = a 200 record with no recorded digest (trimmed /
+                # older-format file): counted unverified in the
+                # sender, never silently skipped
+                expect_digest = rec.get("output_digest") or ""
+            rtid = str(rec.get("trace_id") or "")
+            trace_id = (rtid if len(rtid) == 32
+                        and all(c in "0123456789abcdef" for c in rtid)
+                        else "%032x" % (rng.getrandbits(128) or 1))
+        else:
+            body = json.dumps(
+                payload_fn(i, shapes[i % len(shapes)])).encode()
+            # one trace per scheduled arrival (or-1 guards the 2^-128
+            # all-zero draw the W3C grammar forbids); deterministic
+            # under --seed like the schedule itself
+            trace_id = "%032x" % (rng.getrandbits(128) or 1)
         traceparent = "00-%s-%016x-01" % (trace_id,
                                           rng.getrandbits(64) or 1)
         with lock:
             results.append(None)
         t = threading.Thread(target=sender,
-                             args=(i, body, trace_id, traceparent),
+                             args=(i, body, trace_id, traceparent,
+                                   expect_digest),
                              daemon=True)
         t.start()
         senders.append(t)
@@ -271,6 +381,13 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
                           for q in (50.0, 95.0, 99.0)},
         "slowest": slowest,
     }
+    if replay_list is not None:
+        with lock:
+            summary["replayed"] = i
+            summary["replay_skipped"] = replay_skipped
+            summary["digest_checked"] = digest_stats["checked"]
+            summary["digest_mismatches"] = digest_stats["mismatches"]
+            summary["digest_unverified"] = digest_stats["unverified"]
     if len(target_list) > 1 or targets:
         with lock:
             summary["failover_retries"] = failovers[0]
@@ -347,6 +464,13 @@ def main(argv=None) -> int:
                     help="JSON field name the feature vector rides "
                          "under (the serving model pipeline expects "
                          "'features'; default 'x')")
+    ap.add_argument("--replay", default=None, metavar="CAPTURE_JSONL",
+                    help="drive the payloads of a capture file "
+                         "(runtime/capture.py) in recorded order "
+                         "through the open-loop clock and verify each "
+                         "reply's X-Output-Digest against the record "
+                         "(digest_mismatches in the summary; nonzero "
+                         "exits 2)")
     ap.add_argument("--rps", type=float, default=50.0)
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--shapes", default="2",
@@ -380,10 +504,19 @@ def main(argv=None) -> int:
     def payload(i: int, shape: int) -> Dict[str, Any]:
         return {key: _default_payload(i, shape)["x"]}
 
+    replay_records = None
+    if args.replay:
+        try:
+            replay_records = load_capture_records(args.replay)
+        except OSError as e:
+            ap.error(f"--replay {args.replay}: {e}")
+        if not replay_records:
+            ap.error(f"--replay {args.replay}: no records")
     summary = run_load(args.url, args.rps, args.duration, shapes,
                        deadline_ms=args.deadline_ms,
                        timeout=args.timeout, seed=args.seed,
-                       payload_fn=payload, targets=targets)
+                       payload_fn=payload, targets=targets,
+                       replay_records=replay_records)
     slo = evaluate_slo(summary, args.slo_p99_ms, args.slo_availability)
     if slo is not None:
         summary["slo"] = slo
@@ -401,10 +534,25 @@ def main(argv=None) -> int:
               f"goodput={summary['goodput_rps']:.1f}rps")
         print("latency(200s): " + "  ".join(
             f"p{q:.0f}={lat[q] * 1e3:.2f}ms" for q in (50.0, 95.0, 99.0)))
+        if replay_records is not None:
+            print(f"replay: {summary['replayed']} records, "
+                  f"digest_checked={summary['digest_checked']} "
+                  f"digest_mismatches={summary['digest_mismatches']} "
+                  f"digest_unverified={summary['digest_unverified']}")
         if slo is not None:
             print(f"slo: {'PASS' if slo['pass'] else 'FAIL'} {slo}")
     if summary["hung"]:
         return 1
+    if replay_records is not None and not summary.get("digest_checked"):
+        # zero verified digests = the gate compared NOTHING (endpoint
+        # down, every reply shed, or a capture with no 200 records):
+        # a vacuous pass must not read as "the rollout changed no
+        # scores"
+        print("replay verification vacuous: 0 digests checked "
+              f"(by_status={summary['by_status']})")
+        return 2
+    if summary.get("digest_mismatches"):
+        return 2
     return 0 if slo is None or slo["pass"] else 2
 
 

@@ -1,0 +1,398 @@
+"""Offline deterministic replay of captured incidents.
+
+The capture sink (``synapseml_tpu/runtime/capture.py``) keeps the exact
+input bytes of every SLO-breaching request plus a sha256 **output
+digest** of the reply that went out. This harness closes the loop: load
+a capture file, rebuild the scoring pipeline from the same model (the
+recorded model content hash is verified against the file you hand it —
+replaying yesterday's incident against today's weights would "diverge"
+meaninglessly), warm it from the shared ``ExecutableStore`` (the
+recompile sentinel proves the replay compiled nothing new), re-score
+every record, and diff the recomputed digests against the captured
+ones:
+
+- a captured **200** must reproduce a 200 with a bit-identical digest;
+- a captured **400** (a poison payload the bisection isolated) must
+  reproduce its error — a poison record that suddenly scores clean is
+  a divergence too (the rollout changed behavior);
+- sheds and infrastructure errors (429/503/504/5xx) are environmental,
+  not properties of the payload — they are reported as skipped, never
+  replayed for a verdict.
+
+Exit codes: **0** every replayable record reproduced, **2** any
+divergence (per-record report: rid, trace_id, captured vs replayed
+digest, max-abs-diff when the record retained its reply and
+``--keep-outputs`` is set), **1** usage/model-mismatch/empty-capture
+errors.
+
+``--serve URL`` replays against a LIVE endpoint instead: each payload
+is POSTed in recorded order and the reply's ``X-Output-Digest`` header
+is compared — the "did this rollout change scores?" canary, no model
+file needed on the operator's side.
+
+Usage::
+
+    python tools/replay.py capture.jsonl --model model.onnx \
+        [--cache-dir /cache/compile] [--keep-outputs] \
+        [--limit N] [--out report.json]
+    python tools/replay.py capture.jsonl --serve http://host:8898/
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+REPLAYABLE = (200, 400)
+
+
+def _max_abs_diff(a: bytes, b: bytes) -> Optional[float]:
+    """Max absolute difference between the numeric leaves of two JSON
+    bodies walked in parallel, or None when shapes/types disagree (a
+    structural divergence is reported via the digests either way)."""
+    try:
+        da, db = json.loads(a), json.loads(b)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None
+
+    worst = [0.0]
+
+    def walk(x, y) -> bool:
+        if isinstance(x, bool) or isinstance(y, bool):
+            return x == y
+        if isinstance(x, (int, float)) and isinstance(y, (int, float)):
+            worst[0] = max(worst[0], abs(float(x) - float(y)))
+            return True
+        if isinstance(x, list) and isinstance(y, list):
+            return (len(x) == len(y)
+                    and all(walk(xi, yi) for xi, yi in zip(x, y)))
+        if isinstance(x, dict) and isinstance(y, dict):
+            return (set(x) == set(y)
+                    and all(walk(x[k], y[k]) for k in x))
+        return x == y
+
+    return worst[0] if walk(da, db) else None
+
+
+def _load_records(paths: List[str]) -> List[Dict[str, Any]]:
+    from synapseml_tpu.runtime import capture as cap
+
+    records: List[Dict[str, Any]] = []
+    for p in paths:
+        records.extend(cap.scan(p))
+    return records
+
+
+def _echo_pipeline():
+    """The serving entry's no-model echo pipeline, replicated byte-for-
+    byte (``make_reply`` over the parsed JSON value) so echo captures
+    replay to identical digests."""
+    import numpy as np
+
+    from synapseml_tpu.io.serving import make_reply
+
+    def pipeline(table):
+        replies = np.empty(table.num_rows, dtype=object)
+        for i, v in enumerate(table["value"]):
+            replies[i] = make_reply(v)
+        return table.with_column("reply", replies)
+
+    return pipeline
+
+
+def _score_one(pipeline, rec: Dict[str, Any], payload: bytes
+               ) -> Tuple[int, str, Optional[bytes], Optional[str]]:
+    """Re-score one captured payload through the rebuilt pipeline:
+    ``(status, digest, reply_bytes, error)``. A pipeline exception maps
+    to 400 — exactly the verdict the serving bisection hands a
+    confirmed poison singleton."""
+    import numpy as np
+
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.io.http import HTTPRequestData
+    from synapseml_tpu.io.serving import ID_COL, REQUEST_COL, parse_request
+
+    ids = np.array([rec.get("rid") or "replay"], dtype=object)
+    reqs = np.empty(1, dtype=object)
+    reqs[:] = [HTTPRequestData(
+        url=rec.get("path") or "/", method=rec.get("method") or "POST",
+        headers={"Content-Type": rec.get("content_type")
+                 or "application/json"},
+        entity=payload)]
+    try:
+        table = parse_request(Table({ID_COL: ids, REQUEST_COL: reqs}))
+        resp = pipeline(table)["reply"][0]
+        body = resp.entity or b""
+        return (resp.status_code,
+                hashlib.sha256(body).hexdigest(), body, None)
+    except Exception as e:  # noqa: BLE001 - the poison-reproduce path
+        return 400, "", None, repr(e)[:300]
+
+
+def _post_one(url: str, rec: Dict[str, Any], payload: bytes,
+              timeout: float) -> Tuple[Any, str, Optional[bytes]]:
+    """--serve mode: one POST of a captured payload; ``(status,
+    digest_header, reply_bytes)`` — socket death reports ``"error"``."""
+    req = urllib.request.Request(
+        url, data=payload, method="POST",
+        headers={"Content-Type": rec.get("content_type")
+                 or "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return (r.status, r.headers.get("X-Output-Digest") or "",
+                    r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            body = e.read()
+        except Exception:  # noqa: BLE001 - best-effort drain
+            body = None
+        return (e.code,
+                (e.headers.get("X-Output-Digest") or ""
+                 if e.headers is not None else ""), body)
+    except Exception:  # noqa: BLE001 - refused/reset/timeout
+        return "error", "", None
+
+
+def _recompiles() -> float:
+    """Total post-warmup recompiles this process counted — the
+    PR-10 sentinel. Zero after an offline replay is the proof the
+    shared ExecutableStore really did hand back every executable."""
+    from synapseml_tpu.runtime import telemetry as tm
+
+    return sum(m.value for _lbl, m in
+               tm.series("executor_recompiles_total"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("captures", nargs="+",
+                    help="capture-<pid>.jsonl file(s) to replay")
+    ap.add_argument("--model", default=None,
+                    help="ONNX model file to rebuild the pipeline from "
+                         "(verified against the records' model content "
+                         "hash); omit for echo-pipeline captures")
+    ap.add_argument("--cache-dir", default=None,
+                    help="shared compile-cache/ExecutableStore dir — "
+                         "point it at the serving volume so warmup "
+                         "deserializes instead of compiling (the "
+                         "report's recompiles field proves it)")
+    ap.add_argument("--serve", default=None, metavar="URL",
+                    help="replay against a LIVE endpoint instead of "
+                         "rebuilding the pipeline (verifies the "
+                         "X-Output-Digest reply header)")
+    ap.add_argument("--keep-outputs", action="store_true",
+                    help="retain replayed reply bodies in the "
+                         "divergence report and compute max-abs-diff "
+                         "against records that kept theirs")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="replay at most this many records (0 = all)")
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="--serve mode per-request timeout")
+    ap.add_argument("--out", default=None,
+                    help="write the full report as JSON here")
+    args = ap.parse_args(argv)
+
+    records = _load_records(args.captures)
+    if not records:
+        print(f"error: no records in {', '.join(args.captures)} "
+              "(empty, missing, or fully torn file)")
+        return 1
+    replayable = [r for r in records
+                  if r.get("status_code") in REPLAYABLE
+                  and (r.get("payload") is not None
+                       or r.get("payload_b64") is not None)]
+    skipped = len(records) - len(replayable)
+    limited_out = 0
+    if args.limit > 0:
+        # accounted, never silent: a partial verification must not
+        # read as full coverage (the same no-silent-caps rule the
+        # vacuous-pass exits enforce)
+        limited_out = max(0, len(replayable) - args.limit)
+        replayable = replayable[:args.limit]
+    if not replayable:
+        print(f"error: {len(records)} records but none replayable "
+              "(only sheds/timeouts/5xx — environmental outcomes, "
+              "not payload properties)")
+        return 1
+
+    report: Dict[str, Any] = {
+        "files": args.captures,
+        "mode": "serve" if args.serve else "offline",
+        "records": len(records),
+        "replayable": len(replayable),
+        "skipped": skipped,
+        "limited_out": limited_out,
+    }
+
+    pipeline = None
+    if not args.serve:
+        from synapseml_tpu.runtime import compile_cache as cc
+
+        hashes = {r.get("model_hash") for r in replayable}
+        hashes.discard(None)
+        if hashes and not args.model:
+            print("error: records carry a model content hash "
+                  f"({sorted(hashes)[0][:16]}...) — pass --model "
+                  "<the model file the incident was served from>")
+            return 1
+        if args.model:
+            from synapseml_tpu.io.serving import _model_pipeline
+
+            pipeline, model = _model_pipeline(args.model,
+                                              cache_dir=args.cache_dir)
+            # hash the constructed model's PAYLOAD, exactly as serving
+            # stamped it (content_hash over model.model_payload): a
+            # raw-file hash would wrongly refuse any model whose
+            # loader re-encodes the proto (external-data sidecars)
+            local_hash = cc.content_hash(model.model_payload or b"")
+            report["model_hash"] = local_hash
+            if hashes and hashes != {local_hash}:
+                print("error: model hash mismatch — capture was served "
+                      f"from {sorted(hashes)[0][:16]}..., --model "
+                      f"{args.model} hashes to {local_hash[:16]}... "
+                      "(a diff against different weights is "
+                      "meaningless; find the incident's model)")
+                return 1
+            # warm every bucket signature BEFORE scoring: with the
+            # serving volume's shared store this deserializes instead
+            # of compiling, and the sentinel (report["recompiles"])
+            # proves nothing compiled on the scoring path either
+            try:
+                rep = model.warmup()
+                report["warmup"] = {"signatures": len(rep.entries),
+                                    "loaded": rep.loaded,
+                                    "compiled": rep.compiled,
+                                    "errors": len(rep.errors)}
+            except Exception as e:  # noqa: BLE001 - degrade to lazy
+                report["warmup"] = {"error": repr(e)[:200]}
+        else:
+            pipeline = _echo_pipeline()
+
+    from synapseml_tpu.runtime import capture as cap
+
+    diverged: List[Dict[str, Any]] = []
+    transport_errors: List[Dict[str, Any]] = []
+    matched = reproduced_errors = undecodable = 0
+    for rec in replayable:
+        payload = cap.payload_bytes(rec)
+        if payload is None:
+            # corrupt payload_b64: count it — a file where NOTHING
+            # decodes must end inconclusive, not "ok: 0 bit-identical"
+            undecodable += 1
+            continue
+        cap_status = rec.get("status_code")
+        cap_digest = rec.get("output_digest") or ""
+        if args.serve:
+            rep_status, rep_digest, rep_body = _post_one(
+                args.serve, rec, payload, args.timeout)
+            rep_err = None
+        else:
+            rep_status, rep_digest, rep_body, rep_err = _score_one(
+                pipeline, rec, payload)
+        entry = {
+            "rid": rec.get("rid"),
+            "trace_id": rec.get("trace_id"),
+            "reason": rec.get("reason"),
+            "captured_status": cap_status,
+            "replayed_status": rep_status,
+            "captured_digest": cap_digest,
+            "replayed_digest": rep_digest,
+        }
+        if rep_err:
+            entry["replayed_error"] = rep_err
+        if args.serve and (rep_status == "error"
+                           or rep_status in (429, 503, 504)):
+            # the POST never reached the scoring path: refused/reset/
+            # timeout, or the endpoint shed it (admission 429, drain
+            # 503, deadline 504). That is the ENVIRONMENT failing —
+            # the same statuses the offline replayable filter calls
+            # environmental — never evidence the rollout changed
+            # scores; report unverifiable, not diverged
+            transport_errors.append(entry)
+            continue
+        if cap_status == 400:
+            # the poison contract: the payload itself must still be
+            # the problem — a clean score means behavior changed. In
+            # --serve mode a sequential replay presents the poison as
+            # a SINGLETON batch, and serving's bisection only isolates
+            # to 400 at n>1 (a failing singleton legally replies 500),
+            # so either error status reproduces the poison live
+            if rep_status == 400 or (args.serve and rep_status == 500):
+                reproduced_errors += 1
+                continue
+            diverged.append(entry)
+            continue
+        if rep_status == 200 and rep_digest == cap_digest:
+            matched += 1
+            continue
+        if args.keep_outputs:
+            kept = cap.reply_bytes(rec)
+            if kept is not None and rep_body is not None:
+                entry["max_abs_diff"] = _max_abs_diff(kept, rep_body)
+            if rep_body is not None:
+                try:
+                    entry["replayed_reply"] = rep_body.decode("utf-8")
+                except UnicodeDecodeError:
+                    pass
+        diverged.append(entry)
+
+    report.update({
+        "matched": matched,
+        "reproduced_errors": reproduced_errors,
+        "undecodable": undecodable,
+        "diverged": diverged,
+    })
+    if args.serve:
+        report["transport_errors"] = transport_errors
+    else:
+        report["recompiles"] = _recompiles()
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, default=repr)
+    for d in diverged:
+        extra = (f" max_abs_diff={d['max_abs_diff']!r}"
+                 if "max_abs_diff" in d else "")
+        print(f"DIVERGED rid={d['rid']} trace={d['trace_id']} "
+              f"status {d['captured_status']}->{d['replayed_status']} "
+              f"digest {str(d['captured_digest'])[:16]}... -> "
+              f"{str(d['replayed_digest'])[:16]}...{extra}")
+    verdict = "DIVERGED" if diverged else (
+        "INCONCLUSIVE" if transport_errors or matched == 0 else "ok")
+    rec_note = (f" transport_errors={len(transport_errors)}"
+                if args.serve
+                else f" recompiles={report['recompiles']:.0f}")
+    lim_note = (f", {limited_out} limited out (--limit)"
+                if limited_out else "")
+    print(f"replay {verdict}: {matched} bit-identical, "
+          f"{reproduced_errors} errors reproduced, {len(diverged)} "
+          f"diverged, {skipped} skipped, {undecodable} undecodable"
+          f"{lim_note} (of {len(records)} records){rec_note}")
+    if diverged:
+        return 2
+    if transport_errors:
+        # nothing diverged, but some records never got verified: an
+        # unreachable or shedding endpoint must not read as a clean
+        # rollout
+        return 1
+    if matched == 0:
+        # no captured-200 record scored clean: an all-error run
+        # (poison-only file, broken --cache-dir, version skew) or an
+        # all-undecodable file is indistinguishable from a broken
+        # replay environment — crediting it would false-pass the
+        # exact determinism gate this harness is. Healthy
+        # head-samples exist so a replay always has a should-score
+        # record to prove the environment with.
+        print("inconclusive: zero records verified bit-identical — "
+              "replay a capture that includes healthy head-sampled "
+              "records, or fix the environment first.")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
