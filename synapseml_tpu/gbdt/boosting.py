@@ -401,6 +401,12 @@ class Booster:
                 f"feature width mismatch: model trained on "
                 f"{self.num_features} features, got {x.shape[1]}")
         k = self.num_class
+        if x.shape[0] == 0:
+            # zero-row predict: answer the empty shape directly instead
+            # of tracing the traversal scan over an empty batch (which
+            # used to compile a degenerate program per model)
+            out = np.zeros((0, k), np.float32)
+            return out if k > 1 else out[:, 0]
         t = self.num_trees
         t0 = max(0, int(start_iteration)) * k
         if num_iteration and num_iteration > 0:
@@ -434,7 +440,8 @@ class Booster:
                 jnp.asarray(self.cat_bitsets, jnp.uint32),
                 jnp.asarray(self.cat_boundaries, jnp.int32), k, n_used)
         else:
-            out = _predict_stack(stack, weights, jnp.asarray(x), k, n_used)
+            out = _predict_stack_routed(stack, weights, jnp.asarray(x),
+                                        k, n_used)
         out = np.asarray(out)
         if t0 == 0:
             out = out + self.init_score
@@ -553,7 +560,8 @@ def _predict_stack(stack, weights, x, k: int, t: int):
 
     def body(carry, tree_w):
         (feat, thr, left, right, value), w, idx = tree_w
-        pred = predict_tree((feat, thr, left, right, value), x) * w
+        pred = predict_tree((feat, thr, left, right, value), x,
+                            route=False) * w
         carry = carry.at[:, idx % k].add(pred)
         return carry, None
 
@@ -561,6 +569,49 @@ def _predict_stack(stack, weights, x, k: int, t: int):
     idxs = jnp.arange(t, dtype=jnp.int32)
     out, _ = jax.lax.scan(body, out, (stack, weights, idxs))
     return out
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _predict_stack_pallas(stack, weights, x, k: int, t: int):
+    """Fused-kernel twin of :func:`_predict_stack`: the whole ensemble
+    walks one Pallas launch (pallas_kernels.predict_forest_tpu), leaf
+    sums accumulated in VMEM instead of a T-step scan of gather
+    chains. Weights fold into the value plane so the kernel carries
+    one fewer operand. Selected per shape class by the measured
+    prober (gbdt/predict_route.py), never called directly."""
+    from synapseml_tpu.gbdt import pallas_kernels
+
+    feat, thr, left, right, value = stack
+    return pallas_kernels.predict_forest_tpu(
+        x, feat, thr, left, right, value * weights[:, None], k=k)
+
+
+def _predict_stack_routed(stack, weights, x, k: int, t: int):
+    """Route one ensemble predict through the measured prober: the
+    fused Pallas traversal where a verified verdict says it wins, the
+    XLA scan everywhere else. A kernel-leg failure at dispatch time
+    demotes the shape class (persisted) and silently re-runs XLA —
+    scoring never degrades, it just doesn't accelerate."""
+    from synapseml_tpu.gbdt import predict_route
+
+    backend = predict_route.route_predict(
+        x.shape[0], t, stack[0].shape[1], x.shape[1], k, count=False)
+    if backend == "pallas":
+        try:
+            # materialize INSIDE the try: jax dispatch is async, so an
+            # execute-time kernel fault would otherwise surface at the
+            # caller's np.asarray — outside the fallback
+            out = jax.block_until_ready(
+                _predict_stack_pallas(stack, weights, x, k, t))
+            predict_route.count("pallas")
+            return out
+        except Exception:  # noqa: BLE001 - silent fallback is the contract
+            predict_route.poison(x.shape[0], t, stack[0].shape[1],
+                                 x.shape[1], k)
+    # counted by the backend that ACTUALLY served (catalog contract):
+    # a kernel-leg failure lands here and counts xla, not pallas
+    predict_route.count("xla")
+    return _predict_stack(stack, weights, x, k, t)
 
 
 @partial(jax.jit, static_argnums=(5, 6))

@@ -182,8 +182,13 @@ def _load_disk_routes() -> dict:
 
 # negative-lookup memo for cached_hist_route: shapes with NO measured
 # verdict would otherwise re-open + re-parse the disk cache on every
-# histogram trace. Cleared whenever a probe lands a new verdict.
-_ROUTE_NEG: set = set()
+# histogram trace. Cleared whenever a probe lands a new verdict —
+# and TTL'd ({base: monotonic expiry}): a verdict landed on the shared
+# cache volume by ANOTHER worker used to stay invisible here until a
+# restart (the negative memo never re-checked disk); now an expired
+# entry re-reads the file, so cross-process verdicts surface within
+# SYNAPSEML_ROUTE_NEG_TTL_S (runtime/proberoute.neg_ttl_s, default 60s).
+_ROUTE_NEG: dict = {}
 
 
 def cached_hist_route(n: int, f: int, n_bins: int) -> Optional[str]:
@@ -192,12 +197,18 @@ def cached_hist_route(n: int, f: int, n_bins: int) -> Optional[str]:
     code would be impossible). Prefers the full-integrity verdict;
     falls back to any reduced-budget tier for the same shape. Returns
     "pallas" / "xla" / None (nothing measured yet)."""
+    import time
+
     try:
         base = _route_key_base(n, f, n_bins)
     except Exception:  # noqa: BLE001 - no devices yet etc.
         return None
-    if base in _ROUTE_NEG:
-        return None
+    now = time.monotonic()
+    expiry = _ROUTE_NEG.get(base)
+    if expiry is not None:
+        if now < expiry:
+            return None
+        _ROUTE_NEG.pop(base, None)  # expired: re-check disk below
     got = _HIST_ROUTE_CACHE.get(base)
     if got is None:
         disk = _load_disk_routes()
@@ -211,7 +222,9 @@ def cached_hist_route(n: int, f: int, n_bins: int) -> Optional[str]:
                 got = v
                 break
     if got is None:
-        _ROUTE_NEG.add(base)
+        from synapseml_tpu.runtime.proberoute import neg_ttl_s
+
+        _ROUTE_NEG[base] = now + neg_ttl_s()
     return got
 
 
@@ -621,15 +634,38 @@ def build_tree(
     return tree, state["row_slot"], slot_value, state["slot_node"]
 
 
-def predict_tree(tree_arrays, x):
+def _single_tree_kernel(feat, thr, left, right, value, x):
+    """One-tree call into the fused traversal kernel (T=1 stack).
+    ``x``/``thr`` must already be float; used by both predict_tree
+    variants when the cached route says the kernel wins here."""
+    from synapseml_tpu.gbdt import pallas_kernels
+
+    return pallas_kernels.predict_forest_tpu(
+        x, feat[None, :], thr[None, :], left[None, :], right[None, :],
+        value[None, :], k=1)[:, 0]
+
+
+def predict_tree(tree_arrays, x, route: bool = True):
     """Vectorized traversal on raw features. x: [N, F] float.
 
     tree_arrays: tuple of [M] arrays (feature, threshold, left, right, value).
     NaN comparisons are False -> missing goes right (matches training, where
     the missing bin sorts after every splittable bin).
+
+    ``route=True`` consults the predict router's CACHED verdict (no
+    probe — this traces inside the boosting scan) and takes the fused
+    Pallas traversal when a measured verdict says it wins at this
+    shape; callers that already routed at a higher level (the stacked
+    ensemble predict) pass route=False.
     """
     feat, thr, left, right, value = tree_arrays
     n = x.shape[0]
+    if route and n:
+        from synapseml_tpu.gbdt import predict_route
+
+        if predict_route.cached_route(
+                n, 1, feat.shape[0], x.shape[1], 1) == "pallas":
+            return _single_tree_kernel(feat, thr, left, right, value, x)
     node = jnp.zeros(n, jnp.int32)
     max_depth = feat.shape[0] // 2 + 1
 
@@ -643,10 +679,22 @@ def predict_tree(tree_arrays, x):
     return value[node]
 
 
-def predict_tree_binned(tree_arrays, binned):
-    """Traversal on pre-binned rows (training-time refit / fast path)."""
+def predict_tree_binned(tree_arrays, binned, route: bool = True):
+    """Traversal on pre-binned rows (training-time refit / fast path).
+
+    Rides the same fused kernel as :func:`predict_tree` when routed:
+    bin ids and bin thresholds are exact in float32 (uint8/uint16 bins
+    < 2^24), so the integer ``<=`` comparison is preserved."""
     feat, thr_bin, left, right, value = tree_arrays
     n = binned.shape[0]
+    if route and n:
+        from synapseml_tpu.gbdt import predict_route
+
+        if predict_route.cached_route(
+                n, 1, feat.shape[0], binned.shape[1], 1) == "pallas":
+            return _single_tree_kernel(
+                feat, thr_bin.astype(jnp.float32), left, right, value,
+                binned.astype(jnp.float32))
     node = jnp.zeros(n, jnp.int32)
     max_depth = feat.shape[0] // 2 + 1
 

@@ -1,0 +1,237 @@
+"""Measured routing for the fused Pallas forest-traversal kernel.
+
+``cached_hist_route``-style prober for the PREDICT side (round 15): on
+first sight of a (rows, trees, nodes, features, classes) shape class on
+a TPU backend, compile the fused traversal kernel
+(:func:`synapseml_tpu.gbdt.pallas_kernels.predict_forest_tpu`), VERIFY
+it against the XLA scan reference on synthetic trees, time both legs,
+and persist the verdict ("pallas" only when the kernel is both correct
+and not slower). Any probe failure, numeric mismatch, or timing
+regression silently lands an "xla" verdict — scoring never degrades,
+it just doesn't accelerate. ``SYNAPSEML_GBDT_PALLAS=0`` kills the lane
+outright.
+
+Route decisions are counted in ``gbdt_predict_route_total{backend=}``
+(docs/observability.md) so a fleet can see which formulation actually
+serves — the same honesty contract as the histogram router's
+``auto_routed_to`` bench field.
+
+Trace-safety: :func:`cached_route` never probes (safe inside an
+ambient trace, where ``predict_tree`` runs under the boosting scan);
+:func:`route_predict` may probe, but escapes any ambient trace the way
+``pallas_kernels.available`` does — concrete numpy in, AOT
+lower+compile+execute out.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from synapseml_tpu.runtime.proberoute import RouteTable
+from synapseml_tpu.runtime.proberoute import best_of as _best_of
+
+_TABLE = RouteTable("predict_routing.json")
+
+# probe shape clamps: enough sustained compute per timed call that the
+# verdict reflects the formulations, not the dispatch tunnel (the
+# histogram router's round-4 lesson), yet bounded — the probe runs
+# SYNCHRONOUSLY in the first predict of a shape class, so a 4000-tree
+# ensemble must not pay a 4000-step probe there; per-tree cost scales
+# ~linearly in both formulations, so a clamped-T probe ranks them
+_PROBE_ROWS_CAP = 16384
+_PROBE_TREES_CAP = 128
+_PROBE_VERIFY_RTOL = 1e-4
+_PROBE_VERIFY_ATOL = 1e-5
+
+
+def enabled() -> bool:
+    import os
+
+    return os.environ.get("SYNAPSEML_GBDT_PALLAS", "1") != "0"
+
+
+def _shape_ok(n: int, t: int, m_pad: int, f: int, k: int) -> bool:
+    """Bounds that keep the kernel's [tn, m_pad] one-hot intermediates
+    and the per-tree VMEM blocks sane; anything wider routes to XLA."""
+    return (n >= 1 and t >= 1 and 1 <= k <= 32
+            and f <= 512 and m_pad <= 1024)
+
+
+def _count(backend: str) -> None:
+    try:
+        from synapseml_tpu.runtime import telemetry
+
+        telemetry.counter("gbdt_predict_route_total",
+                          backend=backend).inc()
+    except Exception:  # noqa: BLE001 - telemetry must never gate scoring
+        pass
+
+
+def _m_pad(m: int) -> int:
+    return max(128, -(-m // 128) * 128)
+
+
+def _key(n: int, t: int, m: int, f: int, k: int, strict: bool) -> str:
+    """Shape-class key: rows and trees bucket to the next power of two
+    (nearby sizes share one verdict), node width to its 128-lane pad.
+    Versioned like the histogram router's — a jaxlib or in-package
+    kernel upgrade must re-probe, not remember."""
+    n_b = 1 << (int(min(max(n, 256), 65536)) - 1).bit_length()
+    t_b = 1 << (int(min(max(t, 1), 4096)) - 1).bit_length()
+    kind = jax.devices()[0].device_kind
+    import synapseml_tpu as _pkg
+
+    pkg_v = getattr(_pkg, "__version__", "0")
+    return (f"pv1|jax{jax.__version__}|pkg{pkg_v}|{kind}|"
+            f"n{n_b}|t{t_b}|m{_m_pad(m)}|f{f}|k{k}|"
+            f"{'lt' if strict else 'le'}")
+
+
+def cached_route(n: int, t: int, m: int, f: int, k: int = 1,
+                 strict: bool = False) -> str:
+    """Cache-only verdict — NO probe (trace-safe). "xla" unless a
+    measured "pallas" verdict exists for this shape class and the lane
+    is viable here at all."""
+    backend = "xla"
+    if enabled() and jax.default_backend() == "tpu" \
+            and _shape_ok(n, t, _m_pad(m), f, k):
+        try:
+            got = _TABLE.lookup(_key(n, t, m, f, k, strict))
+        except Exception:  # noqa: BLE001 - no devices yet etc.
+            got = None
+        if got == "pallas":
+            backend = "pallas"
+    _count(backend)
+    return backend
+
+
+def count(backend: str) -> None:
+    """Count one served decision in gbdt_predict_route_total — for
+    callers that route with ``count=False`` and report the backend
+    that ACTUALLY served after the kernel leg's outcome is known (the
+    catalog documents the label as served-by, so a dispatch-time
+    kernel failure must land in the xla bucket)."""
+    _count(backend)
+
+
+def route_predict(n: int, t: int, m: int, f: int, k: int = 1,
+                  strict: bool = False, count: bool = True) -> str:
+    """Full routing: cached verdict, else compile+verify+time the
+    kernel at this shape class and persist the winner. Returns
+    "pallas" or "xla"; the decision is counted unless the caller
+    defers counting to the observed outcome (``count=False`` +
+    :func:`count`)."""
+    backend = "xla"
+    if enabled() and jax.default_backend() == "tpu" \
+            and _shape_ok(n, t, _m_pad(m), f, k):
+        try:
+            key = _key(n, t, m, f, k, strict)
+            got = _TABLE.lookup(key)
+            if got is None:
+                persist = True
+                try:
+                    got = _probe(n, t, m, f, k, strict)
+                except Exception:  # noqa: BLE001 - probe crash = xla leg
+                    # a crashed probe lands "xla" in the in-process memo
+                    # ONLY: not persisted (a transient failure must not
+                    # be remembered across processes), but memoized so a
+                    # deterministic crash costs one probe per process,
+                    # not one per predict call
+                    got, persist = "xla", False
+                _TABLE.record(key, got, persist=persist)
+            if got == "pallas":
+                backend = "pallas"
+        except Exception:  # noqa: BLE001 - routing must never fail a predict
+            backend = "xla"
+    if count:
+        _count(backend)
+    return backend
+
+
+def poison(n: int, t: int, m: int, f: int, k: int = 1,
+           strict: bool = False) -> None:
+    """Demote this shape class to XLA after a runtime failure of the
+    kernel leg (the silent-fallback half of the contract): persisted so
+    the failure is not re-paid after restart."""
+    try:
+        _TABLE.record(_key(n, t, m, f, k, strict), "xla")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _synthetic_forest(t: int, m: int, f: int,
+                      seed: int = 0) -> Tuple[np.ndarray, ...]:
+    """Valid random ensemble in complete-binary layout (children at
+    2i+1/2i+2, leaves where those fall outside M) — structurally the
+    worst-case depth the kernel's fori_loop must cover."""
+    rng = np.random.default_rng(seed)
+    idx = np.arange(m)
+    internal = 2 * idx + 2 < m
+    feat = np.where(internal[None, :],
+                    rng.integers(0, f, (t, m)), -1).astype(np.int32)
+    thr = np.where(internal[None, :],
+                   rng.normal(size=(t, m)), 0.0).astype(np.float32)
+    left = np.where(internal, 2 * idx + 1, 0).astype(np.int32)
+    right = np.where(internal, 2 * idx + 2, 0).astype(np.int32)
+    left = np.broadcast_to(left, (t, m)).copy()
+    right = np.broadcast_to(right, (t, m)).copy()
+    value = np.where(internal[None, :], 0.0,
+                     rng.normal(size=(t, m))).astype(np.float32)
+    return feat, thr, left, right, value
+
+
+def _probe(n: int, t: int, m: int, f: int, k: int,
+           strict: bool) -> str:
+    """Compile + verify + time the kernel against the PRODUCTION
+    fallback it would replace — boosting._predict_stack (unit weights)
+    for GBDT, iforest._path_lengths for the strict/depth variant — at
+    the (clamped) shape class, so a semantic change to either
+    formulation de-certifies stale verdicts instead of letting routed
+    and fallback results diverge. Lazy imports only: boosting/iforest
+    import this module inside functions too, so no cycle. Concrete
+    numpy in, AOT executables out — escapes any ambient trace exactly
+    like pallas_kernels.available()."""
+    from synapseml_tpu.gbdt import pallas_kernels
+
+    n_p = int(min(max(n, 256), _PROBE_ROWS_CAP))
+    t_p = int(min(max(t, 1), _PROBE_TREES_CAP))
+    rng = np.random.default_rng(0)
+    feat, thr, left, right, value = _synthetic_forest(t_p, m, f)
+    x = rng.normal(size=(n_p, f)).astype(np.float32)
+    x[rng.random(x.shape) < 0.05] = np.nan  # missing rows probe too
+
+    stack = (feat, thr, left, right, value)
+    depth = m // 2 + 1
+    if strict:
+        from synapseml_tpu.isolationforest.iforest import _path_lengths
+
+        def xla_fn(xx, *s):
+            # mean path * T = the kernel's accumulated total
+            return (_path_lengths(s, xx, depth) * t_p)[:, None]
+    else:
+        from synapseml_tpu.gbdt.boosting import _predict_stack
+
+        def xla_fn(xx, *s):
+            return _predict_stack(
+                s, jnp.ones((t_p,), jnp.float32), xx, k, t_p)
+
+    pallas_c = jax.jit(lambda xx, *s: pallas_kernels.predict_forest_tpu(
+        xx, *s, k=k, strict=strict)).lower(x, *stack).compile()
+    xla_c = jax.jit(xla_fn).lower(x, *stack).compile()
+
+    got = np.asarray(pallas_c(x, *stack))
+    want = np.asarray(xla_c(x, *stack))
+    if not np.allclose(got, want, rtol=_PROBE_VERIFY_RTOL,
+                       atol=_PROBE_VERIFY_ATOL, equal_nan=True):
+        return "xla"
+    args = (x,) + stack
+    return ("pallas" if _best_of(pallas_c, args) <= _best_of(xla_c, args)
+            else "xla")
+
+
+def clear_cache() -> None:
+    """Test hook: drop the in-process memo + negative memo."""
+    _TABLE.clear()

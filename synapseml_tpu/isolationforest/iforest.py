@@ -102,6 +102,23 @@ def _path_lengths(stack, x, depth_iters: int):
     return total / feat.shape[0]
 
 
+@partial(jax.jit, static_argnames=("depth_iters",))
+def _path_lengths_pallas(stack, x, depth_iters: int):
+    """Fused-kernel twin of :func:`_path_lengths`: the depth-
+    accumulating variant of the GBDT traversal kernel
+    (pallas_kernels.predict_forest_tpu with ``value=depth_adj`` and
+    the isolation-forest ``x < thr`` strict comparison) — the whole
+    forest in one launch, path-length sums resident in VMEM. Selected
+    by the measured prober in :meth:`IsolationForestModel._scores`."""
+    from synapseml_tpu.gbdt import pallas_kernels
+
+    feat, thr, lft, rgt, dadj = stack
+    total = pallas_kernels.predict_forest_tpu(
+        x, feat, thr, lft, rgt, dadj, k=1, depth=depth_iters,
+        strict=True)[:, 0]
+    return total / feat.shape[0]
+
+
 class IsolationForest(Estimator, HasFeaturesCol, HasPredictionCol):
     """ref: core/.../isolationforest/IsolationForest.scala:18 (param names
     follow the LinkedIn library the reference wraps)."""
@@ -175,10 +192,34 @@ class IsolationForestModel(Model, HasFeaturesCol, HasPredictionCol):
     score_col = Param("anomaly score column", default="outlierScore")
 
     def _scores(self, x: np.ndarray) -> np.ndarray:
+        if len(x) == 0:
+            # zero-row score: answer the empty shape directly instead
+            # of compiling a degenerate traversal program per model
+            # (mirrors Booster._raw_scores' round-15 fix)
+            return np.zeros(0, np.float32)
         feat, thr, lft, rgt, dadj = self.trees
         stack = tuple(jnp.asarray(a) for a in (feat, thr, lft, rgt, dadj))
-        mean_path = np.asarray(_path_lengths(stack, jnp.asarray(x, jnp.float32),
-                                             int(self.max_depth) + 1))
+        xd = jnp.asarray(x, jnp.float32)
+        depth_iters = int(self.max_depth) + 1
+        mean_path = None
+        from synapseml_tpu.gbdt import predict_route
+
+        t, m = np.asarray(feat).shape
+        if predict_route.route_predict(
+                len(x), t, m, x.shape[1], 1, strict=True,
+                count=False) == "pallas":
+            try:
+                mean_path = np.asarray(
+                    _path_lengths_pallas(stack, xd, depth_iters))
+                predict_route.count("pallas")
+            except Exception:  # noqa: BLE001 - silent fallback
+                predict_route.poison(len(x), t, m, x.shape[1], 1,
+                                     strict=True)
+        if mean_path is None:
+            # served-by honesty (catalog contract): the routed-away
+            # case AND a kernel-leg failure both count xla
+            predict_route.count("xla")
+            mean_path = np.asarray(_path_lengths(stack, xd, depth_iters))
         return np.power(2.0, -mean_path / max(float(self.c_norm), 1e-9))
 
     def _transform(self, table: Table) -> Table:

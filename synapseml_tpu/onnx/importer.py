@@ -249,10 +249,10 @@ def _dequantize_linear(ctx, x, scale, zero_point=None):
             - jnp.asarray(zp).astype(jnp.float32)) * scale
 
 
-@op("MatMulInteger")
-def _matmul_integer(ctx, a, b, a_zp=None, b_zp=None):
-    """int8 matmul accumulating in int32 (quantized-model compute).
-    On TPU the MXU takes the int operands directly."""
+def _matmul_wide_core(a, b, a_zp=None, b_zp=None):
+    """Widened integer matmul: operands upcast to int32, zero points
+    subtracted BEFORE the contraction — the always-correct reference
+    formulation (and the fallback lane of the int8 router)."""
     a32 = jnp.asarray(a).astype(jnp.int32)
     b32 = jnp.asarray(b).astype(jnp.int32)
     if a_zp is not None:
@@ -267,6 +267,75 @@ def _matmul_integer(ctx, a, b, a_zp=None, b_zp=None):
         (((a32.ndim - 1,), (b32.ndim - 2,)), ((), ())),
         preferred_element_type=jnp.int32) if a32.ndim == 2 and b32.ndim == 2 \
         else jnp.matmul(a32, b32)
+
+
+def _to_int8(x):
+    """(int8 view, offset) with ``x == view + offset`` elementwise:
+    int8 passes through, uint8 rides an exact -128 shift so the MXU's
+    s8xs8 path consumes it natively."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.uint8:
+        return (x.astype(jnp.int16) - 128).astype(jnp.int8), 128
+    return x, 0
+
+
+def _matmul_int8_core(a, b, a_zp=None, b_zp=None):
+    """TRUE int8 matmul lane: the contraction consumes int8 operands
+    (``preferred_element_type=int32`` — the MXU's native s8xs8 path);
+    zero points become EXACT integer correction terms after the dot:
+
+        (a - za)·(b - zb) = a·b - za*colsum(b) - zb*rowsum(a) + K*za*zb
+
+    (with the uint8 -128 shift folded into za/zb). Bit-identical to
+    :func:`_matmul_wide_core` — the router's probe asserts exactly
+    that before this lane ever serves. 2-D x 2-D only (router-gated)."""
+    a8, a_off = _to_int8(a)
+    b8, b_off = _to_int8(b)
+    acc = jax.lax.dot_general(
+        a8, b8, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)                     # [N, M]
+    kdim = a8.shape[1]
+    za = (jnp.asarray(a_zp).astype(jnp.int32) - a_off
+          if a_zp is not None else jnp.int32(-a_off))
+    zb = (jnp.asarray(b_zp).astype(jnp.int32) - b_off
+          if b_zp is not None else jnp.int32(-b_off))
+    za_col = za[:, None] if za.ndim == 1 else za              # [N,1]|scalar
+    zb_row = zb[None, :] if zb.ndim == 1 else zb              # [1,M]|scalar
+    need_za = a_zp is not None or a_off
+    need_zb = b_zp is not None or b_off
+    if need_za:
+        cs = jnp.sum(b8.astype(jnp.int32), axis=0)[None, :]   # [1, M]
+        acc = acc - za_col * cs
+    if need_zb:
+        rs = jnp.sum(a8.astype(jnp.int32), axis=1)[:, None]   # [N, 1]
+        acc = acc - zb_row * rs
+    if need_za and need_zb:
+        acc = acc + kdim * za_col * zb_row
+    return acc
+
+
+@op("MatMulInteger")
+def _matmul_integer(ctx, a, b, a_zp=None, b_zp=None):
+    """int8 matmul accumulating in int32 (quantized-model compute).
+    Routed (onnx/quant_route.py): the true-int8 lane where the
+    measured prober verified it exact and faster, the widened int32
+    formulation everywhere else — a lane failure silently falls back."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    from synapseml_tpu.onnx import quant_route
+
+    if quant_route.route_matmul(a, b, a_zp, b_zp,
+                                do_count=False) == "int8":
+        try:
+            out = _matmul_int8_core(a, b, a_zp, b_zp)
+            quant_route.count("int8")
+            return out
+        except Exception:  # noqa: BLE001 - silent fallback is the contract
+            quant_route.poison_matmul(a, b, a_zp, b_zp)
+    # served-by honesty (catalog contract): the routed-away case AND a
+    # trace-time int8-leg failure both count the widened lane
+    quant_route.count("dequant")
+    return _matmul_wide_core(a, b, a_zp, b_zp)
 
 
 @op("DynamicQuantizeLinear")
@@ -284,10 +353,20 @@ def _dynamic_quantize_linear(ctx, x):
     return y, scale.astype(jnp.float32), zp.astype(jnp.uint8)
 
 
-def _int_conv_core(ctx, x, w, x_zp=None, w_zp=None):
-    """Zero-point-shifted integer conv accumulating in int32 — the shared
-    engine of ConvInteger and QLinearConv. On TPU the MXU consumes the
-    int operands directly (preferred_element_type=int32)."""
+def _conv_params(ctx, x_shape, w_shape):
+    rank = len(x_shape) - 2
+    strides = ctx.attr("strides", [1] * rank)
+    dilations = ctx.attr("dilations", [1] * rank)
+    group = ctx.attr("group", 1)
+    kernel = ctx.attr("kernel_shape", list(w_shape[2:]))
+    pads = _resolve_pads(ctx, x_shape[2:], kernel, strides, dilations)
+    return rank, strides, dilations, group, pads
+
+
+def _conv_wide_core(ctx, x, w, x_zp=None, w_zp=None):
+    """Widened integer conv: operands upcast to int32, zero points
+    subtracted BEFORE the conv — the reference formulation (and the
+    fallback lane of the int8 router)."""
     x32 = jnp.asarray(x).astype(jnp.int32)
     w32 = jnp.asarray(w).astype(jnp.int32)
     if x_zp is not None:
@@ -297,17 +376,89 @@ def _int_conv_core(ctx, x, w, x_zp=None, w_zp=None):
         if zp.ndim == 1:  # per-output-channel
             zp = zp.reshape((-1,) + (1,) * (w32.ndim - 1))
         w32 = w32 - zp
-    rank = x32.ndim - 2
-    strides = ctx.attr("strides", [1] * rank)
-    dilations = ctx.attr("dilations", [1] * rank)
-    group = ctx.attr("group", 1)
-    kernel = ctx.attr("kernel_shape", list(w32.shape[2:]))
-    pads = _resolve_pads(ctx, x32.shape[2:], kernel, strides, dilations)
+    rank, strides, dilations, group, pads = _conv_params(
+        ctx, x32.shape, w32.shape)
     return lax.conv_general_dilated(
         x32, w32, window_strides=strides, padding=pads,
         rhs_dilation=dilations, feature_group_count=group,
         dimension_numbers=_conv_dims(rank),
         preferred_element_type=jnp.int32)
+
+
+def _conv_int8_core(ctx, x, w, x_zp=None, w_zp=None):
+    """TRUE int8 conv lane: the conv consumes int8 operands
+    (``preferred_element_type=int32``); the activation zero point
+    becomes ONE exact integer correction conv after the big one:
+
+        conv(x - zx, w) = conv(x, w) - zx * conv(ones_like(x), w)
+
+    where both convs share the zero padding, so the correction's
+    ones-conv yields each output position's valid-window weight sum —
+    identical border behavior to shifting before padding (the widened
+    path pads the ALREADY-shifted activations with zero). The uint8
+    -128 shift folds into zx. Weights must be int8 with a zero (or
+    absent) zero point — the router gates on exactly that — so no
+    weight-side correction exists. Bit-identical to
+    :func:`_conv_wide_core`; the router's probe asserts it."""
+    x8, x_off = _to_int8(x)
+    w8 = jnp.asarray(w)  # int8 already (router-gated), w_zp == 0
+    rank, strides, dilations, group, pads = _conv_params(
+        ctx, x8.shape, w8.shape)
+
+    def int8_conv(lhs, rhs):
+        return lax.conv_general_dilated(
+            lhs, rhs, window_strides=strides, padding=pads,
+            rhs_dilation=dilations, feature_group_count=group,
+            dimension_numbers=_conv_dims(rank),
+            preferred_element_type=jnp.int32)
+
+    acc = int8_conv(x8, w8)
+    zx = (jnp.asarray(x_zp).astype(jnp.int32) - x_off
+          if x_zp is not None else jnp.int32(-x_off))
+    if x_zp is not None or x_off:
+        ones = jnp.ones((1,) + x8.shape[1:], jnp.int8)
+        acc = acc - zx * int8_conv(ones, w8)   # [1, Cout, *] broadcasts
+    return acc
+
+
+def _int_conv_core(ctx, x, w, x_zp=None, w_zp=None):
+    """Integer conv accumulating in int32 — the shared engine of
+    ConvInteger and QLinearConv, routed (onnx/quant_route.py): the
+    true-int8 lane where the measured prober verified it exact and
+    faster, the widened int32 formulation everywhere else."""
+    x = jnp.asarray(x)
+    w = jnp.asarray(w)
+    from synapseml_tpu.onnx import quant_route
+
+    attrs = _conv_attr_key(ctx, x, w)
+    if quant_route.route_conv(x, w, x_zp, w_zp, attrs,
+                              do_count=False) == "int8":
+        try:
+            out = _conv_int8_core(ctx, x, w, x_zp, None)
+            quant_route.count("int8")
+            return out
+        except Exception:  # noqa: BLE001 - silent fallback is the contract
+            quant_route.poison_conv(x, w, x_zp, attrs)
+    quant_route.count("dequant")
+    return _conv_wide_core(ctx, x, w, x_zp, w_zp)
+
+
+def _conv_attr_key(ctx, x, w) -> str:
+    """The conv attributes that change the compiled program, as a
+    stable JSON key fragment for the router (also how the probe
+    reconstructs an equivalent ctx outside a real graph)."""
+    import json
+
+    rank = x.ndim - 2
+    return json.dumps({
+        "strides": list(ctx.attr("strides", [1] * rank)),
+        "dilations": list(ctx.attr("dilations", [1] * rank)),
+        "group": ctx.attr("group", 1),
+        "kernel_shape": list(ctx.attr("kernel_shape",
+                                      list(w.shape[2:]))),
+        "pads": ctx.attr("pads"),
+        "auto_pad": ctx.attr("auto_pad", "NOTSET"),
+    }, sort_keys=True)
 
 
 @op("ConvInteger")

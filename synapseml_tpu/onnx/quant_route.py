@@ -1,0 +1,302 @@
+"""Measured routing for the true-int8 QOperator execution lane.
+
+The QOperator family (``QLinearConv`` / ``QLinearMatMul`` /
+``ConvInteger`` / ``MatMulInteger``) historically widened its operands
+to int32 before the dot/conv — correct, but the MXU never saw the
+integers natively. Round 15 adds a TRUE int8 lane
+(importer._matmul_int8_core / _conv_int8_core): operands stay int8
+into ``dot_general`` / ``conv_general_dilated`` with
+``preferred_element_type=int32``, zero points handled as exact integer
+correction terms AFTER the contraction (row/column sums for matmul, a
+ones-conv term for conv), so the accumulator is bit-identical to the
+widened path and the existing integer requantization applies
+unchanged.
+
+This module is the ``cached_hist_route``-style prober in front of it:
+on first sight of an (op kind, dtypes, zero-point structure, bucketed
+shape) class on a TPU backend, compile BOTH lanes, verify the int8
+accumulator matches the widened reference EXACTLY, time both, persist
+the winner. Any mismatch, failure, or timing regression silently lands
+the "dequant" verdict (the widened fallback path — which itself
+degrades to dequantize-to-f32 semantics for the non-contraction
+QLinear ops). ``SYNAPSEML_ONNX_INT8=0`` kills the lane. Decisions are
+counted in ``onnx_int8_route_total{backend=}``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from synapseml_tpu.runtime.proberoute import RouteTable
+from synapseml_tpu.runtime.proberoute import best_of as _best_of
+
+_TABLE = RouteTable("onnx_int8_routing.json")
+
+# probe shape clamps: verify+time at a bounded stand-in for the real
+# shape class (same dtypes/zp structure) so a first sight of a huge
+# conv does not pay a huge probe
+_PROBE_ROWS_CAP = 256
+_PROBE_SPATIAL_CAP = 32
+_PROBE_BATCH_CAP = 2
+
+
+def enabled() -> bool:
+    import os
+
+    return os.environ.get("SYNAPSEML_ONNX_INT8", "1") != "0"
+
+
+def _count(backend: str) -> None:
+    try:
+        from synapseml_tpu.runtime import telemetry
+
+        telemetry.counter("onnx_int8_route_total",
+                          backend=backend).inc()
+    except Exception:  # noqa: BLE001 - telemetry must never gate scoring
+        pass
+
+
+def concrete_zero(zp) -> bool:
+    """True when ``zp`` is absent or a CONCRETE all-zero array — the
+    eligibility test the conv lane's weight zero point needs (a traced
+    zp can't be inspected; route to the widened path)."""
+    if zp is None:
+        return True
+    try:
+        return not np.any(np.asarray(zp))
+    except Exception:  # noqa: BLE001 - tracer: value unknowable
+        return False
+
+
+def _zp_tag(zp) -> str:
+    if zp is None:
+        return "none"
+    nd = getattr(zp, "ndim", 0)
+    return f"v{nd}" if nd else "s"
+
+
+def _bucket(v: int, lo: int = 1, hi: int = 65536) -> int:
+    return 1 << (int(min(max(v, lo), hi)) - 1).bit_length()
+
+
+def _key(kind: str, parts) -> str:
+    knd = jax.devices()[0].device_kind
+    import synapseml_tpu as _pkg
+
+    pkg_v = getattr(_pkg, "__version__", "0")
+    return (f"q1|jax{jax.__version__}|pkg{pkg_v}|{knd}|{kind}|"
+            + "|".join(str(p) for p in parts))
+
+
+def count(backend: str) -> None:
+    """Count one served decision in onnx_int8_route_total — the op
+    dispatchers route with ``count=False`` and report the lane whose
+    ops actually landed in the traced program AFTER the int8 leg's
+    trace-time outcome is known (a leg that raises at trace time is
+    served by the widened path and must count dequant)."""
+    _count(backend)
+
+
+def _route(kind: str, parts, probe_fn, do_count: bool = True) -> str:
+    """Shared routing core: kill switch -> backend -> cached verdict ->
+    probe-and-persist. Returns "int8" or "dequant"; counts the
+    decision unless the caller defers to the observed outcome
+    (``do_count=False`` + :func:`count`)."""
+    backend = "dequant"
+    if enabled() and jax.default_backend() == "tpu":
+        try:
+            key = _key(kind, parts)
+            got = _TABLE.lookup(key)
+            if got is None:
+                persist = True
+                try:
+                    got = probe_fn()
+                except Exception:  # noqa: BLE001 - probe crash = widened
+                    # memoized in-process ONLY (never persisted): a
+                    # deterministic probe crash costs one probe per
+                    # process, not one double-compile per trace
+                    got, persist = "dequant", False
+                _TABLE.record(key, got, persist=persist)
+            if got == "int8":
+                backend = "int8"
+        except Exception:  # noqa: BLE001 - routing must never fail scoring
+            backend = "dequant"
+    if do_count:
+        _count(backend)
+    return backend
+
+
+def _matmul_parts(a, b, a_zp, b_zp):
+    n, k = a.shape
+    return (str(a.dtype), str(b.dtype), _zp_tag(a_zp), _zp_tag(b_zp),
+            f"n{_bucket(n)}", f"k{_bucket(k)}",
+            f"m{_bucket(b.shape[1])}")
+
+
+def _conv_parts(x, w, x_zp, attrs: str):
+    spatial = "x".join(str(_bucket(s, hi=4096)) for s in x.shape[2:])
+    return (str(x.dtype), _zp_tag(x_zp), f"b{_bucket(x.shape[0])}",
+            f"ci{x.shape[1]}", f"co{w.shape[0]}",
+            "k" + "x".join(str(s) for s in w.shape[2:]),
+            f"s{spatial}", attrs)
+
+
+def route_matmul(a, b, a_zp, b_zp, do_count: bool = True) -> str:
+    """Route one MatMulInteger/QLinearMatMul contraction. Eligibility:
+    2-D x 2-D, int8/uint8 operands (uint8 rides an exact -128 shift)."""
+    if not (a.ndim == 2 and b.ndim == 2
+            and a.dtype in (jnp.int8, jnp.uint8)
+            and b.dtype in (jnp.int8, jnp.uint8)):
+        if do_count:
+            _count("dequant")
+        return "dequant"
+    n, k = a.shape
+    return _route("matmul", _matmul_parts(a, b, a_zp, b_zp),
+                  lambda: _probe_matmul(a.dtype, b.dtype, a_zp, b_zp,
+                                        n, k, b.shape[1]),
+                  do_count=do_count)
+
+
+def route_conv(x, w, x_zp, w_zp, attrs: str,
+               do_count: bool = True) -> str:
+    """Route one ConvInteger/QLinearConv. Eligibility: int8/uint8
+    activations, int8 weights with a zero (or absent) weight zero
+    point — the ORT static-quantizer's symmetric-weight default; any
+    other layout takes the widened path."""
+    if not (x.dtype in (jnp.int8, jnp.uint8) and w.dtype == jnp.int8
+            and concrete_zero(w_zp)):
+        if do_count:
+            _count("dequant")
+        return "dequant"
+    return _route("conv", _conv_parts(x, w, x_zp, attrs),
+                  lambda: _probe_conv(x.dtype, x_zp, x.shape, w.shape,
+                                      attrs),
+                  do_count=do_count)
+
+
+def poison_matmul(a, b, a_zp, b_zp) -> None:
+    """Demote ONE matmul shape class to the widened path after a
+    runtime failure of its int8 leg — persisted, so a verdict the
+    clamped probe landed but the real shape cannot run is not
+    re-trusted on the next trace (or after restart)."""
+    try:
+        _TABLE.record(_key("matmul", _matmul_parts(a, b, a_zp, b_zp)),
+                      "dequant")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def poison_conv(x, w, x_zp, attrs: str) -> None:
+    """Conv twin of :func:`poison_matmul`."""
+    try:
+        _TABLE.record(_key("conv", _conv_parts(x, w, x_zp, attrs)),
+                      "dequant")
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class _Attrs:
+    """Minimal ctx stand-in for probing the conv cores outside a real
+    graph: attribute dict with the onnx defaulting convention."""
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+        self.opset = 21
+
+    def attr(self, name, default=None):
+        got = self._attrs.get(name)
+        return default if got is None else got
+
+
+def _aot(fn, *args):
+    """Concrete numpy in, compiled executable out — escapes any
+    ambient trace (the pallas_kernels.available pattern)."""
+    return jax.jit(fn).lower(*args).compile()
+
+
+def _verify_and_time(int8_fn, wide_fn, args) -> str:
+    c8 = _aot(int8_fn, *args)
+    cw = _aot(wide_fn, *args)
+    got = np.asarray(c8(*args))
+    want = np.asarray(cw(*args))
+    if got.dtype != want.dtype or not np.array_equal(got, want):
+        return "dequant"  # the int8 accumulator must be EXACT
+    return ("int8" if _best_of(c8, args) <= _best_of(cw, args)
+            else "dequant")
+
+
+def _rand_q(rng, shape, dtype):
+    dt = np.dtype(dtype)
+    info = np.iinfo(dt)
+    return rng.integers(info.min, info.max + 1, shape).astype(dt)
+
+
+def _probe_zp(rng, zp, dtype, length: int):
+    """Synthetic zero point with the SAME structure (none / scalar /
+    vector) and dtype as the real one."""
+    if zp is None:
+        return None
+    dt = np.dtype(getattr(zp, "dtype", dtype))
+    info = np.iinfo(dt)
+    if getattr(zp, "ndim", 0):
+        return rng.integers(info.min, info.max + 1, length).astype(dt)
+    return dt.type(rng.integers(info.min, info.max + 1))
+
+
+def _probe_matmul(a_dt, b_dt, a_zp, b_zp, n: int, k: int,
+                  m: int) -> str:
+    from synapseml_tpu.onnx import importer
+
+    rng = np.random.default_rng(0)
+    n_p = min(n, _PROBE_ROWS_CAP)
+    a = _rand_q(rng, (n_p, k), a_dt)
+    b = _rand_q(rng, (k, m), b_dt)
+    za = _probe_zp(rng, a_zp, a_dt, n_p)
+    zb = _probe_zp(rng, b_zp, b_dt, m)
+    args = tuple(v for v in (a, b, za, zb) if v is not None)
+    has_za, has_zb = za is not None, zb is not None
+
+    def unpack(vals):
+        it = iter(vals)
+        aa, bb = next(it), next(it)
+        return (aa, bb, next(it) if has_za else None,
+                next(it) if has_zb else None)
+
+    return _verify_and_time(
+        lambda *v: importer._matmul_int8_core(*unpack(v)),
+        lambda *v: importer._matmul_wide_core(*unpack(v)), args)
+
+
+def _probe_conv(x_dt, x_zp, x_shape, w_shape, attrs: str) -> str:
+    import json
+
+    from synapseml_tpu.onnx import importer
+
+    rng = np.random.default_rng(0)
+    parsed = json.loads(attrs)
+    ctx = _Attrs(**parsed)
+    xs = (min(x_shape[0], _PROBE_BATCH_CAP), x_shape[1]) + tuple(
+        min(s, _PROBE_SPATIAL_CAP) for s in x_shape[2:])
+    # spatial extent must still cover the EFFECTIVE kernel under the
+    # probe clamp — (k-1)*dilation+1, not the raw tap count
+    dil = parsed.get("dilations") or [1] * len(w_shape[2:])
+    xs = xs[:2] + tuple(max(s, (kk - 1) * dd + 1) for s, kk, dd
+                        in zip(xs[2:], w_shape[2:], dil))
+    x = _rand_q(rng, xs, x_dt)
+    w = _rand_q(rng, w_shape, np.int8)
+    zx = _probe_zp(rng, x_zp, x_dt, 1)
+    args = (x, w) if zx is None else (x, w, zx)
+
+    def unpack(vals):
+        return (vals[0], vals[1],
+                vals[2] if len(vals) > 2 else None, None)
+
+    return _verify_and_time(
+        lambda *v: importer._conv_int8_core(ctx, *unpack(v)),
+        lambda *v: importer._conv_wide_core(ctx, *unpack(v)), args)
+
+
+def clear_cache() -> None:
+    """Test hook: drop the in-process memo + negative memo."""
+    _TABLE.clear()

@@ -1,0 +1,139 @@
+"""Persisted measured-routing verdicts shared by the kernel probers.
+
+The GBDT histogram router (grower.cached_hist_route) established the
+pattern: a backend choice is a MEASURED verdict keyed by shape class,
+memoized in-process and persisted under ``SYNAPSEML_TPU_CACHE_DIR`` so
+one probe cost covers all later runs. This module is that pattern as a
+reusable table for the round-15 lanes (the fused predict traversal
+kernel and the ONNX int8 lane), with the staleness fix built in from
+the start: the negative memo ("no verdict on disk for this key") holds
+a TTL, so a verdict landed by ANOTHER worker on a shared cache volume
+becomes visible within ``neg_ttl_s`` instead of only after a restart.
+
+Lookups are trace-safe (pure host-side dict/file reads — shapes are
+static at trace time); probing and persistence are the caller's job.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+# default negative-memo TTL: long enough that a shape with no verdict
+# does not re-open the cache file on every trace, short enough that a
+# sibling worker's probe verdict lands without a process restart
+_DEFAULT_NEG_TTL_S = 60.0
+
+
+def cache_dir() -> str:
+    return os.environ.get("SYNAPSEML_TPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "synapseml_tpu")
+
+
+def neg_ttl_s() -> float:
+    try:
+        return float(os.environ.get("SYNAPSEML_ROUTE_NEG_TTL_S",
+                                    _DEFAULT_NEG_TTL_S))
+    except ValueError:
+        return _DEFAULT_NEG_TTL_S
+
+
+def best_of(fn, args, reps: int = 2) -> float:
+    """min-of-N wall time of one compiled probe leg, value-fetch
+    forced — the shared timing half of every measured prober (routers
+    alias it as a module-level ``_best_of`` so tests can stub the
+    clock out of a verify-only probe)."""
+    import time
+
+    import numpy as np
+
+    np.asarray(fn(*args))  # warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+class RouteTable:
+    """One lane's verdict table: {key: verdict-string} with an
+    in-process memo, best-effort JSON persistence, and a TTL'd
+    negative memo. Thread-safe; file I/O happens OUTSIDE the lock
+    (a slow shared volume must not park other lookups)."""
+
+    def __init__(self, filename: str):
+        self.filename = filename
+        self._memo: Dict[str, str] = {}
+        self._neg: Dict[str, float] = {}  # key -> monotonic expiry
+        self._lock = threading.Lock()
+
+    def path(self) -> str:
+        return os.path.join(cache_dir(), self.filename)
+
+    def _load_disk(self) -> Dict[str, str]:
+        try:
+            with open(self.path()) as fh:
+                got = json.load(fh)
+            return got if isinstance(got, dict) else {}
+        except Exception:  # noqa: BLE001 - cache is best-effort
+            return {}
+
+    def lookup(self, key: str) -> Optional[str]:
+        """Memoized verdict for ``key``; None = nothing measured yet.
+        A disk re-read happens on first sight and again whenever the
+        negative memo's TTL expires — the shared-volume visibility
+        window."""
+        now = time.monotonic()
+        with self._lock:
+            got = self._memo.get(key)
+            if got is not None:
+                return got
+            exp = self._neg.get(key)
+            if exp is not None and now < exp:
+                return None
+        disk = self._load_disk()
+        with self._lock:
+            for k, v in disk.items():
+                self._memo.setdefault(k, str(v))
+            got = self._memo.get(key)
+            if got is None:
+                self._neg[key] = now + neg_ttl_s()
+            else:
+                self._neg.pop(key, None)
+            return got
+
+    def record(self, key: str, verdict: str,
+               persist: bool = True) -> None:
+        """Land a verdict: memo immediately (and retire every negative
+        lookup — a new verdict may satisfy them), merge-write the disk
+        file when ``persist``."""
+        with self._lock:
+            self._memo[key] = verdict
+            self._neg.clear()
+        if not persist:
+            return
+        path = self.path()
+        try:
+            # merge-then-atomic-replace: re-read immediately before the
+            # write (narrowing the lost-update window against sibling
+            # workers on a shared volume) and land via tmp-then-rename
+            # so a crashed writer can never leave a torn file for
+            # _load_disk to choke on. Best-effort by design — a lost
+            # race costs one re-probe, not correctness.
+            disk = self._load_disk()
+            disk[key] = verdict
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(disk, fh, indent=0)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 - persistence is best-effort
+            pass
+
+    def clear(self) -> None:
+        with self._lock:
+            self._memo.clear()
+            self._neg.clear()

@@ -1,0 +1,667 @@
+"""Round-15 scoring kernels: the fused Pallas forest-traversal kernel,
+its measured predict router, and the true-int8 QOperator lane.
+
+Everything here runs on the CPU tier-1 box: the traversal kernel runs
+under the Pallas interpreter (``interpret=True`` — numerics coverage
+with no TPU attached, the histogram kernel's CI pattern) against the
+XLA scan reference, and the int8 lane's integer-correction algebra is
+EXACT, so parity asserts bit-equality against the widened path. The
+routers are exercised by faking a TPU backend the way the histogram
+router's tests do (docs/perf.md "Round 15 — scoring kernels").
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from synapseml_tpu.gbdt import pallas_kernels, predict_route
+from synapseml_tpu.gbdt.boosting import (
+    BoostParams, _predict_stack, _predict_stack_routed, train)
+from synapseml_tpu.runtime import telemetry
+from synapseml_tpu.runtime.proberoute import RouteTable
+
+
+def _route_count(name: str, backend: str) -> float:
+    return telemetry.snapshot().get("counters", {}).get(
+        f'synapseml_{name}{{backend="{backend}"}}', 0.0)
+
+
+def _random_forest(rng, t, m, f):
+    """Valid ensemble in complete-binary layout (the probe's shape)."""
+    idx = np.arange(m)
+    internal = 2 * idx + 2 < m
+    feat = np.where(internal[None, :],
+                    rng.integers(0, f, (t, m)), -1).astype(np.int32)
+    thr = np.where(internal[None, :],
+                   rng.normal(size=(t, m)), 0.0).astype(np.float32)
+    left = np.broadcast_to(
+        np.where(internal, 2 * idx + 1, 0), (t, m)).astype(np.int32)
+    right = np.broadcast_to(
+        np.where(internal, 2 * idx + 2, 0), (t, m)).astype(np.int32)
+    value = np.where(internal[None, :], 0.0,
+                     rng.normal(size=(t, m))).astype(np.float32)
+    return feat, thr, left, right, value
+
+
+def _kernel(x, stack, value_scaled, k=1, **kw):
+    return np.asarray(pallas_kernels.predict_forest_tpu(
+        jnp.asarray(x), *(jnp.asarray(a) for a in stack[:4]),
+        jnp.asarray(value_scaled), k=k, interpret=True, **kw))
+
+
+# -- traversal kernel parity (interpret mode) ------------------------
+
+@pytest.mark.parametrize("t,m,f,k,n", [
+    (5, 15, 4, 1, 37),        # small, ragged row tile
+    (6, 31, 7, 3, 64),        # multiclass: leaf sums land in t%k columns
+    (4, 127, 5, 1, 300),      # deep trees (complete depth 64)
+    (1, 7, 2, 1, 1),          # single tree, single row
+])
+def test_traversal_matches_xla_stack(rng, t, m, f, k, n):
+    stack = _random_forest(rng, t, m, f)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    x[rng.random(x.shape) < 0.1] = np.nan  # missing goes right, both legs
+    w = rng.random(t).astype(np.float32)
+    ref = np.asarray(_predict_stack(
+        tuple(jnp.asarray(a) for a in stack), jnp.asarray(w),
+        jnp.asarray(x), k, t))
+    got = _kernel(x, stack, stack[4] * w[:, None], k=k)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_traversal_trained_booster_parity(rng):
+    """Real trained ensembles (leaf-wise growth: NOT complete-binary
+    layout) — binary and multiclass — through the kernel vs the
+    production scan."""
+    x = rng.normal(size=(400, 6))
+    for p, y in [
+        (BoostParams(objective="binary", num_iterations=8, num_leaves=15),
+         (x[:, 0] + x[:, 1] > 0).astype(np.float64)),
+        (BoostParams(objective="multiclass", num_class=3,
+                     num_iterations=4, num_leaves=7),
+         rng.integers(0, 3, 400).astype(np.float64)),
+    ]:
+        b = train(p, x, y)
+        k = b.num_class
+        stack = (b.trees_feature, b.trees_threshold, b.trees_left,
+                 b.trees_right, b.trees_value)
+        xv = rng.normal(size=(123, 6)).astype(np.float32)
+        xv[rng.random(xv.shape) < 0.05] = np.nan
+        ref = np.asarray(_predict_stack(
+            tuple(jnp.asarray(a) for a in stack),
+            jnp.asarray(b.tree_weights), jnp.asarray(xv), k, b.num_trees))
+        got = _kernel(xv, stack,
+                      b.trees_value * b.tree_weights[:, None], k=k)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_traversal_all_nan_rows_go_right(rng):
+    """A row of all-NaN features takes the right child at every split
+    in both formulations (training's missing-bin placement)."""
+    stack = _random_forest(rng, 3, 15, 4)
+    x = np.full((5, 4), np.nan, np.float32)
+    ref = np.asarray(_predict_stack(
+        tuple(jnp.asarray(a) for a in stack),
+        jnp.ones(3, np.float32), jnp.asarray(x), 1, 3))
+    got = _kernel(x, stack, stack[4])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    assert np.isfinite(got).all()
+
+
+def test_traversal_edge_shapes(rng):
+    """N=0 and T=0 answer empty/zero without launching a kernel."""
+    stack = _random_forest(rng, 2, 7, 3)
+    out = pallas_kernels.predict_forest_tpu(
+        jnp.zeros((0, 3)), *(jnp.asarray(a) for a in stack),
+        k=2, interpret=True)
+    assert out.shape == (0, 2)
+    empty = tuple(a[:0] for a in stack)
+    out = pallas_kernels.predict_forest_tpu(
+        jnp.zeros((4, 3)), *(jnp.asarray(a) for a in empty),
+        k=1, interpret=True)
+    assert out.shape == (4, 1) and not np.asarray(out).any()
+
+
+def test_traversal_binned_variant(rng):
+    """Pre-binned integer rows ride the same kernel via exact float32
+    casts (bins < 2^24): parity with predict_tree_binned's gather
+    loop."""
+    from synapseml_tpu.gbdt.grower import predict_tree_binned
+
+    t, m, f, n_bins = 1, 31, 5, 200
+    feat, _, left, right, value = _random_forest(rng, t, m, f)
+    thr_bin = np.where(feat >= 0,
+                       rng.integers(0, n_bins, (t, m)), 0).astype(np.int32)
+    binned = rng.integers(0, n_bins, (64, f)).astype(np.uint8)
+    ref = np.asarray(predict_tree_binned(
+        tuple(jnp.asarray(a[0]) for a in
+              (feat, thr_bin, left, right, value)),
+        jnp.asarray(binned), route=False))
+    got = _kernel(binned.astype(np.float32),
+                  (feat, thr_bin.astype(np.float32), left, right),
+                  value)[:, 0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_traversal_iforest_depth_variant(rng):
+    """The depth-accumulating isolation-forest use: strict ``<``
+    comparison, value=depth_adj — parity with _path_lengths on a REAL
+    fitted forest, and score parity through the model."""
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.isolationforest.iforest import (
+        IsolationForest, _path_lengths, _path_lengths_pallas)
+
+    x = rng.normal(size=(150, 5)).astype(np.float32)
+    est = IsolationForest(num_estimators=12, max_samples=64)
+    est.set(features_col="features")
+    model = est._fit(Table({"features": x}))
+    feat, thr, lft, rgt, dadj = model.trees
+    stack = tuple(jnp.asarray(a) for a in (feat, thr, lft, rgt, dadj))
+    di = int(model.max_depth) + 1
+    ref = np.asarray(_path_lengths(stack, jnp.asarray(x), di))
+    got = _kernel(x, (feat, thr, lft, rgt), dadj, k=1, depth=di,
+                  strict=True)[:, 0] / feat.shape[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # model scores on CPU route to the XLA path and stay correct
+    scores = model._scores(x)
+    assert scores.shape == (150,) and np.isfinite(scores).all()
+
+
+# -- predict router ---------------------------------------------------
+
+@pytest.fixture
+def route_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    predict_route.clear_cache()
+    yield tmp_path
+    predict_route.clear_cache()
+
+
+def test_route_kill_switch(route_env, monkeypatch):
+    monkeypatch.setattr(predict_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setenv("SYNAPSEML_GBDT_PALLAS", "0")
+    assert predict_route.route_predict(1024, 10, 31, 8, 1) == "xla"
+    assert predict_route.cached_route(1024, 10, 31, 8, 1) == "xla"
+
+
+def test_route_non_tpu_falls_back_and_counts(route_env):
+    """On the CPU tier-1 box the router PROVABLY falls back: verdict is
+    xla and the route counter moves with backend="xla"."""
+    before = _route_count("gbdt_predict_route_total", "xla")
+    assert predict_route.route_predict(2048, 20, 63, 10, 1) == "xla"
+    assert _route_count("gbdt_predict_route_total", "xla") == before + 1
+
+
+def test_route_probe_verifies_and_persists(route_env, monkeypatch):
+    """Faked-TPU probe: the kernel leg runs under the interpreter, the
+    clock is stubbed so verification alone decides — a correct kernel
+    lands a persisted "pallas" verdict, read back without re-probing."""
+    import functools
+
+    monkeypatch.setattr(predict_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setattr(
+        pallas_kernels, "predict_forest_tpu",
+        functools.partial(pallas_kernels.predict_forest_tpu,
+                          interpret=True))
+    monkeypatch.setattr(predict_route, "_best_of", lambda *a, **k: 1.0)
+    got = predict_route.route_predict(300, 3, 15, 4, 1)
+    assert got == "pallas"
+    disk = json.loads(
+        (route_env / "predict_routing.json").read_text())
+    assert list(disk.values()) == ["pallas"]
+
+    # fresh "process": disk answers, no probe runs
+    predict_route.clear_cache()
+
+    def forbid(*a, **k):
+        raise AssertionError("verdict on disk — probe must not re-run")
+
+    monkeypatch.setattr(predict_route, "_probe", forbid)
+    assert predict_route.route_predict(300, 3, 15, 4, 1) == "pallas"
+    # and the cache-only lookup (the in-trace path) sees it too
+    assert predict_route.cached_route(300, 3, 15, 4, 1) == "pallas"
+
+
+def test_route_probe_mismatch_lands_xla(route_env, monkeypatch):
+    """A kernel that returns WRONG numbers is demoted to xla by the
+    verify half of the probe — persisted, so the mismatch is never
+    re-trusted."""
+    monkeypatch.setattr(predict_route.jax, "default_backend",
+                        lambda: "tpu")
+    real = pallas_kernels.predict_forest_tpu
+
+    def wrong(x, *stack, **kw):
+        kw["interpret"] = True
+        return real(x, *stack, **kw) + 1.0
+
+    monkeypatch.setattr(pallas_kernels, "predict_forest_tpu", wrong)
+    monkeypatch.setattr(predict_route, "_best_of", lambda *a, **k: 1.0)
+    assert predict_route.route_predict(300, 3, 15, 4, 1) == "xla"
+    disk = json.loads(
+        (route_env / "predict_routing.json").read_text())
+    assert list(disk.values()) == ["xla"]
+
+
+def test_route_probe_failure_not_persisted(route_env, monkeypatch):
+    """A probe that RAISES (transient compile failure) answers xla and
+    persists nothing — the next process re-measures — but IS memoized
+    in-process: a deterministic crash costs one probe per process, not
+    one double-compile per predict call."""
+    monkeypatch.setattr(predict_route.jax, "default_backend",
+                        lambda: "tpu")
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("mosaic lowering failed")
+
+    monkeypatch.setattr(pallas_kernels, "predict_forest_tpu", boom)
+    assert predict_route.route_predict(300, 3, 15, 4, 1) == "xla"
+    assert not (route_env / "predict_routing.json").exists()
+    assert predict_route.route_predict(300, 3, 15, 4, 1) == "xla"
+    assert len(calls) == 1  # memoized: no re-probe this process
+
+
+def test_routed_stack_runtime_failure_poisons(route_env, monkeypatch,
+                                              rng):
+    """A kernel leg that fails AT DISPATCH (after a pallas verdict)
+    silently falls back to the XLA scan — correct output — and demotes
+    the shape class on disk."""
+    import synapseml_tpu.gbdt.boosting as boosting
+
+    monkeypatch.setattr(predict_route.jax, "default_backend",
+                        lambda: "tpu")
+    stack_np = _random_forest(rng, 3, 15, 4)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    w = np.ones(3, np.float32)
+    key = predict_route._key(300, 3, 15, 4, 1, False)
+    predict_route._TABLE.record(key, "pallas")
+
+    def boom(*a, **k):
+        raise RuntimeError("kernel died at dispatch")
+
+    monkeypatch.setattr(boosting, "_predict_stack_pallas", boom)
+    stack = tuple(jnp.asarray(a) for a in stack_np)
+    got = np.asarray(_predict_stack_routed(
+        stack, jnp.asarray(w), jnp.asarray(x), 1, 3))
+    ref = np.asarray(_predict_stack(stack, jnp.asarray(w),
+                                    jnp.asarray(x), 1, 3))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    disk = json.loads(
+        (route_env / "predict_routing.json").read_text())
+    assert disk[key] == "xla"
+
+
+def test_predict_tree_cached_route_uses_kernel(route_env, monkeypatch,
+                                               rng):
+    """predict_tree consults the CACHED verdict (no probe — it traces
+    inside the boosting scan) and takes the kernel when it says
+    pallas."""
+    import functools
+
+    from synapseml_tpu.gbdt.grower import predict_tree
+
+    monkeypatch.setattr(predict_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setattr(
+        pallas_kernels, "predict_forest_tpu",
+        functools.partial(pallas_kernels.predict_forest_tpu,
+                          interpret=True))
+    feat, thr, left, right, value = _random_forest(rng, 1, 15, 4)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    tree = tuple(jnp.asarray(a[0]) for a in
+                 (feat, thr, left, right, value))
+    ref = np.asarray(predict_tree(tree, jnp.asarray(x), route=False))
+    # no verdict yet: cached route must NOT probe, must answer xla
+    monkeypatch.setattr(predict_route, "_probe",
+                        lambda *a, **k: pytest.fail("cached_route probed"))
+    got = np.asarray(predict_tree(tree, jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # land a pallas verdict: the kernel leg now serves, identically
+    predict_route._TABLE.record(
+        predict_route._key(64, 1, 15, 4, 1, False), "pallas")
+    got = np.asarray(predict_tree(tree, jnp.asarray(x)))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+# -- negative-memo TTL (the shared-cache-volume staleness fix) --------
+
+def test_route_table_negative_memo_ttl(tmp_path, monkeypatch):
+    """A verdict landed on disk by ANOTHER process becomes visible
+    after the negative memo's TTL — without a restart."""
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    table = RouteTable("ttl_probe.json")
+    assert table.lookup("k1") is None          # negative memoized
+    (tmp_path / "ttl_probe.json").write_text(json.dumps({"k1": "pallas"}))
+    assert table.lookup("k1") is None          # memo still holding
+    import synapseml_tpu.runtime.proberoute as pr
+
+    real = pr.time.monotonic
+    monkeypatch.setattr(pr.time, "monotonic",
+                        lambda: real() + pr.neg_ttl_s() + 1)
+    assert table.lookup("k1") == "pallas"      # expired -> disk re-read
+
+
+def test_route_table_ttl_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("SYNAPSEML_ROUTE_NEG_TTL_S", "0")
+    table = RouteTable("ttl0.json")
+    assert table.lookup("k") is None
+    (tmp_path / "ttl0.json").write_text(json.dumps({"k": "int8"}))
+    assert table.lookup("k") == "int8"         # TTL 0: every miss re-reads
+
+
+def test_hist_route_neg_memo_expires(tmp_path, monkeypatch):
+    """The histogram router's negative memo (the round-15 staleness
+    fix): a sibling worker's verdict surfaces after TTL expiry instead
+    of only after restart."""
+    from synapseml_tpu.gbdt import grower
+
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    grower._HIST_ROUTE_CACHE.clear()
+    grower._ROUTE_NEG.clear()
+    assert grower.cached_hist_route(4096, 6, 64) is None
+    key = grower._route_key_base(4096, 6, 64)
+    assert key in grower._ROUTE_NEG            # negative memoized
+    (tmp_path / "hist_routing.json").write_text(
+        json.dumps({key: "pallas"}))
+    assert grower.cached_hist_route(4096, 6, 64) is None  # TTL holds
+    grower._ROUTE_NEG[key] = 0.0               # force expiry
+    assert grower.cached_hist_route(4096, 6, 64) == "pallas"
+    assert key not in grower._ROUTE_NEG
+    grower._HIST_ROUTE_CACHE.clear()
+    grower._ROUTE_NEG.clear()
+
+
+# -- zero-row predict regression --------------------------------------
+
+def test_zero_row_predict_returns_empty(rng):
+    """Booster.predict/predict_raw on x.shape[0]==0: empty arrays of
+    the right rank, no traced traversal (regression: used to compile a
+    degenerate scan per model)."""
+    x = rng.normal(size=(300, 5))
+    y = (x[:, 0] > 0).astype(np.float64)
+    b = train(BoostParams(objective="binary", num_iterations=3,
+                          num_leaves=7), x, y)
+    assert b.predict(x[:0]).shape == (0,)
+    assert b.predict_raw(np.zeros((0, 5))).shape == (0,)
+    y3 = rng.integers(0, 3, 300).astype(np.float64)
+    b3 = train(BoostParams(objective="multiclass", num_class=3,
+                           num_iterations=2, num_leaves=7), x, y3)
+    assert b3.predict(x[:0]).shape == (0, 3)
+    assert b3.predict_raw(x[:0]).shape == (0, 3)
+    # the width check still guards empty inputs
+    with pytest.raises(ValueError, match="feature width"):
+        b.predict(np.zeros((0, 9)))
+
+
+def test_zero_row_iforest_scores_empty(rng):
+    """IsolationForestModel._scores on zero rows answers the empty
+    shape without compiling a degenerate traversal (the Booster fix's
+    mirror)."""
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.isolationforest.iforest import IsolationForest
+
+    x = rng.normal(size=(100, 4)).astype(np.float32)
+    est = IsolationForest(num_estimators=5, max_samples=32)
+    est.set(features_col="features")
+    model = est._fit(Table({"features": x}))
+    got = model._scores(x[:0])
+    assert got.shape == (0,)
+
+
+# -- true int8 QOperator lane -----------------------------------------
+
+class _Ctx:
+    def __init__(self, **a):
+        self._a = a
+        self.opset = 21
+
+    def attr(self, n, d=None):
+        got = self._a.get(n)
+        return d if got is None else got
+
+
+@pytest.mark.parametrize("adt,bdt", [
+    (np.uint8, np.int8), (np.int8, np.int8),
+    (np.uint8, np.uint8), (np.int8, np.uint8)])
+@pytest.mark.parametrize("za_kind,zb_kind", [
+    (None, None), ("s", "s"), ("v", "v"), ("s", None), (None, "v")])
+def test_int8_matmul_bit_exact(rng, adt, bdt, za_kind, zb_kind):
+    """The int8 lane's zero-point-correction algebra is EXACT: the
+    int32 accumulator equals the widened path bit for bit across
+    dtype/zero-point structures (incl. the uint8 -128 shift)."""
+    from synapseml_tpu.onnx import importer as imp
+
+    a = rng.integers(np.iinfo(adt).min, np.iinfo(adt).max + 1,
+                     (17, 23)).astype(adt)
+    b = rng.integers(np.iinfo(bdt).min, np.iinfo(bdt).max + 1,
+                     (23, 9)).astype(bdt)
+
+    def mk(kind, dt, length):
+        if kind is None:
+            return None
+        if kind == "s":
+            return dt(rng.integers(0, 100))
+        return rng.integers(0, 100, length).astype(dt)
+
+    za, zb = mk(za_kind, adt, 17), mk(zb_kind, bdt, 9)
+    want = np.asarray(imp._matmul_wide_core(a, b, za, zb))
+    got = np.asarray(imp._matmul_int8_core(a, b, za, zb))
+    assert got.dtype == want.dtype == np.int32
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("case", [
+    dict(x=(2, 3, 9, 9), w=(4, 3, 3, 3), attrs=dict(pads=[1, 1, 1, 1])),
+    dict(x=(1, 4, 8, 8), w=(8, 2, 3, 3),
+         attrs=dict(group=2, strides=[2, 2])),
+    dict(x=(2, 3, 11, 11), w=(5, 3, 3, 3),
+         attrs=dict(auto_pad="SAME_UPPER", strides=[2, 2])),
+    dict(x=(1, 2, 10), w=(3, 2, 4), attrs=dict(pads=[2, 1],
+                                               dilations=[2])),
+])
+@pytest.mark.parametrize("xdt", [np.uint8, np.int8])
+def test_int8_conv_bit_exact(rng, case, xdt):
+    """Conv lane: ONE ones-conv correction reproduces the widened
+    path's border behavior exactly — padding taps contribute zero in
+    both formulations — across pads/strides/dilations/groups and 1-D
+    convs."""
+    from synapseml_tpu.onnx import importer as imp
+
+    ctx = _Ctx(**case["attrs"])
+    x = rng.integers(np.iinfo(xdt).min, np.iinfo(xdt).max + 1,
+                     case["x"]).astype(xdt)
+    w = rng.integers(-128, 128, case["w"]).astype(np.int8)
+    for zx in (None, xdt(rng.integers(0, 100))):
+        want = np.asarray(imp._conv_wide_core(ctx, x, w, zx, None))
+        got = np.asarray(imp._conv_int8_core(ctx, x, w, zx, None))
+        assert got.dtype == want.dtype == np.int32
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.fixture
+def int8_route_env(tmp_path, monkeypatch):
+    from synapseml_tpu.onnx import quant_route
+
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    quant_route.clear_cache()
+    yield tmp_path
+    quant_route.clear_cache()
+
+
+def test_int8_route_cpu_falls_back_and_counts(int8_route_env, rng):
+    """On the CPU tier-1 box the int8 router PROVABLY falls back: the
+    op runs the widened path and the route counter moves with
+    backend="dequant" — no behavior change."""
+    from synapseml_tpu.onnx import importer as imp
+
+    a = rng.integers(0, 255, (4, 8)).astype(np.uint8)
+    b = rng.integers(-128, 127, (8, 3)).astype(np.int8)
+    before = _route_count("onnx_int8_route_total", "dequant")
+    got = np.asarray(imp._matmul_integer(_Ctx(), a, b,
+                                         np.uint8(7), np.int8(2)))
+    want = (a.astype(np.int32) - 7) @ (b.astype(np.int32) - 2)
+    np.testing.assert_array_equal(got, want)
+    assert _route_count("onnx_int8_route_total", "dequant") == before + 1
+
+
+def test_int8_route_probe_and_persist(int8_route_env, monkeypatch, rng):
+    """Faked-TPU probe: verify decides (clock stubbed), verdict
+    persists to onnx_int8_routing.json, and the op then serves the
+    int8 lane bit-identically to the widened path."""
+    from synapseml_tpu.onnx import importer as imp
+    from synapseml_tpu.onnx import quant_route
+
+    monkeypatch.setattr(quant_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setattr(quant_route, "_best_of", lambda *a, **k: 1.0)
+    a = rng.integers(0, 255, (16, 32)).astype(np.uint8)
+    b = rng.integers(-128, 127, (32, 8)).astype(np.int8)
+    before = _route_count("onnx_int8_route_total", "int8")
+    got = np.asarray(imp._matmul_integer(_Ctx(), a, b, np.uint8(9), None))
+    want = (a.astype(np.int32) - 9) @ b.astype(np.int32)
+    np.testing.assert_array_equal(got, want)
+    assert _route_count("onnx_int8_route_total", "int8") == before + 1
+    disk = json.loads(
+        (int8_route_env / "onnx_int8_routing.json").read_text())
+    assert list(disk.values()) == ["int8"]
+
+    # conv probe too, through the real op path
+    x = rng.integers(0, 255, (1, 3, 8, 8)).astype(np.uint8)
+    w = rng.integers(-128, 127, (4, 3, 3, 3)).astype(np.int8)
+    ctx = _Ctx(pads=[1, 1, 1, 1])
+    got = np.asarray(imp._int_conv_core(ctx, x, w, np.uint8(5), None))
+    want = np.asarray(imp._conv_wide_core(ctx, x, w, np.uint8(5), None))
+    np.testing.assert_array_equal(got, want)
+    disk = json.loads(
+        (int8_route_env / "onnx_int8_routing.json").read_text())
+    assert sorted(set(disk.values())) == ["int8"] and len(disk) == 2
+
+
+def test_int8_kill_switch(int8_route_env, monkeypatch, rng):
+    from synapseml_tpu.onnx import quant_route
+
+    monkeypatch.setattr(quant_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setenv("SYNAPSEML_ONNX_INT8", "0")
+    a = jnp.asarray(rng.integers(0, 255, (4, 8)).astype(np.uint8))
+    b = jnp.asarray(rng.integers(-128, 127, (8, 3)).astype(np.int8))
+    assert quant_route.route_matmul(a, b, None, None) == "dequant"
+
+
+def test_int8_conv_nonzero_wzp_falls_back(int8_route_env, monkeypatch,
+                                          rng):
+    """A nonzero weight zero point is outside the int8 lane's algebra
+    (it would need a second correction family): the router refuses it
+    BEFORE any probe, and the widened path answers."""
+    from synapseml_tpu.onnx import importer as imp
+    from synapseml_tpu.onnx import quant_route
+
+    monkeypatch.setattr(quant_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setattr(
+        quant_route, "_probe_conv",
+        lambda *a, **k: pytest.fail("ineligible conv must not probe"))
+    x = rng.integers(0, 255, (1, 2, 6, 6)).astype(np.uint8)
+    w = rng.integers(-100, 100, (3, 2, 3, 3)).astype(np.int8)
+    wzp = np.int8(4)
+    got = np.asarray(imp._int_conv_core(_Ctx(), x, w, np.uint8(2), wzp))
+    want = np.asarray(imp._conv_wide_core(_Ctx(), x, w, np.uint8(2),
+                                          wzp))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int8_runtime_failure_poisons_per_key(int8_route_env,
+                                              monkeypatch, rng):
+    """An int8 leg that fails at trace time (after a probe verdict)
+    falls back to the widened path AND persists a 'dequant' demotion
+    for THAT shape class — other verdicts survive, and a restart
+    cannot re-trust the failing one."""
+    from synapseml_tpu.onnx import importer as imp
+    from synapseml_tpu.onnx import quant_route
+
+    monkeypatch.setattr(quant_route.jax, "default_backend",
+                        lambda: "tpu")
+    a = jnp.asarray(rng.integers(0, 255, (4, 8)).astype(np.uint8))
+    b = jnp.asarray(rng.integers(-128, 127, (8, 3)).astype(np.int8))
+    key = quant_route._key(
+        "matmul", quant_route._matmul_parts(a, b, None, None))
+    quant_route._TABLE.record(key, "int8")
+    quant_route._TABLE.record("other|key", "int8")
+
+    def boom(*a, **k):
+        raise RuntimeError("int8 leg died")
+
+    monkeypatch.setattr(imp, "_matmul_int8_core", boom)
+    got = np.asarray(imp._matmul_integer(_Ctx(), a, b))
+    np.testing.assert_array_equal(
+        got, np.asarray(a, np.int32) @ np.asarray(b, np.int32))
+    disk = json.loads(
+        (int8_route_env / "onnx_int8_routing.json").read_text())
+    assert disk[key] == "dequant"
+    assert quant_route._TABLE.lookup("other|key") == "int8"
+
+
+def test_int8_conv_probe_covers_dilated_kernel(int8_route_env,
+                                               monkeypatch):
+    """The probe's spatial clamp must cover the EFFECTIVE kernel
+    extent (k-1)*dilation+1, not the raw tap count — a dilated conv
+    whose extent exceeds the clamp used to crash the probe on every
+    trace (regression)."""
+    import json as _json
+
+    from synapseml_tpu.onnx import quant_route
+
+    monkeypatch.setattr(quant_route, "_best_of", lambda *a, **k: 1.0)
+    attrs = _json.dumps({"strides": [1, 1], "dilations": [8, 8],
+                         "group": 1, "kernel_shape": [5, 5],
+                         "pads": [0, 0, 0, 0], "auto_pad": "NOTSET"},
+                        sort_keys=True)
+    got = quant_route._probe_conv(np.dtype(np.uint8), np.uint8(3),
+                                  (1, 2, 224, 224), (3, 2, 5, 5), attrs)
+    assert got == "int8"  # exact + clock stubbed: verify decides
+
+
+def test_int8_qlinear_matmul_end_to_end(int8_route_env, monkeypatch,
+                                        rng):
+    """QLinearMatMul through a real imported graph with the int8 lane
+    forced on: requantized uint8 output is bit-identical to the spec
+    reference — the accumulator parity carries through requantization
+    untouched."""
+    from synapseml_tpu.onnx import quant_route
+    from synapseml_tpu.onnx.builder import GraphBuilder
+    from synapseml_tpu.onnx.model import import_model
+
+    monkeypatch.setattr(quant_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setattr(quant_route, "_best_of", lambda *a, **k: 1.0)
+    a = rng.integers(0, 255, (6, 12)).astype(np.uint8)
+    b = rng.integers(-127, 127, (12, 5)).astype(np.int8)
+    a_s, a_zp, b_s, b_zp, y_s, y_zp = 0.03, 120, 0.05, 3, 0.2, 64
+    g = GraphBuilder(opset=21)
+    an = g.add_input("a", np.uint8, [6, 12])
+    ins = [an, g.add_initializer("as_", np.float32(a_s)),
+           g.add_initializer("azp", np.uint8(a_zp)),
+           g.add_initializer("b", b),
+           g.add_initializer("bs", np.float32(b_s)),
+           g.add_initializer("bzp", np.int8(b_zp)),
+           g.add_initializer("ys", np.float32(y_s)),
+           g.add_initializer("yzp", np.uint8(y_zp))]
+    y = g.add_node("QLinearMatMul", ins)
+    g.add_output(y, np.uint8, [6, 5])
+    gi = import_model(g.to_bytes())
+    got = np.asarray(gi.apply(gi.params, a)[0])
+    acc = (a.astype(np.int64) - a_zp) @ (b.astype(np.int64) - b_zp)
+    want = np.clip(
+        np.rint(acc.astype(np.float32) * np.float32(a_s * b_s / y_s))
+        + y_zp, 0, 255).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+    disk = json.loads(
+        (int8_route_env / "onnx_int8_routing.json").read_text())
+    assert "int8" in disk.values()

@@ -62,6 +62,11 @@ TOLERANCES = {
     "serving_scored_roundtrip_p50_ms": 1.0,
     "serving_scored_concurrent_p50_ms": 1.0,
     "serving_cold_start_first_batch_ms": 1.5,
+    # round-15 routed scoring lanes (throughput: max-of-N, a 0.75 band
+    # trips below 1/4 of baseline — a step, not scheduler noise on a
+    # contended CPU runner)
+    "gbdt_predict_rows_per_sec_per_chip": 0.75,
+    "onnx_int8_rows_per_sec_per_chip": 0.75,
 }
 
 # units whose metrics are better when SMALLER (latency-domain); every

@@ -28,7 +28,8 @@ import os
 import sys
 
 PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
-            "fleet_", "process_", "trace_", "capture_")
+            "fleet_", "process_", "trace_", "capture_", "gbdt_",
+            "onnx_")
 REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
 
 HERE = os.path.dirname(os.path.abspath(__file__))

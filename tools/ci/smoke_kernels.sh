@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Round-15 scoring-kernel canary: the fused Pallas traversal kernel's
+# interpret-mode parity suite + int8-lane bit-exactness run under a hard
+# wall (tests/test_predict_kernels.py — deep trees, multiclass, NaN
+# rows, N=0/N=1 edges, binned + iforest variants, router semantics),
+# then the probe-fallback contract is exercised EXPLICITLY: with
+# SYNAPSEML_GBDT_PALLAS=0 (and on any non-TPU backend) a routed predict
+# must answer through the XLA path with the route counter proving it —
+# kill switch and fallback are load-bearing, not decorative.
+#
+# Usage: tools/ci/smoke_kernels.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+timeout -k 10 "${SMOKE_TIMEOUT:-420}" env JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_predict_kernels.py -q -p no:cacheprovider
+
+# kill-switch fallback proof: routed predict under SYNAPSEML_GBDT_PALLAS=0
+# answers via XLA (counter asserted), bit-identical to the default route
+timeout -k 10 120 env JAX_PLATFORMS=cpu SYNAPSEML_GBDT_PALLAS=0 \
+  SYNAPSEML_ONNX_INT8=0 python - <<'PY'
+import numpy as np
+from synapseml_tpu.gbdt.boosting import BoostParams, train
+from synapseml_tpu.runtime import telemetry
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(512, 6))
+y = (x[:, 0] > 0).astype(np.float64)
+b = train(BoostParams(objective="binary", num_iterations=4,
+                      num_leaves=7), x, y)
+p1 = b.predict(x[:100])
+counters = telemetry.snapshot()["counters"]
+xla = counters.get('synapseml_gbdt_predict_route_total{backend="xla"}', 0)
+pallas = counters.get(
+    'synapseml_gbdt_predict_route_total{backend="pallas"}', 0)
+assert xla >= 1 and pallas == 0, (xla, pallas)
+print(f"kill-switch fallback ok: xla={int(xla)} pallas={int(pallas)}")
+PY

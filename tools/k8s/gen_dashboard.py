@@ -36,7 +36,8 @@ OUT = os.path.join(HERE, "chart", "dashboards",
                    "serving-dashboard.json")
 
 PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
-            "fleet_", "process_", "trace_", "capture_")
+            "fleet_", "process_", "trace_", "capture_", "gbdt_",
+            "onnx_")
 _NAME = re.compile(r"([a-z][a-z0-9_]*)(\{([a-z_=,]*)\})?")
 
 
