@@ -4,7 +4,7 @@
 #'
 #' @param argmax_output_col column for argmax of first output
 #' @param compile_cache_dir persistent compile-cache directory (default: the SYNAPSEML_COMPILE_CACHE env var; unset = off) — wires JAX's persistent compilation cache and the serialized-executable store warmup() persists into, so a restarted process deserializes instead of recompiling (runtime/compile_cache.py)
-#' @param compute_dtype device compute dtype: float32|bfloat16|float16
+#' @param compute_dtype device compute dtype: float32|bfloat16|float16, or 'auto' for the autotuner's measured f32-vs-bf16 verdict (routed per model content + batch bucket, persisted fleet-wide — runtime/autotune.py lane 'onnx_compute_dtype')
 #' @param cut_layers trailing graph nodes dropped (headless featurization; persists across serde)
 #' @param devices data-parallel device spec: None (single default device), 'all', an int N (first N local devices), or a device sequence — each mini-batch bucket is dp-sharded across them by the executor (runtime/executor.py), bit-identical to single-device
 #' @param feed_dict graph input name -> input column

@@ -22,9 +22,169 @@ from synapseml_tpu.core.param import ComplexParam, Param
 from synapseml_tpu.core.pipeline import Transformer
 from synapseml_tpu.data.table import Table
 from synapseml_tpu.onnx.importer import ImportedGraph, import_model
+from synapseml_tpu.runtime import autotune
 from synapseml_tpu.runtime.executor import BatchedExecutor
 
 _DTYPES = {"float32": np.float32, "bfloat16": "bfloat16", "float16": np.float16}
+
+
+# -- autotuned lanes ------------------------------------------------------
+#
+# Lane "onnx_compute_dtype": compute_dtype="auto" resolves to a MEASURED
+# f32-vs-bf16 verdict per (model content, batch bucket) — the roofline
+# report's top signatures are the ResNet conv/matmul stack, and whether
+# bf16 helps is a property of the box (MXU: yes; an AVX host emulating
+# bf16: emphatically no), so it must be probed, not hardcoded. Params are
+# cast once at executor build (never per batch); the bf16 candidate casts
+# floating inputs ON DEVICE inside the compiled probe so the verdict
+# prices the full formulation. Verification is reference-relative under a
+# measured tolerance: 5% of the f32 output span absorbs bf16 rounding
+# drift through a deep stack while still failing a genuinely broken cast.
+
+def _dtype_probe_args(g, token, batch):
+    rng = np.random.default_rng(0)
+    bp = max(1, min(int(batch), 8))
+    args = []
+    for name in g.input_names:
+        want, shape = g.input_info.get(name, (None, None))
+        row = list(shape)[1:] if shape is not None else None
+        if row is None or any(not isinstance(d, int) or d <= 0
+                              for d in row):
+            # crash semantics: dynamic inputs fall back to the f32
+            # reference, memoized in-process only
+            raise ValueError(
+                f"graph input {name!r} has dynamic non-batch dims {shape}")
+        dt = np.dtype(want) if want is not None else np.dtype(np.float32)
+        if np.issubdtype(dt, np.floating):
+            args.append(rng.standard_normal((bp, *row)).astype(dt))
+        else:
+            args.append(rng.integers(0, 2, (bp, *row)).astype(dt))
+    return tuple(args)
+
+
+def _dtype_candidate(cast):
+    def make(rargs, args):
+        g = rargs[0]
+        fn = g.bind(cast_dtype=cast)
+        if cast is None:
+            return autotune.aot(fn, *args)
+        import jax.numpy as jnp
+
+        def run(*a):
+            staged = [x.astype(cast)
+                      if jnp.issubdtype(x.dtype, jnp.floating) else x
+                      for x in a]
+            return fn(*staged)
+        return autotune.aot(run, *args)
+    return make
+
+
+def _dtype_verify(got, want):
+    gs = got if isinstance(got, tuple) else (got,)
+    ws = want if isinstance(want, tuple) else (want,)
+    if len(gs) != len(ws):
+        return False
+    for g_, w_ in zip(gs, ws):
+        if tuple(g_.shape) != tuple(w_.shape):
+            return False
+        if np.issubdtype(np.asarray(w_).dtype, np.floating):
+            g64 = np.asarray(g_, np.float64)
+            w64 = np.asarray(w_, np.float64)
+            if not w64.size:
+                continue
+            span = max(1e-6, float(np.max(np.abs(w64))))
+            if float(np.max(np.abs(g64 - w64))) > 0.05 * span:
+                return False
+        elif not np.array_equal(np.asarray(g_), np.asarray(w_)):
+            return False
+    return True
+
+
+_DTYPE_LANE = autotune.register_lane(
+    "onnx_compute_dtype",
+    key_fn=lambda g, token, batch: (
+        autotune.key_prefix("onnx_dtype")
+        + f"|{token}|b{autotune.pow2(int(batch), 1, 4096)}"),
+    candidates={"float32": _dtype_candidate(None),
+                "bfloat16": _dtype_candidate("bfloat16")},
+    verify_fn=_dtype_verify,
+    reference="float32",
+    args_fn=_dtype_probe_args,
+    groups=("resnet50", "resnet50_fast"),
+)
+
+
+def routed_compute_dtype(graph, payload, batch: int) -> str:
+    """Measured compute-dtype verdict ("float32" | "bfloat16") for this
+    graph at this batch bucket — what ``compute_dtype="auto"`` resolves
+    to, and what bench's device leg consults instead of hardcoding
+    bf16. Persisted fleet-wide like every lane verdict."""
+    from synapseml_tpu.runtime import compile_cache as _cc
+    token = _cc.content_hash(payload or b"", len(graph._nodes),
+                             tuple(graph.output_names))[:12]
+    return _DTYPE_LANE.route(graph, token, int(batch))
+
+
+# Lane "onnx_hostfeed_wire": which side of the wire dequantizes uint8
+# pixels. The uint8 wire (1 byte/px + on-device (x-mean)*scale) won in
+# BENCH_r05 detail and is the reference; the float wire (host dequant,
+# compute-dtype bytes over the wire) can win where H2D is not the
+# bottleneck. The former hardcode in bench.py is now this routed
+# verdict. Candidates move REAL bytes: the uint8 leg's timed region is
+# device_put(u8) + the compiled dequant (device-resident result — the
+# best_of block_until_ready fix is what keeps this honest), the float
+# leg's is host dequant + the wider device_put.
+
+def _wire_uint8(rargs, args):
+    mean, scale, _row, _b, compute = rargs
+    import jax.numpy as jnp
+    tgt = jnp.dtype(_DTYPES[compute])
+    dq = autotune.aot(
+        lambda x: (x.astype(tgt) - jnp.asarray(mean, tgt))
+        * jnp.asarray(scale, tgt), args[0])
+    return lambda u8: dq(jax.device_put(u8))
+
+
+def _wire_float(rargs, args):
+    mean, scale, _row, _b, compute = rargs
+    np_tgt = np.dtype(_DTYPES[compute])
+
+    def run(u8):
+        v = (u8.astype(np.float32) - mean) * scale
+        return jax.device_put(v.astype(np_tgt))
+    return run
+
+
+def _wire_verify(got, want):
+    g64 = np.asarray(got, np.float64)
+    w64 = np.asarray(want, np.float64)
+    if g64.shape != w64.shape:
+        return False
+    span = max(1e-6, float(np.max(np.abs(w64))))
+    return float(np.max(np.abs(g64 - w64))) <= 0.02 * span
+
+
+def _wire_key(mean, scale, row, b, compute):
+    import hashlib
+    tok = hashlib.sha1(np.asarray(mean, np.float32).tobytes()
+                       + np.asarray(scale, np.float32).tobytes()
+                       ).hexdigest()[:8]
+    return (autotune.key_prefix("onnx_wire")
+            + f"|{tok}|r{'x'.join(str(d) for d in row)}"
+            + f"|b{autotune.pow2(int(b), 1, 4096)}|{compute}")
+
+
+_WIRE_LANE = autotune.register_lane(
+    "onnx_hostfeed_wire",
+    key_fn=_wire_key,
+    candidates={"uint8": _wire_uint8, "float": _wire_float},
+    verify_fn=_wire_verify,
+    reference="uint8",
+    args_fn=lambda mean, scale, row, b, compute: (
+        np.random.default_rng(0).integers(
+            0, 256, (max(1, min(int(b), 32)), *row), dtype=np.uint8),),
+    groups=("resnet50", "resnet50_fast"),
+)
 
 
 class ONNXModel(Transformer):
@@ -40,8 +200,12 @@ class ONNXModel(Transformer):
     feed_dict = Param("graph input name -> input column", default=None)
     fetch_dict = Param("output column -> graph output name", default=None)
     mini_batch_size = Param("max rows per device batch", default=128)
-    compute_dtype = Param("device compute dtype: float32|bfloat16|float16",
-                          default="float32")
+    compute_dtype = Param(
+        "device compute dtype: float32|bfloat16|float16, or 'auto' for "
+        "the autotuner's measured f32-vs-bf16 verdict (routed per model "
+        "content + batch bucket, persisted fleet-wide — "
+        "runtime/autotune.py lane 'onnx_compute_dtype')",
+        default="float32")
     softmax_output_col = Param("column for softmax of first output", default=None)
     argmax_output_col = Param("column for argmax of first output", default=None)
     input_norm = Param(
@@ -173,18 +337,28 @@ class ONNXModel(Transformer):
         from synapseml_tpu.runtime.executor import resolve_devices
         devs = resolve_devices(self.devices)
         dev_key = None if devs is None else tuple(d.id for d in devs)
-        key = (id(g), self.mini_batch_size, self.compute_dtype, norm_key,
+        cd = self.compute_dtype
+        if cd == "auto":
+            # measured verdict (probed once per content+batch class,
+            # then a cache-table hit); the resolved dtype keys the
+            # executor cache so a verdict flip cannot serve stale
+            # weight copies
+            cd = routed_compute_dtype(g, self.model_payload,
+                                      self.mini_batch_size)
+        key = (id(g), self.mini_batch_size, cd, norm_key,
                dev_key, self.compile_cache_dir)
         if key not in cache:
-            dtype = _DTYPES[self.compute_dtype]
+            dtype = _DTYPES[cd]
             params = g.params
-            if self.compute_dtype != "float32":
+            if cd != "float32":
+                # the one-time cast: params land on device in the routed
+                # dtype at executor build (warmup), never per batch
                 params = {
                     k: (v.astype(dtype) if np.issubdtype(v.dtype, np.floating)
                         else v)
                     for k, v in params.items()
                 }
-            compute = None if self.compute_dtype == "float32" else dtype
+            compute = None if cd == "float32" else dtype
 
             # Integer feeds bound for float graph inputs are cast (and
             # optionally normalized) ON DEVICE: the host->device wire then
@@ -232,13 +406,40 @@ class ONNXModel(Transformer):
             from synapseml_tpu.runtime import compile_cache as _cc
             cache_key = _cc.content_hash(
                 self.model_payload or b"", len(g._nodes),
-                tuple(g.output_names), self.compute_dtype, norm_key)
+                tuple(g.output_names), cd, norm_key)
             cache[key] = BatchedExecutor(
                 apply_fn, compute_dtype=compute,
                 max_bucket=self.mini_batch_size, bound_args=(params,),
                 devices=devs, cache_key=cache_key,
                 cache_dir=self.compile_cache_dir)
         return cache[key]
+
+    def preferred_wire(self, input_name: str,
+                       batch: Optional[int] = None) -> str:
+        """Routed hostfeed wire for ``input_name``: "uint8" (ship raw
+        pixels, dequantize on device via ``input_norm`` — the
+        reference) or "float" (dequantize on host, ship the compute
+        dtype). A measured verdict from the "onnx_hostfeed_wire" lane,
+        persisted per (norm content, row shape, batch bucket, compute
+        dtype); "float" unconditionally when the input has no
+        ``input_norm`` spec (there is no uint8 wire without one)."""
+        g = self.graph
+        norm = (self.input_norm or {}).get(input_name)
+        if norm is None:
+            return "float"
+        _want, shape = g.input_info.get(input_name, (None, None))
+        row = list(shape)[1:] if shape is not None else None
+        if row is None or any(not isinstance(d, int) or d <= 0
+                              for d in row):
+            return "uint8"
+        b = int(batch or self.mini_batch_size)
+        compute = self.compute_dtype
+        if compute == "auto":
+            compute = routed_compute_dtype(g, self.model_payload, b)
+        mean = np.asarray(norm.get("mean", 0.0), np.float32)
+        scale = np.asarray(norm.get("scale", 1.0), np.float32)
+        return _WIRE_LANE.route(mean, scale, tuple(int(d) for d in row),
+                                b, compute)
 
     def warmup(self, buckets=None, example_feeds=None):
         """AOT-compile (and persist, when a compile-cache dir is

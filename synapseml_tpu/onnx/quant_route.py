@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from synapseml_tpu.runtime import autotune
 from synapseml_tpu.runtime.proberoute import RouteTable
 from synapseml_tpu.runtime.proberoute import best_of as _best_of
 
@@ -98,48 +99,30 @@ def count(backend: str) -> None:
     _count(backend)
 
 
-def _route(kind: str, parts, probe_fn, do_count: bool = True) -> str:
-    """Shared routing core: kill switch -> backend -> cached verdict ->
-    probe-and-persist. Returns "int8" or "dequant"; counts the
-    decision unless the caller defers to the observed outcome
-    (``do_count=False`` + :func:`count`)."""
-    backend = "dequant"
-    if enabled() and jax.default_backend() == "tpu":
-        try:
-            key = _key(kind, parts)
-            got = _TABLE.lookup(key)
-            if got is None:
-                persist = True
-                try:
-                    got = probe_fn()
-                except Exception:  # noqa: BLE001 - probe crash = widened
-                    # memoized in-process ONLY (never persisted): a
-                    # deterministic probe crash costs one probe per
-                    # process, not one double-compile per trace
-                    got, persist = "dequant", False
-                _TABLE.record(key, got, persist=persist)
-            if got == "int8":
-                backend = "int8"
-        except Exception:  # noqa: BLE001 - routing must never fail scoring
-            backend = "dequant"
-    if do_count:
-        _count(backend)
-    return backend
+def _matmul_parts_p(a_dt, b_dt, a_zp, b_zp, n: int, k: int, m: int):
+    """Key parts from primitives — the same tuple route args carry to
+    the probe, so one lane rargs list serves key_fn AND probe_hook."""
+    return (str(a_dt), str(b_dt), _zp_tag(a_zp), _zp_tag(b_zp),
+            f"n{_bucket(n)}", f"k{_bucket(k)}", f"m{_bucket(m)}")
 
 
 def _matmul_parts(a, b, a_zp, b_zp):
     n, k = a.shape
-    return (str(a.dtype), str(b.dtype), _zp_tag(a_zp), _zp_tag(b_zp),
-            f"n{_bucket(n)}", f"k{_bucket(k)}",
-            f"m{_bucket(b.shape[1])}")
+    return _matmul_parts_p(a.dtype, b.dtype, a_zp, b_zp,
+                           n, k, b.shape[1])
+
+
+def _conv_parts_p(x_dt, x_zp, x_shape, w_shape, attrs: str):
+    spatial = "x".join(str(_bucket(s, hi=4096)) for s in x_shape[2:])
+    return (str(x_dt), _zp_tag(x_zp), f"b{_bucket(x_shape[0])}",
+            f"ci{x_shape[1]}", f"co{w_shape[0]}",
+            "k" + "x".join(str(s) for s in w_shape[2:]),
+            f"s{spatial}", attrs)
 
 
 def _conv_parts(x, w, x_zp, attrs: str):
-    spatial = "x".join(str(_bucket(s, hi=4096)) for s in x.shape[2:])
-    return (str(x.dtype), _zp_tag(x_zp), f"b{_bucket(x.shape[0])}",
-            f"ci{x.shape[1]}", f"co{w.shape[0]}",
-            "k" + "x".join(str(s) for s in w.shape[2:]),
-            f"s{spatial}", attrs)
+    return _conv_parts_p(x.dtype, x_zp, tuple(x.shape),
+                         tuple(w.shape), attrs)
 
 
 def route_matmul(a, b, a_zp, b_zp, do_count: bool = True) -> str:
@@ -151,11 +134,14 @@ def route_matmul(a, b, a_zp, b_zp, do_count: bool = True) -> str:
         if do_count:
             _count("dequant")
         return "dequant"
-    n, k = a.shape
-    return _route("matmul", _matmul_parts(a, b, a_zp, b_zp),
-                  lambda: _probe_matmul(a.dtype, b.dtype, a_zp, b_zp,
-                                        n, k, b.shape[1]),
-                  do_count=do_count)
+    backend = "dequant"
+    if enabled() and jax.default_backend() == "tpu":
+        n, k = a.shape
+        backend = _MM_LANE.route(a.dtype, b.dtype, a_zp, b_zp,
+                                 n, k, b.shape[1])
+    if do_count:
+        _count(backend)
+    return backend
 
 
 def route_conv(x, w, x_zp, w_zp, attrs: str,
@@ -169,10 +155,13 @@ def route_conv(x, w, x_zp, w_zp, attrs: str,
         if do_count:
             _count("dequant")
         return "dequant"
-    return _route("conv", _conv_parts(x, w, x_zp, attrs),
-                  lambda: _probe_conv(x.dtype, x_zp, x.shape, w.shape,
-                                      attrs),
-                  do_count=do_count)
+    backend = "dequant"
+    if enabled() and jax.default_backend() == "tpu":
+        backend = _CONV_LANE.route(x.dtype, x_zp, tuple(x.shape),
+                                   tuple(w.shape), attrs)
+    if do_count:
+        _count(backend)
+    return backend
 
 
 def poison_matmul(a, b, a_zp, b_zp) -> None:
@@ -180,20 +169,14 @@ def poison_matmul(a, b, a_zp, b_zp) -> None:
     runtime failure of its int8 leg — persisted, so a verdict the
     clamped probe landed but the real shape cannot run is not
     re-trusted on the next trace (or after restart)."""
-    try:
-        _TABLE.record(_key("matmul", _matmul_parts(a, b, a_zp, b_zp)),
-                      "dequant")
-    except Exception:  # noqa: BLE001
-        pass
+    n, k = a.shape
+    _MM_LANE.poison(a.dtype, b.dtype, a_zp, b_zp, n, k, b.shape[1])
 
 
 def poison_conv(x, w, x_zp, attrs: str) -> None:
     """Conv twin of :func:`poison_matmul`."""
-    try:
-        _TABLE.record(_key("conv", _conv_parts(x, w, x_zp, attrs)),
-                      "dequant")
-    except Exception:  # noqa: BLE001
-        pass
+    _CONV_LANE.poison(x.dtype, x_zp, tuple(x.shape), tuple(w.shape),
+                      attrs)
 
 
 class _Attrs:
@@ -215,15 +198,16 @@ def _aot(fn, *args):
     return jax.jit(fn).lower(*args).compile()
 
 
+def _verify_exact(got, want) -> bool:
+    """The int8 accumulator must be EXACT — same dtype, same bits."""
+    return got.dtype == want.dtype and np.array_equal(got, want)
+
+
 def _verify_and_time(int8_fn, wide_fn, args) -> str:
-    c8 = _aot(int8_fn, *args)
-    cw = _aot(wide_fn, *args)
-    got = np.asarray(c8(*args))
-    want = np.asarray(cw(*args))
-    if got.dtype != want.dtype or not np.array_equal(got, want):
-        return "dequant"  # the int8 accumulator must be EXACT
-    return ("int8" if _best_of(c8, args) <= _best_of(cw, args)
-            else "dequant")
+    return autotune.verify_then_time(
+        {"int8": _aot(int8_fn, *args), "dequant": _aot(wide_fn, *args)},
+        args, "dequant", verify_fn=_verify_exact,
+        time_fn=lambda fn, a, reps: _best_of(fn, a))
 
 
 def _rand_q(rng, shape, dtype):
@@ -297,6 +281,32 @@ def _probe_conv(x_dt, x_zp, x_shape, w_shape, attrs: str) -> str:
         lambda *v: importer._conv_wide_core(ctx, *unpack(v)), args)
 
 
+# Lane registrations: both share onnx_int8_routing.json and the q1|
+# key schema, so PR-15 fleet verdicts stay valid. _probe_matmul /
+# _probe_conv stay the monkeypatchable whole-probe seams (tests stub
+# or call them directly), riding the autotuner's legacy probe_hook
+# adapter via late-bound lambdas.
+_MM_LANE = autotune.register_lane(
+    "onnx_int8_matmul",
+    key_fn=lambda *r: _key("matmul", _matmul_parts_p(*r)),
+    candidates=("dequant", "int8"),
+    reference="dequant",
+    probe_hook=lambda *r: _probe_matmul(*r),
+    table=_TABLE,
+    groups=("onnx_int8",),
+)
+_CONV_LANE = autotune.register_lane(
+    "onnx_int8_conv",
+    key_fn=lambda *r: _key("conv", _conv_parts_p(*r)),
+    candidates=("dequant", "int8"),
+    reference="dequant",
+    probe_hook=lambda *r: _probe_conv(*r),
+    table=_TABLE,
+    groups=("onnx_int8",),
+)
+
+
 def clear_cache() -> None:
     """Test hook: drop the in-process memo + negative memo."""
-    _TABLE.clear()
+    _MM_LANE.reset()
+    _CONV_LANE.reset()

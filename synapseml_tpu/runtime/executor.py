@@ -1313,6 +1313,12 @@ class BatchedExecutor:
             layout = self._layout(bucket)
             sig = tuple(((bucket,) + row, jnp.dtype(dt).name)
                         for row, dt in specs)
+            if len(sig) > 1 and layout != "shard":
+                # probe the H2D staging formulation for this signature
+                # NOW — warmup is the pay-once moment; _dispatch only
+                # ever reads the persisted verdict (route() is a table
+                # hit when a sibling already landed it)
+                _h2d_lane().route(sig)
             mask = self._donate_mask_for_sig(sig)
             if layout == "shard":
                 targets = [(None, self._shard_data, self._bound, "shard")]
@@ -1380,6 +1386,9 @@ class BatchedExecutor:
                     report.errors.append(
                         f"bucket={bucket} {store_layout}: {e!r}")
                 report.entries.append(entry)
+        # verdicts may have landed above: drop any dispatch-path H2D
+        # memo taken before they did
+        self._h2d_choice = {}
         # the sentinel arms HERE: from now on, any trace/compile the
         # dispatch path performs is a counted, classified, ring-recorded
         # recompile incident (signatures warmup failed on — status
@@ -1387,6 +1396,21 @@ class BatchedExecutor:
         with self._tables_lock:
             self._warmed = True
         return report
+
+    def _h2d_choice_for(self, hostp) -> str:
+        """Dispatch-path verdict for this host-arg signature: memoized
+        per executor, filled from the lane's persisted table (cached
+        lookup only — a missing verdict serves per_arg, it never probes
+        under a live dispatch)."""
+        hkey = tuple((tuple(a.shape), a.dtype.name) for a in hostp)
+        try:
+            memo = self._h2d_choice
+        except AttributeError:
+            memo = self._h2d_choice = {}
+        got = memo.get(hkey)
+        if got is None:
+            got = memo[hkey] = _h2d_lane().cached(hkey) or "per_arg"
+        return got
 
     def _record_cost(self, compiled: Any, bucket: int, sig: tuple,
                      store_layout: str) -> bool:
@@ -1465,6 +1489,7 @@ class BatchedExecutor:
         _F_H2D.fire()
         padded = []
         guard: List[int] = []  # external device arrays we did not copy
+        host_idx: List[int] = []  # host args awaiting their H2D put
         for i, a in enumerate(arrays):
             if isinstance(a, jax.Array):
                 # super-chunk slices pass through; an *external* device
@@ -1479,8 +1504,21 @@ class BatchedExecutor:
             if n < bucket and len(a) < bucket:  # never re-pad a padded tail
                 pad = [(0, bucket - n)] + [(0, 0)] * (a.ndim - 1)
                 a = np.pad(a, pad)
-            padded.append(
-                jax.device_put(a, placement) if placement is not None else a)
+            host_idx.append(i)
+            padded.append(a)
+        if host_idx:
+            hostp = [padded[i] for i in host_idx]
+            # the routed H2D formulation (lane "executor_h2d"): verdict
+            # consulted from a per-executor memo / the persisted table —
+            # NEVER probed here; warmup() is where the probe runs
+            if (len(hostp) > 1 and layout != "shard"
+                    and self._h2d_choice_for(hostp) == "coalesced"):
+                staged = _coalesced_put(hostp, placement)
+            else:
+                staged = [jax.device_put(a, placement)
+                          if placement is not None else a for a in hostp]
+            for i, a in zip(host_idx, staged):
+                padded[i] = a
         sig = tuple((tuple(a.shape), jnp.dtype(a.dtype).name)
                     for a in padded)
         mask = self._donate_mask_for_sig(sig)
@@ -1634,3 +1672,98 @@ def default_device() -> jax.Device:
 
 def local_device_count() -> int:
     return jax.local_device_count()
+
+
+# -- autotuned H2D staging lane ---------------------------------------------
+#
+# Lane "executor_h2d": whether a multi-argument bucket's host arrays ride
+# one contiguous transfer (concatenate per dtype group, a single
+# device_put, device-side slice+reshape back out) or the per-arg
+# device_put loop. Per-arg pays one transfer launch per argument; the
+# coalesced form pays one host memcpy into a contiguous staging buffer +
+# one launch + cheap on-device slices — which side wins is a property of
+# arg count, sizes, and the box's transfer path, so it is a MEASURED
+# verdict keyed by the full staged signature. Probed from warmup() only
+# (the pay-once moment); _dispatch consults the persisted verdict via a
+# per-executor memo and never probes on the serving path. Verification
+# is bit-exact per element and dtype — pure data movement. The timing
+# contrast is honest only because best_of forces with block_until_ready:
+# both candidates' results are device-resident, and a D2H fetch in the
+# timed region would drown the transfer-launch difference being measured.
+
+def _coalesced_put(arrays, placement):
+    """One contiguous transfer per dtype group; singleton groups go
+    direct. Device-side slices materialize fresh buffers, so donation
+    of any output never aliases a sibling."""
+    out = [None] * len(arrays)
+    groups: Dict[str, List[int]] = {}
+    for i, a in enumerate(arrays):
+        groups.setdefault(a.dtype.str, []).append(i)
+    for idxs in groups.values():
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = (jax.device_put(arrays[i], placement)
+                      if placement is not None else jnp.asarray(arrays[i]))
+            continue
+        flat = np.concatenate([arrays[i].ravel() for i in idxs])
+        packed = (jax.device_put(flat, placement)
+                  if placement is not None else jnp.asarray(flat))
+        off = 0
+        for i in idxs:
+            size = arrays[i].size
+            out[i] = packed[off:off + size].reshape(arrays[i].shape)
+            off += size
+    return out
+
+
+def _h2d_args(sig):
+    rng = np.random.default_rng(0)
+    out = []
+    for shape, dt in sig:
+        d = np.dtype(dt)
+        if np.issubdtype(d, np.floating):
+            out.append(rng.standard_normal(shape).astype(d))
+        else:
+            out.append(rng.integers(0, 2, shape).astype(d))
+    return (out,)
+
+
+def _h2d_verify(got, want):
+    if len(got) != len(want):
+        return False
+    for g, w in zip(got, want):
+        if (g.dtype != w.dtype or g.shape != w.shape
+                or not np.array_equal(g, w)):
+            return False
+    return True
+
+
+_H2D_LANE = None
+
+
+def _h2d_lane():
+    """Lazy registration — the lane costs nothing until an executor
+    with a multi-arg signature warms up."""
+    global _H2D_LANE
+    if _H2D_LANE is None:
+        from synapseml_tpu.runtime import autotune as _at
+
+        dev = default_device()
+        _H2D_LANE = _at.register_lane(
+            "executor_h2d",
+            key_fn=lambda sig: (
+                _at.key_prefix("h2d") + "|" + ";".join(
+                    f"{'x'.join(str(d) for d in s)}:{t}"
+                    for s, t in sig)),
+            candidates={
+                "per_arg": lambda rargs, args: (
+                    lambda arrs: tuple(jax.device_put(a, dev)
+                                       for a in arrs)),
+                "coalesced": lambda rargs, args: (
+                    lambda arrs: tuple(_coalesced_put(arrs, dev))),
+            },
+            verify_fn=_h2d_verify,
+            reference="per_arg",
+            args_fn=_h2d_args,
+        )
+    return _H2D_LANE

@@ -1,0 +1,375 @@
+"""runtime/autotune.py — the shared verify-then-time prober registry.
+
+Pins the routing loop's whole failure contract (verify mismatch ->
+reference PERSISTED, timing regression -> reference persisted, probe
+crash -> in-process memo only, kill switch -> zero probes and zero
+table I/O), the fleet-sharing path (a sibling process's persisted
+verdict is adopted with zero probes), the two refactored PR-15 routers
+as lane *callers*, and the round-16 proberoute fixes: no D2H in
+``best_of``'s timed region, selective negative retirement in
+``RouteTable.record``, single-flight disk reads in ``lookup``.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from synapseml_tpu.runtime import autotune
+from synapseml_tpu.runtime import proberoute as pr
+
+
+@pytest.fixture
+def at_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("SYNAPSEML_AUTOTUNE", raising=False)
+    yield tmp_path
+
+
+def _lane(name, candidates, reference, verify_fn=None, time_fn=None,
+          reps=2):
+    """A host-only decomposed lane over 1-D float arrays; candidates
+    map choice -> make(rargs, args) like the real registrations."""
+    return autotune.register_lane(
+        name,
+        key_fn=lambda n: f"t|{n}",
+        candidates=candidates,
+        verify_fn=verify_fn,
+        reference=reference,
+        args_fn=lambda n: (np.arange(n, dtype=np.float64),),
+        time_fn=time_fn,
+        reps=reps,
+    )
+
+
+def _mk(fn):
+    return lambda rargs, args: fn
+
+
+def test_registry_round_trip_probes_once_and_persists(at_env):
+    calls = {"ref": 0, "cand": 0}
+
+    def ref(x):
+        calls["ref"] += 1
+        return x * 2.0
+
+    def cand(x):
+        calls["cand"] += 1
+        return x + x
+
+    ln = _lane("t_round_trip", {"ref": _mk(ref), "cand": _mk(cand)},
+               "ref")
+    ln.time_fn = lambda fn, a, r: 1.0 if fn is cand else 2.0
+    assert ln.route(8) == "cand"
+    assert ln.probes == 1
+    # memoized: no second probe, same verdict
+    assert ln.route(8) == "cand"
+    assert ln.probes == 1
+    # persisted for the fleet
+    path = os.path.join(str(at_env), "autotune_t_round_trip.json")
+    with open(path) as fh:
+        assert json.load(fh) == {"t|8": "cand"}
+    # a fresh table (new process stand-in) adopts it with zero probes
+    ln2 = _lane("t_round_trip", {"ref": _mk(ref), "cand": _mk(cand)},
+                "ref")
+    assert ln2.route(8) == "cand"
+    assert ln2.probes == 0
+    assert autotune.cached("t_round_trip", 8) == "cand"
+
+
+def test_verify_failure_falls_back_and_persists_reference(at_env):
+    def ref(x):
+        return x * 2.0
+
+    def wrong(x):
+        return x * 3.0  # mismatches the reference output
+
+    ln = _lane("t_mismatch", {"ref": _mk(ref), "wrong": _mk(wrong)},
+               "ref", time_fn=lambda fn, a, r: 0.0)
+    assert ln.route(8) == "ref"
+    assert ln.probes == 1
+    # the reference verdict IS persisted: a deterministic mismatch
+    # must not re-pay the probe after restart
+    with open(os.path.join(str(at_env),
+                           "autotune_t_mismatch.json")) as fh:
+        assert json.load(fh) == {"t|8": "ref"}
+    ln2 = _lane("t_mismatch", {"ref": _mk(ref), "wrong": _mk(wrong)},
+                "ref")
+    assert ln2.route(8) == "ref"
+    assert ln2.probes == 0
+
+
+def test_timing_regression_keeps_reference(at_env):
+    def ref(x):
+        return x * 2.0
+
+    def slow(x):
+        return x + x  # verifies clean, times slower
+
+    ln = _lane("t_slow", {"ref": _mk(ref), "slow": _mk(slow)}, "ref")
+    ln.time_fn = lambda fn, a, r: 5.0 if fn is slow else 1.0
+    assert ln.route(8) == "ref"
+    with open(os.path.join(str(at_env), "autotune_t_slow.json")) as fh:
+        assert json.load(fh) == {"t|8": "ref"}
+
+
+def test_probe_crash_memoized_in_process_only(at_env):
+    calls = {"n": 0}
+
+    def boom(rargs, args):
+        calls["n"] += 1
+        raise RuntimeError("compile exploded")
+
+    def ref(x):
+        return x
+
+    # the REFERENCE build crashing is the probe crashing
+    ln = _lane("t_crash", {"ref": boom, "cand": _mk(ref)}, "ref")
+    assert ln.route(8) == "ref"
+    assert ln.probes == 1
+    # in-process memo: no second probe for the same key ...
+    assert ln.route(8) == "ref"
+    assert calls["n"] == 1
+    # ... but NOTHING persisted — a transient crash must not be
+    # remembered fleet-wide
+    assert not os.path.exists(
+        os.path.join(str(at_env), "autotune_t_crash.json"))
+
+
+def test_kill_switch_zero_probes_zero_io(at_env, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_AUTOTUNE", "0")
+
+    def forbid(*a, **k):
+        raise AssertionError("probe ran under the kill switch")
+
+    ln = _lane("t_kill", {"ref": forbid, "cand": forbid}, "ref")
+    ln.probe = forbid
+    assert ln.route(8) == "ref"
+    assert ln.cached(8) is None
+    assert ln.probes == 0
+    assert not os.listdir(str(at_env))
+    assert autotune.snapshot()["enabled"] is False
+
+
+def test_route_never_raises(at_env):
+    ln = _lane("t_neverraise", {"ref": _mk(lambda x: x)}, "ref")
+    ln.key_fn = lambda *a: (_ for _ in ()).throw(RuntimeError("key"))
+    assert ln.route(8) == "ref"
+    assert ln.cached(8) is None
+
+
+def test_cross_process_sharing(at_env):
+    """Process A probes and persists; process B (fresh interpreter,
+    same SYNAPSEML_TPU_CACHE_DIR) serves the verdict with ZERO
+    probes — the fleet-shared half of the contract, for real."""
+    prog = r"""
+import json, sys
+import numpy as np
+from synapseml_tpu.runtime import autotune
+
+ln = autotune.register_lane(
+    "t_fleet",
+    key_fn=lambda n: f"t|{n}",
+    candidates={"ref": lambda r, a: (lambda x: x * 2.0),
+                "cand": lambda r, a: (lambda x: x + x)},
+    reference="ref",
+    args_fn=lambda n: (np.arange(n, dtype=np.float64),),
+    time_fn=lambda fn, a, r: 1.0 if fn(np.ones(1))[0] == 2.0 else 9.0,
+)
+# both legs compute x*2 so time_fn cannot tell them apart by value;
+# force a deterministic winner instead: candidate wins ties
+print(json.dumps({"choice": ln.route(64), "probes": ln.probes}))
+"""
+    env = dict(os.environ, SYNAPSEML_TPU_CACHE_DIR=str(at_env),
+               JAX_PLATFORMS="cpu")
+    out_a = subprocess.run([sys.executable, "-c", prog], env=env,
+                           capture_output=True, text=True, check=True)
+    got_a = json.loads(out_a.stdout.strip().splitlines()[-1])
+    assert got_a["probes"] == 1
+    out_b = subprocess.run([sys.executable, "-c", prog], env=env,
+                           capture_output=True, text=True, check=True)
+    got_b = json.loads(out_b.stdout.strip().splitlines()[-1])
+    assert got_b["probes"] == 0
+    assert got_b["choice"] == got_a["choice"]
+
+
+def test_poison_persists_demotion(at_env):
+    ln = _lane("t_poison", {"ref": _mk(lambda x: x * 2.0),
+                            "cand": _mk(lambda x: x + x)}, "ref",
+               time_fn=lambda fn, a, r: 0.0)
+    ln.poison(8)
+    with open(os.path.join(str(at_env),
+                           "autotune_t_poison.json")) as fh:
+        assert json.load(fh) == {"t|8": "ref"}
+    # a later route serves the demotion without probing
+    assert ln.route(8) == "ref"
+    assert ln.probes == 0
+
+
+def test_verify_then_time_candidate_wins_ties(at_env):
+    def ref(x):
+        return x * 2.0
+
+    def cand(x):
+        return x + x
+
+    got = autotune.verify_then_time(
+        {"ref": ref, "cand": cand}, (np.arange(4.0),), "ref",
+        time_fn=lambda fn, a, r: 1.0)
+    assert got == "cand"
+
+
+def test_snapshot_shape(at_env):
+    ln = _lane("t_snap", {"ref": _mk(lambda x: x)}, "ref")
+    ln.groups = ("some_group",)
+    ln.route(4)
+    snap = autotune.snapshot()
+    rec = snap["lanes"]["t_snap"]
+    assert rec["reference"] == "ref"
+    assert rec["groups"] == ["some_group"]
+    assert rec["decisions"] == {"t|4": "ref"}
+    assert rec["table"] == "autotune_t_snap.json"
+
+
+# -- the refactored PR-15 routers as lane callers -------------------
+
+
+def test_predict_route_is_an_autotune_lane(at_env, monkeypatch):
+    from synapseml_tpu.gbdt import predict_route
+
+    predict_route.clear_cache()
+    ln = autotune.lane("gbdt_predict")
+    assert ln is not None and ln is predict_route._LANE
+    assert ln.reference == "xla"
+    assert set(ln.candidates) == {"xla", "pallas"}
+    monkeypatch.setattr(predict_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setattr(predict_route, "_probe",
+                        lambda *a: "pallas")
+    got = predict_route.route_predict(1024, 64, 512, 32, 6)
+    assert got == "pallas"
+    assert ln.probes == 1
+    # the verdict went through the lane's shared table
+    assert predict_route.cached_route(1024, 64, 512, 32, 6) == "pallas"
+    predict_route.clear_cache()
+
+
+def test_quant_route_is_an_autotune_lane(at_env, monkeypatch):
+    from synapseml_tpu.onnx import quant_route
+
+    quant_route.clear_cache()
+    mm = autotune.lane("onnx_int8_matmul")
+    cv = autotune.lane("onnx_int8_conv")
+    assert mm is quant_route._MM_LANE and cv is quant_route._CONV_LANE
+    assert mm.reference == "dequant" and cv.reference == "dequant"
+    monkeypatch.setattr(quant_route.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.setattr(quant_route, "_probe_matmul",
+                        lambda *a: "int8")
+    a = np.zeros((256, 256), np.uint8)
+    b = np.zeros((256, 256), np.int8)
+    got = quant_route.route_matmul(a, b, np.uint8(3), np.int8(0))
+    assert got == "int8"
+    assert mm.probes == 1
+    # same args, no second probe
+    assert quant_route.route_matmul(a, b, np.uint8(3),
+                                    np.int8(0)) == "int8"
+    assert mm.probes == 1
+    quant_route.clear_cache()
+
+
+# -- round-16 proberoute fixes --------------------------------------
+
+
+class _LazyFetch:
+    """Device-array stand-in: completion is cheap, the value fetch is
+    expensive — exactly the asymmetry the old np.asarray-based timing
+    loop mis-measured."""
+
+    D2H_SLEEP = 0.25
+
+    def block_until_ready(self):
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        time.sleep(self.D2H_SLEEP)
+        return np.zeros(1)
+
+
+def test_best_of_no_d2h_in_timed_region():
+    t = pr.best_of(lambda: _LazyFetch(), (), reps=2)
+    # jax.block_until_ready on a non-jax object must not fall back to
+    # the expensive __array__ fetch; the timed region stays ~free
+    assert t < _LazyFetch.D2H_SLEEP / 2
+
+
+def test_record_retires_only_satisfied_negatives(tmp_path, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    t = pr.RouteTable("t_selective.json")
+    assert t.lookup("k1") is None  # negatives armed for k1
+    assert t.lookup("k2") is None  # ... and k2
+    reads = {"n": 0}
+    orig = pr.RouteTable._load_disk
+
+    def counting(self):
+        reads["n"] += 1
+        return orig(self)
+
+    monkeypatch.setattr(pr.RouteTable, "_load_disk", counting)
+    t.record("k1", "v1")  # persists; must NOT blanket-clear k2's neg
+    assert "k2" in t._neg
+    before = reads["n"]
+    assert t.lookup("k2") is None  # fresh negative: no disk re-read
+    assert reads["n"] == before
+
+
+def test_record_merge_adopts_sibling_and_retires_its_negative(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    t = pr.RouteTable("t_sibling.json")
+    assert t.lookup("k2") is None  # negative armed
+    # a sibling worker lands k2 on the shared volume
+    sib = pr.RouteTable("t_sibling.json")
+    sib.record("k2", "v2")
+    # our own record's pre-write merge surfaces it: memo adopted, k2's
+    # negative retired, visible immediately despite the TTL
+    t.record("k1", "v1")
+    assert "k2" not in t._neg
+    assert t.lookup("k2") == "v2"
+
+
+def test_lookup_single_flight(tmp_path, monkeypatch):
+    monkeypatch.setenv("SYNAPSEML_TPU_CACHE_DIR", str(tmp_path))
+    t = pr.RouteTable("t_flight.json")
+    os.makedirs(str(tmp_path), exist_ok=True)
+    with open(t.path(), "w") as fh:
+        json.dump({"k": "v"}, fh)
+    n = 4
+    gate = threading.Barrier(n)
+    reads = {"n": 0}
+    orig = pr.RouteTable._load_disk
+
+    def slow_read(self):
+        reads["n"] += 1
+        time.sleep(0.05)  # hold the read open so the others pile up
+        return orig(self)
+
+    monkeypatch.setattr(pr.RouteTable, "_load_disk", slow_read)
+    got = [None] * n
+
+    def worker(i):
+        gate.wait()
+        got[i] = t.lookup("k")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert got == ["v"] * n
+    assert reads["n"] == 1  # one disk read served all concurrent missers

@@ -67,6 +67,10 @@ TOLERANCES = {
     # contended CPU runner)
     "gbdt_predict_rows_per_sec_per_chip": 0.75,
     "onnx_int8_rows_per_sec_per_chip": 0.75,
+    # round-16 autotuner-routed resnet50_fast lanes (CI-sized twin;
+    # throughput, same 0.75 collapse band as the other routed lanes)
+    "onnx_resnet50_images_per_sec_per_chip": 0.75,
+    "onnx_resnet50_hostfeed_images_per_sec": 0.75,
 }
 
 # units whose metrics are better when SMALLER (latency-domain); every

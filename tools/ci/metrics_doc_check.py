@@ -29,7 +29,7 @@ import sys
 
 PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
             "fleet_", "process_", "trace_", "capture_", "gbdt_",
-            "onnx_")
+            "onnx_", "autotune_")
 REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
 
 HERE = os.path.dirname(os.path.abspath(__file__))

@@ -1,0 +1,98 @@
+#!/usr/bin/env bash
+# Round-16 autotuner canary (runtime/autotune.py, docs/perf.md "Round
+# 16 — the autotuner"): the registry's contract suite runs under a hard
+# wall, then the fleet-sharing cycle is proven ACROSS PROCESSES on one
+# cache volume — process A probes a demo lane once (reference python
+# loop vs numpy sum, bit-equal, numpy deterministically faster) and
+# persists the verdict; process B serves the SAME choice with zero
+# probes; SYNAPSEML_AUTOTUNE=0 serves the reference with zero probes
+# and zero table I/O, the route counter proving every decision. Kill
+# switch and fleet sharing are load-bearing, not decorative.
+#
+# Usage: tools/ci/smoke_autotune.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+
+timeout -k 10 "${SMOKE_TIMEOUT:-420}" env JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_autotune.py -q -p no:cacheprovider
+
+CACHE_DIR="$(mktemp -d)"
+KILL_DIR="$(mktemp -d)"
+trap 'rm -rf "$CACHE_DIR" "$KILL_DIR"' EXIT
+
+DEMO_LANE='
+import json, os, sys
+import numpy as np
+from synapseml_tpu.runtime import autotune
+
+def _py_sum(rargs, args):
+    def run(x):
+        total = np.int64(0)
+        for v in x.tolist():
+            total += np.int64(v)
+        return np.int64(total)
+    return run
+
+def _np_sum(rargs, args):
+    return lambda x: x.sum(dtype=np.int64)
+
+lane = autotune.register_lane(
+    "smoke_sum",
+    key_fn=lambda n: f"smoke|{n}",
+    candidates={"python": _py_sum, "numpy": _np_sum},
+    reference="python",
+    args_fn=lambda n: (np.arange(n, dtype=np.int64),),
+)
+choice = lane.route(200_000)
+from synapseml_tpu.runtime import telemetry
+counters = telemetry.snapshot()["counters"]
+routed = counters.get(
+    "synapseml_autotune_route_total"
+    "{choice=\"%s\",lane=\"smoke_sum\"}" % choice, 0)
+print(json.dumps({"choice": choice, "probes": lane.probes,
+                  "counter": routed,
+                  "table": os.path.exists(lane.table.path())}))
+'
+
+# Phase A: first process pays the probe and persists the verdict
+A=$(timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      SYNAPSEML_TPU_CACHE_DIR="$CACHE_DIR" python -c "$DEMO_LANE" | tail -1)
+echo "phase A: $A"
+python - "$A" <<'PY'
+import json, sys
+got = json.loads(sys.argv[1])
+assert got["probes"] == 1, got
+assert got["choice"] == "numpy", got   # bit-equal and measurably faster
+assert got["counter"] >= 1, got
+assert got["table"], got               # verdict persisted for the fleet
+print("phase A ok: probed once, numpy won, verdict on disk")
+PY
+
+# Phase B: a FRESH process on the same volume serves the verdict with
+# zero probes — the fleet-shared half of the contract
+B=$(timeout -k 10 120 env JAX_PLATFORMS=cpu \
+      SYNAPSEML_TPU_CACHE_DIR="$CACHE_DIR" python -c "$DEMO_LANE" | tail -1)
+echo "phase B: $B"
+python - "$B" <<'PY'
+import json, sys
+got = json.loads(sys.argv[1])
+assert got["probes"] == 0, got
+assert got["choice"] == "numpy", got
+print("phase B ok: zero probes, same choice adopted from the volume")
+PY
+
+# Phase C: kill switch — reference serves, zero probes, zero table I/O
+C=$(timeout -k 10 120 env JAX_PLATFORMS=cpu SYNAPSEML_AUTOTUNE=0 \
+      SYNAPSEML_TPU_CACHE_DIR="$KILL_DIR" python -c "$DEMO_LANE" | tail -1)
+echo "phase C: $C"
+python - "$C" <<'PY'
+import json, sys
+got = json.loads(sys.argv[1])
+assert got["probes"] == 0, got
+assert got["choice"] == "python", got  # the reference, by fiat
+assert got["counter"] >= 1, got        # decisions still counted
+assert not got["table"], got           # no table I/O under the switch
+print("phase C ok: kill switch serves the reference, zero probes")
+PY
+
+echo "autotune smoke ok"

@@ -74,8 +74,14 @@ _GROUP_LEVERS = {
     "transformer": "occupancy-sensitive (docs/perf.md: bs=128 vs 32 "
                    "nearly 2x) — keep batches >=4k rows per matmul; "
                    "int8 QOperator lane is the next step",
-    "resnet50": "conv stack near its measured MFU — next lever is the "
-                "int8 lane or more chips (dp_scaling tracks that)",
+    "resnet50": "conv stack near its measured MFU — compute dtype and "
+                "hostfeed wire are autotuner-routed (see formulation "
+                "column); next lever is the int8 lane or more chips "
+                "(dp_scaling tracks that)",
+    "resnet50_fast": "CI twin of resnet50: both lanes routed by "
+                     "measured verdicts — a formulation flip here means "
+                     "the autotuner re-decided, check the bench "
+                     "detail.autotune snapshot",
     "dp_scaling": "speedup below ~0.9x/chip means dispatch or H2D "
                   "serialization — check executor_duty_cycle spread "
                   "across devices",
@@ -93,7 +99,29 @@ _REQUIRED_ROW_KEYS = (
     "group", "kind", "bound", "flops_per_item", "bytes_per_item",
     "achieved_flops_per_sec", "attainable_flops_per_sec",
     "roofline_fraction", "lever", "metric", "value", "unit",
+    "formulation",
 )
+
+
+def _group_formulations(payload: Dict[str, Any],
+                        group: str) -> List[str]:
+    """``lane:choice`` strings for every autotune lane whose ``groups``
+    tag includes this bench group (detail.autotune, the lane snapshot
+    bench.py embeds). A lane with several routed keys lists each
+    distinct choice once — the report answers WHICH formulation the
+    run actually executed, per bottleneck."""
+    lanes = ((payload.get("detail") or {}).get("autotune") or {}).get(
+        "lanes") or {}
+    out: List[str] = []
+    for name in sorted(lanes):
+        lane = lanes[name] or {}
+        if group not in (lane.get("groups") or ()):
+            continue
+        choices = sorted(set((lane.get("decisions") or {}).values()))
+        if not choices:
+            choices = [f"{lane.get('reference', '?')} (unrouted)"]
+        out.append(f"{name}:{'/'.join(choices)}")
+    return out
 
 
 def _fmt_eng(v: float, unit: str = "") -> str:
@@ -196,6 +224,8 @@ def attribute_group(group: str, meta: Dict[str, Any],
     lever = _BOUND_LEVERS.get(row["bound"], _BOUND_LEVERS["unknown"])
     extra = _GROUP_LEVERS.get(group)
     row["lever"] = f"{extra} — {lever}" if extra else lever
+    forms = _group_formulations(payload, group)
+    row["formulation"] = "; ".join(forms) if forms else "—"
     return row
 
 
@@ -252,8 +282,9 @@ def build_report(payload: Dict[str, Any],
     add("## Ranked bottlenecks (worst roofline fraction first)")
     add("")
     add("| rank | group | bound | metric | flops/item | "
-        "achieved FLOP/s | attainable | fraction | lever |")
-    add("|---|---|---|---|---|---|---|---|---|")
+        "achieved FLOP/s | attainable | fraction | formulation "
+        "| lever |")
+    add("|---|---|---|---|---|---|---|---|---|---|")
     for i, r in enumerate(rows, 1):
         frac = (f"{r['roofline_fraction']:.2%}"
                 if r["attributed"] and r["kind"] != "host" else "—")
@@ -262,7 +293,7 @@ def build_report(payload: Dict[str, Any],
             f"| {_fmt_eng(r['flops_per_item'])} "
             f"| {_fmt_eng(r['achieved_flops_per_sec'])} "
             f"| {_fmt_eng(r['attainable_flops_per_sec'])} "
-            f"| {frac} | {r['lever']} |")
+            f"| {frac} | {r['formulation']} | {r['lever']} |")
     add("")
     add("## Per-group signatures")
     for r in rows:
@@ -270,6 +301,25 @@ def build_report(payload: Dict[str, Any],
         add(f"### {r['group']} ({r['kind']})")
         if r.get("description"):
             add(f"{r['description']}")
+        lanes = ((payload.get("detail") or {}).get("autotune") or {}
+                 ).get("lanes") or {}
+        routed = [(n, lanes[n]) for n in sorted(lanes)
+                  if r["group"] in (lanes[n].get("groups") or ())]
+        if routed:
+            add("")
+            add("Autotuned formulations (runtime/autotune.py, verdicts "
+                "in the shared route table):")
+            for name, lane in routed:
+                decided = lane.get("decisions") or {}
+                probes = lane.get("probes", 0)
+                if decided:
+                    for key, choice in sorted(decided.items()):
+                        add(f"- `{name}` -> **{choice}** "
+                            f"(key `{key}`, {probes} probe(s) this "
+                            f"run, reference {lane.get('reference')})")
+                else:
+                    add(f"- `{name}`: no keys routed this run "
+                        f"(reference {lane.get('reference')})")
         tagged = _entries_for(cost, r["group"])
         if not tagged:
             add("no cost-table signatures recorded for this group"
