@@ -739,6 +739,8 @@ def bench_serving_scored_latency():
                 with lock:
                     clats.extend(mine)
 
+            # synlint: disable=RL001 - finite barrage clients: the
+            # harness joins every one below; a raise fails the bench
             threads = [threading.Thread(target=client)
                        for _ in range(n_clients)]
             t_all = _time.perf_counter()
@@ -825,27 +827,50 @@ def bench_serving_cold_start():
 
 def bench_synlint():
     """Static-analysis hygiene canary: run synlint (tools/analysis,
-    docs/analysis.md) over the package and record (total findings,
-    analyzer wall time). The committed JSON makes hygiene drift — a new
-    host-sync on the dispatch path, an unguarded shared write — a
-    diffable number per round, same as the donation-warning count.
+    docs/analysis.md) over the package and record total + per-pack
+    finding counts, cold/warm analyzer wall time, and the result-cache
+    hit rate (cold run populates a throwaway cache, warm run replays
+    it). The committed JSON makes hygiene drift — a new host-sync on
+    the dispatch path, an unguarded shared write, a knob-table gap —
+    a diffable number per round, same as the donation-warning count.
     Never sinks the benchmark run: any analyzer failure reports -1."""
+    import tempfile
     import time as _time
 
     try:
-        from tools.analysis.engine import analyze_paths
+        from tools.analysis.cache import ResultCache
+        from tools.analysis.engine import analyze_program, pack_of
 
         # anchor targets to the repo root, not the process cwd — run
         # from elsewhere, bare names would resolve to nothing and the
         # metric would read as a spotless 0
         root = os.path.dirname(os.path.abspath(__file__))
-        t0 = _time.monotonic()
-        findings = analyze_paths(
-            [os.path.join(root, p)
-             for p in ("synapseml_tpu", "tools", "bench.py")], root=root)
-        return len(findings), _time.monotonic() - t0
+        paths = [os.path.join(root, p)
+                 for p in ("synapseml_tpu", "tools", "bench.py")]
+        with tempfile.TemporaryDirectory() as td:
+            cpath = os.path.join(td, "synlint-cache.json")
+            cold_cache = ResultCache(cpath)
+            t0 = _time.monotonic()
+            findings, _prog, _ = analyze_program(paths, root=root,
+                                                 cache=cold_cache)
+            cold_s = _time.monotonic() - t0
+            cold_cache.save()
+            t0 = _time.monotonic()
+            _f, _p, warm = analyze_program(paths, root=root,
+                                           cache=ResultCache(cpath))
+            warm_s = _time.monotonic() - t0
+        packs: dict = {}
+        for f in findings:
+            packs[pack_of(f.rule)] = packs.get(pack_of(f.rule), 0) + 1
+        hit_rate = (warm["cache_hits"] / warm["files"]
+                    if warm.get("files") else 0.0)
+        return {"synlint_findings_total": len(findings),
+                "synlint_runtime_s": round(cold_s, 2),
+                "synlint_warm_runtime_s": round(warm_s, 2),
+                "synlint_cache_hit_rate": round(hit_rate, 3),
+                "synlint_findings_by_pack": dict(sorted(packs.items()))}
     except Exception:  # noqa: BLE001 - the bench must survive lint bugs
-        return -1, -1.0
+        return {"synlint_findings_total": -1, "synlint_runtime_s": -1.0}
 
 
 def _telemetry_snapshot():
@@ -1271,9 +1296,7 @@ def run_bench(groups, synlint: bool = True):
     # work will regress against
     detail = {"donated_buffers_not_usable_warnings": donation_warnings}
     if synlint:
-        synlint_total, synlint_s = bench_synlint()
-        detail["synlint_findings_total"] = synlint_total
-        detail["synlint_runtime_s"] = round(synlint_s, 2)
+        detail.update(bench_synlint())
     detail["telemetry"] = _telemetry_snapshot()
     # autotune lane snapshot: which formulation each registered lane
     # routed for this run (reference, candidates, per-key decisions,

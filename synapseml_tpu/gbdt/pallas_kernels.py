@@ -23,6 +23,24 @@ import numpy as np
 
 _TN = 512  # rows per grid step (wide-feature default)
 
+# Per-core VMEM is ~16 MiB on current TPUs; a grid step whose resident
+# blocks exceed it dies inside Mosaic with an opaque allocation error.
+# Both wrappers bound their block bytes against this before launching
+# so oversized shapes (huge n_bins, very deep trees) fail with an
+# actionable message at the call site instead.
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+
+def _check_vmem_budget(kernel: str, block_bytes: int) -> None:
+    """Reject launches whose per-grid-step VMEM residency (with the
+    pipeline's double-buffering headroom) exceeds the core budget."""
+    budgeted = 2 * block_bytes  # input blocks are double-buffered
+    if budgeted > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"{kernel}: per-step block residency ~{budgeted} bytes "
+            f"exceeds the VMEM budget ({_VMEM_BUDGET_BYTES}); shrink "
+            "the bin count / tree width or use the XLA fallback")
+
 
 def _rows_per_step(n_feat: int) -> int:
     """Rows per grid step, chosen by feature width. Each step issues
@@ -78,6 +96,10 @@ def histogram_tpu(binned: jnp.ndarray, data: jnp.ndarray,
         binned = jnp.pad(binned, ((0, pad), (0, 0)))
         data = jnp.pad(data, ((0, pad), (0, 0)))
     grid = (binned.shape[0] // tn,)
+    # resident per step: binned [tn,F] i32 + data [3,tn] f32 + the full
+    # [F,3,Bp] f32 accumulator (bounded vs _VMEM_BUDGET_BYTES)
+    _check_vmem_budget(
+        "histogram_tpu", 4 * (tn * f + 3 * tn + f * 3 * bp))
 
     out = pl.pallas_call(
         functools.partial(_hist_kernel, n_feat=f, n_bins_padded=bp, tn=tn),
@@ -193,6 +215,10 @@ def predict_forest_tpu(x, feat, thr, left, right, value, k: int = 1,
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
     grid = (x.shape[0] // tn, t)
+    # resident per step: x [tn,F] f32 + five [1,m_pad] tree planes +
+    # out [tn,k] f32 (bounded vs _VMEM_BUDGET_BYTES)
+    _check_vmem_budget(
+        "predict_forest_tpu", 4 * (tn * f + 5 * m_pad + tn * k))
 
     kern = functools.partial(
         _traverse_kernel, tn=tn, m_pad=m_pad, n_feat=f, k=k,

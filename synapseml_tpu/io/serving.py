@@ -961,6 +961,9 @@ class WorkerServer:
                 RuntimeWarning, stacklevel=2)
         self.port = self._httpd.server_address[1]
         self._httpd.daemon_threads = True
+        # synlint: disable=RL001 - socketserver owns this loop's fault
+        # handling: per-request errors route to handle_error, and
+        # serve_forever only exits via stop()'s shutdown()+join
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name=f"serving-{name}",
             daemon=True)
@@ -1654,9 +1657,22 @@ class DistributedServer:
                     and self._probe_thread.is_alive()):
                 return
             self._probe_thread = threading.Thread(
-                target=self._probe_loop,
+                target=self._probe_loop_supervised,
                 name=f"breaker-probe-{self.server.name}", daemon=True)
             self._probe_thread.start()
+
+    def _probe_loop_supervised(self):
+        """:func:`_supervise_loop` around :meth:`_probe_loop`: a dead
+        probe thread would strand every OPEN channel quarantined
+        forever — the breaker re-admits channels through this loop."""
+        def on_restart(e: BaseException):
+            _tm.counter("serving_thread_restarts_total",
+                        server=self.server.name, thread="probe").inc()
+            _bb.record("thread_restart", level="error",
+                       server=self.server.name, thread="probe",
+                       error=repr(e)[:200])
+
+        _supervise_loop(self._probe_loop, self._stop, on_restart)
 
     def _probe_loop(self):
         """Half-open probe: every ``probe_interval`` seconds, each OPEN
@@ -2495,6 +2511,9 @@ class ContinuousServer:
     def start(self) -> "ContinuousServer":
         target = (self._pipelined_loop if self.pipelined
                   else lambda: self._supervised("scorer", self._loop))
+        # synlint: disable=RL001 - both branches of `target` run under
+        # supervision: _pipelined_loop spawns _pipeline_thread stages,
+        # the scorer lambda wraps _loop in _supervised
         self._thread = threading.Thread(
             target=target, name=f"serving-query-{self.name}", daemon=True)
         self._thread.start()

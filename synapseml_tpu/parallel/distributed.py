@@ -129,14 +129,22 @@ class DriverRendezvous:
                 msg = json.dumps({"roster": payload_base,
                                   "process_id": ranks[i]}) + "\n"
                 conn.sendall(msg.encode())
-        except BaseException as e:  # noqa: BLE001 - surfaced via wait()
+        except Exception as e:
+            self.error = e  # surfaced via wait()
+        except BaseException as e:
+            # record for wait(), then re-raise: an injected
+            # faults.ThreadKilled (or KeyboardInterrupt) must terminate
+            # the collector thread, not vanish into self.error
             self.error = e
+            raise
         finally:
             for conn, _ in conns:
                 conn.close()
             self._srv.close()
 
     def start(self) -> "DriverRendezvous":
+        # synlint: disable=RL001 - one-shot collector, not a serving
+        # loop: errors are recorded above and re-raised by wait()
         self._thread = threading.Thread(target=self.run, daemon=True)
         self._thread.start()
         return self

@@ -295,6 +295,8 @@ def trigger(reason: str, rid: Optional[str] = None,
                         "channel": channel, **fields}.items()
                        if v is not None}
     path, safe = _dump_target(reason)
+    # synlint: disable=RL001 - one-shot dump writer, not a loop; a
+    # failed dump must never take the serving process with it
     threading.Thread(target=_write_dump, args=(snap, path, safe, reason),
                      name="blackbox-dump", daemon=True).start()
     return path
@@ -322,6 +324,8 @@ def install_signal_trigger(signum: Optional[int] = None) -> bool:
             return False
 
     def _handler(*_):
+        # synlint: disable=RL001 - one-shot signal handoff (see the
+        # docstring): inline dumping could deadlock the main thread
         threading.Thread(target=trigger, args=("sigusr2",),
                          name="blackbox-sigusr2", daemon=True).start()
 

@@ -102,6 +102,9 @@ class _State:
         self.duty_vals: Dict[str, float] = {}
         self.duty_vals_ts = 0.0
         self.duty_registered: set = set()
+        # device keys whose hbm gauges are live — unregister_all() must
+        # tear down exactly the label sets ensure_registered() created
+        self.device_keys: set = set()
 
 
 _S = _State()
@@ -423,6 +426,8 @@ def ensure_registered(lazy: bool = False) -> bool:
         return False
     for d in devices:
         key = str(d.id)
+        with _LOCK:
+            _S.device_keys.add(key)
         _tm.gauge_fn("device_hbm_bytes_in_use",
                      lambda k=key: _mem_field(k, "bytes_in_use"),
                      device=key)
@@ -508,3 +513,30 @@ def register_duty_gauge(label: str):
     _tm.gauge_fn("executor_duty_cycle",
                  lambda l=label: duty_cycles().get(l, 0.0),
                  device=label)
+
+
+def unregister_all() -> None:
+    """Tear down every gauge_fn this module registered and reset the
+    registration latches, so a process that stops its executors (or a
+    test tearing down a fixture) leaves no live callbacks in the
+    telemetry registry — a leaked sampler pins this module's state and
+    keeps exporting values for devices the process no longer drives.
+    The next ensure_* call re-registers from scratch."""
+    with _LOCK:
+        device_keys = sorted(_S.device_keys)
+        duty_labels = sorted(_S.duty_registered)
+        _S.device_keys.clear()
+        _S.duty_registered.clear()
+        _S.registered = False
+        _S.process_registered = False
+    _tm.unregister("process_rss_bytes")
+    _tm.unregister("process_open_fds")
+    _tm.unregister("process_thread_count")
+    _tm.unregister("process_uptime_seconds")
+    for key in device_keys:
+        _tm.unregister("device_hbm_bytes_in_use", device=key)
+        _tm.unregister("device_hbm_bytes_limit", device=key)
+        _tm.unregister("device_hbm_peak_bytes", device=key)
+        _tm.unregister("device_live_buffer_count", device=key)
+    for label in duty_labels:
+        _tm.unregister("executor_duty_cycle", device=label)

@@ -115,6 +115,8 @@ def _ensure_writer():
         return
     with _WRITER_LOCK:
         if _WRITER is None or not _WRITER.is_alive():
+            # synlint: disable=RL001 - self-healing singleton: every
+            # enqueue re-checks is_alive() and respawns a dead writer
             _WRITER = threading.Thread(target=_writer_loop,
                                        name="structlog-writer",
                                        daemon=True)

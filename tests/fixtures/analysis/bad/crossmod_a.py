@@ -1,0 +1,25 @@
+"""Cross-module lock cycle, half A: LOCK_A -> (crossmod_b) LOCK_B.
+
+Neither file is flaggable alone — each function takes ONE lock and the
+second acquisition happens behind a call into the other module. Only
+the whole-program pass, resolving ``crossmod_b.publish`` through the
+import table and closing over its acquisitions, sees the inverse
+ordering against crossmod_b.rollup.
+"""
+import threading
+
+from tests.fixtures.analysis.bad import crossmod_b
+
+LOCK_A = threading.Lock()
+_TABLE = {}
+
+
+def refresh(key, value):
+    with LOCK_A:
+        _TABLE[key] = value
+        crossmod_b.publish(key)  # acquires LOCK_B while LOCK_A is held
+
+
+def snapshot():
+    with LOCK_A:
+        return dict(_TABLE)

@@ -1,0 +1,19 @@
+"""Cross-module lock cycle, half B: LOCK_B -> (crossmod_a) LOCK_A."""
+import threading
+
+from tests.fixtures.analysis.bad import crossmod_a
+
+LOCK_B = threading.Lock()
+_FEED = []
+
+
+def publish(key):
+    with LOCK_B:
+        _FEED.append(key)
+
+
+def rollup():
+    with LOCK_B:
+        # acquires LOCK_A while LOCK_B is held: the inverse of
+        # crossmod_a.refresh's ordering — a deadlock when both run
+        return crossmod_a.snapshot(), list(_FEED)

@@ -1,0 +1,8 @@
+"""EH001 bad: bare except swallows BaseException (faults.ThreadKilled)."""
+
+
+def drain(q):
+    try:
+        return q.get()
+    except:  # noqa: E722 - EH001: an injected kill vanishes here
+        return None

@@ -1,0 +1,8 @@
+"""EH002 bad: broad except with a silent body and no rationale."""
+
+
+def refresh(cache):
+    try:
+        cache.load()
+    except Exception:
+        pass

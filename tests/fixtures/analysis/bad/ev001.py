@@ -1,0 +1,6 @@
+"""EV001 bad: reads a knob docs/knobs.md has no row for."""
+import os
+
+
+def flag():
+    return os.environ.get("SYNAPSEML_NOT_IN_TABLE", "") == "1"

@@ -1,0 +1,6 @@
+"""EV003 bad: typo'd knob prefix reads the default forever."""
+import os
+
+
+def enabled():
+    return os.environ.get("SYNAPSML_TRACE", "") == "1"  # missing E

@@ -1,0 +1,14 @@
+"""PL001 bad: pallas_call launched with no VMEM-budget guard."""
+import jax
+
+
+def scale_rows(x):
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    return pl.pallas_call(  # PL001: nothing bounds the block bytes
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

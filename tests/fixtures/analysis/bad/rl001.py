@@ -1,0 +1,13 @@
+"""RL001 bad: long-lived thread started outside a supervision boundary."""
+import threading
+
+
+class Poller:
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,  # RL001
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self.tick()

@@ -1,0 +1,8 @@
+"""RL002 bad: instance-scope gauge_fn registration with no unregister."""
+from synapseml_tpu.runtime import telemetry as _tm
+
+
+class Server:
+    def start(self):
+        _tm.gauge_fn("queue_depth", lambda: self.depth())  # RL002
+        return self

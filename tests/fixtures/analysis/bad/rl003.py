@@ -1,0 +1,8 @@
+"""RL003 bad: tmp-file write never finalized by an atomic rename."""
+import json
+
+
+def save(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:  # RL003: no os.replace in this function
+        json.dump(payload, fh)

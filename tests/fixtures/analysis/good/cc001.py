@@ -9,9 +9,9 @@ class Pipeline:
         self.last_error = None       # synlint: shared
 
     def start(self):
-        threading.Thread(target=self._worker, daemon=True).start()
+        threading.Thread(target=self._worker_supervised, daemon=True).start()
 
-    def _worker(self):
+    def _worker_supervised(self):
         with self._lock:
             self.processed += 1
 

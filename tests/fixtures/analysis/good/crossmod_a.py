@@ -1,0 +1,22 @@
+"""Cross-module twins with a consistent lock order, half A.
+
+Same shape as the bad pair, but crossmod_b.rollup reads the snapshot
+BEFORE taking LOCK_B — every path orders LOCK_A before LOCK_B.
+"""
+import threading
+
+from tests.fixtures.analysis.good import crossmod_b
+
+LOCK_A = threading.Lock()
+_TABLE = {}
+
+
+def refresh(key, value):
+    with LOCK_A:
+        _TABLE[key] = value
+        crossmod_b.publish(key)
+
+
+def snapshot():
+    with LOCK_A:
+        return dict(_TABLE)

@@ -1,0 +1,18 @@
+"""Cross-module twins with a consistent lock order, half B."""
+import threading
+
+from tests.fixtures.analysis.good import crossmod_a
+
+LOCK_B = threading.Lock()
+_FEED = []
+
+
+def publish(key):
+    with LOCK_B:
+        _FEED.append(key)
+
+
+def rollup():
+    snap = crossmod_a.snapshot()  # LOCK_A taken and RELEASED first
+    with LOCK_B:
+        return snap, list(_FEED)

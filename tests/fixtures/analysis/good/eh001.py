@@ -1,0 +1,9 @@
+"""EH001 good: BaseException is recorded, then re-raised."""
+
+
+def drain(q, log):
+    try:
+        return q.get()
+    except BaseException as e:
+        log.record("drain_failed", error=repr(e))
+        raise

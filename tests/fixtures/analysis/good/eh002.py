@@ -1,0 +1,8 @@
+"""EH002 good: the silent swallow carries its rationale inline."""
+
+
+def refresh(cache):
+    try:
+        cache.load()
+    except Exception:  # noqa: BLE001 - refresh is best-effort
+        pass

@@ -1,0 +1,6 @@
+"""EV001 good: reads a knob the registry documents."""
+import os
+
+
+def flag():
+    return os.environ.get("SYNAPSEML_TELEMETRY", "") != "0"

@@ -1,0 +1,6 @@
+"""EV003 good: the documented spelling of the knob."""
+import os
+
+
+def enabled():
+    return os.environ.get("SYNAPSEML_TRACE", "") == "1"

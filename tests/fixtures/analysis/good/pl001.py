@@ -1,0 +1,19 @@
+"""PL001 good: the wrapper bounds its footprint against a VMEM budget."""
+import jax
+
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+
+def scale_rows(x):
+    from jax.experimental import pallas as pl
+
+    if 2 * x.size * x.dtype.itemsize > _VMEM_BUDGET_BYTES:
+        raise ValueError("block footprint exceeds the VMEM budget")
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

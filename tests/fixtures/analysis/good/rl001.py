@@ -1,0 +1,13 @@
+"""RL001 good: the thread body runs under a supervision wrapper."""
+import threading
+
+
+class Poller:
+    def _supervised(self):
+        while True:
+            self.tick()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._supervised,
+                                        daemon=True)
+        self._thread.start()

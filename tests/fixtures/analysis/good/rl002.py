@@ -1,0 +1,11 @@
+"""RL002 good: the registration has a matching unregister in-module."""
+from synapseml_tpu.runtime import telemetry as _tm
+
+
+class Server:
+    def start(self):
+        _tm.gauge_fn("queue_depth", lambda: self.depth())
+        return self
+
+    def stop(self):
+        _tm.unregister("queue_depth")

@@ -1,0 +1,10 @@
+"""RL003 good: tmp-then-replace — readers never see a torn write."""
+import json
+import os
+
+
+def save(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+    os.replace(tmp, path)

@@ -20,7 +20,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
 
 RULE_FIXTURES = ["jh001", "jh002", "jh003", "jh004", "jh005",
-                 "cc001", "cc002", "cc003"]
+                 "cc001", "cc002", "cc003",
+                 "rl001", "rl002", "rl003", "eh001", "eh002",
+                 "ev001", "ev003", "pl001"]
 
 
 def _cli(*args):
@@ -149,6 +151,253 @@ def test_unparseable_file_reports_syn000(tmp_path):
 def test_repo_is_clean_under_committed_baseline():
     res = _cli("synapseml_tpu", "tools", "bench.py", "--fail-on-new")
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_stale_baseline_entry_fails_fail_on_new(tmp_path):
+    """A baseline entry nothing produces anymore is rot: the gate must
+    demand --prune-baseline instead of silently carrying it."""
+    target = os.path.join("tests", "fixtures", "analysis", "bad",
+                          "jh001.py")
+    bl = tmp_path / "bl.json"
+    assert _cli(target, "--baseline", str(bl),
+                "--write-baseline").returncode == 0
+    payload = json.loads(bl.read_text())
+    payload["findings"].append({
+        "fingerprint": "00000000deadbeef", "rule": "JH001",
+        "path": target.replace(os.sep, "/"), "context": "gone",
+        "message": "rotted", "count": 1})
+    bl.write_text(json.dumps(payload))
+    res = _cli(target, "--baseline", str(bl), "--fail-on-new")
+    assert res.returncode == 1 and "stale baseline entry" in res.stderr
+
+
+def test_prune_baseline_drops_only_rot(tmp_path):
+    target = os.path.join("tests", "fixtures", "analysis", "bad",
+                          "cc002.py")
+    bl = tmp_path / "bl.json"
+    _cli(target, "--baseline", str(bl), "--write-baseline")
+    payload = json.loads(bl.read_text())
+    live = len(payload["findings"])
+    payload["findings"].append({
+        "fingerprint": "00000000deadbeef", "rule": "CC001",
+        "path": "synapseml_tpu/gone.py", "context": "gone",
+        "message": "rotted", "count": 1})
+    bl.write_text(json.dumps(payload))
+    res = _cli(target, "--baseline", str(bl), "--prune-baseline")
+    assert res.returncode == 0 and "pruned 1 stale" in res.stdout
+    kept = json.loads(bl.read_text())["findings"]
+    assert len(kept) == live
+    assert _cli(target, "--baseline", str(bl),
+                "--fail-on-new").returncode == 0
+
+
+# -- v2: whole-program analysis -----------------------------------------
+
+def test_crossmod_lock_cycle_needs_whole_program():
+    """The two-file lock-order cycle: each half is clean alone (one
+    lock per function; the second acquisition hides behind a call into
+    the other module) — only the cross-module pass flags it."""
+    a = os.path.join(FIXTURES, "bad", "crossmod_a.py")
+    b = os.path.join(FIXTURES, "bad", "crossmod_b.py")
+    assert [f.rule for f in _analyze(a)] == []
+    assert [f.rule for f in _analyze(b)] == []
+    both = analyze_paths([a, b], root=REPO)
+    assert "CC002" in {f.rule for f in both}, [f.render() for f in both]
+    rendered = " ".join(f.render() for f in both)
+    assert "crossmod_a:LOCK_A" in rendered and \
+        "crossmod_b:LOCK_B" in rendered
+
+
+def test_crossmod_good_twins_are_clean():
+    both = analyze_paths(
+        [os.path.join(FIXTURES, "good", "crossmod_a.py"),
+         os.path.join(FIXTURES, "good", "crossmod_b.py")], root=REPO)
+    assert both == [], [f.render() for f in both]
+
+
+def test_pl002_kernel_without_parity_test(tmp_path):
+    """PL002 is repo-relative (it walks tests/), so exercise it in a
+    scratch repo: an undocumented kernel trips, one named next to
+    'interpret' in a test file is clean."""
+    kern = ("_VMEM_BUDGET_BYTES = 1 << 24\n"
+            "def warp_rows(x):\n"
+            "    from jax.experimental import pallas as pl\n"
+            "    if x.size > _VMEM_BUDGET_BYTES:\n"
+            "        raise ValueError('budget')\n"
+            "    return pl.pallas_call(lambda i, o: None,\n"
+            "                          out_shape=None)(x)\n")
+    (tmp_path / "kernels.py").write_text(kern)
+    os.makedirs(tmp_path / "tests")
+    (tmp_path / "tests" / "test_k.py").write_text("")
+    findings = analyze_paths([str(tmp_path / "kernels.py")],
+                             root=str(tmp_path))
+    assert [f.rule for f in findings] == ["PL002"]
+    (tmp_path / "tests" / "test_k.py").write_text(
+        "def test_parity():\n"
+        "    assert warp_rows is not None  # interpret=True parity\n")
+    findings = analyze_paths([str(tmp_path / "kernels.py")],
+                             root=str(tmp_path))
+    assert findings == [], [f.render() for f in findings]
+
+
+# -- v2: suppression attachment -----------------------------------------
+
+def test_suppression_on_decorated_def(tmp_path):
+    """A directive on the decorator line must cover findings anchored
+    at the ``def`` line — decorators and def are ONE statement. PL002
+    anchors at the def line, so a decorated kernel is the regression:
+    v1 attached the directive to the decorator line only and the
+    suppression silently failed."""
+    kern = ("import functools\n"
+            "_VMEM_BUDGET_BYTES = 1 << 24\n"
+            "@functools.lru_cache()  # synlint: disable=PL002\n"
+            "def warp_rows(x):\n"
+            "    from jax.experimental import pallas as pl\n"
+            "    assert x.size < _VMEM_BUDGET_BYTES\n"
+            "    return pl.pallas_call(lambda i, o: None,\n"
+            "                          out_shape=None)(x)\n")
+    p = tmp_path / "kernels.py"
+    p.write_text(kern)
+    os.makedirs(tmp_path / "tests")
+    (tmp_path / "tests" / "test_k.py").write_text("")
+    assert analyze_paths([str(p)], root=str(tmp_path)) == []
+    # same module without the directive proves the rule does fire
+    p.write_text(kern.replace("  # synlint: disable=PL002", ""))
+    assert [f.rule for f in analyze_paths([str(p)],
+                                          root=str(tmp_path))] == ["PL002"]
+
+
+def test_suppression_comment_block(tmp_path):
+    """A directive opening a multi-line comment block attaches through
+    the block to the first code line below it."""
+    src = ("def _dispatch(self, out):\n"
+           "    # synlint: disable=JH001 - deliberate sync point,\n"
+           "    # rationale continues on a second comment line\n"
+           "    return out.block_until_ready()\n")
+    p = tmp_path / "block.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)], root=str(tmp_path)) == []
+
+
+# -- v2: result cache ---------------------------------------------------
+
+def test_cache_second_run_hits(tmp_path):
+    target = os.path.join("tests", "fixtures", "analysis", "bad",
+                          "jh001.py")
+    cache = tmp_path / "cache.json"
+    cold = json.loads(_cli(target, "--no-baseline", "--cache",
+                           str(cache), "--json").stdout)
+    warm = json.loads(_cli(target, "--no-baseline", "--cache",
+                           str(cache), "--json").stdout)
+    assert cold["cache"]["cache_hits"] == 0
+    assert warm["cache"]["cache_hits"] == warm["cache"]["files"] > 0
+    assert cold["findings_total"] == warm["findings_total"] > 0
+    # cached and fresh runs must render identical findings
+    assert cold["findings"] == warm["findings"]
+
+
+def test_cache_invalidated_by_content_change(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text("def _dispatch(self, out):\n"
+                   "    out.block_until_ready()\n")
+    cache = tmp_path / "cache.json"
+    from tools.analysis.cache import ResultCache
+    from tools.analysis.engine import analyze_program
+
+    _f1, _p, s1 = analyze_program([str(src)], root=str(tmp_path),
+                                  cache=ResultCache(str(cache)))
+    src.write_text("def fetch(self, out):\n"
+                   "    return out\n")
+    c2 = ResultCache(str(cache))
+    f2, _p, s2 = analyze_program([str(src)], root=str(tmp_path),
+                                 cache=c2)
+    assert s1["cache_misses"] == 1 and s2["cache_misses"] == 1
+    assert f2 == []
+
+
+# -- v2: --changed-only -------------------------------------------------
+
+def test_changed_only_reports_only_diffed_files(tmp_path):
+    bad = ("def _dispatch(self, out):\n"
+           "    out.block_until_ready()\n")
+    (tmp_path / "a.py").write_text(bad)
+    (tmp_path / "b.py").write_text(bad)
+
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c",
+                        "user.name=t", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (tmp_path / "b.py").write_text(bad + "\n# touched\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "a.py", "b.py",
+         "--no-baseline", "--changed-only", "--json"],
+        capture_output=True, text=True, cwd=tmp_path, env=env)
+    payload = json.loads(res.stdout)
+    assert payload["findings_total"] == 2  # both analyzed...
+    assert {f["path"] for f in payload["findings"]} == {"b.py"}  # one shown
+
+
+# -- v2: SARIF ----------------------------------------------------------
+
+def test_sarif_output(tmp_path):
+    target = os.path.join("tests", "fixtures", "analysis", "bad",
+                          "cc003.py")
+    out = tmp_path / "synlint.sarif"
+    res = _cli(target, "--no-baseline", "--sarif", str(out))
+    assert res.returncode == 1
+    sarif = json.loads(out.read_text())
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert results and all(r["ruleId"].startswith("CC")
+                           for r in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("cc003.py")
+    assert results[0]["partialFingerprints"]["synlint/v1"]
+    rule_ids = {r["id"] for r in
+                sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert {r["ruleId"] for r in results} <= rule_ids
+
+
+# -- v2: knob table -----------------------------------------------------
+
+def test_knob_table_preserves_descriptions(tmp_path):
+    from tools.analysis.engine import analyze_program
+    from tools.analysis.rules_env import render_knob_table
+
+    src = tmp_path / "knobby.py"
+    src.write_text("import os\n"
+                   "X = os.environ.get('SYNAPSEML_FIXTURE_KNOB', '1')\n")
+    _f, prog, _s = analyze_program([str(src)], root=str(tmp_path))
+    first = render_knob_table(prog)
+    assert "SYNAPSEML_FIXTURE_KNOB" in first and "'1'" in first
+    edited = first.replace(
+        "| `synapseml_tpu", "| `synapseml_tpu")  # no-op, keep layout
+    edited = "\n".join(
+        line.rstrip()[:-1] + "hand-written words |"
+        if "SYNAPSEML_FIXTURE_KNOB" in line else line
+        for line in edited.splitlines())
+    again = render_knob_table(prog, existing_text=edited)
+    assert "hand-written words" in again
+
+
+def test_repo_knob_table_is_current():
+    """docs/knobs.md must match what --write-knob-table would emit —
+    the EV-pack side of the one drift gate."""
+    from tools.analysis.engine import analyze_program
+    from tools.analysis.rules_env import render_knob_table
+
+    doc = os.path.join(REPO, "docs", "knobs.md")
+    with open(doc, encoding="utf-8") as fh:
+        committed = fh.read()
+    _f, prog, _s = analyze_program(
+        [os.path.join(REPO, p) for p in
+         ("synapseml_tpu", "tools", "bench.py")], root=REPO)
+    assert render_knob_table(prog, existing_text=committed) == committed
 
 
 def test_executor_serving_fixed_violations_not_baselined():

@@ -1,25 +1,53 @@
-"""CLI: ``python -m tools.analysis [paths] [--baseline F] [--fail-on-new]``.
+"""CLI: ``python -m tools.analysis [paths] [--fail-on-new] [...]``.
 
 Exit codes: 0 = clean (or every finding baselined under ``--fail-on-new``),
-1 = findings (new findings under ``--fail-on-new``), 2 = usage error.
+1 = findings (new findings — or stale baseline entries — under
+``--fail-on-new``), 2 = usage error.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
+from typing import List, Optional, Set
 
-from tools.analysis.engine import analyze_paths
+from tools.analysis.cache import ResultCache, default_cache_path
+from tools.analysis.engine import analyze_program, pack_of
 from tools.analysis.findings import (default_baseline_path, load_baseline,
-                                     split_new, write_baseline)
+                                     load_baseline_entries, prune_baseline,
+                                     split_new, stale_entries,
+                                     write_baseline)
+from tools.analysis.rules_env import KNOB_DOC, render_knob_table
+from tools.analysis.sarif import write_sarif
+
+
+def _changed_files(base: Optional[str]) -> Optional[Set[str]]:
+    """Repo-relative paths changed vs ``base`` (plus untracked files),
+    or None when git can't tell — an unknown diff must degrade to a
+    full report, never to silence."""
+    cmds = [["git", "diff", "--name-only", base or "HEAD", "--"],
+            ["git", "ls-files", "--others", "--exclude-standard"]]
+    changed: Set[str] = set()
+    for cmd in cmds:
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30, check=True).stdout
+        except (OSError, subprocess.SubprocessError):
+            return None
+        changed.update(line.strip() for line in out.splitlines()
+                       if line.strip())
+    return changed
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.analysis",
-        description="synlint: JAX-hygiene + concurrency static analysis "
+        description="synlint: whole-program static analysis — JAX "
+                    "hygiene, lock discipline, resource lifecycle, "
+                    "error handling, env knobs, Pallas, and doc drift "
                     "(rule catalog: docs/analysis.md)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to analyze "
@@ -29,16 +57,35 @@ def main(argv=None) -> int:
                          "(default: tools/analysis/baseline.json when it "
                          "exists)")
     ap.add_argument("--fail-on-new", action="store_true",
-                    help="exit 1 only for findings NOT in the baseline "
-                         "(this is already the behavior whenever a "
-                         "baseline is found; the flag documents intent "
-                         "in CI invocations)")
+                    help="exit 1 only for findings NOT in the baseline, "
+                         "and for stale baseline entries")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore any baseline: report and fail on every "
                          "finding")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write all current findings to the baseline file "
                          "and exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries whose file/scope no "
+                         "longer produces the finding, then exit 0")
+    ap.add_argument("--cache", nargs="?", const=default_cache_path(),
+                    default=None, metavar="FILE",
+                    help="content-hash result cache (default location "
+                         "when the flag is given bare: "
+                         "./.synlint-cache.json)")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="GITREF",
+                    help="report only findings in files changed vs "
+                         "GITREF (default HEAD); the whole repo is "
+                         "still analyzed so cross-module rules stay "
+                         "sound")
+    ap.add_argument("--sarif", default=None, metavar="FILE",
+                    help="also write findings (post-baseline) as SARIF "
+                         "2.1.0 for CI annotations")
+    ap.add_argument("--write-knob-table", action="store_true",
+                    help=f"regenerate {KNOB_DOC} from the analyzed env "
+                         "reads (Description column preserved) and "
+                         "exit 0")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as one JSON object on stdout")
     args = ap.parse_args(argv)
@@ -50,9 +97,23 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    cache = ResultCache(args.cache) if args.cache else None
     t0 = time.monotonic()
-    findings = analyze_paths(paths)
+    findings, prog, stats = analyze_program(paths, cache=cache)
     runtime_s = time.monotonic() - t0
+    if cache is not None:
+        cache.save()
+
+    if args.write_knob_table:
+        doc_path = os.path.join(prog.root, KNOB_DOC)
+        existing = ""
+        if os.path.exists(doc_path):
+            with open(doc_path, encoding="utf-8") as fh:
+                existing = fh.read()
+        with open(doc_path, "w", encoding="utf-8") as fh:
+            fh.write(render_knob_table(prog, existing))
+        print(f"synlint: wrote {KNOB_DOC}")
+        return 0
 
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
@@ -60,13 +121,29 @@ def main(argv=None) -> int:
         print(f"synlint: wrote {len(findings)} findings to "
               f"{baseline_path}")
         return 0
+    if args.prune_baseline:
+        if not os.path.exists(baseline_path):
+            print(f"synlint: baseline {baseline_path} not found",
+                  file=sys.stderr)
+            return 2
+        dropped = prune_baseline(baseline_path, findings,
+                                 prog.summaries, prog.root)
+        for entry in dropped:
+            print(f"pruned: {entry['rule']} {entry['path']} "
+                  f"[{entry['context']}]")
+        print(f"synlint: pruned {len(dropped)} stale baseline "
+              f"entr{'y' if len(dropped) == 1 else 'ies'}")
+        return 0
 
     baseline = None
+    stale: List[dict] = []
     if args.no_baseline:
         pass
     elif os.path.exists(baseline_path):
         try:
             baseline = load_baseline(baseline_path)
+            stale = stale_entries(load_baseline_entries(baseline_path),
+                                  findings, prog.summaries, prog.root)
         except (json.JSONDecodeError, KeyError, OSError) as e:
             print(f"synlint: baseline {baseline_path} unreadable: {e}",
                   file=sys.stderr)
@@ -81,22 +158,46 @@ def main(argv=None) -> int:
     else:
         new, matched = findings, 0
 
+    if args.changed_only is not None:
+        changed = _changed_files(args.changed_only)
+        if changed is not None:
+            new = [f for f in new if f.path in changed]
+
+    if args.sarif:
+        write_sarif(args.sarif, new)
+
+    packs: dict = {}
+    for f in findings:
+        packs[pack_of(f.rule)] = packs.get(pack_of(f.rule), 0) + 1
+
     if args.as_json:
         print(json.dumps({
             "findings_total": len(findings),
             "findings_new": len(new),
             "baselined": matched,
+            "stale_baseline": len(stale),
+            "packs": packs,
+            "cache": stats,
             "runtime_s": round(runtime_s, 3),
-            "findings": [f.to_json() | {"line": f.line} for f in new],
+            "findings": [f.to_json() for f in new],
         }))
     else:
         for f in new:
             print(f.render())
-        tail = (f"synlint: {len(findings)} finding(s), {matched} "
-                f"baselined, {len(new)} new "
-                f"({runtime_s:.2f}s)")
-        print(tail, file=sys.stderr)
-    return 1 if new else 0
+        for entry in stale:
+            print(f"stale baseline entry: {entry['rule']} "
+                  f"{entry['path']} [{entry['context']}] — run "
+                  "--prune-baseline", file=sys.stderr)
+        cache_note = (f", cache {stats['cache_hits']}/{stats['files']} "
+                      "hits" if args.cache else "")
+        print(f"synlint: {len(findings)} finding(s), {matched} "
+              f"baselined, {len(new)} new{cache_note} "
+              f"({runtime_s:.2f}s)", file=sys.stderr)
+    if new:
+        return 1
+    if stale and args.fail_on_new:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Content-hash result cache for the analyzer.
+
+One JSON file maps relpath -> {source hash, module summary, local
+findings}. A hit skips parsing and every local rule for that file; the
+summary still joins the whole-program pass, so cross-module rules run
+over the full repo every time (they are cheap — the expensive part is
+the per-file AST work).
+
+The cache version is a hash of the analyzer's own sources: editing any
+rule invalidates every entry, so a stale cache can never mask a new
+rule's findings. Writes are tmp-then-``os.replace`` (the discipline
+RL003 enforces elsewhere).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from tools.analysis.findings import Finding, from_json
+
+CACHE_FORMAT = 1
+
+
+def default_cache_path() -> str:
+    return os.path.join(os.getcwd(), ".synlint-cache.json")
+
+
+def analyzer_version() -> str:
+    """Hash of every tools/analysis/*.py source, so rule edits
+    invalidate the cache."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha1(str(CACHE_FORMAT).encode())
+    for name in sorted(os.listdir(here)):
+        if name.endswith(".py"):
+            with open(os.path.join(here, name), "rb") as fh:
+                h.update(name.encode())
+                h.update(fh.read())
+    return h.hexdigest()[:16]
+
+
+def _source_hash(source: str) -> str:
+    return hashlib.sha1(source.encode()).hexdigest()[:16]
+
+
+class ResultCache:
+    def __init__(self, path: str, version: Optional[str] = None):
+        self.path = path
+        self.version = version or analyzer_version()
+        self.entries: Dict[str, Dict] = {}
+        self.dirty = False
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+            if data.get("version") == self.version:
+                self.entries = data.get("entries", {})
+        except (OSError, ValueError):
+            pass  # absent/corrupt cache = cold cache
+
+    def lookup(self, rel: str, source: str
+               ) -> Optional[Tuple[Dict, List[Finding]]]:
+        entry = self.entries.get(rel)
+        if entry is None or entry.get("hash") != _source_hash(source):
+            return None
+        return entry["summary"], [from_json(d)
+                                  for d in entry["findings"]]
+
+    def store(self, rel: str, source: str, summary: Dict,
+              findings: List[Finding]) -> None:
+        self.entries[rel] = {"hash": _source_hash(source),
+                             "summary": summary,
+                             "findings": [f.to_json() for f in findings]}
+        self.dirty = True
+
+    def save(self) -> None:
+        if not self.dirty:
+            return
+        payload = {"version": self.version, "entries": self.entries}
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise

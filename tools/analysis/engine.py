@@ -1,9 +1,19 @@
-"""Analysis driver: file walking, directive parsing, suppression.
+"""Analysis driver: file walking, directive parsing, suppression, and
+the whole-program pass.
 
-One :class:`ModuleContext` per file carries everything a rule needs —
-the AST, raw source lines, the ``# synlint:`` directive map, and a
-node→enclosing-qualname map — so rules stay pure functions from context
-to findings.
+v1 ran each rule file-by-file; v2 splits every rule pack into a *local*
+pass (per-file findings, pure function of one :class:`ModuleContext`)
+and an optional *global* pass over a :class:`Program` of serializable
+per-module summaries. The summaries are what the content-hash result
+cache stores (tools/analysis/cache.py) — an unchanged file contributes
+its cached summary to the cross-module analysis without being re-parsed,
+so CC001–CC003 can see through helper functions and cross-module lock
+acquisitions while the CI job stays fast as the repo grows.
+
+One :class:`ModuleContext` per file carries everything a local rule
+needs — the AST, raw source lines, the ``# synlint:`` directive map, and
+a node→enclosing-qualname map — so rules stay pure functions from
+context to findings.
 """
 from __future__ import annotations
 
@@ -12,7 +22,7 @@ import io
 import os
 import re
 import tokenize
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from tools.analysis.findings import Finding
 
@@ -21,6 +31,15 @@ _DIRECTIVE_RE = re.compile(
     re.IGNORECASE)
 
 ALL_RULES = "ALL"
+
+# rule-id prefix -> pack name (what bench.py and --json report per pack)
+RULE_PACKS = {"JH": "jax", "CC": "concurrency", "RL": "lifecycle",
+              "EH": "errors", "EV": "env", "PL": "pallas", "DR": "drift",
+              "SYN": "engine"}
+
+
+def pack_of(rule: str) -> str:
+    return RULE_PACKS.get(rule.rstrip("0123456789"), "other")
 
 
 def _comment_lines(source: str) -> Dict[int, str]:
@@ -59,34 +78,83 @@ class Directives:
                 elif word == "hotpath":
                     self.hotpath.add(i)
 
-    def suppressed(self, line: int, rule: str,
-                   lines: Sequence[str]) -> bool:
-        """A finding is suppressed by a directive on its own line, or on
-        a bare comment line directly above it."""
-        for cand in (line, line - 1):
-            ids = self.disable.get(cand)
-            if not ids:
-                continue
-            if cand == line - 1 and not lines[cand - 1].lstrip().startswith("#"):
-                continue  # code line above: its directive is its own
-            if ALL_RULES in ids or rule in ids:
-                return True
-        return False
+
+def build_suppress_map(directives: Directives, lines: Sequence[str],
+                       tree: ast.AST) -> Dict[int, Set[str]]:
+    """Resolve directives to the exact lines they suppress.
+
+    A directive suppresses its own line; a directive on a *bare comment*
+    line suppresses the line below. A decorated ``def``/``class`` is one
+    statement spread over several lines, so a suppression landing
+    anywhere in the decorator span (including the classic "bare comment
+    above the first decorator") covers the whole span *and* the ``def``
+    line — the v1 bug was anchoring only to the decorator line, which
+    silently failed to suppress findings reported at the ``def``.
+    """
+    sup: Dict[int, Set[str]] = {}
+
+    def bare_comment(ln: int) -> bool:
+        return 1 <= ln <= len(lines) and \
+            lines[ln - 1].lstrip().startswith("#")
+
+    for line, ids in directives.disable.items():
+        sup.setdefault(line, set()).update(ids)
+        if bare_comment(line):
+            # a directive opening a comment BLOCK (rationale may take
+            # several lines) covers through the first code line below
+            ln = line
+            while bare_comment(ln) and ln <= len(lines):
+                ln += 1
+                sup.setdefault(ln, set()).update(ids)
+    for node in ast.walk(tree):
+        decs = getattr(node, "decorator_list", None)
+        if not decs:
+            continue
+        first = min(d.lineno for d in decs)
+        span = range(first, node.lineno + 1)
+        ids = set()
+        for ln in span:
+            ids |= sup.get(ln, set())
+        if ids:
+            for ln in span:
+                sup.setdefault(ln, set()).update(ids)
+    return sup
+
+
+def suppressed_in(sup: Dict[int, Set[str]], line: int, rule: str) -> bool:
+    ids = sup.get(line)
+    return bool(ids) and (ALL_RULES in ids or rule in ids)
+
+
+def module_name_for(relpath: str) -> str:
+    """Dotted module path for a repo-relative file path."""
+    mod = relpath.replace(os.sep, "/")
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
 
 
 class ModuleContext:
     def __init__(self, path: str, relpath: str, source: str):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
+        self.module = module_name_for(self.relpath)
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self.directives = Directives(source)
+        self.suppress = build_suppress_map(self.directives, self.lines,
+                                           self.tree)
         # flat node list: rules iterate this instead of re-walking the
         # tree (ast.walk per rule made the whole run O(rules * nodes))
         self.nodes = list(ast.walk(self.tree))
         self.qualnames: Dict[ast.AST, str] = {}
         self._map_qualnames(self.tree, "")
+        self.imports: Dict[str, str] = {}        # alias -> dotted module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, orig)
+        self._map_imports()
 
     def _map_qualnames(self, node: ast.AST, prefix: str):
         for child in ast.iter_child_nodes(node):
@@ -98,11 +166,30 @@ class ModuleContext:
             else:
                 self._map_qualnames(child, prefix)
 
+    def _map_imports(self):
+        for node in self.nodes:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    self.from_imports[alias.asname or alias.name] = (
+                        node.module, alias.name)
+                    # `from pkg import submodule` also binds a module
+                    self.imports.setdefault(
+                        alias.asname or alias.name,
+                        f"{node.module}.{alias.name}")
+
     def context_for(self, node: ast.AST) -> str:
         """Qualname of the innermost def/class whose span contains the
         node (line-range containment — cheap and good enough)."""
+        return self.context_for_line(getattr(node, "lineno", 0))
+
+    def context_for_line(self, target: int) -> str:
         best, best_span = "<module>", None
-        target = getattr(node, "lineno", 0)
         for scope, qn in self.qualnames.items():
             lo = scope.lineno
             hi = getattr(scope, "end_lineno", lo)
@@ -172,32 +259,188 @@ def iter_py_files(paths: Iterable[str]) -> List[str]:
     return out
 
 
-def analyze_paths(paths: Sequence[str],
-                  root: Optional[str] = None) -> List[Finding]:
-    """Run every rule over every ``.py`` under ``paths``; suppressed
-    findings are already filtered. Unparseable files yield a single
-    SYN000 finding instead of crashing the run."""
-    from tools.analysis import rules_concurrency, rules_jax
+# -- whole-program view ----------------------------------------------------
 
+class Program:
+    """Every analyzed module's summary plus name-resolution helpers.
+
+    Summaries are plain JSON-able dicts (cache-persistable). Resolution
+    is import-based: ``mod.fn()`` resolves through the caller's import
+    table, bare ``fn()`` through its from-imports, then same-module
+    functions — deliberately NOT bare-name matching across the whole
+    repo, which would drown the cross-module rules in aliasing noise.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self.summaries: Dict[str, Dict[str, Any]] = {}  # relpath -> summary
+        self._by_module: Dict[str, str] = {}            # dotted -> relpath
+        self._by_stem: Dict[str, List[str]] = {}        # basename -> relpaths
+
+    def add(self, summary: Dict[str, Any]):
+        rel = summary["path"]
+        self.summaries[rel] = summary
+        mod = summary.get("module") or module_name_for(rel)
+        self._by_module[mod] = rel
+        stem = mod.rsplit(".", 1)[-1]
+        self._by_stem.setdefault(stem, []).append(rel)
+
+    def module_path(self, dotted: str) -> Optional[str]:
+        """relpath of an analyzed module named ``dotted`` (exact dotted
+        match, then suffix match, then bare-stem match)."""
+        if dotted in self._by_module:
+            return self._by_module[dotted]
+        tail = "." + dotted
+        hits = [rel for mod, rel in self._by_module.items()
+                if mod.endswith(tail)]
+        if len(hits) == 1:
+            return hits[0]
+        stems = self._by_stem.get(dotted.rsplit(".", 1)[-1], [])
+        return stems[0] if len(stems) == 1 else None
+
+    def functions(self, rel: str) -> List[Dict[str, Any]]:
+        return self.summaries.get(rel, {}).get("concurrency", {}).get(
+            "functions", [])
+
+    def resolve_call(self, summary: Dict[str, Any], callee: str
+                     ) -> List[Tuple[str, Dict[str, Any]]]:
+        """Resolve a recorded callee (``"name"`` or ``"alias.name"``)
+        to [(relpath, function-record)] candidates."""
+        rel = summary["path"]
+        out: List[Tuple[str, Dict[str, Any]]] = []
+        if "." in callee:
+            alias, name = callee.split(".", 1)
+            if alias in ("self", "cls"):
+                # same-module method (class identity approximated)
+                out.extend((rel, fn) for fn in self.functions(rel)
+                           if fn["name"] == name)
+                return out
+            mod = summary.get("imports", {}).get(alias)
+            target = self.module_path(mod) if mod else None
+            if target:
+                out.extend((target, fn) for fn in self.functions(target)
+                           if fn["name"] == name)
+            return out
+        # bare name: from-import first, then same module
+        fi = summary.get("from_imports", {}).get(callee)
+        if fi:
+            target = self.module_path(fi[0])
+            if target:
+                out.extend((target, fn) for fn in self.functions(target)
+                           if fn["name"] == fi[1])
+                if out:
+                    return out
+        out.extend((rel, fn) for fn in self.functions(rel)
+                   if fn["name"] == callee)
+        return out
+
+    def suppressed(self, path: str, line: int, rule: str) -> bool:
+        sup = self.summaries.get(path, {}).get("suppress", {})
+        ids = sup.get(str(line)) or sup.get(line)
+        return bool(ids) and (ALL_RULES in ids or rule in ids)
+
+    def covers(self, prefix: str) -> bool:
+        """True when any analyzed file sits under ``prefix`` — repo-wide
+        drift rules only make sense when the package was analyzed."""
+        return any(rel.startswith(prefix) for rel in self.summaries)
+
+
+def _packs():
+    from tools.analysis import (rules_concurrency, rules_drift,
+                                rules_errors, rules_env, rules_jax,
+                                rules_lifecycle, rules_pallas)
+
+    return (rules_jax, rules_concurrency, rules_lifecycle, rules_errors,
+            rules_env, rules_pallas, rules_drift)
+
+
+def summarize_module(ctx: ModuleContext) -> Dict[str, Any]:
+    summary: Dict[str, Any] = {
+        "path": ctx.relpath,
+        "module": ctx.module,
+        "suppress": {str(k): sorted(v) for k, v in ctx.suppress.items()},
+        "scopes": sorted(set(ctx.qualnames.values())),
+        "imports": dict(ctx.imports),
+        "from_imports": {k: list(v) for k, v in ctx.from_imports.items()},
+    }
+    for pack in _packs():
+        fn = getattr(pack, "summarize", None)
+        if fn is not None:
+            summary[pack.PACK] = fn(ctx)
+    return summary
+
+
+def run_local_rules(ctx: ModuleContext) -> List[Finding]:
+    raw: List[Finding] = []
+    for pack in _packs():
+        fn = getattr(pack, "run_local", None)
+        if fn is not None:
+            raw.extend(fn(ctx))
+    raw.sort(key=lambda f: (f.line, f.col, f.rule))
+    return [f for f in raw
+            if not suppressed_in(ctx.suppress, f.line, f.rule)]
+
+
+def analyze_program(paths: Sequence[str], root: Optional[str] = None,
+                    cache=None) -> Tuple[List[Finding], Program,
+                                         Dict[str, int]]:
+    """Run local rules per file (cache-served when the content hash
+    matches) then global rules over the assembled Program. Returns
+    (findings, program, stats). Unparseable files yield a single SYN000
+    finding instead of crashing the run."""
     root = root or os.getcwd()
+    prog = Program(root)
     findings: List[Finding] = []
+    stats = {"files": 0, "cache_hits": 0, "cache_misses": 0}
     for fpath in iter_py_files(paths):
-        rel = os.path.relpath(os.path.abspath(fpath), root)
+        rel = os.path.relpath(os.path.abspath(fpath),
+                              root).replace(os.sep, "/")
+        stats["files"] += 1
         try:
             with open(fpath, "r", encoding="utf-8") as fh:
                 source = fh.read()
-            ctx = ModuleContext(fpath, rel, source)
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        except (UnicodeDecodeError, OSError) as e:
             findings.append(Finding(
-                rule="SYN000", path=rel.replace(os.sep, "/"), line=1,
-                col=0, context="<module>",
+                rule="SYN000", path=rel, line=1, col=0,
+                context="<module>",
                 message=f"unparseable file: {e.__class__.__name__}"))
+            prog.add({"path": rel, "suppress": {}, "scopes": []})
             continue
-        raw: List[Finding] = []
-        raw.extend(rules_jax.run(ctx))
-        raw.extend(rules_concurrency.run(ctx))
-        raw.sort(key=lambda f: (f.line, f.col, f.rule))
+        entry = cache.lookup(rel, source) if cache is not None else None
+        if entry is not None:
+            stats["cache_hits"] += 1
+            summary, local = entry
+        else:
+            stats["cache_misses"] += 1
+            try:
+                ctx = ModuleContext(fpath, rel, source)
+            except SyntaxError as e:
+                local = [Finding(
+                    rule="SYN000", path=rel, line=1, col=0,
+                    context="<module>",
+                    message=f"unparseable file: {e.__class__.__name__}")]
+                summary = {"path": rel, "module": module_name_for(rel),
+                           "suppress": {}, "scopes": []}
+            else:
+                local = run_local_rules(ctx)
+                summary = summarize_module(ctx)
+            if cache is not None:
+                cache.store(rel, source, summary, local)
+        prog.add(summary)
+        findings.extend(local)
+    for pack in _packs():
+        fn = getattr(pack, "run_global", None)
+        if fn is None:
+            continue
         findings.extend(
-            f for f in raw
-            if not ctx.directives.suppressed(f.line, f.rule, ctx.lines))
+            f for f in fn(prog)
+            if not prog.suppressed(f.path, f.line, f.rule))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, prog, stats
+
+
+def analyze_paths(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[Finding]:
+    """v1-compatible entry point: findings only, no cache."""
+    findings, _prog, _stats = analyze_program(paths, root=root)
     return findings

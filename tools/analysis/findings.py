@@ -36,8 +36,15 @@ class Finding:
 
     def to_json(self) -> Dict:
         return {"fingerprint": self.fingerprint(), "rule": self.rule,
-                "path": self.path, "context": self.context,
-                "message": self.message}
+                "path": self.path, "line": self.line, "col": self.col,
+                "context": self.context, "message": self.message}
+
+
+def from_json(data: Dict) -> Finding:
+    """Inverse of :meth:`Finding.to_json` (cache deserialization)."""
+    return Finding(rule=data["rule"], path=data["path"],
+                   line=data.get("line", 0), col=data.get("col", 0),
+                   context=data["context"], message=data["message"])
 
 
 BASELINE_VERSION = 1
@@ -80,6 +87,58 @@ def write_baseline(path: str, findings: Iterable[Finding]) -> None:
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(payload, fh, indent=1, sort_keys=False)
         fh.write("\n")
+
+
+def load_baseline_entries(path: str) -> List[Dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh).get("findings", [])
+
+
+def stale_entries(entries: List[Dict], findings: Iterable[Finding],
+                  analyzed: Iterable[str], root: str) -> List[Dict]:
+    """Baseline entries that no longer match anything: zero current
+    occurrences of the fingerprint AND we can actually tell (the entry's
+    file was analyzed this run, or no longer exists at all) — a subset
+    run must not condemn entries it never looked at."""
+    current = Counter(f.fingerprint() for f in findings)
+    analyzed_set = set(analyzed)
+    out = []
+    for entry in entries:
+        if current.get(entry["fingerprint"], 0) > 0:
+            continue
+        path = entry.get("path", "")
+        if path in analyzed_set or \
+                not os.path.exists(os.path.join(root, path)):
+            out.append(entry)
+    return out
+
+
+def prune_baseline(path: str, findings: List[Finding],
+                   analyzed: Iterable[str], root: str) -> List[Dict]:
+    """Drop stale entries and cap surviving counts at the current
+    occurrence count. Returns the dropped entries."""
+    entries = load_baseline_entries(path)
+    dropped = stale_entries(entries, findings, analyzed, root)
+    dead = {e["fingerprint"] for e in dropped}
+    current = Counter(f.fingerprint() for f in findings)
+    kept = []
+    for entry in entries:
+        fp = entry["fingerprint"]
+        if fp in dead:
+            continue
+        if current.get(fp, 0) and entry.get("count", 1) > current[fp]:
+            entry = dict(entry, count=current[fp])
+        kept.append(entry)
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": "intentionally-kept synlint findings; regenerate with "
+                   "python -m tools.analysis <paths> --write-baseline",
+        "findings": kept,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return dropped
 
 
 def split_new(findings: List[Finding],

@@ -7,9 +7,9 @@ CC003  blocking call while holding a lock
 Model (heuristic, lexical — documented in docs/analysis.md):
 
 - *Thread entries* are functions referenced as ``threading.Thread(
-  target=...)``. Anything reachable from an entry through same-module
-  calls (matched by bare/attribute name — over-approximate on purpose)
-  runs off the creating thread.
+  target=...)`` in ANY analyzed module. Anything reachable from an
+  entry through the repo-wide name-based call graph runs off the
+  creating thread (over-approximate on purpose).
 - A write is *guarded* when it sits lexically inside a ``with <lock>:``
   block; lock-ness is detected from ``threading.Lock()``/``RLock()``
   assignments plus a name heuristic ("lock" in the identifier).
@@ -20,15 +20,25 @@ Model (heuristic, lexical — documented in docs/analysis.md):
   handoffs, fields mutated through a non-``self`` receiver).
 - Fields holding intrinsically thread-safe objects (``queue.Queue``,
   ``threading.Event``/``Semaphore``/locks) are exempt.
+
+v2 adds the whole-program passes: lock identities are module/class
+qualified, acquisition-order edges are unioned across modules, and a
+call made *while holding a lock* is resolved through the caller's
+import table so a lock taken in module A and re-acquired (or blocked
+on) inside a helper in module B produces the CC002/CC003 finding that
+single-file analysis provably cannot see.
 """
 from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
-from tools.analysis.engine import ModuleContext, expr_name, expr_text
+from tools.analysis.engine import (ModuleContext, Program, expr_name,
+                                   expr_text)
 from tools.analysis.findings import Finding
+
+PACK = "concurrency"
 
 _LOCK_CTORS = re.compile(r"threading\.(R?Lock|Condition)\b|\b(R?Lock)\(\)")
 _THREADSAFE_CTORS = re.compile(
@@ -40,6 +50,10 @@ _MUTATION_METHODS = {"append", "appendleft", "extend", "insert", "remove",
 _BLOCKING_ATTRS = {"result", "sleep", "block_until_ready",
                    "device_get", "recv", "accept", "connect",
                    "sendall", "readline", "urlopen", "wait"}
+# callee-chain depth for the interprocedural lock-closure walk: enough
+# for wrapper -> helper -> primitive, bounded so aliasing noise can't
+# snowball through the over-approximate name resolution
+_CLOSURE_DEPTH = 3
 
 
 class _Write:
@@ -70,26 +84,25 @@ def _is_lock_expr(node: ast.AST, lock_names: Set[str]) -> bool:
     return name in lock_names or "lock" in name.lower()
 
 
-def _lock_id(node: ast.AST, cls: Optional[str]) -> str:
-    """Lock identity for order tracking: class-qualified for ``self``
-    receivers so two classes' ``_lock`` fields don't alias."""
-    name = expr_name(node)
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self" \
-            and cls:
-        return f"{cls}.{name}"
-    return name
-
-
-def _thread_entries(ctx: ModuleContext) -> Set[str]:
+def _thread_entries(ctx: ModuleContext) -> Tuple[Set[str], Set[str]]:
+    """(local entry names, resolvable target reprs). The names drive
+    same-module reachability (v1 semantics); the reprs let a
+    ``Thread(target=worker.loop)`` in module A seed reachability inside
+    module B through A's import table."""
     entries: Set[str] = set()
+    refs: Set[str] = set()
     for node in ctx.nodes:
         if isinstance(node, ast.Call) and \
                 expr_text(node.func).endswith("Thread"):
             for kw in node.keywords:
                 if kw.arg == "target":
                     entries.add(expr_name(kw.value))
-    return entries
+                    ref = _callee_repr(kw.value) if \
+                        isinstance(kw.value, (ast.Name, ast.Attribute)) \
+                        else None
+                    if ref:
+                        refs.add(ref)
+    return entries, refs
 
 
 def _call_graph(ctx: ModuleContext) -> Dict[str, Set[str]]:
@@ -110,21 +123,19 @@ def _call_graph(ctx: ModuleContext) -> Dict[str, Set[str]]:
     return graph
 
 
-def _reachable(entries: Set[str], graph: Dict[str, Set[str]]) -> Set[str]:
-    seen: Set[str] = set()
-    frontier = [e for e in entries if e in graph]
-    while frontier:
-        fn = frontier.pop()
-        if fn in seen:
-            continue
-        seen.add(fn)
-        frontier.extend(c for c in graph.get(fn, ()) if c in graph)
-    return seen
+def _callee_repr(func: ast.expr) -> Optional[str]:
+    """Resolvable callee form: ``name``, ``alias.name``, ``self.name``.
+    Deeper attribute chains return None — resolution would be guesswork."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return f"{func.value.id}.{func.attr}"
+    return None
 
 
 class _FnScan(ast.NodeVisitor):
     """One pass per function: attr writes with guard state, lock-order
-    edges, blocking-calls-under-lock."""
+    edges, blocking calls, and calls made while holding a lock."""
 
     def __init__(self, ctx: ModuleContext, fn: ast.FunctionDef,
                  cls: Optional[str], lock_names: Set[str]):
@@ -135,8 +146,38 @@ class _FnScan(ast.NodeVisitor):
         self.held: List[Tuple[str, str]] = []  # (lock id, full text)
         self.writes: List[_Write] = []
         self.edges: List[Tuple[str, str, str, str, ast.AST]] = []
+        self.self_edges: List[Tuple[str, ast.AST]] = []
         self.blocking: List[Tuple[ast.AST, str, str]] = []
+        self.blocking_any: List[Tuple[str, int]] = []
+        self.acquires: Set[str] = set()
+        self.under_lock_calls: List[Tuple[str, str, str, int]] = []
+        self.calls: Set[str] = set()
         self._in_init = fn.name == "__init__"
+
+    def _lock_id(self, node: ast.AST) -> str:
+        """Lock identity for order tracking: class-qualified for
+        ``self`` receivers, module-qualified for bare module-level
+        names, alias-qualified for imported-module attributes — so two
+        classes' (or modules') ``_lock`` fields don't alias, while the
+        SAME lock reached from two modules does unify."""
+        name = expr_name(node)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            recv = node.value.id
+            if recv == "self" and self.cls:
+                return f"{self.cls}.{name}"
+            mod = self.ctx.imports.get(recv)
+            if mod:
+                return f"{mod.rsplit('.', 1)[-1]}:{name}"
+            return f"{recv}.{name}"
+        if isinstance(node, ast.Name):
+            fi = self.ctx.from_imports.get(name)
+            if fi:
+                return f"{fi[0].rsplit('.', 1)[-1]}:{fi[1]}"
+            if name in self.lock_names:
+                stem = self.ctx.module.rsplit(".", 1)[-1]
+                return f"{stem}:{name}"
+        return name
 
     def scan(self):
         for stmt in self.fn.body:
@@ -156,12 +197,17 @@ class _FnScan(ast.NodeVisitor):
         for item in node.items:
             expr = item.context_expr
             if _is_lock_expr(expr, self.lock_names):
-                lid = _lock_id(expr, self.cls)
+                lid = self._lock_id(expr)
                 text = expr_text(expr)
+                self.acquires.add(lid)
                 if self.held:
                     outer_id, outer_text = self.held[-1]
-                    self.edges.append(
-                        (outer_id, lid, outer_text, text, node))
+                    if outer_id == lid:
+                        if outer_text == text:
+                            self.self_edges.append((text, node))
+                    else:
+                        self.edges.append(
+                            (outer_id, lid, outer_text, text, node))
                 self.held.append((lid, text))
                 pushed += 1
         for stmt in node.body:
@@ -198,17 +244,27 @@ class _FnScan(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call):
+        callee = _callee_repr(node.func)
+        if callee is not None:
+            self.calls.add(callee)
+            if self.held:
+                self.under_lock_calls.append(
+                    (self.held[-1][0], self.held[-1][1], callee,
+                     node.lineno))
         if isinstance(node.func, ast.Attribute):
             meth = node.func.attr
             if meth in _MUTATION_METHODS and \
                     isinstance(node.func.value, (ast.Attribute,
                                                  ast.Subscript)):
                 self._record_write(node.func.value, node)
-            if self.held and self._is_blocking(node, meth):
-                self.blocking.append((node, meth, self.held[-1][1]))
-        elif isinstance(node.func, ast.Name) and self.held and \
-                node.func.id == "sleep":
-            self.blocking.append((node, "sleep", self.held[-1][1]))
+            if self._is_blocking(node, meth):
+                self.blocking_any.append((meth, node.lineno))
+                if self.held:
+                    self.blocking.append((node, meth, self.held[-1][1]))
+        elif isinstance(node.func, ast.Name) and node.func.id == "sleep":
+            self.blocking_any.append(("sleep", node.lineno))
+            if self.held:
+                self.blocking.append((node, "sleep", self.held[-1][1]))
         self.generic_visit(node)
 
     def _is_blocking(self, node: ast.Call, meth: str) -> bool:
@@ -282,79 +338,231 @@ def _threadsafe_attrs(ctx: ModuleContext) -> Set[str]:
     return safe
 
 
-def run(ctx: ModuleContext) -> List[Finding]:
-    if "threading" not in ctx.source and "Thread" not in ctx.source:
-        return []
+def summarize(ctx: ModuleContext) -> Dict[str, Any]:
+    """Everything the global passes need, JSON-able for the cache."""
     lock_names = _collect_lock_names(ctx)
-    entries = _thread_entries(ctx)
-    reachable = _reachable(entries, _call_graph(ctx)) if entries else set()
     scans = [_FnScan(ctx, fn, cls, lock_names).scan()
              for cls, fn in _class_functions(ctx)]
-    findings: List[Finding] = []
-
-    # -- CC001: unguarded shared writes --------------------------------
-    shared_attrs = _shared_annotated_attrs(ctx, scans)
-    safe_attrs = _threadsafe_attrs(ctx) | lock_names
-    by_key: Dict[Tuple[Optional[str], str], List[_Write]] = {}
+    functions = []
+    for scan in scans:
+        functions.append({
+            "name": scan.fn.name,
+            "qual": ctx.context_for(scan.fn.body[0]) if scan.fn.body
+                    else scan.fn.name,
+            "cls": scan.cls,
+            "line": scan.fn.lineno,
+            "acquires": sorted(scan.acquires),
+            "calls": sorted(scan.calls),
+            "blocking": [[m, ln] for m, ln in scan.blocking_any],
+            "blocking_under_lock": [
+                [m, n.lineno, n.col_offset, lt]
+                for n, m, lt in scan.blocking],
+            "edges": [[o, i, ot, it, n.lineno, n.col_offset]
+                      for o, i, ot, it, n in scan.edges],
+            "self_edges": [[t, n.lineno, n.col_offset]
+                           for t, n in scan.self_edges],
+            "under_lock_calls": [list(t) for t in scan.under_lock_calls],
+        })
+    writes = []
     for scan in scans:
         for w in scan.writes:
-            if w.receiver == "self":
-                by_key.setdefault((scan.cls, w.attr), []).append(w)
-            else:
-                by_key.setdefault((None, w.attr), []).append(w)
-    for (cls, attr), writes in sorted(
-            by_key.items(), key=lambda kv: (kv[0][0] or "", kv[0][1])):
-        if attr in safe_attrs:
-            continue
-        writers = {w.fn for w in writes if not w.in_init}
-        multi = len(writers) >= 2 and bool(writers & reachable)
-        if not multi and attr not in shared_attrs:
-            continue
-        for w in writes:
-            if w.in_init or w.guarded:
-                continue
-            where = f"{cls}.{attr}" if cls else attr
-            why = ("annotated `synlint: shared`" if attr in shared_attrs
-                   else f"written from {len(writers)} functions incl. a "
-                        "thread entry")
-            findings.append(ctx.finding(
-                "CC001", w.node,
-                f"unguarded write to shared field {where} in "
-                f"{w.fn!r} ({why}) — hold the owning lock"))
+            writes.append({
+                "receiver": w.receiver, "attr": w.attr, "fn": w.fn,
+                "cls": scan.cls, "line": w.node.lineno,
+                "col": w.node.col_offset,
+                "qual": ctx.context_for(w.node),
+                "guarded": w.guarded, "in_init": w.in_init})
+    entries, entry_refs = _thread_entries(ctx)
+    return {
+        "functions": functions,
+        "writes": writes,
+        "entries": sorted(entries),
+        "entry_refs": sorted(entry_refs),
+        "callgraph": {k: sorted(v)
+                      for k, v in _call_graph(ctx).items()},
+        "lock_names": sorted(lock_names),
+        "safe_attrs": sorted(_threadsafe_attrs(ctx) | lock_names),
+        "shared_attrs": sorted(_shared_annotated_attrs(ctx, scans)),
+    }
 
-    # -- CC002: lock-order cycles ---------------------------------------
-    adj: Dict[str, Dict[str, ast.AST]] = {}
-    self_edges: List[Tuple[str, ast.AST]] = []
-    for scan in scans:
-        for outer, inner, otext, itext, node in scan.edges:
-            if outer == inner:
-                if otext == itext:
-                    self_edges.append((otext, node))
+
+def _reachable_by_module(prog: Program) -> Dict[str, Set[str]]:
+    """relpath -> function names thread-reachable inside that module.
+
+    Reachability is module-local over the name-based call graph (the
+    repo-wide union drowns CC001 in aliasing noise — `build`/`name`
+    collide everywhere); what IS cross-module is the *seeding*: a
+    ``Thread(target=a.loop)`` in one module resolves through its import
+    table and seeds ``loop`` in module ``a``."""
+    seeds: Dict[str, Set[str]] = {
+        rel: set(summary.get(PACK, {}).get("entries", ()))
+        for rel, summary in prog.summaries.items()}
+    for rel, summary in prog.summaries.items():
+        for ref in summary.get(PACK, {}).get("entry_refs", ()):
+            for trel, tfn in prog.resolve_call(summary, ref):
+                seeds.setdefault(trel, set()).add(tfn["name"])
+    out: Dict[str, Set[str]] = {}
+    for rel, summary in prog.summaries.items():
+        graph = {fn: set(called) for fn, called in
+                 summary.get(PACK, {}).get("callgraph", {}).items()}
+        seen: Set[str] = set()
+        frontier = [e for e in seeds.get(rel, ()) if e in graph]
+        while frontier:
+            fn = frontier.pop()
+            if fn in seen:
                 continue
-            adj.setdefault(outer, {}).setdefault(inner, node)
-    for text, node in self_edges:
-        findings.append(ctx.finding(
-            "CC002", node,
-            f"lock {text} re-acquired while already held — deadlock for "
-            "a non-reentrant Lock"))
+            seen.add(fn)
+            frontier.extend(c for c in graph.get(fn, ()) if c in graph)
+        out[rel] = seen
+    return out
+
+
+def _lock_closure(prog: Program, rel: str, fn: Dict[str, Any],
+                  memo: Dict[Tuple[str, str], Set[str]],
+                  depth: int = _CLOSURE_DEPTH) -> Set[str]:
+    """Locks ``fn`` acquires directly or through resolvable callees
+    (bounded depth, cycle-safe via the memo)."""
+    key = (rel, fn["qual"])
+    if key in memo:
+        return memo[key]
+    memo[key] = set(fn.get("acquires", ()))  # cycle guard: partial first
+    acquired = set(fn.get("acquires", ()))
+    if depth > 0:
+        summary = prog.summaries.get(rel, {})
+        for callee in fn.get("calls", ()):
+            for trel, tfn in prog.resolve_call(summary, callee):
+                if (trel, tfn["qual"]) == key:
+                    continue
+                acquired |= _lock_closure(prog, trel, tfn, memo, depth - 1)
+    memo[key] = acquired
+    return acquired
+
+
+def _fn_blocks(prog: Program, rel: str, fn: Dict[str, Any]
+               ) -> Optional[str]:
+    """Short description of a direct blocking call in ``fn``, if any."""
+    blocking = fn.get("blocking") or []
+    if blocking:
+        meth, line = blocking[0]
+        return f".{meth}(...) at {rel}:{line}"
+    return None
+
+
+def run_global(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    reachable_by_mod = _reachable_by_module(prog)
+
+    # -- CC001: unguarded shared writes (module-local reachability,
+    #    cross-module thread-entry seeding) ------------------------------
+    for rel in sorted(prog.summaries):
+        cc = prog.summaries[rel].get(PACK)
+        if not cc:
+            continue
+        reachable = reachable_by_mod.get(rel, set())
+        shared_attrs = set(cc.get("shared_attrs", ()))
+        safe_attrs = set(cc.get("safe_attrs", ()))
+        by_key: Dict[Tuple[Optional[str], str], List[Dict]] = {}
+        for w in cc.get("writes", ()):
+            key = (w["cls"] if w["receiver"] == "self" else None, w["attr"])
+            by_key.setdefault(key, []).append(w)
+        for (cls, attr), writes in sorted(
+                by_key.items(), key=lambda kv: (kv[0][0] or "", kv[0][1])):
+            if attr in safe_attrs:
+                continue
+            writers = {w["fn"] for w in writes if not w["in_init"]}
+            multi = len(writers) >= 2 and bool(writers & reachable)
+            if not multi and attr not in shared_attrs:
+                continue
+            for w in writes:
+                if w["in_init"] or w["guarded"]:
+                    continue
+                where = f"{cls}.{attr}" if cls else attr
+                why = ("annotated `synlint: shared`"
+                       if attr in shared_attrs
+                       else f"written from {len(writers)} functions incl. "
+                            "a thread entry")
+                findings.append(Finding(
+                    rule="CC001", path=rel, line=w["line"], col=w["col"],
+                    context=w["qual"],
+                    message=f"unguarded write to shared field {where} in "
+                            f"{w['fn']!r} ({why}) — hold the owning lock"))
+
+    # -- CC002: lock-order cycles, direct + through resolved callees ----
+    adj: Dict[str, Dict[str, Tuple[str, int, int, str]]] = {}
+    memo: Dict[Tuple[str, str], Set[str]] = {}
+    for rel in sorted(prog.summaries):
+        summary = prog.summaries[rel]
+        cc = summary.get(PACK)
+        if not cc:
+            continue
+        for fn in cc.get("functions", ()):
+            for text, line, col in fn.get("self_edges", ()):
+                findings.append(Finding(
+                    rule="CC002", path=rel, line=line, col=col,
+                    context=fn["qual"],
+                    message=f"lock {text} re-acquired while already held "
+                            "— deadlock for a non-reentrant Lock"))
+            for outer, inner, _ot, _it, line, col in fn.get("edges", ()):
+                adj.setdefault(outer, {}).setdefault(
+                    inner, (rel, line, col, fn["qual"]))
+            for lid, ltext, callee, line in fn.get("under_lock_calls", ()):
+                for trel, tfn in prog.resolve_call(summary, callee):
+                    closure = _lock_closure(prog, trel, tfn, memo)
+                    for lid2 in closure:
+                        if lid2 == lid:
+                            findings.append(Finding(
+                                rule="CC002", path=rel, line=line, col=0,
+                                context=fn["qual"],
+                                message=f"call {callee}(...) while "
+                                        f"holding {ltext} re-acquires it "
+                                        f"(via {trel}:{tfn['line']}) — "
+                                        "deadlock for a non-reentrant "
+                                        "Lock"))
+                        else:
+                            adj.setdefault(lid, {}).setdefault(
+                                lid2, (rel, line, 0, fn["qual"]))
     reported: Set[frozenset] = set()
     for a, inners in sorted(adj.items()):
-        for b, node in sorted(inners.items()):
+        for b, (rel, line, col, qual) in sorted(inners.items()):
             if a in adj.get(b, {}):
                 key = frozenset((a, b))
                 if key not in reported:
                     reported.add(key)
-                    findings.append(ctx.finding(
-                        "CC002", node,
-                        f"inconsistent lock order: {a} -> {b} here but "
-                        f"{b} -> {a} elsewhere in this module — potential "
-                        "deadlock; pick one order"))
+                    other = adj[b][a]
+                    findings.append(Finding(
+                        rule="CC002", path=rel, line=line, col=col,
+                        context=qual,
+                        message=f"inconsistent lock order: {a} -> {b} "
+                                f"here but {b} -> {a} at {other[0]}:"
+                                f"{other[1]} — potential deadlock; pick "
+                                "one order"))
 
-    # -- CC003: blocking call under a lock ------------------------------
-    for scan in scans:
-        for node, meth, lock_text in scan.blocking:
-            findings.append(ctx.finding(
-                "CC003", node,
-                f"blocking call .{meth}(...) while holding {lock_text} — "
-                "move the wait outside the critical section"))
+    # -- CC003: blocking call under a lock (direct + one resolved hop) --
+    for rel in sorted(prog.summaries):
+        summary = prog.summaries[rel]
+        cc = summary.get(PACK)
+        if not cc:
+            continue
+        for fn in cc.get("functions", ()):
+            for meth, line, col, lock_text in fn.get(
+                    "blocking_under_lock", ()):
+                findings.append(Finding(
+                    rule="CC003", path=rel, line=line, col=col,
+                    context=fn["qual"],
+                    message=f"blocking call .{meth}(...) while holding "
+                            f"{lock_text} — move the wait outside the "
+                            "critical section"))
+            for lid, ltext, callee, line in fn.get("under_lock_calls", ()):
+                for trel, tfn in prog.resolve_call(summary, callee):
+                    why = _fn_blocks(prog, trel, tfn)
+                    if why and trel != rel or why and \
+                            tfn["qual"] != fn["qual"]:
+                        findings.append(Finding(
+                            rule="CC003", path=rel, line=line, col=0,
+                            context=fn["qual"],
+                            message=f"call {callee}(...) while holding "
+                                    f"{ltext} reaches blocking {why} — "
+                                    "move the wait outside the critical "
+                                    "section"))
+                        break  # one finding per call site
     return findings

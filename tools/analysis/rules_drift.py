@@ -1,0 +1,144 @@
+"""DR rules: observability-contract drift, unified under one gate.
+
+DR001  metric registered in code with no catalog row in docs/observability.md
+DR002  catalog row naming a series no analyzed code registers
+DR003  committed Grafana dashboard out of sync with the catalog
+
+This pack is tools/ci/metrics_doc_check.py folded into the analyzer:
+the same AST collection (literal first argument of a ``counter`` /
+``gauge`` / ``gauge_fn`` / ``histogram`` call with a gated prefix) now
+happens in ``summarize`` — so it rides the content-hash cache — and
+the doc side uses the SAME parser as the Grafana generator
+(``tools.k8s.gen_dashboard.catalog_rows``), so a metric cannot satisfy
+the gate yet be missing from the dashboard. metric drift, dashboard
+drift, and knob drift (rules_env) all report through one
+``--fail-on-new`` exit code.
+
+The global pass only runs when the analysis actually covers
+``synapseml_tpu/`` — a fixture-only run must not accuse the package of
+drift it cannot see.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, List
+
+from tools.analysis.engine import ModuleContext, Program
+from tools.analysis.findings import Finding
+
+PACK = "drift"
+
+METRIC_DOC = os.path.join("docs", "observability.md")
+DASHBOARD = os.path.join("tools", "k8s", "chart", "dashboards",
+                         "serving-dashboard.json")
+PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
+            "fleet_", "process_", "trace_", "capture_", "gbdt_",
+            "onnx_", "autotune_")
+REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
+
+
+def summarize(ctx: ModuleContext) -> Dict[str, Any]:
+    metrics: List[List[Any]] = []
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fnode = node.func
+        fname = (fnode.attr if isinstance(fnode, ast.Attribute)
+                 else fnode.id if isinstance(fnode, ast.Name) else None)
+        if fname not in REGISTER_FNS:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith(PREFIXES):
+            metrics.append([arg.value, node.lineno])
+    return {"metrics": metrics}
+
+
+def _doc_rows(root: str):
+    """(catalog names, doc lines-by-name) via the dashboard generator's
+    parser; None when the doc or parser is unavailable."""
+    doc_path = os.path.join(root, METRIC_DOC)
+    if not os.path.isfile(doc_path):
+        return None
+    try:
+        from tools.k8s.gen_dashboard import catalog_rows
+        rows = catalog_rows(doc_path)
+    except (ImportError, SystemExit):
+        return None
+    names = {name for name, _labels, _kind, _meaning in rows
+             if name.startswith(PREFIXES)}
+    lines: Dict[str, int] = {}
+    with open(doc_path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh, 1):
+            for name in names:
+                if name in line:
+                    lines.setdefault(name, i)
+    return rows, names, lines
+
+
+def _dashboard_drift(root: str, rows) -> bool:
+    path = os.path.join(root, DASHBOARD)
+    if not os.path.isfile(path):
+        return False  # chart not vendored in this checkout
+    try:
+        from tools.k8s.gen_dashboard import build
+        with open(path, encoding="utf-8") as fh:
+            committed = json.load(fh)
+        return build(rows) != committed
+    except (ImportError, OSError, ValueError):
+        return True  # an unreadable committed dashboard IS drift
+
+
+def run_global(prog: Program) -> List[Finding]:
+    if not prog.covers("synapseml_tpu/"):
+        return []
+    parsed = _doc_rows(prog.root)
+    if parsed is None:
+        return [Finding(
+            rule="DR002", path=METRIC_DOC, line=1, col=0,
+            context="<doc>",
+            message="metric catalog missing or unparseable — every "
+                    "registered series needs a catalog row")]
+    rows, doc_names, doc_lines = parsed
+    code: Dict[str, List[str]] = {}
+    for rel in sorted(prog.summaries):
+        if not rel.startswith("synapseml_tpu/"):
+            continue
+        dr = prog.summaries[rel].get(PACK)
+        if not dr:
+            continue
+        for name, line in dr.get("metrics", ()):
+            code.setdefault(name, []).append(f"{rel}:{line}")
+    findings: List[Finding] = []
+    for name in sorted(set(code) - doc_names):
+        rel, _, line = code[name][0].rpartition(":")
+        findings.append(Finding(
+            rule="DR001", path=rel, line=int(line), col=0,
+            context="<module>",
+            message=f"metric {name!r} registered here has no catalog "
+                    f"row in {METRIC_DOC} — dashboards, alerts, and "
+                    "the runbook all read the catalog"))
+    # stale-row and dashboard checks accuse the DOC of naming things
+    # the code lacks — only meaningful when the whole package was
+    # analyzed, not a single-file or fixture run
+    full_package = sum(rel.startswith("synapseml_tpu/")
+                       for rel in prog.summaries) >= 20
+    if not full_package:
+        return findings
+    for name in sorted(doc_names - set(code)):
+        findings.append(Finding(
+            rule="DR002", path=METRIC_DOC,
+            line=doc_lines.get(name, 1), col=0, context="<doc>",
+            message=f"catalog row {name!r} names a series no analyzed "
+                    "code registers — stale row (or the registration "
+                    "moved outside synapseml_tpu/)"))
+    if _dashboard_drift(prog.root, rows):
+        findings.append(Finding(
+            rule="DR003", path=DASHBOARD, line=1, col=0,
+            context="<dashboard>",
+            message="committed dashboard differs from one generated "
+                    "from the catalog — run python tools/k8s/"
+                    "gen_dashboard.py"))
+    return findings

@@ -1,0 +1,102 @@
+"""EH rules: error-handling hygiene — the static complement of the
+chaos suite (tests/test_chaos*.py).
+
+EH001  except:/except BaseException that swallows faults.ThreadKilled
+EH002  silent broad except (no record, no re-raise, no stated reason)
+
+:class:`synapseml_tpu.runtime.faults.ThreadKilled` is deliberately a
+``BaseException`` subclass so injected kills escape every ``except
+Exception`` handler and hit the supervision boundary. A bare ``except:``
+or ``except BaseException`` that does not re-raise defeats that design:
+the chaos framework kills a thread and the handler quietly eats it, so
+the fault test passes while the recovery path was never exercised.
+
+EH001 exempts the supervision boundaries themselves (function name
+matching ``supervis``/``_pipeline_thread``) — absorbing the kill and
+restarting *is* their job. EH002 flags ``except
+Exception``-or-broader handlers whose body is pure ``pass``/
+``continue``/``break`` with no trailing comment: a swallow nobody will
+ever see. The fix is ``blackbox.record(...)`` (or a telemetry counter),
+or a trailing comment stating why silence is correct.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from tools.analysis.engine import ModuleContext, expr_text
+from tools.analysis.findings import Finding
+
+PACK = "errors"
+
+_BOUNDARY_RE = re.compile(r"supervis|_pipeline_thread")
+_BROAD = {"Exception", "BaseException"}
+
+
+def _caught_types(handler: ast.ExceptHandler) -> Set[str]:
+    if handler.type is None:
+        return {"<bare>"}
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    return {expr_text(t).rsplit(".", 1)[-1] for t in types}
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """A ``raise`` anywhere in the handler (not inside a nested def)."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _silent_body(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False
+    return True
+
+
+def _has_trailing_comment(ctx: ModuleContext,
+                          handler: ast.ExceptHandler) -> bool:
+    line = ctx.lines[handler.lineno - 1] if \
+        handler.lineno <= len(ctx.lines) else ""
+    return "#" in line
+
+
+def run_local(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ctx.nodes:
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        caught = _caught_types(node)
+        qual = ctx.context_for(node).rsplit(".", 1)[-1]
+        kills = caught & {"<bare>", "BaseException"}
+        if kills and not _reraises(node) and \
+                not _BOUNDARY_RE.search(qual):
+            what = "bare except:" if "<bare>" in caught else \
+                "except BaseException"
+            out.append(ctx.finding(
+                "EH001", node,
+                f"{what} in {qual!r} does not re-raise — it swallows "
+                "faults.ThreadKilled and defeats chaos injection; "
+                "catch Exception, or record and `raise`"))
+            continue  # one finding per handler
+        if caught & (_BROAD | {"<bare>"}) and _silent_body(node) and \
+                not _has_trailing_comment(ctx, node):
+            out.append(ctx.finding(
+                "EH002", node,
+                f"broad except in {qual!r} swallows the error with no "
+                "blackbox.record, counter, or stated reason — record "
+                "it, or justify the silence in a trailing comment"))
+    return out

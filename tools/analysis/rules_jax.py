@@ -21,6 +21,8 @@ from tools.analysis.engine import (ModuleContext, expr_text,
                                   walk_shallow)
 from tools.analysis.findings import Finding
 
+PACK = "jax"
+
 # functions treated as dispatch-critical even without a `# synlint:
 # hotpath` annotation — the executor pipeline's naming convention
 _HOT_NAME_RE = re.compile(r"^_?(dispatch|drain)|^submit$")
@@ -435,7 +437,7 @@ def _rule_jh005(ctx: ModuleContext) -> List[Finding]:
     return out
 
 
-def run(ctx: ModuleContext) -> List[Finding]:
+def run_local(ctx: ModuleContext) -> List[Finding]:
     jitted = _collect_jitted(ctx)
     out: List[Finding] = []
     out.extend(_rule_jh001(ctx))

@@ -1,0 +1,160 @@
+"""RL rules: resource lifecycle.
+
+RL001  threading.Thread started outside a supervision boundary
+RL002  gauge_fn series registered in instance scope with no unregister
+RL003  tmp-file write not finalized by an atomic rename
+
+The runtime's contract since PR 6: every long-lived thread body runs
+under a supervision boundary (``_supervise_loop`` / ``_supervised`` /
+``_pipeline_thread``) so an escaped exception — including an injected
+:class:`faults.ThreadKilled` — is recorded, counted, and restarted
+instead of silently wedging a pipeline stage. ``gauge_fn`` hands the
+telemetry registry a live callback: a registration with no matching
+``unregister`` pins the object (and keeps exporting stale values) after
+its owner stops. Durable files follow tmp-then-``os.replace`` so
+readers never see a torn write.
+
+All three are *local* rules; the supervision check is lexical on the
+``target=`` expression, with ``# synlint: disable=RL001`` as the escape
+hatch for deliberate fire-and-forget threads (state the reason in the
+same comment).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from tools.analysis.engine import ModuleContext, expr_text
+from tools.analysis.findings import Finding
+
+PACK = "lifecycle"
+
+# a thread target is supervised when the target expression names a
+# supervision wrapper (or a lambda closing over one)
+_SUPERVISED_RE = re.compile(r"supervis|_pipeline_thread")
+_ATOMIC_RE = re.compile(r"\bos\s*\.\s*(replace|rename)\b|\.rename\(")
+
+
+def _rule_rl001(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    if ctx.relpath.startswith("tools/"):
+        # CLI harnesses (loadgen, chaos driver, fleet controller) join
+        # their worker threads and die with the process — the
+        # supervision contract is a runtime-package discipline
+        return out
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call) or \
+                not expr_text(node.func).endswith("Thread"):
+            continue
+        target = next((kw.value for kw in node.keywords
+                       if kw.arg == "target"), None)
+        if target is None:
+            continue
+        text = expr_text(target)
+        if _SUPERVISED_RE.search(text):
+            continue
+        out.append(ctx.finding(
+            "RL001", node,
+            f"thread target {text!r} started outside a supervision "
+            "boundary (_supervised/_supervise_loop/_pipeline_thread) — "
+            "an escaped exception or injected ThreadKilled ends it "
+            "silently; wrap the body or annotate the deliberate "
+            "fire-and-forget"))
+    return out
+
+
+def _literal_str_arg(node: ast.Call) -> Optional[str]:
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _rule_rl002(ctx: ModuleContext) -> List[Finding]:
+    unregistered_names: Set[str] = set()
+    has_wildcard_unregister = False
+    registrations = []
+    for node in ctx.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        fname = expr_text(node.func)
+        if fname.endswith("unregister"):
+            name = _literal_str_arg(node)
+            if name is None:
+                # unregister(series_variable, ...) — a loop tearing
+                # down a set of series; assume it covers the module
+                has_wildcard_unregister = True
+            else:
+                unregistered_names.add(name)
+        elif fname.endswith("gauge_fn"):
+            registrations.append(node)
+    out: List[Finding] = []
+    for node in registrations:
+        name = _literal_str_arg(node)
+        if name is None:
+            continue
+        if ctx.context_for(node) == "<module>":
+            continue  # module-level registration lives for the process
+        if has_wildcard_unregister or name in unregistered_names:
+            continue
+        out.append(ctx.finding(
+            "RL002", node,
+            f"gauge_fn series {name!r} registered in instance scope "
+            "with no unregister() in this module — the registry keeps "
+            "the callback (and the object) alive and exports stale "
+            "values after stop"))
+    return out
+
+
+def _is_tmp_write(node: ast.Call) -> Optional[str]:
+    """Describe a tmp-file write: ``open(<...tmp...>, 'w')`` or a
+    ``mkstemp`` call. Returns a short description or None."""
+    fname = expr_text(node.func)
+    if fname.endswith("mkstemp"):
+        return "mkstemp(...)"
+    if fname == "open" and len(node.args) >= 2:
+        mode = node.args[1]
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+                and ("w" in mode.value or "x" in mode.value):
+            path_text = expr_text(node.args[0])
+            if "tmp" in path_text.lower():
+                return f"open({path_text}, {mode.value!r})"
+    return None
+
+
+def _rule_rl003(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[int] = set()  # nested defs appear under both scans
+    for fn in ctx.nodes:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        writes = []
+        finalized = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = _is_tmp_write(node)
+            if desc:
+                writes.append((node, desc))
+            elif _ATOMIC_RE.search(expr_text(node.func) + "("):
+                finalized = True
+        if writes and not finalized:
+            for node, desc in writes:
+                if node.lineno in seen:
+                    continue
+                seen.add(node.lineno)
+                out.append(ctx.finding(
+                    "RL003", node,
+                    f"tmp-file write {desc} is not followed by an "
+                    "atomic os.replace/rename in this function — a "
+                    "crash mid-write leaves a torn or orphaned file"))
+    return out
+
+
+def run_local(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    out.extend(_rule_rl001(ctx))
+    out.extend(_rule_rl002(ctx))
+    out.extend(_rule_rl003(ctx))
+    return out

@@ -1,0 +1,114 @@
+"""PL rules: Pallas kernel hygiene.
+
+PL001  pallas_call without a VMEM-budget guard in the wrapper
+PL002  kernel wrapper with no interpret-mode parity test
+
+TPU VMEM is ~16 MB/core and a ``pallas_call`` whose blocks exceed it
+fails at *compile* time on hardware CI never sees (CPU CI runs
+interpret mode). The discipline: the kernel module declares a budget
+constant (name containing ``VMEM`` and ``BUDGET``) and every wrapper
+that issues a ``pallas_call`` checks its block footprint against it —
+PL001 fires when a wrapper references no such constant. PL002 walks
+``tests/`` (fixture dirs excluded) for a file that names the wrapper
+AND uses ``interpret`` — the parity test that keeps the kernel honest
+off-TPU.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Any, Dict, List
+
+from tools.analysis.engine import ModuleContext, Program, expr_text
+from tools.analysis.findings import Finding
+
+PACK = "pallas"
+
+_BUDGET_RE = re.compile(r"VMEM.*BUDGET|BUDGET.*VMEM", re.IGNORECASE)
+
+
+def _kernel_wrappers(ctx: ModuleContext) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    for fn in ctx.nodes:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        call_line = None
+        checks_budget = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    expr_text(node.func).endswith("pallas_call"):
+                call_line = call_line or node.lineno
+            elif isinstance(node, ast.Name) and _BUDGET_RE.search(node.id):
+                checks_budget = True
+        if call_line is not None:
+            out.append({"name": fn.name, "line": fn.lineno,
+                        "call_line": call_line,
+                        "checks_budget": checks_budget})
+    return out
+
+
+def summarize(ctx: ModuleContext) -> Dict[str, Any]:
+    return {"kernels": _kernel_wrappers(ctx)}
+
+
+def run_local(ctx: ModuleContext) -> List[Finding]:
+    out: List[Finding] = []
+    for k in _kernel_wrappers(ctx):
+        if not k["checks_budget"]:
+            out.append(Finding(
+                rule="PL001", path=ctx.relpath, line=k["call_line"],
+                col=0, context=k["name"],
+                message=f"pallas_call in {k['name']!r} without a VMEM "
+                        "budget guard — declare a *_VMEM_BUDGET_* "
+                        "constant and check the block footprint before "
+                        "launching (OOM here fails at compile time, on "
+                        "hardware CI never sees)"))
+    return out
+
+
+def _test_corpus(root: str) -> List[str]:
+    """Text of every tests/*.py file (fixture trees excluded — a rule
+    fixture naming a kernel is not a parity test)."""
+    corpus: List[str] = []
+    tests = os.path.join(root, "tests")
+    for dirpath, dirs, files in os.walk(tests):
+        dirs[:] = [d for d in dirs
+                   if d not in ("fixtures", "__pycache__")]
+        for fn in files:
+            if fn.endswith(".py"):
+                try:
+                    with open(os.path.join(dirpath, fn),
+                              encoding="utf-8") as fh:
+                        corpus.append(fh.read())
+                except OSError:  # pragma: no cover - racing deletion
+                    continue
+    return corpus
+
+
+def run_global(prog: Program) -> List[Finding]:
+    kernels = []
+    for rel in sorted(prog.summaries):
+        if rel.startswith("tests/"):
+            continue
+        pl = prog.summaries[rel].get(PACK)
+        if pl:
+            kernels.extend((rel, k) for k in pl.get("kernels", ()))
+    if not kernels or not os.path.isdir(os.path.join(prog.root, "tests")):
+        return []
+    corpus = _test_corpus(prog.root)
+    findings: List[Finding] = []
+    for rel, k in kernels:
+        name = k["name"]
+        if name.startswith("_"):
+            continue  # private helper; the public wrapper owns parity
+        if any(name in text and "interpret" in text for text in corpus):
+            continue
+        findings.append(Finding(
+            rule="PL002", path=rel, line=k["line"], col=0,
+            context=name,
+            message=f"kernel wrapper {name!r} has no interpret-mode "
+                    "parity test under tests/ — add one (pallas_call("
+                    "..., interpret=True) vs the reference "
+                    "implementation) so CPU CI exercises the kernel"))
+    return findings

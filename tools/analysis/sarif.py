@@ -1,0 +1,55 @@
+"""SARIF 2.1.0 output — the interchange format CI annotation UIs
+ingest. Minimal and static: one run, one driver, stable rule ordering,
+``partialFingerprints`` carrying the same line-independent fingerprint
+the baseline uses (so an annotation survives unrelated edits exactly
+as long as its baseline entry would)."""
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from tools.analysis.findings import Finding
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: List[Finding]) -> Dict:
+    rules = sorted({f.rule for f in findings})
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f"{f.message} [{f.context}]"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+            "partialFingerprints": {"synlint/v1": f.fingerprint()},
+        })
+    return {
+        "version": "2.1.0",
+        "$schema": _SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "synlint",
+                "informationUri": "docs/analysis.md",
+                "rules": [{"id": r,
+                           "shortDescription": {"text": r}}
+                          for r in rules],
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: List[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_sarif(findings), fh, indent=1)
+        fh.write("\n")

@@ -1,121 +1,55 @@
-"""Doc-drift gate: the metric catalog in docs/observability.md must
-match the metrics the code actually registers — both directions.
+"""Doc-drift gate — thin wrapper over synlint's DR pack.
 
-Code side: an AST pass over ``synapseml_tpu/`` collecting every string
-literal passed as the first argument to a telemetry registration call
-(``counter`` / ``gauge`` / ``gauge_fn`` / ``histogram``, bare or
-attribute-qualified) whose name carries one of the gated prefixes
-(``serving_``, ``executor_``, ``faults_``, ``blackbox_``,
-``device_``, ``fleet_``, ``process_``). The
-registry qualifies names dynamically (``synapseml_`` wire prefix), so
-the literal at the call site IS the catalog name.
+The real check lives in ``tools/analysis/rules_drift.py`` (DR001: series
+registered in code with no catalog row; DR002: catalog row naming a
+series no code registers; DR003: committed Grafana dashboard out of sync
+with the catalog) so that metric-catalog, dashboard, and env-knob drift
+all report through the ONE ``python -m tools.analysis --fail-on-new``
+gate. This entrypoint stays for muscle memory and for the metrics-smoke
+CI job's focused invocation: it runs the analyzer over the package and
+reports only the drift findings.
 
-Doc side: the catalog TABLE rows, parsed by the SAME parser the
-Grafana-dashboard generator uses (``tools.k8s.gen_dashboard.
-catalog_rows``) — one parser, so a metric cannot satisfy this gate
-yet be missing from the generated dashboard (a prose-only mention
-does not count as a catalog row).
-
-A series registered in code with no catalog row fails; a catalog row
-naming a series no code registers fails. Dashboards, alerts, and the
-runbook all read the catalog — this gate is what keeps them honest.
-Wired into tools/ci/pipeline.yaml (metrics-smoke job); pure AST +
-regex, no jax import, fast.
+Exit codes match the old tool: 0 = in sync, 1 = drift, 2 = could not
+collect (unparseable package / missing catalog).
 """
 import argparse
-import ast
 import os
 import sys
 
-PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
-            "fleet_", "process_", "trace_", "capture_", "gbdt_",
-            "onnx_", "autotune_")
-REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
-
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.abspath(os.path.join(HERE, os.pardir, os.pardir))
-sys.path.insert(0, ROOT)  # tools.k8s.gen_dashboard (shared parser)
-
-
-def code_metric_names(package_dir: str) -> dict:
-    """{metric_name: [file:line, ...]} for every gated registration."""
-    names: dict = {}
-    for dirpath, _dirs, files in os.walk(package_dir):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as fh:
-                try:
-                    tree = ast.parse(fh.read(), filename=path)
-                except SyntaxError as e:  # pragma: no cover - repo gate
-                    print(f"unparseable {path}: {e}", file=sys.stderr)
-                    return {}
-            rel = os.path.relpath(path, ROOT)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                fnode = node.func
-                fname = (fnode.attr if isinstance(fnode, ast.Attribute)
-                         else fnode.id if isinstance(fnode, ast.Name)
-                         else None)
-                if fname not in REGISTER_FNS:
-                    continue
-                arg = node.args[0]
-                if not (isinstance(arg, ast.Constant)
-                        and isinstance(arg.value, str)):
-                    continue
-                if arg.value.startswith(PREFIXES):
-                    names.setdefault(arg.value, []).append(
-                        f"{rel}:{node.lineno}")
-    return names
-
-
-def doc_metric_names(doc_path: str) -> set:
-    """Gated names with a catalog TABLE row — via the dashboard
-    generator's parser, so gate and dashboard see the same rows."""
-    from tools.k8s.gen_dashboard import catalog_rows
-
-    return {name for name, _labels, _kind, _meaning
-            in catalog_rows(doc_path)
-            if name.startswith(PREFIXES)}
+sys.path.insert(0, ROOT)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--package",
                     default=os.path.join(ROOT, "synapseml_tpu"))
-    ap.add_argument("--docs", default=os.path.join(
-        ROOT, "docs", "observability.md"))
     args = ap.parse_args(argv)
 
-    code = code_metric_names(args.package)
-    doc = doc_metric_names(args.docs)
-    if not code or not doc:
-        print("doc-drift check could not collect names "
-              f"(code={len(code)}, doc={len(doc)})")
-        return 2
+    from tools.analysis.engine import analyze_program
 
-    undocumented = sorted(set(code) - doc)
-    unregistered = sorted(doc - set(code))
-    rc = 0
-    if undocumented:
-        rc = 1
-        print("registered in code but missing a catalog row in "
-              f"{os.path.relpath(args.docs, ROOT)}:")
-        for n in undocumented:
-            print(f"  {n}  ({', '.join(code[n][:3])})")
-    if unregistered:
-        rc = 1
-        print("catalog rows naming series no code registers:")
-        for n in unregistered:
-            print(f"  {n}")
-    if rc == 0:
-        print(f"doc-drift ok: {len(code)} registered series all "
-              f"cataloged, {len(doc)} catalog rows all registered")
-    return rc
+    findings, _prog, _stats = analyze_program([args.package], root=ROOT)
+    syn = [f for f in findings if f.rule == "SYN000"]
+    drift = [f for f in findings if f.rule.startswith("DR")]
+    if syn:
+        for f in syn:
+            print(f.render(), file=sys.stderr)
+        print("doc-drift check could not collect names", file=sys.stderr)
+        return 2
+    if any("catalog missing" in f.message for f in drift):
+        for f in drift:
+            print(f.render(), file=sys.stderr)
+        return 2
+    if drift:
+        print("metric catalog / dashboard drift "
+              "(docs/observability.md — see docs/analysis.md, DR rules):")
+        for f in drift:
+            print(f"  {f.render()}")
+        return 1
+    print("doc-drift ok: registered series, catalog rows, and the "
+          "generated dashboard agree (synlint DR pack)")
+    return 0
 
 
 if __name__ == "__main__":

@@ -15,5 +15,5 @@ exec timeout -k 10 "${SMOKE_TIMEOUT:-300}" env JAX_PLATFORMS=cpu \
   tests/test_faults.py tests/test_channel_failover.py \
   tests/test_blackbox.py tests/test_perfwatch.py tests/test_fleet.py \
   tests/test_costmodel.py tests/test_tracing.py tests/test_capture.py \
-  tests/test_predict_kernels.py \
+  tests/test_predict_kernels.py tests/test_analysis.py \
   -q -p no:cacheprovider
