@@ -325,6 +325,87 @@ def bench_executor_dp_scaling():
     return all_img_s, one_img_s, ndev
 
 
+def bench_onnx_tp_scaling():
+    """tp=1 vs tp=all A/B through the full ONNXModel executor path: the
+    same transformer token stream scored with the weights replicated
+    (``tensor_parallel=1``) and registry-placed over every chip
+    (``tensor_parallel=<ndev>``, dp=1 — parallel/partition_rules.py).
+    Under the default reduction-free rules + the executor's gather
+    formulation both legs are BIT-identical; what this measures is the
+    price of serving tp-sharded at rest (the entry all-gather) against
+    the per-device HBM it buys — ``param_bytes_per_device`` max rides in
+    the detail as the memory half of the trade. On a 1-device platform
+    both legs run the identical path (speedup ~1.0, the zero-regression
+    guard).
+
+    Returns (tp_seq_s, one_seq_s, ndev, tp_detail)."""
+    import jax
+
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.onnx import ONNXModel, zoo
+    from synapseml_tpu.parallel.onnx_tp import param_bytes_per_device
+
+    vocab, d, heads, ff, layers, s, bs = 1000, 128, 4, 512, 2, 32, 32
+    ndev = len(jax.local_devices())
+    n_batches = max(4, 2 * ndev)
+    payload = zoo.transformer_encoder(vocab, d, heads, ff, layers,
+                                      seq_len=s, seed=0)
+    ids = np.random.default_rng(0).integers(
+        0, vocab, (bs, s)).astype(np.int32)
+
+    def make_leg(tp):
+        model = ONNXModel(model_payload=payload, mini_batch_size=bs)
+        model.set(feed_dict={model.graph.input_names[0]:
+                             model.graph.input_names[0]})
+        if tp > 1:
+            model.set(devices="all", tensor_parallel=tp)
+        ex = model._executor()
+        # AOT warmup (not a lazy first call): records the compiled
+        # flops/bytes signature into the cost table under this group's
+        # tag — what perf_report joins on to attribute the roofline row
+        ex.warmup([((s,), np.int32)], buckets=[bs])
+        ex(ids)  # weights placed, bucket served from the AOT table
+
+        def run():
+            start = time.perf_counter()
+            rows = 0
+            for (out, *_rest) in ex.stream(
+                    (ids,) for _ in range(n_batches)):
+                rows += len(np.asarray(out))
+            return rows / (time.perf_counter() - start)
+        per_dev = param_bytes_per_device(ex._bound)
+        return run, ex, per_dev
+
+    leg_one, ex_one, per_dev_one = make_leg(1)
+    total_bytes = sum(per_dev_one.values()) or max(
+        per_dev_one.values(), default=0)
+    if ndev == 1:
+        one_seq_s = max(leg_one() for _ in range(2))
+        detail = {"devices": 1, "tensor_parallel": 1,
+                  "partition": "dp1xtp1",
+                  "param_bytes_per_device_max": int(max(
+                      per_dev_one.values(), default=0)),
+                  "param_bytes_total": int(total_bytes)}
+        ex_one.close()
+        return one_seq_s, one_seq_s, ndev, detail
+    leg_tp, ex_tp, per_dev_tp = make_leg(ndev)
+    one_seq_s = tp_seq_s = 0.0
+    for _ in range(2):  # interleaved best-of-2: scheduler jitter
+        one_seq_s = max(one_seq_s, leg_one())
+        tp_seq_s = max(tp_seq_s, leg_tp())
+    detail = {"devices": ndev, "tensor_parallel": ndev,
+              "partition": f"dp1xtp{ndev}",
+              "param_bytes_per_device_max": int(max(
+                  per_dev_tp.values(), default=0)),
+              "param_bytes_total": int(sum(
+                  v.nbytes for v in ex_one._bound[0].values())),
+              "single_param_bytes_per_device": int(max(
+                  per_dev_one.values(), default=0))}
+    ex_one.close()
+    ex_tp.close()
+    return tp_seq_s, one_seq_s, ndev, detail
+
+
 def bench_gbdt_train():
     """Returns (rows*iters/s of the production 'auto' routing, plus the
     FULL-LOOP pallas-vs-xla A/B at the same Adult shape — the round-3
@@ -976,6 +1057,27 @@ def _entries_dp_scaling():
     }]
 
 
+def _entries_onnx_tp_scaling():
+    # tensor-parallel serving A/B: the same transformer stream with the
+    # weights registry-placed over every chip (tp=all, dp=1) vs
+    # replicated (tp=1). Bit-identical by contract (gather formulation);
+    # the detail carries the memory half of the trade — max per-device
+    # param bytes vs the total. On a 1-device platform the legs
+    # coincide (speedup ~1, the zero-regression guard)
+    tp_seq_s, one_seq_s, tp_ndev, tp_detail = _with_retries(
+        bench_onnx_tp_scaling)
+    detail = dict(tp_detail)
+    detail["single_device_sequences_per_sec"] = round(one_seq_s, 2)
+    detail["speedup"] = round(tp_seq_s / max(one_seq_s, 1e-9), 3)
+    return [{
+        "metric": "onnx_tp_scaling_sequences_per_sec",
+        "value": round(tp_seq_s, 2),
+        "unit": "sequences/sec",
+        "vs_baseline": round(tp_seq_s / GPU_SEQ_BASELINE, 3),
+        "detail": detail,
+    }]
+
+
 def _entries_gbdt_train():
     rows_s, gbdt_ab = _with_retries(bench_gbdt_train)
     return [{
@@ -1160,6 +1262,12 @@ BENCH_GROUPS = [
         "to one — the chip-count scaling of the hot scoring path",
         ("executor_dp_scaling_images_per_sec",)),
     BenchGroup(
+        "onnx_tp_scaling", _entries_onnx_tp_scaling, "device",
+        "transformer forward with weights registry-placed over every "
+        "chip (tensor_parallel=all) vs replicated (tp=1) — the price "
+        "and per-device HBM payoff of tp-sharded serving",
+        ("onnx_tp_scaling_sequences_per_sec",)),
+    BenchGroup(
         "onnx_lightgbm", _entries_onnx_lightgbm, "device",
         "LightGBM-converted ONNX tree ensemble scored device-resident "
         "(GEMM formulation) — the reference notebook's workload",
@@ -1219,9 +1327,12 @@ BENCH_GROUPS = [
 # the surfaces a framework regression moves first. On the CPU runner
 # both routers provably fall back (the detail records the decision);
 # the heavy device-throughput groups stay driver-territory (the
-# committed BENCH_r*.json history).
+# committed BENCH_r*.json history). onnx_tp_scaling rides along (round
+# 18): on the 1-device CPU runner its legs coincide by construction,
+# so the gate watches the executor-path transformer throughput itself.
 FAST_GROUPS = ("serving", "serving_scored", "cold_start",
-               "gbdt_predict", "onnx_int8", "resnet50_fast")
+               "gbdt_predict", "onnx_int8", "resnet50_fast",
+               "onnx_tp_scaling")
 
 
 def _finite(obj):

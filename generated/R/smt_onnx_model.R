@@ -11,10 +11,12 @@
 #' @param input_norm graph input name -> {'mean':..., 'scale':...} applied ON DEVICE after casting an integer feed to the compute dtype: the wire carries uint8 pixels (1 byte/px vs 2 for bf16) and the fused (x - mean) * scale runs where bandwidth is free
 #' @param mini_batch_size max rows per device batch
 #' @param model_payload raw .onnx protobuf bytes
+#' @param partition_rules per-model partition-rule overrides, matched ahead of the default reduction-free column layout: a list of (regex, axes) pairs — axes a PartitionSpec-like tuple such as (None, 'tp'), None to replicate — or the string 'megatron' for the full Megatron column preset (max memory savings; ~1e-6 cross-shard psum wobble breaks digest stability across reshardings). Only consulted when tensor_parallel > 1
 #' @param softmax_output_col column for softmax of first output
+#' @param tensor_parallel tensor-parallel ways: >1 splits `devices` into a 2-axis dp×tp mesh (dp = len(devices)//tp) — the batch still shards over dp while the weights are placed over tp by the partition-rule registry (parallel/partition_rules.py), so the model no longer needs to fit one device's HBM. The default rule set is the reduction-free column layout: replies stay byte-identical to tensor_parallel=1 (the capture/replay digest contract). Must divide the device count; requires devices
 #' @return a synapseml_tpu transformer handle
 #' @export
-smt_onnx_model <- function(argmax_output_col = NULL, compile_cache_dir = NULL, compute_dtype = "float32", devices = NULL, feed_dict = NULL, fetch_dict = NULL, input_norm = NULL, mini_batch_size = 128, model_payload = NULL, softmax_output_col = NULL) {
+smt_onnx_model <- function(argmax_output_col = NULL, compile_cache_dir = NULL, compute_dtype = "float32", devices = NULL, feed_dict = NULL, fetch_dict = NULL, input_norm = NULL, mini_batch_size = 128, model_payload = NULL, partition_rules = NULL, softmax_output_col = NULL, tensor_parallel = 1) {
   mod <- reticulate::import("synapseml_tpu.onnx.model")
   kwargs <- Filter(Negate(is.null), list(
     argmax_output_col = argmax_output_col,
@@ -26,7 +28,9 @@ smt_onnx_model <- function(argmax_output_col = NULL, compile_cache_dir = NULL, c
     input_norm = input_norm,
     mini_batch_size = mini_batch_size,
     model_payload = model_payload,
-    softmax_output_col = softmax_output_col
+    partition_rules = partition_rules,
+    softmax_output_col = softmax_output_col,
+    tensor_parallel = tensor_parallel
   ))
   do.call(mod$ONNXModel, kwargs)
 }

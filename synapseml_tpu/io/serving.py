@@ -2557,14 +2557,19 @@ class ContinuousServer:
         HTTPSourceStateHolder.remove(self.name)
 
 
-def _model_pipeline(model_path: str, devices=None, cache_dir=None):
+def _model_pipeline(model_path: str, devices=None, cache_dir=None,
+                    tensor_parallel=1, partition_rules=None):
     """JSON {"features": [...]} -> ONNX-scored reply — the deployment
     entry's default pipeline (tools/k8s/chart serving template).
     ``devices`` dp-shards each scored micro-batch across that many chips
-    (ONNXModel.devices -> runtime/executor.py); ``cache_dir`` enables the
-    persistent compile cache + executable store (--cache-dir /
-    SYNAPSEML_COMPILE_CACHE). Returns ``(pipeline, model)`` so ``main``
-    can drive ``model.warmup`` before opening the readiness gate."""
+    (ONNXModel.devices -> runtime/executor.py); ``tensor_parallel`` > 1
+    splits them into a dp×tp mesh with the weights placed over tp by the
+    partition-rule registry (parallel/partition_rules.py) —
+    ``partition_rules`` forwards per-model overrides or the 'megatron'
+    preset; ``cache_dir`` enables the persistent compile cache +
+    executable store (--cache-dir / SYNAPSEML_COMPILE_CACHE). Returns
+    ``(pipeline, model)`` so ``main`` can drive ``model.warmup`` before
+    the readiness gate."""
     import numpy as np
 
     from synapseml_tpu.onnx import ONNXModel
@@ -2573,6 +2578,10 @@ def _model_pipeline(model_path: str, devices=None, cache_dir=None):
     model = ONNXModel(model_path=model_path)
     if devices is not None:
         model.set(devices=devices)
+    if tensor_parallel and int(tensor_parallel) > 1:
+        model.set(tensor_parallel=int(tensor_parallel))
+    if partition_rules is not None:
+        model.set(partition_rules=partition_rules)
     if cache_dir is not None:
         model.set(compile_cache_dir=cache_dir)
     # every capture record carries the scoring model's content hash
@@ -2582,9 +2591,15 @@ def _model_pipeline(model_path: str, devices=None, cache_dir=None):
     # weights would "diverge" meaninglessly
     _cap.set_model_hash(_cc.content_hash(model.model_payload or b""))
     feed = model.graph.input_names[0]
+    # cast features to the graph's DECLARED input dtype — token-id
+    # models (the tensor-parallel transformer smoke) feed int32/int64,
+    # not float32; unknown/absent dtype keeps the float32 default
+    feed_dtype, _ = getattr(model.graph, "input_info", {}).get(feed) \
+        or (None, None)
+    feed_np = np.dtype(feed_dtype) if feed_dtype is not None else np.float32
 
     def pipeline(table: Table) -> Table:
-        feats = np.stack([np.asarray(v["features"], np.float32)
+        feats = np.stack([np.asarray(v["features"], feed_np)
                           for v in table["value"]])
         scored = model.transform(Table({feed: feats},),)
         first_out = model.graph.output_names[0]
@@ -2617,6 +2632,22 @@ def main(argv=None):
         "SYNAPSEML_DEVICES"),
         help="data-parallel device spec: 'all' or an int chip count; "
              "unset = single device")
+    ap.add_argument("--tensor-parallel", type=int,
+                    default=int(os.environ.get(
+                        "SYNAPSEML_TENSOR_PARALLEL", "1")),
+        help="tensor-parallel ways: >1 splits --devices into a dp×tp "
+             "mesh — weights are placed over tp by the partition-rule "
+             "registry so the model need not fit one chip's HBM; "
+             "replies stay byte-identical to tensor-parallel=1 under "
+             "the default rules. Must divide the device count; "
+             "requires --devices")
+    ap.add_argument("--partition-rules", default=os.environ.get(
+        "SYNAPSEML_PARTITION_RULES") or None,
+        help="partition-rule overrides for --tensor-parallel: "
+             "'megatron' (full column preset: max memory headroom, "
+             "~1e-6 cross-shard drift breaks replay digests across "
+             "reshardings) or a JSON list of [regex, axes] pairs "
+             "matched ahead of the default reduction-free layout")
     ap.add_argument("--coalesce-ms", type=float, default=float(os.environ.get(
         "SYNAPSEML_COALESCE_MS", "0")),
         help="deadline-based batching window in ms (0 = off)")
@@ -2682,6 +2713,30 @@ def main(argv=None):
         except ValueError as e:
             print(f"error: --devices {args.devices!r}: {e}", flush=True)
             return 2
+    tp = int(args.tensor_parallel or 1)
+    if tp > 1:
+        # same fail-fast contract as --devices: a tp spec the mesh
+        # cannot satisfy must kill the pod at boot, not 500 per score
+        if devices is None:
+            print("error: --tensor-parallel > 1 requires --devices",
+                  flush=True)
+            return 2
+        from synapseml_tpu.runtime.executor import resolve_devices
+        n = len(resolve_devices(devices))
+        if n % tp:
+            print(f"error: --tensor-parallel {tp} does not divide the "
+                  f"{n}-device pool", flush=True)
+            return 2
+    partition_rules = args.partition_rules
+    if partition_rules and partition_rules != "megatron":
+        try:
+            partition_rules = json.loads(partition_rules)
+            if not isinstance(partition_rules, list):
+                raise ValueError("expected a JSON list of [regex, axes]")
+        except ValueError as e:
+            print(f"error: --partition-rules {args.partition_rules!r}: "
+                  f"{e}", flush=True)
+            return 2
 
     if args.model and not os.path.exists(args.model):
         # a configured-but-missing model must NOT silently degrade to
@@ -2692,11 +2747,13 @@ def main(argv=None):
         return 2
     model = None
     if args.model:
-        pipeline, model = _model_pipeline(args.model, devices=devices,
-                                          cache_dir=args.cache_dir)
+        pipeline, model = _model_pipeline(
+            args.model, devices=devices, cache_dir=args.cache_dir,
+            tensor_parallel=tp, partition_rules=partition_rules)
         what = f"scoring {args.model}"
         if devices is not None:
-            what += f" [devices={devices}]"
+            what += f" [devices={devices}"
+            what += f" tp={tp}]" if tp > 1 else "]"
     else:
         def pipeline(table: Table) -> Table:
             replies = np.empty(table.num_rows, dtype=object)

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterable, Iterator, List, Optional
 
+import logging
+
 import jax
 import numpy as np
 
@@ -26,6 +28,8 @@ from synapseml_tpu.runtime import autotune
 from synapseml_tpu.runtime.executor import BatchedExecutor
 
 _DTYPES = {"float32": np.float32, "bfloat16": "bfloat16", "float16": np.float16}
+
+log = logging.getLogger(__name__)
 
 
 # -- autotuned lanes ------------------------------------------------------
@@ -219,6 +223,24 @@ class ONNXModel(Transformer):
         "mini-batch bucket is dp-sharded across them by the executor "
         "(runtime/executor.py), bit-identical to single-device",
         default=None)
+    tensor_parallel = Param(
+        "tensor-parallel ways: >1 splits `devices` into a 2-axis dp×tp "
+        "mesh (dp = len(devices)//tp) — the batch still shards over dp "
+        "while the weights are placed over tp by the partition-rule "
+        "registry (parallel/partition_rules.py), so the model no longer "
+        "needs to fit one device's HBM. The default rule set is the "
+        "reduction-free column layout: replies stay byte-identical to "
+        "tensor_parallel=1 (the capture/replay digest contract). Must "
+        "divide the device count; requires devices",
+        default=1)
+    partition_rules = Param(
+        "per-model partition-rule overrides, matched ahead of the "
+        "default reduction-free column layout: a list of (regex, axes) "
+        "pairs — axes a PartitionSpec-like tuple such as (None, 'tp'), "
+        "None to replicate — or the string 'megatron' for the full "
+        "Megatron column preset (max memory savings; ~1e-6 cross-shard "
+        "psum wobble breaks digest stability across reshardings). Only "
+        "consulted when tensor_parallel > 1", default=None)
     compile_cache_dir = Param(
         "persistent compile-cache directory (default: the "
         "SYNAPSEML_COMPILE_CACHE env var; unset = off) — wires JAX's "
@@ -345,8 +367,31 @@ class ONNXModel(Transformer):
             # weight copies
             cd = routed_compute_dtype(g, self.model_payload,
                                       self.mini_batch_size)
+        tp = int(self.tensor_parallel or 1)
+        if tp < 1:
+            raise ValueError(f"tensor_parallel must be >= 1, got {tp}")
+        rules = self.partition_rules
+        if tp > 1:
+            if devs is None:
+                raise ValueError(
+                    "tensor_parallel > 1 requires an explicit `devices` "
+                    "spec (the dp×tp mesh needs a device list)")
+            if len(devs) % tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} does not divide the "
+                    f"{len(devs)}-device pool")
+        # canonical rules key: JSON-ish override lists and the
+        # 'megatron' preset string must key distinctly and hashably
+        if rules is None or rules == []:
+            rules_key = None
+        elif isinstance(rules, str):
+            rules_key = (rules,)
+        else:
+            rules_key = tuple(
+                (str(p), tuple(s) if isinstance(s, (list, tuple)) else s)
+                for p, s in rules)
         key = (id(g), self.mini_batch_size, cd, norm_key,
-               dev_key, self.compile_cache_dir)
+               dev_key, self.compile_cache_dir, tp, rules_key)
         if key not in cache:
             dtype = _DTYPES[cd]
             params = g.params
@@ -404,15 +449,51 @@ class ONNXModel(Transformer):
             # bytes. The graph's node count + outputs disambiguate
             # truncated subgraphs (CNTKModel cut_layers) sharing a payload
             from synapseml_tpu.runtime import compile_cache as _cc
+            # pre-tp content hashes keep their exact ingredient list so
+            # existing persistent executables stay warm at tp=1
+            extra = () if (tp == 1 and rules_key is None) \
+                else (tp, repr(rules_key))
             cache_key = _cc.content_hash(
                 self.model_payload or b"", len(g._nodes),
-                tuple(g.output_names), cd, norm_key)
+                tuple(g.output_names), cd, norm_key, *extra)
+            bound_specs = None
+            if tp > 1:
+                # match against the ORIGINAL float32 params: shapes are
+                # what the registry keys on, and np.issubdtype treats
+                # bf16 as non-floating (would skew the 2-D fallback)
+                from jax.sharding import Mesh
+                from synapseml_tpu.parallel.partition_rules import (
+                    match_partition_rules, megatron_rules)
+                dp = len(devs) // tp
+                mesh = Mesh(np.asarray(devs).reshape(dp, tp),
+                            ("dp", "tp"))
+                ovr = megatron_rules() if rules == "megatron" else rules
+                specs, report = match_partition_rules(
+                    g.params, mesh, overrides=ovr)
+                self.__dict__["_partition_report"] = report
+                log.info("tensor_parallel=%d partition coverage: %s",
+                         tp, report.summary())
+                bound_specs = (specs,)
+            # the megatron preset opts into true sharded compute (max
+            # memory headroom, documented ~1e-6 psum drift); every other
+            # layout keeps the gather formulation so replies stay
+            # byte-identical to tp=1 — the capture/replay digest contract
             cache[key] = BatchedExecutor(
                 apply_fn, compute_dtype=compute,
                 max_bucket=self.mini_batch_size, bound_args=(params,),
                 devices=devs, cache_key=cache_key,
-                cache_dir=self.compile_cache_dir)
+                cache_dir=self.compile_cache_dir,
+                tensor_parallel=tp, bound_specs=bound_specs,
+                tp_compute="sharded" if rules == "megatron" else "gather")
         return cache[key]
+
+    def partition_coverage(self) -> Optional[dict]:
+        """Coverage report from the last tensor-parallel executor build:
+        which partition rule claimed each parameter and why (see
+        parallel/partition_rules.py). None until an executor has been
+        built with ``tensor_parallel > 1``."""
+        report = self.__dict__.get("_partition_report")
+        return None if report is None else report.as_dict()
 
     def preferred_wire(self, input_name: str,
                        batch: Optional[int] = None) -> str:

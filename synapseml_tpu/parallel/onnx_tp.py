@@ -7,13 +7,16 @@ pure jax function, so sharding the PARAMETERS over a mesh axis is enough:
 GSPMD propagates the layouts through every matmul and inserts the
 all-reduces — no per-op rules, no graph surgery, any exporter's file.
 
-Heuristic (the Megatron column layout): 2-D float weights shard their
-LAST dim over ``axis``; 1-D biases that feed the same activations
-replicate (GSPMD re-shards them as needed). Weights whose dims don't
-divide the axis size stay replicated. For a transformer this puts each
-rank's slice of every projection in HBM — the model no longer needs to
-fit on one chip (``param_bytes_per_device`` makes that claim checkable,
-and the test suite asserts it).
+Placement is decided by the rule registry in
+:mod:`synapseml_tpu.parallel.partition_rules` (default: the Megatron
+column layout — 2-D weights shard their last dim over ``axis``,
+projection biases ride their weight's column sharding, anything that
+does not divide replicates). ``rules=`` takes per-model overrides, and
+every call can hand back a coverage report naming which rule claimed
+each param. For a transformer this puts each rank's slice of every
+projection in HBM — the model no longer needs to fit on one chip
+(``param_bytes_per_device`` makes that claim checkable, and the test
+suite asserts it).
 
 Activations are replicated by default (right for classifier-shaped
 outputs); ``batch_axis`` keeps inputs/outputs batch-sharded instead so
@@ -21,58 +24,69 @@ activation-heavy graphs don't re-materialize full tensors per device.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from synapseml_tpu.parallel.mesh import replicated
+from synapseml_tpu.parallel.partition_rules import (
+    CoverageReport, match_partition_rules)
 
 
 def tp_shard_params(params: Dict[str, np.ndarray], mesh: Mesh,
-                    axis: str = "tp") -> Dict[str, Any]:
-    """Place a params dict on ``mesh`` with 2-D weights column-sharded
-    over ``axis`` (replicating anything that does not divide)."""
-    n = mesh.shape[axis]
-    rep = replicated(mesh)
-    out: Dict[str, Any] = {}
-    for k, v in params.items():
-        if (v.ndim == 2 and np.issubdtype(v.dtype, np.floating)
-                and v.shape[-1] % n == 0 and v.shape[-1] >= n):
-            out[k] = jax.device_put(
-                v, NamedSharding(mesh, P(None, axis)))
-        else:
-            out[k] = jax.device_put(v, rep)
-    return out
+                    axis: str = "tp",
+                    rules: Optional[Sequence[Tuple[str, Any]]] = None,
+                    with_report: bool = False):
+    """Place a params dict on ``mesh`` by the partition-rule registry.
+
+    ``rules`` prepends per-model overrides ahead of the default Megatron
+    column layout; anything unmatched takes the divisibility fallback
+    (column-shard 2-D float weights, replicate the rest). With
+    ``with_report=True`` returns ``(placed, CoverageReport)``.
+    """
+    specs, report = match_partition_rules(
+        params, mesh, axis=axis, overrides=rules)
+    out: Dict[str, Any] = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()}
+    return (out, report) if with_report else out
 
 
 def param_bytes_per_device(params: Dict[str, Any]) -> Dict[Any, int]:
     """Actual parameter bytes resident on each device — the tested form
     of the "model no longer needs to fit on one chip" claim."""
     per_dev: Dict[Any, int] = {}
-    for v in params.values():
+    for v in jax.tree_util.tree_leaves(params):
+        if not hasattr(v, "addressable_shards"):
+            continue
         for s in v.addressable_shards:
             per_dev[s.device] = per_dev.get(s.device, 0) + s.data.nbytes
     return per_dev
 
 
 def tp_jit(graph, mesh: Mesh, axis: str = "tp",
-           batch_axis: Optional[str] = None):
+           batch_axis: Optional[str] = None,
+           rules: Optional[Sequence[Tuple[str, Any]]] = None,
+           with_report: bool = False):
     """(sharded_params, jitted_fn): run ``graph`` tensor-parallel.
 
-    ``jitted_fn(params, *inputs)`` lets GSPMD carry the column-sharded
+    ``jitted_fn(params, *inputs)`` lets GSPMD carry the registry-placed
     weights through the graph — numerically identical to single-device
-    ``graph.apply``.
+    ``graph.apply``. ``rules`` forwards per-model overrides to the
+    registry; ``with_report=True`` appends the coverage report to the
+    return tuple.
 
     With ``batch_axis=None`` (default) inputs and outputs replicate —
     right for classifiers, where activations are small next to weights.
-    With ``batch_axis="tp"`` (or any mesh axis) inputs/outputs stay
+    With ``batch_axis="dp"`` (or any mesh axis) inputs/outputs stay
     sharded over their leading batch dimension, so an activation-heavy
     graph never materializes a full-batch tensor on any one device;
     the leading dim of every array input must divide the axis size.
     """
-    params = tp_shard_params(graph.params, mesh, axis)
+    params, report = tp_shard_params(
+        graph.params, mesh, axis, rules=rules, with_report=True)
     rep = replicated(mesh)
     n_b = mesh.shape[batch_axis] if batch_axis is not None else 1
     io_sh = NamedSharding(mesh, P(batch_axis)) if batch_axis else rep
@@ -112,4 +126,4 @@ def tp_jit(graph, mesh: Mesh, axis: str = "tp",
             checked_out.append(True)
         return jitted(p, *placed)
 
-    return params, run
+    return (params, run, report) if with_report else (params, run)

@@ -1,0 +1,320 @@
+"""Regex-path → PartitionSpec rule registry for imported param pytrees.
+
+``tp_shard_params`` used to decide placement with one hardcoded
+heuristic — "2-D float weight whose last dim divides the axis" — which
+replicates every bias (even ones feeding column-sharded activations) and
+gives a model author no way to steer placement for an unusual layer. This
+module replaces that heuristic with the registry pattern used by the big
+JAX LLM codebases: an ORDERED list of ``(regex, PartitionSpec)`` rules
+matched against each param's path, first match wins, with per-model
+overrides simply prepended ahead of the defaults.
+
+Matching never raises and never produces an uncompilable layout:
+
+* a param no rule matches falls back to the divisibility heuristic
+  (column-shard a 2-D float weight when its last dim divides the axis,
+  else replicate);
+* a rule that DOES match but names an axis the param's dim cannot divide
+  degrades to replicate — logged, and recorded in the coverage report —
+  instead of letting GSPMD fail compilation with an error naming no
+  tensor;
+* scalars always replicate.
+
+Bias pairing is the one stateful rule: :data:`BIAS_PAIR` is a sentinel
+rule value meaning "shard this 1-D param over ``axis`` IFF a weight its
+name pairs with (``l0_q_b`` ↔ ``l0_q_w``, ``foo.bias`` ↔ ``foo.weight``)
+resolved to a column-sharded layout with a matching output dim". That is
+the registry form of the old 1-D bug fix: projection biases ride their
+weight's column sharding, while layernorm betas (whose pair is a 1-D
+scale, never column-sharded) stay replicated.
+
+The **coverage report** names which rule claimed each param and why the
+fallbacks fired, so ``/debug/memory`` and the tests can prove the layout
+rather than trust it.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+log = logging.getLogger("synapseml_tpu.parallel.partition_rules")
+
+#: Sentinel rule value: shard a 1-D param over the axis iff its paired
+#: weight is column-sharded (see module docstring). Usable in overrides.
+BIAS_PAIR = "bias-pair"
+
+_BIAS_TOKEN = re.compile(r"(?:^|[._])(?P<tok>bias|beta|b)(?P<suf>_\w+)?$")
+_WEIGHT_TOKENS = ("w", "W", "weight", "kernel")
+
+
+def as_spec(spec: Any) -> P:
+    """Normalize a PartitionSpec-or-axes-sequence into a PartitionSpec.
+
+    Accepts ``P(None, "tp")``, ``(None, "tp")``, ``[None, "tp"]`` or
+    ``None`` (replicate) so rules survive a JSON round trip through the
+    serving entry's ``partition_rules`` Param.
+    """
+    if spec is None:
+        return P()
+    if isinstance(spec, P):
+        return spec
+    if isinstance(spec, (list, tuple)):
+        return P(*spec)
+    raise TypeError(f"cannot convert {spec!r} to a PartitionSpec")
+
+
+def default_rules(axis: str = "tp") -> List[Tuple[str, Any]]:
+    """The REDUCTION-FREE column layout — deterministic across
+    reshardings, the serving default.
+
+    Only weights whose matmul stays free of cross-shard reductions are
+    sharded: the attention input projections and the MLP expand half
+    (column-parallel — each output feature is computed whole on one
+    rank, the only collective is an all-gather, i.e. concatenation).
+    The row-parallel halves (attention output / MLP contract) and the
+    embedding tables replicate explicitly: sharding them makes GSPMD
+    psum partial products, and a float sum re-associated across tp
+    ranks is NOT the single-device sum — measured ~1e-6 wobble on the
+    forced-8-device platform, which breaks the capture/replay digest
+    contract (docs/serving.md). With these rules a model served at
+    tp=1, tp=2 and tp=4 produces byte-identical replies; trade
+    determinism for the extra memory with :func:`megatron_rules`.
+    """
+    col = (r"(^|[._])(q|k|v|query|key|value|wq|wk|wv|q_proj|k_proj"
+           r"|v_proj|ff1|fc1|up_proj|gate_proj|wi|w1)"
+           r"([._](w|weight|kernel))?$")
+    row = (r"(^|[._])(o|out|attn_out|o_proj|out_proj|wo|dense|ff2|fc2"
+           r"|down_proj|w2)([._](w|weight|kernel))?$")
+    return [
+        # BERT-style compound names: the ffn expand half
+        (r"(^|[._])intermediate[._]dense[._](w|weight|kernel)$",
+         P(None, axis)),
+        (col, P(None, axis)),
+        # row-parallel halves need a psum: replicate for bit-stability
+        (row, P()),
+        # feature-sharded embeddings put a layernorm reduction across
+        # ranks; vocab-sharded ones need a masked psum — replicate
+        (r"(emb|embed|embedding|wte|wpe)\w*$", P()),
+        # biases shard iff their paired weight is column-sharded
+        (_BIAS_TOKEN.pattern, BIAS_PAIR),
+    ]
+
+
+def megatron_rules(axis: str = "tp") -> List[Tuple[str, Any]]:
+    """The full Megatron column layout: EVERY 2-D weight (embeddings
+    included) shards its last dim over ``axis`` — maximum per-device
+    memory savings, at the cost of cross-shard psums whose float
+    re-association makes outputs differ from tp=1 at the ~1e-6 level
+    (so capture digests do NOT survive a resharding). Pass as
+    ``rules=``/overrides where HBM is the binding constraint."""
+    return [
+        # embedding/vocab tables: shard the embedding dim, not vocab
+        (r"(emb|embed|embedding|wte|wpe)\w*$", P(None, axis)),
+        # 2-D projection weights (importer names: <node>_w, .weight)
+        (r"(\.|_|^)(w|weight|kernel)(_\w+)?$", P(None, axis)),
+        # biases shard iff their paired weight is column-sharded
+        (_BIAS_TOKEN.pattern, BIAS_PAIR),
+    ]
+
+
+@dataclass
+class Claim:
+    """Why one param got the layout it got — a coverage-report row."""
+    param: str
+    spec: P
+    rule: Optional[str]          # regex text, or None for a fallback
+    reason: str                  # "rule" | "bias_pair" | "degraded" | ...
+    shape: Tuple[int, ...] = ()
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"param": self.param, "spec": str(self.spec),
+                "rule": self.rule, "reason": self.reason,
+                "shape": list(self.shape)}
+
+
+@dataclass
+class CoverageReport:
+    """Per-param placement provenance, queryable and log-friendly."""
+    claims: List[Claim] = field(default_factory=list)
+
+    def add(self, claim: Claim) -> None:
+        self.claims.append(claim)
+
+    def by_reason(self, reason: str) -> List[Claim]:
+        return [c for c in self.claims if c.reason == reason]
+
+    def claims_by_name(self) -> Dict[str, Claim]:
+        return {c.param: c for c in self.claims}
+
+    def rule_for(self, param: str) -> Optional[str]:
+        for c in self.claims:
+            if c.param == param:
+                return c.rule
+        return None
+
+    def spec_for(self, param: str) -> Optional[P]:
+        for c in self.claims:
+            if c.param == param:
+                return c.spec
+        return None
+
+    def sharded(self) -> List[Claim]:
+        return [c for c in self.claims if tuple(c.spec) != ()]
+
+    def summary(self) -> Dict[str, Any]:
+        reasons: Dict[str, int] = {}
+        for c in self.claims:
+            reasons[c.reason] = reasons.get(c.reason, 0) + 1
+        return {"params": len(self.claims),
+                "sharded": len(self.sharded()),
+                "reasons": reasons}
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"summary": self.summary(),
+                "claims": [c.as_dict() for c in self.claims]}
+
+
+def _divisible(shape: Tuple[int, ...], spec: P,
+               mesh_axes: Dict[str, int]) -> bool:
+    """Every sharded dim must divide its axis-product; the spec must not
+    name more dims than the param has."""
+    if len(spec) > len(shape):
+        return False
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            continue
+        names = axes if isinstance(axes, tuple) else (axes,)
+        size = 1
+        for a in names:
+            if a not in mesh_axes:
+                return False
+            size *= mesh_axes[a]
+        if size > 1 and (dim < size or dim % size):
+            return False
+    return True
+
+
+def _fallback_spec(shape: Tuple[int, ...], dtype: Any, axis: str,
+                   n: int) -> Tuple[P, str]:
+    """The pre-registry heuristic, kept as the miss path: column-shard a
+    2-D float weight when its last dim divides, else replicate."""
+    floating = dtype is not None and np.issubdtype(
+        np.dtype(dtype), np.floating)
+    if (len(shape) == 2 and floating and shape[-1] >= n
+            and shape[-1] % n == 0):
+        return P(None, axis), "fallback"
+    return P(), "fallback_replicate"
+
+
+def paired_weight_names(bias_name: str) -> List[str]:
+    """Candidate weight names for a bias: the bias token swapped for
+    each weight token (``l0_q_b`` → ``l0_q_w`` … ``l0_q_kernel``)."""
+    m = _BIAS_TOKEN.search(bias_name)
+    if not m:
+        return []
+    suf = m.group("suf") or ""
+    return [bias_name[:m.start("tok")] + tok + suf
+            for tok in _WEIGHT_TOKENS]
+
+
+def _column_sharded(spec: Optional[P], axis: str) -> bool:
+    if spec is None or not tuple(spec):
+        return False
+    last = tuple(spec)[-1]
+    names = last if isinstance(last, tuple) else (last,)
+    return axis in names
+
+
+def match_partition_rules(
+    params: Dict[str, Any],
+    mesh: Mesh,
+    rules: Optional[Sequence[Tuple[str, Any]]] = None,
+    axis: str = "tp",
+    overrides: Optional[Sequence[Tuple[str, Any]]] = None,
+) -> Tuple[Dict[str, P], CoverageReport]:
+    """Resolve a spec for every param: ``(specs, coverage)``.
+
+    ``rules`` defaults to :func:`default_rules`; ``overrides`` (the
+    per-model escape hatch) are prepended so they win over any default.
+    First ``re.search`` match claims the param. A claimed param whose
+    dims cannot divide the named axes degrades to replicate with a
+    logged coverage warning; a missed param takes the divisibility
+    fallback; scalars always replicate. :data:`BIAS_PAIR` claims resolve
+    in a second pass once every weight's layout is known.
+    """
+    base = list(rules) if rules is not None else default_rules(axis)
+    ordered: List[Tuple[str, Any]] = [
+        (pat, s if (isinstance(s, str) and s == BIAS_PAIR) else as_spec(s))
+        for pat, s in list(overrides or []) + base]
+    mesh_axes = dict(mesh.shape)
+    n = mesh_axes.get(axis, 1)
+    specs: Dict[str, P] = {}
+    report = CoverageReport()
+    deferred: List[Tuple[str, str, Tuple[int, ...]]] = []  # name, pat, shape
+
+    for name, v in params.items():
+        shape = tuple(getattr(v, "shape", ()))
+        dtype = getattr(v, "dtype", None)
+        if len(shape) == 0:
+            specs[name] = P()
+            report.add(Claim(name, P(), None, "scalar", shape))
+            continue
+        claimed = None
+        for pat, spec in ordered:
+            if re.search(pat, name):
+                claimed = (pat, spec)
+                break
+        if claimed is None:
+            spec, reason = _fallback_spec(shape, dtype, axis, n)
+            specs[name] = spec
+            report.add(Claim(name, spec, None, reason, shape))
+            continue
+        pat, spec = claimed
+        if isinstance(spec, str):  # BIAS_PAIR sentinel
+            deferred.append((name, pat, shape))
+            continue
+        if tuple(spec) and not _divisible(shape, spec, mesh_axes):
+            log.warning(
+                "partition rule %r claimed %s%s but %s does not divide "
+                "the mesh — degrading to replicate", pat, name,
+                list(shape), str(spec))
+            specs[name] = P()
+            report.add(Claim(name, P(), pat, "degraded", shape))
+            continue
+        specs[name] = spec
+        report.add(Claim(name, spec, pat, "rule", shape))
+
+    # second pass: bias pairing against the now-resolved weight layouts
+    for name, pat, shape in deferred:
+        paired = None
+        for cand in paired_weight_names(name):
+            w = params.get(cand)
+            if w is None:
+                continue
+            w_shape = tuple(getattr(w, "shape", ()))
+            if (_column_sharded(specs.get(cand), axis)
+                    and len(shape) == 1 and w_shape
+                    and w_shape[-1] == shape[0]):
+                paired = cand
+                break
+        if paired is None:
+            specs[name] = P()
+            report.add(Claim(name, P(), pat, "unpaired_bias", shape))
+            continue
+        spec = P(axis)
+        if not _divisible(shape, spec, mesh_axes):
+            log.warning(
+                "bias %s pairs with column-sharded %s but %s does not "
+                "divide axis %r — degrading to replicate", name, paired,
+                list(shape), axis)
+            specs[name] = P()
+            report.add(Claim(name, P(), pat, "degraded", shape))
+            continue
+        specs[name] = spec
+        report.add(Claim(name, spec, pat, "bias_pair", shape))
+    return specs, report

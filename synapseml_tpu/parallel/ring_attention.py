@@ -123,6 +123,28 @@ def make_ring_attention(
     return fn
 
 
+def make_serving_ring_attention(mesh: Mesh, *, causal: bool = False):
+    """Ring attention over the SERVING ``dp×tp`` mesh.
+
+    The serving runtime's tensor-parallel lane builds a 2-axis
+    ``("dp", "tp")`` mesh (runtime/executor.py) — there is no dedicated
+    ``sp`` axis in a scoring pod. For long-sequence transformer graphs
+    the same ``tp`` ranks double as the K/V ring: the sequence shards
+    over ``tp`` (heads stay local), the batch stays on ``dp``, and each
+    chip holds O(S/tp) of K/V while blocks rotate via ``ppermute`` —
+    context length scales with the tp degree using the mesh the
+    partition-rule registry already placed the weights on.
+
+    ``mesh`` must carry ``dp`` and ``tp`` axes; global q,k,v are
+    [B, S, H, D] with B divisible by dp and S by tp."""
+    names = tuple(mesh.axis_names)
+    if "dp" not in names or "tp" not in names:
+        raise ValueError(
+            f"serving ring attention needs a dp×tp mesh, got axes {names}")
+    return make_ring_attention(mesh, seq_axis="tp", head_axis=None,
+                               batch_axis="dp", causal=causal)
+
+
 def make_ulysses_attention(
     mesh: Mesh,
     *,

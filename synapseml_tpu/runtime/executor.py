@@ -638,6 +638,9 @@ class BatchedExecutor:
         devices: Union[None, str, int, Sequence[jax.Device]] = None,
         cache_key: Optional[str] = None,
         cache_dir: Optional[str] = None,
+        tensor_parallel: int = 1,
+        bound_specs: Optional[Tuple[Any, ...]] = None,
+        tp_compute: str = "gather",
     ):
         """``bound_args`` are prepended to every call unpadded — use for a
         weights pytree so it is device-resident and *shared* across all shape
@@ -676,18 +679,71 @@ class BatchedExecutor:
         :meth:`warmup` persists AOT-compiled buckets into, so a
         restarted process deserializes instead of recompiling
         (runtime/compile_cache.py). Any miss, version skew, or corrupt
-        entry silently degrades to a fresh compile."""
+        entry silently degrades to a fresh compile.
+
+        ``tensor_parallel`` > 1 splits ``devices`` into a 2-axis
+        ``dp×tp`` mesh (``dp = len(devices) // tensor_parallel``): the
+        batch still shards over ``dp`` only, while ``bound_specs`` — a
+        tuple aligned with ``bound_args`` holding a PartitionSpec
+        pytree per bound arg (or None to replicate one) — places the
+        weights over ``tp`` by the partition-rule registry's matched
+        specs (parallel/partition_rules.py). GSPMD carries the layouts
+        through the program; the mesh shape is folded into both the
+        AOT warmup keys and the executable-store keys, so tp=2 and
+        tp=4 restarts of the same model never collide and the
+        recompile sentinel stays silent across resharding.
+
+        ``tp_compute`` picks the compute formulation under tp > 1:
+
+        - ``"gather"`` (default): weights live tp-sharded AT REST (the
+          per-device HBM and /debug/memory story) but are all-gathered
+          at function entry via a replicate sharding constraint, so
+          every matmul runs the exact single-device formulation —
+          replies are BITWISE identical to tp=1 (the capture/replay
+          digest contract), because an all-gather is a concatenation,
+          not a reduction.
+        - ``"sharded"``: true tensor-parallel compute — GSPMD keeps the
+          weights sharded through the matmuls. Minimum peak memory,
+          but cross-shard partial sums reassociate float adds:
+          measured ~1e-6 drift vs tp=1 on the transformer zoo model,
+          which breaks digest stability across reshardings. Opt in
+          when capacity matters more than replay equality."""
         devices = resolve_devices(devices)
         if devices is not None and device is not None:
             raise ValueError("pass either device= or devices=, not both")
+        tp = max(1, int(tensor_parallel))
+        if tp_compute not in ("gather", "sharded"):
+            raise ValueError(
+                f"tp_compute={tp_compute!r} (expected 'gather' or "
+                "'sharded')")
+        if tp > 1:
+            if devices is None:
+                raise ValueError(
+                    f"tensor_parallel={tp} requires devices= (a multi-"
+                    "device topology to partition over)")
+            if len(devices) % tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} does not divide the "
+                    f"{len(devices)}-device topology")
         if devices is not None and len(devices) == 1:
             device, devices = devices[0], None
         self._device = device
         self._devices = devices
+        self._tp = tp if devices is not None else 1
+        self._dp = (len(devices) // self._tp if devices is not None else 1)
+        self._tp_compute = tp_compute if self._tp > 1 else "gather"
         if devices is not None:
             from jax.sharding import (Mesh, NamedSharding,
                                       PartitionSpec)  # local: cheap import
-            self._mesh = Mesh(np.asarray(devices), ("dp",))
+            if self._tp > 1:
+                # batch over dp, params over tp: P("dp") on a 2-axis
+                # mesh replicates the batch across tp ranks, which each
+                # hold their registry-matched weight shard
+                self._mesh = Mesh(
+                    np.asarray(devices).reshape(self._dp, self._tp),
+                    ("dp", "tp"))
+            else:
+                self._mesh = Mesh(np.asarray(devices), ("dp",))
             self._shard_data = NamedSharding(self._mesh, PartitionSpec("dp"))
             self._shard_repl = NamedSharding(self._mesh, PartitionSpec())
         else:
@@ -706,12 +762,26 @@ class BatchedExecutor:
         self._depth = max(1, int(pipeline_depth))
         self._stage_workers = max(1, int(stage_workers))
         if devices is not None:
-            # weights replicated once across the mesh: every shard of a
-            # dp-split batch (and the sharded jit) reads its local copy
-            self._bound = tuple(
-                jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, self._shard_repl), b)
-                for b in bound_args)
+            # weights placed once across the mesh: by their matched
+            # PartitionSpecs when the caller passed bound_specs (the
+            # tensor-parallel layout), replicated otherwise — every
+            # shard of a dp-split batch (and the sharded jit) reads its
+            # local copy/shard either way
+            from jax.sharding import NamedSharding as _NS
+            specs = tuple(bound_specs or ())
+            placed = []
+            for i, b in enumerate(bound_args):
+                spec_tree = specs[i] if i < len(specs) else None
+                if spec_tree is None:
+                    placed.append(jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, self._shard_repl), b))
+                else:
+                    # PartitionSpec is a pytree leaf, so a dict of specs
+                    # zips against a params dict directly
+                    placed.append(jax.tree_util.tree_map(
+                        lambda a, s: jax.device_put(
+                            a, _NS(self._mesh, s)), b, spec_tree))
+            self._bound = tuple(placed)
         else:
             self._bound = tuple(
                 jax.tree_util.tree_map(
@@ -733,7 +803,27 @@ class BatchedExecutor:
         elif transfer_batches != "auto":
             transfer_batches = max(1, int(transfer_batches))
         self._transfer_batches = transfer_batches  # "auto" = ~32MB groups
-        self._fn = fn
+        if self._tp > 1 and self._tp_compute == "gather":
+            # bitwise contract: constrain every bound leaf back to
+            # replicated INSIDE the program — XLA all-gathers the
+            # tp-sharded weights at entry (exact concatenation, no
+            # reduction) and the matmuls run the proven dp-only
+            # formulation. GSPMD is otherwise free to keep activations
+            # sharded through row-parallel contractions, and the psum
+            # it inserts reassociates float adds (measured 1e-6 drift)
+            _nb = len(bound_args)
+            _repl = self._shard_repl
+
+            def _gathered(*a, _inner=fn, _nb=_nb, _repl=_repl):
+                gathered = tuple(
+                    jax.tree_util.tree_map(
+                        lambda x: jax.lax.with_sharding_constraint(
+                            x, _repl), t)
+                    for t in a[:_nb])
+                return _inner(*gathered, *a[_nb:])
+            self._fn = _gathered
+        else:
+            self._fn = fn
         # donation indices depend on the call arity AND on which inputs an
         # output can alias (shape/dtype match) — one jitted callable per
         # (arity, donate-mask); jax itself caches executables per input
@@ -790,30 +880,53 @@ class BatchedExecutor:
         # dispatch thread can route a bucket to — rr/single layouts
         # count per chip, a dp-sharded bucket counts ONCE under its
         # mesh label, so the sum across series is always total batches
-        if devices is not None:
+        if devices is not None and self._tp > 1:
+            # tp×dp mesh: every bucket rides the one sharded jit (or its
+            # replicated-input variant) — no round-robin lane, one mesh
+            # label so the series sum stays total batches
+            self._mesh_label = f"dp{self._dp}xtp{self._tp}"
+            self._m_disp_rr = ()
+            self._m_disp_one = _tm.counter(
+                "executor_dispatch_total", device=self._mesh_label)
+        elif devices is not None:
+            self._mesh_label = f"dp{len(devices)}"
             self._m_disp_rr = tuple(
                 _tm.counter("executor_dispatch_total", device=str(d.id))
                 for d in devices)
             self._m_disp_one = _tm.counter(
-                "executor_dispatch_total", device=f"dp{len(devices)}")
+                "executor_dispatch_total", device=self._mesh_label)
         else:
+            self._mesh_label = (str(device.id) if device is not None
+                                else "default")
             self._m_disp_rr = ()
             self._m_disp_one = _tm.counter(
-                "executor_dispatch_total",
-                device=str(device.id) if device is not None else "default")
+                "executor_dispatch_total", device=self._mesh_label)
         self._m_bucket: Dict[int, _tm.Counter] = {}
         # performance observatory (runtime/perfwatch.py): per-device
         # memory gauges once per process, plus a duty-cycle gauge per
         # dispatch target this executor counts under — both sampled at
         # scrape time only, nothing on the hot path
         _pw.ensure_registered()
-        if devices is not None:
+        if devices is not None and self._tp > 1:
+            _pw.register_duty_gauge(self._mesh_label)
+        elif devices is not None:
             for d in devices:
                 _pw.register_duty_gauge(str(d.id))
-            _pw.register_duty_gauge(f"dp{len(devices)}")
+            _pw.register_duty_gauge(self._mesh_label)
         else:
-            _pw.register_duty_gauge(
-                str(device.id) if device is not None else "default")
+            _pw.register_duty_gauge(self._mesh_label)
+        # per-device parameter residency: the placed bound args' actual
+        # shard bytes feed the tp_param_bytes{device=} gauges — the
+        # checkable form of "the model no longer fits on one chip"
+        # (cleared when the executor is dropped; close() clears eagerly)
+        self._tp_bytes_owner: Optional[int] = None
+        if devices is not None and self._bound:
+            from synapseml_tpu.parallel.onnx_tp import param_bytes_per_device
+            per_dev = param_bytes_per_device(self._bound)
+            self._tp_bytes_owner = _pw.record_tp_param_bytes(
+                {str(d.id): int(n) for d, n in per_dev.items()})
+            weakref.finalize(self, _pw.clear_tp_param_bytes,
+                             self._tp_bytes_owner)
 
     @property
     def pipeline_depth(self) -> int:
@@ -966,9 +1079,18 @@ class BatchedExecutor:
         collectives for per-row programs), ``"rr"`` (round-robin whole
         buckets onto successive devices) when it cannot — non-pow2
         topologies, or buckets smaller than the device count — and
-        ``"single"`` without ``devices``."""
+        ``"single"`` without ``devices``.
+
+        Under ``tensor_parallel`` > 1 the round-robin fallback is
+        unsound — the weights live sharded across ALL devices, so no
+        single chip can run a whole bucket — and a dp-indivisible
+        bucket instead rides ``"tp_rep"``: the same mesh-wide jit with
+        the batch replicated (every tp rank still computes only its
+        weight shard; GSPMD inserts the collectives either way)."""
         if self._devices is None:
             return "single"
+        if self._tp > 1:
+            return "shard" if bucket % self._dp == 0 else "tp_rep"
         return "shard" if bucket % len(self._devices) == 0 else "rr"
 
     def _bound_for_device(self, dev: jax.Device) -> tuple:
@@ -1047,6 +1169,9 @@ class BatchedExecutor:
             # broken pipeline after (or while) close() marks the
             # executor permanently closed
             self._closed = True
+        if self._tp_bytes_owner is not None:
+            _pw.clear_tp_param_bytes(self._tp_bytes_owner)
+            self._tp_bytes_owner = None
         state = self._pipeline
         if state is None:
             with self._pipeline_init_lock:
@@ -1263,8 +1388,18 @@ class BatchedExecutor:
         out.append(top)
         return out
 
-    def _mesh_shape(self) -> Tuple[int, ...]:
-        return (len(self._devices),) if self._devices is not None else (1,)
+    def _mesh_shape(self) -> Tuple[Any, ...]:
+        """Folded into every AOT/store key (runtime/compile_cache.py):
+        a tp resharding changes the key, so tp=2 and tp=4 executables
+        never collide across restarts. tp=1 keeps the 1-tuple shape so
+        pre-tp store entries stay warm. Under tp the compute mode
+        rides along too — gather and sharded formulations compile
+        different HLO and must never deserialize into each other."""
+        if self._devices is None:
+            return (1,)
+        if self._tp > 1:
+            return (self._dp, self._tp, self._tp_compute)
+        return (len(self._devices),)
 
     def _device_kind(self) -> str:
         dev = (self._device if self._device is not None
@@ -1322,6 +1457,8 @@ class BatchedExecutor:
             mask = self._donate_mask_for_sig(sig)
             if layout == "shard":
                 targets = [(None, self._shard_data, self._bound, "shard")]
+            elif layout == "tp_rep":
+                targets = [(None, self._shard_repl, self._bound, "tp_rep")]
             elif layout == "rr":
                 targets = [
                     (i, SingleDeviceSharding(d), self._bound_for_device(d),
@@ -1467,6 +1604,12 @@ class BatchedExecutor:
         rr_idx: Optional[int] = None
         if layout == "shard":
             placement: Any = self._shard_data
+            bound = self._bound
+            self._m_disp_one.inc()
+        elif layout == "tp_rep":
+            # dp-indivisible bucket under tensor parallelism: replicate
+            # the batch over the mesh, weights stay tp-sharded
+            placement = self._shard_repl
             bound = self._bound
             self._m_disp_one.inc()
         elif layout == "rr":

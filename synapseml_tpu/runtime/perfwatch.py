@@ -62,6 +62,7 @@ __all__ = [
     "register_duty_gauge", "device_memory",
     "memory_snapshot", "duty_cycles", "check_high_water",
     "high_water_fraction", "set_high_water_fraction", "process_stats",
+    "record_tp_param_bytes", "clear_tp_param_bytes", "tp_param_bytes",
 ]
 
 _LOCK = threading.Lock()
@@ -105,6 +106,13 @@ class _State:
         # device keys whose hbm gauges are live — unregister_all() must
         # tear down exactly the label sets ensure_registered() created
         self.device_keys: set = set()
+        # per-owner {device_key: bytes} of executor-placed parameter
+        # shards (parallel/onnx_tp.param_bytes_per_device) — owners are
+        # tokens handed out by record_tp_param_bytes, cleared when an
+        # executor closes/drops; the tp_param_bytes{device=} gauges sum
+        # across live owners at scrape time
+        self.tp_bytes: Dict[int, Dict[str, int]] = {}
+        self.tp_bytes_next = 0
 
 
 _S = _State()
@@ -120,6 +128,42 @@ def set_high_water_fraction(frac: float) -> float:
     prev = _S.high_water
     _S.high_water = float(frac)
     return prev
+
+
+# -- tensor-parallel parameter residency ------------------------------------
+
+def record_tp_param_bytes(per_device: Dict[str, int]) -> int:
+    """Record one executor's placed parameter-shard bytes per device
+    key; returns an owner token for :func:`clear_tp_param_bytes`. The
+    value set is whatever ``param_bytes_per_device`` measured off the
+    actual placed arrays — under tensor parallelism each device holds
+    ~sharded/tp + the replicated remainder, and the gauges make that
+    claim scrapeable instead of anecdotal."""
+    with _LOCK:
+        token = _S.tp_bytes_next
+        _S.tp_bytes_next += 1
+        _S.tp_bytes[token] = {str(k): int(v)
+                              for k, v in per_device.items()}
+    return token
+
+
+def clear_tp_param_bytes(token: int) -> None:
+    """Drop one owner's record (executor close/GC finalizer)."""
+    with _LOCK:
+        _S.tp_bytes.pop(token, None)
+
+
+def tp_param_bytes(device_key: Optional[str] = None):
+    """Parameter bytes resident per device across live executors —
+    the whole dict, or one device's total."""
+    totals: Dict[str, int] = {}
+    with _LOCK:
+        for per in _S.tp_bytes.values():
+            for k, v in per.items():
+                totals[k] = totals.get(k, 0) + v
+    if device_key is None:
+        return totals
+    return totals.get(device_key, 0)
 
 
 # -- device memory ----------------------------------------------------------
@@ -287,9 +331,14 @@ def _mem_field(device_key: str, field: str) -> float:
 
 
 def memory_snapshot(force: bool = True) -> Dict[str, Any]:
-    """The ``GET /debug/memory`` payload: per-device records plus
-    process totals. ``force=True`` (the default) takes a fresh sample."""
-    devices = _sampled(force=force)
+    """The ``GET /debug/memory`` payload: per-device records (each
+    annotated with its executor-placed parameter-shard bytes) plus
+    process totals. ``force=True`` (the default) takes a fresh
+    sample."""
+    tpb = tp_param_bytes()
+    # annotate copies — _sampled()'s records are TTL-cached and shared
+    devices = [dict(d, tp_param_bytes=tpb.get(d["device"], 0))
+               for d in _sampled(force=force)]
     return {
         "ts": round(time.time(), 6),
         "pid": os.getpid(),
@@ -300,6 +349,7 @@ def memory_snapshot(force: bool = True) -> Dict[str, Any]:
             "live_buffers": sum(d["live_buffers"] for d in devices),
             "process_peak_bytes": sum(
                 d.get("process_peak_bytes", 0) for d in devices),
+            "tp_param_bytes": sum(tpb.values()),
         },
     }
 
@@ -440,6 +490,9 @@ def ensure_registered(lazy: bool = False) -> bool:
         _tm.gauge_fn("device_live_buffer_count",
                      lambda k=key: _mem_field(k, "live_buffers"),
                      device=key)
+        _tm.gauge_fn("tp_param_bytes",
+                     lambda k=key: float(tp_param_bytes(k)),
+                     device=key)
     return True
 
 
@@ -538,5 +591,6 @@ def unregister_all() -> None:
         _tm.unregister("device_hbm_bytes_limit", device=key)
         _tm.unregister("device_hbm_peak_bytes", device=key)
         _tm.unregister("device_live_buffer_count", device=key)
+        _tm.unregister("tp_param_bytes", device=key)
     for label in duty_labels:
         _tm.unregister("executor_duty_cycle", device=label)

@@ -254,3 +254,186 @@ def test_device_for_channel_round_robin():
     for i in range(2 * len(devs)):
         assert device_for_channel(i) == devs[i % len(devs)]
     assert device_for_channel(3, devices=devs[:2]) == devs[1]
+
+
+# ---------------------------------------------------------------------------
+# tensor parallelism: the dp×tp mesh lane (parallel/partition_rules.py)
+
+
+def _tp_transformer():
+    from synapseml_tpu.onnx import import_model, zoo
+
+    g = import_model(zoo.transformer_encoder(
+        100, 64, 4, 128, 2, seq_len=16, seed=3))
+    return g, (lambda p, x: g.apply(p, x))
+
+
+def _tp_specs(g, dp, tp):
+    from jax.sharding import Mesh
+
+    from synapseml_tpu.parallel.partition_rules import match_partition_rules
+
+    mesh = Mesh(np.array(jax.devices()[:dp * tp]).reshape(dp, tp),
+                ("dp", "tp"))
+    specs, report = match_partition_rules(g.params, mesh)
+    return specs, report
+
+
+@needs8
+@pytest.mark.parametrize("tp", [2, 4])
+def test_executor_tp_bit_identical_and_sharded_at_rest(tp):
+    """The tentpole contract end to end: params live tp-sharded at rest
+    (max per-device bytes == sharded/tp + replicated remainder), yet
+    every reply is BITWISE equal to the single-device executor — the
+    gather formulation all-gathers weights at entry, so no float ever
+    reassociates. Covers the dp-divisible shard layout AND the
+    indivisible tp_rep layout (n=5)."""
+    from synapseml_tpu.parallel.onnx_tp import param_bytes_per_device
+
+    g, fn = _tp_transformer()
+    specs, report = _tp_specs(g, 8 // tp, tp)
+    single = BatchedExecutor(fn, bound_args=(g.params,), max_bucket=8)
+    tpex = BatchedExecutor(fn, bound_args=(g.params,), max_bucket=8,
+                           devices="all", tensor_parallel=tp,
+                           bound_specs=(specs,))
+    try:
+        assert tpex._mesh_shape() == (8 // tp, tp, "gather")
+        # at-rest placement: the registry's sharded set really splits
+        per_dev = param_bytes_per_device(tpex._bound)
+        total = sum(v.nbytes for v in g.params.values())
+        sharded = sum(g.params[c.param].nbytes for c in report.sharded())
+        assert len(per_dev) == 8
+        assert max(per_dev.values()) == sharded // tp + (total - sharded)
+        for n in (8, 5, 1):  # shard, tp_rep, tp_rep layouts
+            ids = np.random.default_rng(n).integers(0, 100, (n, 16))
+            want = [np.asarray(a) for a in single.submit(ids).result()]
+            got = [np.asarray(a) for a in tpex.submit(ids).result()]
+            for w, t in zip(want, got):
+                assert w.dtype == t.dtype
+                assert np.array_equal(
+                    w.view(np.uint32), t.view(np.uint32)), (n, tp)
+    finally:
+        single.close()
+        tpex.close()
+
+
+@needs8
+def test_executor_tp_param_bytes_gauges_live_and_clear():
+    """tp_param_bytes{device=} gauges register at executor build with
+    one nonzero entry per mesh device, surface through memory_snapshot
+    (the /debug/memory payload), and clear on close()."""
+    import gc
+
+    from synapseml_tpu.runtime import perfwatch as pw
+
+    # the gauges sum over ALL live multi-device executors (earlier
+    # tests' model-cached ones included) — assert this executor's
+    # DELTA, after flushing any pending finalizers
+    gc.collect()
+    before = pw.tp_param_bytes()
+    g, fn = _tp_transformer()
+    specs, _ = _tp_specs(g, 2, 4)
+    ex = BatchedExecutor(fn, bound_args=(g.params,), max_bucket=8,
+                         devices="all", tensor_parallel=4,
+                         bound_specs=(specs,))
+    try:
+        tpb = pw.tp_param_bytes()
+        delta = {d: tpb.get(d, 0) - before.get(d, 0) for d in tpb}
+        assert len(delta) == 8 and all(v > 0 for v in delta.values())
+        snap = pw.memory_snapshot(force=True)
+        by_dev = {d["device"]: d for d in snap["devices"]}
+        for dev, n in tpb.items():
+            assert by_dev[dev]["tp_param_bytes"] == n
+        assert snap["totals"]["tp_param_bytes"] == sum(tpb.values())
+    finally:
+        ex.close()
+    assert pw.tp_param_bytes() == before
+
+
+@needs8
+def test_executor_tp_validation():
+    g, fn = _tp_transformer()
+    with pytest.raises(ValueError, match="requires devices"):
+        BatchedExecutor(fn, bound_args=(g.params,), tensor_parallel=2)
+    with pytest.raises(ValueError, match="does not divide"):
+        BatchedExecutor(fn, bound_args=(g.params,), devices="all",
+                        tensor_parallel=3)
+    with pytest.raises(ValueError, match="tp_compute"):
+        BatchedExecutor(fn, bound_args=(g.params,), devices="all",
+                        tensor_parallel=2, tp_compute="magic")
+
+
+@needs8
+def test_executor_tp_no_recompiles_after_warmup():
+    """The recompile sentinel must stay silent under tp: every layout
+    (shard + tp_rep) AOT-warms, and serving-shaped traffic afterwards
+    never lands on a dispatch-path compile."""
+    from synapseml_tpu.runtime import telemetry as tm
+
+    def recompiles():
+        return sum(
+            float(ln.rsplit(" ", 1)[1])
+            for ln in tm.prometheus_text().splitlines()
+            if ln.startswith("synapseml_executor_recompiles_total"))
+
+    g, fn = _tp_transformer()
+    specs, _ = _tp_specs(g, 2, 4)
+    ex = BatchedExecutor(fn, bound_args=(g.params,), max_bucket=8,
+                         devices="all", tensor_parallel=4,
+                         bound_specs=(specs,))
+    try:
+        ex.warmup([((16,), np.int64)])
+        before = recompiles()
+        for n in (8, 5, 3, 1):
+            ids = np.random.default_rng(n).integers(0, 100, (n, 16))
+            ex.submit(ids).result()
+        assert recompiles() == before
+    finally:
+        ex.close()
+
+
+@needs8
+def test_onnxmodel_tensor_parallel_bit_identical():
+    """ONNXModel wiring: tensor_parallel=N scores byte-identically to
+    the default single-device path, and the coverage report names the
+    rule that claimed each param."""
+    from synapseml_tpu.data.table import Table
+    from synapseml_tpu.onnx import ONNXModel, zoo
+
+    payload = zoo.transformer_encoder(100, 64, 4, 128, 2,
+                                      seq_len=16, seed=3)
+    tok = np.random.default_rng(0).integers(
+        0, 100, size=(8, 16)).astype(np.int32)
+    table = Table({"tokens": tok})
+    kw = dict(model_payload=payload, mini_batch_size=8,
+              feed_dict={"tokens": "tokens"})
+
+    def out(t):
+        return np.stack([np.asarray(x, np.float32)
+                         for x in t[t.columns[-1]]])
+
+    base = ONNXModel().set(**kw)
+    want = out(base.transform(table))
+    m = ONNXModel().set(devices="all", tensor_parallel=4, **kw)
+    got = out(m.transform(table))
+    try:
+        assert np.array_equal(want.view(np.uint32), got.view(np.uint32))
+        cov = m.partition_coverage()
+        assert cov["summary"]["params"] == 37
+        assert cov["summary"]["sharded"] >= 16
+        assert base.partition_coverage() is None
+    finally:
+        m._executor().close()
+
+
+@needs8
+def test_onnxmodel_tensor_parallel_validation():
+    from synapseml_tpu.onnx import ONNXModel, zoo
+
+    payload = zoo.mlp([16, 32], num_classes=4, seed=0)
+    with pytest.raises(ValueError, match="requires"):
+        ONNXModel().set(model_payload=payload,
+                        tensor_parallel=2)._executor()
+    with pytest.raises(ValueError, match="divide"):
+        ONNXModel().set(model_payload=payload, devices="all",
+                        tensor_parallel=3)._executor()

@@ -346,10 +346,15 @@ def test_imported_onnx_graph_runs_tensor_parallel():
     from synapseml_tpu.onnx import import_model, zoo
     from synapseml_tpu.parallel.onnx_tp import tp_jit
 
+    from synapseml_tpu.parallel.partition_rules import megatron_rules
+
     g = import_model(zoo.transformer_encoder(
         100, 64, 4, 128, 2, seq_len=16, seed=3))
     mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
-    params, run = tp_jit(g, mesh)
+    # the full Megatron preset: every 2-D weight shards (maximum memory
+    # savings; the reduction-free default preset is covered by the
+    # partition-rule tests, which additionally assert bit-identity)
+    params, run = tp_jit(g, mesh, rules=megatron_rules())
     # every 2-D weight actually sharded over tp (64 and 128 divide by 4)
     sharded = [k for k, v in params.items()
                if getattr(v.sharding, "spec", None) is not None
@@ -361,11 +366,14 @@ def test_imported_onnx_graph_runs_tensor_parallel():
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
     # the memory claim is an invariant, not prose: per-device parameter
-    # bytes must be ~total/n (exactly: sharded/n + replicated remainder)
+    # bytes must be ~total/n (exactly: sharded/n + replicated remainder).
+    # The sharded set now includes paired biases (P('tp')), so compute it
+    # from actual placements rather than the 2-D weight list above.
     from synapseml_tpu.parallel.onnx_tp import param_bytes_per_device
     total = sum(v.nbytes for v in g.params.values())
     sharded_total = sum(
-        g.params[k].nbytes for k in sharded)
+        g.params[k].nbytes for k, v in params.items()
+        if tuple(v.sharding.spec) != ())
     expected = sharded_total // 4 + (total - sharded_total)
     per_dev = param_bytes_per_device(params)
     assert len(per_dev) == 4
@@ -410,3 +418,179 @@ def test_imported_onnx_graph_runs_tensor_parallel():
     io = np.load(fx.replace(".onnx", "_io.npz"))
     got2 = np.asarray(run2(params2, io["input"])[0])
     np.testing.assert_allclose(got2, io["expected"], atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# partition-rule registry (parallel/partition_rules.py)
+
+
+def _registry_mesh(dp=2, tp=4):
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    assert len(devs) >= dp * tp
+    return Mesh(np.array(devs[:dp * tp]).reshape(dp, tp), ("dp", "tp"))
+
+
+def test_partition_rules_first_match_wins():
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.parallel.partition_rules import match_partition_rules
+
+    mesh = _registry_mesh()
+    params = {"l0_q_w": np.zeros((8, 8), np.float32)}
+    # two rules both match; the FIRST claims the param
+    rules = [(r"q_w$", P("tp", None)), (r"_w$", P(None, "tp"))]
+    specs, report = match_partition_rules(params, mesh, rules=rules)
+    assert specs["l0_q_w"] == P("tp", None)
+    assert report.rule_for("l0_q_w") == r"q_w$"
+    # swapped order, the other rule wins
+    specs2, report2 = match_partition_rules(
+        params, mesh, rules=list(reversed(rules)))
+    assert specs2["l0_q_w"] == P(None, "tp")
+    assert report2.rule_for("l0_q_w") == r"_w$"
+
+
+def test_partition_rules_overrides_precede_defaults():
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.parallel.partition_rules import match_partition_rules
+
+    mesh = _registry_mesh()
+    params = {"l0_q_w": np.zeros((8, 8), np.float32),
+              "l0_ff2_w": np.zeros((8, 8), np.float32)}
+    # defaults would column-shard q_w; an override pins it replicated
+    specs, report = match_partition_rules(
+        params, mesh, overrides=[(r"q_w$", P())])
+    assert specs["l0_q_w"] == P()
+    assert report.rule_for("l0_q_w") == r"q_w$"
+    # non-overridden params still flow to the default rules
+    assert specs["l0_ff2_w"] == P()  # row half replicates under defaults
+
+
+def test_partition_rules_miss_hits_divisibility_fallback():
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.parallel.partition_rules import match_partition_rules
+
+    mesh = _registry_mesh()  # tp axis size 4
+    params = {
+        "mystery_matrix": np.zeros((6, 8), np.float32),   # 8 % 4 == 0
+        "odd_matrix": np.zeros((6, 7), np.float32),       # 7 % 4 != 0
+        "int_table": np.zeros((6, 8), np.int32),          # non-float
+        "vector": np.zeros((8,), np.float32),             # not 2-D
+    }
+    specs, report = match_partition_rules(params, mesh)
+    # no rule names these: 2-D float with a divisible last dim still
+    # column-shards (the old ndim==2 heuristic, demoted to fallback)
+    assert specs["mystery_matrix"] == P(None, "tp")
+    assert report.claims_by_name()["mystery_matrix"].reason == "fallback"
+    for k in ("odd_matrix", "int_table", "vector"):
+        assert specs[k] == P(), k
+        assert report.claims_by_name()[k].reason == "fallback_replicate", k
+
+
+def test_partition_rules_indivisible_degrades_with_warning(caplog):
+    import logging
+
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.parallel.partition_rules import match_partition_rules
+
+    mesh = _registry_mesh()  # tp axis size 4
+    params = {"l0_q_w": np.zeros((8, 6), np.float32)}  # 6 % 4 != 0
+    with caplog.at_level(logging.WARNING,
+                         logger="synapseml_tpu.parallel.partition_rules"):
+        specs, report = match_partition_rules(params, mesh)
+    # the default column rule CLAIMS it, but the dim does not divide the
+    # axis: degrade to replicate — never a GSPMD shape error — and say so
+    assert specs["l0_q_w"] == P()
+    assert report.claims_by_name()["l0_q_w"].reason == "degraded"
+    assert any("l0_q_w" in r.message and "degrad" in r.message
+               for r in caplog.records)
+
+
+def test_partition_rules_bias_pairs_with_column_sharded_weight():
+    from jax.sharding import PartitionSpec as P
+
+    from synapseml_tpu.parallel.partition_rules import match_partition_rules
+
+    mesh = _registry_mesh()
+    params = {
+        "l0_q_w": np.zeros((8, 8), np.float32),   # column-sharded
+        "l0_q_b": np.zeros((8,), np.float32),     # pairs with q_w
+        "l0_ln1_b": np.zeros((8,), np.float32),   # layernorm: no weight pair
+        "l0_ff2_w": np.zeros((8, 8), np.float32),  # row half: replicated
+        "l0_ff2_b": np.zeros((8,), np.float32),   # pair NOT column-sharded
+    }
+    specs, report = match_partition_rules(params, mesh)
+    by = report.claims_by_name()
+    # the satellite fix: a bias whose weight pair is column-sharded rides
+    # the same axis instead of replicating
+    assert specs["l0_q_w"] == P(None, "tp")
+    assert specs["l0_q_b"] == P("tp")
+    assert by["l0_q_b"].reason == "bias_pair"
+    # a bias with no column-sharded pair must stay replicated
+    assert specs["l0_ln1_b"] == P()
+    assert by["l0_ln1_b"].reason == "unpaired_bias"
+    assert specs["l0_ff2_b"] == P()
+    assert by["l0_ff2_b"].reason == "unpaired_bias"
+
+
+def test_partition_rules_coverage_report_accounts_every_param():
+    from synapseml_tpu.onnx import import_model, zoo
+    from synapseml_tpu.parallel.partition_rules import match_partition_rules
+
+    g = import_model(zoo.transformer_encoder(
+        100, 64, 4, 128, 2, seq_len=16, seed=3))
+    mesh = _registry_mesh()
+    specs, report = match_partition_rules(g.params, mesh)
+    assert set(specs) == set(g.params)
+    assert {c.param for c in report.claims} == set(g.params)
+    summary = report.summary()
+    assert summary["params"] == len(g.params)
+    assert summary["sharded"] == len(report.sharded())
+    # round-trips to JSON for /debug + logs
+    json.dumps(report.as_dict())
+
+
+def test_tp_jit_default_rules_bit_identical_on_tp_dp_mesh():
+    """The digest contract behind capture/replay: under the DEFAULT
+    (reduction-free) rules every cross-device edge is an all-gather —
+    a concatenation, not a reduction — so a tp×dp-sharded forward is
+    BITWISE equal to the single-device graph, not merely allclose."""
+    from synapseml_tpu.onnx import import_model, zoo
+    from synapseml_tpu.parallel.onnx_tp import tp_jit
+
+    g = import_model(zoo.transformer_encoder(
+        100, 64, 4, 128, 2, seq_len=16, seed=3))
+    mesh = _registry_mesh(dp=2, tp=4)
+    params, run, report = tp_jit(g, mesh, with_report=True)
+    assert len(report.sharded()) >= 12
+    ids = np.random.default_rng(0).integers(0, 100, (6, 16))
+    want = np.asarray(g.apply(g.params, ids)[0])
+    got = np.asarray(run(params, ids)[0])
+    assert want.dtype == got.dtype
+    assert np.array_equal(
+        got.view(np.uint32), want.view(np.uint32)), (
+        np.abs(got - want).max())
+
+
+def test_serving_ring_attention_rides_dp_tp_mesh():
+    from jax.sharding import Mesh
+
+    from synapseml_tpu.parallel.ring_attention import (
+        dense_attention, make_serving_ring_attention)
+
+    mesh = _registry_mesh(dp=2, tp=4)
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((2, 16, 4, 8)).astype(
+        np.float32)) for _ in range(3))
+    fn = make_serving_ring_attention(mesh, causal=True)
+    with mesh:
+        got = jax.jit(fn)(q, k, v)
+    want = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="dp×tp|dp.tp"):
+        make_serving_ring_attention(Mesh(np.array(jax.devices()[:4]),
+                                         ("sp",)))

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Tensor-parallel serving smoke (tools/ci/tp_check.py, docs/perf.md
+# "Round 18 — tensor-parallel serving"): on a forced-8-device virtual
+# CPU platform, a real serving subprocess scores a transformer at
+# --tensor-parallel 2 — shard gauges must show weights resident on
+# >= 2 devices, post-warmup recompiles must stay ZERO, and the
+# captured traffic must replay bit-identically at --tensor-parallel 4
+# (exit 2 on divergence). A wedged tp warmup would HANG rather than
+# fail — the timeout turns that into a fast exit-124.
+#
+# Usage: tools/ci/smoke_tp.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+exec timeout -k 10 "${SMOKE_TIMEOUT:-900}" \
+  python tools/ci/tp_check.py

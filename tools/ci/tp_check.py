@@ -1,0 +1,246 @@
+"""Tensor-parallel serving proof (docs/perf.md "Round 18"): the whole
+tp×dp stack on a REAL serving subprocess, on a forced-8-device CPU
+platform (the TPU-slice stand-in) —
+
+1. a serving replica scores a TRANSFORMER (int32 token ids — the
+   pipeline feeds the graph's declared input dtype) at
+   ``--tensor-parallel 2`` with capture armed at head-sample 1.0, AOT
+   warmed against a shared ``ExecutableStore``;
+2. ``/debug/memory`` must show ``tp_param_bytes`` RESIDENT on at least
+   two devices — the weights actually rest sharded, not replicated;
+3. after warmup, live traffic must leave
+   ``executor_recompiles_total`` at ZERO — the mesh layout is folded
+   into every warmup signature, so resharded serving never compiles
+   on the scoring path;
+4. the capture file is replayed OFFLINE at ``--tensor-parallel 4``
+   (tools/replay.py's resharding canary): every record must reproduce
+   a bit-identical digest — the registry's default gather formulation
+   makes tp=2 and tp=4 replies bitwise equal, so any divergence is a
+   real determinism break;
+5. a deliberately perturbed record must make the replay exit 2 with a
+   divergence report naming the rid — the canary actually bites.
+
+Driven by tools/ci/smoke_tp.sh under a hard timeout: a wedged tp
+warmup hangs rather than fails, so it becomes a fast exit-124.
+"""
+import hashlib
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+
+SEQ_LEN = 16
+VOCAB = 100
+REQUESTS = 10
+
+
+def series_total(text: str, name: str) -> float:
+    total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(name) and not ln.startswith(name + "_"):
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def get(url: str, timeout: float = 15.0):
+    with urllib.request.urlopen(urllib.request.Request(url),
+                                timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def post(url: str, obj, timeout: float = 120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers.items())
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers.items()) if e.headers else {}
+
+
+def main() -> int:
+    from synapseml_tpu.onnx import zoo
+
+    work = tempfile.mkdtemp(prefix="tp_proof_")
+    model_path = os.path.join(work, "model.onnx")
+    with open(model_path, "wb") as fh:
+        fh.write(zoo.transformer_encoder(VOCAB, 64, 4, 128, 2,
+                                         seq_len=SEQ_LEN, seed=3))
+    cache_dir = os.path.join(work, "cache")
+    cap_dir = os.path.join(work, "capture")
+
+    env = dict(os.environ)
+    env.pop("SYNAPSEML_FAULTS", None)
+    env.setdefault("PYTHONPATH", os.getcwd())
+    env["SYNAPSEML_CAPTURE_HEAD_SAMPLE"] = "1.0"  # keep every reply
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "synapseml_tpu.io.serving",
+         "--host", "127.0.0.1", "--port", "0", "--name", "tp_proof",
+         "--model", model_path, "--devices", "all",
+         "--tensor-parallel", "2", "--cache-dir", cache_dir,
+         # bucket 1 rides the tp_rep layout, 8 the dp-sharded one —
+         # warming both proves the mesh-folded signatures cover the
+         # layouts traffic will actually dispatch
+         "--warmup", "1,8",
+         "--dump-dir", cap_dir, "--drain-timeout-ms", "4000"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    capture_file = os.path.join(cap_dir, f"capture-{proc.pid}.jsonl")
+    try:
+        lines, url_box = [], {}
+        url_found = threading.Event()
+
+        def read_stdout():
+            for line in proc.stdout:
+                lines.append(line)
+                if not url_found.is_set():
+                    m = re.search(r"serving \[.*\] on (http://\S+/)",
+                                  line)
+                    if m:
+                        url_box["url"] = m.group(1)
+                        url_found.set()
+
+        threading.Thread(target=read_stdout, daemon=True).start()
+        if not url_found.wait(600.0):
+            print("FAIL: serving subprocess never announced its URL\n"
+                  + "".join(lines[-30:]))
+            return 1
+        url = url_box["url"]
+        base = url.rstrip("/")
+        print(f"tp=2 replica up at {url}", flush=True)
+
+        # post-warmup floor: nothing below may move this counter
+        _, m0 = get(base + "/metrics")
+        recompiles0 = series_total(
+            m0.decode(), "synapseml_executor_recompiles_total")
+
+        digests = []
+        for i in range(REQUESTS):
+            tokens = [(7 * i + 3 * k) % VOCAB for k in range(SEQ_LEN)]
+            status, body, headers = post(url, {"features": tokens})
+            if status != 200:
+                print(f"FAIL: request {i} scored {status}: "
+                      f"{body[:300]!r}")
+                return 1
+            digest = headers.get("X-Output-Digest")
+            if digest != hashlib.sha256(body).hexdigest():
+                print(f"FAIL: X-Output-Digest missing/wrong on "
+                      f"request {i}: {digest!r}")
+                return 1
+            digests.append(digest)
+        if len(set(digests)) < 2:
+            print("FAIL: distinct payloads scored to identical "
+                  "replies — the scorer is not scoring")
+            return 1
+
+        # the weights actually REST sharded: tp_param_bytes on >= 2
+        # devices, and no single device holds the whole placement
+        _, mem_b = get(base + "/debug/memory")
+        mem = json.loads(mem_b)
+        per_dev = {d["device"]: d.get("tp_param_bytes", 0)
+                   for d in mem.get("devices", [])}
+        resident = {d: b for d, b in per_dev.items() if b > 0}
+        total = mem.get("totals", {}).get("tp_param_bytes", 0)
+        if len(resident) < 2:
+            print(f"FAIL: tp_param_bytes resident on "
+                  f"{len(resident)} device(s), need >= 2: {per_dev}")
+            return 1
+        if max(resident.values()) >= total:
+            print(f"FAIL: one device holds the entire placement "
+                  f"({max(resident.values())} of {total} B) — "
+                  "weights are replicated, not sharded")
+            return 1
+        print(f"shard gauges ok: {len(resident)} devices, max/device "
+              f"{max(resident.values())} of {total} B total", flush=True)
+
+        _, m1 = get(base + "/metrics")
+        recompiles1 = series_total(
+            m1.decode(), "synapseml_executor_recompiles_total")
+        if recompiles1 != recompiles0:
+            print(f"FAIL: executor recompiled post-warmup "
+                  f"({recompiles0} -> {recompiles1}) — a mesh layout "
+                  "escaped the warmup signatures")
+            return 1
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+        if rc != 0:
+            print(f"FAIL: serving exited {rc}\n" + "".join(lines[-30:]))
+            return 1
+        print(f"tp=2 phase ok: {REQUESTS} scored, 0 recompiles, "
+              "clean drain", flush=True)
+
+        # --- resharding canary: replay the capture at tp=4 ----------
+        report_path = os.path.join(work, "report.json")
+        rp = subprocess.run(
+            [sys.executable, "tools/replay.py", capture_file,
+             "--model", model_path, "--cache-dir", cache_dir,
+             "--devices", "all", "--tensor-parallel", "4",
+             "--out", report_path],
+            capture_output=True, text=True, env=env, timeout=600)
+        print(rp.stdout.strip(), flush=True)
+        if rp.returncode != 0:
+            print(f"FAIL: tp=4 replay exited {rp.returncode}: "
+                  f"{rp.stderr[-2000:]}")
+            return 1
+        with open(report_path, encoding="utf-8") as fh:
+            report = json.load(fh)
+        if report["diverged"]:
+            print(f"FAIL: tp=2 -> tp=4 resharding diverged: "
+                  f"{report['diverged'][:3]}")
+            return 1
+        if report["matched"] < REQUESTS:
+            print(f"FAIL: replay matched only {report['matched']} of "
+                  f"{REQUESTS}")
+            return 1
+        if report.get("recompiles") != 0:
+            print(f"FAIL: tp=4 replay recompiled on the scoring path "
+                  f"({report.get('recompiles')})")
+            return 1
+
+        # --- a perturbed digest must fail loudly --------------------
+        perturbed = os.path.join(work, "perturbed.jsonl")
+        flipped = None
+        with open(capture_file, encoding="utf-8") as src, \
+                open(perturbed, "w", encoding="utf-8") as dst:
+            for line in src:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if flipped is None and rec.get("status_code") == 200:
+                    rec["output_digest"] = "0" * 64
+                    flipped = rec["rid"]
+                dst.write(json.dumps(rec) + "\n")
+        rp2 = subprocess.run(
+            [sys.executable, "tools/replay.py", perturbed,
+             "--model", model_path, "--cache-dir", cache_dir,
+             "--devices", "all", "--tensor-parallel", "4"],
+            capture_output=True, text=True, env=env, timeout=600)
+        if rp2.returncode != 2:
+            print(f"FAIL: perturbed replay exited {rp2.returncode}, "
+                  f"wanted 2: {rp2.stdout[-1000:]}")
+            return 1
+        if flipped not in rp2.stdout:
+            print(f"FAIL: divergence report does not name the "
+                  f"perturbed rid {flipped}: {rp2.stdout[-1000:]}")
+            return 1
+        print(f"tp proof ok: {report['matched']} records bit-identical "
+              f"across tp=2 -> tp=4, shard gauges on {len(resident)} "
+              f"devices, 0 recompiles, perturbed rid {flipped[:8]}... "
+              "exits 2")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,13 +93,18 @@ _GROUP_LEVERS = {
     "cold_start": "ruled by compile/deserialize wall, not FLOP/s — "
                   "lever is the executable store hit rate "
                   "(compile_cache_store_hits_total) and warm hydration",
+    "onnx_tp_scaling": "weights tp-sharded at rest, gathered at entry "
+                       "(the bit-identity contract) — speedup below "
+                       "~1.0 is the all-gather price; to trade replay "
+                       "equality for peak memory, route the megatron "
+                       "preset (partition_rules) into sharded compute",
 }
 
 _REQUIRED_ROW_KEYS = (
     "group", "kind", "bound", "flops_per_item", "bytes_per_item",
     "achieved_flops_per_sec", "attainable_flops_per_sec",
     "roofline_fraction", "lever", "metric", "value", "unit",
-    "formulation",
+    "formulation", "partition",
 )
 
 
@@ -122,6 +127,24 @@ def _group_formulations(payload: Dict[str, Any],
             choices = [f"{lane.get('reference', '?')} (unrouted)"]
         out.append(f"{name}:{'/'.join(choices)}")
     return out
+
+
+def _group_partition(payload: Dict[str, Any], group: str) -> str:
+    """The execution geometry a group ran under — the ``partition``
+    string its bench detail reports (``dp1xtp8``-style, the executor's
+    mesh label layout), or one synthesized from a plain ``devices``
+    count (pure data parallelism). Groups that never leave one device
+    show ``—``: the column answers "was this number measured sharded,
+    and how" next to every roofline fraction."""
+    for m in _group_metrics(payload, group):
+        detail = m.get("detail") or {}
+        part = detail.get("partition")
+        if part:
+            return str(part)
+        ndev = detail.get("devices")
+        if isinstance(ndev, int) and ndev > 1:
+            return f"dp{ndev}"
+    return "—"
 
 
 def _fmt_eng(v: float, unit: str = "") -> str:
@@ -226,6 +249,7 @@ def attribute_group(group: str, meta: Dict[str, Any],
     row["lever"] = f"{extra} — {lever}" if extra else lever
     forms = _group_formulations(payload, group)
     row["formulation"] = "; ".join(forms) if forms else "—"
+    row["partition"] = _group_partition(payload, group)
     return row
 
 
@@ -282,9 +306,9 @@ def build_report(payload: Dict[str, Any],
     add("## Ranked bottlenecks (worst roofline fraction first)")
     add("")
     add("| rank | group | bound | metric | flops/item | "
-        "achieved FLOP/s | attainable | fraction | formulation "
-        "| lever |")
-    add("|---|---|---|---|---|---|---|---|---|---|")
+        "achieved FLOP/s | attainable | fraction | partition "
+        "| formulation | lever |")
+    add("|---|---|---|---|---|---|---|---|---|---|---|")
     for i, r in enumerate(rows, 1):
         frac = (f"{r['roofline_fraction']:.2%}"
                 if r["attributed"] and r["kind"] != "host" else "—")
@@ -293,7 +317,8 @@ def build_report(payload: Dict[str, Any],
             f"| {_fmt_eng(r['flops_per_item'])} "
             f"| {_fmt_eng(r['achieved_flops_per_sec'])} "
             f"| {_fmt_eng(r['attainable_flops_per_sec'])} "
-            f"| {frac} | {r['formulation']} | {r['lever']} |")
+            f"| {frac} | {r['partition']} | {r['formulation']} "
+            f"| {r['lever']} |")
     add("")
     add("## Per-group signatures")
     for r in rows:

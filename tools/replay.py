@@ -180,6 +180,25 @@ def main(argv=None) -> int:
                          "point it at the serving volume so warmup "
                          "deserializes instead of compiling (the "
                          "report's recompiles field proves it)")
+    ap.add_argument("--devices", default=None,
+                    help="rebuild the pipeline dp-sharded over this "
+                         "device spec ('all' or a count) — with "
+                         "--tensor-parallel this is the resharding "
+                         "canary: a capture served at one tp degree "
+                         "must replay bit-identically at another")
+    ap.add_argument("--tensor-parallel", type=int, default=1,
+                    help="tensor-parallel ways for the rebuilt "
+                         "pipeline (requires --devices; must divide "
+                         "the pool). Default partition rules keep "
+                         "replies bitwise identical across tp "
+                         "degrees, so any divergence here is real")
+    ap.add_argument("--partition-rules", default=None,
+                    help="partition-rule override: a JSON "
+                         "[regex, axes] list or the 'megatron' "
+                         "preset (NOTE: megatron opts into sharded "
+                         "compute — ~1e-6 drift vs the captured "
+                         "digests is expected, divergence is not "
+                         "a verdict)")
     ap.add_argument("--serve", default=None, metavar="URL",
                     help="replay against a LIVE endpoint instead of "
                          "rebuilding the pipeline (verifies the "
@@ -242,8 +261,14 @@ def main(argv=None) -> int:
         if args.model:
             from synapseml_tpu.io.serving import _model_pipeline
 
-            pipeline, model = _model_pipeline(args.model,
-                                              cache_dir=args.cache_dir)
+            rules = args.partition_rules
+            if rules and rules != "megatron":
+                rules = json.loads(rules)
+            pipeline, model = _model_pipeline(
+                args.model, devices=args.devices,
+                cache_dir=args.cache_dir,
+                tensor_parallel=args.tensor_parallel,
+                partition_rules=rules)
             # hash the constructed model's PAYLOAD, exactly as serving
             # stamped it (content_hash over model.model_payload): a
             # raw-file hash would wrongly refuse any model whose
