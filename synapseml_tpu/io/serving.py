@@ -401,6 +401,10 @@ class WorkerServer:
         self.default_deadline_ms = default_deadline_ms  # synlint: shared
         self.max_queue = max_queue  # synlint: shared
         self.retry_after_s = retry_after_s
+        # decode mode (runtime/decode.py): when a DecodeScheduler is
+        # attached here, POST /generate admits autoregressive sequences
+        # instead of riding the scoring queue — see Handler._generate
+        self.decode = None  # synlint: shared
         # readiness gate: /health answers 503 until set_ready(True) —
         # a k8s replica that is still AOT-warming its compile cache must
         # not receive traffic (the serving entry's --warmup flow)
@@ -554,6 +558,16 @@ class WorkerServer:
                         req, 503, 0.0, rid=rid, trace_id=trace_id,
                         origin=outer.name,
                         threshold_s=outer.slo_latency_threshold_s)
+                    return
+                if (outer.decode is not None
+                        and self.path.split("?", 1)[0].rstrip("/")
+                        == "/generate"):
+                    # decode mode: sequences go to the continuous-
+                    # batching scheduler, not the scoring queue — its
+                    # admission control (max_waiting) replaces the
+                    # queue-depth shed below
+                    self._generate(req, rid, trace_id, tp_echo,
+                                   span_id, retry_hdr)
                     return
                 if (outer.max_queue is not None
                         and outer.requests.qsize() >= outer.max_queue):
@@ -715,6 +729,167 @@ class WorkerServer:
                         reply_entity=(resp.entity or b""
                                       if resp is not None else None),
                         threshold_s=outer.slo_latency_threshold_s)
+
+            def _generate(self, req, rid, trace_id, tp_echo, span_id,
+                          retry_hdr):
+                """POST /generate — decode-mode sequence admission.
+
+                Body: ``{"tokens": [...], "max_new_tokens": N,
+                "stream": bool}``. Non-streamed replies are one JSON
+                body (``{"prompt_len", "tokens", "finish_reason"}``)
+                through the standard digest/capture contract —
+                X-Output-Digest is sha256 over the exact reply bytes,
+                so ``tools/replay.py --serve`` verifies decode
+                determinism unchanged. Streamed replies are chunked
+                NDJSON: rid + traceparent ride the response headers
+                (sent before the first token), one ``{"i", "t"}`` line
+                per token as it decodes, and the final line carries
+                ``finish_reason`` plus ``digest`` — sha256 of the
+                CANONICAL (non-streamed) reply body for the same
+                result, so a streamed client can assert the same
+                fingerprint a replay recomputes."""
+                t0 = time.monotonic()
+                try:
+                    payload = json.loads(req.entity or b"{}")
+                    tokens = [int(t) for t in payload["tokens"]]
+                    max_new = int(payload.get("max_new_tokens", 16))
+                    stream = bool(payload.get("stream", False))
+                except (ValueError, KeyError, TypeError) as e:
+                    outer._reply_counter(400).inc()
+                    self._send_plain(
+                        400, f"bad decode request: {e!r}".encode(),
+                        headers=retry_hdr[1:])
+                    return
+                deadline_ms = outer.default_deadline_ms
+                hdr = self.headers.get("X-Deadline-Ms")
+                if hdr:
+                    try:
+                        deadline_ms = float(hdr)
+                    except ValueError:
+                        pass
+                try:
+                    handle = outer.decode.submit(
+                        tokens, max_new,
+                        deadline_s=(deadline_ms / 1e3
+                                    if deadline_ms else None))
+                except ValueError as e:
+                    outer._reply_counter(400).inc()
+                    self._send_plain(400, repr(e).encode(),
+                                     headers=retry_hdr[1:])
+                    return
+                except RuntimeError:
+                    # admission queue full (or scheduler stopping):
+                    # same shed contract as the scoring queue
+                    outer._m_queue_shed.inc()
+                    outer._reply_counter(429).inc()
+                    _bb.record("shed_queue", rid=rid, level="warn",
+                               trace=trace_id, server=outer.name,
+                               path="/generate")
+                    self._send_plain(429, b"decode queue full",
+                                     headers=retry_hdr)
+                    _cap.maybe_capture(
+                        req, 429, 0.0, rid=rid, trace_id=trace_id,
+                        origin=outer.name,
+                        threshold_s=outer.slo_latency_threshold_s)
+                    return
+
+                def canonical_body(toks, reason):
+                    return json.dumps(
+                        {"prompt_len": len(tokens), "tokens": toks,
+                         "finish_reason": reason}).encode()
+
+                if not stream:
+                    try:
+                        toks, reason = handle.result(
+                            timeout=outer.reply_timeout)
+                    except TimeoutError:
+                        outer._m_reply_timeout.inc()
+                        outer._reply_counter(504).inc()
+                        self._send_plain(504, b"", headers=retry_hdr)
+                        return
+                    except Exception as e:  # noqa: BLE001 - loop fault
+                        outer._reply_counter(500).inc()
+                        self._send_plain(500, repr(e).encode(),
+                                         headers=retry_hdr[1:])
+                        return
+                    body = canonical_body(toks, reason)
+                    digest = hashlib.sha256(body).hexdigest()
+                    status = 200
+                    outer._reply_counter(status).inc()
+                    dt = time.monotonic() - t0
+                    outer._m_roundtrip.observe(dt, exemplar=trace_id)
+                    self._send_plain(
+                        status, body, content_type="application/json",
+                        headers=(("X-Request-Id", rid),
+                                 ("traceparent", tp_echo),
+                                 ("X-Output-Digest", digest)))
+                    _cap.maybe_capture(
+                        req, status, dt, rid=rid, trace_id=trace_id,
+                        span_id=span_id, origin=outer.name,
+                        digest=digest, reply_entity=body,
+                        threshold_s=outer.slo_latency_threshold_s)
+                    return
+                # streamed: headers (rid + traceparent) leave before
+                # the first token; tokens flush per decode step so the
+                # client's inter-token latency measures the scheduler,
+                # not this buffer
+                self.send_response(200)
+                self.send_header("X-Request-Id", rid)
+                self.send_header("traceparent", tp_echo)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(b: bytes):
+                    self.wfile.write(f"{len(b):x}\r\n".encode()
+                                     + b + b"\r\n")
+
+                toks = []
+                status = 200
+                try:
+                    for tok in handle:
+                        line = json.dumps(
+                            {"i": len(toks), "t": tok}).encode()
+                        toks.append(tok)
+                        chunk(line + b"\n")
+                        self.wfile.flush()
+                    reason = handle.finish_reason or "completed"
+                    body = canonical_body(toks, reason)
+                    digest = hashlib.sha256(body).hexdigest()
+                    final = json.dumps(
+                        {"done": True, "n": len(toks),
+                         "finish_reason": reason,
+                         "digest": digest}).encode()
+                    chunk(final + b"\n")
+                    chunk(b"")  # 0\r\n\r\n terminator
+                    self.wfile.flush()
+                except OSError:
+                    # client hung up mid-stream: release the sequence's
+                    # KV budget; the scheduler-side finish already
+                    # happened or will via deadline
+                    digest = ""
+                    status = 499
+                except Exception as e:  # noqa: BLE001 - loop fault
+                    # headers are gone — terminate the chunk stream
+                    # with an error line instead of a silent cut
+                    digest = ""
+                    status = 500
+                    try:
+                        chunk(json.dumps(
+                            {"done": True, "error": repr(e)}).encode()
+                            + b"\n")
+                        chunk(b"")
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                outer._reply_counter(status).inc()
+                dt = time.monotonic() - t0
+                outer._m_roundtrip.observe(dt, exemplar=trace_id)
+                _cap.maybe_capture(
+                    req, status, dt, rid=rid, trace_id=trace_id,
+                    span_id=span_id, origin=outer.name, digest=digest,
+                    threshold_s=outer.slo_latency_threshold_s)
 
             def _send_plain(self, status: int, body: bytes,
                             content_type: str = "text/plain",
@@ -2648,6 +2823,17 @@ def main(argv=None):
              "~1e-6 cross-shard drift breaks replay digests across "
              "reshardings) or a JSON list of [regex, axes] pairs "
              "matched ahead of the default reduction-free layout")
+    ap.add_argument("--decode", action="store_true",
+                    default=bool(os.environ.get("SYNAPSEML_DECODE", "")),
+        help="decode serving mode: POST /generate admits autoregressive "
+             "sequences into the continuous-batching scheduler "
+             "(runtime/decode.py) with a paged device-resident KV cache "
+             "— requires --model pointing at a share-buffer decoder "
+             "graph (past_key/past_value + seqlens_k inputs). Geometry "
+             "and capacity ride the SYNAPSEML_DECODE_*/SYNAPSEML_KV_* "
+             "env knobs (docs/knobs.md); per-request max_new_tokens in "
+             "the body, deadline via X-Deadline-Ms. The '/' scoring "
+             "path serves echo in this mode")
     ap.add_argument("--coalesce-ms", type=float, default=float(os.environ.get(
         "SYNAPSEML_COALESCE_MS", "0")),
         help="deadline-based batching window in ms (0 = off)")
@@ -2745,8 +2931,37 @@ def main(argv=None):
         print(f"error: model path {args.model!r} does not exist",
               flush=True)
         return 2
+    if args.decode and not args.model:
+        print("error: --decode requires --model (a share-buffer "
+              "decoder graph)", flush=True)
+        return 2
     model = None
-    if args.model:
+    decode_sched = None
+    if args.decode:
+        from synapseml_tpu.onnx.importer import import_model
+        from synapseml_tpu.runtime import compile_cache as _cc
+        from synapseml_tpu.runtime.decode import DecodeScheduler
+
+        with open(args.model, "rb") as f:
+            payload = f.read()
+        # replay refuses a model-hash mismatch — decode captures carry
+        # the same fingerprint the scoring path stamps
+        _cap.set_model_hash(_cc.content_hash(payload))
+        graph = import_model(payload)
+        decode_sched = DecodeScheduler(
+            graph, name=args.name, cache_dir=args.cache_dir,
+            cache_key=_cc.content_hash(payload))
+
+        def pipeline(table: Table) -> Table:
+            replies = np.empty(table.num_rows, dtype=object)
+            for i, v in enumerate(table["value"]):
+                replies[i] = make_reply(v)
+            return table.with_column("reply", replies)
+        what = (f"decode {args.model} [B={decode_sched.B} "
+                f"S_pre={decode_sched.S_pre} page={decode_sched.page} "
+                f"max_seq={decode_sched.max_seq} "
+                f"kv_pages={decode_sched.kv.capacity_pages}]")
+    elif args.model:
         pipeline, model = _model_pipeline(
             args.model, devices=devices, cache_dir=args.cache_dir,
             tensor_parallel=tp, partition_rules=partition_rules)
@@ -2770,7 +2985,22 @@ def main(argv=None):
                           batch_coalesce=args.coalesce_ms / 1e3,
                           deadline_ms=args.deadline_ms or None,
                           max_queue=args.max_queue or None,
-                          ready=not do_warmup)
+                          ready=not (do_warmup or decode_sched
+                                     is not None))
+    if decode_sched is not None:
+        # decode warmup is NOT optional: every (S, T) signature plus
+        # the merge/grow helpers must be compiled before the first
+        # sequence, or steady-state steps land on a compiling chip and
+        # the recompile sentinel fires
+        # single-threaded startup: the readiness gate is still closed,
+        # so no handler thread can read `decode` before this write
+        cs.server.decode = decode_sched  # synlint: disable=CC001
+        print(f"warming up [{what}] ...", flush=True)
+        rep = decode_sched.warmup()
+        decode_sched.start()
+        print(f"warmup done: {len(rep['signatures'])} signatures",
+              flush=True)
+        cs.server.set_ready(True)
     if do_warmup:
         buckets = None if args.warmup == "auto" else \
             [int(b) for b in args.warmup.split(",") if b.strip()]
@@ -2803,6 +3033,13 @@ def main(argv=None):
     print(f"SIGTERM: draining (budget {args.drain_timeout_ms:.0f}ms) ...",
           flush=True)
     drained = cs.drain(args.drain_timeout_ms)
+    if decode_sched is not None:
+        # in-flight sequences finish to real (streamed) replies under
+        # the same budget; new /generate admissions were already shed
+        # 503 by the drain gate
+        drained = decode_sched.drain(
+            args.drain_timeout_ms / 1e3) and drained
+        decode_sched.close()
     print(f"drain {'complete' if drained else 'timed out'}; stopping",
           flush=True)
     cs.stop()

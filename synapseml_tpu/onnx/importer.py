@@ -3074,7 +3074,20 @@ def _group_query_attention(ctx, query, key=None, value=None,
     is assumed densely packed — per-batch ``seqlens_k`` bounds the
     attended keys); internal rotary via ``do_rotary`` with
     batch-uniform position offset = past length. Everything lowers to
-    one einsum-softmax-einsum chain per call; XLA fuses the mask."""
+    one einsum-softmax-einsum chain per call; XLA fuses the mask.
+
+    ``past_present_share_buffer=1`` switches to the serving-cache
+    layout the decode scheduler (runtime/decode.py) compiles against:
+    ``past_key/past_value`` are MAX-LENGTH buffers ``[B, Hkv, T, D]``
+    whose shape never changes across steps (one compiled program per
+    (S, T) geometry — the recompile sentinel stays silent), the new
+    K/V rows are scattered in place at each row's write position
+    ``past_len_b = seqlens_k + 1 - S`` (clamped at 0 so right-padded
+    prefill rows and masked-out idle rows write at the origin), rotary
+    uses the same per-row offsets, and attention masks per row to
+    ``k_pos <= past_len_b + q_idx`` so slots beyond the live frontier
+    — junk from padding, stale evicted rows — are never attended.
+    ``present_*`` return the updated same-shape buffers."""
     num_heads = int(ctx.attr("num_heads", 0))
     kv_heads = int(ctx.attr("kv_num_heads", 0))
     if num_heads <= 0 or kv_heads <= 0:
@@ -3098,9 +3111,25 @@ def _group_query_attention(ctx, query, key=None, value=None,
     q = heads(q, num_heads)                        # [B, Hq, S, D]
     k = heads(k, kv_heads)                         # [B, Hkv, S, D]
     v = heads(v, kv_heads)
+    share = bool(ctx.attr("past_present_share_buffer", 0))
+    if share and (past_key is None or seqlens_k is None):
+        raise ValueError(
+            "GroupQueryAttention: past_present_share_buffer=1 needs "
+            "past_key/past_value buffers and seqlens_k")
     past_len = 0
     if past_key is not None:
         past_len = jnp.asarray(past_key).shape[2]
+    past_len_b = None
+    if share:
+        # ORT share-buffer convention: seqlens_k = total valid keys - 1
+        # INCLUDING this call's S new tokens, so each row's write
+        # position is seqlens_k + 1 - S. The clamp makes right-padded
+        # prefill rows (valid v < S => position v - S < 0) and idle
+        # batch rows (seqlens_k = 0) write at the origin; their junk
+        # lands at/beyond the attention frontier and is either masked
+        # or overwritten before it ever becomes attendable.
+        lens = jnp.asarray(seqlens_k).astype(jnp.int32).reshape(b)
+        past_len_b = jnp.maximum(lens + 1 - s, 0)  # [B] write positions
 
     if bool(ctx.attr("do_rotary", 0)):
         if cos_cache is None or sin_cache is None:
@@ -3108,19 +3137,32 @@ def _group_query_attention(ctx, query, key=None, value=None,
         cos = jnp.asarray(cos_cache, jnp.float32)
         sin = jnp.asarray(sin_cache, jnp.float32)
         rot = 2 * cos.shape[-1]
-        if past_len + s > cos.shape[0]:
+        max_pos = past_len if share else past_len + s
+        if max_pos > cos.shape[0]:
             # a clamped gather would silently freeze the rotary angle
             raise ValueError(
-                f"GroupQueryAttention: positions {past_len}+{s} exceed "
+                f"GroupQueryAttention: positions up to {max_pos} exceed "
                 f"the exported rope cache ({cos.shape[0]} rows); "
                 "re-export with a longer max position")
         inter = bool(ctx.attr("rotary_interleaved", 0))
-        pos = past_len + jnp.arange(s, dtype=jnp.int32)
-        cc, ss = cos[pos][None, None], sin[pos][None, None]
+        if share:
+            pos = past_len_b[:, None] + jnp.arange(s, dtype=jnp.int32)
+            cc, ss = cos[pos][:, None], sin[pos][:, None]  # [B,1,S,half]
+        else:
+            pos = past_len + jnp.arange(s, dtype=jnp.int32)
+            cc, ss = cos[pos][None, None], sin[pos][None, None]
         q = _apply_rope(q, cc, ss, inter, rot)
         k = _apply_rope(k, cc, ss, inter, rot)
 
-    if past_key is not None:
+    if share:
+        # in-place scatter at each row's write position — the buffer
+        # shape (and with it the compiled program) is step-invariant
+        def _scat(buf, new, p):
+            return jax.lax.dynamic_update_slice(buf, new, (0, p, 0))
+
+        k = jax.vmap(_scat)(jnp.asarray(past_key, dt), k, past_len_b)
+        v = jax.vmap(_scat)(jnp.asarray(past_value, dt), v, past_len_b)
+    elif past_key is not None:
         k = jnp.concatenate([jnp.asarray(past_key, dt), k], axis=2)
         v = jnp.concatenate([jnp.asarray(past_value, dt), v], axis=2)
     present_k, present_v = k, v
@@ -3133,13 +3175,21 @@ def _group_query_attention(ctx, query, key=None, value=None,
     scale = ctx.attr("scale", 0.0) or 1.0 / math.sqrt(head)
     logits = jnp.einsum("bkgsd,bktd->bkgst", qg,
                         k.astype(jnp.float32)) * scale
-    q_pos = past_len + jnp.arange(s)[:, None]      # global query positions
     k_pos = jnp.arange(t_kv)[None, :]
-    mask = (k_pos <= q_pos)[None, None, None]      # causal   [S, T]
-    if seqlens_k is not None:
-        # ORT convention: seqlens_k = total valid keys per batch - 1
-        lim = (jnp.asarray(seqlens_k).astype(jnp.int32).reshape(b) + 1)
-        mask = mask & (k_pos < lim[:, None])[:, None, None, None, :]
+    if share:
+        # per-row causal frontier: row b's query j sits at global
+        # position past_len_b[b] + j and may attend keys at or before
+        # it — junk slots beyond the frontier never enter the softmax
+        q_pos = past_len_b[:, None, None] + jnp.arange(s)[None, :, None]
+        mask = (k_pos[:, None] <= q_pos)[:, None, None]  # [B,1,1,S,T]
+    else:
+        q_pos = past_len + jnp.arange(s)[:, None]  # global query positions
+        mask = (k_pos <= q_pos)[None, None, None]      # causal   [S, T]
+        if seqlens_k is not None:
+            # ORT convention: seqlens_k = total valid keys per batch - 1
+            lim = (jnp.asarray(seqlens_k).astype(jnp.int32).reshape(b)
+                   + 1)
+            mask = mask & (k_pos < lim[:, None])[:, None, None, None, :]
     logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgst,bktd->bkgsd", probs, v.astype(jnp.float32))

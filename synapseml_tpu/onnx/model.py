@@ -300,6 +300,27 @@ class ONNXModel(Transformer):
             "opset": g.opset,
         }
 
+    def decode_scheduler(self, **kw) -> "Any":
+        """Build a continuous-batching decode scheduler over this
+        model's graph (runtime/decode.py) — the decode-mode entry the
+        serving CLI's ``--decode`` wraps. The payload must be a
+        share-buffer decoder graph (``past_key``/``past_value`` +
+        ``seqlens_k`` inputs, e.g. an ORT-GenAI export or
+        ``zoo.tiny_decoder``); plain feed-forward graphs raise. The
+        scheduler inherits this model's compile-cache wiring (same
+        content-hash key, so a restarted replica deserializes its
+        decode signatures); geometry and KV capacity default from the
+        ``SYNAPSEML_DECODE_*`` / ``SYNAPSEML_KV_*`` env knobs
+        (docs/knobs.md) with keyword overrides. Caller owns
+        ``warmup()`` + ``start()``."""
+        from synapseml_tpu.runtime import compile_cache as _cc
+        from synapseml_tpu.runtime.decode import DecodeScheduler
+
+        kw.setdefault("cache_dir", self.compile_cache_dir)
+        kw.setdefault("cache_key",
+                      _cc.content_hash(self.model_payload or b""))
+        return DecodeScheduler(self.graph, **kw)
+
     def _post_copy(self, src):
         super()._post_copy(src)
         self._graph_cache = None

@@ -265,3 +265,79 @@ def transformer_encoder(vocab: int, d_model: int, n_heads: int,
     x = layer_norm(x, "final_ln")
     g.add_output(x, np.float32, ["N", seq_len, d_model])
     return g.to_bytes()
+
+
+def tiny_decoder(vocab: int = 64, d_model: int = 32, n_heads: int = 4,
+                 kv_heads: int = 2, n_layers: int = 2,
+                 max_seq: int = 128, seed: int = 0) -> bytes:
+    """Decoder-only LM in the ORT-GenAI serving-cache layout: packed-QKV
+    GroupQueryAttention with ``past_present_share_buffer=1`` and internal
+    rotary, pre-LN FFN blocks, LM head. Every attention input/output is
+    symbolic in B/S/T, so ONE file serves every (batch, chunk, buffer)
+    geometry the decode scheduler compiles — prefill feeds S=chunk
+    against a zeroed buffer, decode feeds S=1 against the live buffer,
+    and ``seqlens_k`` (ORT convention: total valid keys - 1) carries each
+    row's write position. ``max_seq`` caps the rope cache, so every KV
+    buffer bucket must satisfy T <= max_seq."""
+    assert d_model % n_heads == 0 and n_heads % kv_heads == 0
+    hd = d_model // n_heads
+    g = GraphBuilder(name="tiny_decoder", opset=21)
+    r = _Rng(seed)
+
+    ids = g.add_input("input_ids", np.int64, ["B", "S"])
+    seqlens = g.add_input("seqlens_k", np.int32, ["B"])
+    emb = g.add_initializer(
+        "tok_emb", r.rng.normal(0, 0.05, (vocab, d_model)).astype(
+            np.float32))
+    x = g.add_node("Gather", [emb, ids], axis=0)          # (B, S, D)
+
+    inv = 10000.0 ** (np.arange(hd // 2) / (hd // 2))
+    ang = np.arange(max_seq)[:, None] / inv
+    cos = g.add_initializer("rope_cos", np.cos(ang).astype(np.float32))
+    sin = g.add_initializer("rope_sin", np.sin(ang).astype(np.float32))
+
+    def lin(x, out_f, in_f, name):
+        w, b = r.fc(out_f, in_f)
+        wn = g.add_initializer(f"{name}_w", np.ascontiguousarray(w.T))
+        bn = g.add_initializer(f"{name}_b", b)
+        y = g.add_node("MatMul", [x, wn])
+        return g.add_node("Add", [y, bn])
+
+    def layer_norm(x, name):
+        s = g.add_initializer(f"{name}_s", np.ones(d_model, np.float32))
+        b = g.add_initializer(f"{name}_b", np.zeros(d_model, np.float32))
+        return g.add_node("LayerNormalization", [x, s, b], axis=-1)
+
+    presents: List[str] = []
+    for li in range(n_layers):
+        ln1 = layer_norm(x, f"l{li}_ln1")
+        qkv = lin(ln1, (n_heads + 2 * kv_heads) * hd, d_model,
+                  f"l{li}_qkv")
+        pk = g.add_input(f"past_key_{li}", np.float32,
+                         ["B", kv_heads, "T", hd])
+        pv = g.add_input(f"past_value_{li}", np.float32,
+                         ["B", kv_heads, "T", hd])
+        att, prk, prv = g.add_node(
+            "GroupQueryAttention",
+            [qkv, "", "", pk, pv, seqlens, "", cos, sin],
+            outputs=[f"att_{li}", f"present_key_{li}",
+                     f"present_value_{li}"],
+            domain="com.microsoft", num_heads=n_heads,
+            kv_num_heads=kv_heads, do_rotary=1,
+            past_present_share_buffer=1)
+        presents += [prk, prv]
+        proj = lin(att, d_model, n_heads * hd, f"l{li}_o")
+        x = g.add_node("Add", [x, proj])
+
+        ln2 = layer_norm(x, f"l{li}_ln2")
+        h = lin(ln2, 2 * d_model, d_model, f"l{li}_ff1")
+        h = g.add_node("Gelu", [h])
+        h = lin(h, d_model, 2 * d_model, f"l{li}_ff2")
+        x = g.add_node("Add", [x, h])
+
+    x = layer_norm(x, "final_ln")
+    logits = lin(x, vocab, d_model, "lm_head")
+    g.add_output(logits, np.float32, ["B", "S", vocab])
+    for p in presents:
+        g.add_output(p, np.float32, None)
+    return g.to_bytes()

@@ -39,6 +39,13 @@ Signals:
   burn_rate` — windowed, not cumulative, so a recovered fleet stops
   signalling). Burn at/above ``burn_high`` scales up even at low duty:
   an SLO on fire is a capacity problem until proven otherwise.
+- **decode starvation**: max over replicas of
+  ``decode_queue_wait_burn`` (runtime/decode.py) — recent decode
+  admission wait as a burn rate against the replica's wait SLO. At or
+  above ``decode_burn_high`` the fleet scales up and scale-down is
+  blocked: decode steps are short and latency-critical, so a starved
+  decode fleet shows MODERATE duty while sequences age out in the
+  admission queue — duty cycle alone misreads it.
 
 **Warm hydration audit** (:func:`hydration_audit`): a replica that
 booted from the shared ``ExecutableStore`` must show ZERO
@@ -77,12 +84,14 @@ class FleetPolicy:
     defaults are production-shaped — CI tightens them)."""
 
     __slots__ = ("min_replicas", "max_replicas", "duty_high", "duty_low",
-                 "burn_high", "up_consecutive", "down_consecutive",
-                 "up_cooldown_s", "down_cooldown_s", "stale_after_s")
+                 "burn_high", "decode_burn_high", "up_consecutive",
+                 "down_consecutive", "up_cooldown_s", "down_cooldown_s",
+                 "stale_after_s")
 
     def __init__(self, min_replicas: int = 1, max_replicas: int = 8,
                  duty_high: float = 0.75, duty_low: float = 0.20,
-                 burn_high: float = 2.0, up_consecutive: int = 2,
+                 burn_high: float = 2.0, decode_burn_high: float = 1.0,
+                 up_consecutive: int = 2,
                  down_consecutive: int = 4, up_cooldown_s: float = 15.0,
                  down_cooldown_s: float = 60.0,
                  stale_after_s: float = 10.0):
@@ -100,6 +109,13 @@ class FleetPolicy:
         self.duty_high = float(duty_high)
         self.duty_low = float(duty_low)
         self.burn_high = float(burn_high)
+        # decode starvation threshold: decode_queue_wait_burn is
+        # already normalized to the replica's wait SLO, so >= 1.0
+        # MEANS sequences wait longer than the target before their
+        # first prefill — a starved decode fleet. Duty cycle cannot
+        # see this: decode steps are short and keep the chips "busy"
+        # at modest duty while the admission queue ages out.
+        self.decode_burn_high = float(decode_burn_high)
         self.up_consecutive = max(1, int(up_consecutive))
         self.down_consecutive = max(1, int(down_consecutive))
         self.up_cooldown_s = float(up_cooldown_s)
@@ -115,14 +131,16 @@ class ReplicaSample:
     are None when the window carried no signal (no new replies)."""
 
     __slots__ = ("name", "url", "ts", "reachable", "ready", "duty",
-                 "avail_burn", "latency_burn", "recompiles",
-                 "store_skew", "replies_by_code", "store_hits")
+                 "avail_burn", "latency_burn", "decode_wait_burn",
+                 "recompiles", "store_skew", "replies_by_code",
+                 "store_hits")
 
     def __init__(self, name: str, url: str = "", ts: float = 0.0,
                  reachable: bool = False, ready: bool = False,
                  duty: float = 0.0,
                  avail_burn: Optional[float] = None,
                  latency_burn: Optional[float] = None,
+                 decode_wait_burn: Optional[float] = None,
                  recompiles: Optional[Dict[str, float]] = None,
                  store_skew: float = 0.0,
                  store_hits: float = 0.0,
@@ -135,6 +153,10 @@ class ReplicaSample:
         self.duty = duty
         self.avail_burn = avail_burn
         self.latency_burn = latency_burn
+        # decode admission-wait burn against the replica's wait SLO
+        # (synapseml_decode_queue_wait_burn, runtime/decode.py); None
+        # when the replica serves no decode traffic
+        self.decode_wait_burn = decode_wait_burn
         self.recompiles = dict(recompiles or {})
         self.store_skew = store_skew
         self.store_hits = store_hits
@@ -199,6 +221,9 @@ def aggregate(samples: List[ReplicaSample], now: float,
     ready = [s for s in fresh if s.ready]
     duty_mean = (sum(s.duty for s in ready) / len(ready)) if ready else 0.0
     burn_max = max([s.burn_max() for s in fresh], default=0.0)
+    decode_burn_max = max([s.decode_wait_burn for s in fresh
+                           if s.decode_wait_burn is not None],
+                          default=0.0)
     return {
         "replicas": len(samples),
         "fresh": len(fresh),
@@ -206,6 +231,7 @@ def aggregate(samples: List[ReplicaSample], now: float,
         "ready": len(ready),
         "duty_mean": round(duty_mean, 6),
         "burn_max": round(burn_max, 6),
+        "decode_burn_max": round(decode_burn_max, 6),
     }
 
 
@@ -229,13 +255,19 @@ def decide(now: float, samples: List[ReplicaSample], state: FleetState,
 
     duty = agg["duty_mean"]
     burn = agg["burn_max"]
+    decode_burn = agg["decode_burn_max"]
     up_reason = ""
     if burn >= policy.burn_high:
         up_reason = "burn_rate"
+    elif decode_burn >= policy.decode_burn_high:
+        # a starved decode fleet: admission waits exceed the wait SLO
+        # even though short decode steps keep duty moderate
+        up_reason = "decode_starvation"
     elif agg["ready"] > 0 and duty >= policy.duty_high:
         up_reason = "duty_cycle"
     down_ok = (agg["ready"] > 0 and duty <= policy.duty_low
-               and burn < policy.burn_high)
+               and burn < policy.burn_high
+               and decode_burn < policy.decode_burn_high)
 
     if up_reason:
         state.up_streak += 1
@@ -326,6 +358,11 @@ def sample_from_scrape(name: str, url: str, now: float,
     duty = max([v for _l, v in
                 metrics.get("synapseml_executor_duty_cycle", ())],
                default=0.0)
+    decode_burn_series = [
+        v for _l, v in
+        metrics.get("synapseml_decode_queue_wait_burn", ())]
+    decode_wait_burn = (max(decode_burn_series)
+                        if decode_burn_series else None)
     recompiles = {
         labels.get("reason", ""): v for labels, v in
         metrics.get("synapseml_executor_recompiles_total", ())
@@ -336,6 +373,7 @@ def sample_from_scrape(name: str, url: str, now: float,
         replies[code] = replies.get(code, 0.0) + v
     return ReplicaSample(
         name, url, ts=now, reachable=True, ready=ready, duty=duty,
+        decode_wait_burn=decode_wait_burn,
         recompiles=recompiles,
         store_skew=_series_sum(
             metrics, "synapseml_compile_cache_store_skew_total"),

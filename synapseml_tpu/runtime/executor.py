@@ -641,6 +641,7 @@ class BatchedExecutor:
         tensor_parallel: int = 1,
         bound_specs: Optional[Tuple[Any, ...]] = None,
         tp_compute: str = "gather",
+        device_outputs: Optional[Sequence[int]] = None,
     ):
         """``bound_args`` are prepended to every call unpadded — use for a
         weights pytree so it is device-resident and *shared* across all shape
@@ -707,7 +708,16 @@ class BatchedExecutor:
           but cross-shard partial sums reassociate float adds:
           measured ~1e-6 drift vs tp=1 on the transformer zoo model,
           which breaks digest stability across reshardings. Opt in
-          when capacity matters more than replay equality."""
+          when capacity matters more than replay equality.
+
+        ``device_outputs`` lists output-leaf indices (position in the
+        flattened output tuple) the fetch stage must NOT copy to host:
+        those leaves resolve as live ``jax.Array``s, ready to be fed
+        straight back into the next ``submit`` — the decode scheduler's
+        KV-cache contract, where per-step device->host->device round
+        trips of the whole cache would drown the step itself. The fetch
+        still blocks until the leaf is computed, so futures keep their
+        "resolved means done" meaning."""
         devices = resolve_devices(devices)
         if devices is not None and device is not None:
             raise ValueError("pass either device= or devices=, not both")
@@ -803,6 +813,9 @@ class BatchedExecutor:
         elif transfer_batches != "auto":
             transfer_batches = max(1, int(transfer_batches))
         self._transfer_batches = transfer_batches  # "auto" = ~32MB groups
+        self._device_outputs = (frozenset(int(i) for i in device_outputs)
+                                if device_outputs is not None
+                                else frozenset())
         if self._tp > 1 and self._tp_compute == "gather":
             # bitwise contract: constrain every bound leaf back to
             # replicated INSIDE the program — XLA all-gathers the
@@ -1742,8 +1755,25 @@ class BatchedExecutor:
         remote chips. Padding is sliced off per leaf; a leaf whose
         leading dim is NOT the batch axis cannot be row-sliced, and
         doing it silently would mis-assign rows (the round-5 NMS-through-
-        ONNXModel repro) — fail with a recipe instead."""
-        leaves = jax.device_get(jax.tree_util.tree_leaves(out))
+        ONNXModel repro) — fail with a recipe instead.
+
+        Leaves listed in ``device_outputs`` skip the host copy: they
+        block until computed (so the future's resolution still means
+        "done") and resolve as device-resident ``jax.Array``s, row-
+        sliced lazily on device when the bucket padded."""
+        leaves = jax.tree_util.tree_leaves(out)
+        if self._device_outputs:
+            host_idx = [i for i in range(len(leaves))
+                        if i not in self._device_outputs]
+            fetched = jax.device_get([leaves[i] for i in host_idx])
+            pulled = dict(zip(host_idx, fetched))
+            for i in range(len(leaves)):
+                if i in pulled:
+                    leaves[i] = pulled[i]
+                else:
+                    leaves[i].block_until_ready()
+        else:
+            leaves = jax.device_get(leaves)
         trimmed = []
         for l in leaves:
             if np.ndim(l) == 0:

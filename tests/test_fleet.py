@@ -541,3 +541,65 @@ def test_loadgen_cli_targets_and_payload_key(tmp_path):
     with pytest.raises(SystemExit) as ei:
         lg_main(["--rps", "1", "--duration", "0.1"])
     assert ei.value.code == 2
+
+
+# -- decode starvation signal (round 19) ------------------------------------
+
+def test_scale_up_on_decode_starvation_even_at_low_duty():
+    policy = _policy()
+    state = aut.FleetState()
+    starved = [_sample(duty=0.05, decode_wait_burn=1.4)]
+    d = _decide_n(policy, state, starved, n=2)
+    assert (d.direction, d.reason) == ("up", "decode_starvation")
+
+
+def test_decode_burn_below_threshold_does_not_scale():
+    policy = _policy()
+    state = aut.FleetState()
+    warm = [_sample(duty=0.3, decode_wait_burn=0.6)]
+    d = _decide_n(policy, state, warm, n=3)
+    assert d.direction == "hold"
+
+
+def test_decode_burn_blocks_scale_down():
+    # duty says idle, but admission waits are burning the wait SLO:
+    # shrinking the fleet would starve the decode queue further
+    policy = _policy(down_consecutive=2)
+    state = aut.FleetState()
+    idle_but_starved = [_sample("r1", duty=0.01, decode_wait_burn=1.2),
+                        _sample("r2", duty=0.01)]
+    d = _decide_n(policy, state, idle_but_starved, n=3)
+    assert d.direction != "down"
+    # same fleet with the burn cooled drains normally
+    state2 = aut.FleetState()
+    cooled = [_sample("r1", duty=0.01, decode_wait_burn=0.1),
+              _sample("r2", duty=0.01)]
+    d2 = _decide_n(policy, state2, cooled, n=3)
+    assert d2.direction == "down"
+
+
+def test_aggregate_decode_burn_max():
+    agg = aut.aggregate(
+        [_sample("r1", decode_wait_burn=0.4),
+         _sample("r2", decode_wait_burn=1.1),
+         _sample("r3")], 100.0, _policy())
+    assert agg["decode_burn_max"] == 1.1
+
+
+def test_sample_from_scrape_decode_burn():
+    text = METRICS_TEXT + (
+        'synapseml_decode_queue_wait_burn{server="a"} 0.3\n'
+        'synapseml_decode_queue_wait_burn{server="b"} 1.7\n')
+    s = aut.sample_from_scrape("r1", "http://x/", 50.0, text,
+                               ready=True)
+    assert s.decode_wait_burn == 1.7  # max across a replica's servers
+
+
+def test_sample_from_scrape_decode_burn_absent_is_none():
+    # a scoring-only replica exports no decode series: the sample must
+    # say "no signal" (None), not a 0.0 that reads as measured-cold
+    s = aut.sample_from_scrape("r1", "http://x/", 50.0, METRICS_TEXT,
+                               ready=True)
+    assert s.decode_wait_burn is None
+    agg = aut.aggregate([s], 50.0, _policy())
+    assert agg["decode_burn_max"] == 0.0

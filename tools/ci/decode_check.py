@@ -1,0 +1,352 @@
+"""Decode-serving proof (docs/serving.md "Decode serving"): the round-19
+contracts on a REAL --decode serving subprocess, on a FORCED 8-device
+CPU platform (smoke_decode.sh sets XLA_FLAGS), under a KV capacity tiny
+enough that continuous-batching traffic MUST evict and recompute —
+
+1. mixed prefill/decode traffic: concurrent streamed + non-streamed
+   /generate clients with varied prompt/output lengths, so every
+   scheduler iteration mixes prefill chunks with single-token steps;
+2. streamed replies carry the provenance headers BEFORE the first
+   token (X-Request-Id echo + W3C traceparent), then per-token NDJSON
+   lines and a final line whose digest equals the non-stream digest
+   for the same prompt;
+3. the PR-10 recompile sentinel (executor_recompiles_total) reads ZERO
+   after warmup across admissions, retirements, evictions and
+   recomputes — the fixed compile geometry held;
+4. the tiny SYNAPSEML_KV_CAPACITY_BYTES forces evictions
+   (kv_evictions_total > 0, kv_recomputes_total > 0) and an evicted
+   sequence's re-prefilled reply must be BIT-IDENTICAL to the same
+   prompt scored solo before the storm (digest equality — greedy
+   decode over position-exact recompute);
+5. after a SIGTERM drain, the captured non-stream traffic replays
+   against a FRESH decode replica (normal capacity, no evictions) via
+   tools/replay.py --serve: every record reproduces its digest, and a
+   deliberately perturbed record makes the harness exit 2.
+
+Driven by tools/ci/smoke_decode.sh under a hard timeout: a wedged
+warmup, a starved admission queue, or a livelocked eviction loop hangs
+rather than fails, so it becomes a fast exit-124.
+"""
+import base64
+import hashlib
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+# tiny_decoder KV economics: 2 layers x (K+V) x 2 kv-heads x 8 head-dim
+# x f32 = 256 B/token -> page(8) = 2 KiB. 12 pages ~ 2.5 sequences of
+# the ~35-token totals below: with 4 batch slots the cache CANNOT hold
+# a full batch, so decode-step growth must evict (the livelock-free
+# path: admission never evicts, growth does).
+KV_CAPACITY = str(12 * 8 * 256)
+
+
+def series_total(text: str, name: str) -> float:
+    total = 0.0
+    for ln in text.splitlines():
+        if ln.startswith(name) and not ln.startswith(name + "_"):
+            total += float(ln.rsplit(" ", 1)[1])
+    return total
+
+
+def get(url: str, timeout: float = 15.0):
+    with urllib.request.urlopen(urllib.request.Request(url),
+                                timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def generate(base: str, tokens, max_new, stream=False, rid=None,
+             timeout: float = 120.0):
+    """One /generate POST -> (status, body_bytes, headers_dict)."""
+    obj = {"tokens": tokens, "max_new_tokens": max_new}
+    if stream:
+        obj["stream"] = True
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-Id"] = rid
+    req = urllib.request.Request(base + "/generate",
+                                 data=json.dumps(obj).encode(),
+                                 method="POST", headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers.items())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, body, dict(e.headers.items()) if e.headers else {}
+
+
+def prompt_for(i: int, n: int):
+    return [(i * 7 + k * 3) % 50 + 1 for k in range(n)]
+
+
+def launch(model_path, cache_dir, dump_dir, name, env):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "synapseml_tpu.io.serving",
+         "--host", "127.0.0.1", "--port", "0", "--name", name,
+         "--model", model_path, "--decode", "--cache-dir", cache_dir,
+         "--dump-dir", dump_dir, "--drain-timeout-ms", "8000"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    url_box, url_found = {}, threading.Event()
+
+    def read_stdout():
+        for line in proc.stdout:
+            sys.stdout.write("  [srv] " + line)
+            if not url_found.is_set():
+                m = re.search(r"serving \[.*\] on (http://\S+/)", line)
+                if m:
+                    url_box["url"] = m.group(1)
+                    url_found.set()
+
+    threading.Thread(target=read_stdout, daemon=True).start()
+    if not url_found.wait(420.0):
+        proc.kill()
+        raise RuntimeError(f"{name}: never announced its URL")
+    return proc, url_box["url"].rstrip("/")
+
+
+def main() -> int:
+    from synapseml_tpu.onnx import zoo
+
+    work = tempfile.mkdtemp(prefix="decode_proof_")
+    model_path = os.path.join(work, "tiny_decoder.onnx")
+    with open(model_path, "wb") as fh:
+        fh.write(zoo.tiny_decoder())
+    cache_dir = os.path.join(work, "cache")
+    cap_dir = os.path.join(work, "capture")
+
+    env = dict(os.environ)
+    env.pop("SYNAPSEML_FAULTS", None)
+    env.setdefault("PYTHONPATH", os.getcwd())
+    env["SYNAPSEML_CAPTURE_HEAD_SAMPLE"] = "1.0"  # keep every reply
+    env["SYNAPSEML_DECODE_MAX_BATCH"] = "4"
+    env["SYNAPSEML_DECODE_PREFILL_CHUNK"] = "8"
+    env["SYNAPSEML_KV_PAGE"] = "8"
+    env["SYNAPSEML_DECODE_MAX_SEQ"] = "64"
+    env["SYNAPSEML_KV_CAPACITY_BYTES"] = KV_CAPACITY
+
+    proc, base = launch(model_path, cache_dir, cap_dir,
+                        "decode_smoke", env)
+    capture_file = os.path.join(cap_dir, f"capture-{proc.pid}.jsonl")
+    try:
+        print(f"decode replica up at {base}", flush=True)
+        _, m0 = get(base + "/metrics")
+        if series_total(m0.decode(),
+                        "synapseml_executor_recompiles_total") != 0:
+            print("FAIL: recompiles nonzero straight after warmup")
+            return 1
+
+        # solo references BEFORE the storm: prompts the concurrent
+        # phase re-sends; their digests must not move under eviction
+        ref = {}
+        for i in (0, 1):
+            st, body, hdr = generate(base, prompt_for(i, 24), 12)
+            digest = hdr.get("X-Output-Digest")
+            if st != 200 or not digest or digest != hashlib.sha256(
+                    body).hexdigest():
+                print(f"FAIL: solo reference {i}: status {st}, "
+                      f"digest {digest!r}")
+                return 1
+            ref[i] = digest
+
+        # streamed provenance: headers precede the first token line
+        st, sbody, shdr = generate(base, prompt_for(0, 24), 12,
+                                   stream=True, rid="rid-stream-0")
+        if st != 200 or shdr.get("X-Request-Id") != "rid-stream-0" \
+                or not shdr.get("traceparent"):
+            print(f"FAIL: streamed reply provenance: status {st}, "
+                  f"headers {shdr}")
+            return 1
+        lines = sbody.decode().strip().split("\n")
+        fin = json.loads(lines[-1])
+        toks = [json.loads(ln)["t"] for ln in lines[:-1]]
+        if not fin.get("done") or fin.get("n") != len(toks):
+            print(f"FAIL: streamed framing: {lines[-1]!r}, "
+                  f"{len(toks)} token lines")
+            return 1
+        if fin.get("digest") != ref[0]:
+            print(f"FAIL: streamed digest {fin.get('digest')!r} != "
+                  f"non-stream {ref[0]!r} for the same prompt")
+            return 1
+        print("stream provenance ok (rid + traceparent + "
+              "digest-carrying final line)", flush=True)
+
+        # the storm: 12 concurrent mixed-length clients (every 3rd
+        # streamed) against a ~2.5-sequence cache — guaranteed
+        # eviction/recompute churn; clients 0/1 re-send the reference
+        # prompts mid-storm
+        results = [None] * 12
+
+        def client(i):
+            if i < 2:
+                toks, n = prompt_for(i, 24), 12
+            else:
+                toks, n = prompt_for(i, 8 + (i % 3) * 8), 6 + (i % 4) * 4
+            results[i] = (i, *generate(base, toks, n,
+                                       stream=(i % 3 == 2)))
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        if any(r is None for r in results):
+            print("FAIL: a storm client hung")
+            return 1
+        bad = [(i, st) for i, st, _b, _h in results if st != 200]
+        if bad:
+            print(f"FAIL: storm statuses: {bad}")
+            return 1
+        for i in (0, 1):
+            _, _, body, hdr = results[i]
+            if hdr.get("X-Output-Digest") != ref[i]:
+                print(f"FAIL: prompt {i} digest moved under eviction "
+                      f"churn: {hdr.get('X-Output-Digest')!r} != "
+                      f"{ref[i]!r} — recompute is NOT bit-identical")
+                return 1
+
+        _, m1 = get(base + "/metrics")
+        after = m1.decode()
+        recompiles = series_total(
+            after, "synapseml_executor_recompiles_total")
+        evictions = series_total(after, "synapseml_kv_evictions_total")
+        recomputes = series_total(after,
+                                  "synapseml_kv_recomputes_total")
+        prefills = series_total(
+            after, 'synapseml_decode_steps_total{phase="prefill"')
+        decodes = series_total(
+            after, 'synapseml_decode_steps_total{phase="decode"')
+        if recompiles != 0:
+            print(f"FAIL: {recompiles:.0f} post-warmup recompiles — "
+                  "the fixed compile geometry leaked")
+            return 1
+        if evictions < 1 or recomputes < 1:
+            print(f"FAIL: the tiny cache did not churn (evictions="
+                  f"{evictions:.0f} recomputes={recomputes:.0f}) — "
+                  "the eviction path went untested")
+            return 1
+        if prefills < 1 or decodes < 1:
+            print("FAIL: traffic was not mixed prefill/decode")
+            return 1
+        print(f"storm ok: 12/12 scored, {evictions:.0f} evictions, "
+              f"{recomputes:.0f} recomputes, digests stable, "
+              "0 recompiles", flush=True)
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=40)
+        if rc != 0:
+            print(f"FAIL: serving exited {rc}")
+            return 1
+
+        # --- live replay against a FRESH replica --------------------
+        # normal capacity (no evictions): the captured digests — some
+        # produced THROUGH an evict/recompute cycle — must reproduce
+        # on a clean cache. Streamed records are dropped (their digest
+        # rides the final NDJSON line, not the header --serve
+        # compares); so are admission 429s.
+        replay_file = os.path.join(work, "replay.jsonl")
+        kept = 0
+        with open(capture_file, encoding="utf-8") as src, \
+                open(replay_file, "w", encoding="utf-8") as dst:
+            for line in src:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("status_code") != 200:
+                    continue
+                raw = rec.get("payload")
+                if raw is None:
+                    try:
+                        raw = base64.b64decode(
+                            rec.get("payload_b64") or "").decode()
+                    except (ValueError, UnicodeDecodeError):
+                        continue
+                try:
+                    payload = json.loads(raw)
+                except (ValueError, json.JSONDecodeError):
+                    continue
+                if payload.get("stream"):
+                    continue
+                dst.write(json.dumps(rec) + "\n")
+                kept += 1
+        if kept < 8:
+            print(f"FAIL: only {kept} non-stream 200s captured")
+            return 1
+
+        env2 = dict(env)
+        env2["SYNAPSEML_KV_CAPACITY_BYTES"] = ""
+        proc2, base2 = launch(model_path, cache_dir, os.path.join(
+            work, "capture2"), "decode_replay", env2)
+        try:
+            rp = subprocess.run(
+                [sys.executable, "tools/replay.py", replay_file,
+                 "--serve", base2 + "/generate"],
+                capture_output=True, text=True, env=env, timeout=420)
+            print(rp.stdout.strip(), flush=True)
+            if rp.returncode != 0:
+                print(f"FAIL: live replay exited {rp.returncode}: "
+                      f"{rp.stderr[-1500:]}")
+                return 1
+
+            # a perturbed record must exit 2 with the rid named
+            perturbed = os.path.join(work, "perturbed.jsonl")
+            flipped = None
+            with open(replay_file, encoding="utf-8") as src, \
+                    open(perturbed, "w", encoding="utf-8") as dst:
+                for line in src:
+                    rec = json.loads(line)
+                    if flipped is None:
+                        rec["output_digest"] = "0" * 64
+                        flipped = rec["rid"]
+                    dst.write(json.dumps(rec) + "\n")
+            rp2 = subprocess.run(
+                [sys.executable, "tools/replay.py", perturbed,
+                 "--serve", base2 + "/generate"],
+                capture_output=True, text=True, env=env, timeout=420)
+            if rp2.returncode != 2 or flipped not in rp2.stdout:
+                print(f"FAIL: perturbed replay exited "
+                      f"{rp2.returncode} (wanted 2) or did not name "
+                      f"rid {flipped}: {rp2.stdout[-800:]}")
+                return 1
+        finally:
+            if proc2.poll() is None:
+                proc2.send_signal(signal.SIGTERM)
+                try:
+                    proc2.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc2.kill()
+
+        # --- the A/B tripwire: continuous must beat static ----------
+        # in-process (no server), CI-sized; >= 1.2x is the policy-
+        # inversion bound, headroom under the bench's measured 1.82x
+        from bench import bench_decode_serving
+
+        (cont_tps, stat_tps, *_rest, detail) = bench_decode_serving()
+        ratio = cont_tps / max(stat_tps, 1e-9)
+        if ratio < 1.2:
+            print(f"FAIL: continuous batching only {ratio:.2f}x static "
+                  f"({detail}) — iteration-level admission regressed")
+            return 1
+        print(f"decode proof ok: digests stable across "
+              f"{recomputes:.0f} recomputes, 0 recompiles, {kept} "
+              f"records replayed bit-identical on a fresh replica, "
+              f"perturbed rid {flipped[:8]}... exits 2, continuous "
+              f"{ratio:.2f}x static")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Hard-timeout smoke for decode serving (runtime/decode.py +
+# runtime/kvcache.py, docs/serving.md "Decode serving").
+#
+# On a FORCED 8-device virtual CPU platform, a real --decode serving
+# subprocess takes concurrent mixed prefill/decode traffic under a KV
+# capacity tiny enough to force evictions. tools/ci/decode_check.py
+# asserts: streamed replies carry rid + traceparent before the first
+# token; executor_recompiles_total stays ZERO after warmup (the fixed
+# compile geometry); an evicted sequence's recomputed reply is
+# BIT-IDENTICAL to its solo reference (digest match); the captured
+# traffic replays digest-identical against a fresh replica via
+# tools/replay.py --serve (a perturbed record exits 2); and continuous
+# batching beats static batching (the policy-inversion tripwire). A
+# wedged warmup, starved queue, or eviction livelock HANGS, which the
+# timeout turns into a fast exit-124.
+#
+# Usage: tools/ci/smoke_decode.sh   [SMOKE_TIMEOUT=seconds]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8${XLA_FLAGS:+ $XLA_FLAGS}"
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"  # bench.py lives at the root
+exec timeout -k 10 "${SMOKE_TIMEOUT:-600}" \
+  python tools/ci/decode_check.py

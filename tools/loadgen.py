@@ -57,6 +57,14 @@ file's payloads (``runtime/capture.py``) in recorded order through the
 same open-loop clock and verifies each reply's ``X-Output-Digest``
 against the record — ``digest_mismatches`` in the summary/``--out``
 JSON, nonzero exits 2 (the "did the rollout change scores?" gate).
+
+Decode mode: ``--decode`` switches to open-loop Poisson *sequence*
+arrivals against a decode-mode server's ``POST /generate``
+(``io/serving.py --decode``): prompt/output lengths sampled from
+``--prompt-lens`` / ``--output-lens``, a streamed-reply reader that
+timestamps every token line, and TTFT / inter-token-latency
+p50/p95/p99 plus tokens/s in the summary and ``--out`` JSON
+(:func:`run_decode_load`).
 """
 from __future__ import annotations
 
@@ -401,6 +409,168 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
     return summary
 
 
+def _decode_prompt(i: int, prompt_len: int) -> List[int]:
+    """Deterministic token-id prompt for sequence ``i`` — like
+    :func:`_default_payload`, pure in ``i`` so two runs against
+    deterministic greedy decode can compare streams byte for byte."""
+    return [(i * 7 + k * 3) % 50 + 1 for k in range(prompt_len)]
+
+
+def run_decode_load(url: str, rps: float, duration_s: float,
+                    prompt_lens: Sequence[int] = (4, 12, 24),
+                    output_lens: Sequence[int] = (8, 16, 32),
+                    deadline_ms: Optional[float] = None,
+                    timeout: float = 60.0,
+                    seed: Optional[int] = None,
+                    stop: Optional[threading.Event] = None
+                    ) -> Dict[str, Any]:
+    """Open-loop Poisson *sequence* arrivals against a decode-mode
+    server's ``POST /generate`` (``--decode``).
+
+    Each arrival samples a prompt length and an output budget from the
+    given mixes (cycled over the arrival sequence, deterministic under
+    ``seed``) and opens a STREAMED request; the reader timestamps every
+    NDJSON token line as it lands, so the summary reports what a decode
+    deployment is actually judged on:
+
+    - **TTFT** (time to first token): send -> first token line, p50/95/99
+      — admission wait + prefill, the interactive-feel number;
+    - **ITL** (inter-token latency): gaps between consecutive token
+      lines, pooled across sequences, p50/95/99 — the steady-state
+      decode step rate as one sequence experiences it under the
+      continuous batch;
+    - **tokens/s**: total streamed tokens over wall time — the
+      throughput headline bench.py's ``decode_serving`` group A/Bs.
+
+    The final stream line's ``digest`` (the canonical-reply sha256 the
+    server also emits non-streamed) and ``finish_reason`` are recorded
+    per sequence; open-loop semantics, join discipline, and the "every
+    scheduled sequence reaches a terminal record" assertion surface
+    match :func:`run_load`."""
+    rng = random.Random(seed)
+    prompt_lens = list(prompt_lens) or [4]
+    output_lens = list(output_lens) or [16]
+    results: List[Optional[Dict[str, Any]]] = []
+    senders: List[threading.Thread] = []
+    lock = threading.Lock()
+
+    def sender(i: int, body: bytes, traceparent: str):
+        hdrs = {"Content-Type": "application/json",
+                "traceparent": traceparent}
+        if deadline_ms is not None:
+            hdrs["X-Deadline-Ms"] = str(deadline_ms)
+        rec: Dict[str, Any] = {"status": "error", "tokens": 0,
+                               "ttft_s": None, "itl_s": [],
+                               "finish_reason": None, "digest": None,
+                               "rid": None}
+        t0 = time.monotonic()
+        req = urllib.request.Request(url, data=body, method="POST",
+                                     headers=hdrs)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                rec["status"] = r.status
+                rec["rid"] = r.headers.get("X-Request-Id")
+                last = t0
+                while True:
+                    line = r.readline()
+                    if not line:
+                        break
+                    now = time.monotonic()
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if obj.get("done"):
+                        rec["finish_reason"] = obj.get("finish_reason")
+                        rec["digest"] = obj.get("digest")
+                        break
+                    if "t" in obj:
+                        if rec["ttft_s"] is None:
+                            rec["ttft_s"] = now - t0
+                        else:
+                            rec["itl_s"].append(now - last)
+                        rec["tokens"] += 1
+                        last = now
+        except urllib.error.HTTPError as e:
+            try:
+                e.read()
+            except Exception:  # noqa: BLE001 - best-effort drain
+                pass
+            rec["status"] = e.code
+            if e.headers is not None:
+                rec["rid"] = e.headers.get("X-Request-Id")
+        except Exception:  # noqa: BLE001 - refused/reset/socket timeout
+            pass
+        rec["latency_s"] = time.monotonic() - t0
+        with lock:
+            results[i] = rec
+
+    t_start = time.monotonic()
+    t_end = t_start + duration_s
+    next_arrival = t_start
+    i = 0
+    while (stop is None or not stop.is_set()) and next_arrival < t_end:
+        delay = next_arrival - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        p_len = prompt_lens[i % len(prompt_lens)]
+        o_len = output_lens[i % len(output_lens)]
+        body = json.dumps({"tokens": _decode_prompt(i, p_len),
+                           "max_new_tokens": o_len,
+                           "stream": True}).encode()
+        traceparent = "00-%032x-%016x-01" % (rng.getrandbits(128) or 1,
+                                             rng.getrandbits(64) or 1)
+        with lock:
+            results.append(None)
+        t = threading.Thread(target=sender, args=(i, body, traceparent),
+                             daemon=True)
+        t.start()
+        senders.append(t)
+        i += 1
+        next_arrival += rng.expovariate(rps)
+    for t in senders:
+        t.join(timeout=timeout + 10.0)
+    wall = time.monotonic() - t_start
+
+    by_status: Dict[str, int] = {}
+    reasons: Dict[str, int] = {}
+    ttfts: List[float] = []
+    itls: List[float] = []
+    total_tokens = 0
+    hung = 0
+    with lock:
+        snapshot = list(results)
+    for rec in snapshot:
+        if rec is None:
+            hung += 1
+            continue
+        by_status[str(rec["status"])] = \
+            by_status.get(str(rec["status"]), 0) + 1
+        if rec["finish_reason"]:
+            reasons[rec["finish_reason"]] = \
+                reasons.get(rec["finish_reason"], 0) + 1
+        total_tokens += rec["tokens"]
+        if rec["ttft_s"] is not None:
+            ttfts.append(rec["ttft_s"])
+        itls.extend(rec["itl_s"])
+    ttfts.sort()
+    itls.sort()
+    return {
+        "mode": "decode",
+        "scheduled": i,
+        "hung": hung,
+        "by_status": by_status,
+        "finish_reasons": reasons,
+        "offered_rps": rps,
+        "achieved_rps": i / wall if wall > 0 else 0.0,
+        "wall_s": wall,
+        "tokens": total_tokens,
+        "tokens_per_s": total_tokens / wall if wall > 0 else 0.0,
+        "ttft_s": {q: percentile(ttfts, q) for q in (50.0, 95.0, 99.0)},
+        "itl_s": {q: percentile(itls, q) for q in (50.0, 95.0, 99.0)},
+    }
+
+
 def _json_finite(obj: Any) -> Any:
     """Replace non-finite floats with None so the results file is
     strict RFC-8259 JSON — ``json.dump`` would otherwise emit a bare
@@ -471,6 +641,19 @@ def main(argv=None) -> int:
                          "reply's X-Output-Digest against the record "
                          "(digest_mismatches in the summary; nonzero "
                          "exits 2)")
+    ap.add_argument("--decode", action="store_true",
+                    help="decode mode: open-loop Poisson SEQUENCE "
+                         "arrivals against a decode-mode server's "
+                         "POST /generate — streamed-reply reader, "
+                         "TTFT / inter-token-latency p50/p95/p99 and "
+                         "tokens/s in the summary (--url should point "
+                         "at the /generate endpoint)")
+    ap.add_argument("--prompt-lens", default="4,12,24",
+                    help="decode mode: comma-separated prompt token "
+                         "lengths the arrival sequence cycles through")
+    ap.add_argument("--output-lens", default="8,16,32",
+                    help="decode mode: comma-separated max_new_tokens "
+                         "budgets the arrival sequence cycles through")
     ap.add_argument("--rps", type=float, default=50.0)
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--shapes", default="2",
@@ -503,6 +686,37 @@ def main(argv=None) -> int:
 
     def payload(i: int, shape: int) -> Dict[str, Any]:
         return {key: _default_payload(i, shape)["x"]}
+
+    if args.decode:
+        if not args.url:
+            ap.error("--decode requires --url (the /generate endpoint)")
+        summary = run_decode_load(
+            args.url, args.rps, args.duration,
+            prompt_lens=[int(s) for s in args.prompt_lens.split(",")
+                         if s.strip()],
+            output_lens=[int(s) for s in args.output_lens.split(",")
+                         if s.strip()],
+            deadline_ms=args.deadline_ms, timeout=args.timeout,
+            seed=args.seed)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                json.dump(_json_finite(summary), fh, indent=2)
+        if args.json:
+            print(json.dumps(_json_finite(summary), indent=2))
+        else:
+            print(f"scheduled={summary['scheduled']} "
+                  f"hung={summary['hung']} "
+                  f"by_status={summary['by_status']} "
+                  f"finish={summary['finish_reasons']}")
+            print(f"offered={summary['offered_rps']:.1f}seq/s "
+                  f"achieved={summary['achieved_rps']:.1f}seq/s "
+                  f"tokens/s={summary['tokens_per_s']:.1f}")
+            for label, key in (("ttft", "ttft_s"), ("itl", "itl_s")):
+                vals = summary[key]
+                print(f"{label}: " + "  ".join(
+                    f"p{q:.0f}={vals[q] * 1e3:.2f}ms"
+                    for q in (50.0, 95.0, 99.0)))
+        return 1 if summary["hung"] else 0
 
     replay_records = None
     if args.replay:

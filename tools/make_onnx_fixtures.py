@@ -97,6 +97,87 @@ class QuantCNN(nn.Module):
         return self.dequant(x)
 
 
+class TinyKVDecoder(nn.Module):
+    """Autoregressive decoder block with EXPLICIT KV-cache graph I/O:
+    ``(ids, past_key, past_value) -> (logits, present_key, present_value)``
+    — the ORT-GenAI / HF export shape where the cache is the caller's
+    state, not hidden module state. GQA via repeat_interleave (4 query
+    heads over 2 KV heads) and a past-offset causal mask built from
+    traced ``arange`` arithmetic, so the exporter emits the
+    Range/Less/Where idiom over DYNAMIC past length. The round-trip
+    test proves KV concat is position-exact: feeding tokens one at a
+    time through the cache must reproduce the full-sequence logits at
+    every position."""
+
+    def __init__(self, vocab=50, d=32, heads=4, kv_heads=2):
+        super().__init__()
+        self.h, self.kvh, self.hd = heads, kv_heads, d // heads
+        self.emb = nn.Embedding(vocab, d)
+        self.wq = nn.Linear(d, d)
+        self.wk = nn.Linear(d, kv_heads * self.hd)
+        self.wv = nn.Linear(d, kv_heads * self.hd)
+        self.wo = nn.Linear(d, d)
+        self.ln = nn.LayerNorm(d)
+        self.head = nn.Linear(d, vocab)
+
+    def forward(self, ids, past_key, past_value):
+        b, s = ids.shape[0], ids.shape[1]
+        p = past_key.shape[2]
+        x = self.emb(ids)
+        q = self.wq(x).view(b, s, self.h, self.hd).transpose(1, 2)
+        k_new = self.wk(x).view(b, s, self.kvh, self.hd).transpose(1, 2)
+        v_new = self.wv(x).view(b, s, self.kvh, self.hd).transpose(1, 2)
+        k = torch.cat([past_key, k_new], dim=2)
+        v = torch.cat([past_value, v_new], dim=2)
+        kq = k.repeat_interleave(self.h // self.kvh, dim=1)
+        vq = v.repeat_interleave(self.h // self.kvh, dim=1)
+        att = (q @ kq.transpose(-1, -2)) / (self.hd ** 0.5)
+        # past-offset causal mask over dynamic p: query i sits at
+        # absolute position p+i and may attend k positions <= p+i
+        kpos = torch.arange(p + s, device=ids.device)
+        qpos = torch.arange(s, device=ids.device) + p
+        att = att.masked_fill(kpos[None, None, None, :]
+                              > qpos[None, None, :, None],
+                              float("-inf"))
+        out = (att.softmax(-1) @ vq).transpose(1, 2).reshape(b, s, -1)
+        y = self.ln(x + self.wo(out))
+        return self.head(y), k, v
+
+
+def make_kv_decoder(name="torch_kv_decoder"):
+    torch.manual_seed(42)
+    m = TinyKVDecoder().eval()
+    ids = torch.randint(0, 50, (2, 4))
+    past_k = torch.randn(2, 2, 3, 8)
+    past_v = torch.randn(2, 2, 3, 8)
+    path = os.path.join(OUT, f"{name}.onnx")
+    with torch.no_grad():
+        logits, pk, pv = m(ids, past_k, past_v)
+        # the npz also records a FULL-sequence run from an empty cache:
+        # the round-trip test's from-scratch reference
+        full_ids = torch.randint(0, 50, (1, 12))
+        empty = torch.zeros(1, 2, 0, 8)
+        full_logits, _, _ = m(full_ids, empty, empty)
+    torch.onnx.export(
+        m, (ids, past_k, past_v), path, opset_version=17, dynamo=False,
+        input_names=["input_ids", "past_key", "past_value"],
+        output_names=["logits", "present_key", "present_value"],
+        dynamic_axes={"input_ids": {0: "batch", 1: "seq"},
+                      "past_key": {0: "batch", 2: "past"},
+                      "past_value": {0: "batch", 2: "past"},
+                      "logits": {0: "batch", 1: "seq"},
+                      "present_key": {0: "batch", 2: "total"},
+                      "present_value": {0: "batch", 2: "total"}},
+        do_constant_folding=True)
+    np.savez(os.path.join(OUT, f"{name}_io.npz"),
+             input_ids=ids.numpy(), past_key=past_k.numpy(),
+             past_value=past_v.numpy(), logits=logits.numpy(),
+             present_key=pk.numpy(), present_value=pv.numpy(),
+             full_ids=full_ids.numpy(), full_logits=full_logits.numpy())
+    print(f"{name}: {os.path.getsize(path)} bytes, "
+          f"logits {tuple(logits.shape)}, present {tuple(pk.shape)}")
+
+
 def make_quantized(name="torch_quant_cnn"):
     torch.backends.quantized.engine = "fbgemm"
     torch.manual_seed(7)
@@ -169,6 +250,7 @@ def main():
            {"input": {0: "batch"}, "output": {0: "batch"}})
 
     make_quantized()
+    make_kv_decoder()
 
 
 if __name__ == "__main__":
@@ -177,5 +259,8 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "quantized":
         os.makedirs(OUT, exist_ok=True)
         make_quantized()  # additive: leaves the committed fixtures as-is
+    elif len(sys.argv) > 1 and sys.argv[1] == "kv_decoder":
+        os.makedirs(OUT, exist_ok=True)
+        make_kv_decoder()  # additive: leaves the committed fixtures as-is
     else:
         main()

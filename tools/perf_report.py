@@ -104,8 +104,23 @@ _REQUIRED_ROW_KEYS = (
     "group", "kind", "bound", "flops_per_item", "bytes_per_item",
     "achieved_flops_per_sec", "attainable_flops_per_sec",
     "roofline_fraction", "lever", "metric", "value", "unit",
-    "formulation", "partition",
+    "formulation", "partition", "tokens_per_sec",
 )
+
+
+def _group_tokens_per_sec(metrics: List[Dict[str, Any]]) -> Optional[float]:
+    """The group's token throughput, when it reports one (unit
+    ``tokens/sec`` — the decode-serving groups); None otherwise. Kept
+    as its own column because tokens/s is the serving-capacity number
+    a roofline fraction cannot substitute for: a decode step is tiny
+    and memory-bound by construction, so its fraction ranks it last
+    while its tokens/s is the headline."""
+    for m in metrics:
+        v = m.get("value")
+        if str(m.get("unit", "")) == "tokens/sec" and \
+                isinstance(v, (int, float)):
+            return float(v)
+    return None
 
 
 def _group_formulations(payload: Dict[str, Any],
@@ -221,6 +236,7 @@ def attribute_group(group: str, meta: Dict[str, Any],
         "attributed": kind == "host",  # host groups need no signature
         "signature": None,
         "device_kind": None,
+        "tokens_per_sec": _group_tokens_per_sec(metrics),
     }
     if rep is not None:
         bucket = max(1, int(rep.get("bucket", 1)))
@@ -305,15 +321,18 @@ def build_report(payload: Dict[str, Any],
     add("")
     add("## Ranked bottlenecks (worst roofline fraction first)")
     add("")
-    add("| rank | group | bound | metric | flops/item | "
+    add("| rank | group | bound | metric | tokens/s | flops/item | "
         "achieved FLOP/s | attainable | fraction | partition "
         "| formulation | lever |")
-    add("|---|---|---|---|---|---|---|---|---|---|---|")
+    add("|---|---|---|---|---|---|---|---|---|---|---|---|")
     for i, r in enumerate(rows, 1):
         frac = (f"{r['roofline_fraction']:.2%}"
                 if r["attributed"] and r["kind"] != "host" else "—")
+        tps = r.get("tokens_per_sec")
+        tps_cell = f"{tps:,.0f}" if isinstance(tps, (int, float)) else "—"
         add(f"| {i} | {r['group']} | {r['bound']} "
             f"| `{r['metric']}` = {r['value']} {r['unit']} "
+            f"| {tps_cell} "
             f"| {_fmt_eng(r['flops_per_item'])} "
             f"| {_fmt_eng(r['achieved_flops_per_sec'])} "
             f"| {_fmt_eng(r['attainable_flops_per_sec'])} "
