@@ -807,7 +807,8 @@ def bench_serving_scored_latency():
 
         def barrage():
             clats: list = []
-            lock = threading.Lock()
+            from synapseml_tpu.runtime.locksan import make_lock
+            lock = make_lock("bench:lock")
             barrier = threading.Barrier(n_clients)
 
             def client():
@@ -945,13 +946,49 @@ def bench_synlint():
             packs[pack_of(f.rule)] = packs.get(pack_of(f.rule), 0) + 1
         hit_rate = (warm["cache_hits"] / warm["files"]
                     if warm.get("files") else 0.0)
-        return {"synlint_findings_total": len(findings),
-                "synlint_runtime_s": round(cold_s, 2),
-                "synlint_warm_runtime_s": round(warm_s, 2),
-                "synlint_cache_hit_rate": round(hit_rate, 3),
-                "synlint_findings_by_pack": dict(sorted(packs.items()))}
+        out = {"synlint_findings_total": len(findings),
+               "synlint_runtime_s": round(cold_s, 2),
+               "synlint_warm_runtime_s": round(warm_s, 2),
+               "synlint_cache_hit_rate": round(hit_rate, 3),
+               "synlint_findings_by_pack": dict(sorted(packs.items()))}
+        out.update(_dynsan_detail(_prog))
+        return out
     except Exception:  # noqa: BLE001 - the bench must survive lint bugs
         return {"synlint_findings_total": -1, "synlint_runtime_s": -1.0}
+
+
+def _dynsan_detail(prog):
+    """Static<->dynamic lock-graph numbers for the committed JSON: how
+    many lock-order edges the static CC002 model claims, and — when a
+    locksan observed-graph artifact is around (SYNAPSEML_LOCKSAN_OUT,
+    e.g. after tools/ci/smoke_locksan.sh) — how many edges the runtime
+    actually saw, how many were model gaps, and how many static edges
+    no smoke has ever driven (the coverage debt)."""
+    try:
+        from tools.analysis.rules_concurrency import static_adjacency
+        from tools.analysis.rules_dynsan import cross_check, load_artifacts
+
+        adj = static_adjacency(prog)
+        out = {"dynsan_static_edges": sum(len(v) for v in adj.values())}
+        obs_dir = os.environ.get("SYNAPSEML_LOCKSAN_OUT",
+                                 "/tmp/locksan-smoke")
+        try:
+            arts = load_artifacts(obs_dir)
+        except (OSError, ValueError):
+            return out  # no artifact: static edge count still lands
+        findings, coverage = cross_check(prog, arts)
+        findings = [f for f in findings  # same filter the CLI gate uses
+                    if not prog.suppressed(f.path, f.line, f.rule)]
+        out.update({
+            "dynsan_observed_edges": sum(len(a.get("edges", ()))
+                                         for a in arts),
+            "dynsan_model_gaps": sum(1 for f in findings
+                                     if f.rule == "DS001"),
+            "dynsan_coverage_gaps": len(coverage),
+        })
+        return out
+    except Exception:  # noqa: BLE001 - detail ride-along, never fatal
+        return {}
 
 
 def _telemetry_snapshot():
@@ -1255,7 +1292,8 @@ def bench_decode_serving():
             static_batching=static)
         sched.warmup()
         sched.start()
-        lock = threading.Lock()
+        from synapseml_tpu.runtime.locksan import make_lock
+        lock = make_lock("bench:lock")
         ttfts, itls, total = [], [], [0]
 
         def consume(handle, t_sub):

@@ -48,8 +48,9 @@ from synapseml_tpu.runtime import structlog as _slog
 from synapseml_tpu.runtime import telemetry as _tm
 from synapseml_tpu.runtime import tracearchive as _ta
 from synapseml_tpu.runtime.faults import PipelineBrokenError
+from synapseml_tpu.runtime.locksan import make_lock
 
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = make_lock("serving:_REGISTRY_LOCK")
 
 # client-supplied X-Request-Id acceptance (docs/observability.md): a
 # well-formed external id becomes THE rid — span, logs, flight events,
@@ -68,7 +69,7 @@ _SLOW_BATCH_S = float(os.environ.get("SYNAPSEML_SLOW_BATCH_MS",
 # a time per process, so a second concurrent request gets 409 instead
 # of corrupting the first trace. SYNAPSEML_DEBUG_PROFILE=0 disables the
 # endpoint entirely (403) for deployments that lock debug surfaces down.
-_PROFILE_LOCK = threading.Lock()
+_PROFILE_LOCK = make_lock("serving:_PROFILE_LOCK")
 _PROFILE_MAX_MS = 10_000.0
 
 # fault-injection points (runtime/faults.py, docs/robustness.md) —
@@ -248,7 +249,7 @@ def _debug_profile(path: str) -> Tuple[int, Dict[str, Any]]:
 
 
 _BUILD_STATIC: Optional[Dict[str, Any]] = None
-_BUILD_LOCK = threading.Lock()
+_BUILD_LOCK = make_lock("serving:_BUILD_LOCK")
 
 
 def _build_static() -> Dict[str, Any]:
@@ -258,38 +259,45 @@ def _build_static() -> Dict[str, Any]:
     jax/jaxlib versions via importlib.metadata (NO jax import: a
     jax-free front-end answering /debug/build must stay jax-free)."""
     global _BUILD_STATIC
+    if _BUILD_STATIC is not None:
+        return _BUILD_STATIC
+    # Resolve OUTSIDE the lock: the git subprocess can park the thread
+    # for up to its 5s timeout (a DS003 blocking-call finding when held
+    # under _BUILD_LOCK), and the payload is deterministic per process,
+    # so racing resolvers compute identical values — only publication
+    # needs the lock.
+    import platform
+    import subprocess
+
+    sha = os.environ.get("SYNAPSEML_GIT_SHA", "").strip()
+    if not sha:
+        try:
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            sha = subprocess.run(
+                ["git", "rev-parse", "HEAD"], cwd=root, timeout=5,
+                capture_output=True, text=True).stdout.strip()
+        except Exception:  # noqa: BLE001 - no git in the image
+            sha = ""
+
+    def _ver(dist: str) -> Optional[str]:
+        try:
+            from importlib import metadata
+
+            return metadata.version(dist)
+        except Exception:  # noqa: BLE001 - dist absent
+            return None
+
+    built = {
+        "git_sha": sha or None,
+        "python": platform.python_version(),
+        "jax": _ver("jax"),
+        "jaxlib": _ver("jaxlib"),
+        "pid": os.getpid(),
+    }
     with _BUILD_LOCK:
-        if _BUILD_STATIC is not None:
-            return _BUILD_STATIC
-        import platform
-        import subprocess
-
-        sha = os.environ.get("SYNAPSEML_GIT_SHA", "").strip()
-        if not sha:
-            try:
-                root = os.path.dirname(os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__))))
-                sha = subprocess.run(
-                    ["git", "rev-parse", "HEAD"], cwd=root, timeout=5,
-                    capture_output=True, text=True).stdout.strip()
-            except Exception:  # noqa: BLE001 - no git in the image
-                sha = ""
-
-        def _ver(dist: str) -> Optional[str]:
-            try:
-                from importlib import metadata
-
-                return metadata.version(dist)
-            except Exception:  # noqa: BLE001 - dist absent
-                return None
-
-        _BUILD_STATIC = {
-            "git_sha": sha or None,
-            "python": platform.python_version(),
-            "jax": _ver("jax"),
-            "jaxlib": _ver("jaxlib"),
-            "pid": os.getpid(),
-        }
+        if _BUILD_STATIC is None:
+            _BUILD_STATIC = built
         return _BUILD_STATIC
 
 
@@ -425,7 +433,7 @@ class WorkerServer:
         self.routing: Dict[str, _PendingReply] = {}
         self.history: Dict[int, List[CachedRequest]] = {}
         self.current_epoch = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("WorkerServer._lock")
         # telemetry handles, resolved once per server (docs/
         # observability.md catalogs the series); the queue-depth gauge
         # samples qsize() at scrape time — nothing on the request path
@@ -1428,7 +1436,7 @@ class MultiChannelMap:
     the request."""
 
     def __init__(self, n_channels: int):
-        self._lock = threading.Lock()
+        self._lock = make_lock("MultiChannelMap._lock")
         self._channels: List["queue.Queue[CachedRequest]"] = [
             queue.Queue() for _ in range(max(1, n_channels))
         ]
@@ -1484,6 +1492,8 @@ class MultiChannelMap:
         Quarantining re-disperses its parked requests onto enabled
         channels — a request must never sit on a queue no healthy
         consumer drains. Returns how many requests moved."""
+        # synlint: disable=DS001 - breaker -> channel-map nesting is
+        # one-way: the map never calls back into the breaker
         with self._lock:
             if not 0 <= i < len(self._channels):
                 return 0
@@ -1612,7 +1622,7 @@ class DistributedServer:
         # (map calls never block: queue puts only), so a channel can
         # never be breaker-OPEN yet placement-enabled, which would park
         # requests on a queue whose consumer loop is idling
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = make_lock("DistributedServer._breaker_lock")
         self._breaker_state: Dict[int, int] = {}
         self._breaker_fails: Dict[int, int] = {}
         # one-row snapshot of the first successfully scored input:
@@ -2298,7 +2308,7 @@ class ContinuousServer:
         # the drop is counted (serving_errors_dropped_total), so a
         # long-lived server keeps the *recent* errors and a flat memory
         # profile
-        self._err_lock = threading.Lock()
+        self._err_lock = make_lock("ContinuousServer._err_lock")
         self.errors: List[str] = []  # synlint: shared
         self.max_errors = max(1, int(max_errors))
         self.errors_dropped = 0  # synlint: shared

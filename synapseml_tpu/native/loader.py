@@ -21,6 +21,8 @@ from typing import Optional
 
 import numpy as np
 
+from synapseml_tpu.runtime.locksan import make_lock
+
 logger = logging.getLogger("synapseml_tpu")
 
 _SRC = os.path.join(os.path.dirname(__file__), "src", "synapse_native.cpp")
@@ -28,7 +30,7 @@ _CACHE_DIR = os.path.join(os.path.dirname(__file__), "_build")
 _LIB_NAME = "libsynapse_native.so"
 _ABI_VERSION = 1
 
-_lock = threading.Lock()
+_lock = make_lock("loader:_lock")
 _state: dict = {"lib": None, "tried": False}
 
 

@@ -47,6 +47,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from synapseml_tpu.runtime import structlog as _slog
+from synapseml_tpu.runtime.locksan import make_lock
 from synapseml_tpu.runtime import telemetry as _tm
 
 __all__ = [
@@ -66,7 +67,7 @@ class _State:
 
     def __init__(self):
         self.enabled = os.environ.get("SYNAPSEML_BLACKBOX", "") != "0"
-        self.lock = threading.Lock()
+        self.lock = make_lock("_State.lock")
         self.ring: "deque[Dict[str, Any]]" = deque(maxlen=DEFAULT_CAPACITY)
         self.seq = itertools.count()
         self.dump_dir: Optional[str] = os.environ.get(
@@ -158,6 +159,8 @@ def record(event: str, rid: Optional[str] = None,
     for k, v in fields.items():
         if v is not None:
             ev[k] = v
+    # synlint: disable=DS001 - the ring lock is a leaf: record() is the
+    # flight recorder and may be called under any lock in the system
     with _S.lock:
         _S.ring.append(ev)
 

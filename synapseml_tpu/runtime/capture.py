@@ -58,6 +58,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from synapseml_tpu.runtime import telemetry as _tm
+from synapseml_tpu.runtime.locksan import make_lock
 
 __all__ = [
     "maybe_capture", "classify", "capture_path", "scan",
@@ -166,7 +167,7 @@ class _State:
         self.head_every = _head_every_from_env()
         self.reply_cap = _reply_cap_from_env()
         self.payload_cap = _payload_cap_from_env()
-        self.lock = threading.Lock()
+        self.lock = make_lock("_State.lock")
         self.head_counter = itertools.count(1)
         self.default_threshold_s = _threshold_from_env()
         # the serving entry stamps the scoring model's content hash

@@ -38,6 +38,7 @@ import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from synapseml_tpu.runtime import telemetry as _tm
+from synapseml_tpu.runtime.locksan import make_lock
 
 _ENV_KNOB = "SYNAPSEML_COMPILE_CACHE"
 _FORMAT_VERSION = 1
@@ -61,7 +62,7 @@ _M_SAVE_FAIL = _tm.counter("compile_cache_save_failures_total")
 _M_DESER_S = _tm.histogram("executor_compile_seconds",
                            phase="deserialize")
 
-_STATE_LOCK = threading.Lock()
+_STATE_LOCK = make_lock("compile_cache:_STATE_LOCK")
 _PERSISTENT_WIRED: Optional[str] = None
 # every live store, so JitCache.clear() (runtime/executor.py) can drop
 # memoized executables without each test knowing which stores exist
@@ -175,7 +176,7 @@ class ExecutableStore:
     def __init__(self, directory: str):
         self.directory = str(directory)
         self._memo: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("ExecutableStore._lock")
         self.closed = False
         _OPEN_STORES.add(self)
 

@@ -77,6 +77,7 @@ from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import structlog as _slog
 from synapseml_tpu.runtime import telemetry as _tm
 from synapseml_tpu.runtime.executor import BatchedExecutor
+from synapseml_tpu.runtime.locksan import make_condition
 from synapseml_tpu.runtime import kvcache as _kvc
 
 __all__ = ["DecodeScheduler", "DecodeHandle"]
@@ -290,7 +291,7 @@ class DecodeScheduler:
         self._t_bucket = self.t_ladder[0]
         self._seqs: Dict[str, _Seq] = {}
 
-        self._cv = threading.Condition()
+        self._cv = make_condition("DecodeScheduler._cv")
         self._waiting: deque = deque()
         self._stop = False
         self._thread: Optional[threading.Thread] = None

@@ -57,6 +57,7 @@ from synapseml_tpu.runtime import blackbox as _bb
 from synapseml_tpu.runtime import compile_cache as _cc
 from synapseml_tpu.runtime import costmodel as _cm
 from synapseml_tpu.runtime import faults as _flt
+from synapseml_tpu.runtime.locksan import make_lock
 from synapseml_tpu.runtime import perfwatch as _pw
 from synapseml_tpu.runtime import telemetry as _tm
 from synapseml_tpu.runtime.faults import PipelineBrokenError
@@ -227,7 +228,7 @@ class ExecutorFuture:
     def add_done_callback(self, fn: Callable[["ExecutorFuture"], None]):
         """Invoke ``fn(self)`` once the LAST chunk completes."""
         remaining = [len(self._chunks)]
-        lock = threading.Lock()
+        lock = make_lock("executor:lock")
 
         def _one(_f):
             with lock:
@@ -294,7 +295,7 @@ class _PipelineState:
         # may be pending host-side, so a fast producer cannot pin
         # unbounded host memory behind a slow device
         self.stage_slots = threading.Semaphore(depth + stage_workers)
-        self.lock = threading.Lock()
+        self.lock = make_lock("_PipelineState.lock")
         self.closed = False
         # supervision: set (under lock) to the PipelineBrokenError when a
         # pipeline thread dies unexpectedly; read by every loop and by
@@ -850,11 +851,11 @@ class BatchedExecutor:
         # per-executable cache — to the other's overwrite. Slow work
         # (eval_shape, device_put, .lower().compile()) always happens
         # OUTSIDE the lock; only the dict get/setdefault is guarded.
-        self._tables_lock = threading.Lock()
+        self._tables_lock = make_lock("BatchedExecutor._tables_lock")
         self._jits: Dict[Tuple[int, Tuple[bool, ...]], Callable] = {}  # synlint: shared
         self._donate_masks: Dict[tuple, Tuple[bool, ...]] = {}  # synlint: shared
         self._pipeline: Optional[_PipelineState] = None
-        self._pipeline_init_lock = threading.Lock()
+        self._pipeline_init_lock = make_lock("BatchedExecutor._pipeline_init_lock")
         # user-initiated close(): permanent, unlike a supervision break
         # (which only closes ONE _PipelineState and restarts on submit)
         self._closed = False  # synlint: shared
@@ -1809,7 +1810,7 @@ class JitCache:
 
     def __init__(self):
         self._cache: Dict[Any, Callable] = {}  # synlint: shared
-        self._lock = threading.Lock()
+        self._lock = make_lock("JitCache._lock")
 
     def get(self, key: Any, build: Callable[[], Callable]) -> Callable:
         # models call this from arbitrary scorer threads: the historical

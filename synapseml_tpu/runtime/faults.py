@@ -69,6 +69,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from synapseml_tpu.runtime import structlog as _slog
+from synapseml_tpu.runtime.locksan import make_lock
 from synapseml_tpu.runtime import telemetry as _tm
 
 __all__ = [
@@ -137,7 +138,7 @@ class _FaultSpec:
         self.exc = exc
         self.latency_s = float(latency_s)
         self.remaining = times  # None = unlimited
-        self.lock = threading.Lock()
+        self.lock = make_lock("_FaultSpec.lock")
 
     def describe(self) -> Dict[str, Any]:
         return {"prob": self.prob,
@@ -195,7 +196,7 @@ class FaultPoint:
         raise exc(f"injected fault at {self.full_name!r}")
 
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("faults:_LOCK")
 _POINTS: Dict[Tuple[str, Optional[str]], FaultPoint] = {}
 # active specs keyed the same way; (name, None) applies to every scope
 # of the family, including points registered AFTER activation

@@ -46,6 +46,7 @@ import threading
 from typing import Dict, List, Optional
 
 from synapseml_tpu.runtime import blackbox as _bb
+from synapseml_tpu.runtime.locksan import make_lock
 from synapseml_tpu.runtime import telemetry as _tm
 
 __all__ = ["PagedKVCache", "kv_capacity_bytes", "under_pressure"]
@@ -101,7 +102,7 @@ class PagedKVCache:
         # would evict forever without progress; capacity_pages >= 1
         self.capacity_pages = max(1, cap // self.page_bytes)
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("PagedKVCache._lock")
         self._pages: Dict[str, int] = {}      # seq id -> pages held
         self._tokens: Dict[str, int] = {}     # seq id -> tokens covered
         self._clock = 0

@@ -1,0 +1,656 @@
+"""locksan: runtime lock-order & blocking-call sanitizer (synsan).
+
+The dynamic half of the concurrency-tooling story: synlint's CC pack
+(tools/analysis/rules_concurrency.py) reasons *statically* about lock
+order and blocking calls, but it is lexical — it cannot see lock
+aliasing, callback indirection, or the scrape-thread interleavings
+chaos CI actually produces. locksan watches the real execution:
+
+- every lock in the package is built through :func:`make_lock` /
+  :func:`make_rlock` / :func:`make_condition` with a creation-site
+  label equal to the lock's *static CC002 identity* (``modstem:NAME``
+  for module-level locks, ``Class.attr`` for instance fields), so the
+  static model and the observed graph share one vocabulary and
+  tools/analysis/rules_dynsan.py can diff them;
+- per-thread acquire/release events land in lock-free per-thread
+  rings (each thread appends to its own deque; the registry is only
+  touched once per thread);
+- acquisition-order edges feed an observed graph; a cycle on edge
+  insert is a *lock-order inversion* finding;
+- ``sleep`` / ``queue.get`` / ``Future.result`` / socket I/O while a
+  sanitized lock is held is a *blocking-under-lock* finding (the
+  dynamic twin of CC003);
+- a watchdog thread spots a thread parked longer than
+  ``SYNAPSEML_LOCKSAN_WATCHDOG_S`` on a lock whose holder is itself
+  parked and emits a ``locksan_deadlock`` flight-recorder event with
+  both stacks (runtime/blackbox.py dump path).
+
+Off by default: ``SYNAPSEML_LOCKSAN=1`` enables it. The disabled hot
+path is ONE attribute test (``_STATE.tracer is None``), the same
+discipline as ``faults.fire()``; see docs/analysis.md "Dynamic
+sanitizer" for the measured A/B.
+
+This module is imported by telemetry/structlog/faults/blackbox, so it
+must import NOTHING from the package at module level — telemetry and
+blackbox are reached lazily, the idiom blackbox.py uses for costmodel.
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["make_lock", "make_rlock", "make_condition", "enable",
+           "disable", "enabled", "reset", "findings", "edges",
+           "snapshot", "dump"]
+
+# knobs (docs/knobs.md) — read once at import, like faults/blackbox
+_ENV_ON = os.environ.get("SYNAPSEML_LOCKSAN", "") == "1"
+_WATCHDOG_S = float(os.environ.get("SYNAPSEML_LOCKSAN_WATCHDOG_S", "2"))
+_RING = int(os.environ.get("SYNAPSEML_LOCKSAN_RING", "512"))
+_OUT_DIR = os.environ.get("SYNAPSEML_LOCKSAN_OUT", "")
+
+
+class _Switch:
+    """Enable switchboard: the disabled hot path reads ONE attribute."""
+
+    __slots__ = ("tracer",)
+
+    def __init__(self):
+        self.tracer: Optional["_Tracer"] = None
+
+
+_STATE = _Switch()
+_MET: Optional[Dict[str, Any]] = None
+
+
+def _metrics() -> Optional[Dict[str, Any]]:
+    """Telemetry counters, resolved lazily (telemetry imports us for
+    make_lock, so a module-level import would be circular). Returns
+    None until telemetry has finished importing."""
+    global _MET
+    m = _MET
+    if m is None:
+        try:
+            from synapseml_tpu.runtime import telemetry as _tm
+            if getattr(_tm, "counter", None) is None:
+                return None  # telemetry mid-import
+            m = {
+                "events": _tm.counter("locksan_events_total"),
+                "inversion": _tm.counter("locksan_findings_total",
+                                         kind="inversion"),
+                "blocking": _tm.counter("locksan_findings_total",
+                                        kind="blocking"),
+                "deadlock": _tm.counter("locksan_findings_total",
+                                        kind="deadlock"),
+            }
+        except Exception:
+            return None
+        _MET = m
+    return m
+
+
+_SKIP_FILES = (os.sep + "threading.py", os.sep + "queue.py",
+               os.sep + "socket.py", os.sep + "contextlib.py",
+               os.sep + "subprocess.py",
+               "concurrent" + os.sep + "futures")
+
+
+def _caller_site() -> str:
+    """``path:line`` of the nearest frame outside locksan and the
+    stdlib synchronization machinery — the application line that did
+    the acquire/blocking call."""
+    f = sys._getframe(1)
+    here = __file__
+    for _ in range(30):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        if fn != here and not any(s in fn for s in _SKIP_FILES):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>:0"
+
+
+class _Tls(threading.local):
+    def __init__(self):
+        self.held: List[Tuple[str, Any]] = []   # (name, lock) stack
+        self.ring: collections.deque = collections.deque(maxlen=_RING)
+        self.internal = False    # reentrancy guard for tracer innards
+        self.registered = False
+
+
+def _set_guard(tls: _Tls, on: bool) -> None:
+    """Single write site for the reentrancy guard: ``internal`` lives
+    on a ``threading.local`` subclass, per-thread by construction."""
+    tls.internal = on
+
+
+class _Tracer:
+    """All sanitizer state. One instance while enabled; internal
+    bookkeeping uses a RAW threading.Lock (it must stay invisible to
+    itself) and per-thread rings that only their owner writes."""
+
+    def __init__(self, watchdog_s: float):
+        self.watchdog_s = watchdog_s
+        self.tls = _Tls()
+        self._glock = threading.Lock()  # guards graph/findings/registry
+        # observed graph: outer name -> inner name -> [count, site]
+        self.graph: Dict[str, Dict[str, List[Any]]] = {}
+        self.locks: Dict[str, int] = {}          # name -> acquire count
+        self.events_total = 0                    # plain tally; see _publish
+        self.kind_counts: Dict[str, int] = {"inversion": 0,
+                                            "blocking": 0, "deadlock": 0}
+        self._published: Dict[str, int] = {}     # watchdog-thread-only
+        self.findings: List[Dict[str, Any]] = []
+        self._seen: set = set()                  # finding dedup keys
+        self.rings: List[Tuple[int, str, collections.deque]] = []
+        self.waiting: Dict[int, Tuple[Any, float, str]] = {}  # tid -> (lock, t0, park site)
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+
+    # -- per-thread plumbing ------------------------------------------
+
+    def _state(self) -> _Tls:
+        tls = self.tls
+        if not tls.registered:
+            tls.registered = True
+            t = threading.current_thread()
+            with self._glock:
+                self.rings.append((t.ident or 0, t.name, tls.ring))
+        return tls
+
+    def _publish(self):
+        """Push the int tallies into telemetry counters as deltas.
+        Called ONLY from the watchdog thread (and final stop()): event
+        paths must never call ``telemetry.counter`` themselves — the
+        triggering thread may already hold the sanitized (non-reentrant)
+        registry lock, so the call would self-deadlock. The watchdog
+        holds no sanitized locks, and the guard keeps its own registry
+        acquire out of the tracer."""
+        tls = self.tls
+        _set_guard(tls, True)
+        try:
+            m = _metrics()
+            if m is None:
+                return
+            with self._glock:
+                counts = dict(self.kind_counts)
+            counts["events"] = self.events_total
+            for key, val in counts.items():
+                delta = val - self._published.get(key, 0)
+                if delta > 0:
+                    m[key].inc(delta)
+                    self._published[key] = val
+        finally:
+            _set_guard(tls, False)
+
+    def _event(self, tls: _Tls, op: str, name: str):
+        tls.ring.append((time.monotonic(), op, name))
+        self.events_total += 1
+
+    # -- acquisition tracking -----------------------------------------
+
+    def acquire(self, lock: "SanLock", blocking: bool, timeout: float
+                ) -> bool:
+        raw = lock._raw
+        tls = self._state()
+        if tls.internal:
+            ok = raw.acquire(blocking, timeout)
+            if ok:
+                lock._owner = threading.get_ident()
+            return ok
+        ok = raw.acquire(False)
+        if not ok:
+            if not blocking:
+                return False
+            me = threading.get_ident()
+            self.waiting[me] = (lock, time.monotonic(), _caller_site())
+            self._event(tls, "park", lock.name)
+            try:
+                ok = raw.acquire(True, timeout)
+            finally:
+                self.waiting.pop(me, None)
+        if ok:
+            lock._owner = threading.get_ident()
+            self._acquired(tls, lock)
+        return ok
+
+    def _acquired(self, tls: _Tls, lock: "SanLock"):
+        held = tls.held
+        if held and held[-1][0] != lock.name:
+            self._edge(tls, held[-1][0], lock.name)
+        held.append((lock.name, lock))
+        self._event(tls, "acq", lock.name)
+        self.locks[lock.name] = self.locks.get(lock.name, 0) + 1
+
+    def release(self, lock: "SanLock"):
+        tls = self._state()
+        if not tls.internal:
+            held = tls.held
+            for i in range(len(held) - 1, -1, -1):
+                if held[i][1] is lock:
+                    del held[i]
+                    break
+            self._event(tls, "rel", lock.name)
+        lock._owner = None
+        lock._raw.release()
+
+    # -- observed graph + inversion detection -------------------------
+
+    def _edge(self, tls: _Tls, outer: str, inner: str):
+        _set_guard(tls, True)
+        try:
+            cycle = None
+            with self._glock:
+                d = self.graph.setdefault(outer, {})
+                rec = d.get(inner)
+                if rec is not None:
+                    rec[0] += 1
+                    return
+                site = _caller_site()
+                d[inner] = [1, site]
+                cycle = self._path(inner, outer)
+            if cycle:
+                other = self.graph.get(cycle[0], {}).get(cycle[1])
+                self._finding(
+                    "inversion",
+                    key=("inversion", frozenset((outer, inner))),
+                    outer=outer, inner=inner, site=site,
+                    other_site=other[1] if other else "<unknown>:0",
+                    cycle=[outer] + cycle,
+                    detail=f"lock-order inversion: {outer} -> {inner} "
+                           f"observed here but a {' -> '.join(cycle)} "
+                           "path was already observed")
+        finally:
+            _set_guard(tls, False)
+
+    def _path(self, start: str, goal: str) -> Optional[List[str]]:
+        """DFS path start => goal in the observed graph (caller holds
+        ``_glock``); the graph is dozens of nodes, so plain DFS."""
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in self.graph.get(node, ()):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    # -- findings ------------------------------------------------------
+
+    def _finding(self, kind: str, key: tuple, **fields: Any):
+        with self._glock:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            rec = {"kind": kind, "ts": time.time()}
+            rec.update(fields)
+            self.findings.append(rec)
+            self.kind_counts[kind] = self.kind_counts.get(kind, 0) + 1
+        try:
+            from synapseml_tpu.runtime import blackbox
+            blackbox.record("locksan_finding", channel="locksan",
+                            level="error", kind=kind,
+                            detail=str(fields.get("detail", kind)))
+        except Exception:  # reporting must never take the guarded code down
+            pass
+
+    # -- blocking-call hook (installed patches call this) -------------
+
+    def blocked(self, what: str):
+        tls = self._state()
+        if tls.internal or not tls.held:
+            return
+        name = tls.held[-1][0]
+        _set_guard(tls, True)
+        try:
+            site = _caller_site()
+            self._event(tls, "blk", name)
+            self._finding(
+                "blocking", key=("blocking", what, name, site),
+                what=what, lock=name, site=site,
+                detail=f"blocking call {what} while holding {name}")
+        finally:
+            _set_guard(tls, False)
+
+    # -- deadlock watchdog --------------------------------------------
+
+    def start_watchdog(self):
+        # synlint: disable=RL001 - the watchdog IS the supervisor of
+        # last resort: daemon, self-terminating via _stop, and its only
+        # job is to report threads nothing else can see
+        self._watchdog = threading.Thread(
+            target=self._watch, name="locksan-watchdog", daemon=True)
+        self._watchdog.start()
+
+    def _watch(self):
+        tick = min(0.25, max(0.05, self.watchdog_s / 4.0))
+        while not self._stop.wait(tick):
+            self._publish()
+            now = time.monotonic()
+            for tid, (lock, t0, site) in list(self.waiting.items()):
+                if now - t0 < self.watchdog_s:
+                    continue
+                holder = lock._owner
+                if holder is None or holder == tid:
+                    continue
+                if holder not in self.waiting:
+                    continue  # holder is running — slow, not deadlocked
+                self._deadlock(tid, holder, lock, site)
+
+    def _deadlock(self, waiter: int, holder: int, lock: "SanLock",
+                  site: str):
+        frames = sys._current_frames()
+        stacks = {}
+        for label, tid in (("waiter", waiter), ("holder", holder)):
+            f = frames.get(tid)
+            stacks[label] = "".join(traceback.format_stack(f)) if f \
+                else "<gone>"
+        names = {t.ident: t.name for t in threading.enumerate()}
+        hlock = self.waiting.get(holder, (None, 0.0, ""))[0]
+        self._finding(
+            "deadlock", key=("deadlock", lock.name, waiter, holder),
+            lock=lock.name, waiter=names.get(waiter, str(waiter)),
+            holder=names.get(holder, str(holder)),
+            holder_waits_on=getattr(hlock, "name", "<unknown>"),
+            site=site,
+            waiter_stack=stacks["waiter"], holder_stack=stacks["holder"],
+            detail=f"thread {names.get(waiter, waiter)} parked "
+                   f">{self.watchdog_s:g}s on {lock.name} whose holder "
+                   f"{names.get(holder, holder)} is itself parked on "
+                   f"{getattr(hlock, 'name', '<unknown>')}")
+        try:
+            from synapseml_tpu.runtime import blackbox
+            blackbox.record("locksan_deadlock", channel="locksan",
+                            level="error", lock=lock.name,
+                            waiter=names.get(waiter, str(waiter)),
+                            holder=names.get(holder, str(holder)),
+                            waiter_stack=stacks["waiter"],
+                            holder_stack=stacks["holder"])
+            blackbox.trigger("locksan_deadlock")
+        except Exception:  # a failed dump must not wedge the watchdog
+            pass
+
+    def stop(self):
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2)
+        self._publish()  # final flush of the metric tallies
+
+
+# -- lock wrappers --------------------------------------------------------
+
+class SanLock:
+    """``threading.Lock`` shim. When the sanitizer is off, every method
+    is ONE attribute test (``_STATE.tracer``) ahead of the raw op."""
+
+    __slots__ = ("_raw", "name", "_owner")
+
+    def __init__(self, name: str):
+        self._raw = threading.Lock()
+        self.name = name
+        self._owner: Optional[int] = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        tr = _STATE.tracer
+        if tr is None:
+            return self._raw.acquire(blocking, timeout)
+        return tr.acquire(self, blocking, timeout)
+
+    def release(self):
+        tr = _STATE.tracer
+        if tr is None:
+            return self._raw.release()
+        tr.release(self)
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self):
+        # inlined disabled path: `with lock:` is the dominant idiom, so
+        # it gets the one-attribute test without an extra call frame
+        tr = _STATE.tracer
+        if tr is None:
+            self._raw.acquire()
+            return self
+        tr.acquire(self, True, -1)
+        return self
+
+    def __exit__(self, *exc):
+        tr = _STATE.tracer
+        if tr is None:
+            self._raw.release()
+            return
+        tr.release(self)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<SanLock {self.name} raw={self._raw!r}>"
+
+
+class SanRLock:
+    """Reentrant variant: re-acquisition by the owner records neither
+    edges nor park state (matching RLock semantics)."""
+
+    __slots__ = ("_raw", "name", "_owner", "_count")
+
+    def __init__(self, name: str):
+        self._raw = threading.RLock()
+        self.name = name
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        tr = _STATE.tracer
+        if tr is None:
+            return self._raw.acquire(blocking, timeout)
+        if self._owner == threading.get_ident():
+            ok = self._raw.acquire(blocking, timeout)
+            if ok:
+                self._count += 1
+            return ok
+        ok = tr.acquire(self, blocking, timeout)  # type: ignore[arg-type]
+        if ok:
+            self._count = 1
+        return ok
+
+    def release(self):
+        tr = _STATE.tracer
+        if tr is None:
+            return self._raw.release()
+        if self._count > 1:
+            self._count -= 1
+            return self._raw.release()
+        self._count = 0
+        tr.release(self)  # type: ignore[arg-type]
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def make_lock(name: str) -> SanLock:
+    """Factory every ``threading.Lock()`` site migrated to. ``name``
+    MUST be the lock's static CC002 identity (``modstem:NAME`` for a
+    module-level lock, ``Class.attr`` for an instance field) so the
+    observed graph and the static model share one vocabulary."""
+    return SanLock(name)
+
+
+def make_rlock(name: str) -> SanRLock:
+    return SanRLock(name)
+
+
+def make_condition(name: str) -> threading.Condition:
+    """Condition over a sanitized lock: ``wait()`` releases/reacquires
+    through the SanLock wrapper, so the held-set stays truthful across
+    the wait window."""
+    return threading.Condition(make_lock(name))
+
+
+# -- blocking-call patches ------------------------------------------------
+
+_PATCHES: List[Tuple[Any, str, Any]] = []
+
+
+def _hook(owner: Any, attr: str, what: str, pred: Any = None):
+    orig = getattr(owner, attr)
+
+    def wrapper(*args: Any, **kwargs: Any):
+        tr = _STATE.tracer
+        if tr is not None and (pred is None or pred(args, kwargs)):
+            tr.blocked(what)
+        return orig(*args, **kwargs)
+
+    wrapper.__name__ = getattr(orig, "__name__", attr)
+    wrapper._locksan_orig = orig
+    _PATCHES.append((owner, attr, orig))
+    setattr(owner, attr, wrapper)
+
+
+def _install_patches():
+    if _PATCHES:
+        return
+    import queue as _queue
+    import socket as _socket
+    from concurrent.futures import Future as _Future
+    _hook(time, "sleep", "time.sleep")
+    # get_nowait() routes through get(block=False) — only a call that
+    # can actually park the thread counts as blocking
+    _hook(_queue.Queue, "get", "queue.Queue.get",
+          pred=lambda a, k: (a[1] if len(a) > 1
+                             else k.get("block", True)))
+    _hook(_Future, "result", "Future.result")
+    for meth in ("accept", "connect", "recv", "sendall"):
+        _hook(_socket.socket, meth, f"socket.{meth}")
+
+
+def _remove_patches():
+    while _PATCHES:
+        owner, attr, orig = _PATCHES.pop()
+        setattr(owner, attr, orig)
+
+
+# -- public control surface -----------------------------------------------
+
+def enable(watchdog_s: Optional[float] = None) -> None:
+    """Turn the sanitizer on (idempotent). Tests call this directly;
+    production turns it on with ``SYNAPSEML_LOCKSAN=1``."""
+    if _STATE.tracer is not None:
+        return
+    tracer = _Tracer(_WATCHDOG_S if watchdog_s is None else watchdog_s)
+    _install_patches()
+    _STATE.tracer = tracer
+    tracer.start_watchdog()
+
+
+def disable() -> None:
+    tracer = _STATE.tracer
+    if tracer is None:
+        return
+    _STATE.tracer = None
+    _remove_patches()
+    tracer.stop()
+
+
+def enabled() -> bool:
+    return _STATE.tracer is not None
+
+
+def reset() -> None:
+    """Tests: drop observed state but keep the sanitizer running."""
+    tracer = _STATE.tracer
+    if tracer is not None:
+        with tracer._glock:
+            tracer.graph.clear()
+            tracer.locks.clear()
+            tracer.findings.clear()
+            tracer._seen.clear()
+
+
+def findings() -> List[Dict[str, Any]]:
+    tracer = _STATE.tracer
+    if tracer is None:
+        return []
+    with tracer._glock:
+        return [dict(f) for f in tracer.findings]
+
+
+def edges() -> List[Dict[str, Any]]:
+    tracer = _STATE.tracer
+    if tracer is None:
+        return []
+    out = []
+    with tracer._glock:
+        for outer, inners in tracer.graph.items():
+            for inner, (count, site) in inners.items():
+                out.append({"outer": outer, "inner": inner,
+                            "count": count, "site": site})
+    return out
+
+
+def snapshot() -> Dict[str, Any]:
+    """The observed-graph artifact tools/analysis/rules_dynsan.py
+    ingests (``--observed``)."""
+    tracer = _STATE.tracer
+    base: Dict[str, Any] = {
+        "version": 1, "tool": "locksan", "pid": os.getpid(),
+        "enabled": tracer is not None,
+    }
+    if tracer is None:
+        base.update({"edges": [], "locks": {}, "findings": [],
+                     "events_total": 0, "threads": 0})
+        return base
+    with tracer._glock:
+        rings = list(tracer.rings)
+    base.update({
+        "edges": edges(),
+        "locks": dict(tracer.locks),
+        "findings": findings(),
+        "events_total": tracer.events_total,
+        "threads": len(rings),
+        "watchdog_s": tracer.watchdog_s,
+    })
+    return base
+
+
+def dump(path: Optional[str] = None) -> str:
+    """Write the observed-graph artifact. With no ``path``, writes
+    ``locksan-<pid>.json`` under ``SYNAPSEML_LOCKSAN_OUT`` (each
+    process in a multi-process smoke gets its own file; the analyzer
+    merges a directory)."""
+    if path is None:
+        out = _OUT_DIR or "."
+        os.makedirs(out, exist_ok=True)
+        path = os.path.join(out, f"locksan-{os.getpid()}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(snapshot(), fh, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def _atexit_dump():  # pragma: no cover - exercised by the smokes
+    if _OUT_DIR and _STATE.tracer is not None:
+        try:
+            dump()
+        except Exception:  # interpreter tearing down; losing the artifact is fine
+            pass
+
+
+if _ENV_ON:
+    enable()
+if _OUT_DIR:
+    atexit.register(_atexit_dump)

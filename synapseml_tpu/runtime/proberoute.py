@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from synapseml_tpu.runtime.locksan import make_lock
+
 # default negative-memo TTL: long enough that a shape with no verdict
 # does not re-open the cache file on every trace, short enough that a
 # sibling worker's probe verdict lands without a process restart
@@ -82,8 +84,8 @@ class RouteTable:
         self.filename = filename
         self._memo: Dict[str, str] = {}
         self._neg: Dict[str, float] = {}  # key -> monotonic expiry
-        self._lock = threading.Lock()
-        self._read_lock = threading.Lock()  # single-flight disk reads
+        self._lock = make_lock("RouteTable._lock")
+        self._read_lock = make_lock("RouteTable._read_lock")  # single-flight disk reads
         self._read_gen = 0  # bumped after every merged disk read
 
     def path(self) -> str:

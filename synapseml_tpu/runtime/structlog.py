@@ -55,6 +55,8 @@ import threading
 import time
 from typing import Any, Dict, Optional, TextIO
 
+from synapseml_tpu.runtime.locksan import make_lock
+
 __all__ = ["log", "enabled", "mode", "set_mode", "dropped_lines",
            "LEVELS"]
 
@@ -83,13 +85,13 @@ class _Cfg:
 
 
 _CFG = _Cfg()
-_WRITE_LOCK = threading.Lock()
+_WRITE_LOCK = make_lock("structlog:_WRITE_LOCK")
 
 # bounded hand-off to the stderr writer thread: log() never blocks,
 # whatever the pipe's consumer is doing
 _Q_MAX = 4096
 _LOG_Q: "_queue.Queue[str]" = _queue.Queue(maxsize=_Q_MAX)
-_WRITER_LOCK = threading.Lock()
+_WRITER_LOCK = make_lock("structlog:_WRITER_LOCK")
 _WRITER: Optional[threading.Thread] = None
 
 

@@ -53,6 +53,8 @@ import threading
 import time
 import uuid
 from collections import deque
+
+from synapseml_tpu.runtime.locksan import make_lock
 from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
                     Tuple)
 
@@ -283,7 +285,7 @@ class Histogram(_Metric):
 
 # -- registry ---------------------------------------------------------------
 
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock("telemetry:_REG_LOCK")
 _METRICS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], _Metric] = {}
 
 
@@ -294,6 +296,8 @@ def _labels_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
 def _get_or_make(cls, name: str, labels: Dict[str, Any], **kw) -> Any:
     name = _qualify(name)
     key = (name, _labels_key(labels))
+    # synlint: disable=DS001 - _REG_LOCK is a leaf: metric get-or-create
+    # may nest under any caller lock and acquires nothing further
     with _REG_LOCK:
         m = _METRICS.get(key)
         if m is None or not isinstance(m, cls):
@@ -421,7 +425,7 @@ def mint_span_id() -> str:
 
 # -- trace spans ------------------------------------------------------------
 
-_SPAN_LOCK = threading.Lock()
+_SPAN_LOCK = make_lock("telemetry:_SPAN_LOCK")
 _ACTIVE_SPANS: Dict[str, "Span"] = {}
 _MAX_ACTIVE = 4096
 

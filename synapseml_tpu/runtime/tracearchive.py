@@ -49,6 +49,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from synapseml_tpu.runtime import telemetry as _tm
+from synapseml_tpu.runtime.locksan import make_lock
 
 __all__ = [
     "maybe_archive", "archive_path", "scan", "configure", "reset",
@@ -110,7 +111,7 @@ class _State:
         self.dir: Optional[str] = None  # None = beside the flight dumps
         self.max_bytes = _max_bytes_from_env()
         self.head_every = _head_every_from_env()
-        self.lock = threading.Lock()
+        self.lock = make_lock("_State.lock")
         self.head_counter = itertools.count(1)
         self.default_threshold_s = _threshold_from_env()
 

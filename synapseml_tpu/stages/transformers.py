@@ -32,6 +32,7 @@ from synapseml_tpu.core.param import (
 )
 from synapseml_tpu.core.pipeline import Estimator, Model, Transformer
 from synapseml_tpu.data.table import Table, concat_tables
+from synapseml_tpu.runtime.locksan import make_lock
 
 logger = logging.getLogger("synapseml_tpu")
 
@@ -512,7 +513,8 @@ class PartitionConsolidator(Transformer, HasInputCol, HasOutputCol):
 
         st = self.__dict__.get("_consolidator_state")
         if st is None:
-            st = {"lock": threading.Lock(), "buffer": [], "owner": None}
+            st = {"lock": make_lock("st['lock']"), "buffer": [],
+                  "owner": None}
             self.__dict__["_consolidator_state"] = st
         return st
 

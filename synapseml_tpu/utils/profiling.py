@@ -23,11 +23,12 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from synapseml_tpu.data.table import Table
+from synapseml_tpu.runtime.locksan import make_lock
 
 # nesting-safe active-trace count: runtime/telemetry.py consults
 # trace_active() so the executor's pipeline-stage TraceAnnotations only
 # pay their cost while a profiler trace is actually recording
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = make_lock("profiling:_ACTIVE_LOCK")
 _ACTIVE_TRACES = 0
 
 
@@ -129,7 +130,7 @@ class StopWatch:
         self.elapsed = 0.0
         self._start: Optional[float] = None
         self.sync_device = sync_device
-        self._lock = threading.Lock()
+        self._lock = make_lock("StopWatch._lock")
 
     def start(self):
         if self.sync_device:

@@ -1,0 +1,15 @@
+"""DS001 bad: the inner lock is reached through a dict alias, so the
+static CC002 model never orders the pair — but the runtime saw the
+edge (the ``ds001.observed.json`` sidecar), which makes it a model
+gap."""
+from synapseml_tpu.runtime.locksan import make_lock
+
+_A = make_lock("ds001:_A")
+_B = make_lock("ds001:_B")
+_REGISTRY = {"b": _B}
+
+
+def flush():
+    with _A:
+        with _REGISTRY["b"]:        # dynamically _A -> _B; statically opaque
+            pass

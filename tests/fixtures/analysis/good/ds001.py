@@ -1,0 +1,12 @@
+"""DS001 good: the same nesting acquired directly — the static model
+orders the pair, so the observed edge in the sidecar is covered."""
+from synapseml_tpu.runtime.locksan import make_lock
+
+_A = make_lock("ds001:_A")
+_B = make_lock("ds001:_B")
+
+
+def flush():
+    with _A:
+        with _B:
+            pass

@@ -22,7 +22,7 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
 RULE_FIXTURES = ["jh001", "jh002", "jh003", "jh004", "jh005",
                  "cc001", "cc002", "cc003",
                  "rl001", "rl002", "rl003", "eh001", "eh002",
-                 "ev001", "ev003", "pl001"]
+                 "ev001", "ev003", "pl001", "ds001"]
 
 
 def _cli(*args):

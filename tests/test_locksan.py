@@ -1,0 +1,442 @@
+"""locksan (runtime/locksan.py) + the rules_dynsan cross-check.
+
+Pinned here:
+
+- the three detectors on deliberately-broken fixtures: a lock-order
+  inversion, a blocking call under a lock, and a real ABBA deadlock the
+  watchdog must report (the ABBA legs self-unwedge via acquire
+  timeouts, and every wait carries a hard wall so a regression fails
+  fast instead of hanging CI);
+- the disabled hot path is ONE attribute test ahead of the raw op —
+  the zero-overhead contract the knob table promises;
+- lock identity: migrated sites carry their static CC002 labels, so
+  the observed graph and the static model share a vocabulary (the
+  whole point of the factory migration);
+- the static<->dynamic diff: an observed edge the static model cannot
+  reach is a DS001 model-gap finding, a modeled edge is not, and a
+  ``# synlint: disable=DS001`` at the acquire site suppresses it;
+- editing rules_dynsan.py invalidates cached analysis summaries (the
+  analyzer-version hash covers the new pack).
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from synapseml_tpu.runtime import locksan
+
+HARD = 30  # wall-clock ceiling for any single wait in this file
+
+
+@pytest.fixture
+def sanitizer():
+    """Enabled sanitizer with a fast watchdog, always torn down."""
+    locksan.disable()
+    locksan.enable(watchdog_s=0.3)
+    locksan.reset()
+    yield locksan
+    locksan.disable()
+
+
+def _join(threads):
+    for t in threads:
+        t.join(timeout=HARD)
+        assert not t.is_alive(), f"{t.name} wedged past the {HARD}s wall"
+
+
+# -- detectors ----------------------------------------------------------
+
+
+def test_inversion_detected(sanitizer):
+    a = locksan.make_lock("t:_A")
+    b = locksan.make_lock("t:_B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # closes the cycle: B -> A after A -> B
+            pass
+    kinds = [f["kind"] for f in locksan.findings()]
+    assert kinds == ["inversion"]
+    f = locksan.findings()[0]
+    assert {"t:_A", "t:_B"} == {f["outer"], f["inner"]}
+    assert "t:_A" in f["detail"] and "t:_B" in f["detail"]
+
+
+def test_consistent_order_is_clean(sanitizer):
+    a = locksan.make_lock("t:_A")
+    b = locksan.make_lock("t:_B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert locksan.findings() == []
+    assert [(e["outer"], e["inner"], e["count"])
+            for e in locksan.edges()] == [("t:_A", "t:_B", 3)]
+
+
+def test_blocking_under_lock_detected(sanitizer):
+    lk = locksan.make_lock("t:_HELD")
+    with lk:
+        time.sleep(0.01)
+    fs = locksan.findings()
+    assert [f["kind"] for f in fs] == ["blocking"]
+    assert fs[0]["what"] == "time.sleep"
+    assert fs[0]["lock"] == "t:_HELD"
+
+
+def test_nonblocking_get_is_not_blocking(sanitizer):
+    import queue
+    q = queue.Queue()
+    q.put(1)
+    lk = locksan.make_lock("t:_HELD")
+    with lk:
+        assert q.get_nowait() == 1  # routes through get(block=False)
+    with lk:
+        with pytest.raises(queue.Empty):
+            q.get(block=False)
+    assert locksan.findings() == []
+
+
+def test_blocking_get_under_lock_detected(sanitizer):
+    import queue
+    q = queue.Queue()
+    q.put(1)
+    lk = locksan.make_lock("t:_HELD")
+    with lk:
+        q.get(timeout=1)
+    assert [f["kind"] for f in locksan.findings()] == ["blocking"]
+
+
+def test_sleep_without_lock_is_clean(sanitizer):
+    time.sleep(0.01)
+    assert locksan.findings() == []
+
+
+def test_blocking_site_skips_subprocess_internals(sanitizer):
+    # subprocess.run(..., timeout=) parks in a poll loop that calls
+    # time.sleep from subprocess.py; the finding must point at the
+    # application frame that launched the child, not the stdlib.
+    import subprocess
+    import sys
+    lk = locksan.make_lock("t:_HELD")
+    with lk:
+        subprocess.run(
+            [sys.executable, "-c", "import time; time.sleep(0.2)"],
+            timeout=HARD, capture_output=True)
+    fs = [f for f in locksan.findings() if f["kind"] == "blocking"]
+    assert fs and fs[0]["what"] == "time.sleep"
+    assert "test_locksan.py" in fs[0]["site"]
+    assert "subprocess.py" not in fs[0]["site"]
+
+
+def test_build_static_does_not_block_under_lock(sanitizer):
+    # Regression for a real bring-up finding: _build_static() used to
+    # run ``git rev-parse`` (and its sleeping wait loop) while holding
+    # _BUILD_LOCK. The resolve now happens outside the lock.
+    from synapseml_tpu.io import serving
+    old = serving._BUILD_STATIC
+    serving._BUILD_STATIC = None
+    try:
+        info = serving._build_static()
+    finally:
+        serving._BUILD_STATIC = old
+    assert info["python"] and info["pid"] == os.getpid()
+    assert [f for f in locksan.findings()
+            if f["kind"] == "blocking"] == []
+
+
+def test_deadlock_watchdog_fires(sanitizer):
+    """Real ABBA: both threads park on the other's lock. The acquire
+    timeouts (< HARD) self-unwedge the test; the watchdog (0.3s) must
+    report the window first."""
+    a = locksan.make_lock("t:_DL_A")
+    b = locksan.make_lock("t:_DL_B")
+    mid = threading.Barrier(2)
+
+    def leg(first, second):
+        first.acquire()
+        try:
+            mid.wait(timeout=HARD)
+            if second.acquire(timeout=5):  # parks; watchdog fires
+                second.release()
+        except threading.BrokenBarrierError:
+            pass
+        finally:
+            first.release()
+
+    t1 = threading.Thread(target=leg, args=(a, b), name="leg-ab")
+    t2 = threading.Thread(target=leg, args=(b, a), name="leg-ba")
+    t1.start()
+    t2.start()
+    _join([t1, t2])
+    kinds = {f["kind"] for f in locksan.findings()}
+    assert "deadlock" in kinds
+    dl = next(f for f in locksan.findings() if f["kind"] == "deadlock")
+    assert {dl["lock"], dl["holder_waits_on"]} == {"t:_DL_A", "t:_DL_B"}
+    assert "leg-" in dl["waiter"] and "leg-" in dl["holder"]
+    assert dl["waiter_stack"] and dl["holder_stack"]
+
+
+def test_slow_holder_is_not_deadlock(sanitizer):
+    """A parked thread whose holder is RUNNING (slow, not parked) must
+    not trip the watchdog."""
+    lk = locksan.make_lock("t:_SLOW")
+    entered = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.8:  # busy, never parked
+                pass
+
+    t = threading.Thread(target=holder, name="slow-holder")
+    t.start()
+    assert entered.wait(timeout=HARD)
+    assert lk.acquire(timeout=HARD)
+    lk.release()
+    _join([t])
+    assert [f for f in locksan.findings()
+            if f["kind"] == "deadlock"] == []
+
+
+# -- zero-overhead contract + lifecycle ---------------------------------
+
+
+def test_disabled_path_is_one_attribute_test():
+    locksan.disable()
+    lk = locksan.make_lock("t:_OFF")
+    assert locksan._STATE.tracer is None  # the single attribute read
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+    assert locksan.findings() == [] and locksan.edges() == []
+    # and nothing was patched while off
+    assert not locksan._PATCHES
+
+
+def test_disable_restores_patches(sanitizer):
+    assert locksan._PATCHES
+    assert hasattr(time.sleep, "_locksan_orig")
+    locksan.disable()
+    assert not locksan._PATCHES
+    assert not hasattr(time.sleep, "_locksan_orig")
+
+
+def test_rlock_reentry_records_no_edge(sanitizer):
+    rl = locksan.make_rlock("t:_RL")
+    with rl:
+        with rl:  # owner re-entry: RLock semantics, no self-edge
+            pass
+    assert locksan.findings() == []
+    assert locksan.edges() == []
+
+
+def test_condition_wait_releases_held_set(sanitizer):
+    cv = locksan.make_condition("t:_CV")
+    woke = threading.Event()
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=HARD)
+            woke.set()
+
+    t = threading.Thread(target=waiter, name="cv-waiter")
+    t.start()
+    time.sleep(0.1)
+    with cv:  # acquirable: wait() released through the SanLock
+        cv.notify_all()
+    _join([t])
+    assert woke.is_set()
+    # the wait must not read as blocking-under-lock
+    assert [f for f in locksan.findings()
+            if f["kind"] == "blocking"] == []
+
+
+def test_snapshot_and_dump_roundtrip(sanitizer, tmp_path):
+    a = locksan.make_lock("t:_A")
+    b = locksan.make_lock("t:_B")
+    with a:
+        with b:
+            pass
+    path = locksan.dump(str(tmp_path / "locksan-test.json"))
+    art = json.loads(open(path).read())
+    assert art["tool"] == "locksan" and art["enabled"]
+    assert [(e["outer"], e["inner"]) for e in art["edges"]] == \
+        [("t:_A", "t:_B")]
+    assert art["locks"]["t:_A"] == 1 and art["events_total"] >= 4
+
+
+# -- identity vocabulary (satellite: migration stability) ---------------
+
+
+def test_migrated_sites_carry_cc002_identity():
+    from synapseml_tpu.runtime import telemetry
+    assert telemetry._REG_LOCK.name == "telemetry:_REG_LOCK"
+    from synapseml_tpu.runtime.kvcache import PagedKVCache
+    from synapseml_tpu.runtime import blackbox
+    assert blackbox._S.lock.name == "_State.lock"
+    from synapseml_tpu.runtime.decode import DecodeScheduler  # noqa: F401
+
+
+def test_observed_vocabulary_matches_static_model(sanitizer):
+    """The telemetry registry lock under observation carries exactly
+    the identity the static CC002 summary uses — the shared-vocabulary
+    contract the cross-check depends on."""
+    from synapseml_tpu.runtime import telemetry
+    outer = locksan.make_lock("t:_OUTER")
+    with outer:
+        telemetry.counter("locksan_vocab_test_total")
+    names = {e["inner"] for e in locksan.edges()}
+    assert "telemetry:_REG_LOCK" in names
+
+
+# -- static<->dynamic cross-check (rules_dynsan) ------------------------
+
+_MODULE = '''\
+from synapseml_tpu.runtime.locksan import make_lock
+
+_A = make_lock("mod:_A")
+_B = make_lock("mod:_B")
+
+
+def ordered():
+    with _A:
+        with _B:
+            pass
+'''
+
+
+def _observed(path, outer, inner, site):
+    return {"version": 1, "tool": "locksan", "pid": 0, "enabled": True,
+            "edges": [{"outer": outer, "inner": inner, "count": 1,
+                       "site": site}],
+            "locks": {outer: 1, inner: 1}, "findings": [],
+            "events_total": 4, "threads": 1}
+
+
+def _cross(tmp_path, source, observed):
+    from tools.analysis.engine import analyze_program
+    from tools.analysis.rules_dynsan import cross_check
+    mod = tmp_path / "mod.py"
+    mod.write_text(source)
+    _, prog, _ = analyze_program([str(mod)], root=str(tmp_path))
+    return cross_check(prog, [observed]), prog
+
+
+def test_cross_check_modeled_edge_is_clean(tmp_path):
+    (findings, coverage), _ = _cross(
+        tmp_path, _MODULE,
+        _observed("mod.py", "mod:_A", "mod:_B", "mod.py:9"))
+    assert findings == []
+    assert coverage == []  # the one static edge was observed
+
+
+def test_cross_check_model_gap_is_ds001(tmp_path):
+    (findings, _), _ = _cross(
+        tmp_path, _MODULE,
+        _observed("mod.py", "mod:_B", "mod:_A", "mod.py:9"))
+    assert [f.rule for f in findings] == ["DS001"]
+    assert findings[0].context == "mod:_B -> mod:_A"
+
+
+def test_cross_check_coverage_note_for_unobserved_edge(tmp_path):
+    from tools.analysis.engine import analyze_program
+    from tools.analysis.rules_dynsan import cross_check
+    mod = tmp_path / "mod.py"
+    mod.write_text(_MODULE)
+    _, prog, _ = analyze_program([str(mod)], root=str(tmp_path))
+    findings, coverage = cross_check(
+        prog, [{"version": 1, "tool": "locksan", "pid": 0,
+                "enabled": True, "edges": [], "locks": {},
+                "findings": [], "events_total": 0, "threads": 0}])
+    assert findings == []
+    assert [c.rule for c in coverage] == ["DS900"]
+    assert "mod:_A -> mod:_B" in coverage[0].message
+
+
+def test_cross_check_runtime_finding_becomes_ds_rule(tmp_path):
+    from tools.analysis.engine import analyze_program
+    from tools.analysis.rules_dynsan import cross_check
+    mod = tmp_path / "mod.py"
+    mod.write_text(_MODULE)
+    _, prog, _ = analyze_program([str(mod)], root=str(tmp_path))
+    art = _observed("mod.py", "mod:_A", "mod:_B", "mod.py:9")
+    art["findings"] = [{"kind": "blocking", "what": "time.sleep",
+                        "lock": "mod:_A", "site": "mod.py:9",
+                        "detail": "blocking call time.sleep while "
+                                  "holding mod:_A"}]
+    findings, _ = cross_check(prog, [art])
+    assert [f.rule for f in findings] == ["DS003"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_ds001_suppressed_at_acquire_site(tmp_path):
+    from tools.analysis.engine import analyze_paths
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        'from synapseml_tpu.runtime.locksan import make_lock\n'
+        '\n'
+        '_A = make_lock("mod:_A")\n'
+        '_B = make_lock("mod:_B")\n'
+        '\n'
+        '\n'
+        'def leaf():\n'
+        '    # synlint: disable=DS001 - _B is a leaf lock\n'
+        '    with _B:\n'
+        '        pass\n')
+    (tmp_path / "mod.observed.json").write_text(json.dumps(
+        _observed("mod.py", "mod:_A", "mod:_B", "mod.py:9")))
+    findings = analyze_paths([str(mod)], root=str(tmp_path))
+    assert [f.rule for f in findings if f.rule == "DS001"] == []
+
+
+def test_sidecar_fixture_without_suppression_trips(tmp_path):
+    from tools.analysis.engine import analyze_paths
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        'from synapseml_tpu.runtime.locksan import make_lock\n'
+        '\n'
+        '_A = make_lock("mod:_A")\n'
+        '_B = make_lock("mod:_B")\n'
+        '\n'
+        '\n'
+        'def leaf():\n'
+        '    with _B:\n'
+        '        pass\n')
+    (tmp_path / "mod.observed.json").write_text(json.dumps(
+        _observed("mod.py", "mod:_A", "mod:_B", "mod.py:8")))
+    findings = analyze_paths([str(mod)], root=str(tmp_path))
+    assert [f.rule for f in findings] == ["DS001"]
+
+
+def test_load_artifacts_rejects_junk(tmp_path):
+    from tools.analysis.rules_dynsan import load_artifacts
+    with pytest.raises(ValueError):
+        load_artifacts(str(tmp_path))  # empty dir
+    bad = tmp_path / "locksan-1.json"
+    bad.write_text('{"tool": "other"}')
+    with pytest.raises(ValueError):
+        load_artifacts(str(bad))
+
+
+def test_analyzer_version_covers_dynsan_pack():
+    """Editing rules_dynsan.py must invalidate cached summaries."""
+    import tools.analysis.cache as cache
+    import inspect
+    src = inspect.getsource(cache)
+    assert "analyzer_version" in src
+    v = cache.analyzer_version()
+    import tools.analysis.rules_dynsan as rd
+    path = rd.__file__
+    orig = open(path, encoding="utf-8").read()
+    try:
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write("\n# cache-buster\n")
+        assert cache.analyzer_version() != v
+    finally:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(orig)

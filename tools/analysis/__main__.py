@@ -79,6 +79,12 @@ def main(argv=None) -> int:
                          "GITREF (default HEAD); the whole repo is "
                          "still analyzed so cross-module rules stay "
                          "sound")
+    ap.add_argument("--observed", default=None, metavar="PATH",
+                    help="locksan observed-graph artifact (a JSON file, "
+                         "or a directory of locksan-*.json): cross-check "
+                         "the runtime lock-order graph against the "
+                         "static CC002 model (DS rules); coverage "
+                         "annotations ride --json/--sarif as notes")
     ap.add_argument("--sarif", default=None, metavar="FILE",
                     help="also write findings (post-baseline) as SARIF "
                          "2.1.0 for CI annotations")
@@ -103,6 +109,32 @@ def main(argv=None) -> int:
     runtime_s = time.monotonic() - t0
     if cache is not None:
         cache.save()
+
+    coverage: List = []
+    dynsan_stats: Optional[dict] = None
+    if args.observed:
+        from tools.analysis.rules_dynsan import cross_check, load_artifacts
+        try:
+            arts = load_artifacts(args.observed)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"synlint: --observed {args.observed}: {e}",
+                  file=sys.stderr)
+            return 2
+        ds_findings, coverage = cross_check(prog, arts)
+        ds_findings = [f for f in ds_findings
+                       if not prog.suppressed(f.path, f.line, f.rule)]
+        observed_edges = sum(len(a.get("edges", ())) for a in arts)
+        dynsan_stats = {
+            "artifacts": len(arts),
+            "observed_edges": observed_edges,
+            "model_gaps": sum(1 for f in ds_findings
+                              if f.rule == "DS001"),
+            "runtime_findings": sum(1 for f in ds_findings
+                                    if f.rule != "DS001"),
+            "coverage_gaps": len(coverage),
+        }
+        findings = sorted(findings + ds_findings,
+                          key=lambda f: (f.path, f.line, f.rule))
 
     if args.write_knob_table:
         doc_path = os.path.join(prog.root, KNOB_DOC)
@@ -164,7 +196,7 @@ def main(argv=None) -> int:
             new = [f for f in new if f.path in changed]
 
     if args.sarif:
-        write_sarif(args.sarif, new)
+        write_sarif(args.sarif, new, coverage)
 
     packs: dict = {}
     for f in findings:
@@ -180,10 +212,20 @@ def main(argv=None) -> int:
             "cache": stats,
             "runtime_s": round(runtime_s, 3),
             "findings": [f.to_json() for f in new],
+            **({"dynsan": {**dynsan_stats,
+                           "coverage": [f.to_json() for f in coverage]}}
+               if dynsan_stats is not None else {}),
         }))
     else:
         for f in new:
             print(f.render())
+        if dynsan_stats is not None:
+            print(f"dynsan: {dynsan_stats['artifacts']} artifact(s), "
+                  f"{dynsan_stats['observed_edges']} observed edge(s), "
+                  f"{dynsan_stats['model_gaps']} model gap(s), "
+                  f"{dynsan_stats['runtime_findings']} runtime "
+                  f"finding(s), {dynsan_stats['coverage_gaps']} static "
+                  "edge(s) never observed", file=sys.stderr)
         for entry in stale:
             print(f"stale baseline entry: {entry['rule']} "
                   f"{entry['path']} [{entry['context']}] — run "

@@ -35,7 +35,7 @@ ALL_RULES = "ALL"
 # rule-id prefix -> pack name (what bench.py and --json report per pack)
 RULE_PACKS = {"JH": "jax", "CC": "concurrency", "RL": "lifecycle",
               "EH": "errors", "EV": "env", "PL": "pallas", "DR": "drift",
-              "SYN": "engine"}
+              "DS": "dynsan", "SYN": "engine"}
 
 
 def pack_of(rule: str) -> str:
@@ -347,11 +347,11 @@ class Program:
 
 def _packs():
     from tools.analysis import (rules_concurrency, rules_drift,
-                                rules_errors, rules_env, rules_jax,
-                                rules_lifecycle, rules_pallas)
+                                rules_dynsan, rules_errors, rules_env,
+                                rules_jax, rules_lifecycle, rules_pallas)
 
     return (rules_jax, rules_concurrency, rules_lifecycle, rules_errors,
-            rules_env, rules_pallas, rules_drift)
+            rules_env, rules_pallas, rules_drift, rules_dynsan)
 
 
 def summarize_module(ctx: ModuleContext) -> Dict[str, Any]:

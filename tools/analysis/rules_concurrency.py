@@ -12,6 +12,8 @@ Model (heuristic, lexical — documented in docs/analysis.md):
   creating thread (over-approximate on purpose).
 - A write is *guarded* when it sits lexically inside a ``with <lock>:``
   block; lock-ness is detected from ``threading.Lock()``/``RLock()``
+  and ``make_lock()``/``make_rlock()``/``make_condition()`` (the
+  runtime/locksan.py factory every package lock is built through)
   assignments plus a name heuristic ("lock" in the identifier).
 - A field is *shared* when written (outside ``__init__``) from two or
   more functions at least one of which is thread-reachable, or when its
@@ -40,10 +42,12 @@ from tools.analysis.findings import Finding
 
 PACK = "concurrency"
 
-_LOCK_CTORS = re.compile(r"threading\.(R?Lock|Condition)\b|\b(R?Lock)\(\)")
+_LOCK_CTORS = re.compile(r"threading\.(R?Lock|Condition)\b|\b(R?Lock)\(\)"
+                         r"|\bmake_(lock|rlock|condition)\(")
 _THREADSAFE_CTORS = re.compile(
     r"(queue|_queue)\.(Lifo|Priority)?Queue\(|threading\.(Event|Semaphore|"
-    r"BoundedSemaphore|Barrier|R?Lock|Condition)\(|Event\(\)|Semaphore\(")
+    r"BoundedSemaphore|Barrier|R?Lock|Condition)\(|Event\(\)|Semaphore\("
+    r"|\bmake_(lock|rlock|condition)\(")
 _MUTATION_METHODS = {"append", "appendleft", "extend", "insert", "remove",
                      "pop", "popleft", "popitem", "clear", "update", "add",
                      "discard", "setdefault"}
@@ -448,6 +452,56 @@ def _fn_blocks(prog: Program, rel: str, fn: Dict[str, Any]
     return None
 
 
+def static_adjacency(prog: Program,
+                     findings: Optional[List[Finding]] = None
+                     ) -> Dict[str, Dict[str, Tuple[str, int, int, str]]]:
+    """The static CC002 acquisition-order model: lock id -> lock id ->
+    (path, line, col, qualname) for every ordered pair the AST can see,
+    directly nested or through the bounded interprocedural closure.
+    This is the closure tools/analysis/rules_dynsan.py diffs the
+    runtime-observed graph against. When ``findings`` is given, the
+    same-lock re-acquisition findings the walk trips over are appended
+    (run_global passes it; rules_dynsan doesn't — those findings are
+    CC002's to report exactly once)."""
+    adj: Dict[str, Dict[str, Tuple[str, int, int, str]]] = {}
+    memo: Dict[Tuple[str, str], Set[str]] = {}
+    for rel in sorted(prog.summaries):
+        summary = prog.summaries[rel]
+        cc = summary.get(PACK)
+        if not cc:
+            continue
+        for fn in cc.get("functions", ()):
+            if findings is not None:
+                for text, line, col in fn.get("self_edges", ()):
+                    findings.append(Finding(
+                        rule="CC002", path=rel, line=line, col=col,
+                        context=fn["qual"],
+                        message=f"lock {text} re-acquired while already "
+                                "held — deadlock for a non-reentrant "
+                                "Lock"))
+            for outer, inner, _ot, _it, line, col in fn.get("edges", ()):
+                adj.setdefault(outer, {}).setdefault(
+                    inner, (rel, line, col, fn["qual"]))
+            for lid, ltext, callee, line in fn.get("under_lock_calls", ()):
+                for trel, tfn in prog.resolve_call(summary, callee):
+                    closure = _lock_closure(prog, trel, tfn, memo)
+                    for lid2 in closure:
+                        if lid2 == lid:
+                            if findings is not None:
+                                findings.append(Finding(
+                                    rule="CC002", path=rel, line=line,
+                                    col=0, context=fn["qual"],
+                                    message=f"call {callee}(...) while "
+                                            f"holding {ltext} re-acquires "
+                                            f"it (via {trel}:"
+                                            f"{tfn['line']}) — deadlock "
+                                            "for a non-reentrant Lock"))
+                        else:
+                            adj.setdefault(lid, {}).setdefault(
+                                lid2, (rel, line, 0, fn["qual"]))
+    return adj
+
+
 def run_global(prog: Program) -> List[Finding]:
     findings: List[Finding] = []
     reachable_by_mod = _reachable_by_module(prog)
@@ -488,39 +542,7 @@ def run_global(prog: Program) -> List[Finding]:
                             f"{w['fn']!r} ({why}) — hold the owning lock"))
 
     # -- CC002: lock-order cycles, direct + through resolved callees ----
-    adj: Dict[str, Dict[str, Tuple[str, int, int, str]]] = {}
-    memo: Dict[Tuple[str, str], Set[str]] = {}
-    for rel in sorted(prog.summaries):
-        summary = prog.summaries[rel]
-        cc = summary.get(PACK)
-        if not cc:
-            continue
-        for fn in cc.get("functions", ()):
-            for text, line, col in fn.get("self_edges", ()):
-                findings.append(Finding(
-                    rule="CC002", path=rel, line=line, col=col,
-                    context=fn["qual"],
-                    message=f"lock {text} re-acquired while already held "
-                            "— deadlock for a non-reentrant Lock"))
-            for outer, inner, _ot, _it, line, col in fn.get("edges", ()):
-                adj.setdefault(outer, {}).setdefault(
-                    inner, (rel, line, col, fn["qual"]))
-            for lid, ltext, callee, line in fn.get("under_lock_calls", ()):
-                for trel, tfn in prog.resolve_call(summary, callee):
-                    closure = _lock_closure(prog, trel, tfn, memo)
-                    for lid2 in closure:
-                        if lid2 == lid:
-                            findings.append(Finding(
-                                rule="CC002", path=rel, line=line, col=0,
-                                context=fn["qual"],
-                                message=f"call {callee}(...) while "
-                                        f"holding {ltext} re-acquires it "
-                                        f"(via {trel}:{tfn['line']}) — "
-                                        "deadlock for a non-reentrant "
-                                        "Lock"))
-                        else:
-                            adj.setdefault(lid, {}).setdefault(
-                                lid2, (rel, line, 0, fn["qual"]))
+    adj = static_adjacency(prog, findings)
     reported: Set[frozenset] = set()
     for a, inners in sorted(adj.items()):
         for b, (rel, line, col, qual) in sorted(inners.items()):

@@ -35,7 +35,7 @@ DASHBOARD = os.path.join("tools", "k8s", "chart", "dashboards",
                          "serving-dashboard.json")
 PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
             "fleet_", "process_", "trace_", "capture_", "gbdt_",
-            "onnx_", "autotune_", "tp_", "kv_", "decode_")
+            "onnx_", "autotune_", "tp_", "kv_", "decode_", "locksan_")
 REGISTER_FNS = {"counter", "gauge", "gauge_fn", "histogram"}
 
 

@@ -1,0 +1,195 @@
+"""DS rules: the runtime sanitizer's observed graph cross-validated
+against the static CC002 model (the Coverity lesson: static findings
+rot unless checked against real executions).
+
+``synapseml_tpu/runtime/locksan.py`` labels every lock with its static
+CC002 identity (``modstem:NAME`` / ``Class.attr``), so the observed
+acquisition-order graph it dumps (``SYNAPSEML_LOCKSAN_OUT``) and the
+adjacency :func:`tools.analysis.rules_concurrency.static_adjacency`
+builds speak the same vocabulary and can be diffed edge by edge:
+
+DS001  model gap: an edge the runtime OBSERVED but the static closure
+       cannot reach — aliasing or callback indirection the AST can't
+       see. Reported at the observed inner-acquire site; a
+       ``# synlint: disable=DS001`` there declares the nesting
+       understood (typical for leaf locks that may nest under
+       anything).
+DS002  runtime lock-order inversion (a cycle in the observed graph)
+DS003  runtime blocking call while holding a lock (dynamic CC003)
+DS004  deadlock watchdog event: a thread parked past the threshold on
+       a lock whose holder was itself parked
+
+Statically-claimed-but-never-observed edges are NOT findings — they
+become *coverage annotations* (the smoke didn't drive that path), and
+ride the report/SARIF as notes without failing the gate.
+
+Artifacts come in through ``python -m tools.analysis --observed PATH``
+(a file, or a directory of ``locksan-*.json`` from a multi-process
+smoke). The fixture suite uses a sidecar convention instead: a module
+``foo.py`` with ``foo.observed.json`` next to it is cross-checked by
+the ordinary :func:`run_global` pass — that is what lets the
+bad/good-twin fixtures exercise DS001 without a CLI flag.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Sequence, Tuple
+
+from tools.analysis.engine import Program
+from tools.analysis.findings import Finding
+from tools.analysis.rules_concurrency import static_adjacency
+
+PACK = "dynsan"
+
+# findings kinds in the artifact -> rule id
+_KIND_RULES = {"inversion": "DS002", "blocking": "DS003",
+               "deadlock": "DS004"}
+
+
+def load_artifacts(path: str) -> List[Dict[str, Any]]:
+    """Load one artifact file, or every ``locksan-*.json`` under a
+    directory (each process in a multi-process smoke dumps its own).
+    Raises ``ValueError`` for an empty directory or a non-locksan
+    payload — a missing artifact must fail loudly, or the cross-check
+    silently passes on nothing."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "locksan-*.json")))
+        if not files:
+            raise ValueError(f"no locksan-*.json artifacts under {path}")
+    else:
+        files = [path]
+    arts: List[Dict[str, Any]] = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            art = json.load(fh)
+        if not isinstance(art, dict) or art.get("tool") != "locksan":
+            raise ValueError(f"{f}: not a locksan observed-graph artifact")
+        arts.append(art)
+    return arts
+
+
+def _rel_site(site: str, root: str) -> Tuple[str, int]:
+    """``path:line`` from the artifact -> (repo-relative posix path,
+    line). Runtime sites are absolute; fixture sidecars may already be
+    relative."""
+    path, _, line = str(site).rpartition(":")
+    try:
+        lineno = int(line)
+    except ValueError:
+        path, lineno = str(site), 0
+    if os.path.isabs(path):
+        try:
+            path = os.path.relpath(path, root)
+        except ValueError:  # different drive (windows) — keep absolute
+            pass
+    return path.replace(os.sep, "/"), lineno
+
+
+def _merge_edges(arts: Sequence[Dict[str, Any]]
+                 ) -> Dict[Tuple[str, str], Tuple[int, str]]:
+    """(outer, inner) -> (summed count, first site) across artifacts."""
+    merged: Dict[Tuple[str, str], Tuple[int, str]] = {}
+    for art in arts:
+        for e in art.get("edges", ()):
+            key = (str(e.get("outer")), str(e.get("inner")))
+            count = int(e.get("count", 1))
+            site = str(e.get("site", "<unknown>:0"))
+            prev = merged.get(key)
+            merged[key] = (prev[0] + count, prev[1]) if prev \
+                else (count, site)
+    return merged
+
+
+def _reaches(adj: Dict[str, Dict[str, Any]], start: str,
+             goal: str) -> bool:
+    """Static model reachability start => goal: an observed direct edge
+    is *modeled* when the static closure orders the pair at all, even
+    through intermediate locks."""
+    stack, seen = [start], set()
+    while stack:
+        node = stack.pop()
+        if node == goal:
+            return True
+        if node in seen:
+            continue
+        seen.add(node)
+        stack.extend(adj.get(node, ()))
+    return False
+
+
+def cross_check(prog: Program, arts: Sequence[Dict[str, Any]]
+                ) -> Tuple[List[Finding], List[Finding]]:
+    """Diff observed vs static. Returns ``(findings, coverage)``:
+    findings are DS001 model gaps plus every runtime finding the
+    sanitizer recorded (DS002/DS003/DS004); coverage is the list of
+    statically-claimed-but-never-observed edges as note-level
+    pseudo-findings (never part of the gate)."""
+    adj = static_adjacency(prog)
+    observed = _merge_edges(arts)
+    findings: List[Finding] = []
+
+    for (outer, inner), (_count, site) in sorted(observed.items()):
+        if _reaches(adj, outer, inner):
+            continue
+        path, line = _rel_site(site, prog.root)
+        findings.append(Finding(
+            rule="DS001", path=path, line=line, col=0,
+            context=f"{outer} -> {inner}",
+            message=f"observed lock-order edge {outer} -> {inner} is "
+                    "absent from the static CC002 model — aliasing or "
+                    "indirection the AST can't see; teach the model, "
+                    "fix the nesting, or annotate the acquire site"))
+
+    for art in arts:
+        for f in art.get("findings", ()):
+            rule = _KIND_RULES.get(str(f.get("kind", "")))
+            if rule is None:
+                continue
+            path, line = _rel_site(str(f.get("site", "<unknown>:0")),
+                                   prog.root)
+            detail = str(f.get("detail", f.get("kind")))
+            ctx = str(f.get("lock") or
+                      f"{f.get('outer')} -> {f.get('inner')}")
+            findings.append(Finding(
+                rule=rule, path=path, line=line, col=0, context=ctx,
+                message=f"runtime sanitizer: {detail}"))
+
+    coverage: List[Finding] = []
+    for outer in sorted(adj):
+        for inner, (rel, line, _col, qual) in sorted(adj[outer].items()):
+            if (outer, inner) in observed:
+                continue
+            coverage.append(Finding(
+                rule="DS900", path=rel, line=line, col=0, context=qual,
+                message=f"static lock-order edge {outer} -> {inner} "
+                        "never observed at runtime — the sanitized "
+                        "smokes did not drive this path"))
+    return findings, coverage
+
+
+def _sidecar_artifacts(prog: Program) -> List[Dict[str, Any]]:
+    arts: List[Dict[str, Any]] = []
+    for rel in sorted(prog.summaries):
+        if not rel.endswith(".py"):
+            continue
+        sidecar = os.path.join(prog.root, rel[:-3] + ".observed.json")
+        if os.path.isfile(sidecar):
+            try:
+                arts.extend(load_artifacts(sidecar))
+            except (ValueError, json.JSONDecodeError, OSError):
+                continue  # a broken sidecar is a fixture bug, not ours
+    return arts
+
+
+def run_global(prog: Program) -> List[Finding]:
+    """Fixture-convention pass: cross-check any module that ships a
+    ``*.observed.json`` sidecar. The real CI artifact goes through the
+    CLI's ``--observed`` instead (tools/analysis/__main__.py), which
+    also reports coverage; this pass returns findings only."""
+    arts = _sidecar_artifacts(prog)
+    if not arts:
+        return []
+    findings, _coverage = cross_check(prog, arts)
+    return findings

@@ -6,7 +6,7 @@ as long as its baseline entry would)."""
 from __future__ import annotations
 
 import json
-from typing import Dict, List
+from typing import Dict, List, Sequence
 
 from tools.analysis.findings import Finding
 
@@ -14,13 +14,18 @@ _SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
            "master/Schemata/sarif-schema-2.1.0.json")
 
 
-def to_sarif(findings: List[Finding]) -> Dict:
-    rules = sorted({f.rule for f in findings})
+def to_sarif(findings: List[Finding],
+             notes: Sequence[Finding] = ()) -> Dict:
+    """``notes`` are informational results (dynsan coverage
+    annotations): same shape, SARIF level "note", and deliberately NOT
+    part of the gate — they ride the report, not the exit code."""
+    rules = sorted({f.rule for f in findings} | {f.rule for f in notes})
     results = []
-    for f in findings:
+    for f, level in [(f, "warning") for f in findings] + \
+                    [(f, "note") for f in notes]:
         results.append({
             "ruleId": f.rule,
-            "level": "warning",
+            "level": level,
             "message": {"text": f"{f.message} [{f.context}]"},
             "locations": [{
                 "physicalLocation": {
@@ -49,7 +54,8 @@ def to_sarif(findings: List[Finding]) -> Dict:
     }
 
 
-def write_sarif(path: str, findings: List[Finding]) -> None:
+def write_sarif(path: str, findings: List[Finding],
+                notes: Sequence[Finding] = ()) -> None:
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump(to_sarif(findings), fh, indent=1)
+        json.dump(to_sarif(findings, notes), fh, indent=1)
         fh.write("\n")

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Lock-sanitizer smoke (docs/analysis.md "Dynamic sanitizer"): run the
+# chaos and decode smokes with SYNAPSEML_LOCKSAN=1 so every sanitized
+# lock in the serving stack — breaker trips, drain-thread kills,
+# scrape-vs-drain interleavings, decode scheduler wait loops — executes
+# under runtime lock-order/blocking/deadlock detection, with each
+# process dumping its observed-graph artifact into
+# SYNAPSEML_LOCKSAN_OUT. Then close the static<->dynamic loop:
+# `python -m tools.analysis --observed` diffs the merged observed
+# graph against synlint's CC002 closure and gates (--fail-on-new) on
+# model-gap edges AND on any runtime inversion/blocking/deadlock
+# finding the sanitizer recorded — zero findings or red X. The env
+# vars are exported BEFORE the interpreters start so the import-time
+# enable path is itself under test. A deadlocked pipeline HANGS rather
+# than fails, so the hard wall-clock timeouts turn it into a fast
+# exit-124; the artifact directory survives for CI upload either way.
+#
+# Usage: tools/ci/smoke_locksan.sh   [SMOKE_TIMEOUT=seconds]
+#                                    [LOCKSAN_OUT=dir]
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export SYNAPSEML_LOCKSAN=1
+export SYNAPSEML_LOCKSAN_OUT="${LOCKSAN_OUT:-/tmp/locksan-smoke}"
+rm -rf "$SYNAPSEML_LOCKSAN_OUT"
+mkdir -p "$SYNAPSEML_LOCKSAN_OUT"
+
+SMOKE_TIMEOUT="${SMOKE_TIMEOUT:-360}" bash tools/ci/smoke_chaos.sh
+SMOKE_TIMEOUT="${SMOKE_TIMEOUT:-600}" bash tools/ci/smoke_decode.sh
+
+ls "$SYNAPSEML_LOCKSAN_OUT"/locksan-*.json >/dev/null  # artifacts exist
+timeout -k 10 120 \
+  python -m tools.analysis --observed "$SYNAPSEML_LOCKSAN_OUT" \
+  --fail-on-new
+echo "locksan smoke ok: observed graph cross-checked clean" \
+  "($(ls "$SYNAPSEML_LOCKSAN_OUT"/locksan-*.json | wc -l) artifacts)"

@@ -82,6 +82,7 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 from synapseml_tpu.runtime import autoscale as _as  # noqa: E402
+from synapseml_tpu.runtime.locksan import make_lock  # noqa: E402
 from synapseml_tpu.runtime import blackbox as _bb  # noqa: E402
 from synapseml_tpu.runtime import perfwatch as _pw  # noqa: E402
 from synapseml_tpu.runtime import telemetry as _tm  # noqa: E402
@@ -127,7 +128,7 @@ class LocalReplica:
         self.lines: List[str] = []
         self.accounting: Optional[Dict[str, int]] = None
         self._url_found = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("LocalReplica._lock")
         self._reader = threading.Thread(
             target=self._read_stdout, name=f"fleet-stdout-{name}",
             daemon=True)
@@ -322,7 +323,7 @@ class FleetController:
         self._terminations: List[Dict[str, Any]] = []
         self._decisions: List[Dict[str, Any]] = []
         self._aggregates: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("FleetController._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._httpd: Optional[http.server.ThreadingHTTPServer] = None

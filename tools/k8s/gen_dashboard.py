@@ -37,7 +37,7 @@ OUT = os.path.join(HERE, "chart", "dashboards",
 
 PREFIXES = ("serving_", "executor_", "faults_", "blackbox_", "device_",
             "fleet_", "process_", "trace_", "capture_", "gbdt_",
-            "onnx_", "autotune_", "tp_", "kv_", "decode_")
+            "onnx_", "autotune_", "tp_", "kv_", "decode_", "locksan_")
 _NAME = re.compile(r"([a-z][a-z0-9_]*)(\{([a-z_=,]*)\})?")
 
 

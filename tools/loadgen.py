@@ -78,6 +78,12 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+try:
+    from synapseml_tpu.runtime.locksan import make_lock
+except ImportError:  # standalone invocation without the repo on sys.path
+    def make_lock(name):  # type: ignore[misc]
+        return threading.Lock()
+
 
 def _record_payload(rec: Dict[str, Any]) -> Optional[bytes]:
     """A capture record's request body back as bytes (inline utf-8 or
@@ -217,7 +223,7 @@ def run_load(url: Optional[str], rps: float, duration_s: float,
     results: List[Optional[Tuple[Any, float, Optional[str], str,
                                  str]]] = []
     senders: List[threading.Thread] = []
-    lock = threading.Lock()
+    lock = make_lock("loadgen:lock")
     per_target: Dict[str, Dict[str, Any]] = {
         t: {"by_status": {}, "ok_lat": []} for t in target_list}
     failovers = [0]
@@ -452,7 +458,7 @@ def run_decode_load(url: str, rps: float, duration_s: float,
     output_lens = list(output_lens) or [16]
     results: List[Optional[Dict[str, Any]]] = []
     senders: List[threading.Thread] = []
-    lock = threading.Lock()
+    lock = make_lock("loadgen:lock")
 
     def sender(i: int, body: bytes, traceparent: str):
         hdrs = {"Content-Type": "application/json",
